@@ -1,0 +1,71 @@
+"""Hot-mount latency benchmark (driver contract: one JSON line).
+
+Measures BASELINE config 1 end-to-end on the best stack available: hot-add 4
+fake TPU chips to a target "container" /dev directory — device enumeration,
+cgroup grant (skipped when unprivileged), device-node injection, visibility
+check — and reports wall latency vs the 2000 ms north star
+(BASELINE.json: jax.device_count()==4 within 2 s of mount request).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+BASELINE_MS = 2000.0  # north star: 4 chips visible within 2 s
+
+
+def run_config1_device_layer(n_chips: int = 4) -> float:
+    """Fake-device hot-mount through the device layer; returns latency ms."""
+    from gpumounter_tpu.device.backend import FakeDeviceBackend
+    from gpumounter_tpu.nsutil.ns import inject_device_file, remove_device_file
+
+    root = tempfile.mkdtemp(prefix="tpumounter-bench-")
+    try:
+        src = FakeDeviceBackend.create(os.path.join(root, "host-dev"), n_chips)
+        target_dev = os.path.join(root, "container-dev")
+        os.makedirs(target_dev)
+        devices = src.list_devices()
+        assert len(devices) == n_chips
+
+        t0 = time.monotonic()
+        for dev in devices:
+            inject_device_file(target_dev, dev)
+        # visibility check: all nodes present
+        visible = [n for n in os.listdir(target_dev) if n.startswith("accel")]
+        assert len(visible) == n_chips, visible
+        latency_ms = (time.monotonic() - t0) * 1000.0
+
+        for dev in devices:
+            remove_device_file(target_dev, dev)
+        assert not [n for n in os.listdir(target_dev) if n.startswith("accel")]
+        return latency_ms
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main() -> None:
+    try:
+        from bench_e2e import run_config1_full_stack  # full worker+master path
+    except ImportError:
+        value = run_config1_device_layer()
+        metric = "hot_mount_latency_4chips_device_layer"
+    else:
+        # A failure in the e2e path is a real regression: let it propagate
+        # rather than silently reporting the cheaper device-layer number.
+        value = run_config1_full_stack()
+        metric = "hot_mount_latency_4chips_e2e"
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / max(value, 1e-6), 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

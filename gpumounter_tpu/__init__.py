@@ -1,0 +1,34 @@
+"""gpumounter_tpu — TPU-native hot-mount framework for Kubernetes Pods.
+
+A ground-up, TPU-first re-design of the capabilities of GPUMounter
+(reference: jason-gideon/GPUMounter): dynamically add/remove accelerator
+devices to/from *running* Pods without restart, scheduler-coherently.
+
+Where the reference is Go + NVML (cgo) + cgroup-v1 `devices.allow` writes +
+`nsenter` shell-outs, this framework is:
+
+  * Python control plane (master HTTP gateway, per-node worker gRPC daemon,
+    allocator, collector) — no NVIDIA stack anywhere in the loop.
+  * C++ native layer (``native/``) for the host/kernel boundary: `/dev/accel*`
+    discovery, `/proc/*/fd` busy scanning, cgroup-v2 eBPF
+    `BPF_PROG_TYPE_CGROUP_DEVICE` programs, and a `setns(2)`+`mknod(2)`
+    helper — direct syscalls, no `sh -c` string building.
+  * JAX tenant-side library (``gpumounter_tpu.jaxside``) so a running JAX
+    process observes hot-mounted chips (`jax.devices()` refresh), plus the
+    mesh/topology machinery to resume SPMD work over the new chip set.
+
+Layer map (parity with reference SURVEY.md §1):
+  master/    — L1 HTTP API gateway
+  rpc/       — L2 RPC contract (protobuf wire-level, reference api.proto parity)
+  worker/    — L3 per-node daemon + mount orchestration (reference pkg/server, pkg/util/util.go)
+  allocator/ — L4 scheduler-coherent allocation (reference pkg/util/gpu/allocator)
+  collector/ — L5 device inventory + pod<->device map (reference pkg/util/gpu/collector)
+  cgroup/    — L6 device cgroup grant/revoke, v1 + v2-eBPF (reference pkg/util/cgroup)
+  nsutil/    — L6 namespace entry / device-file ops (reference pkg/util/namespace)
+  device/    — L7 TPU device layer (replaces reference pkg/device + nvml cgo bindings)
+  k8s/       — minimal Kubernetes REST client + fake (replaces client-go usage)
+  config/, utils/ — L8 cross-cutting
+  jaxside/, models/, ops/, parallel/ — tenant-side JAX visibility + workload
+"""
+
+__version__ = "0.1.0"

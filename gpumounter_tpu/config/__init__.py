@@ -1,0 +1,3 @@
+from gpumounter_tpu.config.config import Config, get_config, set_config
+
+__all__ = ["Config", "get_config", "set_config"]

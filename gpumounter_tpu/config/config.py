@@ -1,0 +1,107 @@
+"""Configuration for master and worker daemons.
+
+The reference has almost no config surface (SURVEY.md §5): one env var
+CGROUP_DRIVER (pkg/util/cgroup/cgroup.go:78-84), hardcoded ports
+(cmd/GPUMounter-master/main.go:237 → 8080, cmd/GPUMounter-worker/main.go:24 →
+1200), hardcoded in-cluster=true (pkg/config/config.go:31), hardcoded kubelet
+socket / pool namespace / resource name (pkg/util/gpu/types.go:6-18).
+
+Here every knob is an env var with the reference's value as default, gathered
+in one dataclass. TPU-specific swaps: resource name nvidia.com/gpu →
+google.com/tpu, pool namespace gpu-pool → tpu-pool, device prefix /dev/nvidia
+→ /dev/accel.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclass
+class Config:
+    # --- Kubernetes resource model ---
+    # Reference: NvidiaResourceName = "nvidia.com/gpu" (pkg/util/gpu/types.go:10)
+    tpu_resource_name: str = field(default_factory=lambda: _env("TPU_RESOURCE_NAME", "google.com/tpu"))
+    # Reference: GPUPoolNamespace = "gpu-pool" (pkg/util/gpu/types.go:18)
+    pool_namespace: str = field(default_factory=lambda: _env("TPU_POOL_NAMESPACE", "tpu-pool"))
+    # Slave-pod image; reference uses alpine sleep-loop (allocator.go:219-226)
+    slave_pod_image: str = field(default_factory=lambda: _env("SLAVE_POD_IMAGE", "alpine:latest"))
+
+    # --- kubelet pod-resources API ---
+    # Reference: /var/lib/kubelet/pod-resources/kubelet.sock (types.go:6-7)
+    kubelet_socket: str = field(default_factory=lambda: _env(
+        "KUBELET_POD_RESOURCES_SOCKET", "/var/lib/kubelet/pod-resources/kubelet.sock"))
+    # Reference uses v1alpha1 (collector.go:16); modern kubelets serve v1.
+    pod_resources_api: str = field(default_factory=lambda: _env("POD_RESOURCES_API", "auto"))
+    kubelet_conn_timeout_s: float = field(default_factory=lambda: float(_env("KUBELET_CONN_TIMEOUT_S", "10")))
+
+    # --- daemon ports ---
+    worker_port: int = field(default_factory=lambda: int(_env("WORKER_PORT", "1200")))
+    master_port: int = field(default_factory=lambda: int(_env("MASTER_PORT", "8080")))
+    metrics_port: int = field(default_factory=lambda: int(_env("METRICS_PORT", "9400")))
+
+    # --- device layer ---
+    # Real TPU device nodes: /dev/accel0..N (v4/v5e/v5p/v6e accel class) and
+    # legacy /dev/vfio paths. FAKE_DEVICE_DIR switches the device backend to a
+    # directory of fake char devices (BASELINE config 1 dry-run).
+    device_dir: str = field(default_factory=lambda: _env("DEVICE_DIR", "/dev"))
+    fake_device_dir: str = field(default_factory=lambda: _env("FAKE_DEVICE_DIR", ""))
+    libtpu_path: str = field(default_factory=lambda: _env("LIBTPU_PATH", "libtpu.so"))
+
+    # --- cgroup layer ---
+    # Reference: env CGROUP_DRIVER in {systemd, cgroupfs} (cgroup.go:78-84).
+    # "auto" sniffs /sys/fs/cgroup. CGROUP_VERSION auto-detects v1 vs v2.
+    cgroup_driver: str = field(default_factory=lambda: _env("CGROUP_DRIVER", "auto"))
+    cgroup_root: str = field(default_factory=lambda: _env("CGROUP_ROOT", "/sys/fs/cgroup"))
+    cgroup_version: str = field(default_factory=lambda: _env("CGROUP_VERSION", "auto"))
+
+    # --- allocator behaviour ---
+    # Reference busy-polls pod phase unboundedly (allocator.go:246-317); we
+    # use the watch API with a hard timeout.
+    slave_pod_timeout_s: float = field(default_factory=lambda: float(_env("SLAVE_POD_TIMEOUT_S", "120")))
+    slave_pod_name_suffix: str = "-slave-pod-"
+
+    # --- worker discovery (master side) ---
+    worker_label_selector: str = field(default_factory=lambda: _env(
+        "WORKER_LABEL_SELECTOR", "app=tpu-mounter-worker"))
+    worker_namespace: str = field(default_factory=lambda: _env("WORKER_NAMESPACE", "kube-system"))
+
+    # --- logging ---
+    log_dir: str = field(default_factory=lambda: _env("TPUMOUNTER_LOG_DIR", "/var/log/tpumounter"))
+
+    # --- native layer ---
+    native_lib: str = field(default_factory=lambda: _env("TPUMOUNTER_NATIVE_LIB", ""))
+    nsexec_bin: str = field(default_factory=lambda: _env("TPUMOUNTER_NSEXEC", ""))
+
+    def replace(self, **kwargs) -> "Config":
+        vals = {f.name: getattr(self, f.name) for f in fields(self)}
+        vals.update(kwargs)
+        out = Config.__new__(Config)
+        for k, v in vals.items():
+            object.__setattr__(out, k, v)
+        return out
+
+
+_lock = threading.Lock()
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config()
+        return _config
+
+
+def set_config(cfg: Config) -> None:
+    """Test/bench hook: install an explicit config."""
+    global _config
+    with _lock:
+        _config = cfg

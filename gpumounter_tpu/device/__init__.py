@@ -1,0 +1,21 @@
+from gpumounter_tpu.device.tpu import (
+    TPU_ALLOCATED_STATE,
+    TPU_FREE_STATE,
+    TpuDevice,
+)
+from gpumounter_tpu.device.backend import (
+    DeviceBackend,
+    FakeDeviceBackend,
+    RealAccelBackend,
+    backend_from_config,
+)
+
+__all__ = [
+    "TPU_ALLOCATED_STATE",
+    "TPU_FREE_STATE",
+    "TpuDevice",
+    "DeviceBackend",
+    "FakeDeviceBackend",
+    "RealAccelBackend",
+    "backend_from_config",
+]

@@ -1,0 +1,257 @@
+"""Device backends: real /dev/accel* enumeration and a fake for dry-runs.
+
+Replaces the reference's NVML enumeration path (collector.go:40-79 calling
+nvml.Init / DeviceGetCount / GetHandleByIndex / MinorNumber / UUID through the
+cgo dlopen shim nvml_dl.go:29-36). TPU chips appear as accel-class character
+devices; no driver library is required to enumerate them — readdir + stat(2)
++ sysfs reads suffice, with an optional native fast path (see native.py).
+
+Busy detection replaces NVML's GetComputeRunningProcesses (nvml.go:33-52):
+scan /proc/<pid>/fd for open descriptors whose target is the device node
+(matched by rdev, so it works across mount namespaces / renamed device
+files). Note TPU runtime semantics: libtpu holds the chip open for the life
+of the JAX process, so "busy" is the common case (SURVEY.md §7) — remove
+flows lean on `force`.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+import re
+import stat as statmod
+
+from gpumounter_tpu.device.tpu import TpuDevice, stat_device_numbers
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("device")
+
+_ACCEL_RE = re.compile(r"^accel(\d+)$")
+# vfio-based TPU VMs expose /dev/vfio/<group>; accel class is the modern path.
+_VFIO_RE = re.compile(r"^(\d+)$")
+
+
+class DeviceBackend(abc.ABC):
+    """Enumeration + identity + busy primitives behind one interface."""
+
+    @abc.abstractmethod
+    def list_devices(self) -> list[TpuDevice]: ...
+
+    @abc.abstractmethod
+    def device_by_uuid(self, uuid: str) -> TpuDevice | None: ...
+
+    def running_pids(self, device: TpuDevice) -> list[int]:
+        """PIDs (host view) holding the device node open."""
+        return scan_proc_for_device(device.major, device.minor,
+                                    path_hint=device.device_path)
+
+
+class RealAccelBackend(DeviceBackend):
+    """Enumerates accel-class TPU chardevs under device_dir (default /dev).
+
+    Identity: sysfs PCI address when available
+    (/sys/class/accel/accelN/device is a symlink into the PCI tree), else
+    "tpu-<node>-accelN". The reference's analog is the NVML UUID
+    (nvml.go:107-119); PCI addresses are the TPU-native stable handle and
+    are what the GKE TPU device-plugin topology is keyed on.
+    """
+
+    def __init__(self, device_dir: str = "/dev",
+                 sysfs_accel_dir: str = "/sys/class/accel"):
+        self.device_dir = device_dir
+        self.sysfs_accel_dir = sysfs_accel_dir
+
+    def _chip_uuid(self, name: str, index: int) -> str:
+        dev_link = os.path.join(self.sysfs_accel_dir, name, "device")
+        try:
+            target = os.readlink(dev_link)
+            pci = os.path.basename(target)
+            if pci:
+                return f"tpu-pci-{pci}"
+        except OSError:
+            pass
+        node = os.uname().nodename
+        return f"tpu-{node}-accel{index}"
+
+    def list_devices(self) -> list[TpuDevice]:
+        devices: list[TpuDevice] = []
+        try:
+            names = sorted(os.listdir(self.device_dir))
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _ACCEL_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.device_dir, name)
+            try:
+                major, minor, is_char = stat_device_numbers(path)
+            except OSError:
+                continue
+            if not is_char:
+                continue
+            index = int(m.group(1))
+            devices.append(TpuDevice(
+                index=index, device_path=path, major=major, minor=minor,
+                uuid=self._chip_uuid(name, index)))
+        devices.sort(key=lambda d: d.index)
+        return devices
+
+    def device_by_uuid(self, uuid: str) -> TpuDevice | None:
+        for dev in self.list_devices():
+            if dev.uuid == uuid:
+                return dev
+        return None
+
+
+class FakeDeviceBackend(DeviceBackend):
+    """Fake chip inventory over a plain directory (BASELINE config 1).
+
+    Layout: <dir>/accelN are the "device nodes". When the process has
+    CAP_MKNOD they are real char devices cloned from /dev/null's rdev so the
+    whole mount path (cgroup grant + mknod into the container) is exercised
+    for real; otherwise regular files with pseudo major:minor recorded in
+    <dir>/meta.json so enumeration logic still runs everywhere.
+    """
+
+    META = "meta.json"
+
+    def __init__(self, root: str):
+        self.root = root
+
+    @classmethod
+    def create(cls, root: str, count: int) -> "FakeDeviceBackend":
+        os.makedirs(root, exist_ok=True)
+        meta: dict[str, dict] = {}
+        null_rdev = None
+        try:
+            st = os.stat("/dev/null")
+            if statmod.S_ISCHR(st.st_mode):
+                null_rdev = st.st_rdev
+        except OSError:
+            pass
+        for i in range(count):
+            path = os.path.join(root, f"accel{i}")
+            if os.path.exists(path):
+                continue
+            made = False
+            if null_rdev is not None:
+                try:
+                    os.mknod(path, 0o666 | statmod.S_IFCHR, null_rdev)
+                    made = True
+                except (OSError, PermissionError):
+                    made = False
+            if not made:
+                with open(path, "w"):
+                    pass
+                meta[f"accel{i}"] = {"major": 1, "minor": 100 + i}
+        if meta:
+            meta_path = os.path.join(root, cls.META)
+            existing = {}
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    existing = json.load(f)
+            existing.update(meta)
+            with open(meta_path, "w") as f:
+                json.dump(existing, f)
+        return cls(root)
+
+    def _meta(self) -> dict:
+        path = os.path.join(self.root, self.META)
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        return {}
+
+    def list_devices(self) -> list[TpuDevice]:
+        meta = self._meta()
+        devices = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _ACCEL_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.root, name)
+            index = int(m.group(1))
+            try:
+                major, minor, is_char = stat_device_numbers(path)
+            except OSError:
+                continue
+            if not is_char:
+                fake = meta.get(name, {})
+                major = fake.get("major", 1)
+                minor = fake.get("minor", 100 + index)
+            devices.append(TpuDevice(
+                index=index, device_path=path, major=major, minor=minor,
+                uuid=f"tpu-fake-accel{index}"))
+        devices.sort(key=lambda d: d.index)
+        return devices
+
+    def device_by_uuid(self, uuid: str) -> TpuDevice | None:
+        for dev in self.list_devices():
+            if dev.uuid == uuid:
+                return dev
+        return None
+
+    def running_pids(self, device: TpuDevice) -> list[int]:
+        # Fake devices cloned from /dev/null share its rdev; rdev matching
+        # would report every process holding /dev/null. Match by path only.
+        return scan_proc_for_device(None, None, path_hint=device.device_path)
+
+
+def scan_proc_for_device(major: int | None, minor: int | None,
+                         path_hint: str = "", proc_root: str = "/proc") -> list[int]:
+    """PIDs with an open fd on the given device (by rdev and/or path).
+
+    Python fallback for the native scanner (native/tpumounter_native.cpp).
+    Matching by st_rdev catches the device regardless of the path the opener
+    used (bind mounts, different mount namespaces).
+    """
+    pids: list[int] = []
+    want_rdev = None
+    if major is not None and minor is not None and (major, minor) != (0, 0):
+        want_rdev = os.makedev(major, minor)
+    try:
+        entries = os.listdir(proc_root)
+    except FileNotFoundError:
+        return []
+    for entry in entries:
+        if not entry.isdigit():
+            continue
+        fd_dir = os.path.join(proc_root, entry, "fd")
+        try:
+            fds = os.listdir(fd_dir)
+        except OSError:
+            continue
+        for fd in fds:
+            fd_path = os.path.join(fd_dir, fd)
+            matched = False
+            if want_rdev is not None:
+                try:
+                    st = os.stat(fd_path)
+                    if statmod.S_ISCHR(st.st_mode) and st.st_rdev == want_rdev:
+                        matched = True
+                except OSError:
+                    pass
+            if not matched and path_hint:
+                try:
+                    if os.readlink(fd_path) == path_hint:
+                        matched = True
+                except OSError:
+                    pass
+            if matched:
+                pids.append(int(entry))
+                break
+    return pids
+
+
+def backend_from_config(cfg=None) -> DeviceBackend:
+    from gpumounter_tpu.config import get_config
+    cfg = cfg or get_config()
+    if cfg.fake_device_dir:
+        return FakeDeviceBackend(cfg.fake_device_dir)
+    return RealAccelBackend(cfg.device_dir)

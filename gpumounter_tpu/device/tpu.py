@@ -1,0 +1,76 @@
+"""TPU device model.
+
+Replaces the reference's NVIDIA device model (pkg/device/nvidia.go:10-41):
+
+  NvidiaGPU{MinorNumber, DeviceFilePath, UUID, State, PodName, Namespace}
+  with hardcoded major 195, perm "rw", file mode "666", prefix /dev/nvidia.
+
+TPU-native differences:
+  * No hardcoded major. TPU accel-class chardevs get dynamically assigned
+    majors, so major:minor always comes from stat(2) on the device node
+    (SURVEY.md §2a).
+  * Identity ("uuid") is the stable chip identifier derived from the sysfs
+    PCI address (/sys/class/accel/accelN/device -> 0000:xx:yy.z), falling
+    back to the device path. The GKE TPU device plugin advertises device IDs
+    that embed the chip index, so we also keep the bare index.
+  * The busy-detection primitive (reference: NVML process lists,
+    nvidia.go:58-87) is a /proc/<pid>/fd scan for open descriptors on the
+    device node — see gpumounter_tpu.device.backend.
+"""
+
+from __future__ import annotations
+
+import os
+import stat as statmod
+from dataclasses import dataclass, field
+
+TPU_FREE_STATE = "TPU_FREE_STATE"            # reference: GPU_FREE_STATE (nvidia.go:21)
+TPU_ALLOCATED_STATE = "TPU_ALLOCATED_STATE"  # reference: GPU_ALLOCATED_STATE (nvidia.go:22)
+
+# cgroup device-permission string; reference uses "rw" (nvidia.go:38).
+DEVICE_CGROUP_PERMISSION = "rw"
+# mknod file mode; reference uses "666" (nvidia.go:39).
+DEVICE_FILE_MODE = 0o666
+
+
+@dataclass
+class TpuDevice:
+    index: int                 # chip index (accelN)
+    device_path: str           # e.g. /dev/accel0 (or fake dir path)
+    major: int                 # from stat(2), never hardcoded
+    minor: int
+    uuid: str                  # stable id: PCI address or fallback
+    state: str = TPU_FREE_STATE
+    pod_name: str = ""
+    namespace: str = ""
+    extra_paths: list[str] = field(default_factory=list)
+    # Companion device nodes that must travel with the chip (e.g. vfio group
+    # nodes on some TPU VM images); empty for the accel class.
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.device_path)
+
+    def reset_state(self) -> None:
+        # Reference: ResetState (nvidia.go:50-55)
+        self.state = TPU_FREE_STATE
+        self.pod_name = ""
+        self.namespace = ""
+
+    def mark_allocated(self, pod_name: str, namespace: str) -> None:
+        self.state = TPU_ALLOCATED_STATE
+        self.pod_name = pod_name
+        self.namespace = namespace
+
+    def __str__(self) -> str:
+        return (f"TPU{self.index}[{self.uuid}] {self.device_path} "
+                f"{self.major}:{self.minor} {self.state}"
+                + (f" -> {self.namespace}/{self.pod_name}" if self.pod_name else ""))
+
+
+def stat_device_numbers(path: str) -> tuple[int, int, bool]:
+    """(major, minor, is_char_device) for a filesystem node."""
+    st = os.stat(path)
+    is_char = statmod.S_ISCHR(st.st_mode)
+    rdev = st.st_rdev if is_char else 0
+    return os.major(rdev), os.minor(rdev), is_char

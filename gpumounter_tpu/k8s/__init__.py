@@ -1,0 +1,19 @@
+from gpumounter_tpu.k8s.client import (
+    ApiError,
+    ConflictError,
+    KubeClient,
+    NotFoundError,
+    RestKubeClient,
+    in_cluster_client,
+)
+from gpumounter_tpu.k8s.types import Pod
+
+__all__ = [
+    "ApiError",
+    "ConflictError",
+    "KubeClient",
+    "NotFoundError",
+    "Pod",
+    "RestKubeClient",
+    "in_cluster_client",
+]

@@ -1,0 +1,262 @@
+"""Minimal Kubernetes REST client (stdlib only).
+
+Replaces the reference's client-go usage (pkg/config/config.go:30-45 — a
+sync.Once in-cluster clientset). The image has no `kubernetes` Python package
+and installs are forbidden, so this speaks the API directly:
+
+  * in-cluster auth: service-account bearer token + cluster CA
+    (/var/run/secrets/kubernetes.io/serviceaccount/...)
+  * pods: get / create / delete / list (label & field selectors)
+  * watch: chunked JSON event stream — used instead of the reference's
+    unbounded phase busy-polls (allocator.go:246-317, a SURVEY §3 hot loop)
+
+All methods return/accept raw API JSON dicts (see k8s.types.Pod wrapper).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+import socket
+import ssl
+import time
+import urllib.parse
+from collections.abc import Iterator
+from typing import Any
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("k8s")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"kubernetes api error {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    def __init__(self, message: str = ""):
+        super().__init__(404, message)
+
+
+class ConflictError(ApiError):
+    def __init__(self, message: str = ""):
+        super().__init__(409, message)
+
+
+def _raise_for(status: int, body: str) -> None:
+    if status == 404:
+        raise NotFoundError(body)
+    if status == 409:
+        raise ConflictError(body)
+    raise ApiError(status, body)
+
+
+class KubeClient(abc.ABC):
+    """The surface both the real REST client and the test fake implement."""
+
+    @abc.abstractmethod
+    def get_pod(self, namespace: str, name: str) -> dict: ...
+
+    @abc.abstractmethod
+    def create_pod(self, namespace: str, manifest: dict) -> dict: ...
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None: ...
+
+    @abc.abstractmethod
+    def list_pods(self, namespace: str | None = None, label_selector: str = "",
+                  field_selector: str = "") -> list[dict]: ...
+
+    @abc.abstractmethod
+    def watch_pods(self, namespace: str, *, label_selector: str = "",
+                   field_selector: str = "", timeout_s: float = 60.0,
+                   resource_version: str = "") -> Iterator[tuple[str, dict]]:
+        """Yield (event_type, pod_json) until timeout. Types: ADDED/MODIFIED/DELETED."""
+        ...
+
+    # --- composed helper used by the allocator ---
+
+    def wait_for_pod(self, namespace: str, name: str, predicate,
+                     timeout_s: float) -> dict | None:
+        """Wait until predicate(pod_json) is truthy; None on timeout.
+
+        Watch-driven with a list fallback; replaces the reference's zero-sleep
+        busy-poll (checkCreateState/checkDeleteState, allocator.go:246-317).
+        For "wait for deletion" predicates, pass predicate(None)->True on the
+        DELETED event / absent pod.
+        """
+        deadline = time.monotonic() + timeout_s
+        try:
+            pod = self.get_pod(namespace, name)
+        except NotFoundError:
+            pod = None
+        if predicate(pod):
+            return pod if pod is not None else {"__deleted__": True}
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                for etype, obj in self.watch_pods(
+                        namespace,
+                        field_selector=f"metadata.name={name}",
+                        timeout_s=min(remaining, 30.0)):
+                    if etype == "DELETED":
+                        if predicate(None):
+                            return {"__deleted__": True}
+                        continue
+                    if predicate(obj):
+                        return obj
+                    if time.monotonic() >= deadline:
+                        return None
+            except ApiError as exc:
+                logger.warning("watch failed (%s); falling back to poll", exc)
+                time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
+            else:
+                # Watch window closed early without a match (apiserver/proxy
+                # may end streams immediately): don't degenerate into a
+                # zero-sleep reconnect loop.
+                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+            # Watch window expired or errored: re-check current state.
+            try:
+                pod = self.get_pod(namespace, name)
+            except NotFoundError:
+                pod = None
+            if predicate(pod):
+                return pod if pod is not None else {"__deleted__": True}
+
+
+class RestKubeClient(KubeClient):
+    def __init__(self, host: str, port: int, token: str,
+                 ca_file: str | None = None, verify: bool = True):
+        self.host = host
+        self.port = port
+        self.token = token
+        self.ctx = ssl.create_default_context(cafile=ca_file) if verify else None
+        if self.ctx is None:
+            self.ctx = ssl.create_default_context()
+            self.ctx.check_hostname = False
+            self.ctx.verify_mode = ssl.CERT_NONE
+
+    # --- low-level ---
+
+    def _request(self, method: str, path: str, query: dict | None = None,
+                 body: dict | None = None, timeout: float = 30.0):
+        import http.client
+        qs = ("?" + urllib.parse.urlencode(query)) if query else ""
+        conn = http.client.HTTPSConnection(self.host, self.port,
+                                           context=self.ctx, timeout=timeout)
+        headers = {
+            "Authorization": f"Bearer {self.token}",
+            "Accept": "application/json",
+        }
+        payload = None
+        if body is not None:
+            payload = json.dumps(body)
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path + qs, body=payload, headers=headers)
+        return conn, conn.getresponse()
+
+    def _json(self, method: str, path: str, query: dict | None = None,
+              body: dict | None = None) -> dict:
+        conn, resp = self._request(method, path, query, body)
+        try:
+            data = resp.read().decode("utf-8", "replace")
+            if resp.status >= 400:
+                _raise_for(resp.status, data)
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+    # --- pods ---
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._json("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def create_pod(self, namespace: str, manifest: dict) -> dict:
+        return self._json("POST", f"/api/v1/namespaces/{namespace}/pods", body=manifest)
+
+    def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
+        try:
+            self._json("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}",
+                       query={"gracePeriodSeconds": grace_period_seconds})
+        except NotFoundError:
+            pass
+
+    def list_pods(self, namespace: str | None = None, label_selector: str = "",
+                  field_selector: str = "") -> list[dict]:
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        query: dict[str, Any] = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        return self._json("GET", path, query=query).get("items", [])
+
+    def watch_pods(self, namespace: str, *, label_selector: str = "",
+                   field_selector: str = "", timeout_s: float = 60.0,
+                   resource_version: str = "") -> Iterator[tuple[str, dict]]:
+        query: dict[str, Any] = {"watch": "true",
+                                 "timeoutSeconds": max(1, int(timeout_s))}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        conn, resp = self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods", query,
+            timeout=timeout_s + 10.0)
+        try:
+            if resp.status >= 400:
+                _raise_for(resp.status, resp.read().decode("utf-8", "replace"))
+            buf = b""
+            while True:
+                try:
+                    chunk = resp.read1(65536)
+                except (socket.timeout, TimeoutError):
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if not line.strip():
+                        continue
+                    event = json.loads(line)
+                    yield event.get("type", ""), event.get("object", {})
+        finally:
+            conn.close()
+
+
+def in_cluster_client() -> RestKubeClient:
+    """Build a client from the pod's service account.
+
+    Reference hardwires inCluster := true (config.go:31); we also honour
+    KUBERNETES_SERVICE_HOST/PORT overrides for out-of-cluster testing with a
+    token file via TPUMOUNTER_TOKEN_FILE.
+    """
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    port = int(os.environ.get("KUBERNETES_SERVICE_PORT", "443"))
+    token_file = os.environ.get("TPUMOUNTER_TOKEN_FILE", os.path.join(SA_DIR, "token"))
+    ca_file = os.environ.get("TPUMOUNTER_CA_FILE", os.path.join(SA_DIR, "ca.crt"))
+    with open(token_file) as f:
+        token = f.read().strip()
+    if os.path.exists(ca_file):
+        return RestKubeClient(host, port, token, ca_file=ca_file, verify=True)
+    # Never silently downgrade TLS: the bearer token would travel over an
+    # unverified channel. Explicit opt-in only (dev clusters).
+    if os.environ.get("TPUMOUNTER_INSECURE_SKIP_TLS_VERIFY") == "1":
+        logger.warning("CA file %s missing; TLS verification DISABLED by "
+                       "TPUMOUNTER_INSECURE_SKIP_TLS_VERIFY=1", ca_file)
+        return RestKubeClient(host, port, token, verify=False)
+    raise FileNotFoundError(
+        f"cluster CA not found at {ca_file}; set TPUMOUNTER_CA_FILE or "
+        "TPUMOUNTER_INSECURE_SKIP_TLS_VERIFY=1 to opt out of verification")

@@ -1,0 +1,190 @@
+"""In-memory fake Kubernetes client for tests.
+
+The reference has no test substrate at all (SURVEY.md §4: all tests need a
+live cluster). This fake implements the KubeClient surface with watch streams
+and a pluggable scheduler hook, so the allocator / worker / master stacks are
+testable in-process — including contended-scheduling scenarios (BASELINE
+config 4).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+import uuid as uuidlib
+from collections.abc import Callable, Iterator
+
+from gpumounter_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod, match_label_selector
+
+SchedulerHook = Callable[[dict], None]
+"""Called (with the stored pod dict, mutable) right after create_pod.
+Tests use it to emulate the scheduler: set spec.nodeName, status.phase, or an
+Unschedulable condition. Runs on a helper thread to mimic async scheduling."""
+
+
+def _match_field_selector(pod: dict, selector: str) -> bool:
+    if not selector:
+        return True
+    p = Pod(pod)
+    for clause in selector.split(","):
+        k, _, v = clause.partition("=")
+        k = k.strip()
+        v = v.strip()
+        if k == "metadata.name" and p.name != v:
+            return False
+        if k == "metadata.namespace" and p.namespace != v:
+            return False
+        if k == "spec.nodeName" and p.node_name != v:
+            return False
+        if k == "status.phase" and p.phase != v:
+            return False
+    return True
+
+
+class FakeKubeClient(KubeClient):
+    def __init__(self, scheduler_hook: SchedulerHook | None = None,
+                 scheduler_delay_s: float = 0.0):
+        self._pods: dict[tuple[str, str], dict] = {}
+        self._lock = threading.Condition()
+        self._events: list[tuple[int, str, dict]] = []  # (seq, type, pod)
+        self._seq = itertools.count(1)
+        self.scheduler_hook = scheduler_hook
+        self.scheduler_delay_s = scheduler_delay_s
+        self.create_calls = 0
+        self.delete_calls = 0
+
+    # --- event plumbing ---
+
+    def _emit(self, etype: str, pod: dict) -> None:
+        with self._lock:
+            self._events.append((next(self._seq), etype, copy.deepcopy(pod)))
+            self._lock.notify_all()
+
+    # --- KubeClient surface ---
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            return copy.deepcopy(pod)
+
+    def create_pod(self, namespace: str, manifest: dict) -> dict:
+        pod = copy.deepcopy(manifest)
+        meta = pod.setdefault("metadata", {})
+        meta.setdefault("namespace", namespace)
+        name = meta.get("name")
+        if not name:
+            raise ValueError("pod manifest missing metadata.name")
+        meta.setdefault("uid", str(uuidlib.uuid4()))
+        pod.setdefault("status", {}).setdefault("phase", "Pending")
+        with self._lock:
+            if (namespace, name) in self._pods:
+                raise ConflictError(f"pod {namespace}/{name} already exists")
+            self._pods[(namespace, name)] = pod
+            self.create_calls += 1
+        self._emit("ADDED", pod)
+        if self.scheduler_hook is not None:
+            def _schedule():
+                if self.scheduler_delay_s:
+                    time.sleep(self.scheduler_delay_s)
+                with self._lock:
+                    stored = self._pods.get((namespace, name))
+                if stored is None:
+                    return
+                self.scheduler_hook(stored)
+                self._emit("MODIFIED", stored)
+            threading.Thread(target=_schedule, daemon=True).start()
+        return copy.deepcopy(pod)
+
+    def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            self.delete_calls += 1
+        if pod is not None:
+            self._emit("DELETED", pod)
+
+    def list_pods(self, namespace: str | None = None, label_selector: str = "",
+                  field_selector: str = "") -> list[dict]:
+        with self._lock:
+            pods = [copy.deepcopy(p) for p in self._pods.values()]
+        out = []
+        for pod in pods:
+            p = Pod(pod)
+            if namespace and p.namespace != namespace:
+                continue
+            if not match_label_selector(p.labels, label_selector):
+                continue
+            if not _match_field_selector(pod, field_selector):
+                continue
+            out.append(pod)
+        return out
+
+    def watch_pods(self, namespace: str, *, label_selector: str = "",
+                   field_selector: str = "", timeout_s: float = 60.0,
+                   resource_version: str = "") -> Iterator[tuple[str, dict]]:
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            cursor = self._events[-1][0] if self._events else 0
+        while True:
+            with self._lock:
+                pending = [(s, t, p) for (s, t, p) in self._events if s > cursor]
+                if not pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._lock.wait(timeout=min(remaining, 0.25))
+                    pending = [(s, t, p) for (s, t, p) in self._events if s > cursor]
+            for seq, etype, pod in pending:
+                cursor = max(cursor, seq)
+                p = Pod(pod)
+                if p.namespace != namespace:
+                    continue
+                if not match_label_selector(p.labels, label_selector):
+                    continue
+                if not _match_field_selector(pod, field_selector):
+                    continue
+                yield etype, copy.deepcopy(pod)
+            if time.monotonic() >= deadline:
+                return
+
+    # --- test helpers ---
+
+    def set_pod_status(self, namespace: str, name: str, **status) -> None:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            pod.setdefault("status", {}).update(status)
+            stored = copy.deepcopy(pod)
+        self._emit("MODIFIED", stored)
+
+    def mark_unschedulable(self, namespace: str, name: str,
+                           message: str = "0/1 nodes have free TPU") -> None:
+        """Emulates the scheduler's Unschedulable condition.
+
+        Reference detects this via PodReasonUnschedulable in checkCreateState
+        (allocator.go:262-270).
+        """
+        self.set_pod_status(namespace, name, phase="Pending", conditions=[{
+            "type": "PodScheduled", "status": "False",
+            "reason": "Unschedulable", "message": message,
+        }])
+
+    def mark_running(self, namespace: str, name: str, node: str = "",
+                     pod_ip: str = "") -> None:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            if node:
+                pod.setdefault("spec", {})["nodeName"] = node
+            status = pod.setdefault("status", {})
+            status["phase"] = "Running"
+            if pod_ip:
+                status["podIP"] = pod_ip
+            stored = copy.deepcopy(pod)
+        self._emit("MODIFIED", stored)

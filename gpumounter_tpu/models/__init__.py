@@ -1,0 +1,3 @@
+from gpumounter_tpu.models.probe import TransformerConfig, forward, init_params
+
+__all__ = ["TransformerConfig", "forward", "init_params"]

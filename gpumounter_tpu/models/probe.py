@@ -1,0 +1,106 @@
+"""Flagship workload model: a small decoder-only transformer in pure JAX.
+
+The reference is infrastructure (no model code exists in GPUMounter,
+SURVEY.md §2b); this model is our tenant-side *probe workload* — the thing a
+user runs on hot-mounted chips to prove they are usable, and the body of
+bench/e2e "chips do real work" checks. TPU-first choices: bf16 activations,
+matmul-dominated blocks sized for the MXU, static shapes, no Python control
+flow inside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 128
+    dtype: type = jnp.bfloat16
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = 0.02
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params = {
+        "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
+        "pos": dense(keys[1], (cfg.max_len, cfg.d_model)),
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        bk = jax.random.split(keys[2 + i], 6)
+        params["blocks"].append({
+            "wqkv": dense(bk[0], (cfg.d_model, 3 * cfg.d_model)),
+            "wo": dense(bk[1], (cfg.d_model, cfg.d_model)),
+            "w1": dense(bk[2], (cfg.d_model, cfg.d_ff)),
+            "w2": dense(bk[3], (cfg.d_ff, cfg.d_model)),
+            "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
+            "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
+        })
+    return params
+
+
+def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * g
+
+
+def _block(x: jax.Array, p: dict, cfg: TransformerConfig) -> jax.Array:
+    b, t, d = x.shape
+    h = _rmsnorm(x, p["ln1"])
+    qkv = h @ p["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(a):
+        return a.reshape(b, t, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(cfg.d_head, x.dtype))
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d) @ p["wo"]
+    x = x + out
+
+    h = _rmsnorm(x, p["ln2"])
+    x = x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+    return x
+
+
+@partial(jax.jit, static_argnums=2)
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Logits for int32 tokens of shape (batch, seq)."""
+    b, t = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:t]
+    for blk in params["blocks"]:
+        x = _block(x, blk, cfg)
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Next-token cross-entropy (mean)."""
+    logits = forward(params, tokens, cfg)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)

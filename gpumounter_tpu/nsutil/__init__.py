@@ -1,0 +1,7 @@
+from gpumounter_tpu.nsutil.ns import (
+    inject_device_file,
+    kill_pids_in_ns,
+    remove_device_file,
+)
+
+__all__ = ["inject_device_file", "kill_pids_in_ns", "remove_device_file"]

@@ -1,0 +1,144 @@
+"""Container-namespace device-file operations.
+
+Reference parity: pkg/util/namespace/namespace.go — which shells out to
+`nsenter --target PID --mount sh -c "mknod -m 666 /dev/nvidiaN c 195 N"`
+(namespace.go:167-177), `rm` (:179-189) and `kill` (:191-201), and therefore
+requires `sh` + `mknod` binaries *inside the target container*
+(docs/guide/FAQ.md). We instead use direct syscalls — setns(2) + mknod(2) /
+unlink(2) / kill(2) — via the `tpumounter-nsexec` C++ helper (native/
+nsexec.cpp), so the target container needs no binaries at all and no string
+is ever interpreted by a shell.
+
+Two modes:
+  * pid=None  — operate on a plain directory in our own namespace (fake
+    dry-run, BASELINE config 1; also unit tests).
+  * pid=N     — enter PID N's mount namespace with the nsexec helper.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat as statmod
+import subprocess
+
+from gpumounter_tpu.device.tpu import DEVICE_FILE_MODE, TpuDevice
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("nsutil")
+
+
+class NamespaceError(RuntimeError):
+    pass
+
+
+def _nsexec_path() -> str:
+    from gpumounter_tpu.config import get_config
+    cfg = get_config()
+    if cfg.nsexec_bin:
+        return cfg.nsexec_bin
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (os.path.join(here, "native", "build", "tpumounter-nsexec"),
+                 "/usr/local/bin/tpumounter-nsexec"):
+        if os.path.exists(cand):
+            return cand
+    raise NamespaceError(
+        "tpumounter-nsexec helper not found; build it with `make -C native`")
+
+
+def _run_nsexec(args: list[str]) -> None:
+    # argv-only invocation: no shell anywhere (SURVEY.md §7 "no sh -c").
+    cmd = [_nsexec_path()] + args
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=30)
+    if proc.returncode != 0:
+        raise NamespaceError(
+            f"nsexec {' '.join(args)} failed rc={proc.returncode}: "
+            f"{proc.stderr.strip()}")
+
+
+def device_node_path(dev_dir: str, dev: TpuDevice) -> str:
+    return os.path.join(dev_dir, dev.basename)
+
+
+def inject_device_file(target_dev_dir: str, dev: TpuDevice,
+                       pid: int | None = None) -> str:
+    """Create the device node for `dev` inside the target.
+
+    Reference analog: AddGPUDeviceFile (namespace.go:167-177).
+    Returns the path created (target-namespace view when pid is given).
+    """
+    target_path = device_node_path(target_dev_dir, dev)
+    if pid is not None:
+        _run_nsexec(["mknod", str(pid), target_path,
+                     str(dev.major), str(dev.minor), oct(DEVICE_FILE_MODE)])
+        return target_path
+
+    if os.path.exists(target_path):
+        return target_path
+    try:
+        os.mknod(target_path, DEVICE_FILE_MODE | statmod.S_IFCHR,
+                 os.makedev(dev.major, dev.minor))
+        os.chmod(target_path, DEVICE_FILE_MODE)  # mknod mode is umask-masked
+    except (OSError, PermissionError) as exc:
+        # Unprivileged dry-run fallback, fake devices only: copying a real
+        # accelerator chardev would read from the device (can block) and
+        # produce a useless regular file, so real devices fail loudly.
+        if not _is_fake_source(dev.device_path):
+            raise NamespaceError(
+                f"mknod {target_path} c {dev.major}:{dev.minor} failed "
+                f"({exc}) and {dev.device_path} is a real device; "
+                "run the worker with CAP_MKNOD") from exc
+        logger.debug("mknod unavailable (%s); copying node for dry-run", exc)
+        shutil.copyfile(dev.device_path, target_path)
+        os.chmod(target_path, DEVICE_FILE_MODE)
+    return target_path
+
+
+def _is_fake_source(path: str) -> bool:
+    """True if `path` is safe to copy: a regular file or a /dev/null clone."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    if statmod.S_ISREG(st.st_mode):
+        return True
+    if statmod.S_ISCHR(st.st_mode):
+        try:
+            null = os.stat("/dev/null")
+            return st.st_rdev == null.st_rdev
+        except OSError:
+            return False
+    return False
+
+
+def remove_device_file(target_dev_dir: str, dev: TpuDevice,
+                       pid: int | None = None) -> None:
+    """Remove the device node. Reference: RemoveGPUDeviceFile (namespace.go:179-189)."""
+    target_path = device_node_path(target_dev_dir, dev)
+    if pid is not None:
+        _run_nsexec(["rm", str(pid), target_path])
+        return
+    try:
+        os.unlink(target_path)
+    except FileNotFoundError:
+        pass
+
+
+def kill_pids_in_ns(pids: list[int], pid: int | None = None,
+                    signal_num: int = 9) -> None:
+    """Kill device-holding PIDs. Reference: KillRunningGPUProcesses (namespace.go:191-201).
+
+    PIDs are host-view (worker runs with hostPID: true); with pid=None we
+    signal directly, otherwise via nsexec (enters the PID namespace so the
+    kill is scoped).
+    """
+    if not pids:
+        return
+    if pid is not None:
+        _run_nsexec(["kill", str(pid), str(signal_num)] + [str(p) for p in pids])
+        return
+    for p in pids:
+        try:
+            os.kill(p, signal_num)
+        except ProcessLookupError:
+            pass

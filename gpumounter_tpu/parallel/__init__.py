@@ -1,0 +1,4 @@
+from gpumounter_tpu.parallel.mesh import build_mesh, mesh_shape_for
+from gpumounter_tpu.parallel.train_step import make_train_step, shard_params
+
+__all__ = ["build_mesh", "mesh_shape_for", "make_train_step", "shard_params"]

@@ -1,0 +1,32 @@
+"""Device-mesh construction over (possibly hot-mounted) chip sets.
+
+TPU-first: scaling is expressed as a `jax.sharding.Mesh` with named axes and
+NamedSharding annotations — XLA inserts the collectives and rides ICI
+(SURVEY.md §5 "distributed communication backend": we expose the fabric to
+JAX rather than writing a comm library). After a hot-mount changes the chip
+set, tenants rebuild the mesh with `build_mesh(jax.devices())`.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def mesh_shape_for(n_devices: int) -> tuple[int, int]:
+    """(data, model) mesh shape: widest model axis that divides n_devices,
+    capped at 8 (a v5e host), model axis preferred over ICI-local groups."""
+    model = 1
+    for cand in (8, 4, 2):
+        if n_devices % cand == 0 and n_devices >= cand:
+            model = cand
+            break
+    return n_devices // model, model
+
+
+def build_mesh(devices=None, axis_names: tuple[str, str] = ("data", "model")) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    data, model = mesh_shape_for(len(devices))
+    import numpy as np
+    arr = np.array(devices).reshape(data, model)
+    return Mesh(arr, axis_names)

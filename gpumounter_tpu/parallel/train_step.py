@@ -1,0 +1,69 @@
+"""Sharded training step over a named mesh (dp × tp).
+
+Used by the multi-chip dry-run and by post-hot-mount validation: after chips
+appear, the tenant rebuilds the mesh and resumes stepping with the same
+functions. Shardings: batch over "data"; attention/MLP weights over "model"
+(column/row split so XLA emits a single psum per block on ICI); everything
+jit-compiled with explicit NamedSharding in/out specs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gpumounter_tpu.models.probe import TransformerConfig, loss_fn
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs: tensor-parallel over the "model" axis.
+
+    wqkv/w1 column-split (output dim), wo/w2 row-split (input dim) — the
+    Megatron layout; XLA inserts one reduce per block boundary.
+    """
+    block = {
+        "wqkv": P(None, "model"),
+        "wo": P("model", None),
+        "w1": P(None, "model"),
+        "w2": P("model", None),
+        "ln1": P(None),
+        "ln2": P(None),
+    }
+    return {
+        "embed": P(None, None),
+        "pos": P(None, None),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"))
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
+    """Returns step(params, tokens) -> (params, loss), jitted over the mesh."""
+    specs = param_specs(cfg)
+    param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+    data_sharding = NamedSharding(mesh, P("data", None))
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg))(params)
+        new_params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+                          ).astype(p.dtype), params, grads)
+        return new_params, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(param_shardings, data_sharding),
+        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+    )

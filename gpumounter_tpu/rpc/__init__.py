@@ -1,0 +1,3 @@
+from gpumounter_tpu.rpc import api, wire
+
+__all__ = ["api", "wire"]

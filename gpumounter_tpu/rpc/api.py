@@ -1,0 +1,79 @@
+"""Master <-> worker RPC contract.
+
+Wire-compatible with the reference contract (pkg/api/gpu-mount/api.proto):
+same field numbers, same result enums (including the reference's quirk that
+RemoveGPUResult has no value 3 and GPUNotFound = 4, api.proto:25-41), so a
+client written against the reference's proto can talk to our worker. Services
+are registered under both the TPU-native names (tpu_mount.AddTPUService /
+RemoveTPUService) and the reference names (gpu_mount.AddGPUService /
+RemoveGPUService) for drop-in compatibility.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from gpumounter_tpu.rpc.wire import Field, Message
+
+
+class AddTPUResult(enum.IntEnum):
+    # Reference: AddGPUResponse.AddGPUResult (api.proto:12-17)
+    Success = 0
+    InsufficientTPU = 1
+    PodNotFound = 2
+
+
+class RemoveTPUResult(enum.IntEnum):
+    # Reference: RemoveGPUResponse.RemoveGPUResult (api.proto:32-39).
+    # Value 3 intentionally absent; TPUNotFound = 4 matches GPUNotFound = 4.
+    Success = 0
+    TPUBusy = 1
+    PodNotFound = 2
+    TPUNotFound = 4
+
+
+class AddTPURequest(Message):
+    # Reference: AddGPURequest (api.proto:4-9)
+    FIELDS = [
+        Field(1, "pod_name", "string"),
+        Field(2, "namespace", "string"),
+        Field(3, "tpu_num", "int32"),
+        Field(4, "is_entire_mount", "bool"),
+    ]
+
+
+class AddTPUResponse(Message):
+    # Reference: AddGPUResponse (api.proto:11-19)
+    FIELDS = [
+        Field(1, "add_tpu_result", "enum"),
+    ]
+
+
+class RemoveTPURequest(Message):
+    # Reference: RemoveGPURequest (api.proto:25-30); uuids -> device ids.
+    FIELDS = [
+        Field(1, "pod_name", "string"),
+        Field(2, "namespace", "string"),
+        Field(3, "uuids", "string", repeated=True),
+        Field(4, "force", "bool"),
+    ]
+
+
+class RemoveTPUResponse(Message):
+    # Reference: RemoveGPUResponse (api.proto:32-41)
+    FIELDS = [
+        Field(1, "remove_tpu_result", "enum"),
+    ]
+
+
+# gRPC method descriptors: (service_full_name, method, request_cls, response_cls)
+ADD_SERVICE_TPU = "tpu_mount.AddTPUService"
+REMOVE_SERVICE_TPU = "tpu_mount.RemoveTPUService"
+# Reference service names (api.proto:21-23, 43-45) for drop-in clients.
+ADD_SERVICE_LEGACY = "gpu_mount.AddGPUService"
+REMOVE_SERVICE_LEGACY = "gpu_mount.RemoveGPUService"
+
+ADD_METHOD = "AddGPU"          # reference method name (api.proto:22)
+REMOVE_METHOD = "RemoveGPU"    # reference method name (api.proto:44)
+ADD_METHOD_TPU = "AddTPU"
+REMOVE_METHOD_TPU = "RemoveTPU"

@@ -1,0 +1,3 @@
+from gpumounter_tpu.utils.log import get_logger, init_logger
+
+__all__ = ["get_logger", "init_logger"]

@@ -1,0 +1,56 @@
+"""Structured logging for master/worker daemons.
+
+Reference parity: pkg/util/log/log.go:9-30 (zap SugaredLogger, console encoder,
+ISO8601 timestamps, Debug level, dual sink stdout + /var/log/GPUMounter/<file>.log).
+Here: stdlib logging with an ISO8601 console formatter and optional file sink.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+_LOCK = threading.Lock()
+_INITIALIZED = False
+
+_FMT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
+_DATEFMT = "%Y-%m-%dT%H:%M:%S%z"
+
+
+def init_logger(log_dir: str | None = None, filename: str | None = None,
+                level: int = logging.DEBUG) -> logging.Logger:
+    """Initialise root logging: stdout always; file sink if log_dir given.
+
+    Mirrors InitLogger(log.go:9-17): distinct filenames per daemon
+    ("tpumounter-master.log" / "tpumounter-worker.log"), multi-sink.
+    Safe to call more than once; later calls only adjust the level.
+    """
+    global _INITIALIZED
+    root = logging.getLogger("gpumounter_tpu")
+    with _LOCK:
+        if _INITIALIZED:
+            root.setLevel(level)
+            return root
+        root.setLevel(level)
+        formatter = logging.Formatter(_FMT, datefmt=_DATEFMT)
+        stream = logging.StreamHandler(sys.stdout)
+        stream.setFormatter(formatter)
+        root.addHandler(stream)
+        if log_dir and filename:
+            try:
+                os.makedirs(log_dir, exist_ok=True)
+                fileh = logging.FileHandler(os.path.join(log_dir, filename))
+                fileh.setFormatter(formatter)
+                root.addHandler(fileh)
+            except OSError:
+                root.warning("cannot open log file in %s; stdout only", log_dir)
+        root.propagate = False
+        _INITIALIZED = True
+    return root
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Child logger under the gpumounter_tpu root."""
+    return logging.getLogger("gpumounter_tpu").getChild(name)

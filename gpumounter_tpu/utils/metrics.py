@@ -1,0 +1,115 @@
+"""Minimal Prometheus text-format metrics registry.
+
+The reference exposes no metrics (SURVEY.md §5). Both daemons here serve
+/metrics with counters and histograms for mount/unmount operations and their
+phase latencies. Implemented on stdlib only (no prometheus_client in image).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for key, val in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+        return lines
+
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str
+    buckets: tuple = _DEFAULT_BUCKETS
+    _counts: dict[tuple, list] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            entry = self._counts.setdefault(key, [[0] * (len(self.buckets) + 1), 0.0])
+            counts, _ = entry
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            entry[1] += value
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, (counts, total) in sorted(self._counts.items()):
+                labels = dict(key)
+                for i, b in enumerate(self.buckets):
+                    lines.append(
+                        f"{self.name}_bucket{_fmt_labels({**labels, 'le': repr(b)})} {counts[i]}"
+                    )
+                lines.append(f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {counts[-1]}")
+                lines.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
+                lines.append(f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}")
+        return lines
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str) -> Counter:
+        c = Counter(name, help)
+        with self._lock:
+            self._metrics.append(c)
+        return c
+
+    def histogram(self, name: str, help: str, buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        h = Histogram(name, help, buckets)
+        with self._lock:
+            self._metrics.append(h)
+        return h
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+MOUNT_TOTAL = REGISTRY.counter(
+    "tpumounter_mount_total", "Total mount operations by result")
+UNMOUNT_TOTAL = REGISTRY.counter(
+    "tpumounter_unmount_total", "Total unmount operations by result")
+MOUNT_LATENCY = REGISTRY.histogram(
+    "tpumounter_mount_latency_seconds", "End-to-end hot-mount latency")
+PHASE_LATENCY = REGISTRY.histogram(
+    "tpumounter_phase_latency_seconds", "Per-phase latency (phase label)")

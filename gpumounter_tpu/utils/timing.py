@@ -1,0 +1,40 @@
+"""Per-phase latency instrumentation.
+
+The reference has zero timing visibility (SURVEY.md §5: only zap log
+timestamps). Our north-star metric is hot-mount latency (BASELINE.json), so
+every mount/unmount records a phase breakdown: slave-pod schedule, cgroup
+grant, device-file inject, JAX-visible.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates named phase durations for one operation."""
+
+    phases: dict[str, float] = field(default_factory=dict)
+    _start: float = field(default_factory=time.monotonic)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (time.monotonic() - t0)
+
+    def record(self, name: str, seconds: float) -> None:
+        self.phases[name] = self.phases.get(name, 0.0) + seconds
+
+    def total(self) -> float:
+        return time.monotonic() - self._start
+
+    def summary_ms(self) -> dict[str, float]:
+        out = {k: round(v * 1000.0, 3) for k, v in self.phases.items()}
+        out["total"] = round(self.total() * 1000.0, 3)
+        return out

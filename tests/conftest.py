@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Provides a virtual 8-device CPU mesh (multi-chip sharding tests run without
+TPU hardware). XLA_FLAGS must be set before the CPU backend is first used;
+note this environment's sitecustomize may pre-register a TPU platform as
+default, so multi-device tests must ask for the CPU backend explicitly
+(jax.devices("cpu")) rather than rely on JAX_PLATFORMS.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no-op when a platform is pinned
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from gpumounter_tpu.config import Config, set_config  # noqa: E402
+
+
+@pytest.fixture()
+def fake_device_dir(tmp_path):
+    """A fake chip inventory with 4 devices (BASELINE config 1 substrate)."""
+    from gpumounter_tpu.device.backend import FakeDeviceBackend
+    root = str(tmp_path / "fakedev")
+    backend = FakeDeviceBackend.create(root, 4)
+    return backend
+
+
+@pytest.fixture()
+def test_config(tmp_path):
+    cfg = Config()
+    cfg = cfg.replace(fake_device_dir=str(tmp_path / "fakedev"),
+                      slave_pod_timeout_s=5.0)
+    set_config(cfg)
+    yield cfg
+    set_config(Config())

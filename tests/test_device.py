@@ -1,0 +1,74 @@
+"""Device layer: fake backend enumeration, identity, busy detection."""
+
+import os
+
+from gpumounter_tpu.device.backend import (
+    FakeDeviceBackend,
+    RealAccelBackend,
+    scan_proc_for_device,
+)
+from gpumounter_tpu.device.tpu import TPU_FREE_STATE, TpuDevice
+
+
+def test_fake_backend_enumeration(fake_device_dir):
+    devices = fake_device_dir.list_devices()
+    assert len(devices) == 4
+    assert [d.index for d in devices] == [0, 1, 2, 3]
+    for d in devices:
+        assert d.state == TPU_FREE_STATE
+        assert d.uuid == f"tpu-fake-accel{d.index}"
+        assert os.path.exists(d.device_path)
+        assert (d.major, d.minor) != (0, 0)
+
+
+def test_fake_backend_lookup_by_uuid(fake_device_dir):
+    dev = fake_device_dir.device_by_uuid("tpu-fake-accel2")
+    assert dev is not None and dev.index == 2
+    assert fake_device_dir.device_by_uuid("nope") is None
+
+
+def test_device_state_transitions(fake_device_dir):
+    dev = fake_device_dir.list_devices()[0]
+    dev.mark_allocated("pod-a", "ns-a")
+    assert dev.pod_name == "pod-a"
+    dev.reset_state()
+    assert dev.state == TPU_FREE_STATE and dev.pod_name == ""
+
+
+def test_real_backend_empty_dir(tmp_path):
+    backend = RealAccelBackend(str(tmp_path))
+    assert backend.list_devices() == []
+
+
+def test_real_backend_skips_non_accel(tmp_path):
+    (tmp_path / "null").write_text("")
+    (tmp_path / "accelX").write_text("")
+    backend = RealAccelBackend(str(tmp_path))
+    assert backend.list_devices() == []
+
+
+def test_busy_detection_by_open_fd(fake_device_dir):
+    devices = fake_device_dir.list_devices()
+    dev = devices[0]
+    pids = fake_device_dir.running_pids(dev)
+    assert os.getpid() not in pids
+    with open(dev.device_path):
+        pids = fake_device_dir.running_pids(dev)
+        assert os.getpid() in pids
+    pids = fake_device_dir.running_pids(dev)
+    assert os.getpid() not in pids
+
+
+def test_scan_proc_path_match(tmp_path):
+    target = tmp_path / "accel9"
+    target.write_text("")
+    with open(target):
+        pids = scan_proc_for_device(None, None, path_hint=str(target))
+        assert os.getpid() in pids
+
+
+def test_extra_paths_default():
+    d = TpuDevice(index=0, device_path="/dev/accel0", major=120, minor=0,
+                  uuid="u")
+    assert d.extra_paths == []
+    assert d.basename == "accel0"

@@ -1,0 +1,132 @@
+"""Fake clientset behaviour: CRUD, selectors, watch, wait_for_pod."""
+
+import threading
+import time
+
+import pytest
+
+from gpumounter_tpu.k8s.client import ConflictError, NotFoundError
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.k8s.types import Pod, match_label_selector
+
+
+def make_pod(name, namespace="default", labels=None, node=""):
+    return {
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": labels or {}},
+        "spec": {"nodeName": node, "containers": [{"name": "c"}]},
+    }
+
+
+def test_crud_and_selectors():
+    c = FakeKubeClient()
+    c.create_pod("ns1", make_pod("a", "ns1", {"app": "x"}))
+    c.create_pod("ns1", make_pod("b", "ns1", {"app": "y"}))
+    c.create_pod("ns2", make_pod("a", "ns2", {"app": "x"}))
+
+    assert Pod(c.get_pod("ns1", "a")).name == "a"
+    with pytest.raises(NotFoundError):
+        c.get_pod("ns1", "zzz")
+    with pytest.raises(ConflictError):
+        c.create_pod("ns1", make_pod("a", "ns1"))
+
+    assert len(c.list_pods()) == 3
+    assert len(c.list_pods("ns1")) == 2
+    assert [Pod(p).name for p in c.list_pods("ns1", label_selector="app=x")] == ["a"]
+    assert [Pod(p).namespace for p in c.list_pods(label_selector="app=x")] == ["ns1", "ns2"]
+
+    c.delete_pod("ns1", "a")
+    with pytest.raises(NotFoundError):
+        c.get_pod("ns1", "a")
+    c.delete_pod("ns1", "a")  # idempotent
+
+
+def test_label_selector_matching():
+    labels = {"app": "w", "tier": "be"}
+    assert match_label_selector(labels, "app=w")
+    assert match_label_selector(labels, "app=w,tier=be")
+    assert not match_label_selector(labels, "app=z")
+    assert match_label_selector(labels, "app!=z")
+    assert not match_label_selector(labels, "app!=w")
+    assert match_label_selector(labels, "tier")
+    assert not match_label_selector(labels, "missing")
+
+
+def test_watch_sees_transitions():
+    c = FakeKubeClient()
+    events = []
+
+    def watcher():
+        for etype, obj in c.watch_pods("ns", timeout_s=3.0):
+            events.append((etype, Pod(obj).name, Pod(obj).phase))
+            if etype == "DELETED":
+                return
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    time.sleep(0.1)
+    c.create_pod("ns", make_pod("w", "ns"))
+    c.mark_running("ns", "w", node="n1", pod_ip="10.0.0.5")
+    c.delete_pod("ns", "w")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert ("ADDED", "w", "Pending") in events
+    assert ("MODIFIED", "w", "Running") in events
+    assert events[-1][0] == "DELETED"
+
+
+def test_wait_for_pod_running():
+    c = FakeKubeClient()
+    c.create_pod("ns", make_pod("p", "ns"))
+
+    def later():
+        time.sleep(0.2)
+        c.mark_running("ns", "p", node="n1")
+
+    threading.Thread(target=later).start()
+    got = c.wait_for_pod("ns", "p", lambda pod: pod and Pod(pod).phase == "Running",
+                         timeout_s=5.0)
+    assert got and Pod(got).phase == "Running"
+
+
+def test_wait_for_pod_deletion():
+    c = FakeKubeClient()
+    c.create_pod("ns", make_pod("p", "ns"))
+
+    def later():
+        time.sleep(0.2)
+        c.delete_pod("ns", "p")
+
+    threading.Thread(target=later).start()
+    got = c.wait_for_pod("ns", "p", lambda pod: pod is None, timeout_s=5.0)
+    assert got == {"__deleted__": True}
+
+
+def test_wait_for_pod_timeout():
+    c = FakeKubeClient()
+    c.create_pod("ns", make_pod("p", "ns"))
+    t0 = time.monotonic()
+    got = c.wait_for_pod("ns", "p", lambda pod: pod and Pod(pod).phase == "Running",
+                         timeout_s=0.5)
+    assert got is None
+    assert time.monotonic() - t0 < 3.0
+
+
+def test_scheduler_hook():
+    def hook(pod):
+        pod.setdefault("spec", {})["nodeName"] = "node-1"
+        pod.setdefault("status", {})["phase"] = "Running"
+
+    c = FakeKubeClient(scheduler_hook=hook, scheduler_delay_s=0.05)
+    c.create_pod("ns", make_pod("p", "ns"))
+    got = c.wait_for_pod("ns", "p", lambda pod: pod and Pod(pod).phase == "Running",
+                         timeout_s=5.0)
+    assert got and Pod(got).node_name == "node-1"
+
+
+def test_unschedulable_condition():
+    c = FakeKubeClient()
+    c.create_pod("ns", make_pod("p", "ns"))
+    c.mark_unschedulable("ns", "p")
+    pod = Pod(c.get_pod("ns", "p"))
+    assert pod.unschedulable_reason()
