@@ -1,0 +1,111 @@
+"""Full-stack hot-mount benchmark (BASELINE config 1 through every layer).
+
+Boots the whole control plane in-process — fake 4-chip inventory, fake
+kubelet pod-resources gRPC server, fake API server with device-plugin
+scheduler emulation, real worker gRPC server, real master HTTP server —
+then measures the reference's AddGPU call stack (SURVEY.md §3.2) end to
+end: HTTP request → master → gRPC → worker → slave-pod scheduling →
+collector → mount → device nodes visible in the target "container" /dev.
+
+The metric is directly comparable to the north star (BASELINE.json):
+4 chips visible within 2000 ms of the mount request.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+
+def run_config1_full_stack(n_chips: int = 4) -> float:
+    from gpumounter_tpu.collector.collector import TpuCollector
+    from gpumounter_tpu.collector.podresources import PodResourcesClient
+    from gpumounter_tpu.master.app import (
+        MasterApp,
+        WorkerRegistry,
+        build_http_server,
+    )
+    from gpumounter_tpu.testing.cluster import FakeCluster
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+    from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+    root = tempfile.mkdtemp(prefix="tpumounter-bench-e2e-")
+    cluster = None
+    httpd = None
+    grpc_server = None
+    try:
+        cluster = FakeCluster(root, n_chips=n_chips).start()
+        container_dev = os.path.join(root, "container-dev")
+        os.makedirs(container_dev)
+
+        collector = TpuCollector(
+            backend=cluster.backend,
+            podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                            timeout_s=5.0),
+            cfg=cluster.cfg)
+        mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+        mounter.resolve_target = lambda pod: MountTarget(
+            dev_dir=container_dev,
+            description=f"{pod.namespace}/{pod.name}")
+        service = TpuMountService(cluster.kube, collector=collector,
+                                  mounter=mounter, cfg=cluster.cfg)
+        grpc_server = build_server(service, address="localhost:0")
+        grpc_port = grpc_server.bound_port
+        grpc_server.start()
+
+        cfg = cluster.cfg.replace(worker_port=grpc_port)
+        cluster.kube.create_pod(cfg.worker_namespace, {
+            "metadata": {"name": "tpu-mounter-worker-bench",
+                         "namespace": cfg.worker_namespace,
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": cluster.node_name,
+                     "containers": [{"name": "worker"}]},
+            "status": {"phase": "Running", "podIP": "127.0.0.1"},
+        })
+        app = MasterApp(cluster.kube, cfg=cfg,
+                        registry=WorkerRegistry(cluster.kube, cfg))
+        httpd = build_http_server(app, port=0, host="127.0.0.1")
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        cluster.add_target_pod("bench-pod")
+
+        t0 = time.monotonic()
+        url = (f"{base}/addtpu/namespace/default/pod/bench-pod/"
+               f"tpu/{n_chips}/isEntireMount/false")
+        with urllib.request.urlopen(url) as resp:
+            assert resp.status == 200, resp.read()
+        visible = [n for n in os.listdir(container_dev)
+                   if n.startswith("accel")]
+        assert len(visible) == n_chips, visible
+        latency_ms = (time.monotonic() - t0) * 1000.0
+
+        # Round-trip hygiene: remove again so the bench leaves no residue
+        # and the remove path is exercised too (not timed).
+        devices = service.collector.get_pod_devices("bench-pod", "default")
+        data = urllib.parse.urlencode(
+            {"uuids": ",".join(d.uuid for d in devices)}).encode()
+        req = urllib.request.Request(
+            f"{base}/removetpu/namespace/default/pod/bench-pod/force/false",
+            data=data, method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200, resp.read()
+        assert cluster.free_chip_count() == n_chips
+        return latency_ms
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        if grpc_server is not None:
+            grpc_server.stop(grace=None)
+        if cluster is not None:
+            cluster.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    print(f"{run_config1_full_stack():.2f} ms")

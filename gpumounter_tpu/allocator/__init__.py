@@ -1,0 +1,13 @@
+"""L4 allocator: scheduler-coherent TPU allocation via slave pods.
+
+Reference parity: pkg/util/gpu/allocator (allocator.go:27-317).
+"""
+
+from gpumounter_tpu.allocator.allocator import (
+    InsufficientTpuError,
+    MountType,
+    SlavePodError,
+    TpuAllocator,
+)
+
+__all__ = ["TpuAllocator", "MountType", "InsufficientTpuError", "SlavePodError"]

@@ -1,0 +1,304 @@
+"""Scheduler-coherence core: placeholder ("slave") pods hold TPU resources
+in the Kubernetes scheduler's books while chips are injected into the target.
+
+Reference parity — pkg/util/gpu/allocator/allocator.go:
+  * newGPUSlavePod: alpine sleep-loop pod in the pool namespace, label
+    app=<pool>, resource limits, NodeSelector pinned to the owner's node,
+    name "<owner>-slave-pod-<hex>", OwnerReferences → owner (GC'd with it)
+    (allocator.go:189-234)
+  * GetAvailableGPU: create total/num-per-pod slaves, wait Running, detect
+    Unschedulable → Insufficient, roll back on failure, then read the
+    slaves' device assignment from the collector (allocator.go:40-96)
+  * GetRemoveGPU: filter pod devices to slave-owned matching uuids;
+    entire-mount removes all; any unmatched uuid → empty (allocator.go:101-125)
+  * DeleteSlavePods + deletion wait (allocator.go:128-156)
+  * GetMountType heuristic: entire-mount iff #slave-pods < #chips
+    (allocator.go:158-187)
+
+TPU-native deltas (SURVEY.md §3 hot loops, §7): the reference busy-polls pod
+phase with zero sleep (checkCreateState/checkDeleteState, allocator.go:246-317);
+we use the watch API with a hard deadline (KubeClient.wait_for_pod). Waits for
+multiple slaves run concurrently. Resource name is google.com/tpu; note the
+GKE TPU device plugin on multi-host slices allocates atomically per slice
+(SURVEY.md §7 hard part #4) — single-host chip-granular pools are the
+supported target for slave-pod granularity.
+"""
+
+from __future__ import annotations
+
+import enum
+import secrets
+import threading
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.device.tpu import TpuDevice
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("allocator")
+
+
+class MountType(enum.Enum):
+    # Reference: MountType strings (pkg/util/gpu/types.go:21-28)
+    ENTIRE = "entire-mount"
+    SINGLE = "single-mount"
+    NONE = "no-mount"
+    UNKNOWN = "unknown-mount"
+
+
+class SlavePodError(RuntimeError):
+    pass
+
+
+class InsufficientTpuError(SlavePodError):
+    """Scheduler cannot place the slave pods: not enough free chips."""
+
+
+class TpuAllocator:
+    def __init__(self, kube: KubeClient, collector: TpuCollector, cfg=None):
+        self.kube = kube
+        self.collector = collector
+        self.cfg = cfg or get_config()
+        # Serializes slave-pod allocation on this node. Two concurrent
+        # requests that together exceed capacity would otherwise both
+        # create slaves, both observe Unschedulable, and both roll back
+        # (the reference races exactly like this); serialized, the first
+        # wins and the second gets a clean InsufficientTPU.
+        self._alloc_mutex = threading.Lock()
+
+    # --- slave pod manifest (reference: newGPUSlavePod, allocator.go:189-234) ---
+
+    def _slave_pod_manifest(self, owner: Pod, tpu_num: int) -> dict:
+        name = (f"{owner.name}{self.cfg.slave_pod_name_suffix}"
+                f"{secrets.token_hex(3)}")
+        # NOTE on GC: the reference sets OwnerReferences → the owner pod
+        # (allocator.go:202-212), but its slave pods live in gpu-pool while
+        # owners live elsewhere — Kubernetes forbids cross-namespace owner
+        # refs and its GC *deletes* dependents whose owner UID is absent in
+        # the dependent's own namespace, silently freeing chips that are
+        # still hot-mounted. We instead record ownership in labels (used by
+        # every ownership query) and reap orphans ourselves
+        # (worker.reaper.SlaveReaper).
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": self.cfg.pool_namespace,
+                "labels": {"app": "tpu-pool",
+                           "tpumounter.io/owner": owner.name,
+                           "tpumounter.io/owner-namespace": owner.namespace,
+                           "tpumounter.io/owner-uid": owner.uid},
+            },
+            "spec": {
+                "nodeSelector": {"kubernetes.io/hostname": owner.node_name},
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "placeholder",
+                    "image": self.cfg.slave_pod_image,
+                    "command": ["sleep", "infinity"],
+                    "resources": {
+                        "limits": {self.cfg.tpu_resource_name: str(tpu_num)},
+                        "requests": {self.cfg.tpu_resource_name: str(tpu_num)},
+                    },
+                }],
+                # Never restarted, never evicted for priority: plain pod.
+                "tolerations": [{"key": "google.com/tpu",
+                                 "operator": "Exists",
+                                 "effect": "NoSchedule"}],
+            },
+        }
+
+    # --- allocation (reference: GetAvailableGPU, allocator.go:40-96) ---
+
+    def get_available_tpus(self, owner: Pod, total_tpu_num: int,
+                           tpu_num_per_pod: int) -> tuple[list[TpuDevice], list[str]]:
+        """Create slave pods and return (devices, slave_pod_names).
+
+        total_tpu_num must be divisible by tpu_num_per_pod (entire-mount:
+        one slave holding all; single-mount: one slave per chip —
+        server.go:61-66).
+        """
+        if total_tpu_num <= 0 or total_tpu_num % tpu_num_per_pod != 0:
+            raise ValueError(
+                f"total_tpu_num={total_tpu_num} not divisible by "
+                f"tpu_num_per_pod={tpu_num_per_pod}")
+        if not owner.node_name:
+            raise SlavePodError(
+                f"owner pod {owner.namespace}/{owner.name} is not scheduled")
+        n_pods = total_tpu_num // tpu_num_per_pod
+        with self._alloc_mutex:
+            return self._allocate_locked(owner, total_tpu_num,
+                                         tpu_num_per_pod, n_pods)
+
+    def _allocate_locked(self, owner: Pod, total_tpu_num: int,
+                         tpu_num_per_pod: int,
+                         n_pods: int) -> tuple[list[TpuDevice], list[str]]:
+        created: list[str] = []
+        try:
+            for _ in range(n_pods):
+                manifest = self._slave_pod_manifest(owner, tpu_num_per_pod)
+                pod = self.kube.create_pod(self.cfg.pool_namespace, manifest)
+                created.append(Pod(pod).name)
+            self._wait_all_running(created)
+        except Exception:
+            self._rollback(created)
+            raise
+        devices: list[TpuDevice] = []
+        # One kubelet pod-resources refresh for the whole batch, then
+        # answer per-slave queries from the refreshed state (the reference
+        # re-Lists per query — a SURVEY §3 hot-loop).
+        self.collector.update_status()
+        for name in created:
+            devs = self.collector.get_slave_pod_devices(name, refresh=False)
+            if len(devs) != tpu_num_per_pod:
+                self._rollback(created)
+                raise SlavePodError(
+                    f"slave pod {name} reports {len(devs)} chip(s), "
+                    f"expected {tpu_num_per_pod} (device plugin lag?)")
+            devices.extend(devs)
+        logger.info("allocated %d chip(s) via %d slave pod(s) for %s/%s",
+                    len(devices), n_pods, owner.namespace, owner.name)
+        return devices, created
+
+    def _wait_all_running(self, names: list[str]) -> None:
+        errors: dict[str, Exception] = {}
+
+        def _wait(name: str) -> None:
+            def pred(pod_json):
+                if pod_json is None:
+                    raise SlavePodError(f"slave pod {name} deleted while waiting")
+                p = Pod(pod_json)
+                if p.phase == "Running":
+                    return True
+                reason = p.unschedulable_reason()
+                if reason:
+                    raise InsufficientTpuError(
+                        f"slave pod {name} unschedulable: {reason}")
+                if p.phase in ("Failed", "Succeeded"):
+                    raise SlavePodError(
+                        f"slave pod {name} entered phase {p.phase}")
+                return False
+            try:
+                result = self.kube.wait_for_pod(
+                    self.cfg.pool_namespace, name, pred,
+                    timeout_s=self.cfg.slave_pod_timeout_s)
+                if result is None:
+                    raise SlavePodError(
+                        f"slave pod {name} not Running within "
+                        f"{self.cfg.slave_pod_timeout_s}s")
+            except Exception as exc:  # noqa: BLE001 — collected per pod
+                errors[name] = exc
+
+        threads = [threading.Thread(target=_wait, args=(n,), daemon=True)
+                   for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            insufficient = [e for e in errors.values()
+                            if isinstance(e, InsufficientTpuError)]
+            raise (insufficient[0] if insufficient
+                   else next(iter(errors.values())))
+
+    def _rollback(self, names: list[str]) -> None:
+        # Reference: rollback on InsufficientGPU/FailedCreated (allocator.go:65-82)
+        if names:
+            logger.warning("rolling back %d slave pod(s)", len(names))
+        self.delete_slave_pods(names, wait=False)
+
+    # --- removal planning (reference: GetRemoveGPU, allocator.go:101-125) ---
+
+    def get_remove_tpus(self, pod: Pod, uuids: list[str],
+                        entire_mount: bool,
+                        refresh: bool = True) -> list[TpuDevice]:
+        """Slave-held devices of `pod` matching `uuids`.
+
+        Entire-mount removes everything regardless of uuids. Any uuid that
+        matches no slave-held device → return [] (worker maps to
+        TPUNotFound, reference server.go:130-135).
+        """
+        slave_names = {p.name for p in self.slave_pods_for(pod)}
+        devices = self.collector.get_pod_devices(pod.name, pod.namespace,
+                                                 slave_names, refresh=refresh)
+        slave_owned = [d for d in devices if d.pod_name in slave_names]
+        if entire_mount:
+            return slave_owned
+        by_uuid = {d.uuid: d for d in slave_owned}
+        out = []
+        for uuid in uuids:
+            dev = by_uuid.get(uuid)
+            if dev is None:
+                logger.warning("uuid %s not slave-held by %s/%s",
+                               uuid, pod.namespace, pod.name)
+                return []
+            out.append(dev)
+        return out
+
+    # --- slave pod deletion (reference: DeleteSlavePods, allocator.go:128-156) ---
+
+    def delete_slave_pods(self, names: list[str], wait: bool = True) -> None:
+        for name in names:
+            try:
+                self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                     grace_period_seconds=0)
+            except NotFoundError:
+                pass
+        if not wait:
+            return
+        for name in names:
+            gone = self.kube.wait_for_pod(
+                self.cfg.pool_namespace, name,
+                lambda pod_json: pod_json is None,
+                timeout_s=self.cfg.slave_pod_timeout_s)
+            if gone is None:
+                raise SlavePodError(
+                    f"slave pod {name} not deleted within "
+                    f"{self.cfg.slave_pod_timeout_s}s")
+
+    def slave_pods_for(self, pod: Pod) -> list[Pod]:
+        """Slave pods owned by this pod, matched by owner labels — name,
+        namespace, and (when known) UID, so same-named pods in different
+        namespaces, or a recreated pod with a recycled name, never
+        cross-talk. (The reference matches by name prefix only,
+        collector.go:156-161.)"""
+        selector = (f"tpumounter.io/owner={pod.name},"
+                    f"tpumounter.io/owner-namespace={pod.namespace}")
+        out = []
+        for p in self.kube.list_pods(self.cfg.pool_namespace,
+                                     label_selector=selector):
+            sp = Pod(p)
+            owner_uid = sp.labels.get("tpumounter.io/owner-uid", "")
+            if pod.uid and owner_uid and owner_uid != pod.uid:
+                continue
+            out.append(sp)
+        return out
+
+    def slave_pods_holding(self, pod: Pod,
+                           devices: list[TpuDevice]) -> list[str]:
+        """Names of slave pods owning any of `devices`."""
+        owners = {d.pod_name for d in devices
+                  if d.namespace == self.cfg.pool_namespace}
+        return sorted(owners)
+
+    # --- mount-type heuristic (reference: GetMountType, allocator.go:158-187) ---
+
+    def get_mount_type(self, pod: Pod, refresh: bool = True) -> MountType:
+        slaves = self.slave_pods_for(pod)
+        if not slaves:
+            return MountType.NONE
+        slave_names = {p.name for p in slaves}
+        devices = self.collector.get_pod_devices(pod.name, pod.namespace,
+                                                 slave_names, refresh=refresh)
+        slave_held = [d for d in devices
+                      if d.namespace == self.cfg.pool_namespace]
+        if not slave_held:
+            return MountType.UNKNOWN
+        if len(slaves) < len(slave_held):
+            return MountType.ENTIRE
+        if len(slaves) == len(slave_held):
+            return MountType.SINGLE
+        return MountType.UNKNOWN

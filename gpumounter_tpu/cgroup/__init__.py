@@ -1,0 +1,49 @@
+"""L6 cgroup layer: device grant/revoke behind one interface, v1 + v2.
+
+Reference parity: pkg/util/cgroup/cgroup.go (v1-only). The v2 side is the
+new native work (SURVEY.md §7 hard part #1).
+"""
+
+from __future__ import annotations
+
+from gpumounter_tpu.cgroup.naming import (
+    container_cgroup_dir,
+    detect_cgroup_driver,
+    detect_cgroup_version,
+    get_cgroup_pids,
+    pod_cgroup_relpath,
+    pod_qos_class,
+)
+from gpumounter_tpu.cgroup.v1 import CgroupError, V1DeviceController
+from gpumounter_tpu.cgroup.ebpf import DeviceRule, V2DeviceController
+
+_v2_singleton: V2DeviceController | None = None
+
+
+def device_controller(version: int):
+    """V1 or V2 device controller for the detected/forced cgroup version.
+
+    The v2 controller is a process singleton because it holds the saved
+    original-program fds across grant/revoke pairs.
+    """
+    global _v2_singleton
+    if version == 2:
+        if _v2_singleton is None:
+            _v2_singleton = V2DeviceController()
+        return _v2_singleton
+    return V1DeviceController()
+
+
+__all__ = [
+    "container_cgroup_dir",
+    "detect_cgroup_driver",
+    "detect_cgroup_version",
+    "get_cgroup_pids",
+    "pod_cgroup_relpath",
+    "pod_qos_class",
+    "CgroupError",
+    "V1DeviceController",
+    "V2DeviceController",
+    "DeviceRule",
+    "device_controller",
+]

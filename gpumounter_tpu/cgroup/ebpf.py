@@ -1,0 +1,382 @@
+"""cgroup-v2 device access control via BPF_PROG_TYPE_CGROUP_DEVICE.
+
+The genuinely new native component relative to the reference (SURVEY.md §2a):
+on cgroup v2 there are no `devices.allow`/`devices.deny` files — device
+access is mediated by eBPF programs attached to the cgroup with attach type
+BPF_CGROUP_DEVICE. The reference is v1-only (cgroup.go:115-118).
+
+Semantics that shape the design: with BPF_F_ALLOW_MULTI, *every* attached
+program must return 1 for access to be allowed (the kernel ANDs results).
+So hot-granting a device cannot be done by attaching an extra program —
+the container runtime's own program (runc attaches one per container) would
+still deny the new device. Instead we **replace**:
+
+  1. On first grant for a cgroup, query the attached device programs and
+     take fds to them (the fd pins the program even after detach).
+  2. Attach our own allow-list program: runc's default container device
+     rules + the pod's legitimately-allocated chips + the hot-granted set.
+  3. Detach the original program(s).
+  4. On revoke of the last hot-granted chip, re-attach the originals from
+     the saved fds and detach ours — exact restoration.
+
+Everything speaks bpf(2) directly via ctypes (no libbpf / cilium-ebpf
+dependency); the BPF bytecode for the allow-list program is assembled here.
+A C++ implementation of the same operations lives in native/ for
+environments where the Python path is undesirable.
+
+Program logic (mirrors what runc generates for v2 containers):
+
+    r2 = ctx->access_type & 0xFFFF      ; device type (1=block, 2=char)
+    r3 = ctx->access_type >> 16         ; access bits (1=mknod,2=read,4=write)
+    r4 = ctx->major
+    r5 = ctx->minor
+    for each rule:
+        type/major/minor mismatch -> next
+        requested access not a subset of rule access -> next
+        return 1
+    return 0
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from dataclasses import dataclass
+
+from gpumounter_tpu.device.tpu import TpuDevice
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("cgroup.ebpf")
+
+# --- kernel ABI constants (linux/bpf.h) ---
+
+SYS_BPF = 321  # x86_64
+BPF_PROG_LOAD = 5
+BPF_PROG_ATTACH = 8
+BPF_PROG_DETACH = 9
+BPF_PROG_GET_FD_BY_ID = 13
+BPF_PROG_QUERY = 16
+
+BPF_PROG_TYPE_CGROUP_DEVICE = 15
+BPF_CGROUP_DEVICE = 6
+BPF_F_ALLOW_MULTI = 2
+
+BPF_DEVCG_DEV_BLOCK = 1
+BPF_DEVCG_DEV_CHAR = 2
+BPF_DEVCG_ACC_MKNOD = 1
+BPF_DEVCG_ACC_READ = 2
+BPF_DEVCG_ACC_WRITE = 4
+
+# --- instruction opcodes ---
+
+OP_LDX_MEM_W = 0x61   # dst = *(u32 *)(src + off)
+OP_MOV64_IMM = 0xB7
+OP_MOV64_REG = 0xBF
+OP_AND64_IMM = 0x57
+OP_RSH64_IMM = 0x77
+OP_JNE_IMM = 0x55
+OP_EXIT = 0x95
+
+INSN_SIZE = 8
+
+
+def insn(op: int, dst: int = 0, src: int = 0, off: int = 0, imm: int = 0) -> bytes:
+    return struct.pack("<BBhi", op, (src << 4) | dst, off, imm)
+
+
+_ACCESS_BITS = {"r": BPF_DEVCG_ACC_READ, "w": BPF_DEVCG_ACC_WRITE,
+                "m": BPF_DEVCG_ACC_MKNOD}
+_TYPE_BITS = {"c": BPF_DEVCG_DEV_CHAR, "b": BPF_DEVCG_DEV_BLOCK, "a": 0}
+
+
+@dataclass(frozen=True)
+class DeviceRule:
+    """One allow-list entry: type 'c'/'b'/'a'(any), major/minor (None=any),
+    access ⊆ "rwm"."""
+    type: str
+    major: int | None
+    minor: int | None
+    access: str
+
+    def access_mask(self) -> int:
+        mask = 0
+        for ch in self.access:
+            mask |= _ACCESS_BITS[ch]
+        return mask
+
+
+# runc's standard AllowedDevices for containers: keeping these in the
+# replacement program preserves the container's normal /dev behavior.
+DEFAULT_CONTAINER_RULES: tuple[DeviceRule, ...] = (
+    DeviceRule("c", None, None, "m"),     # mknod any char device
+    DeviceRule("b", None, None, "m"),     # mknod any block device
+    DeviceRule("c", 1, 3, "rwm"),         # /dev/null
+    DeviceRule("c", 1, 5, "rwm"),         # /dev/zero
+    DeviceRule("c", 1, 7, "rwm"),         # /dev/full
+    DeviceRule("c", 1, 8, "rwm"),         # /dev/random
+    DeviceRule("c", 1, 9, "rwm"),         # /dev/urandom
+    DeviceRule("c", 5, 0, "rwm"),         # /dev/tty
+    DeviceRule("c", 5, 1, "rwm"),         # /dev/console
+    DeviceRule("c", 5, 2, "rwm"),         # /dev/ptmx
+    DeviceRule("c", 136, None, "rwm"),    # /dev/pts/*
+    DeviceRule("c", 10, 200, "rwm"),      # /dev/net/tun
+)
+
+
+def device_rule(dev: TpuDevice, access: str = "rw") -> DeviceRule:
+    return DeviceRule("c", dev.major, dev.minor, access)
+
+
+def build_device_program(rules: list[DeviceRule] | tuple[DeviceRule, ...]) -> bytes:
+    """Assemble the allow-list program; returns raw bpf_insn bytes."""
+    out = bytearray()
+    # prologue: unpack ctx (r1) into r2=type, r3=access, r4=major, r5=minor
+    out += insn(OP_LDX_MEM_W, dst=2, src=1, off=0)
+    out += insn(OP_MOV64_REG, dst=3, src=2)
+    out += insn(OP_RSH64_IMM, dst=3, imm=16)
+    out += insn(OP_AND64_IMM, dst=2, imm=0xFFFF)
+    out += insn(OP_LDX_MEM_W, dst=4, src=1, off=4)
+    out += insn(OP_LDX_MEM_W, dst=5, src=1, off=8)
+
+    for rule in rules:
+        block = bytearray()
+        checks: list[tuple[int, int]] = []  # (reg, expected) for JNE guards
+        type_bits = _TYPE_BITS[rule.type]
+        if type_bits:
+            checks.append((2, type_bits))
+        if rule.major is not None:
+            checks.append((4, rule.major))
+        if rule.minor is not None:
+            checks.append((5, rule.minor))
+        # tail of the block after the guards:
+        #   mov r6, r3; and r6, ~mask; jne r6,0,+2; mov r0,1; exit
+        tail_len = 5
+        # each guard jumps past the remainder of this rule block
+        n_guards = len(checks)
+        for i, (reg, expected) in enumerate(checks):
+            remaining = (n_guards - i - 1) + tail_len
+            block += insn(OP_JNE_IMM, dst=reg, off=remaining, imm=expected)
+        inv_mask = (~rule.access_mask()) & 0xFFFFFFFF
+        # as signed 32-bit immediate
+        inv_imm = inv_mask - (1 << 32) if inv_mask >= 1 << 31 else inv_mask
+        block += insn(OP_MOV64_REG, dst=6, src=3)
+        block += insn(OP_AND64_IMM, dst=6, imm=inv_imm)
+        block += insn(OP_JNE_IMM, dst=6, off=2, imm=0)
+        block += insn(OP_MOV64_IMM, dst=0, imm=1)
+        block += insn(OP_EXIT)
+        out += block
+
+    out += insn(OP_MOV64_IMM, dst=0, imm=0)
+    out += insn(OP_EXIT)
+    return bytes(out)
+
+
+# --- bpf(2) via ctypes ---
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+class BpfError(OSError):
+    pass
+
+
+def prog_load(insns: bytes, name: str = "tpumounter_dev") -> int:
+    """Load a CGROUP_DEVICE program; returns prog fd."""
+    insn_buf = ctypes.create_string_buffer(insns, len(insns))
+    license_buf = ctypes.create_string_buffer(b"Apache-2.0\x00")
+    log_buf = ctypes.create_string_buffer(65536)
+    attr = struct.pack(
+        "<II Q Q II Q II 16s",
+        BPF_PROG_TYPE_CGROUP_DEVICE,
+        len(insns) // INSN_SIZE,
+        ctypes.addressof(insn_buf),
+        ctypes.addressof(license_buf),
+        1,                       # log_level
+        len(log_buf),            # log_size
+        ctypes.addressof(log_buf),
+        0,                       # kern_version
+        0,                       # prog_flags
+        name.encode()[:15],
+    )
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    fd = _libc.syscall(SYS_BPF, BPF_PROG_LOAD, buf, len(attr))
+    if fd < 0:
+        err = ctypes.get_errno()
+        log = log_buf.value.decode(errors="replace").strip()
+        raise BpfError(err, f"BPF_PROG_LOAD: {os.strerror(err)}"
+                            + (f"; verifier: {log}" if log else ""))
+    return fd
+
+
+def _attach_attr(target_fd: int, attach_fd: int, flags: int = 0,
+                 replace_fd: int = 0) -> bytes:
+    return struct.pack("<IIIII", target_fd, attach_fd, BPF_CGROUP_DEVICE,
+                       flags, replace_fd)
+
+
+def prog_attach(cgroup_fd: int, prog_fd: int,
+                flags: int = BPF_F_ALLOW_MULTI) -> None:
+    attr = _attach_attr(cgroup_fd, prog_fd, flags)
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    if _libc.syscall(SYS_BPF, BPF_PROG_ATTACH, buf, len(attr)) < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_PROG_ATTACH: {os.strerror(err)}")
+
+
+def prog_detach(cgroup_fd: int, prog_fd: int) -> None:
+    attr = _attach_attr(cgroup_fd, prog_fd)
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    if _libc.syscall(SYS_BPF, BPF_PROG_DETACH, buf, len(attr)) < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_PROG_DETACH: {os.strerror(err)}")
+
+
+def prog_query(cgroup_fd: int, max_progs: int = 64) -> list[int]:
+    """IDs of device programs attached directly to the cgroup."""
+    ids = (ctypes.c_uint32 * max_progs)()
+    attr = struct.pack("<IIII Q I", cgroup_fd, BPF_CGROUP_DEVICE, 0, 0,
+                       ctypes.addressof(ids), max_progs)
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    if _libc.syscall(SYS_BPF, BPF_PROG_QUERY, buf, len(attr)) < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_PROG_QUERY: {os.strerror(err)}")
+    (_, _, _, _, _, count) = struct.unpack("<IIII Q I", buf.raw[:struct.calcsize("<IIII Q I")])
+    return [ids[i] for i in range(count)]
+
+
+def prog_get_fd_by_id(prog_id: int) -> int:
+    attr = struct.pack("<II", prog_id, 0)
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    fd = _libc.syscall(SYS_BPF, BPF_PROG_GET_FD_BY_ID, buf, len(attr))
+    if fd < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_PROG_GET_FD_BY_ID({prog_id}): {os.strerror(err)}")
+    return fd
+
+
+# --- controller ---
+
+@dataclass
+class _CgroupState:
+    cgroup_fd: int
+    original_fds: list[int]
+    our_fd: int | None
+    granted: dict[tuple[int, int], DeviceRule]
+    base_rules: list[DeviceRule]
+
+
+class V2DeviceController:
+    """Hot grant/revoke of device access on cgroup-v2 via program replacement.
+
+    Limitation (documented, reconciliation TODO for a later round): state
+    (original program fds) lives in this process. If the worker restarts
+    between grant and revoke, the original runc program is unrecoverable —
+    `revoke_all` then leaves our program in place rather than breaking the
+    container. The reference has the same class of gap (SURVEY.md §5:
+    "no reconciliation loop").
+    """
+
+    def __init__(self):
+        self._state: dict[str, _CgroupState] = {}
+
+    def _get_state(self, cgroup_dir: str,
+                   base_rules: list[DeviceRule] | None) -> _CgroupState:
+        st = self._state.get(cgroup_dir)
+        if st is not None:
+            return st
+        cgroup_fd = os.open(cgroup_dir, os.O_RDONLY | os.O_DIRECTORY)
+        original_fds = []
+        try:
+            for prog_id in prog_query(cgroup_fd):
+                original_fds.append(prog_get_fd_by_id(prog_id))
+        except BpfError as exc:
+            # Must fail hard: proceeding with original_fds empty would
+            # attach our program WITHOUT detaching runc's, and under
+            # ALLOW_MULTI (AND semantics) the hot-granted device would
+            # still be denied — a silent no-op grant.
+            for fd in original_fds:
+                os.close(fd)
+            os.close(cgroup_fd)
+            raise BpfError(
+                exc.errno or 0,
+                f"cannot query existing device progs on {cgroup_dir} "
+                f"({exc}); refusing to grant blindly") from exc
+        st = _CgroupState(cgroup_fd=cgroup_fd, original_fds=original_fds,
+                          our_fd=None, granted={},
+                          base_rules=list(base_rules or []))
+        self._state[cgroup_dir] = st
+        return st
+
+    def _rules(self, st: _CgroupState) -> list[DeviceRule]:
+        return (list(DEFAULT_CONTAINER_RULES) + st.base_rules
+                + list(st.granted.values()))
+
+    def _swap_program(self, st: _CgroupState) -> None:
+        new_fd = prog_load(build_device_program(self._rules(st)))
+        try:
+            prog_attach(st.cgroup_fd, new_fd)
+        except BpfError:
+            os.close(new_fd)
+            raise
+        # detach what the new program supersedes
+        stale = ([st.our_fd] if st.our_fd is not None else
+                 list(st.original_fds))
+        for fd in stale:
+            try:
+                prog_detach(st.cgroup_fd, fd)
+            except BpfError as exc:
+                logger.warning("detach of superseded device prog failed: %s", exc)
+        if st.our_fd is not None:
+            os.close(st.our_fd)
+        st.our_fd = new_fd
+
+    def grant(self, cgroup_dir: str, dev: TpuDevice,
+              base_rules: list[DeviceRule] | None = None) -> None:
+        st = self._get_state(cgroup_dir, base_rules)
+        st.granted[(dev.major, dev.minor)] = device_rule(dev)
+        self._swap_program(st)
+        logger.info("cgroup v2: granted c %d:%d rw on %s",
+                    dev.major, dev.minor, cgroup_dir)
+
+    def revoke(self, cgroup_dir: str, dev: TpuDevice) -> None:
+        st = self._state.get(cgroup_dir)
+        if st is None:
+            logger.warning("revoke on untracked cgroup %s; no-op", cgroup_dir)
+            return
+        st.granted.pop((dev.major, dev.minor), None)
+        if st.granted:
+            self._swap_program(st)
+            return
+        # Last grant gone: restore the original program set exactly.
+        restored = 0
+        for fd in st.original_fds:
+            try:
+                prog_attach(st.cgroup_fd, fd)
+                restored += 1
+            except BpfError as exc:
+                logger.error("cannot restore original device prog: %s", exc)
+        if st.our_fd is not None and (restored == len(st.original_fds)):
+            try:
+                prog_detach(st.cgroup_fd, st.our_fd)
+            except BpfError as exc:
+                logger.warning("detach of our device prog failed: %s", exc)
+            os.close(st.our_fd)
+            st.our_fd = None
+        self._close_state(cgroup_dir)
+        logger.info("cgroup v2: revoked c %d:%d on %s (restored %d orig prog(s))",
+                    dev.major, dev.minor, cgroup_dir, restored)
+
+    def _close_state(self, cgroup_dir: str) -> None:
+        st = self._state.pop(cgroup_dir, None)
+        if st is None:
+            return
+        for fd in st.original_fds:
+            os.close(fd)
+        if st.our_fd is not None:
+            os.close(st.our_fd)
+        os.close(st.cgroup_fd)
+
+    def close(self) -> None:
+        for cgroup_dir in list(self._state):
+            self._close_state(cgroup_dir)

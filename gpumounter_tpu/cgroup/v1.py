@@ -1,0 +1,67 @@
+"""cgroup-v1 device controller: devices.allow / devices.deny writes.
+
+Reference parity: AddGPUDevicePermission / RemoveGPUDevicePermission
+(cgroup.go:143-169), which shell out to
+`sh -c "echo 'c 195:<minor> rw' > .../devices.allow|deny"` with a hardcoded
+major. Here: direct file writes (no shell), major:minor from stat(2)
+(SURVEY.md §2a — TPU majors are dynamic).
+"""
+
+from __future__ import annotations
+
+import os
+
+from gpumounter_tpu.device.tpu import DEVICE_CGROUP_PERMISSION, TpuDevice
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("cgroup.v1")
+
+
+class CgroupError(RuntimeError):
+    pass
+
+
+class V1DeviceController:
+    """Grant/revoke char-device access on a v1 `devices` controller dir."""
+
+    def __init__(self, permission: str = DEVICE_CGROUP_PERMISSION):
+        self.permission = permission
+
+    def _write(self, cgroup_dir: str, filename: str, rule: str) -> None:
+        path = os.path.join(cgroup_dir, filename)
+        try:
+            with open(path, "w") as f:
+                f.write(rule)
+        except OSError as exc:
+            raise CgroupError(f"write {rule!r} to {path}: {exc}") from exc
+        logger.debug("cgroup v1: %s <- %r", path, rule)
+
+    def grant(self, cgroup_dir: str, dev: TpuDevice) -> None:
+        self._write(cgroup_dir, "devices.allow",
+                    f"c {dev.major}:{dev.minor} {self.permission}")
+
+    def revoke(self, cgroup_dir: str, dev: TpuDevice) -> None:
+        self._write(cgroup_dir, "devices.deny",
+                    f"c {dev.major}:{dev.minor} {self.permission}")
+
+    def allowed(self, cgroup_dir: str, dev: TpuDevice) -> bool | None:
+        """Best-effort check via devices.list; None if unreadable.
+
+        devices.list is only populated meaningfully on the default
+        whitelist hierarchy; used by tests and the CLI `status` verb.
+        """
+        path = os.path.join(cgroup_dir, "devices.list")
+        try:
+            with open(path) as f:
+                entries = f.read().splitlines()
+        except OSError:
+            return None
+        want = {f"c {dev.major}:{dev.minor}", f"c {dev.major}:*", "a *:*",
+                "c *:*"}
+        for line in entries:
+            parts = line.split()
+            if len(parts) != 3:
+                continue
+            if f"{parts[0]} {parts[1]}" in want and "r" in parts[2] and "w" in parts[2]:
+                return True
+        return False

@@ -1,0 +1,12 @@
+"""L5 collector: device inventory + pod↔device ownership map.
+
+Reference parity: pkg/util/gpu/collector (collector.go:23-194).
+"""
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import (
+    FakeKubeletServer,
+    PodResourcesClient,
+)
+
+__all__ = ["TpuCollector", "PodResourcesClient", "FakeKubeletServer"]

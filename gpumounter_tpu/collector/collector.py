@@ -1,0 +1,171 @@
+"""TpuCollector: node chip inventory + pod↔chip ownership.
+
+Reference parity: GPUCollector (collector.go:19-163) —
+  * NewGPUCollector = enumerate + initial status refresh (collector.go:23-38)
+  * UpdateGPUStatus = kubelet pod-resources List → mark owners (collector.go:90-138)
+  * GetPodGPUResources = refresh, then devices owned by the pod or its
+    slave pods (collector.go:149-163)
+  * GetGPUByUUID (collector.go:81-88)
+
+TPU-native deltas (SURVEY.md §7):
+  * Enumeration is the device backend (readdir+stat of /dev/accel*), not NVML.
+  * Resource name google.com/tpu, pod-resources v1 with v1alpha1 fallback.
+  * The reference mutates GPUList with no lock while serving concurrent RPCs
+    (SURVEY.md §5 race hazard); all state here is guarded by an RLock.
+  * Device-ID matching is tolerant of the plugin's ID scheme: the GKE TPU
+    device plugin advertises bare chip indices ("0".."7"); we also accept
+    accelN basenames, device paths, and our uuid form.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from gpumounter_tpu.collector.podresources import (
+    PodResourcesClient,
+    iter_device_claims,
+)
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.device.backend import DeviceBackend, backend_from_config
+from gpumounter_tpu.device.tpu import TpuDevice
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("collector")
+
+_INDEX_RE = re.compile(r"^(?:accel)?(\d+)$")
+
+
+class TpuCollector:
+    def __init__(self, backend: DeviceBackend | None = None,
+                 podresources: PodResourcesClient | None = None,
+                 cfg=None):
+        self.cfg = cfg or get_config()
+        self.backend = backend or backend_from_config(self.cfg)
+        self._podresources = podresources
+        self._lock = threading.RLock()
+        self.devices: list[TpuDevice] = []
+        self.refresh_inventory()
+        try:
+            self.update_status()
+        except FileNotFoundError:
+            # No kubelet socket (local / dry-run mode): inventory only.
+            logger.warning("kubelet pod-resources socket unavailable; "
+                           "running without ownership info")
+
+    # --- enumeration (reference: GetGPUInfo, collector.go:40-79) ---
+
+    def refresh_inventory(self) -> None:
+        with self._lock:
+            fresh = self.backend.list_devices()
+            # Preserve ownership marks for devices that persist across
+            # rescans (hot-unplug/replug keeps identity via uuid).
+            old = {d.uuid: d for d in self.devices}
+            for dev in fresh:
+                prev = old.get(dev.uuid)
+                if prev is not None and prev.pod_name:
+                    dev.mark_allocated(prev.pod_name, prev.namespace)
+            self.devices = fresh
+            logger.info("TPU inventory: %d chip(s)", len(self.devices))
+
+    # --- ownership refresh (reference: UpdateGPUStatus, collector.go:90-138) ---
+
+    def _client(self) -> PodResourcesClient:
+        if self._podresources is None:
+            self._podresources = PodResourcesClient(
+                self.cfg.kubelet_socket,
+                timeout_s=self.cfg.kubelet_conn_timeout_s,
+                api=self.cfg.pod_resources_api)
+        return self._podresources
+
+    def _match_device(self, device_id: str) -> TpuDevice | None:
+        """Map a device-plugin ID to a chip. Lock must be held."""
+        for dev in self.devices:
+            if device_id == dev.uuid or device_id == dev.device_path:
+                return dev
+        m = _INDEX_RE.match(device_id)
+        if m:
+            idx = int(m.group(1))
+            for dev in self.devices:
+                if dev.index == idx:
+                    return dev
+        return None
+
+    def update_status(self) -> None:
+        client = self._client()
+        pod_resources = client.list()
+        with self._lock:
+            for dev in self.devices:
+                dev.reset_state()
+            unmatched: list[str] = []
+            for pod, ns, device_id in iter_device_claims(
+                    pod_resources, self.cfg.tpu_resource_name):
+                dev = self._match_device(device_id)
+                if dev is None:
+                    unmatched.append(device_id)
+                    continue
+                dev.mark_allocated(pod, ns)
+            if unmatched:
+                logger.warning("pod-resources advertises %s=%s not in local "
+                               "inventory", self.cfg.tpu_resource_name,
+                               unmatched)
+
+    # --- queries ---
+
+    def get_device_by_uuid(self, uuid: str) -> TpuDevice | None:
+        # Reference: GetGPUByUUID (collector.go:81-88)
+        with self._lock:
+            for dev in self.devices:
+                if dev.uuid == uuid:
+                    return dev
+        return None
+
+    def free_devices(self) -> list[TpuDevice]:
+        with self._lock:
+            return [d for d in self.devices if not d.pod_name]
+
+    def get_pod_devices(self, pod_name: str, namespace: str,
+                        slave_pod_names: set[str] | None = None,
+                        refresh: bool = True) -> list[TpuDevice]:
+        """Chips owned by the pod, or by the named slave pods in the pool
+        namespace.
+
+        Reference analog: GetPodGPUResources (collector.go:149-163). The
+        reference couples collector to allocator via a "<pod>-slave-pod-"
+        name-prefix convention, which cross-talks between same-named pods
+        in different namespaces; here the allocator passes the exact slave
+        names it found via owner labels. With slave_pod_names=None, falls
+        back to the prefix convention (CLI/debug use).
+        """
+        if refresh:
+            self.update_status()
+        slave_prefix = pod_name + self.cfg.slave_pod_name_suffix
+        with self._lock:
+            out = []
+            for dev in self.devices:
+                if not dev.pod_name:
+                    continue
+                if dev.pod_name == pod_name and dev.namespace == namespace:
+                    out.append(dev)
+                elif dev.namespace == self.cfg.pool_namespace and (
+                        dev.pod_name in slave_pod_names
+                        if slave_pod_names is not None
+                        else dev.pod_name.startswith(slave_prefix)):
+                    out.append(dev)
+            return out
+
+    def get_slave_pod_devices(self, slave_pod_name: str,
+                              refresh: bool = True) -> list[TpuDevice]:
+        """Chips the scheduler handed to one slave pod (allocator.go:85-96)."""
+        if refresh:
+            self.update_status()
+        with self._lock:
+            return [d for d in self.devices
+                    if d.pod_name == slave_pod_name
+                    and d.namespace == self.cfg.pool_namespace]
+
+    def snapshot(self) -> list[TpuDevice]:
+        """Copy of the inventory for read-only display (CLI, /devices)."""
+        import copy
+        with self._lock:
+            return copy.deepcopy(self.devices)

@@ -1,0 +1,201 @@
+"""kubelet pod-resources API: messages, client, and an in-process fake.
+
+Reference parity: the worker dials the kubelet's pod-resources unix socket
+and calls PodResourcesLister.List to learn which pod owns which device
+(collector.go:165-194, using the v1alpha1 generated client). Differences
+here, per SURVEY.md §7:
+
+  * We speak **v1 first** (modern kubelets; has cpu_ids/memory/topology)
+    and fall back to **v1alpha1** (what the reference hardcodes,
+    collector.go:16). The two versions share field numbers for everything
+    we read, so one message set decodes both; only the gRPC service name
+    differs (v1.PodResourcesLister vs v1alpha1.PodResourcesLister).
+  * Messages ride our hand-rolled proto3 codec (rpc/wire.py) — no protoc,
+    no generated code (the reference carries 481 generated lines).
+  * The reference has no test substrate (SURVEY.md §4); FakeKubeletServer
+    is a real gRPC server on a unix socket serving canned ListPodResources
+    responses, so collector tests exercise the actual wire path.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent import futures
+
+import grpc
+
+from gpumounter_tpu.rpc.wire import Field, Message
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("podresources")
+
+# Full gRPC service names, per k8s.io/kubelet/pkg/apis/podresources.
+SERVICE_V1 = "v1.PodResourcesLister"
+SERVICE_V1ALPHA1 = "v1alpha1.PodResourcesLister"
+LIST_METHOD = "List"
+
+
+class TopologyInfo(Message):
+    FIELDS = []  # NUMA nodes unused by us; unknown fields are skipped anyway
+
+
+class ContainerDevices(Message):
+    # v1 & v1alpha1: resource_name = 1, device_ids = 2 (v1 adds topology = 3)
+    FIELDS = [
+        Field(1, "resource_name", "string"),
+        Field(2, "device_ids", "string", repeated=True),
+    ]
+
+
+class ContainerResources(Message):
+    # v1 & v1alpha1: name = 1, devices = 2 (v1 adds cpu_ids = 3, memory = 4)
+    FIELDS = [
+        Field(1, "name", "string"),
+        Field(2, "devices", "message", repeated=True, message=ContainerDevices),
+    ]
+
+
+class PodResources(Message):
+    FIELDS = [
+        Field(1, "name", "string"),
+        Field(2, "namespace", "string"),
+        Field(3, "containers", "message", repeated=True, message=ContainerResources),
+    ]
+
+
+class ListPodResourcesRequest(Message):
+    FIELDS = []
+
+
+class ListPodResourcesResponse(Message):
+    FIELDS = [
+        Field(1, "pod_resources", "message", repeated=True, message=PodResources),
+    ]
+
+
+class PodResourcesClient:
+    """gRPC client for the kubelet pod-resources socket with version nego.
+
+    Reference analog: connectToServer + ListPods (collector.go:165-194),
+    which dials with a 10 s timeout and is pinned to v1alpha1.
+    """
+
+    def __init__(self, socket_path: str, timeout_s: float = 10.0,
+                 api: str = "auto"):
+        if not os.path.exists(socket_path):
+            raise FileNotFoundError(
+                f"kubelet pod-resources socket not found: {socket_path}")
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(f"unix://{socket_path}")
+        if api == "auto":
+            self._services = [SERVICE_V1, SERVICE_V1ALPHA1]
+        elif api == "v1":
+            self._services = [SERVICE_V1]
+        elif api == "v1alpha1":
+            self._services = [SERVICE_V1ALPHA1]
+        else:
+            raise ValueError(f"unknown pod-resources api {api!r}")
+        self._pinned: str | None = self._services[0] if len(self._services) == 1 else None
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _call_list(self, service: str) -> ListPodResourcesResponse:
+        stub = self._channel.unary_unary(
+            f"/{service}/{LIST_METHOD}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=ListPodResourcesResponse.decode)
+        return stub(ListPodResourcesRequest(), timeout=self.timeout_s)
+
+    def list(self) -> list[PodResources]:
+        """ListPodResources; negotiates v1 → v1alpha1 on UNIMPLEMENTED."""
+        if self._pinned is not None:
+            return self._call_list(self._pinned).pod_resources
+        last_err: Exception | None = None
+        for service in self._services:
+            try:
+                resp = self._call_list(service)
+                self._pinned = service
+                logger.debug("pod-resources API pinned to %s", service)
+                return resp.pod_resources
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    last_err = exc
+                    continue
+                raise
+        raise RuntimeError(
+            f"kubelet at {self.socket_path} serves no known pod-resources "
+            f"API version: {last_err}")
+
+
+def iter_device_claims(pod_resources: list[PodResources], resource_name: str):
+    """Yield (pod_name, namespace, device_id) for a resource across pods.
+
+    Reference analog: the loop marking devices allocated in UpdateGPUStatus
+    (collector.go:113-135), filtered on ResourceName == "nvidia.com/gpu".
+    """
+    for pr in pod_resources:
+        for container in pr.containers:
+            for dev in container.devices:
+                if dev.resource_name != resource_name:
+                    continue
+                for device_id in dev.device_ids:
+                    yield pr.name, pr.namespace, device_id
+
+
+class FakeKubeletServer:
+    """In-process pod-resources gRPC server over a unix socket (tests/bench).
+
+    Serves whichever API versions it is told to, so tests cover both the v1
+    happy path and the v1alpha1 fallback. State is a mutable list of
+    (pod_name, namespace, container, resource_name, [device_ids]).
+    """
+
+    def __init__(self, socket_path: str, versions: tuple[str, ...] = ("v1",)):
+        self.socket_path = socket_path
+        self.claims: list[tuple[str, str, str, str, list[str]]] = []
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        service_names = {"v1": SERVICE_V1, "v1alpha1": SERVICE_V1ALPHA1}
+        for v in versions:
+            handler = grpc.method_handlers_generic_handler(
+                service_names[v],
+                {LIST_METHOD: grpc.unary_unary_rpc_method_handler(
+                    self._list,
+                    request_deserializer=ListPodResourcesRequest.decode,
+                    response_serializer=lambda m: m.encode())})
+            self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(f"unix://{socket_path}")
+
+    def _list(self, request, context) -> ListPodResourcesResponse:
+        pods: dict[tuple[str, str], PodResources] = {}
+        for pod, ns, container, resource, ids in self.claims:
+            pr = pods.setdefault((ns, pod),
+                                 PodResources(name=pod, namespace=ns))
+            cr = next((c for c in pr.containers if c.name == container), None)
+            if cr is None:
+                cr = ContainerResources(name=container)
+                pr.containers.append(cr)
+            cr.devices.append(ContainerDevices(
+                resource_name=resource, device_ids=list(ids)))
+        return ListPodResourcesResponse(pod_resources=list(pods.values()))
+
+    def set_claim(self, pod: str, namespace: str, resource: str,
+                  device_ids: list[str], container: str = "main") -> None:
+        self.claims.append((pod, namespace, container, resource, list(device_ids)))
+
+    def clear(self) -> None:
+        self.claims.clear()
+
+    def start(self) -> "FakeKubeletServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=None)

@@ -38,8 +38,11 @@ class DeviceBackend(abc.ABC):
     @abc.abstractmethod
     def list_devices(self) -> list[TpuDevice]: ...
 
-    @abc.abstractmethod
-    def device_by_uuid(self, uuid: str) -> TpuDevice | None: ...
+    def device_by_uuid(self, uuid: str) -> TpuDevice | None:
+        for dev in self.list_devices():
+            if dev.uuid == uuid:
+                return dev
+        return None
 
     def running_pids(self, device: TpuDevice) -> list[int]:
         """PIDs (host view) holding the device node open."""
@@ -97,12 +100,6 @@ class RealAccelBackend(DeviceBackend):
                 uuid=self._chip_uuid(name, index)))
         devices.sort(key=lambda d: d.index)
         return devices
-
-    def device_by_uuid(self, uuid: str) -> TpuDevice | None:
-        for dev in self.list_devices():
-            if dev.uuid == uuid:
-                return dev
-        return None
 
 
 class FakeDeviceBackend(DeviceBackend):
@@ -190,12 +187,6 @@ class FakeDeviceBackend(DeviceBackend):
                 uuid=f"tpu-fake-accel{index}"))
         devices.sort(key=lambda d: d.index)
         return devices
-
-    def device_by_uuid(self, uuid: str) -> TpuDevice | None:
-        for dev in self.list_devices():
-            if dev.uuid == uuid:
-                return dev
-        return None
 
     def running_pids(self, device: TpuDevice) -> list[int]:
         # Fake devices cloned from /dev/null share its rdev; rdev matching

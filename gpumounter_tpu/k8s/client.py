@@ -92,44 +92,58 @@ class KubeClient(abc.ABC):
         DELETED event / absent pod.
         """
         deadline = time.monotonic() + timeout_s
-        try:
-            pod = self.get_pod(namespace, name)
-        except NotFoundError:
-            pod = None
-        if predicate(pod):
-            return pod if pod is not None else {"__deleted__": True}
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 return None
+            # Subscribe FIRST (watch_pods connects eagerly), then check
+            # current state: an event landing between the check and the
+            # subscription can then never be lost — it is already queued
+            # on the open watch.
+            watch = None
             try:
-                for etype, obj in self.watch_pods(
+                try:
+                    watch = self.watch_pods(
                         namespace,
                         field_selector=f"metadata.name={name}",
-                        timeout_s=min(remaining, 30.0)):
-                    if etype == "DELETED":
-                        if predicate(None):
-                            return {"__deleted__": True}
-                        continue
-                    if predicate(obj):
-                        return obj
-                    if time.monotonic() >= deadline:
-                        return None
-            except ApiError as exc:
-                logger.warning("watch failed (%s); falling back to poll", exc)
-                time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
-            else:
-                # Watch window closed early without a match (apiserver/proxy
-                # may end streams immediately): don't degenerate into a
-                # zero-sleep reconnect loop.
-                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
-            # Watch window expired or errored: re-check current state.
-            try:
-                pod = self.get_pod(namespace, name)
-            except NotFoundError:
-                pod = None
-            if predicate(pod):
-                return pod if pod is not None else {"__deleted__": True}
+                        timeout_s=min(remaining, 30.0))
+                except ApiError as exc:
+                    logger.warning("watch failed (%s); falling back to poll",
+                                   exc)
+                    time.sleep(min(1.0, max(0.0,
+                                            deadline - time.monotonic())))
+                try:
+                    pod = self.get_pod(namespace, name)
+                except NotFoundError:
+                    pod = None
+                if predicate(pod):
+                    return pod if pod is not None else {"__deleted__": True}
+                if watch is None:
+                    continue
+                try:
+                    for etype, obj in watch:
+                        if etype == "DELETED":
+                            if predicate(None):
+                                return {"__deleted__": True}
+                            continue
+                        if predicate(obj):
+                            return obj
+                        if time.monotonic() >= deadline:
+                            return None
+                except ApiError as exc:
+                    logger.warning("watch stream failed (%s); retrying", exc)
+                    time.sleep(min(1.0, max(0.0,
+                                            deadline - time.monotonic())))
+                else:
+                    # Watch window closed without a match (apiserver/proxy
+                    # may end streams immediately): don't degenerate into a
+                    # zero-sleep reconnect loop.
+                    time.sleep(min(0.2, max(0.0,
+                                            deadline - time.monotonic())))
+            finally:
+                close = getattr(watch, "close", None)
+                if close is not None:
+                    close()
 
 
 class RestKubeClient(KubeClient):
@@ -211,29 +225,57 @@ class RestKubeClient(KubeClient):
             query["fieldSelector"] = field_selector
         if resource_version:
             query["resourceVersion"] = resource_version
+        # Open the connection EAGERLY (before the generator is consumed):
+        # wait_for_pod depends on watch-then-recheck ordering to avoid
+        # losing events raised between its state check and the watch start.
         conn, resp = self._request(
             "GET", f"/api/v1/namespaces/{namespace}/pods", query,
             timeout=timeout_s + 10.0)
-        try:
-            if resp.status >= 400:
-                _raise_for(resp.status, resp.read().decode("utf-8", "replace"))
-            buf = b""
-            while True:
-                try:
-                    chunk = resp.read1(65536)
-                except (socket.timeout, TimeoutError):
-                    return
-                if not chunk:
-                    return
-                buf += chunk
-                while b"\n" in buf:
-                    line, _, buf = buf.partition(b"\n")
-                    if not line.strip():
-                        continue
-                    event = json.loads(line)
-                    yield event.get("type", ""), event.get("object", {})
-        finally:
+        if resp.status >= 400:
+            body = resp.read().decode("utf-8", "replace")
             conn.close()
+            _raise_for(resp.status, body)
+        return _WatchStream(conn, resp)
+
+
+class _WatchStream:
+    """Iterator over watch events that owns the HTTP connection: `close()`
+    releases it even when the stream is never consumed (generators only run
+    their finally once started)."""
+
+    def __init__(self, conn, resp):
+        self._conn = conn
+        self._resp = resp
+        self._buf = b""
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[str, dict]:
+        if self._done:
+            raise StopIteration
+        while True:
+            while b"\n" in self._buf:
+                line, _, self._buf = self._buf.partition(b"\n")
+                if not line.strip():
+                    continue
+                event = json.loads(line)
+                return event.get("type", ""), event.get("object", {})
+            try:
+                chunk = self._resp.read1(65536)
+            except (socket.timeout, TimeoutError):
+                chunk = b""
+            if not chunk:
+                self.close()
+                raise StopIteration
+
+            self._buf += chunk
+
+    def close(self) -> None:
+        if not self._done:
+            self._done = True
+            self._conn.close()
 
 
 def in_cluster_client() -> RestKubeClient:

@@ -46,12 +46,14 @@ def _match_field_selector(pod: dict, selector: str) -> bool:
 
 class FakeKubeClient(KubeClient):
     def __init__(self, scheduler_hook: SchedulerHook | None = None,
-                 scheduler_delay_s: float = 0.0):
+                 scheduler_delay_s: float = 0.0,
+                 delete_hook: SchedulerHook | None = None):
         self._pods: dict[tuple[str, str], dict] = {}
         self._lock = threading.Condition()
         self._events: list[tuple[int, str, dict]] = []  # (seq, type, pod)
         self._seq = itertools.count(1)
         self.scheduler_hook = scheduler_hook
+        self.delete_hook = delete_hook
         self.scheduler_delay_s = scheduler_delay_s
         self.create_calls = 0
         self.delete_calls = 0
@@ -91,12 +93,16 @@ class FakeKubeClient(KubeClient):
             def _schedule():
                 if self.scheduler_delay_s:
                     time.sleep(self.scheduler_delay_s)
+                # Mutate the stored pod under the store lock: concurrent
+                # get/list/watch deepcopy the store and must never observe
+                # a half-written status. (Condition() wraps an RLock, so
+                # _emit's re-acquisition inside is fine.)
                 with self._lock:
                     stored = self._pods.get((namespace, name))
-                if stored is None:
-                    return
-                self.scheduler_hook(stored)
-                self._emit("MODIFIED", stored)
+                    if stored is None:
+                        return
+                    self.scheduler_hook(stored)
+                    self._emit("MODIFIED", stored)
             threading.Thread(target=_schedule, daemon=True).start()
         return copy.deepcopy(pod)
 
@@ -105,6 +111,8 @@ class FakeKubeClient(KubeClient):
             pod = self._pods.pop((namespace, name), None)
             self.delete_calls += 1
         if pod is not None:
+            if self.delete_hook is not None:
+                self.delete_hook(pod)
             self._emit("DELETED", pod)
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
@@ -126,9 +134,17 @@ class FakeKubeClient(KubeClient):
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
                    resource_version: str = "") -> Iterator[tuple[str, dict]]:
+        # Subscribe EAGERLY (cursor captured at call time, not at first
+        # next()): callers rely on open-watch-then-recheck to close the
+        # missed-event window (KubeClient.wait_for_pod).
         deadline = time.monotonic() + timeout_s
         with self._lock:
             cursor = self._events[-1][0] if self._events else 0
+        return self._watch_iter(namespace, label_selector, field_selector,
+                                deadline, cursor)
+
+    def _watch_iter(self, namespace, label_selector, field_selector,
+                    deadline, cursor) -> Iterator[tuple[str, dict]]:
         while True:
             with self._lock:
                 pending = [(s, t, p) for (s, t, p) in self._events if s > cursor]

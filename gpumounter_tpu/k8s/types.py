@@ -127,9 +127,13 @@ def match_label_selector(labels: dict[str, str], selector: str) -> bool:
             k, _, v = clause.partition("!=")
             if labels.get(k.strip()) == v.strip():
                 return False
+        elif "==" in clause:
+            k, _, v = clause.partition("==")
+            if labels.get(k.strip()) != v.strip():
+                return False
         elif "=" in clause:
             k, _, v = clause.partition("=")
-            if labels.get(k.strip().rstrip("=")) != v.strip():
+            if labels.get(k.strip()) != v.strip():
                 return False
         else:  # bare key: existence
             if clause not in labels:

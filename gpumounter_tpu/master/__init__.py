@@ -1,0 +1,8 @@
+"""L1 master: HTTP API gateway routing to per-node workers.
+
+Reference parity: cmd/GPUMounter-master/main.go.
+"""
+
+from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+
+__all__ = ["MasterApp", "WorkerRegistry"]

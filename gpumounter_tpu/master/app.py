@@ -1,0 +1,267 @@
+"""Master HTTP gateway.
+
+Reference parity — cmd/GPUMounter-master/main.go:
+  * Routes (main.go:232-234):
+      GET  /addgpu/namespace/:ns/pod/:pod/gpu/:n/isEntireMount/:bool
+      POST /removegpu/namespace/:ns/pod/:pod/force/:bool   (form: uuids)
+      GET  /
+    plus TPU-native aliases /addtpu/.../tpu/:n/... and /removetpu/...
+  * Target pod lookup to find its node (main.go:52-66).
+  * Worker discovery by listing labeled pods (findAllWorker, main.go:248-268)
+    — but cached with a TTL here instead of one LIST per request
+    (SURVEY.md §3 hot-loop fix).
+  * gRPC to worker `podIP:1200` (main.go:82,185) via rpc.client.WorkerClient.
+  * Result→HTTP mapping kept exactly: Add Success→200 body "Add ... Success",
+    Insufficient→500, PodNotFound→400 (main.go:103-116); Remove
+    PodNotFound/Busy/NotFound→400, Success→200 (main.go:206-224).
+
+Additions over the reference (SURVEY.md §5 gaps): /healthz, /metrics,
+/devices inventory endpoint, structured 404s.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("master")
+
+
+class WorkerRegistry:
+    """node name → worker pod IP, TTL-cached.
+
+    Reference re-lists every request (main.go:68,171); we cache and
+    refresh on miss so a just-scheduled worker is still found.
+    """
+
+    def __init__(self, kube: KubeClient, cfg=None, ttl_s: float = 10.0):
+        self.kube = kube
+        self.cfg = cfg or get_config()
+        self.ttl_s = ttl_s
+        self._cache: dict[str, str] = {}
+        self._stamp = 0.0
+
+    def _refresh(self) -> None:
+        pods = self.kube.list_pods(
+            self.cfg.worker_namespace,
+            label_selector=self.cfg.worker_label_selector)
+        cache: dict[str, str] = {}
+        for pod_json in pods:
+            p = Pod(pod_json)
+            if p.node_name and p.pod_ip:
+                cache[p.node_name] = p.pod_ip
+        self._cache = cache
+        self._stamp = time.monotonic()
+
+    def worker_address(self, node_name: str) -> str | None:
+        if time.monotonic() - self._stamp > self.ttl_s:
+            self._refresh()
+        ip = self._cache.get(node_name)
+        if ip is None:
+            self._refresh()  # cache miss: maybe a brand-new worker
+            ip = self._cache.get(node_name)
+        if ip is None:
+            return None
+        return f"{ip}:{self.cfg.worker_port}"
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(
+        r"^/add(?:gpu|tpu)/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
+        r"/(?:gpu|tpu)/(?P<num>[^/]+)/isEntireMount/(?P<entire>[^/]+)$"),
+     "add"),
+    ("POST", re.compile(
+        r"^/remove(?:gpu|tpu)/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
+        r"/force/(?P<force>[^/]+)$"),
+     "remove"),
+    ("GET", re.compile(r"^/$"), "index"),
+    ("GET", re.compile(r"^/healthz$"), "healthz"),
+    ("GET", re.compile(r"^/metrics$"), "metrics"),
+    ("GET", re.compile(r"^/devices$"), "devices"),
+]
+
+
+def _parse_bool(raw: str, param: str) -> bool:
+    low = raw.lower()
+    if low in ("true", "1", "t"):
+        return True
+    if low in ("false", "0", "f"):
+        return False
+    raise _HttpError(400, f"Invalid param {param}: {raw} "
+                          "(should be true or false)")
+
+
+class MasterApp:
+    """Transport-independent request handling; served by build_http_server."""
+
+    def __init__(self, kube: KubeClient, cfg=None,
+                 worker_client_factory=None,
+                 registry: WorkerRegistry | None = None):
+        self.cfg = cfg or get_config()
+        self.kube = kube
+        self.registry = registry or WorkerRegistry(kube, self.cfg)
+        self._client_factory = worker_client_factory or (
+            lambda addr: WorkerClient(addr))
+
+    # --- plumbing ---
+
+    def handle(self, method: str, path: str, body: bytes,
+               headers: dict[str, str]) -> tuple[int, str, str]:
+        """Returns (status, content_type, body)."""
+        try:
+            for m, pattern, name in _ROUTES:
+                if m != method:
+                    continue
+                match = pattern.match(path)
+                if match:
+                    return getattr(self, f"_route_{name}")(match, body, headers)
+            raise _HttpError(404, "404 page not found")
+        except _HttpError as exc:
+            return exc.status, "text/plain", exc.message + "\n"
+        except Exception as exc:  # noqa: BLE001 — boundary
+            logger.exception("unhandled error for %s %s", method, path)
+            return 500, "text/plain", f"Service Internal Error: {exc}\n"
+
+    def _worker_for_pod(self, namespace: str, pod_name: str) -> tuple[str, str]:
+        """(worker_address, node_name); raises _HttpError on miss."""
+        try:
+            pod = Pod(self.kube.get_pod(namespace, pod_name))
+        except NotFoundError:
+            raise _HttpError(
+                404, f"No pod: {pod_name} in namespace: {namespace}")
+        node = pod.node_name
+        if not node:
+            raise _HttpError(400, f"Pod {pod_name} is not scheduled yet")
+        address = self.registry.worker_address(node)
+        if address is None:
+            logger.error("no tpumounter worker on node %s", node)
+            raise _HttpError(500, "Service Internal Error")
+        return address, node
+
+    # --- routes ---
+
+    def _route_index(self, match, body, headers):
+        return 200, "text/plain", "tpumounter master\n"
+
+    def _route_healthz(self, match, body, headers):
+        return 200, "text/plain", "ok\n"
+
+    def _route_metrics(self, match, body, headers):
+        return 200, "text/plain; version=0.0.4", REGISTRY.render()
+
+    def _route_devices(self, match, body, headers):
+        # Inventory endpoint (no reference analog): which nodes have workers.
+        self.registry._refresh()
+        lines = [f"{node} {ip}" for node, ip in
+                 sorted(self.registry._cache.items())]
+        return 200, "text/plain", "\n".join(lines) + "\n"
+
+    def _route_add(self, match, body, headers):
+        ns = match.group("ns")
+        pod_name = match.group("pod")
+        num_raw = match.group("num")
+        try:
+            tpu_num = int(num_raw)
+        except ValueError:
+            raise _HttpError(400, f"Invalid param gpuNum: {num_raw}")
+        entire = _parse_bool(match.group("entire"), "isEntireMount")
+        logger.info("AddTPU request: %s/%s num=%d entire=%s",
+                    ns, pod_name, tpu_num, entire)
+        address, node = self._worker_for_pod(ns, pod_name)
+        with self._client_factory(address) as client:
+            try:
+                result = client.add_tpu(pod_name, ns, tpu_num, entire)
+            except Exception as exc:  # noqa: BLE001 — gRPC boundary
+                logger.error("worker AddTPU failed: %s", exc)
+                raise _HttpError(500, f"Service Internal Error: {_grpc_detail(exc)}")
+        if result == api.AddTPUResult.Success:
+            return 200, "text/plain", "Add TPU Success\n"
+        if result == api.AddTPUResult.InsufficientTPU:
+            raise _HttpError(500, f"Insufficient TPU on Node: {node}")
+        if result == api.AddTPUResult.PodNotFound:
+            raise _HttpError(400, f"No Pod {pod_name} on Node: {node}")
+        raise _HttpError(500, f"unknown worker result {result}")
+
+    def _route_remove(self, match, body, headers):
+        ns = match.group("ns")
+        pod_name = match.group("pod")
+        force = _parse_bool(match.group("force"), "force")
+        form = urllib.parse.parse_qs(body.decode("utf-8", "replace"))
+        raw_uuids = form.get("uuids")
+        if not raw_uuids:
+            raise _HttpError(400, "Invalid parameter")
+        uuids: list[str] = []
+        for entry in raw_uuids:  # repeated fields and comma-joined both work
+            uuids.extend(u for u in entry.split(",") if u)
+        logger.info("RemoveTPU request: %s/%s uuids=%s force=%s",
+                    ns, pod_name, uuids, force)
+        address, node = self._worker_for_pod(ns, pod_name)
+        with self._client_factory(address) as client:
+            try:
+                result = client.remove_tpu(pod_name, ns, uuids, force)
+            except Exception as exc:  # noqa: BLE001 — gRPC boundary
+                logger.error("worker RemoveTPU failed: %s", exc)
+                raise _HttpError(500, f"Service Internal Error: {_grpc_detail(exc)}")
+        joined = ", ".join(uuids)
+        if result == api.RemoveTPUResult.Success:
+            return 200, "text/plain", f"Remove {len(uuids)} TPUs Success\n"
+        if result == api.RemoveTPUResult.PodNotFound:
+            raise _HttpError(400, f"No Pod {pod_name} on Node: {node}")
+        if result == api.RemoveTPUResult.TPUBusy:
+            raise _HttpError(
+                400, f"Pod: {pod_name} has running processes on TPU: {joined}")
+        if result == api.RemoveTPUResult.TPUNotFound:
+            raise _HttpError(400, f"Invalid UUIDs: {joined}")
+        raise _HttpError(500, f"unknown worker result {result}")
+
+
+def _grpc_detail(exc: Exception) -> str:
+    details = getattr(exc, "details", None)
+    if callable(details):
+        return str(details())
+    return str(exc)
+
+
+def build_http_server(app: MasterApp, port: int | None = None,
+                      host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    cfg = app.cfg
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _dispatch(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, ctype, text = app.handle(
+                self.command, self.path, body, dict(self.headers))
+            payload = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = _dispatch
+        do_POST = _dispatch
+
+        def log_message(self, fmt, *args):
+            logger.debug("http: " + fmt, *args)
+
+    return ThreadingHTTPServer((host, port or cfg.master_port), Handler)

@@ -1,0 +1,34 @@
+"""Master daemon entrypoint.
+
+Reference parity: cmd/GPUMounter-master/main.go:230-246 — init logger,
+route table, serve on :8080.
+"""
+
+from __future__ import annotations
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.utils.log import get_logger, init_logger
+
+logger = get_logger("master.main")
+
+
+def main() -> None:
+    cfg = get_config()
+    init_logger(cfg.log_dir, "tpumounter-master.log")
+    from gpumounter_tpu.k8s.client import in_cluster_client
+    from gpumounter_tpu.master.app import MasterApp, build_http_server
+
+    kube = in_cluster_client()
+    app = MasterApp(kube, cfg=cfg)
+    httpd = build_http_server(app)
+    logger.info("tpumounter master serving on :%d", cfg.master_port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

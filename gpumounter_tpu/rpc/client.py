@@ -1,0 +1,57 @@
+"""gRPC client for the worker's mount services.
+
+Reference parity: the master dials workerIP:1200 insecure and calls
+AddGPU/RemoveGPU (cmd/GPUMounter-master/main.go:82-96, 185-199). This client
+speaks the TPU-native service names; `legacy=True` switches to the
+reference's gpu_mount.* names for cross-testing.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gpumounter_tpu.rpc import api
+
+
+class WorkerClient:
+    def __init__(self, address: str, timeout_s: float = 300.0,
+                 legacy: bool = False):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(address)
+        add_service = api.ADD_SERVICE_LEGACY if legacy else api.ADD_SERVICE_TPU
+        rem_service = (api.REMOVE_SERVICE_LEGACY if legacy
+                       else api.REMOVE_SERVICE_TPU)
+        add_method = api.ADD_METHOD if legacy else api.ADD_METHOD_TPU
+        rem_method = api.REMOVE_METHOD if legacy else api.REMOVE_METHOD_TPU
+        self._add = self._channel.unary_unary(
+            f"/{add_service}/{add_method}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=api.AddTPUResponse.decode)
+        self._remove = self._channel.unary_unary(
+            f"/{rem_service}/{rem_method}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=api.RemoveTPUResponse.decode)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
+                is_entire_mount: bool = False) -> api.AddTPUResult:
+        resp = self._add(api.AddTPURequest(
+            pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
+            is_entire_mount=is_entire_mount), timeout=self.timeout_s)
+        return api.AddTPUResult(resp.add_tpu_result)
+
+    def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
+                   force: bool = False) -> api.RemoveTPUResult:
+        resp = self._remove(api.RemoveTPURequest(
+            pod_name=pod_name, namespace=namespace, uuids=list(uuids),
+            force=force), timeout=self.timeout_s)
+        return api.RemoveTPUResult(resp.remove_tpu_result)

@@ -312,15 +312,3 @@ def _skip(data: bytes, pos: int, wt: int) -> int:
     return pos
 
 
-def serializer(cls: type[Message]):
-    """grpc request_serializer for a Message class."""
-    def _ser(msg: Message) -> bytes:
-        return msg.encode()
-    return _ser
-
-
-def deserializer(cls: type[Message]):
-    """grpc response_deserializer for a Message class."""
-    def _de(data: bytes) -> Message:
-        return cls.decode(data)
-    return _de

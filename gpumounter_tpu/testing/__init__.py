@@ -1,0 +1,11 @@
+"""Test/bench substrate: simulated single-node TPU cluster.
+
+The reference ships no test infrastructure at all (SURVEY.md §4); this
+package is the substrate its survey prescribes: fake device dir + in-process
+fake kubelet pod-resources server + fake API server with a device-plugin-
+emulating scheduler.
+"""
+
+from gpumounter_tpu.testing.cluster import FakeCluster
+
+__all__ = ["FakeCluster"]

@@ -1,0 +1,133 @@
+"""FakeCluster: one simulated TPU node, end-to-end testable in-process.
+
+Wires together:
+  * FakeDeviceBackend — N fake chips in a tmp dir (null-backed char devices
+    when privileged, regular files otherwise)
+  * FakeKubeletServer — real gRPC pod-resources server on a unix socket
+  * FakeKubeClient — API-server fake whose scheduler hook emulates the GKE
+    TPU device plugin: pods requesting `google.com/tpu` get free chips
+    assigned (atomically, under a lock), are marked Running, and their
+    claims appear in the fake kubelet; when chips run out the pod goes
+    Unschedulable — exactly the signal the allocator maps to
+    InsufficientTPU (reference allocator.go:262-270). Deletion frees chips.
+
+This is the substrate for BASELINE configs 1 and 4 (dry-run and contended
+add/remove) with no Kubernetes anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from gpumounter_tpu.collector.podresources import FakeKubeletServer
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.device.backend import FakeDeviceBackend
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.k8s.types import Pod
+
+
+class FakeCluster:
+    def __init__(self, root: str, n_chips: int = 4,
+                 node_name: str = "tpu-node-0",
+                 scheduler_delay_s: float = 0.0,
+                 kubelet_versions: tuple[str, ...] = ("v1",),
+                 cfg: Config | None = None):
+        self.root = root
+        self.node_name = node_name
+        self.cfg = (cfg or Config()).replace(
+            fake_device_dir=os.path.join(root, "host-dev"),
+            kubelet_socket=os.path.join(root, "kubelet.sock"),
+            slave_pod_timeout_s=10.0,
+        )
+        self.backend = FakeDeviceBackend.create(self.cfg.fake_device_dir,
+                                                n_chips)
+        self.kubelet = FakeKubeletServer(self.cfg.kubelet_socket,
+                                         versions=kubelet_versions)
+        self._alloc_lock = threading.Lock()
+        # chip id (device-plugin view) -> (namespace, pod) or None
+        self._assignment: dict[str, tuple[str, str] | None] = {
+            str(d.index): None for d in self.backend.list_devices()}
+        self.kube = FakeKubeClient(scheduler_hook=self._schedule,
+                                   delete_hook=self._reap,
+                                   scheduler_delay_s=scheduler_delay_s)
+
+    # --- device-plugin + scheduler emulation ---
+
+    def _tpu_request(self, pod: dict) -> int:
+        return Pod(pod).resource_limit(self.cfg.tpu_resource_name)
+
+    def _schedule(self, pod: dict) -> None:
+        p = Pod(pod)
+        want = self._tpu_request(pod)
+        if want == 0:
+            pod.setdefault("spec", {}).setdefault("nodeName", self.node_name)
+            pod.setdefault("status", {})["phase"] = "Running"
+            return
+        with self._alloc_lock:
+            free = [cid for cid, owner in self._assignment.items()
+                    if owner is None]
+            if len(free) < want:
+                pod.setdefault("status", {}).update({
+                    "phase": "Pending",
+                    "conditions": [{
+                        "type": "PodScheduled", "status": "False",
+                        "reason": "Unschedulable",
+                        "message": f"0/1 nodes available: insufficient "
+                                   f"{self.cfg.tpu_resource_name}",
+                    }]})
+                return
+            assigned = sorted(free, key=int)[:want]
+            for cid in assigned:
+                self._assignment[cid] = (p.namespace, p.name)
+            self.kubelet.set_claim(p.name, p.namespace,
+                                   self.cfg.tpu_resource_name, assigned)
+        pod.setdefault("spec", {})["nodeName"] = self.node_name
+        pod.setdefault("status", {})["phase"] = "Running"
+
+    def _reap(self, pod: dict) -> None:
+        p = Pod(pod)
+        with self._alloc_lock:
+            for cid, owner in list(self._assignment.items()):
+                if owner == (p.namespace, p.name):
+                    self._assignment[cid] = None
+            self.kubelet.claims = [
+                c for c in self.kubelet.claims
+                if not (c[0] == p.name and c[1] == p.namespace)]
+
+    # --- convenience ---
+
+    def free_chip_count(self) -> int:
+        with self._alloc_lock:
+            return sum(1 for o in self._assignment.values() if o is None)
+
+    def add_target_pod(self, name: str, namespace: str = "default",
+                       uid: str | None = None) -> Pod:
+        """A running workload pod (no TPU request) to hot-mount into."""
+        manifest = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": namespace,
+                         **({"uid": uid} if uid else {})},
+            "spec": {"containers": [{"name": "main", "image": "app"}]},
+        }
+        created = self.kube.create_pod(namespace, manifest)
+        # containerStatuses so resolve_target has container IDs
+        self.kube.set_pod_status(namespace, name, containerStatuses=[{
+            "name": "main",
+            "containerID": f"containerd://{name}-cid",
+            "state": {"running": {}},
+        }])
+        deadline = 5.0
+        pod = self.kube.wait_for_pod(
+            namespace, name,
+            lambda pj: pj is not None and Pod(pj).phase == "Running",
+            timeout_s=deadline)
+        assert pod is not None, f"target pod {name} did not reach Running"
+        return Pod(self.kube.get_pod(namespace, name))
+
+    def start(self) -> "FakeCluster":
+        self.kubelet.start()
+        return self
+
+    def stop(self) -> None:
+        self.kubelet.stop()

@@ -1,0 +1,13 @@
+"""L3 worker: per-node daemon — mount mechanics + gRPC services.
+
+Reference parity: pkg/server/gpu-mount/server.go + pkg/util/util.go.
+"""
+
+from gpumounter_tpu.worker.mounter import (
+    MountError,
+    MountTarget,
+    TpuBusyError,
+    TpuMounter,
+)
+
+__all__ = ["TpuMounter", "MountTarget", "MountError", "TpuBusyError"]

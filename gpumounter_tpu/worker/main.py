@@ -1,0 +1,86 @@
+"""Worker daemon entrypoint.
+
+Reference parity: cmd/GPUMounter-worker/main.go — boot logger, construct
+the mount service, serve gRPC on :1200. Additions over the reference
+(SURVEY.md §5 gaps): /healthz + /metrics HTTP endpoints and graceful
+shutdown on SIGTERM.
+
+Env-driven (config.py): FAKE_DEVICE_DIR switches the device backend to a
+fake inventory for the no-k8s dry-run; TPUMOUNTER_NO_KUBE=1 runs without a
+Kubernetes API (local CLI mode only).
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.utils.log import get_logger, init_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("worker.main")
+
+
+class _OpsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            body = b"ok\n"
+            ctype = "text/plain"
+        elif self.path == "/metrics":
+            body = REGISTRY.render().encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+
+def serve_ops(port: int) -> ThreadingHTTPServer:
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), _OpsHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def main() -> None:
+    cfg = get_config()
+    init_logger(cfg.log_dir, "tpumounter-worker.log")
+    logger.info("tpumounter worker starting (port %d)", cfg.worker_port)
+
+    from gpumounter_tpu.k8s.client import in_cluster_client
+    from gpumounter_tpu.worker.reaper import SlaveReaper
+    from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+    kube = in_cluster_client()
+    service = TpuMountService(kube, cfg=cfg)
+    server = build_server(service)
+    ops = serve_ops(cfg.metrics_port)
+    reaper = SlaveReaper(kube, cfg=cfg).start()
+    server.start()
+    logger.info("worker serving: %d chip(s) in inventory",
+                len(service.collector.snapshot()))
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        logger.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    reaper.stop()
+    server.stop(grace=5).wait()
+    ops.shutdown()
+
+
+if __name__ == "__main__":
+    main()

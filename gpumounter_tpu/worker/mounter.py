@@ -1,0 +1,211 @@
+"""Mount mechanics: the actual hot-plug of a chip into a running container.
+
+Reference parity — pkg/util/util.go:
+  * MountGPU (util.go:17-71): containerID → cgroup path → device permission
+    → first cgroup PID → nsenter mknod.
+  * UnmountGPU (util.go:73-150): busy gate unless force → permission revoke
+    → rm device node → kill surviving holders when forced.
+  * GetPodGPUProcesses (util.go:152-196): cgroup PIDs ∩ device-holder PIDs.
+  * CanMount policy gates (util.go:207-226).
+
+TPU-native deltas (SURVEY.md §7):
+  * All containers are handled, not ContainerStatuses[0] (util.go:22), and
+    both docker:// and containerd:// IDs.
+  * cgroup v1 *and* v2 (eBPF) behind `device_controller`.
+  * Device-node injection via setns(2)+mknod(2) (nsutil), no shell.
+  * Busy detection is a /proc fd scan by rdev (device backend), not NVML;
+    remember libtpu holds the chip open for the life of the JAX process, so
+    busy-on-remove is the common case and `force` is the designed path.
+  * A MountTarget can also be a plain directory with no cgroup/namespace —
+    the BASELINE config-1 dry-run and the CLI local mode use this.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from gpumounter_tpu.allocator.allocator import MountType
+from gpumounter_tpu.cgroup import (
+    container_cgroup_dir,
+    detect_cgroup_driver,
+    detect_cgroup_version,
+    device_controller,
+    get_cgroup_pids,
+)
+from gpumounter_tpu.cgroup.ebpf import DeviceRule
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.device.backend import DeviceBackend, scan_proc_for_device
+from gpumounter_tpu.device.tpu import TpuDevice
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.nsutil import ns as nsutil
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import MOUNT_LATENCY, MOUNT_TOTAL, PHASE_LATENCY, UNMOUNT_TOTAL
+from gpumounter_tpu.utils.timing import PhaseTimer
+
+logger = get_logger("mounter")
+
+
+class MountError(RuntimeError):
+    pass
+
+
+class TpuBusyError(MountError):
+    """Chip has live holder processes and force was not set."""
+
+
+@dataclass
+class MountTarget:
+    """Where a chip lands: a container (cgroup + namespace) or a bare dir."""
+
+    dev_dir: str = "/dev"            # device dir in the target's mount ns
+    cgroup_dirs: list[str] = field(default_factory=list)
+    ns_pid: int | None = None        # PID whose namespaces we enter; None = ours
+    description: str = "local"
+
+    @property
+    def has_cgroup(self) -> bool:
+        return bool(self.cgroup_dirs)
+
+
+class TpuMounter:
+    def __init__(self, backend: DeviceBackend, cfg=None):
+        self.cfg = cfg or get_config()
+        self.backend = backend
+        version = self.cfg.cgroup_version
+        self.cgroup_version = (detect_cgroup_version(self.cfg.cgroup_root)
+                               if version == "auto" else int(version))
+        self.controller = device_controller(self.cgroup_version)
+
+    # --- target resolution (reference: util.go:22-50) ---
+
+    def resolve_target(self, pod: Pod) -> MountTarget:
+        ids = pod.container_ids()
+        if not ids:
+            raise MountError(
+                f"pod {pod.namespace}/{pod.name} has no running containers")
+        driver = self.cfg.cgroup_driver
+        if driver == "auto":
+            driver = detect_cgroup_driver(self.cfg.cgroup_root)
+        cgroup_dirs = []
+        for _, runtime, cid in ids:
+            cgroup_dirs.append(container_cgroup_dir(
+                pod, cid, runtime,
+                cgroup_root=self.cfg.cgroup_root, driver=driver,
+                version=self.cgroup_version))
+        ns_pid = None
+        for cg in cgroup_dirs:
+            pids = get_cgroup_pids(cg)
+            if pids:
+                ns_pid = pids[0]
+                break
+        if ns_pid is None:
+            raise MountError(
+                f"no PIDs found in cgroups of {pod.namespace}/{pod.name} "
+                f"(looked in {cgroup_dirs})")
+        return MountTarget(dev_dir="/dev", cgroup_dirs=cgroup_dirs,
+                           ns_pid=ns_pid,
+                           description=f"{pod.namespace}/{pod.name}")
+
+    # --- busy detection (reference: GetPodGPUProcesses, util.go:152-196) ---
+
+    def holder_pids(self, target: MountTarget, dev: TpuDevice) -> list[int]:
+        holders = set(self.backend.running_pids(dev))
+        # Also catch holders of the target-side node when it is a distinct
+        # path (fake dirs; bind-mounted /dev). Path-only match: for real
+        # chips the backend's rdev scan already covers every alias.
+        injected = nsutil.device_node_path(target.dev_dir, dev)
+        if injected != dev.device_path:
+            holders.update(scan_proc_for_device(None, None,
+                                                path_hint=injected))
+        if not target.has_cgroup:
+            return sorted(holders)
+        cgroup_pids: set[int] = set()
+        for cg in target.cgroup_dirs:
+            cgroup_pids.update(get_cgroup_pids(cg))
+        return sorted(p for p in holders if p in cgroup_pids)
+
+    # --- policy gate (reference: CanMount, util.go:207-226) ---
+
+    @staticmethod
+    def can_mount(mount_type: MountType, is_entire_mount: bool) -> tuple[bool, str]:
+        if mount_type == MountType.UNKNOWN:
+            return False, "mount type of pod is unknown; refusing"
+        if mount_type == MountType.ENTIRE:
+            return False, "pod already holds an entire-mount; no further mounts"
+        if mount_type == MountType.SINGLE and is_entire_mount:
+            return False, "pod holds single-mounts; entire-mount not allowed"
+        return True, ""
+
+    # --- mount (reference: MountGPU, util.go:17-71) ---
+
+    def mount(self, target: MountTarget, dev: TpuDevice,
+              base_rules: list[DeviceRule] | None = None) -> dict:
+        """Grant + inject one chip. Returns phase timings (ms)."""
+        timer = PhaseTimer()
+        try:
+            with timer.phase("cgroup_grant"):
+                for cg in target.cgroup_dirs:
+                    if self.cgroup_version == 2:
+                        self.controller.grant(cg, dev, base_rules=base_rules)
+                    else:
+                        self.controller.grant(cg, dev)
+            with timer.phase("device_inject"):
+                nsutil.inject_device_file(target.dev_dir, dev,
+                                          pid=target.ns_pid)
+        except MountError:
+            MOUNT_TOTAL.inc(result="error")
+            raise
+        except Exception as exc:
+            # Normalize lower-layer failures (CgroupError, BpfError,
+            # NamespaceError, OSError) so callers' rollback paths fire on
+            # a single exception type.
+            MOUNT_TOTAL.inc(result="error")
+            raise MountError(
+                f"mount of {dev.uuid} into {target.description}: {exc}") from exc
+        MOUNT_TOTAL.inc(result="success")
+        MOUNT_LATENCY.observe(timer.total())
+        for phase, seconds in timer.phases.items():
+            PHASE_LATENCY.observe(seconds, phase=phase)
+        summary = timer.summary_ms()
+        logger.info("mounted %s into %s (%s)", dev, target.description, summary)
+        return summary
+
+    # --- unmount (reference: UnmountGPU, util.go:73-150) ---
+
+    def unmount(self, target: MountTarget, dev: TpuDevice,
+                force: bool = False) -> dict:
+        timer = PhaseTimer()
+        with timer.phase("busy_check"):
+            holders = self.holder_pids(target, dev)
+        if holders and not force:
+            UNMOUNT_TOTAL.inc(result="busy")
+            raise TpuBusyError(
+                f"{dev.device_path} held by PIDs {holders} in "
+                f"{target.description}; use force (libtpu holds chips for "
+                "the life of the process)")
+        try:
+            with timer.phase("cgroup_revoke"):
+                for cg in target.cgroup_dirs:
+                    self.controller.revoke(cg, dev)
+            with timer.phase("device_remove"):
+                nsutil.remove_device_file(target.dev_dir, dev,
+                                          pid=target.ns_pid)
+            if force and holders:
+                with timer.phase("kill_holders"):
+                    # Reference kills via nsenter when forced (util.go:137-142)
+                    nsutil.kill_pids_in_ns(holders, pid=target.ns_pid)
+        except TpuBusyError:
+            raise
+        except MountError:
+            UNMOUNT_TOTAL.inc(result="error")
+            raise
+        except Exception as exc:
+            UNMOUNT_TOTAL.inc(result="error")
+            raise MountError(
+                f"unmount of {dev.uuid} from {target.description}: {exc}") from exc
+        UNMOUNT_TOTAL.inc(result="success")
+        for phase, seconds in timer.phases.items():
+            PHASE_LATENCY.observe(seconds, phase=phase)
+        summary = timer.summary_ms()
+        logger.info("unmounted %s from %s (%s)", dev, target.description, summary)
+        return summary

@@ -1,0 +1,92 @@
+"""SlaveReaper: garbage-collect slave pods whose owner is gone.
+
+The reference relies on Kubernetes OwnerReferences for crash consistency
+(allocator.go:202-212) — but its slave pods live in gpu-pool while owners
+live in other namespaces, and Kubernetes forbids cross-namespace owner
+references: the GC treats such an owner as absent and deletes the dependent
+(kubernetes docs: "cross-namespace owner references are disallowed by
+design"), silently freeing chips that are still hot-mounted. So the
+reference's only crash-consistency mechanism is actually destructive.
+
+This reaper is the working replacement: a reconcile loop on the worker that
+deletes slave pods whose recorded owner (labels tpumounter.io/owner,
+owner-namespace, owner-uid) no longer exists or was recreated under a new
+UID. Owner death ⇒ its chips return to the scheduler's books within one
+reap interval.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("reaper")
+
+
+class SlaveReaper:
+    def __init__(self, kube: KubeClient, cfg=None, interval_s: float = 15.0):
+        self.kube = kube
+        self.cfg = cfg or get_config()
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def reap_once(self) -> list[str]:
+        """One reconcile pass; returns names of slave pods deleted."""
+        deleted: list[str] = []
+        try:
+            slaves = self.kube.list_pods(self.cfg.pool_namespace,
+                                         label_selector="app=tpu-pool")
+        except Exception as exc:  # noqa: BLE001 — keep the loop alive
+            logger.warning("reaper list failed: %s", exc)
+            return deleted
+        for slave_json in slaves:
+            slave = Pod(slave_json)
+            owner = slave.labels.get("tpumounter.io/owner", "")
+            owner_ns = slave.labels.get("tpumounter.io/owner-namespace", "")
+            owner_uid = slave.labels.get("tpumounter.io/owner-uid", "")
+            if not owner or not owner_ns:
+                continue  # not ours / hand-made pod: leave it alone
+            orphaned = False
+            try:
+                owner_pod = Pod(self.kube.get_pod(owner_ns, owner))
+                if owner_uid and owner_pod.uid != owner_uid:
+                    orphaned = True  # recreated under a new UID
+                elif owner_pod.phase in ("Succeeded", "Failed"):
+                    orphaned = True  # owner finished; chips must free
+            except NotFoundError:
+                orphaned = True
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("reaper owner check %s/%s failed: %s",
+                               owner_ns, owner, exc)
+                continue
+            if orphaned:
+                logger.info("reaping orphan slave pod %s (owner %s/%s gone)",
+                            slave.name, owner_ns, owner)
+                try:
+                    self.kube.delete_pod(self.cfg.pool_namespace, slave.name,
+                                         grace_period_seconds=0)
+                    deleted.append(slave.name)
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("reap delete %s failed: %s",
+                                   slave.name, exc)
+        return deleted
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.reap_once()
+
+    def start(self) -> "SlaveReaper":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="slave-reaper")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

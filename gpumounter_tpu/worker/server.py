@@ -1,0 +1,207 @@
+"""Worker gRPC services: AddTPU / RemoveTPU.
+
+Reference parity — pkg/server/gpu-mount/server.go:
+  * AddGPU (server.go:34-99): get pod → CanMount gate → GetAvailableGPU
+    with gpuNumPerPod = gpuNum if entire else 1 (server.go:61-66) → mount
+    each device, rolling back slave pods on failure (server.go:80-95).
+  * RemoveGPU (server.go:101-179): get pod → GetRemoveGPU → busy pre-check
+    per device unless force (server.go:137-153) → unmount each →
+    DeleteSlavePods (server.go:155-175).
+
+Served under both the TPU-native service names and the reference's
+gpu_mount.* names so a client built against the reference proto works
+unchanged (rpc/api.py). Response enums match api.proto values exactly.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from gpumounter_tpu.allocator.allocator import (
+    InsufficientTpuError,
+    MountType,
+    SlavePodError,
+    TpuAllocator,
+)
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.device.backend import backend_from_config
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.worker.mounter import MountError, TpuBusyError, TpuMounter
+from gpumounter_tpu.cgroup.ebpf import device_rule
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.timing import PhaseTimer
+
+logger = get_logger("worker.server")
+
+
+class TpuMountService:
+    """The business logic shared by both wire service registrations."""
+
+    def __init__(self, kube: KubeClient, collector: TpuCollector | None = None,
+                 allocator: TpuAllocator | None = None,
+                 mounter: TpuMounter | None = None, cfg=None):
+        self.cfg = cfg or get_config()
+        self.kube = kube
+        self.collector = collector or TpuCollector(cfg=self.cfg)
+        self.allocator = allocator or TpuAllocator(kube, self.collector,
+                                                   cfg=self.cfg)
+        self.mounter = mounter or TpuMounter(self.collector.backend,
+                                             cfg=self.cfg)
+
+    # --- AddTPU (reference: server.go:34-99) ---
+
+    def add_tpu(self, request: api.AddTPURequest,
+                context: grpc.ServicerContext) -> api.AddTPUResponse:
+        timer = PhaseTimer()
+        logger.info("AddTPU %s/%s num=%d entire=%s", request.namespace,
+                    request.pod_name, request.tpu_num, request.is_entire_mount)
+        if request.tpu_num <= 0:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"invalid tpu_num {request.tpu_num}")
+        try:
+            pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
+        except NotFoundError:
+            return api.AddTPUResponse(
+                add_tpu_result=api.AddTPUResult.PodNotFound)
+
+        mount_type = self.allocator.get_mount_type(pod)
+        ok, why = self.mounter.can_mount(mount_type, request.is_entire_mount)
+        if not ok:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, why)
+
+        per_pod = request.tpu_num if request.is_entire_mount else 1
+        with timer.phase("slave_pod_schedule"):
+            try:
+                devices, slaves = self.allocator.get_available_tpus(
+                    pod, request.tpu_num, per_pod)
+            except InsufficientTpuError as exc:
+                logger.warning("insufficient TPU: %s", exc)
+                return api.AddTPUResponse(
+                    add_tpu_result=api.AddTPUResult.InsufficientTPU)
+            except SlavePodError as exc:
+                context.abort(grpc.StatusCode.INTERNAL, str(exc))
+
+        # v2 eBPF replacement programs must preserve chips the device
+        # plugin already granted to the pod directly.
+        base_rules = [device_rule(d) for d in self.collector.snapshot()
+                      if d.pod_name == pod.name
+                      and d.namespace == pod.namespace]
+        mounted: list = []
+        try:
+            with timer.phase("mount"):
+                target = self.mounter.resolve_target(pod)
+                for dev in devices:
+                    self.mounter.mount(target, dev, base_rules=base_rules)
+                    mounted.append(dev)
+        except MountError as exc:
+            # Rollback: revoke what was already granted — otherwise the
+            # target keeps kernel-level access to chips the scheduler is
+            # about to hand to someone else — then free the scheduler's
+            # books (reference only does the latter, server.go:86-91).
+            logger.error("mount failed, rolling back %d mount(s) + slaves: %s",
+                         len(mounted), exc)
+            for dev in mounted:
+                try:
+                    self.mounter.unmount(target, dev, force=True)
+                except MountError as undo_exc:
+                    logger.error("rollback unmount of %s failed: %s",
+                                 dev.uuid, undo_exc)
+            self.allocator.delete_slave_pods(slaves, wait=False)
+            context.abort(grpc.StatusCode.INTERNAL, str(exc))
+        logger.info("AddTPU done: %s", timer.summary_ms())
+        return api.AddTPUResponse(add_tpu_result=api.AddTPUResult.Success)
+
+    # --- RemoveTPU (reference: server.go:101-179) ---
+
+    def remove_tpu(self, request: api.RemoveTPURequest,
+                   context: grpc.ServicerContext) -> api.RemoveTPUResponse:
+        logger.info("RemoveTPU %s/%s uuids=%s force=%s", request.namespace,
+                    request.pod_name, request.uuids, request.force)
+        try:
+            pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
+        except NotFoundError:
+            return api.RemoveTPUResponse(
+                remove_tpu_result=api.RemoveTPUResult.PodNotFound)
+
+        self.collector.update_status()  # one refresh for the whole request
+        entire = self.allocator.get_mount_type(pod, refresh=False) == \
+            MountType.ENTIRE
+        devices = self.allocator.get_remove_tpus(pod, request.uuids, entire,
+                                                 refresh=False)
+        if not devices:
+            return api.RemoveTPUResponse(
+                remove_tpu_result=api.RemoveTPUResult.TPUNotFound)
+
+        target = None
+        try:
+            target = self.mounter.resolve_target(pod)
+        except MountError as exc:
+            context.abort(grpc.StatusCode.INTERNAL, str(exc))
+
+        # Busy pre-check across all devices before touching any
+        # (server.go:137-153) — avoids partial removal.
+        if not request.force:
+            for dev in devices:
+                holders = self.mounter.holder_pids(target, dev)
+                if holders:
+                    logger.warning("%s busy (PIDs %s)", dev.uuid, holders)
+                    return api.RemoveTPUResponse(
+                        remove_tpu_result=api.RemoveTPUResult.TPUBusy)
+
+        slaves = self.allocator.slave_pods_holding(pod, devices)
+        try:
+            for dev in devices:
+                self.mounter.unmount(target, dev, force=request.force)
+        except TpuBusyError:
+            return api.RemoveTPUResponse(
+                remove_tpu_result=api.RemoveTPUResult.TPUBusy)
+        except MountError as exc:
+            context.abort(grpc.StatusCode.INTERNAL, str(exc))
+        self.allocator.delete_slave_pods(slaves)
+        return api.RemoveTPUResponse(
+            remove_tpu_result=api.RemoveTPUResult.Success)
+
+
+def build_server(service: TpuMountService, port: int | None = None,
+                 address: str | None = None,
+                 max_workers: int = 8) -> grpc.Server:
+    """gRPC server with the service registered under all four names.
+
+    Reference: worker main registers AddGPUService + RemoveGPUService on
+    :1200 (cmd/GPUMounter-worker/main.go:24-33).
+
+    The actually-bound port (useful with ":0") is exposed as
+    `server.bound_port`.
+    """
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+
+    def _handler(fn, req_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req_cls.decode,
+            response_serializer=lambda m: m.encode())
+
+    add = _handler(service.add_tpu, api.AddTPURequest)
+    remove = _handler(service.remove_tpu, api.RemoveTPURequest)
+    registrations = {
+        api.ADD_SERVICE_TPU: {api.ADD_METHOD_TPU: add, api.ADD_METHOD: add},
+        api.ADD_SERVICE_LEGACY: {api.ADD_METHOD: add},
+        api.REMOVE_SERVICE_TPU: {api.REMOVE_METHOD_TPU: remove,
+                                 api.REMOVE_METHOD: remove},
+        api.REMOVE_SERVICE_LEGACY: {api.REMOVE_METHOD: remove},
+    }
+    for service_name, methods in registrations.items():
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(service_name, methods),))
+
+    if address:
+        server.bound_port = server.add_insecure_port(address)
+    else:
+        cfg = service.cfg
+        server.bound_port = server.add_insecure_port(
+            f"[::]:{port or cfg.worker_port}")
+    return server

@@ -1,0 +1,148 @@
+"""Allocator tests on the FakeCluster (no live cluster, unlike
+allocator_test.go:13-38 which needs in-cluster kubeconfig + 2 real GPUs)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.allocator.allocator import (
+    InsufficientTpuError,
+    MountType,
+    TpuAllocator,
+)
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = FakeCluster(str(tmp_path), n_chips=4).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def allocator(cluster):
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    return TpuAllocator(cluster.kube, collector, cfg=cluster.cfg)
+
+
+def test_single_mount_allocation(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    devices, slaves = allocator.get_available_tpus(owner, 2, 1)
+    assert len(devices) == 2
+    assert len(slaves) == 2
+    assert all(s.startswith("trainer-slave-pod-") for s in slaves)
+    assert cluster.free_chip_count() == 2
+    # scheduler accounting: slave pods hold the chips
+    for s in slaves:
+        pod = cluster.kube.get_pod(cluster.cfg.pool_namespace, s)
+        assert pod["status"]["phase"] == "Running"
+
+
+def test_entire_mount_allocation(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    devices, slaves = allocator.get_available_tpus(owner, 4, 4)
+    assert len(devices) == 4
+    assert len(slaves) == 1
+
+
+def test_insufficient_rolls_back(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    with pytest.raises(InsufficientTpuError):
+        allocator.get_available_tpus(owner, 8, 1)
+    # every slave pod rolled back; no chips leaked
+    assert cluster.free_chip_count() == 4
+    assert allocator.slave_pods_for(owner) == []
+
+
+def test_slave_pod_ownership_labels(cluster, allocator):
+    """Ownership is recorded in labels (no cross-namespace ownerReferences —
+    Kubernetes GC would treat those as absent owners and reap the slaves)."""
+    owner = cluster.add_target_pod("trainer")
+    _, slaves = allocator.get_available_tpus(owner, 1, 1)
+    slave = cluster.kube.get_pod(cluster.cfg.pool_namespace, slaves[0])
+    labels = slave["metadata"]["labels"]
+    assert labels["tpumounter.io/owner"] == "trainer"
+    assert labels["tpumounter.io/owner-namespace"] == "default"
+    assert labels["tpumounter.io/owner-uid"] == owner.uid
+    assert "ownerReferences" not in slave["metadata"]
+    assert slave["spec"]["nodeSelector"] == {
+        "kubernetes.io/hostname": cluster.node_name}
+
+
+def test_no_cross_namespace_crosstalk(cluster, allocator):
+    """Same-named pods in different namespaces never see each other's
+    slave-held chips (name-prefix matching in the reference cross-talks)."""
+    owner_a = cluster.add_target_pod("trainer", namespace="team-a")
+    owner_b = cluster.add_target_pod("trainer", namespace="team-b")
+    devs_a, _ = allocator.get_available_tpus(owner_a, 1, 1)
+    devs_b, _ = allocator.get_available_tpus(owner_b, 1, 1)
+    got_a = allocator.get_remove_tpus(owner_a, [], entire_mount=True)
+    got_b = allocator.get_remove_tpus(owner_b, [], entire_mount=True)
+    assert [d.uuid for d in got_a] == [devs_a[0].uuid]
+    assert [d.uuid for d in got_b] == [devs_b[0].uuid]
+
+
+def test_mount_type_heuristic(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    assert allocator.get_mount_type(owner) == MountType.NONE
+    _, slaves = allocator.get_available_tpus(owner, 2, 1)
+    assert allocator.get_mount_type(owner) == MountType.SINGLE
+    allocator.delete_slave_pods(slaves)
+    assert allocator.get_mount_type(owner) == MountType.NONE
+    allocator.get_available_tpus(owner, 2, 2)
+    assert allocator.get_mount_type(owner) == MountType.ENTIRE
+
+
+def test_get_remove_tpus(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    devices, _ = allocator.get_available_tpus(owner, 2, 1)
+    uuids = [d.uuid for d in devices]
+    got = allocator.get_remove_tpus(owner, [uuids[0]], entire_mount=False)
+    assert [d.uuid for d in got] == [uuids[0]]
+    # unmatched uuid -> empty (reference: GPUNotFound path)
+    assert allocator.get_remove_tpus(owner, ["bogus"], entire_mount=False) == []
+    # entire mount removes all regardless
+    got = allocator.get_remove_tpus(owner, [], entire_mount=True)
+    assert sorted(d.uuid for d in got) == sorted(uuids)
+
+
+def test_delete_slave_pods_frees_chips(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    devices, slaves = allocator.get_available_tpus(owner, 2, 1)
+    allocator.delete_slave_pods(slaves)
+    assert cluster.free_chip_count() == 4
+
+
+def test_contended_allocation_is_coherent(cluster, allocator):
+    """BASELINE config 4: two pods racing for 4 chips never double-allocate."""
+    import threading
+
+    owner_a = cluster.add_target_pod("pod-a")
+    owner_b = cluster.add_target_pod("pod-b")
+    results = {}
+
+    def grab(name, owner):
+        try:
+            devices, _ = allocator.get_available_tpus(owner, 3, 1)
+            results[name] = devices
+        except InsufficientTpuError:
+            results[name] = "insufficient"
+
+    ta = threading.Thread(target=grab, args=("a", owner_a))
+    tb = threading.Thread(target=grab, args=("b", owner_b))
+    ta.start(); tb.start(); ta.join(); tb.join()
+
+    winners = [k for k, v in results.items() if v != "insufficient"]
+    # 4 chips, two requests of 3: exactly one can win
+    assert len(winners) == 1, results
+    won = results[winners[0]]
+    assert len(won) == 3
+    assert len({d.uuid for d in won}) == 3
+    assert cluster.free_chip_count() == 1

@@ -1,0 +1,231 @@
+"""Cgroup layer tests: naming, v1 writes on a fake root, eBPF program
+semantics via a tiny interpreter (no kernel needed), and an optional
+real-kernel attach test behind TPUMOUNTER_EBPF_TESTS=1.
+
+The reference's cgroup tests write to a live cluster's devices.allow as a
+side effect (cgroup_test.go:40-46); these are hermetic.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import pytest
+
+from gpumounter_tpu.cgroup.ebpf import (
+    BPF_DEVCG_ACC_MKNOD,
+    BPF_DEVCG_ACC_READ,
+    BPF_DEVCG_ACC_WRITE,
+    BPF_DEVCG_DEV_BLOCK,
+    BPF_DEVCG_DEV_CHAR,
+    DEFAULT_CONTAINER_RULES,
+    DeviceRule,
+    build_device_program,
+    device_rule,
+)
+from gpumounter_tpu.cgroup.naming import (
+    container_cgroup_dir,
+    detect_cgroup_version,
+    expand_slice,
+    get_cgroup_pids,
+    pod_cgroup_relpath,
+    pod_qos_class,
+)
+from gpumounter_tpu.cgroup.v1 import V1DeviceController
+from gpumounter_tpu.device.tpu import TpuDevice
+from gpumounter_tpu.k8s.types import Pod
+
+
+def make_pod(uid="11111111-2222-3333-4444-555555555555", qos=None,
+             containers=None):
+    obj = {
+        "metadata": {"name": "p", "namespace": "ns", "uid": uid},
+        "spec": {"containers": containers or [{"name": "main"}]},
+        "status": {},
+    }
+    if qos:
+        obj["status"]["qosClass"] = qos
+    return Pod(obj)
+
+
+# --- naming ---
+
+def test_expand_slice():
+    assert expand_slice("kubepods.slice") == "kubepods.slice"
+    assert expand_slice("kubepods-burstable.slice") == \
+        "kubepods.slice/kubepods-burstable.slice"
+    assert expand_slice("kubepods-burstable-podabc.slice") == \
+        "kubepods.slice/kubepods-burstable.slice/kubepods-burstable-podabc.slice"
+
+
+def test_systemd_path_containerd():
+    pod = make_pod(qos="Burstable")
+    rel = pod_cgroup_relpath(pod, "deadbeef", "containerd", "systemd")
+    assert rel == (
+        "kubepods.slice/kubepods-burstable.slice/"
+        "kubepods-burstable-pod11111111_2222_3333_4444_555555555555.slice/"
+        "cri-containerd-deadbeef.scope")
+
+
+def test_systemd_path_guaranteed_docker():
+    pod = make_pod(qos="Guaranteed")
+    rel = pod_cgroup_relpath(pod, "cafe", "docker", "systemd")
+    assert rel == (
+        "kubepods.slice/"
+        "kubepods-pod11111111_2222_3333_4444_555555555555.slice/"
+        "docker-cafe.scope")
+
+
+def test_cgroupfs_path():
+    pod = make_pod(qos="BestEffort")
+    rel = pod_cgroup_relpath(pod, "cafe", "containerd", "cgroupfs")
+    assert rel == ("kubepods/besteffort/"
+                   "pod11111111-2222-3333-4444-555555555555/cafe")
+
+
+def test_qos_fallback_derivation():
+    # BestEffort: nothing set
+    assert pod_qos_class(make_pod()) == "BestEffort"
+    # Guaranteed: limits == requests for cpu+memory
+    g = make_pod(containers=[{"name": "c", "resources": {
+        "limits": {"cpu": "1", "memory": "1Gi"},
+        "requests": {"cpu": "1", "memory": "1Gi"}}}])
+    assert pod_qos_class(g) == "Guaranteed"
+    # Burstable: requests < limits
+    b = make_pod(containers=[{"name": "c", "resources": {
+        "limits": {"cpu": "2"}, "requests": {"cpu": "1"}}}])
+    assert pod_qos_class(b) == "Burstable"
+    # API-server value wins
+    assert pod_qos_class(make_pod(qos="Burstable")) == "Burstable"
+
+
+def test_container_cgroup_dir_v1_fake_root(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "devices", "kubepods"))
+    pod = make_pod(qos="BestEffort")
+    path = container_cgroup_dir(pod, "cid1", "containerd",
+                                cgroup_root=root, driver="auto")
+    assert detect_cgroup_version(root) == 1
+    assert path.startswith(os.path.join(root, "devices", "kubepods"))
+
+
+def test_get_cgroup_pids(tmp_path):
+    d = tmp_path / "cg"
+    d.mkdir()
+    (d / "cgroup.procs").write_text("12\n34\n")
+    assert get_cgroup_pids(str(d)) == [12, 34]
+    assert get_cgroup_pids(str(tmp_path / "absent")) == []
+
+
+# --- v1 controller on a fake root ---
+
+def test_v1_grant_revoke(tmp_path):
+    cg = tmp_path / "cgdev"
+    cg.mkdir()
+    (cg / "devices.allow").write_text("")
+    (cg / "devices.deny").write_text("")
+    dev = TpuDevice(index=0, device_path="/dev/accel0", major=120, minor=7,
+                    uuid="u0")
+    ctl = V1DeviceController()
+    ctl.grant(str(cg), dev)
+    assert (cg / "devices.allow").read_text() == "c 120:7 rw"
+    ctl.revoke(str(cg), dev)
+    assert (cg / "devices.deny").read_text() == "c 120:7 rw"
+
+
+# --- eBPF program semantics via interpreter ---
+
+def interp(prog: bytes, dev_type: int, access: int, major: int, minor: int) -> int:
+    """Execute our BPF subset: returns r0 of the program."""
+    regs = {i: 0 for i in range(11)}
+    ctx = {0: (access << 16) | dev_type, 4: major, 8: minor}
+    regs[1] = "ctx"
+    insns = [struct.unpack("<BBhi", prog[i:i + 8])
+             for i in range(0, len(prog), 8)]
+    pc = 0
+    steps = 0
+    while pc < len(insns):
+        steps += 1
+        assert steps < 10_000, "runaway program"
+        op, regbyte, off, imm = insns[pc]
+        dst, src = regbyte & 0xF, regbyte >> 4
+        if op == 0x61:      # LDX_MEM_W
+            assert regs[src] == "ctx"
+            regs[dst] = ctx[off]
+        elif op == 0xB7:    # MOV64_IMM
+            regs[dst] = imm & 0xFFFFFFFFFFFFFFFF if imm >= 0 else imm + (1 << 64)
+        elif op == 0xBF:    # MOV64_REG
+            regs[dst] = regs[src]
+        elif op == 0x57:    # AND64_IMM (sign-extended imm)
+            imm64 = imm & 0xFFFFFFFFFFFFFFFF if imm >= 0 else imm + (1 << 64)
+            regs[dst] = regs[dst] & imm64
+        elif op == 0x77:    # RSH64_IMM
+            regs[dst] = regs[dst] >> imm
+        elif op == 0x55:    # JNE_IMM
+            imm64 = imm & 0xFFFFFFFFFFFFFFFF if imm >= 0 else imm + (1 << 64)
+            if regs[dst] != imm64:
+                pc += off
+        elif op == 0x95:    # EXIT
+            return regs[0]
+        else:
+            raise AssertionError(f"unknown opcode {op:#x}")
+        pc += 1
+    raise AssertionError("fell off end of program")
+
+
+RW = BPF_DEVCG_ACC_READ | BPF_DEVCG_ACC_WRITE
+
+
+def test_program_allows_granted_chip():
+    dev = TpuDevice(index=0, device_path="/dev/accel0", major=250, minor=0,
+                    uuid="u")
+    prog = build_device_program(list(DEFAULT_CONTAINER_RULES) + [device_rule(dev)])
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 250, 0) == 1
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, BPF_DEVCG_ACC_READ, 250, 0) == 1
+    # a different chip stays denied
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 250, 1) == 0
+    # mknod-any default still applies to the other chip
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, BPF_DEVCG_ACC_MKNOD, 250, 1) == 1
+
+
+def test_program_default_rules_preserved():
+    prog = build_device_program(list(DEFAULT_CONTAINER_RULES))
+    # /dev/null rw
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 1, 3) == 1
+    # /dev/pts/* wildcard minor
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 136, 42) == 1
+    # block-device mknod allowed, write denied
+    assert interp(prog, BPF_DEVCG_DEV_BLOCK, BPF_DEVCG_ACC_MKNOD, 8, 0) == 1
+    assert interp(prog, BPF_DEVCG_DEV_BLOCK, BPF_DEVCG_ACC_WRITE, 8, 0) == 0
+    # arbitrary char device rw denied
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 250, 0) == 0
+
+
+def test_program_access_superset_denied():
+    # rule grants read-only; write request must be denied
+    prog = build_device_program([DeviceRule("c", 9, 9, "r")])
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, BPF_DEVCG_ACC_READ, 9, 9) == 1
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 9, 9) == 0
+
+
+def test_program_wildcard_type():
+    prog = build_device_program([DeviceRule("a", None, None, "rwm")])
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, RW, 7, 7) == 1
+    assert interp(prog, BPF_DEVCG_DEV_BLOCK, RW, 7, 7) == 1
+
+
+def test_program_empty_rules_denies_all():
+    prog = build_device_program([])
+    assert interp(prog, BPF_DEVCG_DEV_CHAR, BPF_DEVCG_ACC_READ, 1, 3) == 0
+
+
+# --- real kernel (opt-in; needs root + cgroup2 + CAP_BPF/CAP_SYS_ADMIN) ---
+
+@pytest.mark.skipif(os.environ.get("TPUMOUNTER_EBPF_TESTS") != "1",
+                    reason="set TPUMOUNTER_EBPF_TESTS=1 to run kernel eBPF tests")
+def test_prog_load_real_kernel():
+    from gpumounter_tpu.cgroup.ebpf import prog_load
+    fd = prog_load(build_device_program(list(DEFAULT_CONTAINER_RULES)))
+    assert fd > 0
+    os.close(fd)

@@ -1,0 +1,133 @@
+"""Collector tests: real gRPC wire path against the fake kubelet server.
+
+The reference's collector tests need a live cluster + NVML
+(collector_test.go:8-67); these run anywhere.
+"""
+
+import os
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import (
+    FakeKubeletServer,
+    PodResourcesClient,
+)
+from gpumounter_tpu.config import Config, set_config
+from gpumounter_tpu.device.backend import FakeDeviceBackend
+
+
+@pytest.fixture()
+def kubelet(tmp_path):
+    sock = str(tmp_path / "kubelet.sock")
+    server = FakeKubeletServer(sock, versions=("v1",)).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def kubelet_v1alpha1(tmp_path):
+    sock = str(tmp_path / "kubelet-alpha.sock")
+    server = FakeKubeletServer(sock, versions=("v1alpha1",)).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def backend(tmp_path):
+    return FakeDeviceBackend.create(str(tmp_path / "fakedev"), 4)
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    cfg = Config().replace(fake_device_dir=str(tmp_path / "fakedev"))
+    set_config(cfg)
+    yield cfg
+    set_config(Config())
+
+
+def _client(server, api="auto"):
+    return PodResourcesClient(server.socket_path, timeout_s=5.0, api=api)
+
+
+def test_list_empty(kubelet, backend, cfg):
+    with _client(kubelet) as client:
+        assert client.list() == []
+
+
+def test_claims_roundtrip(kubelet, backend, cfg):
+    kubelet.set_claim("trainer", "default", "google.com/tpu", ["0", "1"])
+    with _client(kubelet) as client:
+        pods = client.list()
+    assert len(pods) == 1
+    assert pods[0].name == "trainer"
+    assert pods[0].namespace == "default"
+    devs = pods[0].containers[0].devices[0]
+    assert devs.resource_name == "google.com/tpu"
+    assert devs.device_ids == ["0", "1"]
+
+
+def test_v1alpha1_fallback(kubelet_v1alpha1, backend, cfg):
+    kubelet_v1alpha1.set_claim("p", "ns", "google.com/tpu", ["2"])
+    with _client(kubelet_v1alpha1, api="auto") as client:
+        pods = client.list()  # v1 → UNIMPLEMENTED → v1alpha1
+        assert client._pinned == "v1alpha1.PodResourcesLister"
+    assert pods[0].containers[0].devices[0].device_ids == ["2"]
+
+
+def test_missing_socket_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        PodResourcesClient(str(tmp_path / "nope.sock"))
+
+
+def test_collector_marks_owners(kubelet, backend, cfg):
+    kubelet.set_claim("trainer", "default", "google.com/tpu", ["0", "1"])
+    kubelet.set_claim("other", "default", "ignored.com/thing", ["2"])
+    coll = TpuCollector(backend=backend, podresources=_client(kubelet), cfg=cfg)
+    owned = {d.index: d.pod_name for d in coll.snapshot() if d.pod_name}
+    assert owned == {0: "trainer", 1: "trainer"}
+    assert len(coll.free_devices()) == 2
+
+
+def test_collector_device_id_forms(kubelet, backend, cfg):
+    # accelN basename and uuid forms must also match.
+    kubelet.set_claim("a", "ns", "google.com/tpu", ["accel2"])
+    kubelet.set_claim("b", "ns", "google.com/tpu", ["tpu-fake-accel3"])
+    coll = TpuCollector(backend=backend, podresources=_client(kubelet), cfg=cfg)
+    owned = {d.index: d.pod_name for d in coll.snapshot() if d.pod_name}
+    assert owned == {2: "a", 3: "b"}
+
+
+def test_get_pod_devices_includes_slaves(kubelet, backend, cfg):
+    kubelet.set_claim("trainer-slave-pod-a1b2c3", cfg.pool_namespace,
+                      "google.com/tpu", ["0"])
+    kubelet.set_claim("trainer", "default", "google.com/tpu", ["1"])
+    kubelet.set_claim("unrelated", "default", "google.com/tpu", ["2"])
+    coll = TpuCollector(backend=backend, podresources=_client(kubelet), cfg=cfg)
+    devs = coll.get_pod_devices("trainer", "default")
+    assert sorted(d.index for d in devs) == [0, 1]
+
+
+def test_get_slave_pod_devices(kubelet, backend, cfg):
+    kubelet.set_claim("t-slave-pod-x", cfg.pool_namespace,
+                      "google.com/tpu", ["3"])
+    coll = TpuCollector(backend=backend, podresources=_client(kubelet), cfg=cfg)
+    devs = coll.get_slave_pod_devices("t-slave-pod-x")
+    assert [d.index for d in devs] == [3]
+
+
+def test_status_refresh_clears_stale(kubelet, backend, cfg):
+    kubelet.set_claim("trainer", "default", "google.com/tpu", ["0"])
+    coll = TpuCollector(backend=backend, podresources=_client(kubelet), cfg=cfg)
+    assert len(coll.free_devices()) == 3
+    kubelet.clear()
+    coll.update_status()
+    assert len(coll.free_devices()) == 4
+
+
+def test_collector_without_kubelet(backend, cfg, tmp_path):
+    # Local dry-run mode: no socket → inventory only, no crash.
+    cfg2 = cfg.replace(kubelet_socket=str(tmp_path / "missing.sock"))
+    coll = TpuCollector(backend=backend, cfg=cfg2)
+    assert len(coll.snapshot()) == 4
+    assert os.path.basename(coll.snapshot()[0].device_path) == "accel0"

@@ -1,0 +1,162 @@
+"""Master e2e: real HTTP → master app → real gRPC → worker → fake cluster.
+
+Exercises the reference's full AddGPU/RemoveGPU call stacks (SURVEY.md §3.2,
+§3.3) in-process, including route shapes, worker discovery, and HTTP status
+mapping (main.go:103-116, 206-224).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.master.app import MasterApp, WorkerRegistry, build_http_server
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """(base_url, cluster, container_dev, service) with live HTTP+gRPC."""
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev), description=f"{pod.namespace}/{pod.name}")
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cluster.cfg)
+    grpc_server = build_server(service, address="localhost:0")
+    grpc_port = grpc_server.bound_port
+    grpc_server.start()
+
+    cfg = cluster.cfg.replace(worker_port=grpc_port)
+    # Register the worker pod the way the DaemonSet would appear.
+    cluster.kube.create_pod(cfg.worker_namespace, {
+        "metadata": {"name": "tpu-mounter-worker-abc",
+                     "namespace": cfg.worker_namespace,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": cluster.node_name,
+                 "containers": [{"name": "worker"}]},
+        "status": {"phase": "Running", "podIP": "127.0.0.1"},
+    })
+    app = MasterApp(cluster.kube, cfg=cfg,
+                    registry=WorkerRegistry(cluster.kube, cfg))
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    yield base, cluster, str(container_dev), service
+
+    httpd.shutdown()
+    grpc_server.stop(grace=None)
+    cluster.stop()
+
+
+def http(method: str, url: str, form: dict | None = None):
+    data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def test_index_and_health(stack):
+    base, *_ = stack
+    assert http("GET", base + "/")[0] == 200
+    assert http("GET", base + "/healthz") == (200, "ok\n")
+    status, body = http("GET", base + "/metrics")
+    assert status == 200 and "tpumounter_mount_total" in body
+
+
+def test_add_remove_via_http(stack):
+    base, cluster, container_dev, service = stack
+    cluster.add_target_pod("trainer")
+    status, body = http(
+        "GET", base + "/addtpu/namespace/default/pod/trainer/tpu/2/"
+                      "isEntireMount/false")
+    assert (status, body) == (200, "Add TPU Success\n"), body
+    assert len([n for n in os.listdir(container_dev)
+                if n.startswith("accel")]) == 2
+
+    devices = service.collector.get_pod_devices("trainer", "default")
+    uuids = ",".join(d.uuid for d in devices)
+    status, body = http(
+        "POST", base + "/removetpu/namespace/default/pod/trainer/force/false",
+        form={"uuids": uuids})
+    assert (status, body) == (200, "Remove 2 TPUs Success\n"), body
+    assert cluster.free_chip_count() == 4
+
+
+def test_legacy_route_shape(stack):
+    """The reference's /addgpu/.../gpu/... URL works unchanged."""
+    base, cluster, container_dev, service = stack
+    cluster.add_target_pod("legacy-pod")
+    status, body = http(
+        "GET", base + "/addgpu/namespace/default/pod/legacy-pod/gpu/1/"
+                      "isEntireMount/false")
+    assert (status, body) == (200, "Add TPU Success\n"), body
+
+
+def test_http_error_mapping(stack):
+    base, cluster, *_ = stack
+    # pod not found → 404 (main.go:55-59)
+    status, body = http(
+        "GET", base + "/addtpu/namespace/default/pod/ghost/tpu/1/"
+                      "isEntireMount/false")
+    assert status == 404 and "No pod" in body
+    # bad gpuNum → 400
+    status, _ = http(
+        "GET", base + "/addtpu/namespace/default/pod/ghost/tpu/xx/"
+                      "isEntireMount/false")
+    assert status == 400
+    # bad bool → 400
+    status, _ = http(
+        "GET", base + "/addtpu/namespace/default/pod/ghost/tpu/1/"
+                      "isEntireMount/maybe")
+    assert status == 400
+    # insufficient → 500 (main.go:107-109)
+    cluster.add_target_pod("hungry")
+    status, body = http(
+        "GET", base + "/addtpu/namespace/default/pod/hungry/tpu/64/"
+                      "isEntireMount/false")
+    assert status == 500 and "Insufficient TPU" in body
+    # remove without uuids → 400 (main.go:128-133)
+    status, _ = http(
+        "POST", base + "/removetpu/namespace/default/pod/hungry/force/false",
+        form={})
+    assert status == 400
+    # unknown route → 404
+    assert http("GET", base + "/nope")[0] == 404
+
+
+def test_busy_maps_to_400(stack):
+    base, cluster, container_dev, service = stack
+    cluster.add_target_pod("busy-pod")
+    http("GET", base + "/addtpu/namespace/default/pod/busy-pod/tpu/1/"
+                       "isEntireMount/false")
+    devices = service.collector.get_pod_devices("busy-pod", "default")
+    holder = open(os.path.join(container_dev, devices[0].basename), "rb")
+    try:
+        status, body = http(
+            "POST", base + "/removetpu/namespace/default/pod/busy-pod/"
+                           "force/false",
+            form={"uuids": devices[0].uuid})
+        assert status == 400 and "running processes" in body
+    finally:
+        holder.close()

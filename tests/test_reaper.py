@@ -1,0 +1,73 @@
+"""SlaveReaper tests: our GC for orphaned slave pods (replaces the
+reference's broken cross-namespace OwnerReferences, allocator.go:202-212)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.allocator.allocator import TpuAllocator
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.reaper import SlaveReaper
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = FakeCluster(str(tmp_path), n_chips=4).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def allocator(cluster):
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    return TpuAllocator(cluster.kube, collector, cfg=cluster.cfg)
+
+
+def test_reaper_frees_orphan_slaves(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    _, slaves = allocator.get_available_tpus(owner, 2, 1)
+    assert cluster.free_chip_count() == 2
+
+    reaper = SlaveReaper(cluster.kube, cfg=cluster.cfg)
+    # Owner alive: nothing reaped.
+    assert reaper.reap_once() == []
+
+    cluster.kube.delete_pod("default", "trainer")
+    deleted = reaper.reap_once()
+    assert sorted(deleted) == sorted(slaves)
+    assert cluster.free_chip_count() == 4
+
+
+def test_reaper_detects_recreated_owner(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    _, slaves = allocator.get_available_tpus(owner, 1, 1)
+    # Recreate the owner under a new UID (delete + create).
+    cluster.kube.delete_pod("default", "trainer")
+    cluster.add_target_pod("trainer")
+    reaper = SlaveReaper(cluster.kube, cfg=cluster.cfg)
+    assert reaper.reap_once() == slaves
+
+
+def test_reaper_ignores_foreign_pods(cluster):
+    cluster.kube.create_pod(cluster.cfg.pool_namespace, {
+        "metadata": {"name": "someone-elses-pod",
+                     "namespace": cluster.cfg.pool_namespace,
+                     "labels": {"app": "tpu-pool"}},
+        "spec": {"containers": [{"name": "x"}]},
+    })
+    reaper = SlaveReaper(cluster.kube, cfg=cluster.cfg)
+    assert reaper.reap_once() == []
+
+
+def test_reaper_reaps_finished_owner(cluster, allocator):
+    owner = cluster.add_target_pod("trainer")
+    _, slaves = allocator.get_available_tpus(owner, 1, 1)
+    cluster.kube.set_pod_status("default", "trainer", phase="Succeeded")
+    reaper = SlaveReaper(cluster.kube, cfg=cluster.cfg)
+    assert reaper.reap_once() == slaves
