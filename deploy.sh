@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Deploy/undeploy tpumounter. Reference parity: deploy.sh:8-40
+# (deploy | redeploy | uninstall over the deploy/ manifests).
+set -euo pipefail
+
+MANIFESTS=(
+  deploy/namespace.yaml
+  deploy/rbac.yaml
+  deploy/worker-daemonset.yaml
+  deploy/master-deployment.yaml
+  deploy/service.yaml
+)
+
+deploy() {
+  for m in "${MANIFESTS[@]}"; do kubectl apply -f "$m"; done
+  echo "tpumounter deployed. Label TPU nodes to opt in:"
+  echo "  kubectl label node <node> tpu-mounter-enable=enable"
+}
+
+uninstall() {
+  for ((i=${#MANIFESTS[@]}-1; i>=0; i--)); do
+    kubectl delete -f "${MANIFESTS[$i]}" --ignore-not-found
+  done
+}
+
+case "${1:-}" in
+  deploy)    deploy ;;
+  redeploy)  uninstall; deploy ;;
+  uninstall) uninstall ;;
+  *) echo "usage: $0 deploy|redeploy|uninstall" >&2; exit 2 ;;
+esac

@@ -71,7 +71,9 @@ class TpuAllocator:
     # --- slave pod manifest (reference: newGPUSlavePod, allocator.go:189-234) ---
 
     def _slave_pod_manifest(self, owner: Pod, tpu_num: int) -> dict:
-        name = (f"{owner.name}{self.cfg.slave_pod_name_suffix}"
+        # Pod names may be 253 chars; keep room for the suffix + hex.
+        base = owner.name[:200]
+        name = (f"{base}{self.cfg.slave_pod_name_suffix}"
                 f"{secrets.token_hex(3)}")
         # NOTE on GC: the reference sets OwnerReferences → the owner pod
         # (allocator.go:202-212), but its slave pods live in gpu-pool while
@@ -87,10 +89,19 @@ class TpuAllocator:
             "metadata": {
                 "name": name,
                 "namespace": self.cfg.pool_namespace,
+                # The UID label is the authoritative ownership key (UIDs
+                # are 36 chars, always label-legal); pod *names* can exceed
+                # the 63-char label-value cap, so full names live in
+                # annotations and the name labels are display-truncated.
                 "labels": {"app": "tpu-pool",
-                           "tpumounter.io/owner": owner.name,
-                           "tpumounter.io/owner-namespace": owner.namespace,
-                           "tpumounter.io/owner-uid": owner.uid},
+                           "tpumounter.io/owner-uid": owner.uid,
+                           "tpumounter.io/owner": owner.name[:63],
+                           "tpumounter.io/owner-namespace":
+                               owner.namespace[:63]},
+                "annotations": {
+                    "tpumounter.io/owner": owner.name,
+                    "tpumounter.io/owner-namespace": owner.namespace,
+                },
             },
             "spec": {
                 "nodeSelector": {"kubernetes.io/hostname": owner.node_name},
@@ -260,22 +271,17 @@ class TpuAllocator:
                     f"{self.cfg.slave_pod_timeout_s}s")
 
     def slave_pods_for(self, pod: Pod) -> list[Pod]:
-        """Slave pods owned by this pod, matched by owner labels — name,
-        namespace, and (when known) UID, so same-named pods in different
-        namespaces, or a recreated pod with a recycled name, never
-        cross-talk. (The reference matches by name prefix only,
-        collector.go:156-161.)"""
-        selector = (f"tpumounter.io/owner={pod.name},"
-                    f"tpumounter.io/owner-namespace={pod.namespace}")
-        out = []
-        for p in self.kube.list_pods(self.cfg.pool_namespace,
-                                     label_selector=selector):
-            sp = Pod(p)
-            owner_uid = sp.labels.get("tpumounter.io/owner-uid", "")
-            if pod.uid and owner_uid and owner_uid != pod.uid:
-                continue
-            out.append(sp)
-        return out
+        """Slave pods owned by this pod, matched by the owner-UID label —
+        immune to same-named pods in different namespaces and to recycled
+        names after recreation. (The reference matches by name prefix only,
+        collector.go:156-161, which cross-talks.)"""
+        if pod.uid:
+            selector = f"tpumounter.io/owner-uid={pod.uid}"
+        else:  # no UID known (should not happen for running pods)
+            selector = (f"tpumounter.io/owner={pod.name[:63]},"
+                        f"tpumounter.io/owner-namespace={pod.namespace[:63]}")
+        return [Pod(p) for p in self.kube.list_pods(
+            self.cfg.pool_namespace, label_selector=selector)]
 
     def slave_pods_holding(self, pod: Pod,
                            devices: list[TpuDevice]) -> list[str]:

@@ -334,8 +334,19 @@ class V2DeviceController:
     def grant(self, cgroup_dir: str, dev: TpuDevice,
               base_rules: list[DeviceRule] | None = None) -> None:
         st = self._get_state(cgroup_dir, base_rules)
-        st.granted[(dev.major, dev.minor)] = device_rule(dev)
-        self._swap_program(st)
+        key = (dev.major, dev.minor)
+        had_prior = key in st.granted
+        st.granted[key] = device_rule(dev)
+        try:
+            self._swap_program(st)
+        except BpfError:
+            # Roll the rule back out: a later successful grant must not
+            # silently include a chip whose grant failed.
+            if not had_prior:
+                st.granted.pop(key, None)
+            if not st.granted and st.our_fd is None:
+                self._close_state(cgroup_dir)
+            raise
         logger.info("cgroup v2: granted c %d:%d rw on %s",
                     dev.major, dev.minor, cgroup_dir)
 
@@ -356,7 +367,15 @@ class V2DeviceController:
                 restored += 1
             except BpfError as exc:
                 logger.error("cannot restore original device prog: %s", exc)
-        if st.our_fd is not None and (restored == len(st.original_fds)):
+        if restored < len(st.original_fds):
+            # Keep the state (and the fds pinning the originals!) so a
+            # retry of revoke can restore later; closing them here would
+            # free the kernel's last reference to the runc policy.
+            raise BpfError(
+                0, f"restored only {restored}/{len(st.original_fds)} "
+                   f"original device prog(s) on {cgroup_dir}; state kept "
+                   "for retry")
+        if st.our_fd is not None:
             try:
                 prog_detach(st.cgroup_fd, st.our_fd)
             except BpfError as exc:

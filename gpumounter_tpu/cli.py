@@ -1,0 +1,214 @@
+"""tpumounter CLI: local single-node mode + master client.
+
+Two families of verbs:
+
+  Local (no Kubernetes anywhere — the SURVEY.md §7 "minimum end-to-end
+  slice" / BASELINE config 1):
+    devices                          chip inventory + busy holders
+    probe                            native layer + libtpu status
+    mount   --target-dev DIR [--pid N] [--cgroup DIR] --chips N | --uuid U..
+    unmount --target-dev DIR [--pid N] [--cgroup DIR] --uuid U.. [--force]
+
+  Remote (against a running master, same HTTP API as the reference's
+  QuickStart curl examples):
+    add     --master URL --namespace NS --pod POD --num N [--entire]
+    remove  --master URL --namespace NS --pod POD --uuids U,U [--force]
+
+The reference has no CLI at all (interaction is raw curl,
+docs/guide/QuickStart.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.device.backend import backend_from_config
+from gpumounter_tpu.utils.log import init_logger
+
+
+def _backend():
+    return backend_from_config(get_config())
+
+
+def cmd_devices(args) -> int:
+    backend = _backend()
+    devices = backend.list_devices()
+    out = []
+    for dev in devices:
+        entry = {
+            "index": dev.index, "uuid": dev.uuid, "path": dev.device_path,
+            "major": dev.major, "minor": dev.minor,
+        }
+        if args.busy:
+            entry["holder_pids"] = backend.running_pids(dev)
+        out.append(entry)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_probe(args) -> int:
+    from gpumounter_tpu import native
+
+    lib = native.load_native()
+    report = {
+        "native_lib": "loaded" if lib is not None else "unavailable",
+        "libtpu": native.libtpu_probe(),
+        "chips": len(_backend().list_devices()),
+    }
+    from gpumounter_tpu.cgroup.naming import (
+        detect_cgroup_driver,
+        detect_cgroup_version,
+    )
+    cfg = get_config()
+    report["cgroup_version"] = detect_cgroup_version(cfg.cgroup_root)
+    report["cgroup_driver"] = detect_cgroup_driver(cfg.cgroup_root)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def _local_mounter_and_target(args):
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+
+    backend = _backend()
+    mounter = TpuMounter(backend)
+    target = MountTarget(
+        dev_dir=args.target_dev,
+        cgroup_dirs=[args.cgroup] if args.cgroup else [],
+        ns_pid=args.pid,
+        description=f"local:{args.target_dev}")
+    return backend, mounter, target
+
+
+def cmd_mount(args) -> int:
+    backend, mounter, target = _local_mounter_and_target(args)
+    devices = backend.list_devices()
+    chosen = []
+    if args.uuid:
+        by_uuid = {d.uuid: d for d in devices}
+        for u in args.uuid:
+            if u not in by_uuid:
+                print(f"error: no device with uuid {u}", file=sys.stderr)
+                return 1
+            chosen.append(by_uuid[u])
+    else:
+        chosen = devices[:args.chips]
+        if len(chosen) < args.chips:
+            print(f"error: only {len(chosen)} chip(s) available",
+                  file=sys.stderr)
+            return 1
+    for dev in chosen:
+        timings = mounter.mount(target, dev)
+        print(json.dumps({"mounted": dev.uuid, "timings_ms": timings}))
+    return 0
+
+
+def cmd_unmount(args) -> int:
+    from gpumounter_tpu.worker.mounter import TpuBusyError
+
+    backend, mounter, target = _local_mounter_and_target(args)
+    rc = 0
+    for u in args.uuid:
+        dev = backend.device_by_uuid(u)
+        if dev is None:
+            print(f"error: no device with uuid {u}", file=sys.stderr)
+            rc = 1
+            continue
+        try:
+            timings = mounter.unmount(target, dev, force=args.force)
+            print(json.dumps({"unmounted": dev.uuid, "timings_ms": timings}))
+        except TpuBusyError as exc:
+            print(f"busy: {exc}", file=sys.stderr)
+            rc = 2
+    return rc
+
+
+def _http(method: str, url: str, form: dict | None = None) -> tuple[int, str]:
+    data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def cmd_add(args) -> int:
+    url = (f"{args.master.rstrip('/')}/addtpu/namespace/{args.namespace}"
+           f"/pod/{args.pod}/tpu/{args.num}"
+           f"/isEntireMount/{str(args.entire).lower()}")
+    status, body = _http("GET", url)
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def cmd_remove(args) -> int:
+    url = (f"{args.master.rstrip('/')}/removetpu/namespace/{args.namespace}"
+           f"/pod/{args.pod}/force/{str(args.force).lower()}")
+    status, body = _http("POST", url, form={"uuids": args.uuids})
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpumounter")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    d = sub.add_parser("devices", help="list chip inventory")
+    d.add_argument("--busy", action="store_true",
+                   help="include holder PIDs per chip")
+    d.set_defaults(fn=cmd_devices)
+
+    pr = sub.add_parser("probe", help="native layer / libtpu / cgroup status")
+    pr.set_defaults(fn=cmd_probe)
+
+    def _local_args(sp):
+        sp.add_argument("--target-dev", required=True,
+                        help="device dir of the target (its /dev)")
+        sp.add_argument("--pid", type=int, default=None,
+                        help="PID whose mount namespace to enter")
+        sp.add_argument("--cgroup", default="",
+                        help="target cgroup dir for device permission")
+
+    m = sub.add_parser("mount", help="local mount (no k8s)")
+    _local_args(m)
+    m.add_argument("--chips", type=int, default=1)
+    m.add_argument("--uuid", action="append", default=[])
+    m.set_defaults(fn=cmd_mount)
+
+    um = sub.add_parser("unmount", help="local unmount (no k8s)")
+    _local_args(um)
+    um.add_argument("--uuid", action="append", required=True)
+    um.add_argument("--force", action="store_true")
+    um.set_defaults(fn=cmd_unmount)
+
+    a = sub.add_parser("add", help="hot-add via a running master")
+    a.add_argument("--master", required=True)
+    a.add_argument("--namespace", default="default")
+    a.add_argument("--pod", required=True)
+    a.add_argument("--num", type=int, default=1)
+    a.add_argument("--entire", action="store_true")
+    a.set_defaults(fn=cmd_add)
+
+    r = sub.add_parser("remove", help="hot-remove via a running master")
+    r.add_argument("--master", required=True)
+    r.add_argument("--namespace", default="default")
+    r.add_argument("--pod", required=True)
+    r.add_argument("--uuids", required=True, help="comma-separated")
+    r.add_argument("--force", action="store_true")
+    r.set_defaults(fn=cmd_remove)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    init_logger()
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

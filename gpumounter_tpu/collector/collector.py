@@ -139,7 +139,9 @@ class TpuCollector:
         """
         if refresh:
             self.update_status()
-        slave_prefix = pod_name + self.cfg.slave_pod_name_suffix
+        # Matches the allocator's name construction (owner truncated to 200
+        # chars before the suffix, allocator._slave_pod_manifest).
+        slave_prefix = pod_name[:200] + self.cfg.slave_pod_name_suffix
         with self._lock:
             out = []
             for dev in self.devices:

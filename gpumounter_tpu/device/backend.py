@@ -198,10 +198,17 @@ def scan_proc_for_device(major: int | None, minor: int | None,
                          path_hint: str = "", proc_root: str = "/proc") -> list[int]:
     """PIDs with an open fd on the given device (by rdev and/or path).
 
-    Python fallback for the native scanner (native/tpumounter_native.cpp).
-    Matching by st_rdev catches the device regardless of the path the opener
-    used (bind mounts, different mount namespaces).
+    Uses the native scanner (native/tpumounter_native.cpp) when built —
+    this sits on the busy-check hot path of every unmount — with this
+    Python implementation as the always-available fallback. Matching by
+    st_rdev catches the device regardless of the path the opener used
+    (bind mounts, different mount namespaces).
     """
+    from gpumounter_tpu import native as native_mod
+    native_pids = native_mod.scan_device_holders(major, minor, path_hint,
+                                                 proc_root)
+    if native_pids is not None:
+        return native_pids
     pids: list[int] = []
     want_rdev = None
     if major is not None and minor is not None and (major, minor) != (0, 0):

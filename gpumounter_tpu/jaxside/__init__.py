@@ -1,0 +1,25 @@
+"""Tenant-side JAX visibility for hot-mounted chips.
+
+No reference analog exists: GPUMounter stops at the device node + cgroup
+(CUDA enumerates GPUs lazily per call, so new /dev/nvidiaN just works in a
+running process). libtpu/PJRT enumerates chips once at backend init and
+holds them exclusively for the life of the client, so a running JAX process
+needs explicit choreography to observe hot-mounted chips (SURVEY.md §7
+hard part #2). This package provides it.
+"""
+
+from gpumounter_tpu.jaxside.visibility import (
+    chips_visible_in_dev,
+    refresh_devices,
+    set_topology_env,
+    wait_for_chips,
+)
+from gpumounter_tpu.jaxside.resume import HotResumable
+
+__all__ = [
+    "chips_visible_in_dev",
+    "refresh_devices",
+    "set_topology_env",
+    "wait_for_chips",
+    "HotResumable",
+]

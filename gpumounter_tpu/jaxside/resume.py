@@ -1,0 +1,65 @@
+"""Resume SPMD work over a hot-changed chip set.
+
+The hard constraint: rebuilding the PJRT backend (jaxside.visibility.
+refresh_devices) invalidates every live device array. So "hot-add chips to
+a training job" is a three-beat move:
+
+    state = HotResumable.pack(params, opt_state)   # device → host
+    wait_for_chips(new_count)                      # backend rebuild
+    params, opt_state = state.restore(build_mesh())  # host → new mesh
+
+Resharding is a plain device_put with the new NamedSharding — XLA lays the
+data out for the new mesh and its collectives ride ICI from then on
+(TPU-first scaling: mesh + shardings, not a comm library; SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxside.resume")
+
+
+@dataclass
+class HotResumable:
+    """Host-memory snapshot of a pytree-of-arrays training state."""
+
+    host_state: Any
+
+    @classmethod
+    def pack(cls, *trees: Any) -> "HotResumable":
+        """Pull device arrays to host memory (survives backend teardown)."""
+        import jax
+        import numpy as np
+
+        host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), trees)
+        logger.debug("packed %d tree(s) to host", len(trees))
+        return cls(host_state=host)
+
+    def restore(self, mesh, specs: Any = None) -> tuple:
+        """Re-shard onto `mesh`. specs mirrors the packed trees (a pytree of
+        PartitionSpec per tree, or None for fully-replicated)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def _put(tree, tree_specs):
+            if tree_specs is None:
+                return jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(mesh, P())), tree)
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                tree, tree_specs,
+                is_leaf=lambda x: not isinstance(x, (dict, list, tuple)))
+
+        if specs is None:
+            out = tuple(_put(t, None) for t in self.host_state)
+        else:
+            out = tuple(_put(t, s)
+                        for t, s in zip(self.host_state, specs))
+        logger.info("restored %d tree(s) onto mesh %s", len(out),
+                    dict(zip(mesh.axis_names, mesh.devices.shape)))
+        return out

@@ -1,0 +1,161 @@
+"""Make a running JAX process observe hot-mounted TPU chips.
+
+Mechanism (BASELINE.json north star: mount → jax.device_count() match):
+
+  1. The worker injects /dev/accelN + cgroup grant (control-plane side).
+  2. The tenant (this module) waits for the device nodes to appear,
+  3. tears down the PJRT backend (libtpu enumerated chips at init and
+     won't see new ones), refreshing topology env if provided,
+  4. re-initializes by touching jax.devices() — libtpu re-enumerates
+     /dev/accel* and the new chips appear.
+
+Multi-host (BASELINE config 5, stretch): after all hosts mounted, each
+host updates its topology env coherently and calls
+jax.distributed.shutdown()/initialize() before the backend rebuild —
+`reinit_distributed` wraps that ordering.
+
+IMPORTANT: backend teardown invalidates live device arrays. Use
+jaxside.resume.HotResumable to pack state to host memory first.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxside")
+
+# Topology env vars libtpu consults at init (SURVEY.md §5: the TPU fabric
+# is exposed to the tenant via env + device files; JAX's own runtime then
+# drives ICI/DCN).
+TOPOLOGY_ENV_VARS = (
+    "TPU_CHIPS_PER_HOST_BOUNDS",
+    "TPU_HOST_BOUNDS",
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_VISIBLE_CHIPS",
+    "TPU_ACCELERATOR_TYPE",
+)
+
+
+def chips_visible_in_dev(dev_dir: str = "/dev") -> int:
+    """Count accel device nodes currently present in the container."""
+    try:
+        return sum(1 for n in os.listdir(dev_dir)
+                   if n.startswith("accel") and n[5:].isdigit())
+    except FileNotFoundError:
+        return 0
+
+
+def set_topology_env(*, chips_per_host_bounds: str | None = None,
+                     host_bounds: str | None = None,
+                     worker_id: int | None = None,
+                     worker_hostnames: str | None = None,
+                     visible_chips: str | None = None,
+                     accelerator_type: str | None = None) -> None:
+    """Set/refresh libtpu topology env before a backend rebuild.
+
+    E.g. a v5e single host going from 1 chip to 4:
+        set_topology_env(chips_per_host_bounds="2,2,1", host_bounds="1,1,1",
+                         visible_chips="0,1,2,3")
+    """
+    mapping = {
+        "TPU_CHIPS_PER_HOST_BOUNDS": chips_per_host_bounds,
+        "TPU_HOST_BOUNDS": host_bounds,
+        "TPU_WORKER_ID": None if worker_id is None else str(worker_id),
+        "TPU_WORKER_HOSTNAMES": worker_hostnames,
+        "TPU_VISIBLE_CHIPS": visible_chips,
+        "TPU_ACCELERATOR_TYPE": accelerator_type,
+    }
+    for key, val in mapping.items():
+        if val is not None:
+            os.environ[key] = val
+            logger.debug("topology env %s=%s", key, val)
+
+
+def refresh_devices(platform: str | None = None) -> int:
+    """Tear down and rebuild the JAX backend; returns new device count.
+
+    CUDA analog: unnecessary (lazy per-device open). libtpu: required —
+    chips are enumerated and locked at PJRT client init.
+    """
+    import jax
+
+    try:
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 — older jax
+        pass
+    # Public-ish API moved over versions; try in order.
+    cleared = False
+    for clear in ("clear_backends",):
+        fn = getattr(jax, clear, None) or getattr(
+            getattr(jax, "extend", None) or object(), clear, None)
+        if fn is not None:
+            fn()
+            cleared = True
+            break
+    if not cleared:  # very old fallback
+        from jax._src import xla_bridge
+        xla_bridge.get_backend.cache_clear()
+    devices = jax.devices(platform) if platform else jax.devices()
+    logger.info("backend rebuilt: %d device(s)", len(devices))
+    return len(devices)
+
+
+def wait_for_chips(expected: int, timeout_s: float = 30.0,
+                   dev_dir: str = "/dev",
+                   platform: str | None = None,
+                   poll_interval_s: float = 0.05) -> dict:
+    """Block until `expected` chips are mounted AND visible to JAX.
+
+    Returns phase timings (ms): nodes_visible, backend_rebuild, total —
+    the tenant half of the north-star latency. Raises TimeoutError.
+    """
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while chips_visible_in_dev(dev_dir) < expected:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"only {chips_visible_in_dev(dev_dir)}/{expected} device "
+                f"node(s) in {dev_dir} after {timeout_s}s")
+        time.sleep(poll_interval_s)
+    t_nodes = time.monotonic()
+
+    count = refresh_devices(platform)
+    while count < expected:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"jax.device_count()={count} < {expected} after {timeout_s}s")
+        time.sleep(poll_interval_s)
+        count = refresh_devices(platform)
+    t_done = time.monotonic()
+    timings = {
+        "nodes_visible_ms": round((t_nodes - t0) * 1000.0, 3),
+        "backend_rebuild_ms": round((t_done - t_nodes) * 1000.0, 3),
+        "total_ms": round((t_done - t0) * 1000.0, 3),
+        "device_count": count,
+    }
+    logger.info("chips visible: %s", timings)
+    return timings
+
+
+def reinit_distributed(coordinator_address: str, num_processes: int,
+                       process_id: int) -> None:
+    """Multi-host re-init ordering (BASELINE config 5, stretch):
+    shutdown distributed → (caller refreshes topology env on every host)
+    → initialize → backend rebuild happens on next jax.devices().
+    """
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as exc:  # noqa: BLE001 — not initialized yet is fine
+        logger.debug("distributed shutdown: %s", exc)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    logger.info("jax.distributed re-initialized: %d process(es), id %d",
+                num_processes, process_id)

@@ -63,6 +63,10 @@ class WorkerRegistry:
         self._cache = cache
         self._stamp = time.monotonic()
 
+    def registry_snapshot(self) -> dict[str, str]:
+        self._refresh()
+        return dict(self._cache)
+
     def worker_address(self, node_name: str) -> str | None:
         if time.monotonic() - self._stamp > self.ttl_s:
             self._refresh()
@@ -94,7 +98,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/$"), "index"),
     ("GET", re.compile(r"^/healthz$"), "healthz"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
-    ("GET", re.compile(r"^/devices$"), "devices"),
+    ("GET", re.compile(r"^/workers$"), "workers"),
 ]
 
 
@@ -166,11 +170,10 @@ class MasterApp:
     def _route_metrics(self, match, body, headers):
         return 200, "text/plain; version=0.0.4", REGISTRY.render()
 
-    def _route_devices(self, match, body, headers):
-        # Inventory endpoint (no reference analog): which nodes have workers.
-        self.registry._refresh()
+    def _route_workers(self, match, body, headers):
+        # Worker registry endpoint (no reference analog): node → worker IP.
         lines = [f"{node} {ip}" for node, ip in
-                 sorted(self.registry._cache.items())]
+                 sorted(self.registry.registry_snapshot().items())]
         return 200, "text/plain", "\n".join(lines) + "\n"
 
     def _route_add(self, match, body, headers):

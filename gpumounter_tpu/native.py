@@ -1,0 +1,132 @@
+"""ctypes bindings for libtpumounter_native.so (graceful fallback to Python).
+
+The native library (native/tpumounter_native.cpp) is the TPU-native
+replacement of the reference's NVML cgo boundary (nvml_dl.go:29-36): device
+enumeration, /proc busy scanning, cgroup-v2 device-eBPF ops, and an optional
+libtpu.so probe. Every entry point here returns None (or falls back) when
+the library is absent so the pure-Python paths keep the framework fully
+functional — the reference, by contrast, hard-fails without
+libnvidia-ml.so.1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("native")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_tried = False
+
+
+class _TpmDevice(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("major_num", ctypes.c_uint32),
+        ("minor_num", ctypes.c_uint32),
+        ("path", ctypes.c_char * 256),
+    ]
+
+
+def _candidates() -> list[str]:
+    from gpumounter_tpu.config import get_config
+    cfg = get_config()
+    out = []
+    if cfg.native_lib:
+        out.append(cfg.native_lib)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out.append(os.path.join(here, "native", "build",
+                            "libtpumounter_native.so"))
+    out.append("/usr/local/lib/libtpumounter_native.so")
+    return out
+
+
+def load_native() -> ctypes.CDLL | None:
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        for path in _candidates():
+            if not os.path.exists(path):
+                continue
+            try:
+                lib = ctypes.CDLL(path, use_errno=True)
+            except OSError as exc:
+                logger.warning("cannot load %s: %s", path, exc)
+                continue
+            lib.tpm_enum_accel.restype = ctypes.c_int
+            lib.tpm_enum_accel.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(_TpmDevice), ctypes.c_int]
+            lib.tpm_scan_device_holders.restype = ctypes.c_int
+            lib.tpm_scan_device_holders.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+            lib.tpm_libtpu_probe.restype = ctypes.c_int
+            lib.tpm_libtpu_probe.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+            logger.info("native layer loaded: %s", path)
+            _lib = lib
+            return _lib
+        logger.debug("native library unavailable; using Python paths")
+        return None
+
+
+def reset_for_tests() -> None:
+    global _lib, _lib_tried
+    with _lock:
+        _lib = None
+        _lib_tried = False
+
+
+def enum_accel(dev_dir: str) -> list[tuple[int, int, int, str]] | None:
+    """[(index, major, minor, path)] via the native scanner, or None."""
+    lib = load_native()
+    if lib is None:
+        return None
+    cap = 64
+    while True:
+        buf = (_TpmDevice * cap)()
+        n = lib.tpm_enum_accel(dev_dir.encode(), buf, cap)
+        if n < 0:
+            return None
+        if n <= cap:
+            return [(buf[i].index, buf[i].major_num, buf[i].minor_num,
+                     buf[i].path.decode()) for i in range(n)]
+        cap = n
+
+
+def scan_device_holders(major: int | None, minor: int | None,
+                        path_hint: str = "",
+                        proc_root: str = "/proc") -> list[int] | None:
+    """PIDs holding the device open, via the native scanner, or None."""
+    lib = load_native()
+    if lib is None:
+        return None
+    cap = 256
+    while True:
+        buf = (ctypes.c_int32 * cap)()
+        n = lib.tpm_scan_device_holders(
+            major if major is not None else -1,
+            minor if minor is not None else -1,
+            path_hint.encode(), proc_root.encode(), buf, cap)
+        if n < 0:
+            return None
+        if n <= cap:
+            return [buf[i] for i in range(n)]
+        cap = n
+
+
+def libtpu_probe(path: str = "") -> str:
+    """Human-readable libtpu availability report (never initializes it)."""
+    lib = load_native()
+    if lib is None:
+        return "native layer unavailable"
+    buf = ctypes.create_string_buffer(512)
+    lib.tpm_libtpu_probe(path.encode(), buf, len(buf))
+    return buf.value.decode()

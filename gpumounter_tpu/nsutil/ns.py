@@ -70,7 +70,7 @@ def inject_device_file(target_dev_dir: str, dev: TpuDevice,
     target_path = device_node_path(target_dev_dir, dev)
     if pid is not None:
         _run_nsexec(["mknod", str(pid), target_path,
-                     str(dev.major), str(dev.minor), oct(DEVICE_FILE_MODE)])
+                     str(dev.major), str(dev.minor), f"{DEVICE_FILE_MODE:o}"])
         return target_path
 
     if os.path.exists(target_path):
@@ -128,9 +128,10 @@ def kill_pids_in_ns(pids: list[int], pid: int | None = None,
                     signal_num: int = 9) -> None:
     """Kill device-holding PIDs. Reference: KillRunningGPUProcesses (namespace.go:191-201).
 
-    PIDs are host-view (worker runs with hostPID: true); with pid=None we
-    signal directly, otherwise via nsexec (enters the PID namespace so the
-    kill is scoped).
+    PIDs are host-view (worker runs with hostPID: true, like the reference
+    DaemonSet), so the kill needs no namespace entry; the nsexec route is
+    used when configured for symmetry/auditability, and it also signals
+    the host-view PIDs directly (native/nsexec.cpp cmd_kill).
     """
     if not pids:
         return

@@ -142,6 +142,7 @@ class TpuMounter:
               base_rules: list[DeviceRule] | None = None) -> dict:
         """Grant + inject one chip. Returns phase timings (ms)."""
         timer = PhaseTimer()
+        granted: list[str] = []
         try:
             with timer.phase("cgroup_grant"):
                 for cg in target.cgroup_dirs:
@@ -149,17 +150,26 @@ class TpuMounter:
                         self.controller.grant(cg, dev, base_rules=base_rules)
                     else:
                         self.controller.grant(cg, dev)
+                    granted.append(cg)
             with timer.phase("device_inject"):
                 nsutil.inject_device_file(target.dev_dir, dev,
                                           pid=target.ns_pid)
-        except MountError:
-            MOUNT_TOTAL.inc(result="error")
-            raise
         except Exception as exc:
+            # Undo partial grants: without this, a failed injection leaves
+            # the container with kernel-level access to a chip the caller's
+            # rollback is about to hand back to the scheduler.
+            for cg in granted:
+                try:
+                    self.controller.revoke(cg, dev)
+                except Exception as undo_exc:  # noqa: BLE001
+                    logger.error("grant rollback on %s failed: %s",
+                                 cg, undo_exc)
+            MOUNT_TOTAL.inc(result="error")
+            if isinstance(exc, MountError):
+                raise
             # Normalize lower-layer failures (CgroupError, BpfError,
             # NamespaceError, OSError) so callers' rollback paths fire on
             # a single exception type.
-            MOUNT_TOTAL.inc(result="error")
             raise MountError(
                 f"mount of {dev.uuid} into {target.description}: {exc}") from exc
         MOUNT_TOTAL.inc(result="success")

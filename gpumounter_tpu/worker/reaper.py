@@ -46,8 +46,12 @@ class SlaveReaper:
             return deleted
         for slave_json in slaves:
             slave = Pod(slave_json)
-            owner = slave.labels.get("tpumounter.io/owner", "")
-            owner_ns = slave.labels.get("tpumounter.io/owner-namespace", "")
+            # Full owner identity lives in annotations (label values are
+            # 63-char-capped); labels are the fallback for older slaves.
+            owner = (slave.annotations.get("tpumounter.io/owner")
+                     or slave.labels.get("tpumounter.io/owner", ""))
+            owner_ns = (slave.annotations.get("tpumounter.io/owner-namespace")
+                        or slave.labels.get("tpumounter.io/owner-namespace", ""))
             owner_uid = slave.labels.get("tpumounter.io/owner-uid", "")
             if not owner or not owner_ns:
                 continue  # not ours / hand-made pod: leave it alone

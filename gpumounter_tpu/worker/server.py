@@ -153,18 +153,47 @@ class TpuMountService:
                     return api.RemoveTPUResponse(
                         remove_tpu_result=api.RemoveTPUResult.TPUBusy)
 
-        slaves = self.allocator.slave_pods_holding(pod, devices)
+        unmounted: list = []
         try:
             for dev in devices:
                 self.mounter.unmount(target, dev, force=request.force)
+                unmounted.append(dev)
         except TpuBusyError:
+            # Free what was already unmounted before the busy hit —
+            # otherwise those chips stay revoked from the pod yet booked
+            # to slave pods the reaper will never touch.
+            self._release_slaves_for(devices, unmounted)
             return api.RemoveTPUResponse(
                 remove_tpu_result=api.RemoveTPUResult.TPUBusy)
         except MountError as exc:
+            self._release_slaves_for(devices, unmounted)
             context.abort(grpc.StatusCode.INTERNAL, str(exc))
-        self.allocator.delete_slave_pods(slaves)
+        self._release_slaves_for(devices, unmounted)
         return api.RemoveTPUResponse(
             remove_tpu_result=api.RemoveTPUResult.Success)
+
+    def _release_slaves_for(self, requested: list, unmounted: list) -> None:
+        """Delete slave pods whose every requested chip was unmounted.
+
+        A slave still holding a mounted chip (entire-mount partial failure)
+        must keep its booking — deleting it would free chips the container
+        still has kernel access to.
+        """
+        if not unmounted:
+            return
+        unmounted_keys = {d.uuid for d in unmounted}
+        by_slave: dict[str, list] = {}
+        for dev in requested:
+            by_slave.setdefault(dev.pod_name, []).append(dev)
+        releasable = [slave for slave, devs in by_slave.items()
+                      if all(d.uuid in unmounted_keys for d in devs)]
+        if not releasable:
+            return
+        try:
+            self.allocator.delete_slave_pods(sorted(releasable))
+        except SlavePodError as exc:
+            logger.error("slave pod release failed (capacity stays booked "
+                         "until retry/reap): %s", exc)
 
 
 def build_server(service: TpuMountService, port: int | None = None,

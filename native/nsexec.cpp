@@ -1,0 +1,154 @@
+// tpumounter-nsexec: enter a container's mount namespace and manage device
+// nodes with direct syscalls.
+//
+// Replaces the reference's shell-outs (pkg/util/namespace/namespace.go):
+//   nsenter --target PID --mount sh -c "mknod -m 666 /dev/nvidiaN c 195 N"
+//     (namespace.go:167-177)
+//   nsenter ... sh -c "rm /dev/nvidiaN"          (namespace.go:179-189)
+//   nsenter ... sh -c "kill -9 PID..."           (namespace.go:191-201)
+// which require sh + mknod binaries INSIDE the target container
+// (docs/guide/FAQ.md) and build command strings for a shell. This helper
+// needs nothing in the target: setns(2) + mknod(2)/chmod(2)/unlink(2) +
+// kill(2), argv-only.
+//
+// Usage (argv, no shell anywhere):
+//   tpumounter-nsexec mknod <pid> <path> <major> <minor> <mode-octal>
+//   tpumounter-nsexec rm    <pid> <path>
+//   tpumounter-nsexec kill  <pid> <signal> <pid1> [pid2...]
+//   tpumounter-nsexec stat  <pid> <path>          (prints "major minor")
+//
+// <pid> selects the target mount namespace via /proc/<pid>/ns/mnt. For
+// `kill`, PIDs are host-view (the worker runs with hostPID: true, like the
+// reference's DaemonSet, gpu-mounter-workers.yaml:16-51) so no pid-ns entry
+// is needed; <pid> is accepted for interface symmetry.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <csignal>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+[[noreturn]] void die(const char* what) {
+  std::fprintf(stderr, "nsexec: %s: %s\n", what, std::strerror(errno));
+  std::exit(1);
+}
+
+[[noreturn]] void usage() {
+  std::fprintf(stderr,
+               "usage: tpumounter-nsexec mknod <pid> <path> <major> <minor> "
+               "<mode-octal>\n"
+               "       tpumounter-nsexec rm <pid> <path>\n"
+               "       tpumounter-nsexec kill <pid> <signal> <pid1> [...]\n"
+               "       tpumounter-nsexec stat <pid> <path>\n");
+  std::exit(2);
+}
+
+long parse_long(const char* s, const char* what, int base = 10) {
+  char* end = nullptr;
+  errno = 0;
+  long v = std::strtol(s, &end, base);
+  if (errno != 0 || end == s || *end != '\0') {
+    std::fprintf(stderr, "nsexec: bad %s: %s\n", what, s);
+    std::exit(2);
+  }
+  return v;
+}
+
+// Join the mount namespace of `pid`. pid 0 = stay in our own.
+void enter_mount_ns(long pid) {
+  if (pid == 0) return;
+  char path[64];
+  std::snprintf(path, sizeof(path), "/proc/%ld/ns/mnt", pid);
+  int fd = open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0) die("open target ns");
+  if (setns(fd, CLONE_NEWNS) != 0) die("setns(CLONE_NEWNS)");
+  close(fd);
+}
+
+int cmd_mknod(int argc, char** argv) {
+  if (argc != 5) usage();
+  long pid = parse_long(argv[0], "pid");
+  const char* path = argv[1];
+  long major_n = parse_long(argv[2], "major");
+  long minor_n = parse_long(argv[3], "minor");
+  long mode = parse_long(argv[4], "mode", 8);
+  enter_mount_ns(pid);
+  dev_t dev = makedev(static_cast<unsigned>(major_n),
+                      static_cast<unsigned>(minor_n));
+  if (mknod(path, static_cast<mode_t>(mode) | S_IFCHR, dev) != 0) {
+    if (errno == EEXIST) {
+      // Idempotent when the existing node matches (re-mount after crash).
+      struct stat st{};
+      if (stat(path, &st) == 0 && S_ISCHR(st.st_mode) && st.st_rdev == dev)
+        return 0;
+      errno = EEXIST;
+    }
+    die("mknod");
+  }
+  // mknod mode is umask-masked; chmod to the requested bits.
+  if (chmod(path, static_cast<mode_t>(mode)) != 0) die("chmod");
+  return 0;
+}
+
+int cmd_rm(int argc, char** argv) {
+  if (argc != 2) usage();
+  long pid = parse_long(argv[0], "pid");
+  const char* path = argv[1];
+  enter_mount_ns(pid);
+  if (unlink(path) != 0 && errno != ENOENT) die("unlink");
+  return 0;
+}
+
+int cmd_kill(int argc, char** argv) {
+  if (argc < 3) usage();
+  // argv[0] is the ns pid (unused: PIDs are host-view under hostPID).
+  int sig = static_cast<int>(parse_long(argv[1], "signal"));
+  int rc = 0;
+  for (int i = 2; i < argc; i++) {
+    long target = parse_long(argv[i], "pid");
+    if (kill(static_cast<pid_t>(target), sig) != 0 && errno != ESRCH) {
+      std::fprintf(stderr, "nsexec: kill %ld: %s\n", target,
+                   std::strerror(errno));
+      rc = 1;
+    }
+  }
+  return rc;
+}
+
+int cmd_stat(int argc, char** argv) {
+  if (argc != 2) usage();
+  long pid = parse_long(argv[0], "pid");
+  const char* path = argv[1];
+  enter_mount_ns(pid);
+  struct stat st{};
+  if (stat(path, &st) != 0) die("stat");
+  if (!S_ISCHR(st.st_mode) && !S_ISBLK(st.st_mode)) {
+    std::fprintf(stderr, "nsexec: %s is not a device node\n", path);
+    return 1;
+  }
+  std::printf("%u %u\n", major(st.st_rdev), minor(st.st_rdev));
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) usage();
+  const char* cmd = argv[1];
+  argc -= 2;
+  argv += 2;
+  if (std::strcmp(cmd, "mknod") == 0) return cmd_mknod(argc, argv);
+  if (std::strcmp(cmd, "rm") == 0) return cmd_rm(argc, argv);
+  if (std::strcmp(cmd, "kill") == 0) return cmd_kill(argc, argv);
+  if (std::strcmp(cmd, "stat") == 0) return cmd_stat(argc, argv);
+  usage();
+}

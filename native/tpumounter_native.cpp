@@ -1,0 +1,297 @@
+// libtpumounter_native: the framework's native (C++) host/kernel boundary.
+//
+// TPU-native replacement for the reference's only native component, the NVML
+// cgo binding (pkg/util/gpu/collector/nvml/: dlopen of libnvidia-ml.so.1 at
+// nvml_dl.go:29-36 wrapping device enumeration and per-device process
+// queries). TPUs need no driver library for any of this; the kernel
+// interfaces suffice:
+//
+//   tpm_enum_accel()        — /dev/accel* readdir + stat(2) (replaces
+//                             nvmlDeviceGetCount/MinorNumber/UUID,
+//                             nvml.go:83-119; majors from st_rdev, never
+//                             hardcoded — reference hardcodes 195)
+//   tpm_scan_device_holders() — /proc/<pid>/fd scan by rdev/path (replaces
+//                             GetComputeRunningProcesses, nvml.go:33-52)
+//   tpm_bpf_*               — cgroup-v2 BPF_PROG_TYPE_CGROUP_DEVICE
+//                             load/attach/detach/query via bpf(2); same
+//                             allow-list program the Python assembler
+//                             builds (gpumounter_tpu/cgroup/ebpf.py)
+//   tpm_libtpu_probe()      — optional dlopen probe of libtpu.so (runtime-
+//                             optional linkage, like the reference's
+//                             --unresolved-symbols trick, bindings.go:20)
+//
+// Exposed as a plain C ABI for ctypes (gpumounter_tpu/native.py); no
+// pybind11 in the image.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <dirent.h>
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/sysmacros.h>
+#include <unistd.h>
+
+#include <linux/bpf.h>
+
+extern "C" {
+
+// ---------- device enumeration ----------
+
+struct TpmDevice {
+  int32_t index;
+  uint32_t major_num;
+  uint32_t minor_num;
+  char path[256];
+};
+
+// Fills out[0..max); returns count found (possibly > max; caller re-calls
+// with a larger buffer) or -errno.
+int tpm_enum_accel(const char* dev_dir, TpmDevice* out, int max_out) {
+  DIR* dir = opendir(dev_dir);
+  if (!dir) return -errno;
+  int count = 0;
+  struct dirent* ent;
+  while ((ent = readdir(dir)) != nullptr) {
+    int index = -1;
+    if (sscanf(ent->d_name, "accel%d", &index) != 1 || index < 0) continue;
+    char path[512];
+    std::snprintf(path, sizeof(path), "%s/%s", dev_dir, ent->d_name);
+    struct stat st{};
+    if (stat(path, &st) != 0 || !S_ISCHR(st.st_mode)) continue;
+    if (count < max_out) {
+      out[count].index = index;
+      out[count].major_num = major(st.st_rdev);
+      out[count].minor_num = minor(st.st_rdev);
+      size_t cap = sizeof(out[count].path);
+      std::memcpy(out[count].path, path,
+                  std::strlen(path) < cap ? std::strlen(path) + 1 : cap);
+      out[count].path[cap - 1] = '\0';
+    }
+    count++;
+  }
+  closedir(dir);
+  return count;
+}
+
+// ---------- busy detection ----------
+
+// PIDs holding an open fd whose target is the device (by rdev when
+// want_major/minor >= 0, and/or by exact link path). Returns count
+// (possibly > max_out) or -errno on /proc open failure.
+int tpm_scan_device_holders(int64_t want_major, int64_t want_minor,
+                            const char* path_hint, const char* proc_root,
+                            int32_t* out_pids, int max_out) {
+  const char* root = proc_root && *proc_root ? proc_root : "/proc";
+  DIR* proc = opendir(root);
+  if (!proc) return -errno;
+  dev_t want_rdev = 0;
+  bool match_rdev = want_major >= 0 && want_minor >= 0;
+  if (match_rdev)
+    want_rdev = makedev(static_cast<unsigned>(want_major),
+                        static_cast<unsigned>(want_minor));
+  bool match_path = path_hint && *path_hint;
+  int count = 0;
+  struct dirent* pent;
+  while ((pent = readdir(proc)) != nullptr) {
+    char* end = nullptr;
+    long pid = std::strtol(pent->d_name, &end, 10);
+    if (end == pent->d_name || *end != '\0') continue;
+    char fd_dir_path[300];
+    std::snprintf(fd_dir_path, sizeof(fd_dir_path), "%s/%ld/fd", root, pid);
+    DIR* fd_dir = opendir(fd_dir_path);
+    if (!fd_dir) continue;
+    struct dirent* fent;
+    bool hit = false;
+    while (!hit && (fent = readdir(fd_dir)) != nullptr) {
+      if (fent->d_name[0] == '.') continue;
+      char fd_path[640];
+      std::snprintf(fd_path, sizeof(fd_path), "%s/%s", fd_dir_path,
+                    fent->d_name);
+      if (match_rdev) {
+        struct stat st{};
+        if (stat(fd_path, &st) == 0 && S_ISCHR(st.st_mode) &&
+            st.st_rdev == want_rdev)
+          hit = true;
+      }
+      if (!hit && match_path) {
+        char link[512];
+        ssize_t n = readlink(fd_path, link, sizeof(link) - 1);
+        if (n > 0) {
+          link[n] = '\0';
+          if (std::strcmp(link, path_hint) == 0) hit = true;
+        }
+      }
+    }
+    closedir(fd_dir);
+    if (hit) {
+      if (count < max_out) out_pids[count] = static_cast<int32_t>(pid);
+      count++;
+    }
+  }
+  closedir(proc);
+  return count;
+}
+
+// ---------- cgroup-v2 device eBPF ----------
+
+static long sys_bpf(int cmd, union bpf_attr* attr, unsigned size) {
+  return syscall(__NR_bpf, cmd, attr, size);
+}
+
+struct TpmDeviceRule {
+  uint32_t dev_type;   // BPF_DEVCG_DEV_CHAR / _BLOCK; 0 = any
+  int64_t major_num;   // -1 = any
+  int64_t minor_num;   // -1 = any
+  uint32_t access;     // BPF_DEVCG_ACC_* mask
+};
+
+namespace {
+
+struct Insn {
+  uint8_t op, regs;
+  int16_t off;
+  int32_t imm;
+};
+
+void emit(Insn* insns, int* n, uint8_t op, uint8_t dst, uint8_t src,
+          int16_t off, int32_t imm) {
+  insns[*n] = Insn{op, static_cast<uint8_t>((src << 4) | dst), off, imm};
+  (*n)++;
+}
+
+}  // namespace
+
+// Builds + loads the allow-list program (same logic as ebpf.py
+// build_device_program); returns prog fd or -errno.
+int tpm_bpf_load_device_prog(const TpmDeviceRule* rules, int n_rules,
+                             char* log_buf, int log_len) {
+  // 6 prologue + up to 8 per rule + 2 epilogue
+  int cap = 6 + n_rules * 8 + 2;
+  Insn* insns = static_cast<Insn*>(std::calloc(cap, sizeof(Insn)));
+  if (!insns) return -ENOMEM;
+  int n = 0;
+  // r2 = ctx->access_type; r3 = r2 >> 16 (access); r2 &= 0xFFFF (type)
+  emit(insns, &n, 0x61, 2, 1, 0, 0);
+  emit(insns, &n, 0xBF, 3, 2, 0, 0);
+  emit(insns, &n, 0x77, 3, 0, 0, 16);
+  emit(insns, &n, 0x57, 2, 0, 0, 0xFFFF);
+  emit(insns, &n, 0x61, 4, 1, 4, 0);   // r4 = major
+  emit(insns, &n, 0x61, 5, 1, 8, 0);   // r5 = minor
+  for (int i = 0; i < n_rules; i++) {
+    const TpmDeviceRule& r = rules[i];
+    int guards = (r.dev_type != 0) + (r.major_num >= 0) + (r.minor_num >= 0);
+    int tail = 5;
+    int g = 0;
+    if (r.dev_type != 0)
+      emit(insns, &n, 0x55, 2, 0,
+           static_cast<int16_t>(guards - (++g) + tail),
+           static_cast<int32_t>(r.dev_type));
+    if (r.major_num >= 0)
+      emit(insns, &n, 0x55, 4, 0,
+           static_cast<int16_t>(guards - (++g) + tail),
+           static_cast<int32_t>(r.major_num));
+    if (r.minor_num >= 0)
+      emit(insns, &n, 0x55, 5, 0,
+           static_cast<int16_t>(guards - (++g) + tail),
+           static_cast<int32_t>(r.minor_num));
+    emit(insns, &n, 0xBF, 6, 3, 0, 0);                       // mov r6, r3
+    emit(insns, &n, 0x57, 6, 0, 0,
+         static_cast<int32_t>(~r.access));                   // and r6, ~mask
+    emit(insns, &n, 0x55, 6, 0, 2, 0);                       // jne r6,0,+2
+    emit(insns, &n, 0xB7, 0, 0, 0, 1);                       // mov r0, 1
+    emit(insns, &n, 0x95, 0, 0, 0, 0);                       // exit
+  }
+  emit(insns, &n, 0xB7, 0, 0, 0, 0);
+  emit(insns, &n, 0x95, 0, 0, 0, 0);
+
+  union bpf_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.prog_type = BPF_PROG_TYPE_CGROUP_DEVICE;
+  attr.insn_cnt = static_cast<uint32_t>(n);
+  attr.insns = reinterpret_cast<uint64_t>(insns);
+  static const char license[] = "Apache-2.0";
+  attr.license = reinterpret_cast<uint64_t>(license);
+  if (log_buf && log_len > 0) {
+    attr.log_level = 1;
+    attr.log_size = static_cast<uint32_t>(log_len);
+    attr.log_buf = reinterpret_cast<uint64_t>(log_buf);
+  }
+  std::snprintf(attr.prog_name, sizeof(attr.prog_name), "tpumounter_dev");
+  long fd = sys_bpf(BPF_PROG_LOAD, &attr, sizeof(attr));
+  int saved = errno;
+  std::free(insns);
+  return fd >= 0 ? static_cast<int>(fd) : -saved;
+}
+
+int tpm_bpf_attach(int cgroup_fd, int prog_fd, uint32_t flags) {
+  union bpf_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.target_fd = static_cast<uint32_t>(cgroup_fd);
+  attr.attach_bpf_fd = static_cast<uint32_t>(prog_fd);
+  attr.attach_type = BPF_CGROUP_DEVICE;
+  attr.attach_flags = flags;
+  return sys_bpf(BPF_PROG_ATTACH, &attr, sizeof(attr)) == 0 ? 0 : -errno;
+}
+
+int tpm_bpf_detach(int cgroup_fd, int prog_fd) {
+  union bpf_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.target_fd = static_cast<uint32_t>(cgroup_fd);
+  attr.attach_bpf_fd = static_cast<uint32_t>(prog_fd);
+  attr.attach_type = BPF_CGROUP_DEVICE;
+  return sys_bpf(BPF_PROG_DETACH, &attr, sizeof(attr)) == 0 ? 0 : -errno;
+}
+
+// Returns count of attached device progs (ids in out, up to max) or -errno.
+int tpm_bpf_query(int cgroup_fd, uint32_t* out_ids, int max_out) {
+  union bpf_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.query.target_fd = static_cast<uint32_t>(cgroup_fd);
+  attr.query.attach_type = BPF_CGROUP_DEVICE;
+  attr.query.prog_ids = reinterpret_cast<uint64_t>(out_ids);
+  attr.query.prog_cnt = static_cast<uint32_t>(max_out);
+  if (sys_bpf(BPF_PROG_QUERY, &attr, sizeof(attr)) != 0) return -errno;
+  return static_cast<int>(attr.query.prog_cnt);
+}
+
+int tpm_bpf_prog_get_fd_by_id(uint32_t prog_id) {
+  union bpf_attr attr;
+  std::memset(&attr, 0, sizeof(attr));
+  attr.prog_id = prog_id;
+  long fd = sys_bpf(BPF_PROG_GET_FD_BY_ID, &attr, sizeof(attr));
+  return fd >= 0 ? static_cast<int>(fd) : -errno;
+}
+
+// ---------- libtpu probe ----------
+
+// Runtime-optional driver linkage (reference: dlopen of libnvidia-ml,
+// nvml_dl.go:29-36). Reports loadability + which known entry symbols exist.
+// Never calls into libtpu (initializing it would grab the chip lock).
+int tpm_libtpu_probe(const char* path, char* out_info, int out_len) {
+  const char* lib = path && *path ? path : "libtpu.so";
+  void* h = dlopen(lib, RTLD_LAZY | RTLD_LOCAL);
+  if (!h) {
+    std::snprintf(out_info, out_len, "unavailable: %s", dlerror());
+    return 0;
+  }
+  const char* symbols[] = {"GetPjrtApi", "TpuDriver_Open",
+                           "SE_GetTpuPlatform"};
+  char found[128] = "";
+  for (const char* sym : symbols) {
+    if (dlsym(h, sym)) {
+      if (*found) std::strncat(found, ",", sizeof(found) - strlen(found) - 1);
+      std::strncat(found, sym, sizeof(found) - strlen(found) - 1);
+    }
+  }
+  std::snprintf(out_info, out_len, "loaded: %s symbols=[%s]", lib, found);
+  dlclose(h);
+  return 1;
+}
+
+}  // extern "C"

@@ -76,6 +76,24 @@ def test_slave_pod_ownership_labels(cluster, allocator):
         "kubernetes.io/hostname": cluster.node_name}
 
 
+def test_long_owner_pod_name(cluster, allocator):
+    """A 250-char owner name must still allocate: labels are truncated,
+    the UID label is authoritative, full name lives in annotations."""
+    long_name = "x" * 250
+    owner = cluster.add_target_pod(long_name)
+    devices, slaves = allocator.get_available_tpus(owner, 1, 1)
+    assert len(devices) == 1
+    slave = cluster.kube.get_pod(cluster.cfg.pool_namespace, slaves[0])
+    assert len(slave["metadata"]["name"]) <= 253
+    labels = slave["metadata"]["labels"]
+    assert len(labels["tpumounter.io/owner"]) <= 63
+    assert slave["metadata"]["annotations"]["tpumounter.io/owner"] == long_name
+    assert labels["tpumounter.io/owner-uid"] == owner.uid
+    # removal still finds the slave-held chip via the UID label
+    got = allocator.get_remove_tpus(owner, [], entire_mount=True)
+    assert [d.uuid for d in got] == [devices[0].uuid]
+
+
 def test_no_cross_namespace_crosstalk(cluster, allocator):
     """Same-named pods in different namespaces never see each other's
     slave-held chips (name-prefix matching in the reference cross-talks)."""

@@ -1,0 +1,66 @@
+"""Deployment manifest invariants (reference ships manifests untested)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def _load(name):
+    with open(os.path.join(DEPLOY, name)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def test_all_manifests_parse():
+    for name in os.listdir(DEPLOY):
+        docs = _load(name)
+        assert docs, name
+        for doc in docs:
+            assert doc.get("apiVersion") and doc.get("kind"), (name, doc)
+
+
+def test_worker_daemonset_privileges():
+    (ds,) = _load("worker-daemonset.yaml")
+    spec = ds["spec"]["template"]["spec"]
+    assert spec["hostPID"] is True
+    container = spec["containers"][0]
+    assert container["securityContext"]["privileged"] is True
+    mounts = {m["mountPath"] for m in container["volumeMounts"]}
+    # reference hostPaths (gpu-mounter-workers.yaml:40-51) + /dev for accel
+    assert {"/sys/fs/cgroup", "/var/lib/kubelet/pod-resources",
+            "/dev"} <= mounts
+    assert spec["nodeSelector"] == {"tpu-mounter-enable": "enable"}
+
+
+def test_rbac_not_cluster_admin():
+    docs = _load("rbac.yaml")
+    for doc in docs:
+        if doc["kind"] == "ClusterRoleBinding":
+            assert doc["roleRef"]["name"] != "cluster-admin"
+    kinds = {d["kind"] for d in docs}
+    assert {"ServiceAccount", "ClusterRole", "ClusterRoleBinding", "Role",
+            "RoleBinding"} <= kinds
+
+
+def test_pool_namespace_matches_config():
+    (ns,) = _load("namespace.yaml")
+    from gpumounter_tpu.config import Config
+    assert ns["metadata"]["name"] == Config().pool_namespace
+
+
+def test_master_service_port_mapping():
+    (svc,) = _load("service.yaml")
+    port = svc["spec"]["ports"][0]
+    assert (port["port"], port["targetPort"]) == (80, 8080)
+
+
+def test_deploy_sh_usage():
+    proc = subprocess.run([os.path.join(REPO, "deploy.sh")],
+                          capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert "deploy|redeploy|uninstall" in proc.stderr

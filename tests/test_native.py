@@ -1,0 +1,150 @@
+"""Native layer tests: build artifacts, nsexec behavior, scanner parity.
+
+The reference's native boundary (NVML cgo) is untestable without a GPU
+driver (nvml_test.go needs ≥3 real GPUs); ours tests hermetically — nsexec
+runs against our own mount namespace, the scanner against our own /proc.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+NSEXEC = os.path.join(NATIVE_DIR, "build", "tpumounter-nsexec")
+NATIVE_LIB = os.path.join(NATIVE_DIR, "build", "libtpumounter_native.so")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                   capture_output=True)
+
+
+def test_nsexec_usage_exit_code():
+    proc = subprocess.run([NSEXEC], capture_output=True)
+    assert proc.returncode == 2
+
+
+def test_nsexec_mknod_rm_own_ns(tmp_path):
+    """pid = our own: setns into our own mount ns, then mknod/stat/rm."""
+    if os.geteuid() != 0:
+        pytest.skip("needs CAP_MKNOD/CAP_SYS_ADMIN")
+    pid = str(os.getpid())
+    node = str(tmp_path / "accel9")
+    null = os.stat("/dev/null")
+    major, minor = os.major(null.st_rdev), os.minor(null.st_rdev)
+    subprocess.run([NSEXEC, "mknod", pid, node, str(major), str(minor),
+                    "666"], check=True, capture_output=True)
+    st = os.stat(node)
+    assert oct(st.st_mode & 0o777) == "0o666"
+    assert os.major(st.st_rdev) == major
+    # idempotent re-mknod of an identical node succeeds
+    subprocess.run([NSEXEC, "mknod", pid, node, str(major), str(minor),
+                    "666"], check=True, capture_output=True)
+    # stat subcommand reports major minor
+    out = subprocess.run([NSEXEC, "stat", pid, node], check=True,
+                         capture_output=True, text=True).stdout.split()
+    assert out == [str(major), str(minor)]
+    subprocess.run([NSEXEC, "rm", pid, node], check=True, capture_output=True)
+    assert not os.path.exists(node)
+    # rm of a missing node is idempotent
+    subprocess.run([NSEXEC, "rm", pid, node], check=True, capture_output=True)
+
+
+def test_nsexec_kill():
+    proc = subprocess.Popen(["sleep", "60"])
+    try:
+        subprocess.run([NSEXEC, "kill", "0", "9", str(proc.pid)],
+                       check=True, capture_output=True)
+        assert proc.wait(timeout=5) == -9
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_native_scanner_matches_python(tmp_path):
+    """Native /proc scanner and the Python fallback agree."""
+    from gpumounter_tpu import native
+    from gpumounter_tpu.device import backend as be
+
+    native.reset_for_tests()
+    lib = native.load_native()
+    assert lib is not None, "native lib should load after build"
+
+    target = tmp_path / "probe-file"
+    target.write_text("x")
+    holder = open(target, "rb")
+    try:
+        want = str(target)
+        got_native = native.scan_device_holders(None, None, path_hint=want)
+        assert os.getpid() in got_native
+        # pure-python path (bypass native) must agree
+        pids = []
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            fd_dir = f"/proc/{entry}/fd"
+            try:
+                for fd in os.listdir(fd_dir):
+                    try:
+                        if os.readlink(f"{fd_dir}/{fd}") == want:
+                            pids.append(int(entry))
+                            break
+                    except OSError:
+                        pass
+            except OSError:
+                continue
+        assert sorted(got_native) == sorted(pids)
+    finally:
+        holder.close()
+
+
+def test_native_enum_accel(tmp_path):
+    from gpumounter_tpu import native
+    native.reset_for_tests()
+    if os.geteuid() != 0:
+        pytest.skip("needs mknod")
+    null = os.stat("/dev/null")
+    for i in (0, 1, 3):
+        os.mknod(str(tmp_path / f"accel{i}"), 0o666 | 0o020000, null.st_rdev)
+    (tmp_path / "not-a-device").write_text("x")
+    got = native.enum_accel(str(tmp_path))
+    assert got is not None
+    assert sorted(d[0] for d in got) == [0, 1, 3]
+    for _, major, minor, path in got:
+        assert (major, minor) == (os.major(null.st_rdev),
+                                  os.minor(null.st_rdev))
+        assert os.path.exists(path)
+
+
+def test_libtpu_probe_reports():
+    from gpumounter_tpu import native
+    native.reset_for_tests()
+    report = native.libtpu_probe()
+    # either loadable (TPU VM) or a clean unavailable report — never raises
+    assert report.startswith(("loaded:", "unavailable:"))
+
+
+def test_nsexec_via_nsutil(tmp_path, monkeypatch):
+    """nsutil drives nsexec end-to-end with pid set (own namespace)."""
+    if os.geteuid() != 0:
+        pytest.skip("needs CAP_MKNOD/CAP_SYS_ADMIN")
+    from gpumounter_tpu.device.tpu import TpuDevice
+    from gpumounter_tpu.nsutil import ns as nsutil
+
+    null = os.stat("/dev/null")
+    dev = TpuDevice(index=0, device_path="/dev/null",
+                    major=os.major(null.st_rdev),
+                    minor=os.minor(null.st_rdev), uuid="probe")
+    created = nsutil.inject_device_file(str(tmp_path), dev, pid=os.getpid())
+    st = os.stat(created)
+    assert os.major(st.st_rdev) == dev.major
+    nsutil.remove_device_file(str(tmp_path), dev, pid=os.getpid())
+    assert not os.path.exists(created)
