@@ -48,6 +48,25 @@ def run_config1_device_layer(n_chips: int = 4) -> float:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def measure_jax_rebuild_ms() -> float | None:
+    """Tenant half of the north star: PJRT backend teardown + re-enumerate
+    so a running JAX process observes the new chip set (jaxside). Measured
+    on whatever platform is live (real TPU on the bench host)."""
+    try:
+        import jax
+
+        jax.devices()  # pay first-init outside the timed window
+        from gpumounter_tpu.jaxside import refresh_devices
+
+        t0 = time.monotonic()
+        n = refresh_devices()
+        ms = (time.monotonic() - t0) * 1000.0
+        assert n >= 1
+        return ms
+    except Exception:
+        return None
+
+
 def main() -> None:
     try:
         from bench_e2e import run_config1_full_stack  # full worker+master path
@@ -59,6 +78,15 @@ def main() -> None:
         # rather than silently reporting the cheaper device-layer number.
         value = run_config1_full_stack()
         metric = "hot_mount_latency_4chips_e2e"
+    if metric == "hot_mount_latency_4chips_e2e":
+        # Only the full-stack number may be promoted to the north-star
+        # metric — never the device-layer fallback.
+        rebuild_ms = measure_jax_rebuild_ms()
+        if rebuild_ms is not None:
+            # Full north-star loop: control-plane hot-mount + tenant-side
+            # backend rebuild to jax.device_count() visibility.
+            value += rebuild_ms
+            metric = "hot_mount_to_jax_visible_4chips"
     print(json.dumps({
         "metric": metric,
         "value": round(value, 3),

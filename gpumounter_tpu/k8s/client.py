@@ -80,6 +80,11 @@ class KubeClient(abc.ABC):
         """Yield (event_type, pod_json) until timeout. Types: ADDED/MODIFIED/DELETED."""
         ...
 
+    def create_event(self, namespace: str, manifest: dict) -> dict:
+        """Post a core/v1 Event. Best-effort surface; default no-op so
+        non-cluster deployments (CLI local mode) need nothing."""
+        return {}
+
     # --- composed helper used by the allocator ---
 
     def wait_for_pod(self, namespace: str, name: str, predicate,
@@ -202,6 +207,10 @@ class RestKubeClient(KubeClient):
                        query={"gracePeriodSeconds": grace_period_seconds})
         except NotFoundError:
             pass
+
+    def create_event(self, namespace: str, manifest: dict) -> dict:
+        return self._json("POST", f"/api/v1/namespaces/{namespace}/events",
+                          body=manifest)
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:

@@ -57,6 +57,7 @@ class FakeKubeClient(KubeClient):
         self.scheduler_delay_s = scheduler_delay_s
         self.create_calls = 0
         self.delete_calls = 0
+        self.events_posted: list[tuple[str, dict]] = []
 
     # --- event plumbing ---
 
@@ -166,6 +167,11 @@ class FakeKubeClient(KubeClient):
                 yield etype, copy.deepcopy(pod)
             if time.monotonic() >= deadline:
                 return
+
+    def create_event(self, namespace: str, manifest: dict) -> dict:
+        with self._lock:
+            self.events_posted.append((namespace, copy.deepcopy(manifest)))
+        return manifest
 
     # --- test helpers ---
 

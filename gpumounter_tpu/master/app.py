@@ -99,6 +99,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/healthz$"), "healthz"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/workers$"), "workers"),
+    ("POST", re.compile(r"^/addslice$"), "addslice"),
+    ("POST", re.compile(r"^/removeslice$"), "removeslice"),
 ]
 
 
@@ -169,6 +171,67 @@ class MasterApp:
 
     def _route_metrics(self, match, body, headers):
         return 200, "text/plain; version=0.0.4", REGISTRY.render()
+
+    def _parse_slice_body(self, body: bytes):
+        import json as jsonlib
+
+        from gpumounter_tpu.master.slice_ops import SliceTarget
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        raw = payload.get("pods")
+        if not isinstance(raw, list) or not raw:
+            raise _HttpError(400, 'JSON body needs "pods": '
+                                  '[{"namespace": ..., "pod": ...}, ...]')
+        targets = []
+        for entry in raw:
+            if not isinstance(entry, dict):
+                raise _HttpError(400, f"pods entries must be objects "
+                                      f'{{"namespace", "pod"}}: {entry!r}')
+            ns = entry.get("namespace", "default")
+            pod = entry.get("pod")
+            if not pod:
+                raise _HttpError(400, f"pods entry missing 'pod': {entry}")
+            targets.append(SliceTarget(namespace=ns, pod=pod))
+        return payload, targets
+
+    def _slice_coordinator(self):
+        from gpumounter_tpu.master.slice_ops import SliceCoordinator
+        return SliceCoordinator(self.kube, self.registry,
+                                self._client_factory, self.cfg)
+
+    def _route_addslice(self, match, body, headers):
+        import json as jsonlib
+
+        from gpumounter_tpu.master.slice_ops import SliceError
+        payload, targets = self._parse_slice_body(body)
+        try:
+            chips = int(payload.get("chipsPerHost", 4))
+        except (TypeError, ValueError):
+            raise _HttpError(400, f"Invalid chipsPerHost: "
+                                  f"{payload.get('chipsPerHost')!r}")
+        if chips <= 0:
+            raise _HttpError(400, f"Invalid chipsPerHost: {chips}")
+        entire = bool(payload.get("isEntireMount", True))
+        try:
+            plan = self._slice_coordinator().mount_slice(targets, chips,
+                                                         entire)
+        except SliceError as exc:
+            raise _HttpError(exc.status, str(exc))
+        return 200, "application/json", jsonlib.dumps(plan, indent=1) + "\n"
+
+    def _route_removeslice(self, match, body, headers):
+        import json as jsonlib
+
+        from gpumounter_tpu.master.slice_ops import SliceError
+        payload, targets = self._parse_slice_body(body)
+        force = bool(payload.get("force", False))
+        try:
+            outcome = self._slice_coordinator().remove_slice(targets, force)
+        except SliceError as exc:
+            raise _HttpError(exc.status, str(exc))
+        return 200, "application/json", jsonlib.dumps(outcome) + "\n"
 
     def _route_workers(self, match, body, headers):
         # Worker registry endpoint (no reference analog): node → worker IP.

@@ -1,0 +1,207 @@
+"""Multi-host pod-slice coordination (BASELINE config 5, stretch).
+
+No reference analog: GPUMounter mounts one pod on one node per request. A
+TPU pod-slice (e.g. v5e-16) spans hosts whose chips are joined by ICI, so
+hot-attaching a slice means mounting on EVERY host's pod coherently and
+handing the tenant a consistent topology before `jax.distributed` re-init
+(SURVEY.md §7 hard part #3). The coordinator:
+
+  1. fans AddTPU out to each target pod's node-worker in parallel,
+  2. rolls back every successful mount if any host fails (all-or-nothing —
+     a partially-attached slice is useless: collectives would hang),
+  3. returns a per-worker topology-env plan (TPU_WORKER_ID,
+     TPU_WORKER_HOSTNAMES, TPU_CHIPS_PER_HOST_BOUNDS, TPU_HOST_BOUNDS)
+     that tenants feed to jaxside.set_topology_env + reinit_distributed.
+
+The worker-id order is the order of `pods` in the request — the caller
+fixes it (it must match the job's process ranks).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("master.slice")
+
+
+class SliceError(RuntimeError):
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclass(frozen=True)
+class SliceTarget:
+    namespace: str
+    pod: str
+
+
+# Simplified v5e-style physical layouts per chips-per-host count.
+_CHIP_BOUNDS = {1: "1,1,1", 2: "1,2,1", 4: "2,2,1", 8: "2,4,1"}
+
+
+def topology_plan(targets: list[SliceTarget], nodes: list[str],
+                  chips_per_host: int) -> dict:
+    """Env plan per worker: what each host's tenant should export before
+    backend re-init. Hostnames are the pod names (headless-service style
+    DNS is the caller's concern)."""
+    hostnames = ",".join(t.pod for t in targets)
+    chip_bounds = _CHIP_BOUNDS.get(chips_per_host,
+                                   f"1,{chips_per_host},1")
+    plan = {
+        "slice": {
+            "num_hosts": len(targets),
+            "total_chips": chips_per_host * len(targets),
+            "TPU_HOST_BOUNDS": f"{len(targets)},1,1",
+            "TPU_CHIPS_PER_HOST_BOUNDS": chip_bounds,
+            "TPU_WORKER_HOSTNAMES": hostnames,
+        },
+        "workers": [
+            {
+                "namespace": t.namespace,
+                "pod": t.pod,
+                "node": node,
+                "env": {
+                    "TPU_WORKER_ID": str(i),
+                    "TPU_WORKER_HOSTNAMES": hostnames,
+                    "TPU_CHIPS_PER_HOST_BOUNDS": chip_bounds,
+                    "TPU_HOST_BOUNDS": f"{len(targets)},1,1",
+                },
+            }
+            for i, (t, node) in enumerate(zip(targets, nodes))
+        ],
+    }
+    return plan
+
+
+class SliceCoordinator:
+    def __init__(self, kube, registry, client_factory, cfg):
+        self.kube = kube
+        self.registry = registry
+        self.client_factory = client_factory
+        self.cfg = cfg
+
+    def _resolve(self, targets: list[SliceTarget]) -> list[tuple[SliceTarget, str, str]]:
+        """[(target, node, worker_address)]; validates every pod first."""
+        out = []
+        seen_nodes: dict[str, SliceTarget] = {}
+        for t in targets:
+            try:
+                pod = Pod(self.kube.get_pod(t.namespace, t.pod))
+            except NotFoundError:
+                raise SliceError(
+                    f"No pod: {t.pod} in namespace: {t.namespace}", 404)
+            if not pod.node_name:
+                raise SliceError(f"Pod {t.pod} is not scheduled yet", 400)
+            if pod.node_name in seen_nodes:
+                raise SliceError(
+                    f"pods {seen_nodes[pod.node_name].pod} and {t.pod} are "
+                    f"on the same node {pod.node_name}; a slice needs one "
+                    "pod per host", 400)
+            seen_nodes[pod.node_name] = t
+            address = self.registry.worker_address(pod.node_name)
+            if address is None:
+                raise SliceError(
+                    f"no tpumounter worker on node {pod.node_name}", 500)
+            out.append((t, pod.node_name, address))
+        return out
+
+    def mount_slice(self, targets: list[SliceTarget], chips_per_host: int,
+                    entire: bool = True) -> dict:
+        if len(targets) < 1:
+            raise SliceError("empty slice", 400)
+        resolved = self._resolve(targets)
+        results: dict[int, tuple[api.AddTPUResult, list[str]] | Exception] = {}
+
+        def _mount(i: int, address: str, t: SliceTarget) -> None:
+            try:
+                with self.client_factory(address) as client:
+                    results[i] = client.add_tpu_detailed(
+                        t.pod, t.namespace, chips_per_host, entire)
+            except Exception as exc:  # noqa: BLE001 — per-host gRPC boundary
+                results[i] = exc
+
+        threads = [threading.Thread(target=_mount, args=(i, addr, t),
+                                    daemon=True)
+                   for i, (t, _, addr) in enumerate(resolved)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+
+        failures = {i: r for i, r in results.items()
+                    if not (isinstance(r, tuple)
+                            and r[0] == api.AddTPUResult.Success)}
+        if failures:
+            succeeded = [i for i in results if i not in failures]
+            logger.error("slice mount failed on %d/%d host(s); rolling "
+                         "back %d", len(failures), len(targets),
+                         len(succeeded))
+            for i in succeeded:
+                t, _, addr = resolved[i]
+                _, mounted_uuids = results[i]  # type: ignore[misc]
+                try:
+                    with self.client_factory(addr) as client:
+                        # Remove exactly what THIS operation mounted —
+                        # empty uuids would no-op on single-mounts and
+                        # over-remove pre-existing entire-mounts.
+                        client.remove_tpu(t.pod, t.namespace,
+                                          mounted_uuids, force=True)
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("slice rollback on %s failed: %s",
+                                 t.pod, exc)
+            def _fmt(r):
+                return r[0].name if isinstance(r, tuple) else str(r)
+            detail = "; ".join(
+                f"{resolved[i][0].pod}: {_fmt(r)}"
+                for i, r in failures.items())
+            insufficient = any(
+                isinstance(r, tuple)
+                and r[0] == api.AddTPUResult.InsufficientTPU
+                for r in failures.values())
+            # 503: capacity exhaustion is retryable-after-scale-up and
+            # must be distinguishable from an internal fault.
+            raise SliceError(f"slice mount failed ({detail})",
+                             503 if insufficient else 500)
+        nodes = [node for _, node, _ in resolved]
+        plan = topology_plan(targets, nodes, chips_per_host)
+        logger.info("slice mounted: %d host(s) × %d chip(s)",
+                    len(targets), chips_per_host)
+        return plan
+
+    def remove_slice(self, targets: list[SliceTarget],
+                     force: bool = False) -> dict:
+        resolved = self._resolve(targets)
+        results = {}
+
+        def _remove(i: int, address: str, t: SliceTarget) -> None:
+            try:
+                with self.client_factory(address) as client:
+                    results[i] = client.remove_tpu(t.pod, t.namespace, [],
+                                                   force=force,
+                                                   remove_all=True)
+            except Exception as exc:  # noqa: BLE001
+                results[i] = exc
+
+        threads = [threading.Thread(target=_remove, args=(i, addr, t),
+                                    daemon=True)
+                   for i, (t, _, addr) in enumerate(resolved)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        outcome = {
+            resolved[i][0].pod: (r.name if isinstance(r, api.RemoveTPUResult)
+                                 else f"error: {r}")
+            for i, r in results.items()}
+        bad = [p for p, r in outcome.items()
+               if r not in ("Success", "TPUNotFound")]
+        if bad:
+            raise SliceError(f"slice remove incomplete: {outcome}", 500)
+        return {"removed": outcome}

@@ -1,0 +1,127 @@
+"""Ring attention: sequence/context parallelism over a named mesh axis.
+
+Long-context support for workloads running on hot-mounted chip sets
+(SURVEY.md §2b: the reference has no compute stack at all; our tenant-side
+obligation is that the chips we mount are *usable* for modern workloads,
+and long sequences are the canonical reason to hot-add chips mid-job).
+
+TPU-first design: the sequence axis is sharded over a mesh axis; each
+device holds a Q/K/V chunk and K/V chunks rotate around the ring with
+`jax.lax.ppermute` — XLA lowers this to neighbor-to-neighbor ICI transfers
+that overlap with the per-chunk attention compute. Softmax is combined
+online (flash-attention style running max/denominator), so memory stays
+O(chunk²) instead of O(seq²) and no device ever materializes the full
+attention matrix.
+
+No NCCL/MPI analog anywhere: the collective IS the jax primitive
+(scaling-book recipe: mesh + shardings + XLA collectives).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _chunk_attention(q, k, v, q_pos, k_pos, m_prev, num_prev, den_prev,
+                     scale, causal):
+    """One ring step of online-softmax attention.
+
+    q: (B, H, Lq, D); k/v: (B, H, Lk, D); positions are global indices for
+    causal masking. Accumulators: m (B,H,Lq,1), num (B,H,Lq,D),
+    den (B,H,Lq,1) — combined across steps in fp32.
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_chunk = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_chunk)
+    # Fully-masked rows produce -inf maxima; keep exp() finite.
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - m_safe)
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m_prev), -jnp.inf,
+                                   m_prev - m_safe))
+    correction = jnp.where(jnp.isneginf(m_prev), 0.0, correction)
+    num_new = num_prev * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    den_new = den_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+    return m_new, num_new, den_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
+                          causal: bool):
+    """Per-device body (runs under shard_map). Shapes are local chunks:
+    q/k/v (B, H, L_local, D); returns (B, H, L_local, D)."""
+    n_dev = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    chunk = q.shape[2]
+    q_pos = my_idx * chunk + jnp.arange(chunk)
+
+    b, h, lq, d = q.shape
+    m0 = jnp.full((b, h, lq, 1), -jnp.inf, jnp.float32)
+    num0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    den0 = jnp.zeros((b, h, lq, 1), jnp.float32)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def step(carry, s):
+        k_cur, v_cur, m, num, den = carry
+        # K/V chunk currently held originated on device (my_idx - s) mod n.
+        src = (my_idx - s) % n_dev
+        k_pos = src * chunk + jnp.arange(chunk)
+        m, num, den = _chunk_attention(q, k_cur, v_cur, q_pos, k_pos,
+                                       m, num, den, scale, causal)
+        # Rotate K/V to the next device; overlaps with next-step compute
+        # after XLA schedules the ICI DMA.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, num, den), None
+
+    (k, v, m, num, den), _ = jax.lax.scan(
+        step, (k, v, m0, num0, den0), jnp.arange(n_dev))
+    out = num / jnp.maximum(den, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   *, seq_axis: str = "seq", causal: bool = True,
+                   scale: float | None = None) -> jax.Array:
+    """Sequence-parallel attention over `mesh`'s `seq_axis`.
+
+    q, k, v: (batch, heads, seq, head_dim), sharded (or shardable) with
+    the sequence dimension split over `seq_axis`. Returns same shape/
+    sharding. Use inside jit; XLA emits ppermute ICI transfers.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    spec = P(None, None, seq_axis, None)
+    body = partial(_ring_attention_local, axis_name=seq_axis, scale=scale,
+                   causal=causal)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: float | None = None) -> jax.Array:
+    """Single-device O(L²) attention; the correctness oracle for tests."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        l_q, l_k = q.shape[2], k.shape[2]
+        mask = jnp.arange(l_k)[None, :] <= jnp.arange(l_q)[:, None]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def shard_qkv(x: jax.Array, mesh: Mesh, seq_axis: str = "seq") -> jax.Array:
+    """Place a (B, H, L, D) array with L split over the mesh's seq axis."""
+    return jax.device_put(
+        x, NamedSharding(mesh, P(None, None, seq_axis, None)))

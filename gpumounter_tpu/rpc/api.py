@@ -43,19 +43,28 @@ class AddTPURequest(Message):
 
 
 class AddTPUResponse(Message):
-    # Reference: AddGPUResponse (api.proto:11-19)
+    # Reference: AddGPUResponse (api.proto:11-19). Field 2 is our
+    # extension: the device ids actually mounted, so callers (the slice
+    # coordinator's rollback in particular) can undo exactly this
+    # operation. Wire-compatible — proto3 decoders skip unknown fields,
+    # so clients built against the reference proto still work.
     FIELDS = [
         Field(1, "add_tpu_result", "enum"),
+        Field(2, "uuids", "string", repeated=True),
     ]
 
 
 class RemoveTPURequest(Message):
     # Reference: RemoveGPURequest (api.proto:25-30); uuids -> device ids.
+    # Field 5 is our extension: remove every slave-held chip regardless of
+    # mount type (the slice coordinator's remove path; wire-compatible —
+    # legacy peers skip the unknown field and see reference semantics).
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
         Field(3, "uuids", "string", repeated=True),
         Field(4, "force", "bool"),
+        Field(5, "remove_all", "bool"),
     ]
 
 

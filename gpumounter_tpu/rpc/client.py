@@ -44,14 +44,23 @@ class WorkerClient:
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
                 is_entire_mount: bool = False) -> api.AddTPUResult:
+        result, _ = self.add_tpu_detailed(pod_name, namespace, tpu_num,
+                                          is_entire_mount)
+        return result
+
+    def add_tpu_detailed(self, pod_name: str, namespace: str, tpu_num: int,
+                         is_entire_mount: bool = False,
+                         ) -> tuple[api.AddTPUResult, list[str]]:
+        """(result, mounted device uuids) — uuids empty unless Success."""
         resp = self._add(api.AddTPURequest(
             pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
             is_entire_mount=is_entire_mount), timeout=self.timeout_s)
-        return api.AddTPUResult(resp.add_tpu_result)
+        return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
-                   force: bool = False) -> api.RemoveTPUResult:
+                   force: bool = False,
+                   remove_all: bool = False) -> api.RemoveTPUResult:
         resp = self._remove(api.RemoveTPURequest(
             pod_name=pod_name, namespace=namespace, uuids=list(uuids),
-            force=force), timeout=self.timeout_s)
+            force=force, remove_all=remove_all), timeout=self.timeout_s)
         return api.RemoveTPUResult(resp.remove_tpu_result)

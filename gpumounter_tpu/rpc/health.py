@@ -1,0 +1,58 @@
+"""Standard gRPC health service (grpc.health.v1.Health/Check).
+
+The reference's worker has no health surface at all (SURVEY.md §5: "the
+gRPC server has no health service"); kubelet/gRPC-aware probes expect this
+exact protocol. Messages ride our wire codec — no grpcio-health-checking
+dependency.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gpumounter_tpu.rpc.wire import Field, Message
+
+SERVICE = "grpc.health.v1.Health"
+
+SERVING = 1
+NOT_SERVING = 2
+SERVICE_UNKNOWN = 3
+
+
+class HealthCheckRequest(Message):
+    FIELDS = [Field(1, "service", "string")]
+
+
+class HealthCheckResponse(Message):
+    FIELDS = [Field(1, "status", "enum")]
+
+
+def add_health_service(server: grpc.Server,
+                       known_services: set[str] | None = None) -> None:
+    known = known_services or set()
+
+    def check(request: HealthCheckRequest, context) -> HealthCheckResponse:
+        if request.service and known and request.service not in known:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"unknown service {request.service}")
+        return HealthCheckResponse(status=SERVING)
+
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE,
+        {"Check": grpc.unary_unary_rpc_method_handler(
+            check,
+            request_deserializer=HealthCheckRequest.decode,
+            response_serializer=lambda m: m.encode())})
+    server.add_generic_rpc_handlers((handler,))
+
+
+def check_health(address: str, service: str = "",
+                 timeout_s: float = 5.0) -> int:
+    """Client-side Check; returns the status enum value."""
+    with grpc.insecure_channel(address) as channel:
+        stub = channel.unary_unary(
+            f"/{SERVICE}/Check",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=HealthCheckResponse.decode)
+        resp = stub(HealthCheckRequest(service=service), timeout=timeout_s)
+        return resp.status

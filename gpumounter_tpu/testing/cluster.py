@@ -1,18 +1,21 @@
-"""FakeCluster: one simulated TPU node, end-to-end testable in-process.
+"""FakeCluster: simulated TPU node(s), end-to-end testable in-process.
 
 Wires together:
-  * FakeDeviceBackend — N fake chips in a tmp dir (null-backed char devices
-    when privileged, regular files otherwise)
-  * FakeKubeletServer — real gRPC pod-resources server on a unix socket
-  * FakeKubeClient — API-server fake whose scheduler hook emulates the GKE
-    TPU device plugin: pods requesting `google.com/tpu` get free chips
-    assigned (atomically, under a lock), are marked Running, and their
-    claims appear in the fake kubelet; when chips run out the pod goes
-    Unschedulable — exactly the signal the allocator maps to
-    InsufficientTPU (reference allocator.go:262-270). Deletion frees chips.
+  * FakeDeviceBackend per node — fake chips in a tmp dir (null-backed char
+    devices when privileged, regular files otherwise)
+  * FakeKubeletServer per node — real gRPC pod-resources server on a unix
+    socket
+  * one shared FakeKubeClient — API-server fake whose scheduler hook
+    emulates the GKE TPU device plugin: pods requesting `google.com/tpu`
+    are placed on a node with free chips (honoring a
+    kubernetes.io/hostname nodeSelector), get chips assigned atomically
+    under a lock, are marked Running, and their claims appear in that
+    node's fake kubelet; when no node fits, the pod goes Unschedulable —
+    exactly the signal the allocator maps to InsufficientTPU (reference
+    allocator.go:262-270). Deletion frees chips.
 
-This is the substrate for BASELINE configs 1 and 4 (dry-run and contended
-add/remove) with no Kubernetes anywhere.
+Single-node form is BASELINE configs 1/4; the multi-node form underpins
+config 5 (pod-slice coordination across hosts).
 """
 
 from __future__ import annotations
@@ -27,107 +30,168 @@ from gpumounter_tpu.k8s.fake import FakeKubeClient
 from gpumounter_tpu.k8s.types import Pod
 
 
+class _FakeNode:
+    def __init__(self, root: str, name: str, n_chips: int,
+                 kubelet_versions: tuple[str, ...]):
+        self.name = name
+        self.fake_device_dir = os.path.join(root, name, "host-dev")
+        self.kubelet_socket = os.path.join(root, name, "kubelet.sock")
+        os.makedirs(os.path.dirname(self.kubelet_socket), exist_ok=True)
+        self.backend = FakeDeviceBackend.create(self.fake_device_dir, n_chips)
+        self.kubelet = FakeKubeletServer(self.kubelet_socket,
+                                         versions=kubelet_versions)
+        # chip id (device-plugin view) -> (namespace, pod) or None
+        self.assignment: dict[str, tuple[str, str] | None] = {
+            str(d.index): None for d in self.backend.list_devices()}
+
+    def free_ids(self) -> list[str]:
+        return sorted((cid for cid, o in self.assignment.items()
+                       if o is None), key=int)
+
+
 class FakeCluster:
     def __init__(self, root: str, n_chips: int = 4,
                  node_name: str = "tpu-node-0",
+                 nodes: dict[str, int] | None = None,
                  scheduler_delay_s: float = 0.0,
                  kubelet_versions: tuple[str, ...] = ("v1",),
                  cfg: Config | None = None):
         self.root = root
-        self.node_name = node_name
-        self.cfg = (cfg or Config()).replace(
-            fake_device_dir=os.path.join(root, "host-dev"),
-            kubelet_socket=os.path.join(root, "kubelet.sock"),
-            slave_pod_timeout_s=10.0,
-        )
-        self.backend = FakeDeviceBackend.create(self.cfg.fake_device_dir,
-                                                n_chips)
-        self.kubelet = FakeKubeletServer(self.cfg.kubelet_socket,
-                                         versions=kubelet_versions)
+        if nodes is None:
+            nodes = {node_name: n_chips}
+        self._nodes = {name: _FakeNode(root, name, count, kubelet_versions)
+                       for name, count in nodes.items()}
+        self.node_name = next(iter(self._nodes))  # primary (single-node API)
+        base = (cfg or Config()).replace(slave_pod_timeout_s=10.0)
+        self.cfg = self.node_cfg(self.node_name, base)
         self._alloc_lock = threading.Lock()
-        # chip id (device-plugin view) -> (namespace, pod) or None
-        self._assignment: dict[str, tuple[str, str] | None] = {
-            str(d.index): None for d in self.backend.list_devices()}
         self.kube = FakeKubeClient(scheduler_hook=self._schedule,
                                    delete_hook=self._reap,
                                    scheduler_delay_s=scheduler_delay_s)
+
+    # --- per-node views ---
+
+    def node(self, name: str | None = None) -> _FakeNode:
+        return self._nodes[name or self.node_name]
+
+    def node_cfg(self, name: str | None = None,
+                 base: Config | None = None) -> Config:
+        node = self.node(name)
+        return (base or self.cfg).replace(
+            fake_device_dir=node.fake_device_dir,
+            kubelet_socket=node.kubelet_socket,
+            slave_pod_timeout_s=10.0)
+
+    @property
+    def backend(self):
+        return self.node().backend
+
+    @property
+    def kubelet(self):
+        return self.node().kubelet
+
+    @property
+    def node_names(self) -> list[str]:
+        return list(self._nodes)
 
     # --- device-plugin + scheduler emulation ---
 
     def _tpu_request(self, pod: dict) -> int:
         return Pod(pod).resource_limit(self.cfg.tpu_resource_name)
 
+    def _pick_node(self, pod: Pod, want: int) -> _FakeNode | None:
+        """Placement honoring nodeSelector; else first node that fits.
+        Caller holds _alloc_lock."""
+        selector = (pod.obj.get("spec", {}).get("nodeSelector") or {}).get(
+            "kubernetes.io/hostname")
+        candidates = ([self._nodes[selector]]
+                      if selector in self._nodes else
+                      [] if selector else list(self._nodes.values()))
+        for node in candidates:
+            if len(node.free_ids()) >= want:
+                return node
+        return None
+
     def _schedule(self, pod: dict) -> None:
         p = Pod(pod)
         want = self._tpu_request(pod)
         if want == 0:
-            pod.setdefault("spec", {}).setdefault("nodeName", self.node_name)
+            selector = (pod.get("spec", {}).get("nodeSelector") or {}).get(
+                "kubernetes.io/hostname")
+            pod.setdefault("spec", {}).setdefault(
+                "nodeName", selector or self.node_name)
             pod.setdefault("status", {})["phase"] = "Running"
             return
         with self._alloc_lock:
-            free = [cid for cid, owner in self._assignment.items()
-                    if owner is None]
-            if len(free) < want:
+            node = self._pick_node(p, want)
+            if node is None:
                 pod.setdefault("status", {}).update({
                     "phase": "Pending",
                     "conditions": [{
                         "type": "PodScheduled", "status": "False",
                         "reason": "Unschedulable",
-                        "message": f"0/1 nodes available: insufficient "
+                        "message": f"0/{len(self._nodes)} nodes available: "
+                                   f"insufficient "
                                    f"{self.cfg.tpu_resource_name}",
                     }]})
                 return
-            assigned = sorted(free, key=int)[:want]
+            assigned = node.free_ids()[:want]
             for cid in assigned:
-                self._assignment[cid] = (p.namespace, p.name)
-            self.kubelet.set_claim(p.name, p.namespace,
+                node.assignment[cid] = (p.namespace, p.name)
+            node.kubelet.set_claim(p.name, p.namespace,
                                    self.cfg.tpu_resource_name, assigned)
-        pod.setdefault("spec", {})["nodeName"] = self.node_name
+        pod.setdefault("spec", {})["nodeName"] = node.name
         pod.setdefault("status", {})["phase"] = "Running"
 
     def _reap(self, pod: dict) -> None:
         p = Pod(pod)
         with self._alloc_lock:
-            for cid, owner in list(self._assignment.items()):
-                if owner == (p.namespace, p.name):
-                    self._assignment[cid] = None
-            self.kubelet.claims = [
-                c for c in self.kubelet.claims
-                if not (c[0] == p.name and c[1] == p.namespace)]
+            for node in self._nodes.values():
+                for cid, owner in list(node.assignment.items()):
+                    if owner == (p.namespace, p.name):
+                        node.assignment[cid] = None
+                node.kubelet.claims = [
+                    c for c in node.kubelet.claims
+                    if not (c[0] == p.name and c[1] == p.namespace)]
 
     # --- convenience ---
 
-    def free_chip_count(self) -> int:
+    def free_chip_count(self, node: str | None = None) -> int:
         with self._alloc_lock:
-            return sum(1 for o in self._assignment.values() if o is None)
+            if node is not None:
+                return len(self._nodes[node].free_ids())
+            return sum(len(n.free_ids()) for n in self._nodes.values())
 
     def add_target_pod(self, name: str, namespace: str = "default",
-                       uid: str | None = None) -> Pod:
+                       uid: str | None = None,
+                       node: str | None = None) -> Pod:
         """A running workload pod (no TPU request) to hot-mount into."""
         manifest = {
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": name, "namespace": namespace,
                          **({"uid": uid} if uid else {})},
-            "spec": {"containers": [{"name": "main", "image": "app"}]},
+            "spec": {"containers": [{"name": "main", "image": "app"}],
+                     **({"nodeSelector": {"kubernetes.io/hostname": node}}
+                        if node else {})},
         }
-        created = self.kube.create_pod(namespace, manifest)
-        # containerStatuses so resolve_target has container IDs
+        self.kube.create_pod(namespace, manifest)
         self.kube.set_pod_status(namespace, name, containerStatuses=[{
             "name": "main",
             "containerID": f"containerd://{name}-cid",
             "state": {"running": {}},
         }])
-        deadline = 5.0
         pod = self.kube.wait_for_pod(
             namespace, name,
             lambda pj: pj is not None and Pod(pj).phase == "Running",
-            timeout_s=deadline)
+            timeout_s=5.0)
         assert pod is not None, f"target pod {name} did not reach Running"
         return Pod(self.kube.get_pod(namespace, name))
 
     def start(self) -> "FakeCluster":
-        self.kubelet.start()
+        for node in self._nodes.values():
+            node.kubelet.start()
         return self
 
     def stop(self) -> None:
-        self.kubelet.stop()
+        for node in self._nodes.values():
+            node.kubelet.stop()

@@ -81,6 +81,10 @@ class SlaveReaper:
         return deleted
 
     def _loop(self) -> None:
+        # Immediate first pass = startup reconciliation: a worker restart
+        # may have missed owner deletions (the reference has no
+        # reconciliation at all, SURVEY.md §5).
+        self.reap_once()
         while not self._stop.wait(self.interval_s):
             self.reap_once()
 

@@ -112,9 +112,16 @@ class TpuMountService:
                     logger.error("rollback unmount of %s failed: %s",
                                  dev.uuid, undo_exc)
             self.allocator.delete_slave_pods(slaves, wait=False)
+            self._post_event(pod, "TPUMountFailed", str(exc), "Warning")
             context.abort(grpc.StatusCode.INTERNAL, str(exc))
         logger.info("AddTPU done: %s", timer.summary_ms())
-        return api.AddTPUResponse(add_tpu_result=api.AddTPUResult.Success)
+        self._post_event(
+            pod, "TPUMounted",
+            f"hot-mounted {len(devices)} TPU chip(s): "
+            f"{', '.join(d.uuid for d in devices)} "
+            f"(phases ms: {timer.summary_ms()})")
+        return api.AddTPUResponse(add_tpu_result=api.AddTPUResult.Success,
+                                  uuids=[d.uuid for d in devices])
 
     # --- RemoveTPU (reference: server.go:101-179) ---
 
@@ -129,7 +136,8 @@ class TpuMountService:
                 remove_tpu_result=api.RemoveTPUResult.PodNotFound)
 
         self.collector.update_status()  # one refresh for the whole request
-        entire = self.allocator.get_mount_type(pod, refresh=False) == \
+        entire = request.remove_all or \
+            self.allocator.get_mount_type(pod, refresh=False) == \
             MountType.ENTIRE
         devices = self.allocator.get_remove_tpus(pod, request.uuids, entire,
                                                  refresh=False)
@@ -169,8 +177,44 @@ class TpuMountService:
             self._release_slaves_for(devices, unmounted)
             context.abort(grpc.StatusCode.INTERNAL, str(exc))
         self._release_slaves_for(devices, unmounted)
+        self._post_event(
+            pod, "TPUUnmounted",
+            f"hot-removed {len(unmounted)} TPU chip(s): "
+            f"{', '.join(d.uuid for d in unmounted)}"
+            + (" (forced)" if request.force else ""))
         return api.RemoveTPUResponse(
             remove_tpu_result=api.RemoveTPUResult.Success)
+
+    def _post_event(self, pod: Pod, reason: str, message: str,
+                    event_type: str = "Normal") -> None:
+        """Surface mount/unmount outcomes as k8s Events on the target pod
+        (the reference writes logs only — SURVEY.md §5 'no events on the
+        Pod'). Best-effort: failures are logged, never raised."""
+        import secrets as _secrets
+        import time as _time
+
+        ts = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{pod.name[:200]}.tpumounter.{_secrets.token_hex(4)}",
+                "namespace": pod.namespace,
+            },
+            "involvedObject": {"kind": "Pod", "name": pod.name,
+                               "namespace": pod.namespace, "uid": pod.uid},
+            "reason": reason,
+            "message": message[:1024],
+            "type": event_type,
+            "source": {"component": "tpumounter-worker"},
+            "firstTimestamp": ts,
+            "lastTimestamp": ts,
+            "count": 1,
+        }
+        try:
+            self.kube.create_event(pod.namespace, manifest)
+        except Exception as exc:  # noqa: BLE001 — events are advisory
+            logger.debug("event post failed: %s", exc)
 
     def _release_slaves_for(self, requested: list, unmounted: list) -> None:
         """Delete slave pods whose every requested chip was unmounted.
@@ -226,6 +270,8 @@ def build_server(service: TpuMountService, port: int | None = None,
     for service_name, methods in registrations.items():
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(service_name, methods),))
+    from gpumounter_tpu.rpc.health import add_health_service
+    add_health_service(server, known_services=set(registrations) | {""})
 
     if address:
         server.bound_port = server.add_insecure_port(address)

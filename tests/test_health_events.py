@@ -1,0 +1,70 @@
+"""gRPC health service + k8s Event emission (SURVEY.md §5 gaps the
+reference leaves open: no health surface, no events on the Pod)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.rpc.health import SERVING, check_health
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    dev_dir = tmp_path / "cdev"
+    dev_dir.mkdir()
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(dev_dir), description=pod.name)
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cluster.cfg)
+    server = build_server(service, address="localhost:0")
+    server.start()
+    yield cluster, f"localhost:{server.bound_port}", service
+    server.stop(grace=None)
+    cluster.stop()
+
+
+def test_health_check_serving(stack):
+    _, addr, _ = stack
+    assert check_health(addr) == SERVING
+    assert check_health(addr, "tpu_mount.AddTPUService") == SERVING
+
+
+def test_health_unknown_service(stack):
+    import grpc
+    _, addr, _ = stack
+    with pytest.raises(grpc.RpcError) as exc:
+        check_health(addr, "nope.Service")
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_mount_emits_event(stack):
+    cluster, addr, service = stack
+    cluster.add_target_pod("trainer")
+    with WorkerClient(addr) as client:
+        assert client.add_tpu("trainer", "default", 2) == \
+            api.AddTPUResult.Success
+        events = [e for _, e in cluster.kube.events_posted]
+        mounted = [e for e in events if e["reason"] == "TPUMounted"]
+        assert len(mounted) == 1
+        assert mounted[0]["involvedObject"]["name"] == "trainer"
+        assert mounted[0]["type"] == "Normal"
+        assert "2 TPU chip(s)" in mounted[0]["message"]
+
+        devices = service.collector.get_pod_devices("trainer", "default")
+        client.remove_tpu("trainer", "default", [d.uuid for d in devices])
+        events = [e for _, e in cluster.kube.events_posted]
+        assert any(e["reason"] == "TPUUnmounted" for e in events)

@@ -1,0 +1,216 @@
+"""Multi-host slice coordination tests (BASELINE config 5 substrate):
+4 fake nodes, 4 workers, one pod per node, coordinated mount/rollback."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.master.app import MasterApp, WorkerRegistry, build_http_server
+from gpumounter_tpu.master.slice_ops import (
+    SliceCoordinator,
+    SliceError,
+    SliceTarget,
+    topology_plan,
+)
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+N_NODES = 4
+
+
+@pytest.fixture()
+def slice_stack(tmp_path):
+    """4-node cluster with one worker gRPC server per node."""
+    nodes = {f"host-{i}": 4 for i in range(N_NODES)}
+    cluster = FakeCluster(str(tmp_path), nodes=nodes).start()
+
+    servers = []
+    port_by_ip = {}
+    services = {}
+    for i, name in enumerate(cluster.node_names):
+        cfg = cluster.node_cfg(name)
+        node = cluster.node(name)
+        collector = TpuCollector(
+            backend=node.backend,
+            podresources=PodResourcesClient(node.kubelet_socket,
+                                            timeout_s=5.0),
+            cfg=cfg)
+        mounter = TpuMounter(node.backend, cfg=cfg)
+        dev_dir = tmp_path / f"container-dev-{name}"
+        dev_dir.mkdir()
+        mounter.resolve_target = (
+            lambda pod, _d=str(dev_dir): MountTarget(
+                dev_dir=_d, description=pod.name))
+        service = TpuMountService(cluster.kube, collector=collector,
+                                  mounter=mounter, cfg=cfg)
+        server = build_server(service, address="localhost:0")
+        server.start()
+        servers.append(server)
+        ip = f"10.0.0.{i + 1}"
+        port_by_ip[ip] = server.bound_port
+        services[name] = (service, str(dev_dir))
+        cluster.kube.create_pod(cfg.worker_namespace, {
+            "metadata": {"name": f"worker-{name}",
+                         "namespace": cfg.worker_namespace,
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": name, "containers": [{"name": "w"}]},
+            "status": {"phase": "Running", "podIP": ip},
+        })
+
+    def client_factory(address: str):
+        ip = address.rsplit(":", 1)[0]
+        return WorkerClient(f"localhost:{port_by_ip[ip]}")
+
+    registry = WorkerRegistry(cluster.kube, cluster.cfg)
+    coordinator = SliceCoordinator(cluster.kube, registry, client_factory,
+                                   cluster.cfg)
+    yield cluster, coordinator, services, client_factory, registry
+    for s in servers:
+        s.stop(grace=None)
+    cluster.stop()
+
+
+def _make_slice_pods(cluster, n=N_NODES):
+    return [
+        (cluster.add_target_pod(f"rank-{i}", node=f"host-{i}"),
+         SliceTarget(namespace="default", pod=f"rank-{i}"))
+        for i in range(n)
+    ]
+
+
+def test_topology_plan_shape():
+    targets = [SliceTarget("default", f"rank-{i}") for i in range(4)]
+    plan = topology_plan(targets, [f"host-{i}" for i in range(4)], 4)
+    assert plan["slice"]["total_chips"] == 16
+    assert plan["slice"]["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert plan["slice"]["TPU_HOST_BOUNDS"] == "4,1,1"
+    assert [w["env"]["TPU_WORKER_ID"] for w in plan["workers"]] == \
+        ["0", "1", "2", "3"]
+    assert all(w["env"]["TPU_WORKER_HOSTNAMES"] ==
+               "rank-0,rank-1,rank-2,rank-3" for w in plan["workers"])
+
+
+def test_mount_slice_all_hosts(slice_stack, tmp_path):
+    cluster, coordinator, services, *_ = slice_stack
+    pods = _make_slice_pods(cluster)
+    plan = coordinator.mount_slice([t for _, t in pods], chips_per_host=4)
+    assert plan["slice"]["num_hosts"] == N_NODES
+    # every node's chips booked, every container sees 4 accel nodes
+    for name, (service, dev_dir) in services.items():
+        assert cluster.free_chip_count(name) == 0
+        import os
+        assert len([f for f in os.listdir(dev_dir)
+                    if f.startswith("accel")]) == 4
+    # coordinated remove frees everything
+    out = coordinator.remove_slice([t for _, t in pods], force=True)
+    assert set(out["removed"].values()) == {"Success"}
+    assert cluster.free_chip_count() == 16
+
+
+def test_mount_slice_all_or_nothing(slice_stack):
+    cluster, coordinator, services, *_ = slice_stack
+    # Occupy host-2 entirely so its rank cannot mount.
+    squatter = cluster.add_target_pod("squatter", node="host-2")
+    with WorkerClient_for(slice_stack, "host-2") as c:
+        from gpumounter_tpu.rpc import api
+        assert c.add_tpu("squatter", "default", 4) == api.AddTPUResult.Success
+    pods = _make_slice_pods(cluster)
+    with pytest.raises(SliceError, match="slice mount failed"):
+        coordinator.mount_slice([t for _, t in pods], chips_per_host=4)
+    # rollback: the other hosts' chips are free again
+    for name in cluster.node_names:
+        if name != "host-2":
+            assert cluster.free_chip_count(name) == 4, name
+
+
+def WorkerClient_for(slice_stack, node_name):
+    cluster, _, services, client_factory, registry = slice_stack
+    return client_factory(registry.worker_address(node_name))
+
+
+def test_single_mount_slice_roundtrip_and_rollback(slice_stack):
+    """Single-mount slices must rollback/remove via the mounted uuids —
+    empty-uuid removal is a no-op for single-mounts."""
+    cluster, coordinator, services, *_ = slice_stack
+    pods = _make_slice_pods(cluster)
+    plan = coordinator.mount_slice([t for _, t in pods], chips_per_host=2,
+                                   entire=False)
+    assert plan["slice"]["total_chips"] == 2 * N_NODES
+    # remove_all path frees single-mounted chips too
+    out = coordinator.remove_slice([t for _, t in pods], force=True)
+    assert set(out["removed"].values()) == {"Success"}
+    assert cluster.free_chip_count() == 16
+
+    # rollback path: occupy one host, single-mount slice must fully undo
+    cluster.add_target_pod("squatter", node="host-3")
+    from gpumounter_tpu.rpc import api
+    with WorkerClient_for(slice_stack, "host-3") as c:
+        assert c.add_tpu("squatter", "default", 4) == api.AddTPUResult.Success
+    with pytest.raises(SliceError):
+        coordinator.mount_slice([t for _, t in pods], chips_per_host=2,
+                                entire=False)
+    for name in cluster.node_names:
+        if name != "host-3":
+            assert cluster.free_chip_count(name) == 4, name
+
+
+def test_insufficient_slice_maps_to_503(slice_stack):
+    cluster, coordinator, *_ = slice_stack
+    pods = _make_slice_pods(cluster)
+    from gpumounter_tpu.rpc import api
+    with WorkerClient_for(slice_stack, "host-1") as c:
+        cluster.add_target_pod("hog", node="host-1")
+        assert c.add_tpu("hog", "default", 4) == api.AddTPUResult.Success
+    with pytest.raises(SliceError) as exc:
+        coordinator.mount_slice([t for _, t in pods], chips_per_host=4)
+    assert exc.value.status == 503
+
+
+def test_slice_requires_distinct_nodes(slice_stack):
+    cluster, coordinator, *_ = slice_stack
+    cluster.add_target_pod("a", node="host-0")
+    cluster.add_target_pod("b", node="host-0")
+    with pytest.raises(SliceError, match="same node"):
+        coordinator.mount_slice([SliceTarget("default", "a"),
+                                 SliceTarget("default", "b")], 1)
+
+
+def test_slice_http_routes(slice_stack, tmp_path):
+    cluster, coordinator, services, client_factory, registry = slice_stack
+    app = MasterApp(cluster.kube, cfg=cluster.cfg,
+                    worker_client_factory=client_factory, registry=registry)
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        _make_slice_pods(cluster)
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": f"rank-{i}"}
+                     for i in range(N_NODES)],
+            "chipsPerHost": 4,
+        }).encode()
+        req = urllib.request.Request(base + "/addslice", data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req) as resp:
+            plan = json.loads(resp.read())
+        assert plan["slice"]["total_chips"] == 16
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": f"rank-{i}"}
+                     for i in range(N_NODES)],
+            "force": True,
+        }).encode()
+        req = urllib.request.Request(base + "/removeslice", data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert set(out["removed"].values()) == {"Success"}
+    finally:
+        httpd.shutdown()
