@@ -1,0 +1,69 @@
+"""Flash-attention kernel micro-benchmark on the live accelerator.
+
+Not part of the driver contract (bench.py is); run by hand to compare the
+Pallas kernel against XLA's materialized attention on real hardware.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpumounter_tpu.ops.flash_attention import (
+    _xla_attention,
+    flash_attention_pallas,
+)
+
+
+ITERS = 20
+
+
+def chained(attn_fn):
+    """Fold ITERS applications into ONE dispatch: over a network-tunneled
+    device, per-call dispatch latency would otherwise swamp the kernel."""
+    def run(q, k, v):
+        def body(carry, _):
+            out = attn_fn(q, k, carry)
+            return out, ()
+        final, _ = jax.lax.scan(body, v, None, length=ITERS)
+        return final
+    return jax.jit(run)
+
+
+def timeit(fn, *args):
+    jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / ITERS * 1000.0
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+    on_tpu = dev.platform == "tpu"
+    b, h, d = 4, 8, 128
+    for l in (1024, 2048, 4096, 8192):
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.3,
+                               jnp.bfloat16) for _ in range(3))
+        scale = 1.0 / (d ** 0.5)
+        xla = chained(lambda q, k, v: _xla_attention(q, k, v, True, scale))
+        flash = chained(lambda q, k, v: flash_attention_pallas(
+            q, k, v, causal=True, scale=scale, interpret=not on_tpu))
+        t_xla = timeit(xla, q, k, v)
+        t_flash = timeit(flash, q, k, v)
+        flops = 4 * b * h * l * l * d / 2  # causal
+        print(f"L={l}: xla {t_xla:7.3f} ms ({flops/t_xla/1e9:6.1f} TFLOP/s)"
+              f" | flash {t_flash:7.3f} ms ({flops/t_flash/1e9:6.1f}"
+              f" TFLOP/s) | speedup {t_xla/t_flash:4.2f}x")
+        got = np.asarray(flash(q, k, v), np.float32)
+        want = np.asarray(xla(q, k, v), np.float32)
+        err = np.abs(got - want).max()
+        print(f"        max |err| vs xla (x{ITERS} chained): {err:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,8 @@ logger = get_logger("cgroup.ebpf")
 
 SYS_BPF = 321  # x86_64
 BPF_PROG_LOAD = 5
+BPF_OBJ_PIN = 6
+BPF_OBJ_GET = 7
 BPF_PROG_ATTACH = 8
 BPF_PROG_DETACH = 9
 BPF_PROG_GET_FD_BY_ID = 13
@@ -255,6 +257,28 @@ def prog_get_fd_by_id(prog_id: int) -> int:
     return fd
 
 
+def obj_pin(path: str, bpf_fd: int) -> None:
+    """Pin a program to bpffs so it survives this process (BPF_OBJ_PIN)."""
+    pathname = ctypes.create_string_buffer(path.encode())
+    attr = struct.pack("<QI", ctypes.addressof(pathname), bpf_fd)
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    if _libc.syscall(SYS_BPF, BPF_OBJ_PIN, buf, len(attr)) < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_OBJ_PIN({path}): {os.strerror(err)}")
+
+
+def obj_get(path: str) -> int:
+    """Re-open a pinned program; returns a new fd (BPF_OBJ_GET)."""
+    pathname = ctypes.create_string_buffer(path.encode())
+    attr = struct.pack("<QI", ctypes.addressof(pathname), 0)
+    buf = ctypes.create_string_buffer(attr, len(attr))
+    fd = _libc.syscall(SYS_BPF, BPF_OBJ_GET, buf, len(attr))
+    if fd < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_OBJ_GET({path}): {os.strerror(err)}")
+    return fd
+
+
 # --- controller ---
 
 @dataclass
@@ -269,16 +293,171 @@ class _CgroupState:
 class V2DeviceController:
     """Hot grant/revoke of device access on cgroup-v2 via program replacement.
 
-    Limitation (documented, reconciliation TODO for a later round): state
-    (original program fds) lives in this process. If the worker restarts
-    between grant and revoke, the original runc program is unrecoverable —
-    `revoke_all` then leaves our program in place rather than breaking the
-    container. The reference has the same class of gap (SURVEY.md §5:
-    "no reconciliation loop").
+    Crash consistency: the fds pinning the container's ORIGINAL (runc)
+    device programs would die with this process, making restoration after
+    a worker restart impossible. So, when a bpffs pin directory is
+    available (TPUMOUNTER_BPF_PIN_DIR, default /sys/fs/bpf/tpumounter),
+    every original program and our replacement are pinned there and the
+    grant bookkeeping is journaled as JSON under TPUMOUNTER_STATE_DIR; a
+    restarted worker re-opens the pins (BPF_OBJ_GET) and can still revoke
+    and restore exactly. Without bpffs the controller degrades to
+    in-process state (the reference has no reconciliation at all,
+    SURVEY.md §5).
     """
 
-    def __init__(self):
+    def __init__(self, pin_dir: str | None = None,
+                 state_dir: str | None = None):
+        if pin_dir is None:
+            pin_dir = os.environ.get("TPUMOUNTER_BPF_PIN_DIR",
+                                     "/sys/fs/bpf/tpumounter")
+        if state_dir is None:
+            state_dir = os.environ.get("TPUMOUNTER_STATE_DIR",
+                                       "/var/lib/tpumounter")
+        self.pin_dir = pin_dir
+        self.state_dir = state_dir
+        self._pinning = self._probe_pin_dir()
         self._state: dict[str, _CgroupState] = {}
+        if self._pinning:
+            self._restore_all()
+
+    # --- persistence ---
+
+    def _probe_pin_dir(self) -> bool:
+        try:
+            os.makedirs(self.pin_dir, exist_ok=True)
+            os.makedirs(self.state_dir, exist_ok=True)
+            return True
+        except OSError as exc:
+            logger.info("bpffs pinning unavailable (%s); v2 grant state "
+                        "is in-process only", exc)
+            return False
+
+    def _key(self, cgroup_dir: str) -> str:
+        import hashlib
+        return hashlib.sha1(cgroup_dir.encode()).hexdigest()[:16]
+
+    def _journal_path(self, cgroup_dir: str) -> str:
+        return os.path.join(self.state_dir, self._key(cgroup_dir) + ".json")
+
+    def _persist(self, cgroup_dir: str, st: _CgroupState) -> None:
+        if not self._pinning:
+            return
+        import json
+        key = self._key(cgroup_dir)
+        try:
+            for i, fd in enumerate(st.original_fds):
+                pin = os.path.join(self.pin_dir, f"{key}-orig-{i}")
+                if not os.path.exists(pin):
+                    obj_pin(pin, fd)
+            ours_pin = os.path.join(self.pin_dir, f"{key}-ours")
+            if st.our_fd is not None:
+                # Pin-new-then-rename: unlinking first would open a crash
+                # window with no ours pin at all, after which a restarted
+                # worker could never detach the replacement program.
+                tmp_pin = ours_pin + ".new"
+                if os.path.exists(tmp_pin):
+                    os.unlink(tmp_pin)
+                obj_pin(tmp_pin, st.our_fd)
+                os.replace(tmp_pin, ours_pin)
+            record = {
+                "cgroup_dir": cgroup_dir,
+                "n_orig": len(st.original_fds),
+                "granted": [[maj, minor, rule.access]
+                            for (maj, minor), rule in st.granted.items()],
+                "base_rules": [[r.type, r.major, r.minor, r.access]
+                               for r in st.base_rules],
+            }
+            with open(self._journal_path(cgroup_dir), "w") as f:
+                json.dump(record, f)
+        except (BpfError, OSError) as exc:
+            logger.warning("cannot persist v2 grant state for %s: %s",
+                           cgroup_dir, exc)
+
+    def _unpersist(self, cgroup_dir: str, n_orig: int) -> None:
+        if not self._pinning:
+            return
+        key = self._key(cgroup_dir)
+        for name in ([f"{key}-orig-{i}" for i in range(n_orig)]
+                     + [f"{key}-ours"]):
+            try:
+                os.unlink(os.path.join(self.pin_dir, name))
+            except FileNotFoundError:
+                pass
+            except OSError as exc:
+                logger.warning("cannot unpin %s: %s", name, exc)
+        try:
+            os.unlink(self._journal_path(cgroup_dir))
+        except OSError:
+            pass
+
+    def _restore_all(self) -> None:
+        """Worker-restart reconciliation: re-open pinned programs."""
+        import json
+        try:
+            entries = os.listdir(self.state_dir)
+        except OSError:
+            return
+        for name in entries:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.state_dir, name)
+            opened: list[int] = []
+            record = None
+            try:
+                with open(path) as f:
+                    record = json.load(f)
+                cgroup_dir = record["cgroup_dir"]
+                key = self._key(cgroup_dir)
+                cgroup_fd = os.open(cgroup_dir, os.O_RDONLY | os.O_DIRECTORY)
+                opened.append(cgroup_fd)
+                original_fds = []
+                for i in range(record["n_orig"]):
+                    fd = obj_get(os.path.join(self.pin_dir,
+                                              f"{key}-orig-{i}"))
+                    opened.append(fd)
+                    original_fds.append(fd)
+                our_fd = None
+                ours_pin = os.path.join(self.pin_dir, f"{key}-ours")
+                if os.path.exists(ours_pin):
+                    our_fd = obj_get(ours_pin)
+                    opened.append(our_fd)
+                granted = {(maj, minor): DeviceRule("c", maj, minor, access)
+                           for maj, minor, access in record["granted"]}
+                base_rules = [DeviceRule(t, maj, minor, access)
+                              for t, maj, minor, access
+                              in record.get("base_rules", [])]
+                self._state[cgroup_dir] = _CgroupState(
+                    cgroup_fd=cgroup_fd, original_fds=original_fds,
+                    our_fd=our_fd, granted=granted, base_rules=base_rules)
+                logger.info("restored v2 grant state for %s (%d grant(s))",
+                            cgroup_dir, len(granted))
+            except (OSError, BpfError, KeyError, ValueError, TypeError) as exc:
+                # Unrestorable (container gone during the outage is the
+                # routine case): release every resource — fds AND the
+                # bpffs pins, else each churn event would permanently pin
+                # kernel BPF programs — then drop the journal.
+                logger.warning("cannot restore v2 state %s: %s; dropping",
+                               path, exc)
+                for fd in opened:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                key = self._key(record["cgroup_dir"]) if (
+                    isinstance(record, dict) and "cgroup_dir" in record
+                ) else name[:-len(".json")]
+                n_orig = (record.get("n_orig", 64)
+                          if isinstance(record, dict) else 64)
+                for pin in ([f"{key}-orig-{i}" for i in range(n_orig)]
+                            + [f"{key}-ours", f"{key}-ours.new"]):
+                    try:
+                        os.unlink(os.path.join(self.pin_dir, pin))
+                    except OSError:
+                        pass
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def _get_state(self, cgroup_dir: str,
                    base_rules: list[DeviceRule] | None) -> _CgroupState:
@@ -347,6 +526,7 @@ class V2DeviceController:
             if not st.granted and st.our_fd is None:
                 self._close_state(cgroup_dir)
             raise
+        self._persist(cgroup_dir, st)
         logger.info("cgroup v2: granted c %d:%d rw on %s",
                     dev.major, dev.minor, cgroup_dir)
 
@@ -358,6 +538,7 @@ class V2DeviceController:
         st.granted.pop((dev.major, dev.minor), None)
         if st.granted:
             self._swap_program(st)
+            self._persist(cgroup_dir, st)
             return
         # Last grant gone: restore the original program set exactly.
         restored = 0
@@ -382,6 +563,7 @@ class V2DeviceController:
                 logger.warning("detach of our device prog failed: %s", exc)
             os.close(st.our_fd)
             st.our_fd = None
+        self._unpersist(cgroup_dir, len(st.original_fds))
         self._close_state(cgroup_dir)
         logger.info("cgroup v2: revoked c %d:%d on %s (restored %d orig prog(s))",
                     dev.major, dev.minor, cgroup_dir, restored)

@@ -180,6 +180,9 @@ class MasterApp:
             payload = jsonlib.loads(body or b"{}")
         except ValueError:
             raise _HttpError(400, "body must be JSON")
+        if not isinstance(payload, dict):
+            raise _HttpError(400, 'body must be a JSON object with a '
+                                  '"pods" list')
         raw = payload.get("pods")
         if not isinstance(raw, list) or not raw:
             raise _HttpError(400, 'JSON body needs "pods": '

@@ -156,6 +156,30 @@ class SliceCoordinator:
                 except Exception as exc:  # noqa: BLE001
                     logger.error("slice rollback on %s failed: %s",
                                  t.pod, exc)
+            # Transport-level failures (timeouts, dropped connections) may
+            # have mounted server-side after the RPC died. For entire-mount
+            # slices an empty-uuid remove is safe and exact: it removes
+            # everything iff the pod ended up entire-mounted (the slice's
+            # mount), and no-ops (TPUNotFound) if the mount never landed —
+            # prior single-mounts are untouched either way.
+            for i, r in failures.items():
+                if not isinstance(r, Exception):
+                    continue  # worker answered: nothing was mounted
+                t, _, addr = resolved[i]
+                if not entire:
+                    logger.error(
+                        "host %s failed at transport level during a "
+                        "single-mount slice; cannot distinguish slice "
+                        "chips from pre-existing ones — manual "
+                        "remove may be needed", t.pod)
+                    continue
+                try:
+                    with self.client_factory(addr) as client:
+                        client.remove_tpu(t.pod, t.namespace, [],
+                                          force=True)
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("post-timeout rollback probe on %s: %s",
+                                   t.pod, exc)
             def _fmt(r):
                 return r[0].name if isinstance(r, tuple) else str(r)
             detail = "; ".join(

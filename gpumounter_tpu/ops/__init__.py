@@ -1,0 +1,5 @@
+"""TPU-native ops: Pallas kernels for the probe workload's hot paths."""
+
+from gpumounter_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,163 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The probe model's attention is the FLOPs hot spot of the tenant workload
+(models/probe.py materializes the full (L, L) score matrix — fine for
+probes, quadratic HBM traffic for real sequence lengths). This kernel
+streams K/V blocks through VMEM with an online-softmax accumulator, so
+HBM traffic is O(L·D) and the (block_q, block_k) score tile lives only in
+VMEM next to the MXU.
+
+Kernel structure (pallas_guide.md patterns): 3-D grid (batch·heads,
+q-blocks, k-blocks); the last grid axis iterates sequentially on TPU, so
+the running max / denominator / output accumulator persist in VMEM scratch
+across k-blocks, initialized at ik==0 and written back at the last ik.
+
+`interpret=True` runs the same kernel on CPU (tests); the public entry
+falls back to an XLA implementation off-TPU so the probe model works
+everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-but-finite: -inf breaks the m==NEG_INF row fixups
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  block_q: int, block_k: int, n_k: int, scale: float,
+                  causal: bool):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal block skip: when every key in this block is strictly in the
+    # future of every query in the q block, the whole step is a no-op —
+    # for nk ≈ nq this halves the work.
+    needed = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]                      # (block_q, d)
+        k = k_ref[0]                      # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
+
+        if causal:
+            q_idx = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_idx = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]                             # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                             # (block_q, block_k)
+        # Rows with every key masked so far: keep accumulators at zero.
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+
+        l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
+        acc_scr[:] = acc
+
+    @pl.when(ik == n_k - 1)
+    def _writeback():
+        denom = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                           causal: bool = True, scale: float | None = None,
+                           block_q: int = 256, block_k: int = 512,
+                           interpret: bool = False) -> jax.Array:
+    """(B, H, L, D) attention via the Pallas kernel. L must divide into
+    blocks; block sizes are clamped to L."""
+    b, h, l, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, l)
+    block_k = min(block_k, l)
+    if l % block_q or l % block_k:
+        raise ValueError(f"seq len {l} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    n_q = l // block_q
+    n_k = l // block_k
+
+    qr = q.reshape(b * h, l, d)
+    kr = k.reshape(b * h, l, d)
+    vr = v.reshape(b * h, l, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
+        scale=scale, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, l, d)
+
+
+def _xla_attention(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        l_q, l_k = q.shape[2], k.shape[2]
+        mask = jnp.arange(l_k)[None, :] <= jnp.arange(l_q)[:, None]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: float | None = None,
+                    backend: str = "auto") -> jax.Array:
+    """Public entry.
+
+    backend: "auto" (XLA — measured FASTER than the Pallas kernel on
+    v5e at L=1k-8k, see bench_flash.py; XLA's own attention fusion is
+    excellent on TPU), or "pallas" to force the hand-written kernel.
+    The Pallas kernel's value is O(L·D) HBM traffic at sequence lengths
+    where the materialized (L, L) scores no longer fit the roofline —
+    and as the in-repo exemplar of the guide's kernel patterns.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if backend == "pallas":
+        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+        return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
+                                      interpret=not on_tpu)
+    return _xla_attention(q, k, v, causal, scale)

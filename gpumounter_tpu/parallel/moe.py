@@ -1,0 +1,105 @@
+"""Mixture-of-Experts FFN with expert parallelism over a mesh axis.
+
+TPU-first: experts are sharded over the "expert" mesh axis with
+NamedSharding; routing uses dense one-hot dispatch/combine einsums
+(Switch-style top-1), so the whole layer is three MXU-friendly einsums and
+XLA inserts the all-to-all/psum collectives implied by the shardings —
+no hand-written communication (scaling-book recipe; SURVEY.md §2b).
+
+Capacity-less formulation: every token's hidden is computed against its
+expert via the dispatch one-hot, which keeps shapes static (XLA-friendly)
+at the cost of E× compute of a capacity router — the right trade for a
+probe/e2e workload whose job is to light up chips, not to train cheaply.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(key: jax.Array, n_experts: int, d_model: int,
+                    d_ff: int, dtype=jnp.bfloat16) -> dict:
+    k_router, k_w1, k_w2 = jax.random.split(key, 3)
+    scale = 0.02
+    return {
+        "router": (jax.random.normal(k_router, (d_model, n_experts),
+                                     jnp.float32) * scale),
+        "w1": (jax.random.normal(k_w1, (n_experts, d_model, d_ff),
+                                 jnp.float32) * scale).astype(dtype),
+        "w2": (jax.random.normal(k_w2, (n_experts, d_ff, d_model),
+                                 jnp.float32) * scale).astype(dtype),
+    }
+
+
+def moe_param_specs() -> dict:
+    """Experts over the "expert" axis; router replicated."""
+    return {
+        "router": P(None, None),
+        "w1": P("expert", None, None),
+        "w2": P("expert", None, None),
+    }
+
+
+def shard_moe_params(params: dict, mesh: Mesh) -> dict:
+    specs = moe_param_specs()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def moe_ffn(params: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Top-1 routed FFN. x: (tokens, d_model) → (tokens, d_model).
+
+    Returns (output, aux_loss) where aux_loss is the Switch load-balancing
+    loss (mean fraction · mean router prob per expert, scaled by E).
+    """
+    n_tokens, d_model = x.shape
+    n_experts = params["router"].shape[1]
+    logits = x.astype(jnp.float32) @ params["router"]      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                # (T,)
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype)  # (T, E)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None],
+                               axis=1).astype(x.dtype)     # (T, 1)
+
+    # dispatch: (E, T, d) — token rows zeroed except at their expert;
+    # sharded einsums put each expert's slice on its own devices.
+    dispatched = jnp.einsum("te,td->etd", onehot, x)
+    h = jnp.einsum("etd,edf->etf", dispatched, params["w1"])
+    h = jax.nn.gelu(h)
+    out_e = jnp.einsum("etf,efd->etd", h, params["w2"])
+    combined = jnp.einsum("etd,te->td", out_e, onehot) * gate
+
+    # Switch aux loss: encourages uniform routing.
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)    # (E,)
+    prob_mean = jnp.mean(probs, axis=0)
+    aux = n_experts * jnp.sum(frac * prob_mean)
+    return combined, aux
+
+
+def make_moe_step(mesh: Mesh, n_experts: int, d_model: int, d_ff: int,
+                  lr: float = 1e-2):
+    """Jitted MoE train step over (data, expert) mesh axes: tokens sharded
+    on "data", experts on "expert"."""
+    specs = moe_param_specs()
+    param_shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    x_sharding = NamedSharding(mesh, P("data", None))
+
+    def loss_fn(params, x, target):
+        out, aux = moe_ffn(params, x)
+        mse = jnp.mean((out.astype(jnp.float32)
+                        - target.astype(jnp.float32)) ** 2)
+        return mse + 0.01 * aux
+
+    def step(params, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, target)
+        params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32)
+                          - lr * g.astype(jnp.float32)).astype(p.dtype),
+            params, grads)
+        return params, loss
+
+    return jax.jit(step,
+                   in_shardings=(param_shardings, x_sharding, x_sharding),
+                   out_shardings=(param_shardings, NamedSharding(mesh, P())))

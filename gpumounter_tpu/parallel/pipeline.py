@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+TPU-first: each device on the "pipe" axis owns one stage's parameters;
+activations move stage-to-stage with `jax.lax.ppermute` (neighbor ICI
+transfers) inside a `lax.fori_loop` over M + P - 1 ticks, all under one
+jit — no host round-trips, static shapes throughout (SURVEY.md §2b: the
+collective is the JAX primitive, not a comm library).
+
+Schedule: at tick t, stage p computes microbatch (t - p) when
+0 ≤ t - p < M: stage 0 feeds itself from the microbatch buffer, later
+stages consume the activation ppermuted from stage p-1 at tick end. The
+last stage scatters its result into the output buffer, which is summed
+across the ring at the end (only the last stage wrote nonzero rows).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
+    """Per-device body under shard_map.
+
+    stage_params: this stage's params, leading axis stripped (block of 1).
+    x_micro: (M, mb, d) — full microbatch buffer, replicated.
+    Returns (M, mb, d) outputs, replicated (psum at the end).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    # shard_map delivers this stage's block with the stage axis kept
+    # (leading size 1); strip it so stage_fn sees plain per-stage params.
+    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    n_micro, mb, d = x_micro.shape
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    out_buf = jnp.zeros_like(x_micro, dtype=jnp.float32)
+    recv = jnp.zeros((mb, d), x_micro.dtype)
+
+    def tick(t, carry):
+        recv, out_buf = carry
+        m = t - stage                      # microbatch index for this stage
+        active = (m >= 0) & (m < n_micro)
+        # Stage 0 reads its own input; others use the received activation.
+        own = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(m, 0, n_micro - 1), axis=0, keepdims=False)
+        x_in = jnp.where(stage == 0, own, recv)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # Last stage records its finished microbatch.
+        is_last = stage == n_stages - 1
+        write_idx = jnp.clip(m, 0, n_micro - 1)
+        contribution = jnp.where(active & is_last,
+                                 y.astype(jnp.float32),
+                                 jnp.zeros_like(y, jnp.float32))
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf,
+            jax.lax.dynamic_index_in_dim(out_buf, write_idx, 0, False)
+            + contribution,
+            write_idx, axis=0)
+        # Rotate activations forward one stage.
+        recv = jax.lax.ppermute(y, axis_name, perm_fwd)
+        return recv, out_buf
+
+    recv, out_buf = jax.lax.fori_loop(
+        0, n_micro + n_stages - 1, tick, (recv, out_buf))
+    # Only the last stage holds real outputs; share them with every stage.
+    return jax.lax.psum(out_buf, axis_name).astype(x_micro.dtype)
+
+
+def pipeline_apply(stage_params, x: jax.Array, mesh: Mesh, stage_fn,
+                   *, n_micro: int, pipe_axis: str = "pipe") -> jax.Array:
+    """Run x (B, d) through P pipeline stages with M microbatches.
+
+    stage_params: pytree whose leaves have a leading stage axis of size P,
+    sharded over `pipe_axis`. stage_fn(params_for_stage, x_mb) -> y_mb.
+    B must divide by n_micro.
+    """
+    b, d = x.shape
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    x_micro = x.reshape(n_micro, b // n_micro, d)
+
+    body = partial(_pipeline_local, stage_fn=stage_fn, axis_name=pipe_axis)
+    param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)
+    y_micro = fn(stage_params, x_micro)
+    return y_micro.reshape(b, d)
+
+
+def shard_stage_params(stage_params, mesh: Mesh, pipe_axis: str = "pipe"):
+    return jax.tree.map(
+        lambda leaf: jax.device_put(
+            leaf, NamedSharding(mesh, P(pipe_axis))), stage_params)

@@ -229,3 +229,34 @@ def test_prog_load_real_kernel():
     fd = prog_load(build_device_program(list(DEFAULT_CONTAINER_RULES)))
     assert fd > 0
     os.close(fd)
+
+
+def _cgroup2_mount() -> str | None:
+    for cand in ("/sys/fs/cgroup", "/sys/fs/cgroup/unified"):
+        if os.path.exists(os.path.join(cand, "cgroup.controllers")):
+            return cand
+    return None
+
+
+@pytest.mark.skipif(os.environ.get("TPUMOUNTER_EBPF_TESTS") != "1",
+                    reason="set TPUMOUNTER_EBPF_TESTS=1 to run kernel eBPF tests")
+def test_attach_cycle_real_cgroup2():
+    """Load → attach → query → detach against a real cgroup2 cgroup."""
+    from gpumounter_tpu.cgroup import ebpf
+    root = _cgroup2_mount()
+    if root is None:
+        pytest.skip("no cgroup2 hierarchy mounted")
+    cgdir = os.path.join(root, "tpumounter-test")
+    os.makedirs(cgdir, exist_ok=True)
+    fd = os.open(cgdir, os.O_RDONLY | os.O_DIRECTORY)
+    prog = ebpf.prog_load(
+        build_device_program(list(DEFAULT_CONTAINER_RULES)))
+    try:
+        ebpf.prog_attach(fd, prog)
+        assert len(ebpf.prog_query(fd)) == 1
+        ebpf.prog_detach(fd, prog)
+        assert ebpf.prog_query(fd) == []
+    finally:
+        os.close(prog)
+        os.close(fd)
+        os.rmdir(cgdir)

@@ -1,0 +1,157 @@
+"""V2DeviceController crash-consistency: pinned-program journal lets a
+restarted worker revoke grants it did not make in this process.
+
+Kernel bpf(2) ops are stubbed (no bpffs in the sandbox); "program fds" are
+real /dev/null fds so the controller's fd lifecycle runs unmodified. The
+real syscall wrappers are covered by test_cgroup's gated kernel test. What
+this verifies is the state machine: pin/journal on grant, restore on
+restart, exact original restoration and cleanup on final revoke.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from gpumounter_tpu.cgroup import ebpf
+from gpumounter_tpu.device.tpu import TpuDevice
+
+
+class FakeKernel:
+    """bpf(2) stand-in: programs are ids; fds are real /dev/null fds."""
+
+    def __init__(self):
+        self.next_id = 100
+        self.fd2prog: dict[int, int] = {}
+        self.attached: dict[str, list[int]] = {}  # cgroup dir -> prog ids
+
+    def _new_fd(self, prog_id: int) -> int:
+        fd = os.open("/dev/null", os.O_RDONLY)
+        self.fd2prog[fd] = prog_id
+        return fd
+
+    def _cg_of(self, cgroup_fd: int) -> str:
+        return os.readlink(f"/proc/self/fd/{cgroup_fd}")
+
+    def install(self, monkeypatch):
+        def prog_load(insns, name="x"):
+            pid = self.next_id
+            self.next_id += 1
+            return self._new_fd(pid)
+
+        monkeypatch.setattr(ebpf, "prog_load", prog_load)
+        monkeypatch.setattr(
+            ebpf, "prog_attach",
+            lambda cg_fd, fd, flags=0: self.attached.setdefault(
+                self._cg_of(cg_fd), []).append(self.fd2prog[fd]))
+        monkeypatch.setattr(
+            ebpf, "prog_detach",
+            lambda cg_fd, fd: self.attached[self._cg_of(cg_fd)].remove(
+                self.fd2prog[fd]))
+        monkeypatch.setattr(
+            ebpf, "prog_query",
+            lambda cg_fd, max_progs=64: list(
+                self.attached.get(self._cg_of(cg_fd), [])))
+        monkeypatch.setattr(ebpf, "prog_get_fd_by_id",
+                            lambda pid: self._new_fd(pid))
+        # Pins live in the real filesystem (prog id stored in the file),
+        # so os.replace/unlink on pin paths behave like bpffs.
+        def obj_pin(path, fd):
+            with open(path, "w") as f:
+                f.write(str(self.fd2prog[fd]))
+
+        def obj_get(path):
+            with open(path) as f:
+                return self._new_fd(int(f.read()))
+
+        monkeypatch.setattr(ebpf, "obj_pin", obj_pin)
+        monkeypatch.setattr(ebpf, "obj_get", obj_get)
+
+    def preattach(self, cgroup_dir: str, prog_id: int) -> None:
+        self.attached.setdefault(cgroup_dir, []).append(prog_id)
+
+
+@pytest.fixture()
+def kernel(monkeypatch):
+    k = FakeKernel()
+    k.install(monkeypatch)
+    return k
+
+
+def _controller(tmp_path):
+    return ebpf.V2DeviceController(
+        pin_dir=str(tmp_path / "bpffs"),
+        state_dir=str(tmp_path / "state"))
+
+
+DEV = TpuDevice(index=0, device_path="/dev/accel0", major=250, minor=0,
+                uuid="chip0")
+DEV2 = TpuDevice(index=1, device_path="/dev/accel1", major=250, minor=1,
+                 uuid="chip1")
+
+
+def test_grant_persists_and_restores(tmp_path, kernel):
+    cg = tmp_path / "cgroup"
+    cg.mkdir()
+    cg_key = os.path.realpath(str(cg))
+    kernel.preattach(cg_key, 7)   # runc's program
+
+    ctl_a = _controller(tmp_path)
+    ctl_a.grant(cg_key, DEV)
+    ctl_a.grant(cg_key, DEV2)
+    # original (7) detached, ours attached
+    assert 7 not in kernel.attached[cg_key]
+    assert len(kernel.attached[cg_key]) == 1
+    assert len(os.listdir(tmp_path / "state")) == 1
+    pins = sorted(os.listdir(tmp_path / "bpffs"))
+    assert any(p.endswith("-orig-0") for p in pins)
+    assert any(p.endswith("-ours") for p in pins)
+
+    # --- "worker restart": fresh controller restores from journal ---
+    ctl_b = _controller(tmp_path)
+    assert cg_key in ctl_b._state
+    st = ctl_b._state[cg_key]
+    assert set(st.granted) == {(250, 0), (250, 1)}
+    assert len(st.original_fds) == 1
+
+    ctl_b.revoke(cg_key, DEV)
+    assert set(ctl_b._state[cg_key].granted) == {(250, 1)}
+    ctl_b.revoke(cg_key, DEV2)
+    # original program restored exactly, pins + journal cleaned up
+    assert kernel.attached[cg_key] == [7]
+    assert os.listdir(tmp_path / "state") == []
+    assert os.listdir(tmp_path / "bpffs") == []
+
+
+def test_corrupt_journal_dropped(tmp_path, kernel):
+    state = tmp_path / "state"
+    state.mkdir(parents=True)
+    (state / "deadbeef.json").write_text("{not json")
+    ctl = _controller(tmp_path)
+    assert ctl._state == {}
+    assert not (state / "deadbeef.json").exists()
+
+
+def test_unrestorable_state_releases_pins(tmp_path, kernel):
+    """Container deleted while the worker was down: restore fails, and the
+    pins must be unlinked (else BPF programs stay pinned forever)."""
+    cg = tmp_path / "cgroup"
+    cg.mkdir()
+    cg_key = os.path.realpath(str(cg))
+    kernel.preattach(cg_key, 7)
+    ctl_a = _controller(tmp_path)
+    ctl_a.grant(cg_key, DEV)
+    assert len(os.listdir(tmp_path / "bpffs")) == 2  # orig-0 + ours
+
+    os.rmdir(cg)  # "container gone"
+    ctl_b = _controller(tmp_path)
+    assert ctl_b._state == {}
+    assert os.listdir(tmp_path / "state") == []
+    assert os.listdir(tmp_path / "bpffs") == []
+
+
+def test_degrades_without_bpffs():
+    ctl = ebpf.V2DeviceController(pin_dir="/proc/definitely/not/writable",
+                                  state_dir="/proc/also/not")
+    assert ctl._pinning is False

@@ -1,0 +1,64 @@
+"""Pallas flash-attention kernel vs the O(L²) oracle (interpret mode on
+CPU; the same bytecode runs compiled on TPU — see bench_flash.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gpumounter_tpu.ops.flash_attention import (
+    _xla_attention,
+    flash_attention_pallas,
+)
+
+
+@pytest.fixture(autouse=True)
+def _cpu_default():
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+
+
+def _qkv(b=2, h=2, l=256, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("block", [64, 128, 256])
+def test_matches_oracle(causal, block):
+    q, k, v = _qkv()
+    want = _xla_attention(q, k, v, causal, 1.0 / 8.0)
+    got = flash_attention_pallas(q, k, v, causal=causal, scale=1.0 / 8.0,
+                                 block_q=block, block_k=block,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_blocks_rejected():
+    q, k, v = _qkv(l=96)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_attention_pallas(q, k, v, block_q=64, block_k=64,
+                               interpret=True)
+
+
+def test_single_block():
+    q, k, v = _qkv(l=64)
+    want = _xla_attention(q, k, v, True, 0.125)
+    got = flash_attention_pallas(q, k, v, scale=0.125, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16():
+    q, k, v = _qkv(l=128, dtype=jnp.bfloat16)
+    want = _xla_attention(q, k, v, True, 0.125)
+    got = flash_attention_pallas(q, k, v, scale=0.125, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=5e-2, atol=5e-2)
