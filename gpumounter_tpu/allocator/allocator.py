@@ -283,13 +283,6 @@ class TpuAllocator:
         return [Pod(p) for p in self.kube.list_pods(
             self.cfg.pool_namespace, label_selector=selector)]
 
-    def slave_pods_holding(self, pod: Pod,
-                           devices: list[TpuDevice]) -> list[str]:
-        """Names of slave pods owning any of `devices`."""
-        owners = {d.pod_name for d in devices
-                  if d.namespace == self.cfg.pool_namespace}
-        return sorted(owners)
-
     # --- mount-type heuristic (reference: GetMountType, allocator.go:158-187) ---
 
     def get_mount_type(self, pod: Pod, refresh: bool = True) -> MountType:

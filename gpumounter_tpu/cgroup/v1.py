@@ -39,8 +39,14 @@ class V1DeviceController:
     def grant(self, cgroup_dir: str, dev: TpuDevice) -> None:
         self._write(cgroup_dir, "devices.allow",
                     f"c {dev.major}:{dev.minor} {self.permission}")
+        for comp in dev.companions:
+            self._write(cgroup_dir, "devices.allow",
+                        f"c {comp.major}:{comp.minor} {self.permission}")
 
     def revoke(self, cgroup_dir: str, dev: TpuDevice) -> None:
+        # Only the chip's node is denied. Companion nodes (shared vfio
+        # container) stay allowed: denying them would break sibling chips
+        # still mounted, and the container node grants nothing by itself.
         self._write(cgroup_dir, "devices.deny",
                     f"c {dev.major}:{dev.minor} {self.permission}")
 

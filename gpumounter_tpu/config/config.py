@@ -67,6 +67,12 @@ class Config:
     slave_pod_timeout_s: float = field(default_factory=lambda: float(_env("SLAVE_POD_TIMEOUT_S", "120")))
     slave_pod_name_suffix: str = "-slave-pod-"
 
+    # --- master-side request validation ---
+    # Reference accepts any int32 gpuNum incl. 0/negative at L1
+    # (cmd/GPUMounter-master/main.go:31-43 parses but never range-checks);
+    # bad requests should die at the gateway, not deep in the worker.
+    max_tpu_per_request: int = field(default_factory=lambda: int(_env("MAX_TPU_PER_REQUEST", "64")))
+
     # --- worker discovery (master side) ---
     worker_label_selector: str = field(default_factory=lambda: _env(
         "WORKER_LABEL_SELECTOR", "app=tpu-mounter-worker"))

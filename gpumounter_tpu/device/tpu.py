@@ -33,23 +33,44 @@ DEVICE_CGROUP_PERMISSION = "rw"
 DEVICE_FILE_MODE = 0o666
 
 
+@dataclass(frozen=True)
+class CompanionNode:
+    """A device node that must travel with the chip into the container.
+
+    vfio-based TPU VMs: each chip is an IOMMU group node /dev/vfio/<N>,
+    and opening it is useless without the shared vfio *container* node
+    /dev/vfio/vfio — so the container node rides along on every mount.
+    Shared across chips: injected idempotently, never removed on unmount
+    (alone it grants nothing), and its cgroup rule lives with each chip's
+    grant so revoking one chip cannot break another's companion access.
+    """
+    rel_path: str              # path relative to /dev, e.g. "vfio/vfio"
+    major: int
+    minor: int
+
+
 @dataclass
 class TpuDevice:
-    index: int                 # chip index (accelN)
-    device_path: str           # e.g. /dev/accel0 (or fake dir path)
+    index: int                 # chip index (accelN / vfio group number)
+    device_path: str           # e.g. /dev/accel0, /dev/vfio/3 (or fake path)
     major: int                 # from stat(2), never hardcoded
     minor: int
     uuid: str                  # stable id: PCI address or fallback
     state: str = TPU_FREE_STATE
     pod_name: str = ""
     namespace: str = ""
-    extra_paths: list[str] = field(default_factory=list)
-    # Companion device nodes that must travel with the chip (e.g. vfio group
-    # nodes on some TPU VM images); empty for the accel class.
+    # Node path relative to the /dev root ("accel0", "vfio/3"); defaults to
+    # the basename for flat accel-class nodes.
+    node_rel_path: str = ""
+    companions: list[CompanionNode] = field(default_factory=list)
 
     @property
     def basename(self) -> str:
         return os.path.basename(self.device_path)
+
+    @property
+    def rel_path(self) -> str:
+        return self.node_rel_path or self.basename
 
     def reset_state(self) -> None:
         # Reference: ResetState (nvidia.go:50-55)

@@ -75,6 +75,43 @@ def set_topology_env(*, chips_per_host_bounds: str | None = None,
             logger.debug("topology env %s=%s", key, val)
 
 
+def _clear_backends() -> str:
+    """Drop every initialized PJRT client so the next jax.devices() call
+    re-enumerates hardware. Returns the mechanism used (for tests/logs).
+
+    Version-gated, newest API first (this call is the north-star path —
+    a silent no-op here means hot-mounted chips never become visible):
+
+      * jax >= 0.4.34 (incl. 0.9.x installed here):
+        jax.extend.backend.clear_backends()
+      * jax ~ 0.4.x older: jax.clear_backends() (deprecated alias)
+      * last resort: private xla_bridge._clear_backends()
+
+    Each candidate is verified to exist before use; there is no silent
+    fallthrough — if no mechanism exists we raise, because pretending to
+    refresh is strictly worse than failing loudly.
+    """
+    import jax
+
+    try:
+        import jax.extend.backend as jeb
+        if hasattr(jeb, "clear_backends"):
+            jeb.clear_backends()
+            return "jax.extend.backend.clear_backends"
+    except ImportError:
+        pass
+    if hasattr(jax, "clear_backends"):
+        jax.clear_backends()
+        return "jax.clear_backends"
+    from jax._src import xla_bridge
+    if hasattr(xla_bridge, "_clear_backends"):
+        xla_bridge._clear_backends()
+        return "xla_bridge._clear_backends"
+    raise RuntimeError(
+        f"no backend-reset API found on jax {jax.__version__}; "
+        "hot-mounted chips cannot become visible without one")
+
+
 def refresh_devices(platform: str | None = None) -> int:
     """Tear down and rebuild the JAX backend; returns new device count.
 
@@ -84,23 +121,13 @@ def refresh_devices(platform: str | None = None) -> int:
     import jax
 
     try:
-        jax.clear_caches()
+        jax.clear_caches()  # drop compiled executables tied to old client
     except Exception:  # noqa: BLE001 — older jax
         pass
-    # Public-ish API moved over versions; try in order.
-    cleared = False
-    for clear in ("clear_backends",):
-        fn = getattr(jax, clear, None) or getattr(
-            getattr(jax, "extend", None) or object(), clear, None)
-        if fn is not None:
-            fn()
-            cleared = True
-            break
-    if not cleared:  # very old fallback
-        from jax._src import xla_bridge
-        xla_bridge.get_backend.cache_clear()
+    mechanism = _clear_backends()
     devices = jax.devices(platform) if platform else jax.devices()
-    logger.info("backend rebuilt: %d device(s)", len(devices))
+    logger.info("backend rebuilt via %s: %d device(s)", mechanism,
+                len(devices))
     return len(devices)
 
 

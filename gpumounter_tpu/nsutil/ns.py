@@ -57,40 +57,58 @@ def _run_nsexec(args: list[str]) -> None:
 
 
 def device_node_path(dev_dir: str, dev: TpuDevice) -> str:
-    return os.path.join(dev_dir, dev.basename)
+    return os.path.join(dev_dir, dev.rel_path)
 
 
-def inject_device_file(target_dev_dir: str, dev: TpuDevice,
-                       pid: int | None = None) -> str:
-    """Create the device node for `dev` inside the target.
-
-    Reference analog: AddGPUDeviceFile (namespace.go:167-177).
-    Returns the path created (target-namespace view when pid is given).
-    """
-    target_path = device_node_path(target_dev_dir, dev)
+def _mknod_at(target_path: str, major: int, minor: int,
+              source_path: str = "", pid: int | None = None) -> None:
+    """Create one char device node (idempotent), parents included."""
     if pid is not None:
+        # nsexec creates missing parent dirs inside the target ns itself
+        # (vfio nodes live under /dev/vfio/).
         _run_nsexec(["mknod", str(pid), target_path,
-                     str(dev.major), str(dev.minor), f"{DEVICE_FILE_MODE:o}"])
-        return target_path
-
+                     str(major), str(minor), f"{DEVICE_FILE_MODE:o}"])
+        return
     if os.path.exists(target_path):
-        return target_path
+        return
+    os.makedirs(os.path.dirname(target_path), exist_ok=True)
     try:
         os.mknod(target_path, DEVICE_FILE_MODE | statmod.S_IFCHR,
-                 os.makedev(dev.major, dev.minor))
+                 os.makedev(major, minor))
         os.chmod(target_path, DEVICE_FILE_MODE)  # mknod mode is umask-masked
     except (OSError, PermissionError) as exc:
         # Unprivileged dry-run fallback, fake devices only: copying a real
         # accelerator chardev would read from the device (can block) and
         # produce a useless regular file, so real devices fail loudly.
-        if not _is_fake_source(dev.device_path):
+        if not (source_path and _is_fake_source(source_path)):
             raise NamespaceError(
-                f"mknod {target_path} c {dev.major}:{dev.minor} failed "
-                f"({exc}) and {dev.device_path} is a real device; "
-                "run the worker with CAP_MKNOD") from exc
+                f"mknod {target_path} c {major}:{minor} failed "
+                f"({exc}) and {source_path or 'the source'} is a real "
+                "device; run the worker with CAP_MKNOD") from exc
         logger.debug("mknod unavailable (%s); copying node for dry-run", exc)
-        shutil.copyfile(dev.device_path, target_path)
+        shutil.copyfile(source_path, target_path)
         os.chmod(target_path, DEVICE_FILE_MODE)
+
+
+def inject_device_file(target_dev_dir: str, dev: TpuDevice,
+                       pid: int | None = None) -> str:
+    """Create the device node(s) for `dev` inside the target.
+
+    Reference analog: AddGPUDeviceFile (namespace.go:167-177).
+    Companion nodes (vfio container) are injected idempotently alongside
+    the chip node. Returns the chip node path (target-namespace view when
+    pid is given).
+    """
+    target_path = device_node_path(target_dev_dir, dev)
+    _mknod_at(target_path, dev.major, dev.minor,
+              source_path=dev.device_path, pid=pid)
+    source_root = os.path.dirname(os.path.dirname(dev.device_path)) \
+        if "/" in dev.rel_path else os.path.dirname(dev.device_path)
+    for comp in dev.companions:
+        comp_path = os.path.join(target_dev_dir, comp.rel_path)
+        _mknod_at(comp_path, comp.major, comp.minor,
+                  source_path=os.path.join(source_root, comp.rel_path),
+                  pid=pid)
     return target_path
 
 
@@ -113,7 +131,13 @@ def _is_fake_source(path: str) -> bool:
 
 def remove_device_file(target_dev_dir: str, dev: TpuDevice,
                        pid: int | None = None) -> None:
-    """Remove the device node. Reference: RemoveGPUDeviceFile (namespace.go:179-189)."""
+    """Remove the chip's device node. Reference: RemoveGPUDeviceFile
+    (namespace.go:179-189).
+
+    Companion nodes are deliberately left in place: the vfio container
+    node is shared across every mounted group (removing it would break
+    sibling chips) and grants nothing by itself once the group node and
+    its cgroup rule are gone."""
     target_path = device_node_path(target_dev_dir, dev)
     if pid is not None:
         _run_nsexec(["rm", str(pid), target_path])
@@ -122,6 +146,47 @@ def remove_device_file(target_dev_dir: str, dev: TpuDevice,
         os.unlink(target_path)
     except FileNotFoundError:
         pass
+
+
+def scan_container_dev_nodes(pid: int | None, dev_dir: str = "/dev",
+                             max_nodes: int = 256,
+                             max_depth: int = 3) -> list[tuple[str, int, int]]:
+    """(rel_path, major, minor) of every char-device node in the target's
+    /dev tree — the ground truth for the device set the container was
+    started with (device-plugin devices like /dev/fuse, spec-declared
+    devices, runtime defaults).
+
+    For a live container this reads /proc/<pid>/root<dev_dir> — no
+    namespace entry needed. The v2 eBPF replacement program folds these in
+    as base rules so a hot-grant never strips access the container
+    legitimately had (the kubelet pod-resources API exposes only opaque
+    device IDs for non-TPU plugins, so the container's own /dev is the
+    only complete source).
+    """
+    root = (os.path.join(f"/proc/{pid}/root", dev_dir.lstrip("/"))
+            if pid is not None else dev_dir)
+    nodes: list[tuple[str, int, int]] = []
+    base_depth = root.rstrip("/").count("/")
+    for dirpath, dirnames, filenames in os.walk(root):
+        if dirpath.rstrip("/").count("/") - base_depth >= max_depth:
+            dirnames[:] = []
+        for name in filenames:
+            full = os.path.join(dirpath, name)
+            try:
+                st = os.lstat(full)
+            except OSError:
+                continue
+            if not statmod.S_ISCHR(st.st_mode):
+                continue
+            rel = os.path.relpath(full, root)
+            nodes.append((rel, os.major(st.st_rdev), os.minor(st.st_rdev)))
+            if len(nodes) >= max_nodes:
+                logger.warning(
+                    "container %s has > %d device nodes; base-rule scan "
+                    "truncated (further devices may be denied by the "
+                    "replacement program)", root, max_nodes)
+                return nodes
+    return nodes
 
 
 def kill_pids_in_ns(pids: list[int], pid: int | None = None,

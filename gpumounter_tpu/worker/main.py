@@ -63,7 +63,9 @@ def main() -> None:
     service = TpuMountService(kube, cfg=cfg)
     server = build_server(service)
     ops = serve_ops(cfg.metrics_port)
-    reaper = SlaveReaper(kube, cfg=cfg).start()
+    reaper = SlaveReaper(
+        kube, cfg=cfg,
+        device_controller=service.mounter.controller).start()
     server.start()
     logger.info("worker serving: %d chip(s) in inventory",
                 len(service.collector.snapshot()))

@@ -138,6 +138,40 @@ class TpuMounter:
 
     # --- mount (reference: MountGPU, util.go:17-71) ---
 
+    def _v2_base_rules(self, target: MountTarget,
+                       base_rules: list[DeviceRule] | None) -> list[DeviceRule]:
+        """Caller-supplied rules (pod's legitimately-claimed chips) plus
+        every char device already present in the container's /dev.
+
+        The v2 replacement program *replaces* runc's device program; any
+        rule not carried over is silently denied for the life of the grant
+        (ADVICE r1 medium). Kubelet's pod-resources API only exposes
+        opaque IDs for non-TPU plugins, so the container's own /dev tree
+        is the complete, honest source of its original device set.
+        """
+        rules = list(base_rules or [])
+        seen = {(r.major, r.minor) for r in rules}
+        # Never bake OUR chips into the immutable base rules: a previously
+        # hot-mounted chip's node may still sit in the container's /dev,
+        # and a base rule for it would survive its revoke — keeping the
+        # old container's kernel access to a chip the scheduler has moved
+        # on. (Companion nodes are fine: harmless without the chip node.)
+        own_chips = {(d.major, d.minor) for d in self.backend.list_devices()}
+        scanned = nsutil.scan_container_dev_nodes(target.ns_pid,
+                                                  target.dev_dir)
+        folded = 0
+        for rel, major, minor in scanned:
+            if (major, minor) in seen or (major, minor) in own_chips:
+                continue
+            seen.add((major, minor))
+            rules.append(DeviceRule("c", major, minor, "rwm"))
+            folded += 1
+        logger.info(
+            "v2 base rules for %s: %d caller rule(s) + %d/%d scanned /dev "
+            "node(s)", target.description, len(base_rules or []), folded,
+            len(scanned))
+        return rules
+
     def mount(self, target: MountTarget, dev: TpuDevice,
               base_rules: list[DeviceRule] | None = None) -> dict:
         """Grant + inject one chip. Returns phase timings (ms)."""
@@ -145,6 +179,15 @@ class TpuMounter:
         granted: list[str] = []
         try:
             with timer.phase("cgroup_grant"):
+                if target.cgroup_dirs and self.cgroup_version == 2:
+                    # The controller captures base rules only at FIRST
+                    # grant per cgroup; skip the /dev walk (a /proc tree
+                    # scan) when every target cgroup is already tracked —
+                    # an entire-mount calls mount() once per chip.
+                    has_state = getattr(self.controller, "has_state",
+                                        lambda cg: False)
+                    if not all(has_state(cg) for cg in target.cgroup_dirs):
+                        base_rules = self._v2_base_rules(target, base_rules)
                 for cg in target.cgroup_dirs:
                     if self.cgroup_version == 2:
                         self.controller.grant(cg, dev, base_rules=base_rules)

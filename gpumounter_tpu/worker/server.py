@@ -15,6 +15,8 @@ unchanged (rpc/api.py). Response enums match api.proto values exactly.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from concurrent import futures
 
 import grpc
@@ -39,6 +41,32 @@ from gpumounter_tpu.utils.timing import PhaseTimer
 logger = get_logger("worker.server")
 
 
+class _KeyedLocks:
+    """Per-key mutual exclusion without unbounded growth: entries are
+    refcounted and dropped when the last holder releases."""
+
+    def __init__(self) -> None:
+        self._guard = threading.Lock()
+        self._entries: dict[str, tuple[threading.Lock, int]] = {}
+
+    @contextlib.contextmanager
+    def held(self, key: str):
+        with self._guard:
+            lock, refs = self._entries.get(key, (threading.Lock(), 0))
+            self._entries[key] = (lock, refs + 1)
+        lock.acquire()
+        try:
+            yield
+        finally:
+            lock.release()
+            with self._guard:
+                lock, refs = self._entries[key]
+                if refs <= 1:
+                    del self._entries[key]
+                else:
+                    self._entries[key] = (lock, refs - 1)
+
+
 class TpuMountService:
     """The business logic shared by both wire service registrations."""
 
@@ -52,6 +80,11 @@ class TpuMountService:
                                                    cfg=self.cfg)
         self.mounter = mounter or TpuMounter(self.collector.backend,
                                              cfg=self.cfg)
+        # Per-pod (UID-keyed) serialization of the CanMount-gate →
+        # allocate → mount / remove critical sections. Without it two
+        # concurrent AddTPU(entire) calls can both observe MountType.NONE
+        # and both mount (TOCTOU the reference shares, server.go:57).
+        self._pod_locks = _KeyedLocks()
 
     # --- AddTPU (reference: server.go:34-99) ---
 
@@ -68,7 +101,12 @@ class TpuMountService:
         except NotFoundError:
             return api.AddTPUResponse(
                 add_tpu_result=api.AddTPUResult.PodNotFound)
+        with self._pod_locks.held(pod.uid):
+            return self._add_tpu_locked(request, context, pod, timer)
 
+    def _add_tpu_locked(self, request: api.AddTPURequest,
+                        context: grpc.ServicerContext, pod: Pod,
+                        timer: PhaseTimer) -> api.AddTPUResponse:
         mount_type = self.allocator.get_mount_type(pod)
         ok, why = self.mounter.can_mount(mount_type, request.is_entire_mount)
         if not ok:
@@ -134,7 +172,12 @@ class TpuMountService:
         except NotFoundError:
             return api.RemoveTPUResponse(
                 remove_tpu_result=api.RemoveTPUResult.PodNotFound)
+        with self._pod_locks.held(pod.uid):
+            return self._remove_tpu_locked(request, context, pod)
 
+    def _remove_tpu_locked(self, request: api.RemoveTPURequest,
+                           context: grpc.ServicerContext,
+                           pod: Pod) -> api.RemoveTPUResponse:
         self.collector.update_status()  # one refresh for the whole request
         entire = request.remove_all or \
             self.allocator.get_mount_type(pod, refresh=False) == \

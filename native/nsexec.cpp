@@ -74,6 +74,23 @@ void enter_mount_ns(long pid) {
   close(fd);
 }
 
+// Create every missing parent directory of `path` (0755), inside the
+// already-entered namespace. Needed for nodes below /dev, e.g. /dev/vfio/N.
+void mkdir_parents(const char* path) {
+  char buf[4096];
+  if (std::snprintf(buf, sizeof(buf), "%s", path) >=
+      static_cast<int>(sizeof(buf))) {
+    errno = ENAMETOOLONG;
+    die("mkdir parents");
+  }
+  for (char* p = buf + 1; *p; p++) {
+    if (*p != '/') continue;
+    *p = '\0';
+    if (mkdir(buf, 0755) != 0 && errno != EEXIST) die("mkdir parent");
+    *p = '/';
+  }
+}
+
 int cmd_mknod(int argc, char** argv) {
   if (argc != 5) usage();
   long pid = parse_long(argv[0], "pid");
@@ -82,6 +99,7 @@ int cmd_mknod(int argc, char** argv) {
   long minor_n = parse_long(argv[3], "minor");
   long mode = parse_long(argv[4], "mode", 8);
   enter_mount_ns(pid);
+  mkdir_parents(path);
   dev_t dev = makedev(static_cast<unsigned>(major_n),
                       static_cast<unsigned>(minor_n));
   if (mknod(path, static_cast<mode_t>(mode) | S_IFCHR, dev) != 0) {

@@ -9,7 +9,7 @@ default, so multi-device tests must ask for the CPU backend explicitly
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")  # no-op when a platform is pinned
+os.environ["JAX_PLATFORMS"] = "cpu"  # hermetic: tests never touch a real TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -177,6 +177,87 @@ def interp(prog: bytes, dev_type: int, access: int, major: int, minor: int) -> i
 RW = BPF_DEVCG_ACC_READ | BPF_DEVCG_ACC_WRITE
 
 
+def test_scan_container_dev_nodes(tmp_path):
+    """ADVICE r1 (medium): the v2 replacement program must carry over the
+    container's original device set. The scan reads the /dev tree."""
+    import stat as statmod
+
+    from gpumounter_tpu.nsutil import ns as nsutil
+
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    made_char = True
+    try:
+        null = os.stat("/dev/null")
+        os.mknod(str(dev / "fuse"), 0o666 | statmod.S_IFCHR, null.st_rdev)
+        os.mknod(str(dev / "vfio" / "vfio"), 0o666 | statmod.S_IFCHR,
+                 null.st_rdev)
+    except (OSError, PermissionError):
+        made_char = False
+    (dev / "not-a-device").write_text("")  # regular files are skipped
+
+    nodes = nsutil.scan_container_dev_nodes(None, str(dev))
+    rels = sorted(r for r, _, _ in nodes)
+    if made_char:
+        assert rels == ["fuse", "vfio/vfio"]
+        for _, major, minor in nodes:
+            assert (major, minor) == (os.major(null.st_rdev),
+                                      os.minor(null.st_rdev))
+    else:
+        assert rels == []
+
+    # the host's own /dev always yields /dev/null itself
+    host_nodes = nsutil.scan_container_dev_nodes(None, "/dev",
+                                                 max_nodes=4096)
+    assert ("null", 1, 3) in host_nodes
+
+
+def test_v2_base_rules_merge(tmp_path):
+    """Mounter folds scanned /dev nodes into the caller's base rules,
+    deduped by major:minor."""
+    import stat as statmod
+
+    from gpumounter_tpu.device.backend import DeviceBackend
+    from gpumounter_tpu.device.tpu import TpuDevice
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+    from gpumounter_tpu.config import Config
+
+    class StubBackend(DeviceBackend):
+        def list_devices(self):
+            return [TpuDevice(index=0, device_path="/dev/accel0",
+                              major=250, minor=5, uuid="chip")]
+
+    container_dev = tmp_path / "cdev"
+    container_dev.mkdir()
+    try:
+        null = os.stat("/dev/null")
+        os.mknod(str(container_dev / "fuse"),
+                 0o666 | statmod.S_IFCHR, null.st_rdev)
+        # a lingering node of one of OUR chips must NOT become a base rule
+        os.mknod(str(container_dev / "accel0"),
+                 0o666 | statmod.S_IFCHR, os.makedev(250, 5))
+    except (OSError, PermissionError):
+        pytest.skip("needs CAP_MKNOD")
+
+    cfg = Config().replace(cgroup_version="2")
+    mounter = TpuMounter(StubBackend(), cfg=cfg)
+    target = MountTarget(dev_dir=str(container_dev), description="t")
+    caller = [DeviceRule("c", 250, 0, "rw")]
+    rules = mounter._v2_base_rules(target, caller)
+    majors = {(r.major, r.minor) for r in rules}
+    assert (250, 0) in majors               # caller rule kept
+    assert (os.major(null.st_rdev),
+            os.minor(null.st_rdev)) in majors  # scanned node folded in
+    assert (250, 5) not in majors           # own chip excluded (review fix)
+    # dedupe: scanning again via a rule that already covers it
+    rules2 = mounter._v2_base_rules(
+        target, [DeviceRule("c", os.major(null.st_rdev),
+                            os.minor(null.st_rdev), "rw")])
+    assert len([r for r in rules2
+                if (r.major, r.minor) == (os.major(null.st_rdev),
+                                          os.minor(null.st_rdev))]) == 1
+
+
 def test_program_allows_granted_chip():
     dev = TpuDevice(index=0, device_path="/dev/accel0", major=250, minor=0,
                     uuid="u")

@@ -1,0 +1,54 @@
+"""Driver-contract tests for __graft_entry__ (VERDICT r1 item #1).
+
+dryrun_multichip validates multi-chip sharding and must be hermetic: it
+runs entirely on virtual CPU devices and never initializes a non-CPU
+backend, so its outcome cannot depend on the health of a real TPU on the
+host (round-1 failure: oracle ops hit a broken TPU backend, rc=1).
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+_DRYRUN_PROBE = """
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+from jax._src import xla_bridge
+initialized = set(xla_bridge._backends)
+assert initialized == {"cpu"}, f"non-CPU backend initialized: {initialized}"
+print("HERMETIC_OK")
+"""
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_is_hermetic_cpu_only():
+    """Run the dryrun in a pristine subprocess that emulates the driver
+    host: no XLA_FLAGS preset, the site environment's pinned platform
+    (possibly a TPU plugin) left in place. The dryrun must pass AND must
+    have initialized only the CPU backend."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # dryrun must top this up itself
+    env.pop("JAX_PLATFORMS", None)  # site env may re-pin; dryrun overrides
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRYRUN_PROBE],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "HERMETIC_OK" in proc.stdout
+
+
+def test_force_virtual_cpu_in_process():
+    """In-process: _force_virtual_cpu yields >= n CPU devices even though
+    the test conftest already initialized the CPU backend."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import __graft_entry__ as g
+    finally:
+        sys.path.pop(0)
+    devices = g._force_virtual_cpu(8)
+    assert len(devices) == 8
+    assert all(d.platform == "cpu" for d in devices)

