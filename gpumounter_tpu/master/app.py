@@ -303,9 +303,12 @@ class MasterApp:
         if chips <= 0:
             raise _HttpError(400, f"Invalid chipsPerHost: {chips}")
         entire = bool(payload.get("isEntireMount", True))
+        accel_type = payload.get("acceleratorType") or None
+        topology_hint = payload.get("topology") or None
         try:
-            plan = self._slice_coordinator().mount_slice(targets, chips,
-                                                         entire)
+            plan = self._slice_coordinator().mount_slice(
+                targets, chips, entire, accel_type=accel_type,
+                topology_hint=topology_hint)
         except SliceError as exc:
             raise _HttpError(exc.status, str(exc))
         return 200, "application/json", jsonlib.dumps(plan, indent=1) + "\n"

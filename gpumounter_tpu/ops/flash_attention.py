@@ -86,20 +86,35 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
 
 
+def _fit_block(l: int, want: int) -> int:
+    """Largest divisor of l that is <= want, preferring lane-aligned
+    (multiple-of-128) sizes. A valid dividing block always exists (1
+    divides everything), so non-power-of-two L degrades instead of
+    erroring (ADVICE r1)."""
+    want = min(want, l)
+    if l % want == 0:
+        return want
+    for b in range((want // 128) * 128, 0, -128):  # multiples of 128 only
+        if l % b == 0:
+            return b
+    for b in range(want, 0, -1):
+        if l % b == 0:
+            return b
+    return 1
+
+
 def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            causal: bool = True, scale: float | None = None,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False) -> jax.Array:
-    """(B, H, L, D) attention via the Pallas kernel. L must divide into
-    blocks; block sizes are clamped to L."""
+    """(B, H, L, D) attention via the Pallas kernel. Block sizes are
+    clamped to L and reduced to the largest dividing size when the
+    requested blocks do not divide L."""
     b, h, l, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, l)
-    block_k = min(block_k, l)
-    if l % block_q or l % block_k:
-        raise ValueError(f"seq len {l} not divisible by blocks "
-                         f"({block_q}, {block_k})")
+    block_q = _fit_block(l, block_q)
+    block_k = _fit_block(l, block_k)
     n_q = l // block_q
     n_k = l // block_k
 
@@ -142,22 +157,47 @@ def _xla_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+# Data-driven dispatch (BENCH_flash_r02.json, real v5e, causal bf16
+# B=4 H=8 D=128): XLA wins at L<=2k; the Pallas kernel wins at 4k
+# (1.12x), matches at 8k, and is the ONLY path at 16k+ where XLA's
+# materialized (L, L) scores abort (60-80 TFLOP/s, 0.41 MFU at 32k).
+PALLAS_CROSSOVER_SEQ_LEN = 4096
+
+
+def _best_blocks(l: int) -> tuple[int, int]:
+    """Fastest swept (block_q, block_k) per sequence length
+    (BENCH_flash_r02.json): 256x1024 at 4k-8k, 512x1024 at 16k+."""
+    if l >= 16384:
+        return 512, 1024
+    return 256, 1024
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
                     backend: str = "auto") -> jax.Array:
     """Public entry.
 
-    backend: "auto" (XLA — measured FASTER than the Pallas kernel on
-    v5e at L=1k-8k, see bench_flash.py; XLA's own attention fusion is
-    excellent on TPU), or "pallas" to force the hand-written kernel.
-    The Pallas kernel's value is O(L·D) HBM traffic at sequence lengths
-    where the materialized (L, L) scores no longer fit the roofline —
-    and as the in-repo exemplar of the guide's kernel patterns.
+    backend: "auto" picks by the committed sweep data — XLA below
+    PALLAS_CROSSOVER_SEQ_LEN (XLA's fused attention is excellent at
+    short L on TPU), the Pallas kernel at and above it (O(L·D) HBM
+    traffic; the only viable path once the (L, L) score matrix exceeds
+    HBM). "xla" / "pallas" force a path.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if backend == "pallas":
-        on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    l = q.shape[2]
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    bq, bk = (_fit_block(l, b) for b in _best_blocks(l))
+    # auto only takes the kernel when the fitted blocks stay lane-aligned
+    # — odd lengths (primes, non-multiples of 128) degrade to tiny or
+    # sublane-misaligned tiles that compile poorly or not at all; XLA
+    # handles those lengths fine.
+    blocks_ok = bq % 128 == 0 and bk % 128 == 0
+    use_pallas = (backend == "pallas"
+                  or (backend == "auto" and on_tpu and blocks_ok
+                      and l >= PALLAS_CROSSOVER_SEQ_LEN))
+    if use_pallas:
         return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
+                                      block_q=bq, block_k=bk,
                                       interpret=not on_tpu)
     return _xla_attention(q, k, v, causal, scale)

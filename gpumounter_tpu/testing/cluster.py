@@ -175,11 +175,14 @@ class FakeCluster:
                         if node else {})},
         }
         self.kube.create_pod(namespace, manifest)
+        # Running pods always carry a pod IP (the slice coordinator uses
+        # it as the resolvable TPU_WORKER_HOSTNAMES entry).
+        ip_suffix = (abs(hash((namespace, name))) % 250) + 2
         self.kube.set_pod_status(namespace, name, containerStatuses=[{
             "name": "main",
             "containerID": f"containerd://{name}-cid",
             "state": {"running": {}},
-        }])
+        }], podIP=f"10.8.0.{ip_suffix}")
         pod = self.kube.wait_for_pod(
             namespace, name,
             lambda pj: pj is not None and Pod(pj).phase == "Running",

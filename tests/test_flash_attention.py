@@ -39,11 +39,22 @@ def test_matches_oracle(causal, block):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_uneven_blocks_rejected():
+def test_uneven_blocks_degrade_to_divisor():
+    """ADVICE r1: non-dividing defaults reduce to the largest dividing
+    block instead of erroring; result stays correct."""
+    from gpumounter_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(96, 64) == 48      # largest divisor <= 64
+    assert _fit_block(768, 512) == 384   # lane-aligned divisor preferred
+    assert _fit_block(1000, 512) == 500
+    assert _fit_block(97, 64) == 1       # prime: degenerate but valid
+
     q, k, v = _qkv(l=96)
-    with pytest.raises(ValueError, match="not divisible"):
-        flash_attention_pallas(q, k, v, block_q=64, block_k=64,
-                               interpret=True)
+    want = _xla_attention(q, k, v, True, 0.125)
+    got = flash_attention_pallas(q, k, v, scale=0.125, block_q=64,
+                                 block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_single_block():
