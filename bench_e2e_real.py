@@ -43,7 +43,9 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-ARTIFACT = os.path.join(REPO, "BENCH_e2e_real_r02.json")
+# Overridable so test runs don't clobber the committed real-chip artifact.
+ARTIFACT = os.environ.get("TPM_E2E_ARTIFACT",
+                          os.path.join(REPO, "BENCH_e2e_real_r02.json"))
 
 V1_ROOT = "/sys/fs/cgroup/devices"
 V2_ROOT_CANDIDATES = ("/sys/fs/cgroup/unified", "/sys/fs/cgroup")
