@@ -179,9 +179,36 @@ def build_device_program(rules: list[DeviceRule] | tuple[DeviceRule, ...]) -> by
 
 _libc = ctypes.CDLL(None, use_errno=True)
 
+# The attr passed to bpf(2) is a union the KERNEL also writes output fields
+# into at fixed union offsets — e.g. BPF_PROG_QUERY writes query.prog_cnt
+# (offset 24), query.attach_flags (offset 12) and, since Linux 6.3,
+# query.revision (an 8-byte store at offset 56) regardless of the size the
+# caller declared. Passing a buffer sized to just the input fields therefore
+# lets the kernel scribble past the allocation — real heap corruption we
+# debugged on a 6.18 kernel (the r2 bench SIGSEGV: GC crashed long after a
+# 28-byte query attr was overrun). Every call must hand the kernel a buffer
+# at least as large as its union bpf_attr; trailing zeros are explicitly
+# legal (kernel bpf_check_uarg_tail_zero accepts size > its sizeof when the
+# tail is zero).
+BPF_ATTR_SIZE = 256  # > sizeof(union bpf_attr) on any current kernel
+
 
 class BpfError(OSError):
     pass
+
+
+def _bpf(cmd: int, attr: bytes) -> tuple[int, bytes]:
+    """bpf(2) with a full-size zero-padded attr; returns (ret, attr_out).
+
+    ret < 0 means failure; errno is fetched by the caller via
+    ctypes.get_errno(). attr_out is the post-call attr contents so callers
+    can read kernel-written output fields.
+    """
+    assert len(attr) <= BPF_ATTR_SIZE
+    buf = ctypes.create_string_buffer(attr.ljust(BPF_ATTR_SIZE, b"\x00"),
+                                      BPF_ATTR_SIZE)
+    ret = _libc.syscall(SYS_BPF, cmd, buf, BPF_ATTR_SIZE)
+    return ret, buf.raw
 
 
 def prog_load(insns: bytes, name: str = "tpumounter_dev") -> int:
@@ -202,8 +229,7 @@ def prog_load(insns: bytes, name: str = "tpumounter_dev") -> int:
         0,                       # prog_flags
         name.encode()[:15],
     )
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    fd = _libc.syscall(SYS_BPF, BPF_PROG_LOAD, buf, len(attr))
+    fd, _ = _bpf(BPF_PROG_LOAD, attr)
     if fd < 0:
         err = ctypes.get_errno()
         log = log_buf.value.decode(errors="replace").strip()
@@ -220,38 +246,38 @@ def _attach_attr(target_fd: int, attach_fd: int, flags: int = 0,
 
 def prog_attach(cgroup_fd: int, prog_fd: int,
                 flags: int = BPF_F_ALLOW_MULTI) -> None:
-    attr = _attach_attr(cgroup_fd, prog_fd, flags)
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    if _libc.syscall(SYS_BPF, BPF_PROG_ATTACH, buf, len(attr)) < 0:
+    ret, _ = _bpf(BPF_PROG_ATTACH, _attach_attr(cgroup_fd, prog_fd, flags))
+    if ret < 0:
         err = ctypes.get_errno()
         raise BpfError(err, f"BPF_PROG_ATTACH: {os.strerror(err)}")
 
 
 def prog_detach(cgroup_fd: int, prog_fd: int) -> None:
-    attr = _attach_attr(cgroup_fd, prog_fd)
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    if _libc.syscall(SYS_BPF, BPF_PROG_DETACH, buf, len(attr)) < 0:
+    ret, _ = _bpf(BPF_PROG_DETACH, _attach_attr(cgroup_fd, prog_fd))
+    if ret < 0:
         err = ctypes.get_errno()
         raise BpfError(err, f"BPF_PROG_DETACH: {os.strerror(err)}")
+
+
+_QUERY_FMT = "<IIII Q I"
 
 
 def prog_query(cgroup_fd: int, max_progs: int = 64) -> list[int]:
     """IDs of device programs attached directly to the cgroup."""
     ids = (ctypes.c_uint32 * max_progs)()
-    attr = struct.pack("<IIII Q I", cgroup_fd, BPF_CGROUP_DEVICE, 0, 0,
+    attr = struct.pack(_QUERY_FMT, cgroup_fd, BPF_CGROUP_DEVICE, 0, 0,
                        ctypes.addressof(ids), max_progs)
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    if _libc.syscall(SYS_BPF, BPF_PROG_QUERY, buf, len(attr)) < 0:
+    ret, out = _bpf(BPF_PROG_QUERY, attr)
+    if ret < 0:
         err = ctypes.get_errno()
         raise BpfError(err, f"BPF_PROG_QUERY: {os.strerror(err)}")
-    (_, _, _, _, _, count) = struct.unpack("<IIII Q I", buf.raw[:struct.calcsize("<IIII Q I")])
+    (_, _, _, _, _, count) = struct.unpack(
+        _QUERY_FMT, out[:struct.calcsize(_QUERY_FMT)])
     return [ids[i] for i in range(count)]
 
 
 def prog_get_fd_by_id(prog_id: int) -> int:
-    attr = struct.pack("<II", prog_id, 0)
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    fd = _libc.syscall(SYS_BPF, BPF_PROG_GET_FD_BY_ID, buf, len(attr))
+    fd, _ = _bpf(BPF_PROG_GET_FD_BY_ID, struct.pack("<II", prog_id, 0))
     if fd < 0:
         err = ctypes.get_errno()
         raise BpfError(err, f"BPF_PROG_GET_FD_BY_ID({prog_id}): {os.strerror(err)}")
@@ -261,9 +287,9 @@ def prog_get_fd_by_id(prog_id: int) -> int:
 def obj_pin(path: str, bpf_fd: int) -> None:
     """Pin a program to bpffs so it survives this process (BPF_OBJ_PIN)."""
     pathname = ctypes.create_string_buffer(path.encode())
-    attr = struct.pack("<QI", ctypes.addressof(pathname), bpf_fd)
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    if _libc.syscall(SYS_BPF, BPF_OBJ_PIN, buf, len(attr)) < 0:
+    ret, _ = _bpf(BPF_OBJ_PIN,
+                  struct.pack("<QI", ctypes.addressof(pathname), bpf_fd))
+    if ret < 0:
         err = ctypes.get_errno()
         raise BpfError(err, f"BPF_OBJ_PIN({path}): {os.strerror(err)}")
 
@@ -271,9 +297,8 @@ def obj_pin(path: str, bpf_fd: int) -> None:
 def obj_get(path: str) -> int:
     """Re-open a pinned program; returns a new fd (BPF_OBJ_GET)."""
     pathname = ctypes.create_string_buffer(path.encode())
-    attr = struct.pack("<QI", ctypes.addressof(pathname), 0)
-    buf = ctypes.create_string_buffer(attr, len(attr))
-    fd = _libc.syscall(SYS_BPF, BPF_OBJ_GET, buf, len(attr))
+    fd, _ = _bpf(BPF_OBJ_GET,
+                 struct.pack("<QI", ctypes.addressof(pathname), 0))
     if fd < 0:
         err = ctypes.get_errno()
         raise BpfError(err, f"BPF_OBJ_GET({path}): {os.strerror(err)}")

@@ -20,14 +20,10 @@ here, per SURVEY.md §7:
 from __future__ import annotations
 
 import os
-import typing
 from concurrent import futures
 
-if typing.TYPE_CHECKING:  # runtime import is lazy: grpc's cython core
-    import grpc            # registers fork handlers that can segfault
-                           # subprocess-heavy users (the mounter path)
-
 from gpumounter_tpu.rpc.wire import Field, Message
+from gpumounter_tpu.utils.lazy_grpc import grpc
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("podresources")

@@ -8,9 +8,8 @@ reference's gpu_mount.* names for cross-testing.
 
 from __future__ import annotations
 
-import grpc
-
 from gpumounter_tpu.rpc import api
+from gpumounter_tpu.utils.lazy_grpc import grpc
 
 
 class WorkerClient:

@@ -8,9 +8,8 @@ dependency.
 
 from __future__ import annotations
 
-import grpc
-
 from gpumounter_tpu.rpc.wire import Field, Message
+from gpumounter_tpu.utils.lazy_grpc import grpc
 
 SERVICE = "grpc.health.v1.Health"
 

@@ -1,0 +1,44 @@
+"""Single import point for grpc, deferred until first attribute access.
+
+Policy
+------
+grpc's cython core registers pthread_atfork handlers at import time.
+Subprocess/fork-heavy paths (worker/mounter.py, nsutil/, the bench
+harnesses) must be importable without pulling grpc — and with it those
+handlers — into the process. Therefore **no module in gpumounter_tpu
+imports grpc at module top**. Every user does
+
+    from gpumounter_tpu.utils.lazy_grpc import grpc
+
+and the real module loads on the first attribute access, i.e. when a
+channel or server is actually constructed — by which point the process
+has committed to being a gRPC endpoint. Enforced by
+tests/test_lazy_grpc.py (imports the mounter in a subprocess and asserts
+"grpc" never enters sys.modules).
+
+Reference contrast: the reference links grpc unconditionally in both
+binaries (cmd/GPUMounter-worker/main.go:24-33); it can afford to because
+Go gRPC has no fork-handler hazard. Python grpcio does, hence the policy.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+class _LazyGrpc:
+    """Attribute-forwarding proxy; imports grpc exactly once, on demand."""
+
+    _module = None
+
+    def _load(self):
+        if _LazyGrpc._module is None:
+            _LazyGrpc._module = importlib.import_module("grpc")
+        return _LazyGrpc._module
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._load(), name)
+
+
+grpc = _LazyGrpc()

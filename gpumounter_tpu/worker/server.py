@@ -19,8 +19,6 @@ import contextlib
 import threading
 from concurrent import futures
 
-import grpc
-
 from gpumounter_tpu.allocator.allocator import (
     InsufficientTpuError,
     MountType,
@@ -35,6 +33,7 @@ from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.worker.mounter import MountError, TpuBusyError, TpuMounter
 from gpumounter_tpu.cgroup.ebpf import device_rule
+from gpumounter_tpu.utils.lazy_grpc import grpc
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.timing import PhaseTimer
 

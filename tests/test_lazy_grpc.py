@@ -1,0 +1,66 @@
+"""Enforce the lazy-grpc import policy (utils/lazy_grpc.py docstring).
+
+The fork/subprocess-heavy paths (mounter, cgroup, nsutil, collector,
+worker.server as a module) must be importable without grpc — and its
+pthread_atfork handlers — entering the process. The checks run in a
+subprocess so this test file's own imports can't pollute the verdict.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+
+def _run_isolated(prog: str) -> subprocess.CompletedProcess:
+    """Run `prog` with the repo importable and no site hooks that could
+    drag grpc in behind our back (this host's sitecustomize, conftest)."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    return subprocess.run([sys.executable, "-c", prog], cwd=REPO_ROOT,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+
+# Modules that must NOT transitively import grpc at import time.
+_GRPC_FREE_IMPORTS = [
+    "gpumounter_tpu.worker.mounter",
+    "gpumounter_tpu.collector.podresources",
+    "gpumounter_tpu.collector.collector",
+    "gpumounter_tpu.worker.server",
+    "gpumounter_tpu.rpc.client",
+    "gpumounter_tpu.rpc.health",
+    "gpumounter_tpu.cgroup",
+    "gpumounter_tpu.nsutil.ns",
+]
+
+
+def test_import_graph_is_grpc_free():
+    prog = (
+        "import sys\n"
+        + "\n".join(f"import {m}" for m in _GRPC_FREE_IMPORTS)
+        + "\nassert 'grpc' not in sys.modules, 'grpc leaked into import graph'\n"
+        "print('OK')\n"
+    )
+    proc = _run_isolated(prog)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "OK"
+
+
+def test_proxy_resolves_real_grpc_on_first_use():
+    prog = (
+        "import sys\n"
+        "from gpumounter_tpu.utils.lazy_grpc import grpc\n"
+        "assert 'grpc' not in sys.modules\n"
+        "code = grpc.StatusCode.UNIMPLEMENTED\n"
+        "assert 'grpc' in sys.modules\n"
+        "import grpc as real\n"
+        "assert code is real.StatusCode.UNIMPLEMENTED\n"
+        "print('OK')\n"
+    )
+    proc = _run_isolated(prog)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "OK"
