@@ -160,8 +160,16 @@ class TpuAllocator:
         devices: list[TpuDevice] = []
         # One kubelet pod-resources refresh for the whole batch, then
         # answer per-slave queries from the refreshed state (the reference
-        # re-Lists per query — a SURVEY §3 hot-loop).
-        self.collector.update_status()
+        # re-Lists per query — a SURVEY §3 hot-loop). strict: acting on a
+        # stale/empty ownership map here would roll back a successful
+        # allocation and blame the device plugin.
+        try:
+            self.collector.update_status(strict=True)
+        except Exception as exc:
+            self._rollback(created)
+            raise SlavePodError(
+                f"kubelet pod-resources query failed after slave-pod "
+                f"creation: {exc}") from exc
         for name in created:
             devs = self.collector.get_slave_pod_devices(name, refresh=False)
             if len(devs) != tpu_num_per_pod:

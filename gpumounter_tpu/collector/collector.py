@@ -45,13 +45,11 @@ class TpuCollector:
         self._podresources = podresources
         self._lock = threading.RLock()
         self.devices: list[TpuDevice] = []
+        # False whenever the last pod-resources query failed: the chip list
+        # is live but ownership marks are stale/unknown.
+        self.ownership_known = False
         self.refresh_inventory()
-        try:
-            self.update_status()
-        except FileNotFoundError:
-            # No kubelet socket (local / dry-run mode): inventory only.
-            logger.warning("kubelet pod-resources socket unavailable; "
-                           "running without ownership info")
+        self.update_status()
 
     # --- enumeration (reference: GetGPUInfo, collector.go:40-79) ---
 
@@ -91,10 +89,31 @@ class TpuCollector:
                     return dev
         return None
 
-    def update_status(self) -> None:
-        client = self._client()
-        pod_resources = client.list()
+    def update_status(self, strict: bool = False) -> None:
+        """Refresh pod↔chip ownership from the kubelet.
+
+        Degrades instead of failing when the kubelet socket is absent or
+        the query errors (reference behavior: dial failure is tolerated
+        per query, collector.go:92-103): the device inventory stays
+        served, existing ownership marks are kept (marking everything
+        free on a kubelet outage would hand owned chips to the
+        allocator), and `ownership_known` flips to False. `strict=True`
+        re-raises — for callers that must not act on stale data.
+        """
+        try:
+            client = self._client()
+            pod_resources = client.list()
+        except Exception as exc:  # noqa: BLE001 — degrade like the reference
+            if strict:
+                raise
+            with self._lock:
+                self.ownership_known = False
+            logger.warning(
+                "pod-resources query failed (%s); serving device-only "
+                "inventory, ownership unknown/stale", exc)
+            return
         with self._lock:
+            self.ownership_known = True
             for dev in self.devices:
                 dev.reset_state()
             unmatched: list[str] = []

@@ -150,13 +150,30 @@ def wait_for_chips(expected: int, timeout_s: float = 30.0,
         time.sleep(poll_interval_s)
     t_nodes = time.monotonic()
 
+    # A full PJRT client rebuild is expensive (complete teardown +
+    # re-enumeration), so rebuild once now that the nodes exist, then
+    # again only when the /dev node count changes OR on an exponentially
+    # backed-off retry (a rebuild can race libtpu readiness: node present,
+    # enumeration not yet). A slow attach therefore costs O(changes +
+    # log(timeout)) rebuilds, not O(timeout / poll_interval).
     count = refresh_devices(platform)
+    nodes_at_rebuild = chips_visible_in_dev(dev_dir)
+    retry_wait = max(poll_interval_s, 0.1)
+    next_retry = time.monotonic() + retry_wait
     while count < expected:
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"jax.device_count()={count} < {expected} after {timeout_s}s")
         time.sleep(poll_interval_s)
-        count = refresh_devices(platform)
+        nodes_now = chips_visible_in_dev(dev_dir)
+        now = time.monotonic()
+        if nodes_now != nodes_at_rebuild or now >= next_retry:
+            count = refresh_devices(platform)
+            nodes_at_rebuild = nodes_now
+            retry_wait = (max(poll_interval_s, 0.1)
+                          if nodes_now != nodes_at_rebuild
+                          else min(retry_wait * 2, 5.0))
+            next_retry = time.monotonic() + retry_wait
     t_done = time.monotonic()
     timings = {
         "nodes_visible_ms": round((t_nodes - t0) * 1000.0, 3),

@@ -66,6 +66,12 @@ class WorkerRegistry:
         self._last_list = 0.0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Watch events that land while a LIST is in flight (a _miss_refresh
+        # racing the watch thread) are journaled and replayed on top of the
+        # LIST result before the swap, so a delta observed between the LIST
+        # response and the cache swap is never lost (it used to be silently
+        # dropped until the next watch re-open, ~60 s).
+        self._journal: list[tuple[str, Pod]] | None = None
 
     # --- lifecycle ---
 
@@ -85,35 +91,57 @@ class WorkerRegistry:
 
     # --- cache maintenance ---
 
-    def _apply(self, etype: str, pod: Pod) -> None:
+    @staticmethod
+    def _apply_to(cache: dict[str, tuple[str, str]], etype: str,
+                  pod: Pod) -> None:
         if not pod.node_name:
             return
+        entry = cache.get(pod.node_name)
+        if etype == "DELETED":
+            # Evict only if the entry still belongs to THIS pod (by
+            # name — terminal events may carry no podIP): during a
+            # rolling update the replacement's ADDED can land before
+            # the old pod's DELETED, and popping unconditionally
+            # would evict the live replacement.
+            if entry is not None and entry[1] == pod.name:
+                cache.pop(pod.node_name, None)
+            return
+        if pod.pod_ip:
+            cache[pod.node_name] = (pod.pod_ip, pod.name)
+
+    def _apply(self, etype: str, pod: Pod) -> None:
         with self._lock:
-            entry = self._cache.get(pod.node_name)
-            if etype == "DELETED":
-                # Evict only if the entry still belongs to THIS pod (by
-                # name — terminal events may carry no podIP): during a
-                # rolling update the replacement's ADDED can land before
-                # the old pod's DELETED, and popping unconditionally
-                # would evict the live replacement.
-                if entry is not None and entry[1] == pod.name:
-                    self._cache.pop(pod.node_name, None)
-                return
-            if pod.pod_ip:
-                self._cache[pod.node_name] = (pod.pod_ip, pod.name)
+            self._apply_to(self._cache, etype, pod)
+            if self._journal is not None:  # a LIST is in flight: journal too
+                self._journal.append((etype, pod))
 
     def _refresh(self) -> None:
-        pods = self.kube.list_pods(
-            self.cfg.worker_namespace,
-            label_selector=self.cfg.worker_label_selector)
-        cache: dict[str, tuple[str, str]] = {}
-        for pod_json in pods:
-            p = Pod(pod_json)
-            if p.node_name and p.pod_ip:
-                cache[p.node_name] = (p.pod_ip, p.name)
+        with self._refresh_mu:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        """LIST + journal-merged swap. Caller holds _refresh_mu (only one
+        LIST may be in flight — a second would stomp the journal)."""
         with self._lock:
-            self._cache = cache
-            self._last_list = time.monotonic()
+            self._journal = []
+        try:
+            pods = self.kube.list_pods(
+                self.cfg.worker_namespace,
+                label_selector=self.cfg.worker_label_selector)
+            cache: dict[str, tuple[str, str]] = {}
+            for pod_json in pods:
+                p = Pod(pod_json)
+                if p.node_name and p.pod_ip:
+                    cache[p.node_name] = (p.pod_ip, p.name)
+            with self._lock:
+                # Watch deltas that raced the LIST win over its snapshot.
+                for etype, pod in self._journal:
+                    self._apply_to(cache, etype, pod)
+                self._cache = cache
+                self._last_list = time.monotonic()
+        finally:
+            with self._lock:
+                self._journal = None
         self._primed.set()
 
     def _watch_loop(self) -> None:
@@ -150,7 +178,7 @@ class WorkerRegistry:
                 if time.monotonic() - self._last_list \
                         <= self.MISS_RELIST_INTERVAL_S:
                     return
-            self._refresh()
+            self._refresh_locked()
 
     def worker_address(self, node_name: str) -> str | None:
         self._ensure_started()

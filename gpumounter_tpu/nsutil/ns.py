@@ -150,11 +150,13 @@ def remove_device_file(target_dev_dir: str, dev: TpuDevice,
 
 def scan_container_dev_nodes(pid: int | None, dev_dir: str = "/dev",
                              max_nodes: int = 256,
-                             max_depth: int = 3) -> list[tuple[str, int, int]]:
-    """(rel_path, major, minor) of every char-device node in the target's
-    /dev tree — the ground truth for the device set the container was
-    started with (device-plugin devices like /dev/fuse, spec-declared
-    devices, runtime defaults).
+                             max_depth: int = 3,
+                             ) -> list[tuple[str, int, int, int]]:
+    """(rel_path, major, minor, mode) of every char-device node in the
+    target's /dev tree — the ground truth for the device set the container
+    was started with (device-plugin devices like /dev/fuse, spec-declared
+    devices, runtime defaults). `mode` is the stat st_mode (permission
+    bits drive how much cgroup access a folded base rule grants).
 
     For a live container this reads /proc/<pid>/root<dev_dir> — no
     namespace entry needed. The v2 eBPF replacement program folds these in
@@ -165,7 +167,7 @@ def scan_container_dev_nodes(pid: int | None, dev_dir: str = "/dev",
     """
     root = (os.path.join(f"/proc/{pid}/root", dev_dir.lstrip("/"))
             if pid is not None else dev_dir)
-    nodes: list[tuple[str, int, int]] = []
+    nodes: list[tuple[str, int, int, int]] = []
     base_depth = root.rstrip("/").count("/")
     for dirpath, dirnames, filenames in os.walk(root):
         if dirpath.rstrip("/").count("/") - base_depth >= max_depth:
@@ -179,7 +181,8 @@ def scan_container_dev_nodes(pid: int | None, dev_dir: str = "/dev",
             if not statmod.S_ISCHR(st.st_mode):
                 continue
             rel = os.path.relpath(full, root)
-            nodes.append((rel, os.major(st.st_rdev), os.minor(st.st_rdev)))
+            nodes.append((rel, os.major(st.st_rdev), os.minor(st.st_rdev),
+                          st.st_mode))
             if len(nodes) >= max_nodes:
                 logger.warning(
                     "container %s has > %d device nodes; base-rule scan "

@@ -32,7 +32,7 @@ from gpumounter_tpu.cgroup import (
     device_controller,
     get_cgroup_pids,
 )
-from gpumounter_tpu.cgroup.ebpf import DeviceRule
+from gpumounter_tpu.cgroup.ebpf import DEFAULT_CONTAINER_RULES, DeviceRule
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.backend import DeviceBackend, scan_proc_for_device
 from gpumounter_tpu.device.tpu import TpuDevice
@@ -43,6 +43,37 @@ from gpumounter_tpu.utils.metrics import MOUNT_LATENCY, MOUNT_TOTAL, PHASE_LATEN
 from gpumounter_tpu.utils.timing import PhaseTimer
 
 logger = get_logger("mounter")
+
+
+# Char devices runc's OCI default spec grants rwm in every container —
+# derived from DEFAULT_CONTAINER_RULES (the single source of truth the v2
+# replacement program always carries) so the two can't drift.
+_RUNC_DEFAULT_RWM: frozenset[tuple[int, int | None]] = frozenset(
+    (r.major, r.minor) for r in DEFAULT_CONTAINER_RULES
+    if r.type == "c" and "r" in r.access and r.major is not None)
+
+
+def _fold_access(major: int, minor: int, mode: int) -> str:
+    """Access string for a base rule folded from a scanned /dev node.
+
+    ADVICE r2 low: a blanket "rwm" grants every scanned node write for
+    the life of the grant, wider than the container's original runc
+    program may have allowed (e.g. a read-only node gaining write). The
+    OCI default-device set keeps its spec-mandated rwm; everything else
+    (device-plugin nodes, spec-declared devices) derives r/w from the
+    node's permission bits — the honest signal available. mknod stays
+    covered for every device by DEFAULT_CONTAINER_RULES' wildcard
+    `c *:* m` / `b *:* m` entries (runc parity), which the replacement
+    program always includes, so no folded rule needs to add it.
+    """
+    if (major, minor) in _RUNC_DEFAULT_RWM or (major, None) in _RUNC_DEFAULT_RWM:
+        return "rwm"
+    access = ""
+    if mode & 0o444:
+        access += "r"
+    if mode & 0o222:
+        access += "w"
+    return access or "r"  # a 000-mode node still shouldn't break on stat-open
 
 
 class MountError(RuntimeError):
@@ -160,11 +191,12 @@ class TpuMounter:
         scanned = nsutil.scan_container_dev_nodes(target.ns_pid,
                                                   target.dev_dir)
         folded = 0
-        for rel, major, minor in scanned:
+        for rel, major, minor, mode in scanned:
             if (major, minor) in seen or (major, minor) in own_chips:
                 continue
             seen.add((major, minor))
-            rules.append(DeviceRule("c", major, minor, "rwm"))
+            rules.append(DeviceRule("c", major, minor,
+                                    _fold_access(major, minor, mode)))
             folded += 1
         logger.info(
             "v2 base rules for %s: %d caller rule(s) + %d/%d scanned /dev "

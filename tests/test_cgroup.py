@@ -197,19 +197,20 @@ def test_scan_container_dev_nodes(tmp_path):
     (dev / "not-a-device").write_text("")  # regular files are skipped
 
     nodes = nsutil.scan_container_dev_nodes(None, str(dev))
-    rels = sorted(r for r, _, _ in nodes)
+    rels = sorted(r for r, _, _, _ in nodes)
     if made_char:
         assert rels == ["fuse", "vfio/vfio"]
-        for _, major, minor in nodes:
+        for _, major, minor, mode in nodes:
             assert (major, minor) == (os.major(null.st_rdev),
                                       os.minor(null.st_rdev))
+            assert mode & 0o444  # read bits survive the umask
     else:
         assert rels == []
 
     # the host's own /dev always yields /dev/null itself
     host_nodes = nsutil.scan_container_dev_nodes(None, "/dev",
                                                  max_nodes=4096)
-    assert ("null", 1, 3) in host_nodes
+    assert ("null", 1, 3) in [(r, ma, mi) for r, ma, mi, _ in host_nodes]
 
 
 def test_v2_base_rules_merge(tmp_path):
@@ -341,3 +342,17 @@ def test_attach_cycle_real_cgroup2():
         os.close(prog)
         os.close(fd)
         os.rmdir(cgdir)
+
+
+def test_fold_access_derives_from_mode():
+    """ADVICE r2 low: folded base rules must not blanket-grant rwm.
+    OCI default devices keep rwm; other nodes derive r/w from permission
+    bits and never gain mknod."""
+    from gpumounter_tpu.worker.mounter import _fold_access
+
+    assert _fold_access(1, 3, 0o20666) == "rwm"    # /dev/null: OCI default
+    assert _fold_access(136, 7, 0o20620) == "rwm"  # /dev/pts/*: wildcard
+    assert _fold_access(10, 229, 0o20666) == "rw"  # /dev/fuse: plugin node
+    assert _fold_access(10, 229, 0o20444) == "r"   # read-only node stays ro
+    assert _fold_access(10, 229, 0o20000) == "r"   # 000-mode: minimal floor
+    assert "m" not in _fold_access(508, 0, 0o20666)

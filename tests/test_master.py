@@ -235,3 +235,47 @@ def test_busy_maps_to_400(stack):
         assert status == 400 and "running processes" in body
     finally:
         holder.close()
+
+
+def test_registry_refresh_does_not_lose_racing_watch_event(tmp_path):
+    """ADVICE r2 low: a watch DELETED applied between the LIST response
+    and the cache swap must not be resurrected by the swap (it used to be
+    lost until the next watch re-open, ~60 s)."""
+    from gpumounter_tpu.k8s.types import Pod as _Pod
+
+    cluster = FakeCluster(str(tmp_path), n_chips=1).start()
+    try:
+        cfg = cluster.cfg
+        kube = cluster.kube
+        kube.create_pod(cfg.worker_namespace,
+                        _worker_pod("w1", "node-a", "10.0.0.1",
+                                    cfg.worker_namespace))
+        reg = WorkerRegistry(kube, cfg)
+        try:
+            assert reg.worker_address("node-a") is not None
+
+            # Simulate the race deterministically: while the LIST is in
+            # flight (its response already includes w1), the watch thread
+            # applies DELETED for w1 before the swap.
+            orig_list = kube.list_pods
+            deleted_pod = _Pod({
+                "metadata": {"name": "w1",
+                             "namespace": cfg.worker_namespace},
+                "spec": {"nodeName": "node-a"},
+                "status": {}})
+
+            def racing_list(*args, **kwargs):
+                pods = orig_list(*args, **kwargs)
+                reg._apply("DELETED", deleted_pod)  # the racing delta
+                return pods
+
+            kube.list_pods = racing_list
+            reg._last_list = -1e9  # defeat the miss-refresh rate limit
+            reg._refresh()
+            with reg._lock:
+                assert "node-a" not in reg._cache, \
+                    "LIST snapshot resurrected a worker deleted mid-LIST"
+        finally:
+            reg.stop()
+    finally:
+        cluster.stop()
