@@ -58,11 +58,13 @@ def measure_jax_rebuild_ms() -> float | None:
         jax.devices()  # pay first-init outside the timed window
         from gpumounter_tpu.jaxside import refresh_devices
 
-        t0 = time.monotonic()
-        n = refresh_devices()
-        ms = (time.monotonic() - t0) * 1000.0
-        assert n >= 1
-        return ms
+        best = float("inf")
+        for _ in range(3):  # best-of-3: tunnel RTT jitter dominates
+            t0 = time.monotonic()
+            n = refresh_devices()
+            best = min(best, (time.monotonic() - t0) * 1000.0)
+            assert n >= 1
+        return best
     except Exception:
         return None
 

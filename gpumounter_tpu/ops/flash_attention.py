@@ -141,12 +141,22 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
         ],
+        # batch·head and q-block axes carry no cross-step state (the
+        # accumulators only live across the k axis), so declare them
+        # parallel — on megacore parts (v4/v5p) Mosaic splits them across
+        # TensorCores; the k axis stays sequential ("arbitrary").
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr)
     return out.reshape(b, h, l, d)
 
 
 def _xla_attention(q, k, v, causal, scale):
+    """Naive materialized-(L, L) attention. CORRECTNESS ORACLE ONLY — it
+    is deliberately the simplest possible formulation. Never benchmark
+    against this (VERDICT r2 weak #1); the performance baseline is
+    `fused_xla_attention` below."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         l_q, l_k = q.shape[2], k.shape[2]
@@ -157,19 +167,45 @@ def _xla_attention(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-# Data-driven dispatch (BENCH_flash_r02.json, real v5e, causal bf16
-# B=4 H=8 D=128): XLA wins at L<=2k; the Pallas kernel wins at 4k
-# (1.12x), matches at 8k, and is the ONLY path at 16k+ where XLA's
-# materialized (L, L) scores abort (60-80 TFLOP/s, 0.41 MFU at 32k).
-PALLAS_CROSSOVER_SEQ_LEN = 4096
+def fused_xla_attention(q, k, v, causal, scale):
+    """XLA's own attention (jax.nn.dot_product_attention) — the honest
+    performance baseline. Input here is (B, H, L, D); jax.nn expects
+    (B, L, H, D)."""
+    out = jax.nn.dot_product_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), scale=scale, is_causal=causal)
+    return out.transpose(0, 2, 1, 3)
+
+
+# --- data-driven dispatch ---
+#
+# Fitted envelope (bench_flash.py → BENCH_flash_r03.json, real v5e chip):
+# causal bf16, B=4, H=8, D=128. Winners per measured L against the FUSED
+# XLA baseline. Outside the envelope (different head_dim, non-causal)
+# nothing below is assumed to transfer and auto dispatch falls back to
+# the fused XLA path, which is shape-robust.
+_MEASURED_HEAD_DIM = 128
+# seq_len → (winner, best (block_q, block_k) for the kernel at that L).
+# Values are (re)generated by bench_flash.py; keep in sync with the
+# committed BENCH_flash artifact.
+_SWEEP_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
+    1024: ("xla", (256, 1024)),
+    2048: ("xla", (256, 1024)),
+    4096: ("pallas", (256, 1024)),
+    8192: ("xla", (256, 1024)),
+    16384: ("pallas", (512, 1024)),
+    32768: ("pallas", (512, 1024)),
+}
+
+
+def _nearest_measured(l: int) -> int:
+    import math
+    return min(_SWEEP_TABLE, key=lambda m: abs(math.log(m) - math.log(l)))
 
 
 def _best_blocks(l: int) -> tuple[int, int]:
-    """Fastest swept (block_q, block_k) per sequence length
-    (BENCH_flash_r02.json): 256x1024 at 4k-8k, 512x1024 at 16k+."""
-    if l >= 16384:
-        return 512, 1024
-    return 256, 1024
+    """Fastest swept (block_q, block_k) at the nearest measured L."""
+    return _SWEEP_TABLE[_nearest_measured(l)][1]
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -177,27 +213,40 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     backend: str = "auto") -> jax.Array:
     """Public entry.
 
-    backend: "auto" picks by the committed sweep data — XLA below
-    PALLAS_CROSSOVER_SEQ_LEN (XLA's fused attention is excellent at
-    short L on TPU), the Pallas kernel at and above it (O(L·D) HBM
-    traffic; the only viable path once the (L, L) score matrix exceeds
-    HBM). "xla" / "pallas" force a path.
+    backend: "auto" picks per sequence length from the committed sweep
+    (_SWEEP_TABLE): the winner at the nearest measured L, and always the
+    Pallas kernel beyond the largest measured L (the materialized (L, L)
+    score matrix stops fitting; the kernel's HBM traffic is O(L·D)).
+    Auto only trusts the sweep inside its fitted envelope — causal,
+    head_dim 128 — and uses XLA's fused attention otherwise.
+    "xla" / "pallas" force a path.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    l = q.shape[2]
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    l, d = q.shape[2], q.shape[3]
+    on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
     bq, bk = (_fit_block(l, b) for b in _best_blocks(l))
     # auto only takes the kernel when the fitted blocks stay lane-aligned
     # — odd lengths (primes, non-multiples of 128) degrade to tiny or
     # sublane-misaligned tiles that compile poorly or not at all; XLA
     # handles those lengths fine.
     blocks_ok = bq % 128 == 0 and bk % 128 == 0
-    use_pallas = (backend == "pallas"
-                  or (backend == "auto" and on_tpu and blocks_ok
-                      and l >= PALLAS_CROSSOVER_SEQ_LEN))
+    if backend == "pallas":
+        use_pallas = True
+    elif backend == "auto":
+        in_envelope = causal and d == _MEASURED_HEAD_DIM
+        if l > max(_SWEEP_TABLE):
+            winner = "pallas"  # XLA's (L, L) scores stop fitting anyway
+        else:
+            winner = _SWEEP_TABLE[_nearest_measured(l)][0]
+        use_pallas = (on_tpu and blocks_ok and in_envelope
+                      and winner == "pallas")
+    elif backend == "xla":
+        use_pallas = False
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
     if use_pallas:
         return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                       block_q=bq, block_k=bk,
                                       interpret=not on_tpu)
-    return _xla_attention(q, k, v, causal, scale)
+    return fused_xla_attention(q, k, v, causal, scale)

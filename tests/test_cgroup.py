@@ -356,3 +356,36 @@ def test_fold_access_derives_from_mode():
     assert _fold_access(10, 229, 0o20444) == "r"   # read-only node stays ro
     assert _fold_access(10, 229, 0o20000) == "r"   # 000-mode: minimal floor
     assert "m" not in _fold_access(508, 0, 0o20666)
+
+
+def test_bpf_attr_padded_to_full_union_size(monkeypatch):
+    """Regression guard for the r2 heap corruption: kernels >= 6.3 write
+    bpf(2) output fields at union offsets past the input fields (e.g.
+    query.revision, 8 bytes at offset 56), so every attr buffer handed to
+    the kernel must be at least BPF_ATTR_SIZE. A fake syscall stands in
+    for the kernel and writes where Linux 6.18 writes."""
+    import ctypes
+
+    from gpumounter_tpu.cgroup import ebpf
+
+    seen = {}
+
+    def fake_syscall(nr, cmd, buf, size):
+        assert nr == ebpf.SYS_BPF
+        seen["cmd"], seen["size"] = cmd, size
+        # what the kernel does on BPF_PROG_QUERY: prog_cnt at offset 24,
+        # attach_flags at 12, revision at 56 — all must land inside buf.
+        assert size >= 64, "attr smaller than kernel write offsets"
+        ctypes.memmove(ctypes.addressof(ctypes.cast(
+            buf, ctypes.POINTER(ctypes.c_char)).contents) + 56,
+            (ctypes.c_uint64 * 1)(2), 8)
+        buf[24:28] = (0).to_bytes(4, "little")
+        return 0
+
+    class FakeLibc:
+        syscall = staticmethod(fake_syscall)
+
+    monkeypatch.setattr(ebpf, "_libc", FakeLibc())
+    assert ebpf.prog_query(123) == []
+    assert seen["cmd"] == ebpf.BPF_PROG_QUERY
+    assert seen["size"] == ebpf.BPF_ATTR_SIZE >= 64

@@ -41,14 +41,15 @@ import numpy as np
 assert jax.process_count() == int(os.environ["TPM_NPROC"]), \
     jax.process_count()
 devices = jax.devices()
-assert len(devices) == 8, devices  # 2 processes x 4 local CPU devices
+n_expected = int(os.environ["TPM_EXPECT_DEVICES"])
+assert len(devices) == n_expected, devices
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 mesh = Mesh(np.array(devices), ("data",))
 local = jnp.arange(4, dtype=jnp.float32) + 10.0 * jax.process_index()
 garr = jax.make_array_from_process_local_data(
-    NamedSharding(mesh, P("data")), np.asarray(local), (8,))
+    NamedSharding(mesh, P("data")), np.asarray(local), (n_expected,))
 
 try:
     from jax import shard_map
@@ -59,10 +60,52 @@ summed = jax.jit(shard_map(
     lambda x: jax.lax.psum(x, "data"), mesh=mesh,
     in_specs=P("data"), out_specs=P()))(garr)
 total = float(np.asarray(summed)[0])
-# sum over both processes' shards: (0+1+2+3) + (10+11+12+13) = 52
-assert total == 52.0, total
+assert total == float(os.environ["TPM_EXPECT_TOTAL"]), total
+
+# Every topology env var this process consumed must be exactly what the
+# master's plan said — nothing rewritten locally (VERDICT r2 #9).
+for key, val in worker["env"].items():
+    assert os.environ[key] == val, (key, os.environ[key], val)
 print("PSUM_OK", total, flush=True)
 """
+
+
+def _run_slice(plan, nproc, expect_devices, expect_total,
+               local_devices=4, timeout=300):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    env_base = dict(os.environ)
+    env_base.pop("PYTHONPATH", None)  # skip the site TPU plugin entirely
+    env_base.update({
+        "TPM_REPO": REPO_ROOT,
+        "TPM_COORD": coord,
+        "TPM_NPROC": str(nproc),
+        "TPM_EXPECT_DEVICES": str(expect_devices),
+        "TPM_EXPECT_TOTAL": str(expect_total),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={local_devices}",
+    })
+    procs = []
+    for worker in plan["workers"]:
+        env = dict(env_base)
+        env["TPM_PLAN_WORKER"] = json.dumps(worker)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER_PROG], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-3000:]
+        assert f"PSUM_OK {float(expect_total)}" in out, (out, err[-1500:])
 
 
 @pytest.mark.slow
@@ -85,35 +128,35 @@ def test_two_host_virtual_slice_psum(tmp_path):
     assert plan["slice"]["TPU_HOST_BOUNDS"] in ("1,2,1", "2,1,1")
     assert plan["slice"]["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    # sum over both processes' shards: (0+1+2+3) + (10+11+12+13) = 52
+    _run_slice(plan, nproc=2, expect_devices=8, expect_total=52.0)
 
-    env_base = dict(os.environ)
-    env_base.pop("PYTHONPATH", None)  # skip the site TPU plugin entirely
-    env_base.update({
-        "TPM_REPO": REPO_ROOT,
-        "TPM_COORD": coord,
-        "TPM_NPROC": "2",
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-    })
-    procs = []
-    for worker in plan["workers"]:
-        env = dict(env_base)
-        env["TPM_PLAN_WORKER"] = json.dumps(worker)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER_PROG], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, err[-3000:]
-        assert "PSUM_OK 52.0" in out, (out, err[-1500:])
+
+@pytest.mark.slow
+def test_v5litepod16_four_host_slice_psum(tmp_path):
+    """VERDICT r2 #9: the published v5litepod-16 plan (4 hosts x 4 chips,
+    HOST_BOUNDS 2,2,1) fed end-to-end through 4 REAL processes; every env
+    var each process consumed came from topology_plan verbatim."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from gpumounter_tpu.master.slice_ops import (
+            SliceTarget, topology_plan)
+    finally:
+        sys.path.pop(0)
+
+    targets = [SliceTarget("default", f"rank-{i}") for i in range(4)]
+    plan = topology_plan(targets,
+                         [f"host-{i}" for i in range(4)],
+                         ["127.0.0.1"] * 4, 4,
+                         accel_type="v5litepod-16")
+    # Published geometry, used verbatim: 4x4 chip grid over 2x2 hosts.
+    assert plan["slice"]["TPU_HOST_BOUNDS"] == "2,2,1"
+    assert plan["slice"]["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert plan["slice"]["TPU_ACCELERATOR_TYPE"] == "v5litepod-16"
+    assert plan["slice"]["total_chips"] == 16
+    worker_ids = sorted(int(w["env"]["TPU_WORKER_ID"])
+                        for w in plan["workers"])
+    assert worker_ids == [0, 1, 2, 3]
+
+    # sum over 4 processes' shards: 4*(0+1+2+3) + 4*10*(0+1+2+3) = 264
+    _run_slice(plan, nproc=4, expect_devices=16, expect_total=264.0)

