@@ -29,9 +29,11 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-but-finite: -inf breaks the m==NEG_INF row fixups
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_k: int, n_k: int, scale: float,
-                  causal: bool):
+                  causal: bool, with_lse: bool = False):
+    lse_ref = rest[0] if with_lse else None
+    m_scr, l_scr, acc_scr = rest[-3:]
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -84,6 +86,124 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _writeback():
         denom = jnp.maximum(l_scr[:, 0:1], 1e-30)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        if with_lse:
+            # log-sum-exp per q row: m + log(denom). Rows with every key
+            # masked keep m == NEG_INF, so their lse stays ~NEG_INF and a
+            # cross-chunk combine weights them exp(NEG_INF - x) == 0.
+            # Written 8x sublane-redundant — Mosaic requires the last two
+            # block dims be (8k, 128m), so a flat (1, block_q) lse block
+            # is unlowerable; callers read sublane 0.
+            m_col = m_scr[:, 0:1]
+            lse = jnp.where(m_col <= NEG_INF / 2, NEG_INF,
+                            m_col + jnp.log(denom))
+            lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :],
+                                          lse_ref.shape[1:])
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, block_q: int, block_k: int,
+                         n_k: int, scale: float, causal: bool):
+    """dq = Σ_k  [p ∘ (do·vᵀ − Δ)]·k·scale, accumulated over k blocks.
+
+    p is recomputed from the saved lse (p = exp(s − lse)); Δ is the
+    caller-precomputed rowsum(do∘o) − dlse, which folds an incoming lse
+    cotangent into the same kernel (∂lse/∂s == p)."""
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]                                # (block_q,)
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_idx = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+        # Fully-masked rows keep lse == NEG_INF; exp(s - NEG_INF) would
+        # overflow, so zero them explicitly. Reshape the f32 column FIRST
+        # and compare in 2-D: Mosaic cannot insert a minor dim on the i1
+        # vector a 1-D comparison would produce.
+        lse_col = lse[:, None]
+        p = jnp.where(lse_col <= NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == n_k - 1)
+    def _writeback():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+                          block_k: int, n_q: int, scale: float,
+                          causal: bool):
+    """dk = Σ_q dsᵀ·q·scale and dv = Σ_q pᵀ·do, accumulated over q blocks
+    for one k block (grid: (batch·heads, k-blocks, q-blocks), last axis
+    sequential so the scratch accumulators persist)."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_idx = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+        lse_col = lse[:, None]
+        p = jnp.where(lse_col <= NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == n_q - 1)
+    def _writeback():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _fit_block(l: int, want: int) -> int:
@@ -106,10 +226,18 @@ def _fit_block(l: int, want: int) -> int:
 def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            causal: bool = True, scale: float | None = None,
                            block_q: int = 256, block_k: int = 512,
-                           interpret: bool = False) -> jax.Array:
+                           interpret: bool = False,
+                           return_lse: bool = False):
     """(B, H, L, D) attention via the Pallas kernel. Block sizes are
     clamped to L and reduced to the largest dividing size when the
-    requested blocks do not divide L."""
+    requested blocks do not divide L.
+
+    return_lse additionally returns the per-row log-sum-exp
+    (B, H, L) float32 — `m + log(denominator)` of the online softmax —
+    which lets callers combine partial attention over key chunks
+    processed elsewhere (ring attention / flash decoding):
+    ``o = sum_i o_i * exp(lse_i - logsumexp_i(lse_i))``.
+    """
     b, h, l, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -124,18 +252,40 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
-        scale=scale, causal=causal)
+        scale=scale, causal=causal, with_lse=return_lse)
+    if causal:
+        # Causal DMA skip: iterations whose whole k block is in the
+        # future of the q block are compute-skipped by pl.when, but the
+        # BlockSpec would still stream their K/V from HBM — for nk ≈ nq
+        # that is ~2x the necessary K/V traffic, and the kernel is
+        # HBM-bound at large L. Clamping the index map makes every
+        # masked-out iteration re-reference the block already resident
+        # in VMEM; Mosaic detects the unchanged index and elides the
+        # copy, so K/V traffic drops to only the needed blocks.
+        def kv_index(bh, iq, ik):
+            last_needed = (iq * block_q + block_q - 1) // block_k
+            return (bh, jnp.minimum(ik, last_needed), 0)
+    else:
+        def kv_index(bh, iq, ik):
+            return (bh, ik, 0)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        out_specs=(
+            [pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+             pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq))]
+            if return_lse else
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))),
+        out_shape=(
+            [jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+             jax.ShapeDtypeStruct((b * h, 8, l), jnp.float32)]
+            if return_lse else
+            jax.ShapeDtypeStruct((b * h, l, d), q.dtype)),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
@@ -149,7 +299,167 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr)
+    if return_lse:
+        o, lse = out
+        return o.reshape(b, h, l, d), lse[:, 0, :].reshape(b, h, l)
     return out.reshape(b, h, l, d)
+
+
+def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
+                    block_q: int, block_k: int, interpret: bool):
+    """Run the two backward kernels; q/k/v/do are (B, H, L, D), lse/delta
+    (B, H, L) float32. Returns (dq, dk, dv) in the input dtype."""
+    b, h, l, d = q.shape
+    block_q = _fit_block(l, block_q)
+    block_k = _fit_block(l, block_k)
+    n_q = l // block_q
+    n_k = l // block_k
+    qr, kr, vr, dor = (x.reshape(b * h, l, d) for x in (q, k, v, do))
+    # 8x sublane-redundant rows (same Mosaic tiling rule as the forward
+    # lse output); the kernels read sublane 0.
+    lser = jnp.broadcast_to(lse.reshape(b * h, 1, l), (b * h, 8, l))
+    deltar = jnp.broadcast_to(delta.reshape(b * h, 1, l), (b * h, 8, l))
+
+    if causal:
+        # Same DMA-skip trick as the forward kernel, in both directions:
+        # dq iterates k blocks (clamp above the diagonal), dk/dv iterates
+        # q blocks (clamp below it).
+        def kv_index(bh, iq, ik):
+            last = (iq * block_q + block_q - 1) // block_k
+            return (bh, jnp.minimum(ik, last), 0)
+
+        def q_index(bh, ik, iq):
+            first = (ik * block_k) // block_q
+            return (bh, jnp.maximum(iq, first), 0)
+
+        def qrow_index(bh, ik, iq):
+            first = (ik * block_k) // block_q
+            return (bh, 0, jnp.maximum(iq, first))
+    else:
+        def kv_index(bh, iq, ik):
+            return (bh, ik, 0)
+
+        def q_index(bh, ik, iq):
+            return (bh, iq, 0)
+
+        def qrow_index(bh, ik, iq):
+            return (bh, 0, iq)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, n_k=n_k, scale=scale,
+                          causal=causal),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, iq, ik: (bh, 0, iq)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, n_q=n_q, scale=scale,
+                          causal=causal),
+        grid=(b * h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, 8, block_q), qrow_index),
+            pl.BlockSpec((1, 8, block_q), qrow_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, l, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, l, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+    unflat = lambda x: x.reshape(b, h, l, d)
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
+                             block_q: int, block_k: int, interpret: bool):
+    """Differentiable flash attention returning (o, lse). The VJP runs
+    the blockwise backward kernels (O(L·D) memory — no (L, L) score
+    matrix in either direction); an incoming lse cotangent is folded
+    into the Δ term, so ring attention's lse-weighted combine
+    differentiates through this too."""
+    return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret, return_lse=True)
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = flash_attention_with_lse(q, k, v, causal, scale, block_q,
+                                      block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, cot):
+    q, k, v, o, lse = res
+    do, dlse = cot
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1) - dlse.astype(jnp.float32)
+    dq, dk, dv = _flash_backward(q, k, v, do, lse, delta, causal=causal,
+                                 scale=scale, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
+                               interpret):
+    """Public-path primal: the EXACT kernel the committed sweep timed
+    (no lse output). Only under differentiation does the fwd rule switch
+    to the with-lse kernel — lse is a residual the backward needs anyway
+    — so inference dispatch constants and the sweep evidence stay in
+    agreement."""
+    return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret)
+
+
+def _trainable_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = flash_attention_pallas(q, k, v, causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k,
+                                    interpret=interpret, return_lse=True)
+    return o, (q, k, v, o, lse)
+
+
+def _trainable_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    return _flash_backward(q, k, v, do, lse, delta, causal=causal,
+                           scale=scale, block_q=block_q, block_k=block_k,
+                           interpret=interpret)
+
+
+_flash_attention_trainable.defvjp(_trainable_fwd, _trainable_bwd)
 
 
 def _xla_attention(q, k, v, causal, scale):
@@ -189,13 +499,24 @@ _MEASURED_HEAD_DIM = 128
 # Values are (re)generated by bench_flash.py; keep in sync with the
 # committed BENCH_flash artifact.
 _SWEEP_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
-    1024: ("xla", (256, 1024)),
-    2048: ("xla", (256, 1024)),
-    4096: ("pallas", (256, 1024)),
-    8192: ("xla", (256, 1024)),
-    16384: ("pallas", (512, 1024)),
-    32768: ("pallas", (512, 1024)),
+    1024: ("pallas", (256, 1024)),
+    2048: ("pallas", (1024, 1024)),
+    4096: ("pallas", (512, 512)),
+    8192: ("pallas", (512, 1024)),
+    16384: ("pallas", (512, 2048)),
+    32768: ("pallas", (1024, 1024)),
 }
+
+
+def _target_platform() -> str:
+    """Platform the computation will actually run on: an explicitly set
+    default device (e.g. tests pinning jax.default_device to CPU on a
+    TPU-attached host) wins over the priority-ordered backend list."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        # jax accepts both a Device object and a platform string here.
+        return dev if isinstance(dev, str) else dev.platform
+    return jax.default_backend()
 
 
 def _nearest_measured(l: int) -> int:
@@ -214,17 +535,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Public entry.
 
     backend: "auto" picks per sequence length from the committed sweep
-    (_SWEEP_TABLE): the winner at the nearest measured L, and always the
-    Pallas kernel beyond the largest measured L (the materialized (L, L)
-    score matrix stops fitting; the kernel's HBM traffic is O(L·D)).
-    Auto only trusts the sweep inside its fitted envelope — causal,
-    head_dim 128 — and uses XLA's fused attention otherwise.
+    (_SWEEP_TABLE): the winner at the nearest measured L. Inside the
+    sweep range auto only trusts the sweep inside its fitted envelope —
+    causal, head_dim 128 — and uses XLA's fused attention otherwise.
+    Beyond the largest measured L the fused path stops being a fallback
+    (its materialized (L, L) scores abort the compile), so auto takes
+    the O(L·D) kernel whenever the tiles are lane-aligned — even
+    out-of-envelope — and raises a clear error when they are not.
     "xla" / "pallas" force a path.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     l, d = q.shape[2], q.shape[3]
-    on_tpu = any(dev.platform == "tpu" for dev in jax.devices())
+    on_tpu = _target_platform() == "tpu"
     bq, bk = (_fit_block(l, b) for b in _best_blocks(l))
     # auto only takes the kernel when the fitted blocks stay lane-aligned
     # — odd lengths (primes, non-multiples of 128) degrade to tiny or
@@ -234,19 +557,36 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if backend == "pallas":
         use_pallas = True
     elif backend == "auto":
-        in_envelope = causal and d == _MEASURED_HEAD_DIM
         if l > max(_SWEEP_TABLE):
-            winner = "pallas"  # XLA's (L, L) scores stop fitting anyway
+            # Beyond the largest measured L the fused XLA path is not a
+            # fallback but a crash: its default implementation
+            # materializes (L, L) f32 logits (137 GB at B=4 H=8 L=32k)
+            # and the compile aborts. Take the O(L·D) kernel whenever
+            # its tiles are lane-aligned, even outside the fitted
+            # (causal, D=128) envelope — perf there is unmeasured, but
+            # it runs.
+            use_pallas = on_tpu and blocks_ok
+            if on_tpu and not blocks_ok:
+                # Refuse loudly: the fused path would abort with an
+                # opaque compile OOM at this L anyway.
+                raise ValueError(
+                    f"flash_attention auto dispatch: L={l} exceeds the "
+                    f"largest measured length ({max(_SWEEP_TABLE)}) but "
+                    f"does not tile into lane-aligned blocks "
+                    f"(fit: {bq}x{bk}); pad L to a multiple of 128 or "
+                    f"force backend='pallas'/'xla' explicitly")
         else:
+            in_envelope = causal and d == _MEASURED_HEAD_DIM
             winner = _SWEEP_TABLE[_nearest_measured(l)][0]
-        use_pallas = (on_tpu and blocks_ok and in_envelope
-                      and winner == "pallas")
+            use_pallas = (on_tpu and blocks_ok and in_envelope
+                          and winner == "pallas")
     elif backend == "xla":
         use_pallas = False
     else:
         raise ValueError(f"unknown backend {backend!r}")
     if use_pallas:
-        return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
-                                      block_q=bq, block_k=bk,
-                                      interpret=not on_tpu)
+        # Custom-VJP wrapper: trainable (blockwise backward kernels, no
+        # (L, L) matrix), and its primal is the exact swept kernel.
+        return _flash_attention_trainable(q, k, v, causal, scale, bq, bk,
+                                          not on_tpu)
     return fused_xla_attention(q, k, v, causal, scale)

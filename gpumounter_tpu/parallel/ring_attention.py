@@ -87,20 +87,120 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
     return out.astype(q.dtype)
 
 
+def _combine_chunks(o_prev, lse_prev, o_chunk, lse_chunk):
+    """Merge two normalized partial-attention results via their
+    log-sum-exps: o = Σᵢ oᵢ·exp(lseᵢ − logaddexp(lse₁, lse₂))."""
+    lse_new = jnp.logaddexp(lse_prev, lse_chunk)
+    w_prev = jnp.exp(lse_prev - lse_new)[..., None]
+    w_chunk = jnp.exp(lse_chunk - lse_new)[..., None]
+    return o_prev * w_prev + o_chunk * w_chunk, lse_new
+
+
+def _ring_flash_local(q, k, v, *, axis_name: str, scale: float,
+                      causal: bool, block_q: int, block_k: int,
+                      interpret: bool):
+    """Per-device ring body with the Pallas flash kernel as the inner
+    chunk step. Memory is O(chunk·D) — no (Lq, Lk) score matrix even per
+    chunk — and causal chunk classification is real control flow
+    (lax.cond), so fully-future chunks cost nothing on the MXU:
+
+      src >  my_idx → every key is in the future: skip entirely
+      src == my_idx → the diagonal chunk: causal flash
+      src <  my_idx → whole chunk in the past: non-causal flash
+
+    Cross-chunk combination uses the kernel's lse output
+    (flash-decoding combine), all in fp32.
+    """
+    from gpumounter_tpu.ops.flash_attention import (
+        NEG_INF, flash_attention_with_lse)
+
+    n_dev = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, lq, d = q.shape
+    o0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    # Must match the kernel's masked-row sentinel exactly: the combine
+    # weights a fully-masked chunk exp(NEG_INF - x) == 0 only if both
+    # sides use the same NEG_INF.
+    lse0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def attend(q_, k_, v_, causal_):
+        # custom-VJP wrapper: trainable, lse cotangent folded into Δ.
+        return flash_attention_with_lse(q_, k_, v_, causal_, scale,
+                                        block_q, block_k, interpret)
+
+    def step(carry, s):
+        k_cur, v_cur, o, lse = carry
+        src = (my_idx - s) % n_dev
+
+        def diag(args):
+            o, lse = args
+            oc, lsec = attend(q, k_cur, v_cur, True)
+            return _combine_chunks(o, lse, oc.astype(jnp.float32), lsec)
+
+        def past(args):
+            o, lse = args
+            oc, lsec = attend(q, k_cur, v_cur, False)
+            return _combine_chunks(o, lse, oc.astype(jnp.float32), lsec)
+
+        if causal:
+            o, lse = jax.lax.cond(
+                src > my_idx, lambda args: args,
+                lambda args: jax.lax.cond(src == my_idx, diag, past, args),
+                (o, lse))
+        else:
+            o, lse = past((o, lse))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o, lse), None
+
+    (k, v, o, lse), _ = jax.lax.scan(
+        step, (k, v, o0, lse0), jnp.arange(n_dev))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    *, seq_axis: str = "seq", causal: bool = True,
-                   scale: float | None = None) -> jax.Array:
+                   scale: float | None = None, impl: str = "auto",
+                   block_q: int = 256, block_k: int = 512) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `seq_axis`.
 
     q, k, v: (batch, heads, seq, head_dim), sharded (or shardable) with
     the sequence dimension split over `seq_axis`. Returns same shape/
     sharding. Use inside jit; XLA emits ppermute ICI transfers.
+
+    impl: "flash" runs the Pallas flash kernel per ring chunk (lse-based
+    cross-chunk combine, O(chunk·D) memory, causal chunks skipped by
+    lax.cond — interpret mode off-TPU so it works everywhere); "xla"
+    keeps the einsum online-softmax body (materializes per-chunk scores,
+    shape-robust); "auto" picks flash on TPU and xla elsewhere.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, None, seq_axis, None)
-    body = partial(_ring_attention_local, axis_name=seq_axis, scale=scale,
-                   causal=causal)
+    on_tpu = any(dev.platform == "tpu" for dev in mesh.devices.flat)
+    if impl == "auto":
+        # Same envelope discipline as ops-level auto dispatch: only take
+        # the Pallas body when the per-device chunk yields lane-aligned
+        # blocks and head_dim is the measured 128 — Mosaic compiles
+        # unaligned tiles poorly or not at all, and the previously
+        # always-XLA body handled those shapes fine.
+        from gpumounter_tpu.ops.flash_attention import (
+            _MEASURED_HEAD_DIM, _fit_block)
+        chunk = q.shape[2] // mesh.shape[seq_axis]
+        bq, bk = _fit_block(chunk, block_q), _fit_block(chunk, block_k)
+        in_envelope = (causal and q.shape[-1] == _MEASURED_HEAD_DIM
+                       and bq % 128 == 0 and bk % 128 == 0)
+        impl = "flash" if (on_tpu and in_envelope) else "xla"
+    if impl == "flash":
+        body = partial(_ring_flash_local, axis_name=seq_axis, scale=scale,
+                       causal=causal, block_q=block_q, block_k=block_k,
+                       interpret=not on_tpu)
+    elif impl == "xla":
+        body = partial(_ring_attention_local, axis_name=seq_axis,
+                       scale=scale, causal=causal)
+    else:
+        raise ValueError(f"unknown impl {impl!r}")
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from gpumounter_tpu.ops.flash_attention import (
     _xla_attention,
     flash_attention_pallas,
+    flash_attention_with_lse,
 )
 
 
@@ -75,6 +76,65 @@ def test_bf16():
                                rtol=5e-2, atol=5e-2)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_backward_kernels_match_oracle_grads(causal):
+    """The blockwise dq / dk/dv kernels (custom VJP) must agree with
+    autodiff through the materialized oracle — including the lse
+    cotangent path (ring attention's combine differentiates lse)."""
+    q, k, v = _qkv()
+    l, d = q.shape[2], q.shape[3]
+    scale = 1.0 / d ** 0.5
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal, scale,
+                                          128, 128, True)
+        return jnp.sum(o ** 2) + 0.1 * jnp.sum(lse)
+
+    def loss_ref(q, k, v):
+        o = _xla_attention(q, k, v, causal, scale)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        if causal:
+            m = jnp.arange(l)[None, :] <= jnp.arange(l)[:, None]
+            s = jnp.where(m[None, None], s, -1e30)
+        return jnp.sum(o ** 2) + 0.1 * jnp.sum(jax.nn.logsumexp(s, -1))
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
+def test_public_flash_attention_is_trainable(monkeypatch):
+    """grad() through the public entry's Pallas path must not raise and
+    must match grad through the oracle (interpret mode, pallas forced)."""
+    import importlib
+    fa = importlib.import_module("gpumounter_tpu.ops.flash_attention")
+    q, k, v = _qkv()
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        fa.flash_attention(q, k, v, backend="pallas") ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    w = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, 1.0 / q.shape[-1] ** 0.5) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=5e-3)
+
+
+def test_target_platform_accepts_string_default_device():
+    """jax_default_device may hold a platform STRING (jax-supported);
+    _target_platform must not assume a Device object."""
+    import importlib
+    fa = importlib.import_module("gpumounter_tpu.ops.flash_attention")
+    prev = jax.config.jax_default_device
+    try:
+        jax.config.update("jax_default_device", "cpu")
+        assert fa._target_platform() == "cpu"
+    finally:
+        jax.config.update("jax_default_device", prev)
+
+
 def test_dispatch_table_consistency():
     """VERDICT r2 weak #1/#5: dispatch constants must match their own
     sweep data and qualify the fitted envelope."""
@@ -121,18 +181,18 @@ def test_auto_dispatch_respects_envelope(monkeypatch):
 
     def fake_pallas(*a, **k):
         calls["pallas"] = True
+        if k.get("return_lse"):
+            import jax.numpy as jnp
+            return a[0], jnp.zeros(a[0].shape[:-1], jnp.float32)
         return a[0]
 
     def fake_fused(q, k, v, causal, scale):
         calls["fused"] = True
         return q
 
-    class FakeDev:
-        platform = "tpu"
-
     monkeypatch.setattr(fa, "flash_attention_pallas", fake_pallas)
     monkeypatch.setattr(fa, "fused_xla_attention", fake_fused)
-    monkeypatch.setattr(fa.jax, "devices", lambda *a: [FakeDev()])
+    monkeypatch.setattr(fa, "_target_platform", lambda: "tpu")
 
     import jax.numpy as jnp
     pallas_l = max(l for l, (w, _) in fa._SWEEP_TABLE.items() if w == "pallas")
@@ -157,3 +217,14 @@ def test_auto_dispatch_respects_envelope(monkeypatch):
         qx = jnp.zeros((1, 1, xla_ls[0], 128), jnp.bfloat16)
         fa.flash_attention(qx, qx, qx, causal=True)
         assert calls.pop("fused", False) and not calls.pop("pallas", False)
+
+    # BEYOND the sweep range the envelope no longer gates: fused XLA
+    # materializes (L, L) f32 there and aborts, so even out-of-envelope
+    # shapes (non-causal, D=64) must take the kernel.
+    beyond = 2 * max(fa._SWEEP_TABLE)
+    qb = jnp.zeros((1, 1, beyond, 128), jnp.bfloat16)
+    fa.flash_attention(qb, qb, qb, causal=False)
+    assert calls.pop("pallas", False) and not calls.pop("fused", False)
+    qb64 = jnp.zeros((1, 1, beyond, 64), jnp.bfloat16)
+    fa.flash_attention(qb64, qb64, qb64, causal=True)
+    assert calls.pop("pallas", False) and not calls.pop("fused", False)
