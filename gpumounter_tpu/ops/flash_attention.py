@@ -237,8 +237,18 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     which lets callers combine partial attention over key chunks
     processed elsewhere (ring attention / flash decoding):
     ``o = sum_i o_i * exp(lse_i - logsumexp_i(lse_i))``.
+
+    GQA/MQA: k and v may carry fewer heads (B, H_kv, L, D) with
+    H % H_kv == 0 — the kernel reads the shared K/V head through the
+    index map (q head bh maps to kv head bh // group), so grouping is
+    zero-copy: no broadcast materialization in HBM.
     """
     b, h, l, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads "
+                         f"({h_kv})")
+    group = h // h_kv
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     block_q = _fit_block(l, block_q)
@@ -247,12 +257,14 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     n_k = l // block_k
 
     qr = q.reshape(b * h, l, d)
-    kr = k.reshape(b * h, l, d)
-    vr = v.reshape(b * h, l, d)
+    kr = k.reshape(b * h_kv, l, d)
+    vr = v.reshape(b * h_kv, l, d)
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
         scale=scale, causal=causal, with_lse=return_lse)
+    # Flattened q-head index bh = i*h + j maps to kv head
+    # i*h_kv + j//group == bh // group (since h = h_kv*group).
     if causal:
         # Causal DMA skip: iterations whose whole k block is in the
         # future of the q block are compute-skipped by pl.when, but the
@@ -264,10 +276,10 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # copy, so K/V traffic drops to only the needed blocks.
         def kv_index(bh, iq, ik):
             last_needed = (iq * block_q + block_q - 1) // block_k
-            return (bh, jnp.minimum(ik, last_needed), 0)
+            return (bh // group, jnp.minimum(ik, last_needed), 0)
     else:
         def kv_index(bh, iq, ik):
-            return (bh, ik, 0)
+            return (bh // group, ik, 0)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
@@ -307,14 +319,24 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool):
-    """Run the two backward kernels; q/k/v/do are (B, H, L, D), lse/delta
-    (B, H, L) float32. Returns (dq, dk, dv) in the input dtype."""
+    """Run the two backward kernels; q/do are (B, H, L, D), k/v
+    (B, H_kv, L, D) with H % H_kv == 0, lse/delta (B, H, L) float32.
+    Returns (dq, dk, dv) in the input dtypes; dk/dv have H_kv heads.
+
+    GQA note: the dk/dv kernel writes PER-Q-HEAD partials (each grid
+    program owns its output block, so no cross-program accumulation
+    race) and the group-sum happens outside in XLA — costing group× the
+    final dk/dv in transient HBM, a deliberate correctness-over-memory
+    trade."""
     b, h, l, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
     block_q = _fit_block(l, block_q)
     block_k = _fit_block(l, block_k)
     n_q = l // block_q
     n_k = l // block_k
-    qr, kr, vr, dor = (x.reshape(b * h, l, d) for x in (q, k, v, do))
+    qr, dor = (x.reshape(b * h, l, d) for x in (q, do))
+    kr, vr = (x.reshape(b * h_kv, l, d) for x in (k, v))
     # 8x sublane-redundant rows (same Mosaic tiling rule as the forward
     # lse output); the kernels read sublane 0.
     lser = jnp.broadcast_to(lse.reshape(b * h, 1, l), (b * h, 8, l))
@@ -326,7 +348,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
         # q blocks (clamp below it).
         def kv_index(bh, iq, ik):
             last = (iq * block_q + block_q - 1) // block_k
-            return (bh, jnp.minimum(ik, last), 0)
+            return (bh // group, jnp.minimum(ik, last), 0)
 
         def q_index(bh, ik, iq):
             first = (ik * block_k) // block_q
@@ -337,7 +359,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
             return (bh, 0, jnp.maximum(iq, first))
     else:
         def kv_index(bh, iq, ik):
-            return (bh, ik, 0)
+            return (bh // group, ik, 0)
 
         def q_index(bh, ik, iq):
             return (bh, iq, 0)
@@ -374,8 +396,10 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
         grid=(b * h, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, ik, iq: (bh // group, ik, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, ik, iq: (bh // group, ik, 0)),
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, 8, block_q), qrow_index),
             pl.BlockSpec((1, 8, block_q), qrow_index),
@@ -385,8 +409,13 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
             pl.BlockSpec((1, block_k, d), lambda bh, ik, iq: (bh, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, l, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, l, d), v.dtype),
+            # f32 partials: for GQA the group-sum happens OUTSIDE the
+            # kernel, and rounding each partial to bf16 before summing
+            # would compound error with group size — keep the
+            # f32-until-the-single-final-cast discipline of the rest of
+            # the file.
+            jax.ShapeDtypeStruct((b * h, l, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, l, d), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -394,8 +423,11 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
-    unflat = lambda x: x.reshape(b, h, l, d)
-    return unflat(dq), unflat(dk), unflat(dv)
+    dq = dq.reshape(b, h, l, d)
+    # dk/dv come back per q head; fold the group back onto the kv heads.
+    dk = dk.reshape(b, h_kv, group, l, d).sum(axis=2).astype(k.dtype)
+    dv = dv.reshape(b, h_kv, group, l, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -466,7 +498,12 @@ def _xla_attention(q, k, v, causal, scale):
     """Naive materialized-(L, L) attention. CORRECTNESS ORACLE ONLY — it
     is deliberately the simplest possible formulation. Never benchmark
     against this (VERDICT r2 weak #1); the performance baseline is
-    `fused_xla_attention` below."""
+    `fused_xla_attention` below. GQA inputs are broadcast to full heads
+    (simplest-possible again; memory is no object in an oracle)."""
+    if k.shape[1] != q.shape[1]:
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         l_q, l_k = q.shape[2], k.shape[2]

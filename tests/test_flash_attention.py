@@ -122,6 +122,53 @@ def test_public_flash_attention_is_trainable(monkeypatch):
                                    rtol=2e-3, atol=5e-3)
 
 
+@pytest.mark.parametrize("h_kv", [1, 2])
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_matches_broadcast_oracle(h_kv, causal):
+    """GQA/MQA: k/v with fewer heads, read zero-copy through the index
+    map, must equal attention against the broadcast k/v."""
+    b, h, l, d = 2, 4, 256, 64
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.5, jnp.float32)
+    got = flash_attention_pallas(q, k, v, causal=causal, block_q=128,
+                                 block_k=128, interpret=True)
+    want = _xla_attention(q, k, v, causal, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_rejects_indivisible_heads():
+    q = jnp.zeros((1, 4, 128, 64), jnp.float32)
+    kv = jnp.zeros((1, 3, 128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention_pallas(q, kv, kv, interpret=True)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_backward_matches_oracle_grads(causal):
+    """GQA backward: per-q-head dk/dv partials group-summed onto the kv
+    heads must equal autodiff through the broadcast oracle."""
+    b, h, h_kv, l, d = 2, 4, 2, 256, 64
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.5, jnp.float32)
+    scale = 1.0 / d ** 0.5
+
+    got = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, causal, scale, 128, 128, True)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, causal, scale) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
 def test_target_platform_accepts_string_default_device():
     """jax_default_device may hold a platform STRING (jax-supported);
     _target_platform must not assume a Device object."""
