@@ -29,9 +29,36 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-but-finite: -inf breaks the m==NEG_INF row fixups
 
 
+def _band_needed(iq, ik, block_q, block_k, causal, window):
+    """Whether k block ik overlaps q block iq's attention band
+    [q - window, q] (full causal history when window is None)."""
+    if not causal:
+        return True
+    needed = ik * block_k <= iq * block_q + block_q - 1
+    if window is not None:
+        needed = jnp.logical_and(
+            needed, ik * block_k + block_k - 1 >= iq * block_q - window)
+    return needed
+
+
+def _band_mask(s, iq, ik, block_q, block_k, causal, window):
+    """Apply the causal / sliding-window mask to a score tile."""
+    if not causal:
+        return s
+    q_idx = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_idx = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = k_idx <= q_idx
+    if window is not None:
+        keep = jnp.logical_and(keep, k_idx >= q_idx - window)
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_k: int, n_k: int, scale: float,
-                  causal: bool, with_lse: bool = False):
+                  causal: bool, window: int | None = None,
+                  with_lse: bool = False):
     lse_ref = rest[0] if with_lse else None
     m_scr, l_scr, acc_scr = rest[-3:]
     ik = pl.program_id(2)
@@ -43,10 +70,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal block skip: when every key in this block is strictly in the
-    # future of every query in the q block, the whole step is a no-op —
-    # for nk ≈ nq this halves the work.
-    needed = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    # Band block skip: when every key in this block is outside the q
+    # block's attention band (future, or beyond the sliding window), the
+    # whole step is a no-op — for full causal this halves the work; with
+    # a window the per-row work drops to O(window).
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -57,12 +85,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
 
-        if causal:
-            q_idx = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window)
 
         m_prev = m_scr[:, 0:1]                             # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -102,7 +125,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, block_q: int, block_k: int,
-                         n_k: int, scale: float, causal: bool):
+                         n_k: int, scale: float, causal: bool,
+                         window: int | None = None):
     """dq = Σ_k  [p ∘ (do·vᵀ − Δ)]·k·scale, accumulated over k blocks.
 
     p is recomputed from the saved lse (p = exp(s − lse)); Δ is the
@@ -115,7 +139,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    needed = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window)
 
     @pl.when(needed)
     def _compute():
@@ -128,12 +152,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_idx = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window)
         # Fully-masked rows keep lse == NEG_INF; exp(s - NEG_INF) would
         # overflow, so zero them explicitly. Reshape the f32 column FIRST
         # and compare in 2-D: Mosaic cannot insert a minor dim on the i1
@@ -156,7 +175,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
                           block_k: int, n_q: int, scale: float,
-                          causal: bool):
+                          causal: bool, window: int | None = None):
     """dk = Σ_q dsᵀ·q·scale and dv = Σ_q pᵀ·do, accumulated over q blocks
     for one k block (grid: (batch·heads, k-blocks, q-blocks), last axis
     sequential so the scratch accumulators persist)."""
@@ -168,7 +187,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    needed = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+    # Transposed band condition: q block iq contributes to k block ik
+    # when it is not entirely before the keys (causal) nor entirely past
+    # the window's reach (q <= k + window).
+    if not causal:
+        needed = True
+    else:
+        needed = iq * block_q + block_q - 1 >= ik * block_k
+        if window is not None:
+            needed = jnp.logical_and(
+                needed,
+                iq * block_q <= ik * block_k + block_k - 1 + window)
 
     @pl.when(needed)
     def _compute():
@@ -181,12 +210,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_idx = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_idx = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_idx <= q_idx, s, NEG_INF)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window)
         lse_col = lse[:, None]
         p = jnp.where(lse_col <= NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
         dv_scr[:] += jax.lax.dot_general(
@@ -227,10 +251,17 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            causal: bool = True, scale: float | None = None,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False,
-                           return_lse: bool = False):
+                           return_lse: bool = False,
+                           window: int | None = None):
     """(B, H, L, D) attention via the Pallas kernel. Block sizes are
     clamped to L and reduced to the largest dividing size when the
     requested blocks do not divide L.
+
+    window (requires causal): each query attends only the last `window`
+    keys plus itself — positions [q - window, q]. Blocks entirely
+    outside the band are skipped in BOTH compute and DMA (the index map
+    re-references a resident block), so work per row is O(window), not
+    O(L).
 
     return_lse additionally returns the per-row log-sum-exp
     (B, H, L) float32 — `m + log(denominator)` of the online softmax —
@@ -248,6 +279,10 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if h % h_kv:
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads "
                          f"({h_kv})")
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if window is not None and window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     group = h // h_kv
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -262,21 +297,27 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
-        scale=scale, causal=causal, with_lse=return_lse)
+        scale=scale, causal=causal, window=window, with_lse=return_lse)
     # Flattened q-head index bh = i*h + j maps to kv head
     # i*h_kv + j//group == bh // group (since h = h_kv*group).
     if causal:
-        # Causal DMA skip: iterations whose whole k block is in the
-        # future of the q block are compute-skipped by pl.when, but the
-        # BlockSpec would still stream their K/V from HBM — for nk ≈ nq
-        # that is ~2x the necessary K/V traffic, and the kernel is
-        # HBM-bound at large L. Clamping the index map makes every
-        # masked-out iteration re-reference the block already resident
-        # in VMEM; Mosaic detects the unchanged index and elides the
-        # copy, so K/V traffic drops to only the needed blocks.
+        # Band DMA skip: iterations whose whole k block is outside the
+        # attention band are compute-skipped by pl.when, but the
+        # BlockSpec would still stream their K/V from HBM — for full
+        # causal that is ~2x the necessary K/V traffic, and with a
+        # sliding window nearly all of it. Clamping the index map into
+        # [first_needed, last_needed] makes every masked-out iteration
+        # re-reference the block already resident in VMEM; Mosaic
+        # detects the unchanged index and elides the copy, so K/V
+        # traffic drops to only the needed blocks.
         def kv_index(bh, iq, ik):
             last_needed = (iq * block_q + block_q - 1) // block_k
-            return (bh // group, jnp.minimum(ik, last_needed), 0)
+            clamped = jnp.minimum(ik, last_needed)
+            if window is not None:
+                first_needed = jnp.maximum(
+                    0, iq * block_q - window) // block_k
+                clamped = jnp.maximum(clamped, first_needed)
+            return (bh // group, clamped, 0)
     else:
         def kv_index(bh, iq, ik):
             return (bh // group, ik, 0)
@@ -318,7 +359,8 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    window: int | None = None):
     """Run the two backward kernels; q/do are (B, H, L, D), k/v
     (B, H_kv, L, D) with H % H_kv == 0, lse/delta (B, H, L) float32.
     Returns (dq, dk, dv) in the input dtypes; dk/dv have H_kv heads.
@@ -344,19 +386,32 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
 
     if causal:
         # Same DMA-skip trick as the forward kernel, in both directions:
-        # dq iterates k blocks (clamp above the diagonal), dk/dv iterates
-        # q blocks (clamp below it).
+        # dq iterates k blocks (clamped into the band), dk/dv iterates
+        # q blocks (clamped into the transposed band: q in
+        # [k, k + window]).
         def kv_index(bh, iq, ik):
             last = (iq * block_q + block_q - 1) // block_k
-            return (bh // group, jnp.minimum(ik, last), 0)
+            clamped = jnp.minimum(ik, last)
+            if window is not None:
+                first = jnp.maximum(0, iq * block_q - window) // block_k
+                clamped = jnp.maximum(clamped, first)
+            return (bh // group, clamped, 0)
+
+        def _q_clamp(ik, iq):
+            first = (ik * block_k) // block_q
+            clamped = jnp.maximum(iq, first)
+            if window is not None:
+                last = jnp.minimum(
+                    n_q - 1,
+                    (ik * block_k + block_k - 1 + window) // block_q)
+                clamped = jnp.minimum(clamped, last)
+            return clamped
 
         def q_index(bh, ik, iq):
-            first = (ik * block_k) // block_q
-            return (bh, jnp.maximum(iq, first), 0)
+            return (bh, _q_clamp(ik, iq), 0)
 
         def qrow_index(bh, ik, iq):
-            first = (ik * block_k) // block_q
-            return (bh, 0, jnp.maximum(iq, first))
+            return (bh, 0, _q_clamp(ik, iq))
     else:
         def kv_index(bh, iq, ik):
             return (bh // group, ik, 0)
@@ -370,7 +425,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, n_k=n_k, scale=scale,
-                          causal=causal),
+                          causal=causal, window=window),
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -392,7 +447,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, n_q=n_q, scale=scale,
-                          causal=causal),
+                          causal=causal, window=window),
         grid=(b * h, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
@@ -430,9 +485,10 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
-                             block_q: int, block_k: int, interpret: bool):
+                             block_q: int, block_k: int, interpret: bool,
+                             window: int | None = None):
     """Differentiable flash attention returning (o, lse). The VJP runs
     the blockwise backward kernels (O(L·D) memory — no (L, L) score
     matrix in either direction); an incoming lse cotangent is folded
@@ -440,32 +496,36 @@ def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
     differentiates through this too."""
     return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
-                                  interpret=interpret, return_lse=True)
+                                  interpret=interpret, return_lse=True,
+                                  window=window)
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window=None):
     o, lse = flash_attention_with_lse(q, k, v, causal, scale, block_q,
-                                      block_k, interpret)
+                                      block_k, interpret, window)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, cot):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
+                   res, cot):
     q, k, v, o, lse = res
     do, dlse = cot
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1) - dlse.astype(jnp.float32)
     dq, dk, dv = _flash_backward(q, k, v, do, lse, delta, causal=causal,
                                  scale=scale, block_q=block_q,
-                                 block_k=block_k, interpret=interpret)
+                                 block_k=block_k, interpret=interpret,
+                                 window=window)
     return dq, dk, dv
 
 
 flash_attention_with_lse.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
-                               interpret):
+                               interpret, window=None):
     """Public-path primal: the EXACT kernel the committed sweep timed
     (no lse output). Only under differentiation does the fwd rule switch
     to the with-lse kernel — lse is a residual the backward needs anyway
@@ -473,28 +533,31 @@ def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
     agreement."""
     return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
-                                  interpret=interpret)
+                                  interpret=interpret, window=window)
 
 
-def _trainable_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _trainable_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window=None):
     o, lse = flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                     block_q=block_q, block_k=block_k,
-                                    interpret=interpret, return_lse=True)
+                                    interpret=interpret, return_lse=True,
+                                    window=window)
     return o, (q, k, v, o, lse)
 
 
-def _trainable_bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _trainable_bwd(causal, scale, block_q, block_k, interpret, window,
+                   res, do):
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     return _flash_backward(q, k, v, do, lse, delta, causal=causal,
                            scale=scale, block_q=block_q, block_k=block_k,
-                           interpret=interpret)
+                           interpret=interpret, window=window)
 
 
 _flash_attention_trainable.defvjp(_trainable_fwd, _trainable_bwd)
 
 
-def _xla_attention(q, k, v, causal, scale):
+def _xla_attention(q, k, v, causal, scale, window=None):
     """Naive materialized-(L, L) attention. CORRECTNESS ORACLE ONLY — it
     is deliberately the simplest possible formulation. Never benchmark
     against this (VERDICT r2 weak #1); the performance baseline is
@@ -508,19 +571,24 @@ def _xla_attention(q, k, v, causal, scale):
     if causal:
         l_q, l_k = q.shape[2], k.shape[2]
         mask = jnp.arange(l_k)[None, :] <= jnp.arange(l_q)[:, None]
+        if window is not None:
+            mask = mask & (jnp.arange(l_k)[None, :]
+                           >= jnp.arange(l_q)[:, None] - window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def fused_xla_attention(q, k, v, causal, scale):
+def fused_xla_attention(q, k, v, causal, scale, window=None):
     """XLA's own attention (jax.nn.dot_product_attention) — the honest
     performance baseline. Input here is (B, H, L, D); jax.nn expects
-    (B, L, H, D)."""
+    (B, L, H, D). window maps to local_window_size=(window, 0): the last
+    `window` keys plus self, matching the kernel's band."""
     out = jax.nn.dot_product_attention(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3), scale=scale, is_causal=causal)
+        v.transpose(0, 2, 1, 3), scale=scale, is_causal=causal,
+        local_window_size=None if window is None else (window, 0))
     return out.transpose(0, 2, 1, 3)
 
 
@@ -568,7 +636,8 @@ def _best_blocks(l: int) -> tuple[int, int]:
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
-                    backend: str = "auto") -> jax.Array:
+                    backend: str = "auto",
+                    window: int | None = None) -> jax.Array:
     """Public entry.
 
     backend: "auto" picks per sequence length from the committed sweep
@@ -580,9 +649,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     the O(L·D) kernel whenever the tiles are lane-aligned — even
     out-of-envelope — and raises a clear error when they are not.
     "xla" / "pallas" force a path.
+
+    window (requires causal): sliding-window attention over the last
+    `window` keys plus self. The kernel's band block skipping makes
+    per-row work O(window); with window set, auto prefers the kernel
+    whenever its tiles are lane-aligned (the win is structural, not
+    sweep-derived) and otherwise falls back to the fused path's
+    local_window_size.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if window is not None and window < 0:
+        # Validate on EVERY path: the fused fallback would turn a
+        # negative window into an empty key range and NaN output
+        # instead of an error.
+        raise ValueError(f"window must be >= 0, got {window}")
     l, d = q.shape[2], q.shape[3]
     on_tpu = _target_platform() == "tpu"
     bq, bk = (_fit_block(l, b) for b in _best_blocks(l))
@@ -594,7 +677,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if backend == "pallas":
         use_pallas = True
     elif backend == "auto":
-        if l > max(_SWEEP_TABLE):
+        if window is not None:
+            use_pallas = on_tpu and blocks_ok
+            if on_tpu and not blocks_ok and l > max(_SWEEP_TABLE):
+                # Same loud refusal as the windowless beyond-sweep
+                # branch: the fused fallback materializes (L, L) f32
+                # logits regardless of local_window_size and aborts.
+                raise ValueError(
+                    f"flash_attention auto dispatch: windowed L={l} "
+                    f"exceeds the largest measured length "
+                    f"({max(_SWEEP_TABLE)}) but does not tile into "
+                    f"lane-aligned blocks (fit: {bq}x{bk}); pad L to a "
+                    f"multiple of 128 or force backend explicitly")
+        elif l > max(_SWEEP_TABLE):
             # Beyond the largest measured L the fused XLA path is not a
             # fallback but a crash: its default implementation
             # materializes (L, L) f32 logits (137 GB at B=4 H=8 L=32k)
@@ -625,5 +720,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # Custom-VJP wrapper: trainable (blockwise backward kernels, no
         # (L, L) matrix), and its primal is the exact swept kernel.
         return _flash_attention_trainable(q, k, v, causal, scale, bq, bk,
-                                          not on_tpu)
-    return fused_xla_attention(q, k, v, causal, scale)
+                                          not on_tpu, window)
+    return fused_xla_attention(q, k, v, causal, scale, window)

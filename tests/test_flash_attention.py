@@ -169,6 +169,54 @@ def test_gqa_backward_matches_oracle_grads(causal):
                                    rtol=2e-3, atol=5e-3)
 
 
+@pytest.mark.parametrize("window", [0, 37, 128, 300])
+def test_sliding_window_matches_banded_oracle(window):
+    """Sliding-window attention: q attends [q-window, q] only. Windows
+    smaller than, equal to, and spanning multiple k blocks."""
+    q, k, v = _qkv()
+    got = flash_attention_pallas(q, k, v, causal=True, window=window,
+                                 block_q=64, block_k=64, interpret=True)
+    want = _xla_attention(q, k, v, True, 1.0 / q.shape[-1] ** 0.5,
+                          window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sliding_window_requires_causal():
+    q, k, v = _qkv(l=128)
+    with pytest.raises(ValueError, match="window requires causal"):
+        flash_attention_pallas(q, k, v, causal=False, window=16,
+                               interpret=True)
+
+
+@pytest.mark.parametrize("window", [37, 128])
+def test_sliding_window_backward_matches_oracle_grads(window):
+    q, k, v = _qkv()
+    scale = 1.0 / q.shape[-1] ** 0.5
+    got = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, True, scale, 64, 64, True, window)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, scale, window=window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
+def test_sliding_window_matches_fused_local_window():
+    """The kernel's band must agree with jax.nn.dot_product_attention's
+    local_window_size=(window, 0) — the fallback the public entry uses."""
+    from gpumounter_tpu.ops.flash_attention import fused_xla_attention
+    q, k, v = _qkv()
+    scale = 1.0 / q.shape[-1] ** 0.5
+    a = flash_attention_pallas(q, k, v, causal=True, window=100,
+                               block_q=64, block_k=64, interpret=True)
+    b = fused_xla_attention(q, k, v, True, scale, window=100)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_target_platform_accepts_string_default_device():
     """jax_default_device may hold a platform STRING (jax-supported);
     _target_platform must not assume a Device object."""
@@ -233,7 +281,7 @@ def test_auto_dispatch_respects_envelope(monkeypatch):
             return a[0], jnp.zeros(a[0].shape[:-1], jnp.float32)
         return a[0]
 
-    def fake_fused(q, k, v, causal, scale):
+    def fake_fused(q, k, v, causal, scale, window=None):
         calls["fused"] = True
         return q
 
