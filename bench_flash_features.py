@@ -1,0 +1,127 @@
+"""GQA and sliding-window flash-attention evidence on the live chip.
+
+Companion to bench_flash.py (which owns the dispatch-table sweep):
+measures the two structural features the r03 kernel added —
+  * GQA/MQA: k/v heads < q heads, read zero-copy through the index map;
+    expected effect is reduced K/V HBM traffic at equal FLOPs.
+  * sliding window: band block skipping in compute AND DMA; expected
+    effect is O(window) per-row work instead of O(L).
+Timing discipline is bench_flash.py's: distinct inputs per rep, output
+probes fetched to the host, delta = (3N-chain − N-chain)/2N cancels the
+tunnel RTT, and physically-impossible rates are flagged invalid.
+
+Not part of the driver contract; run by hand on hardware.
+Writes BENCH_flash_features_r03.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpumounter_tpu.ops.flash_attention import flash_attention_pallas
+
+ITERS = 10
+REPS = 3
+V5E_BF16_PEAK_TFLOPS = 197.0
+ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_flash_features_r03.json")
+
+
+def chained(fn, iters):
+    """Chain iterations through v. For GQA the output has more heads
+    than v, so slice back to v's head count — keeps the data dependence
+    (no iteration can be elided) and the carry type fixed."""
+    def run(q, k, v):
+        h_kv = v.shape[1]
+        def body(carry, _):
+            out = fn(q, k, carry)
+            return out[:, :h_kv].astype(carry.dtype), ()
+        final, _ = jax.lax.scan(body, v, None, length=iters)
+        return final
+    return jax.jit(run)
+
+
+def _min_time(fn, q, k, v_variants):
+    np.asarray(fn(q, k, v_variants[-1])[0, 0, :8, 0])
+    best = float("inf")
+    probes = []
+    for i in range(REPS):
+        t0 = time.perf_counter()
+        probe = np.asarray(fn(q, k, v_variants[i])[0, 0, :8, 0])
+        best = min(best, time.perf_counter() - t0)
+        probes.append(probe.tobytes())
+    return best, len(set(probes)) < len(probes)
+
+
+def delta_ms(fn, q, k, vv):
+    t_short, c1 = _min_time(chained(fn, ITERS), q, k, vv)
+    t_long, c2 = _min_time(chained(fn, 3 * ITERS), q, k, vv)
+    ms = (t_long - t_short) / (2 * ITERS) * 1000.0
+    return round(ms, 4), bool(c1 or c2 or ms <= 0)
+
+
+def main():
+    dev = jax.devices()[0]
+    out = {
+        "schema": "tpumounter-flash-features/r03",
+        "device": f"{dev.device_kind} ({dev.platform})",
+        "iters_chained": ITERS, "reps": REPS,
+        "timing": "delta statistic, distinct inputs, fetched output "
+                  "probes (see bench_flash.py)",
+    }
+
+    # --- GQA: B=4, H=8, L=8192, D=128, causal; vary kv heads.
+    b, h, l, d = 4, 8, 8192, 128
+    rng = np.random.default_rng(0)
+    q = jax.device_put(jnp.asarray(
+        rng.normal(size=(b, h, l, d)) * 0.3, jnp.bfloat16))
+    gqa = {}
+    for h_kv in (8, 2, 1):
+        k = jax.device_put(jnp.asarray(
+            rng.normal(size=(b, h_kv, l, d)) * 0.3, jnp.bfloat16))
+        v0 = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.3,
+                         jnp.bfloat16)
+        vv = [jax.device_put(v0 + jnp.bfloat16(4e-3 * i))
+              for i in range(REPS + 1)]
+        fn = lambda q, k, v: flash_attention_pallas(
+            q, k, v, causal=True, block_q=512, block_k=1024)
+        ms, invalid = delta_ms(fn, q, k, vv)
+        gqa[f"h_kv={h_kv}"] = {"ms": ms, "invalid_timing": invalid,
+                               "kv_bytes_ratio": round(h_kv / h, 3)}
+    out["gqa_L8192"] = gqa
+
+    # --- Sliding window: L=32768, vary window (None = full causal).
+    l = 32768
+    rng = np.random.default_rng(1)
+    q = jax.device_put(jnp.asarray(
+        rng.normal(size=(b, h, l, d)) * 0.3, jnp.bfloat16))
+    k = jax.device_put(jnp.asarray(
+        rng.normal(size=(b, h, l, d)) * 0.3, jnp.bfloat16))
+    v0 = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.3, jnp.bfloat16)
+    vv = [jax.device_put(v0 + jnp.bfloat16(4e-3 * i))
+          for i in range(REPS + 1)]
+    win = {}
+    for w in (None, 8192, 4096, 1024):
+        fn = lambda q, k, v, w=w: flash_attention_pallas(
+            q, k, v, causal=True, window=w, block_q=1024, block_k=1024)
+        ms, invalid = delta_ms(fn, q, k, vv)
+        win[f"window={w}"] = {"ms": ms, "invalid_timing": invalid}
+    full = win["window=None"]["ms"]
+    for key, row in win.items():
+        if not row["invalid_timing"] and full > 0:
+            row["speedup_vs_full_causal"] = round(full / row["ms"], 2)
+    out["window_L32768"] = win
+
+    with open(ARTIFACT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

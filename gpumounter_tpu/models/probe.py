@@ -72,12 +72,13 @@ def _block(x: jax.Array, p: dict, cfg: TransformerConfig) -> jax.Array:
         return a.reshape(b, t, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
-        jnp.asarray(cfg.d_head, x.dtype))
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
-    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    # The framework attention op: data-driven dispatch (committed sweep)
+    # picks the Pallas kernel or XLA's fused attention per shape. At
+    # probe scale (d_head 32, short L) this resolves to the fused path,
+    # which is also safely partitionable under the tp sharding of
+    # parallel/train_step.py.
+    from gpumounter_tpu.ops.flash_attention import flash_attention
+    out = flash_attention(q, k, v, causal=True)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, d) @ p["wo"]
     x = x + out
 

@@ -1,11 +1,11 @@
 """Flash attention as a Pallas TPU kernel.
 
-The probe model's attention is the FLOPs hot spot of the tenant workload
-(models/probe.py materializes the full (L, L) score matrix — fine for
-probes, quadratic HBM traffic for real sequence lengths). This kernel
-streams K/V blocks through VMEM with an online-softmax accumulator, so
-HBM traffic is O(L·D) and the (block_q, block_k) score tile lives only in
-VMEM next to the MXU.
+Attention is the FLOPs hot spot of the tenant workload (models/probe.py
+routes through the public entry below; a materialized (L, L) score
+matrix would mean quadratic HBM traffic at real sequence lengths). This
+kernel streams K/V blocks through VMEM with an online-softmax
+accumulator, so HBM traffic is O(L·D) and the (block_q, block_k) score
+tile lives only in VMEM next to the MXU.
 
 Kernel structure (pallas_guide.md patterns): 3-D grid (batch·heads,
 q-blocks, k-blocks); the last grid axis iterates sequentially on TPU, so
@@ -187,17 +187,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    # Transposed band condition: q block iq contributes to k block ik
-    # when it is not entirely before the keys (causal) nor entirely past
-    # the window's reach (q <= k + window).
-    if not causal:
-        needed = True
-    else:
-        needed = iq * block_q + block_q - 1 >= ik * block_k
-        if window is not None:
-            needed = jnp.logical_and(
-                needed,
-                iq * block_q <= ik * block_k + block_k - 1 + window)
+    # Band overlap is symmetric in (q block, k block), so the forward
+    # helper gives the transposed condition verbatim.
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window)
 
     @pl.when(needed)
     def _compute():

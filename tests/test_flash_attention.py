@@ -182,6 +182,34 @@ def test_sliding_window_matches_banded_oracle(window):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_sliding_window_with_gqa():
+    """window and GQA compose in one kv_index expression
+    ((bh // group, clamped, 0)) — exercise them together, forward and
+    backward."""
+    b, h, h_kv, l, d = 2, 4, 2, 256, 64
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.5, jnp.float32)
+    scale = 1.0 / d ** 0.5
+    got = flash_attention_pallas(q, k, v, causal=True, window=100,
+                                 block_q=64, block_k=64, interpret=True)
+    want = _xla_attention(q, k, v, True, scale, window=100)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    gg = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, True, scale, 64, 64, True, 100)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, scale, window=100) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(gg, gw):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
 def test_sliding_window_requires_causal():
     q, k, v = _qkv(l=128)
     with pytest.raises(ValueError, match="window requires causal"):
