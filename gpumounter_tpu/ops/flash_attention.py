@@ -29,23 +29,29 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-but-finite: -inf breaks the m==NEG_INF row fixups
 
 
-def _band_needed(iq, ik, block_q, block_k, causal, window):
+def _band_needed(iq, ik, block_q, block_k, causal, window, offset=0):
     """Whether k block ik overlaps q block iq's attention band
-    [q - window, q] (full causal history when window is None)."""
+    [q - window, q] (full causal history when window is None).
+
+    offset places the queries on the key timeline: query row i sits at
+    global position offset + i. For self-attention offset == 0; for
+    decode against a longer K/V cache offset == l_k - l_q (the queries
+    are the LAST l_q positions)."""
     if not causal:
         return True
-    needed = ik * block_k <= iq * block_q + block_q - 1
+    needed = ik * block_k <= offset + iq * block_q + block_q - 1
     if window is not None:
         needed = jnp.logical_and(
-            needed, ik * block_k + block_k - 1 >= iq * block_q - window)
+            needed,
+            ik * block_k + block_k - 1 >= offset + iq * block_q - window)
     return needed
 
 
-def _band_mask(s, iq, ik, block_q, block_k, causal, window):
+def _band_mask(s, iq, ik, block_q, block_k, causal, window, offset=0):
     """Apply the causal / sliding-window mask to a score tile."""
     if not causal:
         return s
-    q_idx = iq * block_q + jax.lax.broadcasted_iota(
+    q_idx = offset + iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_idx = ik * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
@@ -58,7 +64,7 @@ def _band_mask(s, iq, ik, block_q, block_k, causal, window):
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_k: int, n_k: int, scale: float,
                   causal: bool, window: int | None = None,
-                  with_lse: bool = False):
+                  offset: int = 0, with_lse: bool = False):
     lse_ref = rest[0] if with_lse else None
     m_scr, l_scr, acc_scr = rest[-3:]
     ik = pl.program_id(2)
@@ -74,7 +80,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     # block's attention band (future, or beyond the sliding window), the
     # whole step is a no-op — for full causal this halves the work; with
     # a window the per-row work drops to O(window).
-    needed = _band_needed(iq, ik, block_q, block_k, causal, window)
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset)
 
     @pl.when(needed)
     def _compute():
@@ -85,7 +91,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
 
-        s = _band_mask(s, iq, ik, block_q, block_k, causal, window)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
 
         m_prev = m_scr[:, 0:1]                             # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -126,7 +132,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, block_q: int, block_k: int,
                          n_k: int, scale: float, causal: bool,
-                         window: int | None = None):
+                         window: int | None = None, offset: int = 0):
     """dq = Σ_k  [p ∘ (do·vᵀ − Δ)]·k·scale, accumulated over k blocks.
 
     p is recomputed from the saved lse (p = exp(s − lse)); Δ is the
@@ -139,7 +145,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    needed = _band_needed(iq, ik, block_q, block_k, causal, window)
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset)
 
     @pl.when(needed)
     def _compute():
@@ -152,7 +158,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _band_mask(s, iq, ik, block_q, block_k, causal, window)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
         # Fully-masked rows keep lse == NEG_INF; exp(s - NEG_INF) would
         # overflow, so zero them explicitly. Reshape the f32 column FIRST
         # and compare in 2-D: Mosaic cannot insert a minor dim on the i1
@@ -175,7 +181,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
                           block_k: int, n_q: int, scale: float,
-                          causal: bool, window: int | None = None):
+                          causal: bool, window: int | None = None,
+                          offset: int = 0):
     """dk = Σ_q dsᵀ·q·scale and dv = Σ_q pᵀ·do, accumulated over q blocks
     for one k block (grid: (batch·heads, k-blocks, q-blocks), last axis
     sequential so the scratch accumulators persist)."""
@@ -189,7 +196,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     # Band overlap is symmetric in (q block, k block), so the forward
     # helper gives the transposed condition verbatim.
-    needed = _band_needed(iq, ik, block_q, block_k, causal, window)
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset)
 
     @pl.when(needed)
     def _compute():
@@ -202,7 +209,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _band_mask(s, iq, ik, block_q, block_k, causal, window)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
         lse_col = lse[:, None]
         p = jnp.where(lse_col <= NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
         dv_scr[:] += jax.lax.dot_general(
@@ -265,9 +272,14 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     H % H_kv == 0 — the kernel reads the shared K/V head through the
     index map (q head bh maps to kv head bh // group), so grouping is
     zero-copy: no broadcast materialization in HBM.
+
+    Cross-length (decode / encoder-decoder): q may be shorter than k/v
+    (L_q <= L_k). For causal, the queries sit at the LAST L_q positions
+    of the key timeline (offset = L_k − L_q) — the KV-cache decode
+    convention; non-causal accepts any length pair.
     """
-    b, h, l, d = q.shape
-    h_kv = k.shape[1]
+    b, h, l_q, d = q.shape
+    h_kv, l_k = k.shape[1], k.shape[2]
     if h % h_kv:
         raise ValueError(f"q heads ({h}) must be a multiple of kv heads "
                          f"({h_kv})")
@@ -275,21 +287,27 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError("window requires causal=True")
     if window is not None and window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
+    if causal and l_q > l_k:
+        raise ValueError(f"causal attention needs L_q <= L_k (queries "
+                         f"are the last L_q key positions); got "
+                         f"L_q={l_q} L_k={l_k}")
+    offset = l_k - l_q if causal else 0
     group = h // h_kv
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    block_q = _fit_block(l, block_q)
-    block_k = _fit_block(l, block_k)
-    n_q = l // block_q
-    n_k = l // block_k
+    block_q = _fit_block(l_q, block_q)
+    block_k = _fit_block(l_k, block_k)
+    n_q = l_q // block_q
+    n_k = l_k // block_k
 
-    qr = q.reshape(b * h, l, d)
-    kr = k.reshape(b * h_kv, l, d)
-    vr = v.reshape(b * h_kv, l, d)
+    qr = q.reshape(b * h, l_q, d)
+    kr = k.reshape(b * h_kv, l_k, d)
+    vr = v.reshape(b * h_kv, l_k, d)
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
-        scale=scale, causal=causal, window=window, with_lse=return_lse)
+        scale=scale, causal=causal, window=window, offset=offset,
+        with_lse=return_lse)
     # Flattened q-head index bh = i*h + j maps to kv head
     # i*h_kv + j//group == bh // group (since h = h_kv*group).
     if causal:
@@ -303,11 +321,11 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # detects the unchanged index and elides the copy, so K/V
         # traffic drops to only the needed blocks.
         def kv_index(bh, iq, ik):
-            last_needed = (iq * block_q + block_q - 1) // block_k
+            last_needed = (offset + iq * block_q + block_q - 1) // block_k
             clamped = jnp.minimum(ik, last_needed)
             if window is not None:
                 first_needed = jnp.maximum(
-                    0, iq * block_q - window) // block_k
+                    0, offset + iq * block_q - window) // block_k
                 clamped = jnp.maximum(clamped, first_needed)
             return (bh // group, clamped, 0)
     else:
@@ -327,10 +345,10 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
             if return_lse else
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0))),
         out_shape=(
-            [jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
-             jax.ShapeDtypeStruct((b * h, 8, l), jnp.float32)]
+            [jax.ShapeDtypeStruct((b * h, l_q, d), q.dtype),
+             jax.ShapeDtypeStruct((b * h, 8, l_q), jnp.float32)]
             if return_lse else
-            jax.ShapeDtypeStruct((b * h, l, d), q.dtype)),
+            jax.ShapeDtypeStruct((b * h, l_q, d), q.dtype)),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running denom
@@ -346,8 +364,8 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     )(qr, kr, vr)
     if return_lse:
         o, lse = out
-        return o.reshape(b, h, l, d), lse[:, 0, :].reshape(b, h, l)
-    return out.reshape(b, h, l, d)
+        return o.reshape(b, h, l_q, d), lse[:, 0, :].reshape(b, h, l_q)
+    return out.reshape(b, h, l_q, d)
 
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
@@ -362,40 +380,43 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     race) and the group-sum happens outside in XLA — costing group× the
     final dk/dv in transient HBM, a deliberate correctness-over-memory
     trade."""
-    b, h, l, d = q.shape
-    h_kv = k.shape[1]
+    b, h, l_q, d = q.shape
+    h_kv, l_k = k.shape[1], k.shape[2]
     group = h // h_kv
-    block_q = _fit_block(l, block_q)
-    block_k = _fit_block(l, block_k)
-    n_q = l // block_q
-    n_k = l // block_k
-    qr, dor = (x.reshape(b * h, l, d) for x in (q, do))
-    kr, vr = (x.reshape(b * h_kv, l, d) for x in (k, v))
+    offset = l_k - l_q if causal else 0
+    block_q = _fit_block(l_q, block_q)
+    block_k = _fit_block(l_k, block_k)
+    n_q = l_q // block_q
+    n_k = l_k // block_k
+    qr, dor = (x.reshape(b * h, l_q, d) for x in (q, do))
+    kr, vr = (x.reshape(b * h_kv, l_k, d) for x in (k, v))
     # 8x sublane-redundant rows (same Mosaic tiling rule as the forward
     # lse output); the kernels read sublane 0.
-    lser = jnp.broadcast_to(lse.reshape(b * h, 1, l), (b * h, 8, l))
-    deltar = jnp.broadcast_to(delta.reshape(b * h, 1, l), (b * h, 8, l))
+    lser = jnp.broadcast_to(lse.reshape(b * h, 1, l_q), (b * h, 8, l_q))
+    deltar = jnp.broadcast_to(delta.reshape(b * h, 1, l_q), (b * h, 8, l_q))
 
     if causal:
         # Same DMA-skip trick as the forward kernel, in both directions:
         # dq iterates k blocks (clamped into the band), dk/dv iterates
         # q blocks (clamped into the transposed band: q in
-        # [k, k + window]).
+        # [k, k + window]). All clamps live on the key timeline, where
+        # query row i sits at global position offset + i.
         def kv_index(bh, iq, ik):
-            last = (iq * block_q + block_q - 1) // block_k
+            last = (offset + iq * block_q + block_q - 1) // block_k
             clamped = jnp.minimum(ik, last)
             if window is not None:
-                first = jnp.maximum(0, iq * block_q - window) // block_k
+                first = jnp.maximum(
+                    0, offset + iq * block_q - window) // block_k
                 clamped = jnp.maximum(clamped, first)
             return (bh // group, clamped, 0)
 
         def _q_clamp(ik, iq):
-            first = (ik * block_k) // block_q
+            first = jnp.maximum(0, ik * block_k - offset) // block_q
             clamped = jnp.maximum(iq, first)
             if window is not None:
-                last = jnp.minimum(
-                    n_q - 1,
-                    (ik * block_k + block_k - 1 + window) // block_q)
+                last = jnp.clip(
+                    (ik * block_k + block_k - 1 + window - offset)
+                    // block_q, 0, n_q - 1)
                 clamped = jnp.minimum(clamped, last)
             return clamped
 
@@ -417,7 +438,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, n_k=n_k, scale=scale,
-                          causal=causal, window=window),
+                          causal=causal, window=window, offset=offset),
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -429,7 +450,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh, iq, ik: (bh, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, l_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -439,7 +460,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, n_q=n_q, scale=scale,
-                          causal=causal, window=window),
+                          causal=causal, window=window, offset=offset),
         grid=(b * h, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
@@ -461,8 +482,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
             # would compound error with group size — keep the
             # f32-until-the-single-final-cast discipline of the rest of
             # the file.
-            jax.ShapeDtypeStruct((b * h, l, d), jnp.float32),
-            jax.ShapeDtypeStruct((b * h, l, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, l_k, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, l_k, d), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -470,10 +491,10 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr, dor, lser, deltar)
-    dq = dq.reshape(b, h, l, d)
+    dq = dq.reshape(b, h, l_q, d)
     # dk/dv come back per q head; fold the group back onto the kv heads.
-    dk = dk.reshape(b, h_kv, group, l, d).sum(axis=2).astype(k.dtype)
-    dv = dv.reshape(b, h_kv, group, l, d).sum(axis=2).astype(v.dtype)
+    dk = dk.reshape(b, h_kv, group, l_k, d).sum(axis=2).astype(k.dtype)
+    dv = dv.reshape(b, h_kv, group, l_k, d).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -562,10 +583,11 @@ def _xla_attention(q, k, v, causal, scale, window=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         l_q, l_k = q.shape[2], k.shape[2]
-        mask = jnp.arange(l_k)[None, :] <= jnp.arange(l_q)[:, None]
+        # Decode convention: queries sit at the LAST l_q key positions.
+        q_pos = (l_k - l_q) + jnp.arange(l_q)[:, None]
+        mask = jnp.arange(l_k)[None, :] <= q_pos
         if window is not None:
-            mask = mask & (jnp.arange(l_k)[None, :]
-                           >= jnp.arange(l_q)[:, None] - window)
+            mask = mask & (jnp.arange(l_k)[None, :] >= q_pos - window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
@@ -596,9 +618,9 @@ _MEASURED_HEAD_DIM = 128
 # Values are (re)generated by bench_flash.py; keep in sync with the
 # committed BENCH_flash artifact.
 _SWEEP_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
-    1024: ("pallas", (256, 1024)),
-    2048: ("pallas", (1024, 1024)),
-    4096: ("pallas", (512, 512)),
+    1024: ("pallas", (1024, 1024)),
+    2048: ("xla", (256, 1024)),
+    4096: ("pallas", (1024, 1024)),
     8192: ("pallas", (512, 1024)),
     16384: ("pallas", (512, 2048)),
     32768: ("pallas", (1024, 1024)),
@@ -658,6 +680,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # negative window into an empty key range and NaN output
         # instead of an error.
         raise ValueError(f"window must be >= 0, got {window}")
+    if causal and q.shape[2] != k.shape[2]:
+        # CAUSAL cross-length alignment differs between the kernel
+        # (decode convention: queries are the LAST L_q key positions)
+        # and jax.nn's fused path — refusing here keeps the two dispatch
+        # targets semantically identical. Decode callers use
+        # flash_attention_pallas / flash_attention_with_lse directly.
+        # Non-causal cross-length (encoder-decoder) is unambiguous and
+        # passes through.
+        raise ValueError(
+            f"causal flash_attention requires L_q == L_k (got "
+            f"{q.shape[2]} vs {k.shape[2]}); for KV-cache decode use "
+            f"flash_attention_pallas(..., return_lse=...) which follows "
+            f"the decode convention")
     l, d = q.shape[2], q.shape[3]
     on_tpu = _target_platform() == "tpu"
     bq, bk = (_fit_block(l, b) for b in _best_blocks(l))

@@ -182,6 +182,116 @@ def test_sliding_window_matches_banded_oracle(window):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("l_q,l_k", [(64, 256), (1, 256), (128, 128)])
+@pytest.mark.parametrize("causal", [True, False])
+def test_cross_length_matches_oracle(l_q, l_k, causal):
+    """Decode / cross-attention: L_q < L_k with causal queries at the
+    LAST L_q key positions (KV-cache convention); includes the
+    single-token decode case L_q=1."""
+    b, h, d = 2, 2, 64
+    rng = np.random.default_rng(12)
+    q = jnp.asarray(rng.normal(size=(b, h, l_q, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, l_k, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, l_k, d)) * 0.5, jnp.float32)
+    got = flash_attention_pallas(q, k, v, causal=causal, block_q=64,
+                                 block_k=64, interpret=True)
+    want = _xla_attention(q, k, v, causal, 1.0 / d ** 0.5)
+    assert got.shape == (b, h, l_q, d)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_cross_length_decode_equals_full_last_rows():
+    """Decoding the last token against the cache must equal the last row
+    of full self-attention — the invariant KV-cache decoding relies on."""
+    b, h, l, d = 2, 2, 256, 64
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, l, d)) * 0.5, jnp.float32)
+    full = flash_attention_pallas(q, k, v, causal=True, block_q=64,
+                                  block_k=64, interpret=True)
+    last = flash_attention_pallas(q[:, :, -1:], k, v, causal=True,
+                                  block_q=64, block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, :, -1:]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_cross_length_backward_matches_oracle_grads():
+    b, h, l_q, l_k, d = 2, 2, 64, 256, 64
+    rng = np.random.default_rng(14)
+    q = jnp.asarray(rng.normal(size=(b, h, l_q, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, l_k, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, l_k, d)) * 0.5, jnp.float32)
+    scale = 1.0 / d ** 0.5
+    got = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, True, scale, 64, 64, True)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, scale) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("l_q,l_k", [(64, 256), (1, 256)])
+@pytest.mark.parametrize("window", [30, 100])
+def test_cross_length_with_window_matches_oracle(l_q, l_k, window):
+    """Window + offset is the most error-prone clamp arithmetic: both
+    band edges shift by offset = L_k - L_q in the forward kv clamp and
+    the backward _q_clamp."""
+    b, h, d = 2, 2, 64
+    rng = np.random.default_rng(15)
+    q = jnp.asarray(rng.normal(size=(b, h, l_q, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, l_k, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, l_k, d)) * 0.5, jnp.float32)
+    scale = 1.0 / d ** 0.5
+    got = flash_attention_pallas(q, k, v, causal=True, window=window,
+                                 block_q=64, block_k=64, interpret=True)
+    want = _xla_attention(q, k, v, True, scale, window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    gg = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, True, scale, 64, 64, True, window)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, scale, window=window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(gg, gw):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
+def test_public_entry_allows_noncausal_cross_length():
+    from gpumounter_tpu.ops.flash_attention import flash_attention
+    rng = np.random.default_rng(16)
+    q = jnp.asarray(rng.normal(size=(1, 2, 64, 64)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 2, 128, 64)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 2, 128, 64)) * 0.5, jnp.float32)
+    got = flash_attention(q, k, v, causal=False)
+    want = _xla_attention(q, k, v, False, 1.0 / 64 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cross_length_causal_rejects_longer_q():
+    q = jnp.zeros((1, 2, 256, 64), jnp.float32)
+    kv = jnp.zeros((1, 2, 128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="L_q <= L_k"):
+        flash_attention_pallas(q, kv, kv, causal=True, interpret=True)
+
+
+def test_public_entry_rejects_cross_length():
+    from gpumounter_tpu.ops.flash_attention import flash_attention
+    q = jnp.zeros((1, 2, 64, 64), jnp.float32)
+    kv = jnp.zeros((1, 2, 128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="L_q == L_k"):
+        flash_attention(q, kv, kv)
+
+
 def test_sliding_window_with_gqa():
     """window and GQA compose in one kv_index expression
     ((bh // group, clamped, 0)) — exercise them together, forward and
