@@ -1,5 +1,6 @@
 """TPU-native ops: Pallas kernels for the probe workload's hot paths."""
 
 from gpumounter_tpu.ops.flash_attention import flash_attention
+from gpumounter_tpu.ops.flash_decode import flash_decode
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_decode"]

@@ -694,8 +694,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"flash_attention_pallas(..., return_lse=...) which follows "
             f"the decode convention")
     l, d = q.shape[2], q.shape[3]
+    # Non-causal cross-length passes through, so dispatch must consider
+    # BOTH lengths: block_q fits L_q, block_k fits L_k, and "beyond the
+    # sweep" means the larger of the two (the fused path materializes
+    # (L_q, L_k) logits).
+    l_k = k.shape[2]
+    l_dispatch = max(l, l_k)
     on_tpu = _target_platform() == "tpu"
-    bq, bk = (_fit_block(l, b) for b in _best_blocks(l))
+    want_bq, want_bk = _best_blocks(l_dispatch)
+    bq, bk = _fit_block(l, want_bq), _fit_block(l_k, want_bk)
     # auto only takes the kernel when the fitted blocks stay lane-aligned
     # — odd lengths (primes, non-multiples of 128) degrade to tiny or
     # sublane-misaligned tiles that compile poorly or not at all; XLA
@@ -706,17 +713,17 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     elif backend == "auto":
         if window is not None:
             use_pallas = on_tpu and blocks_ok
-            if on_tpu and not blocks_ok and l > max(_SWEEP_TABLE):
+            if on_tpu and not blocks_ok and l_dispatch > max(_SWEEP_TABLE):
                 # Same loud refusal as the windowless beyond-sweep
                 # branch: the fused fallback materializes (L, L) f32
                 # logits regardless of local_window_size and aborts.
                 raise ValueError(
-                    f"flash_attention auto dispatch: windowed L={l} "
+                    f"flash_attention auto dispatch: windowed L={l_dispatch} "
                     f"exceeds the largest measured length "
                     f"({max(_SWEEP_TABLE)}) but does not tile into "
                     f"lane-aligned blocks (fit: {bq}x{bk}); pad L to a "
                     f"multiple of 128 or force backend explicitly")
-        elif l > max(_SWEEP_TABLE):
+        elif l_dispatch > max(_SWEEP_TABLE):
             # Beyond the largest measured L the fused XLA path is not a
             # fallback but a crash: its default implementation
             # materializes (L, L) f32 logits (137 GB at B=4 H=8 L=32k)
@@ -729,14 +736,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 # Refuse loudly: the fused path would abort with an
                 # opaque compile OOM at this L anyway.
                 raise ValueError(
-                    f"flash_attention auto dispatch: L={l} exceeds the "
+                    f"flash_attention auto dispatch: L={l_dispatch} exceeds the "
                     f"largest measured length ({max(_SWEEP_TABLE)}) but "
                     f"does not tile into lane-aligned blocks "
                     f"(fit: {bq}x{bk}); pad L to a multiple of 128 or "
                     f"force backend='pallas'/'xla' explicitly")
         else:
             in_envelope = causal and d == _MEASURED_HEAD_DIM
-            winner = _SWEEP_TABLE[_nearest_measured(l)][0]
+            winner = _SWEEP_TABLE[_nearest_measured(l_dispatch)][0]
             use_pallas = (on_tpu and blocks_ok and in_envelope
                           and winner == "pallas")
     elif backend == "xla":

@@ -1,0 +1,170 @@
+"""Fixed-shape KV-cache decode attention with a DYNAMIC valid length.
+
+The serving problem: a decode loop's cache grows by one token per step,
+and a kernel specialized on the cache length would recompile every step
+(or every bucket). Here the cache keeps a FIXED shape (B, H_kv, L_max, D)
+and the number of valid entries arrives as a traced int32 — threaded to
+the kernel via Pallas scalar prefetch (pltpu.PrefetchScalarGridSpec), so
+the grid index maps can clamp K/V streaming to the valid region at run
+time. ONE compile serves every cache length.
+
+How the dynamic length composes with the band machinery of
+flash_attention.py (reference: its static `offset` threading):
+  * queries are the LAST l_q valid positions — query row i sits at
+    global position (cache_len - l_q) + i;
+  * the score mask keeps k <= q_pos (causal within the valid region —
+    which also excludes every invalid slot, since q_pos == cache_len - 1
+    for the newest token) and optionally k >= q_pos - window;
+  * pl.when skips blocks entirely past the valid region (or outside the
+    window band), and the K/V index map clamps into the needed range, so
+    skipped blocks cost neither MXU time nor HBM traffic — per-step work
+    is O(cache_len·D), not O(L_max·D).
+
+GQA/MQA works as in the forward kernel: k/v may carry fewer heads and
+are read zero-copy through the index map (q head bh → kv head
+bh // group).
+
+Inference-only: no VJP (training uses ops.flash_attention, which has
+blockwise backward kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gpumounter_tpu.ops.flash_attention import (
+    NEG_INF,
+    _band_mask,
+    _band_needed,
+    _fit_block,
+)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_k: int, n_k: int,
+                   l_q: int, scale: float, window: int | None):
+    ik = pl.program_id(1)
+    cache_len = len_ref[0]
+    offset = cache_len - l_q          # dynamic: q row 0's global position
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # The shared band helpers accept a traced offset; with iq == 0 and
+    # block_q == l_q their causal condition `k_start <= offset + l_q - 1`
+    # is exactly `k_start < cache_len`, which is also what excludes the
+    # cache's invalid tail (the newest query sits at cache_len - 1, the
+    # last valid position).
+    needed = _band_needed(0, ik, l_q, block_k, True, window, offset)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]                  # (l_q, d)
+        k = k_ref[0]                  # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _band_mask(s, 0, ik, l_q, block_k, True, window, offset)
+
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+        l_scr[:, 0:1] = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1,
+                                                        keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, 0:1] = m_new
+
+    @pl.when(ik == n_k - 1)
+    def _writeback():
+        denom = jnp.maximum(l_scr[:, 0:1], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                 cache_len: jax.Array | int, *,
+                 scale: float | None = None, block_k: int = 512,
+                 window: int | None = None,
+                 interpret: bool = False) -> jax.Array:
+    """Attend the last l_q tokens against a fixed-shape KV cache.
+
+    q: (B, H, l_q, D) — the newest l_q tokens, ending at position
+    cache_len - 1. k_cache/v_cache: (B, H_kv, L_max, D); entries at
+    positions >= cache_len are ignored (any garbage is safe).
+    cache_len: int32 scalar, may be traced — the SAME compiled kernel
+    serves every value, clamped to [l_q, L_max].
+
+    Returns (B, H, l_q, D).
+    """
+    b, h, l_q, d = q.shape
+    h_kv, l_max = k_cache.shape[1], k_cache.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads "
+                         f"({h_kv})")
+    if window is not None and window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if l_q > l_max:
+        # Below, cache_len is clipped to [l_q, l_max]; with l_q > l_max
+        # that clip inverts and the offset goes negative — every query
+        # row would silently mask ALL keys and return zeros.
+        raise ValueError(f"l_q ({l_q}) must be <= cache capacity "
+                         f"({l_max})")
+    group = h // h_kv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_k = _fit_block(l_max, block_k)
+    n_k = l_max // block_k
+    cache_len = jnp.clip(jnp.asarray(cache_len, jnp.int32), l_q, l_max)
+
+    qr = q.reshape(b * h, l_q, d)
+    kr = k_cache.reshape(b * h_kv, l_max, d)
+    vr = v_cache.reshape(b * h_kv, l_max, d)
+
+    def kv_index(bh, ik, len_ref):
+        last_needed = (len_ref[0] - 1) // block_k
+        clamped = jnp.minimum(ik, last_needed)
+        if window is not None:
+            first_needed = jnp.maximum(
+                0, len_ref[0] - l_q - window) // block_k
+            clamped = jnp.maximum(clamped, first_needed)
+        return (bh // group, clamped, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, n_k),
+        in_specs=[
+            pl.BlockSpec((1, l_q, d), lambda bh, ik, len_ref: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, l_q, d),
+                               lambda bh, ik, len_ref: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((l_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((l_q, 128), jnp.float32),   # running denom
+            pltpu.VMEM((l_q, d), jnp.float32),     # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=block_k, n_k=n_k,
+                          l_q=l_q, scale=scale, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, l_q, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(cache_len.reshape(1), qr, kr, vr)
+    return out.reshape(b, h, l_q, d)

@@ -1,0 +1,91 @@
+"""flash_decode vs the cross-length oracle: dynamic cache_len, one
+compile for every length, garbage tolerance in the invalid tail."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gpumounter_tpu.ops.flash_attention import _xla_attention
+from gpumounter_tpu.ops.flash_decode import flash_decode
+
+
+@pytest.fixture(autouse=True)
+def _cpu_default():
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+
+
+def _setup(b=2, h=2, h_kv=2, l_max=256, l_q=1, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, h, l_q, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h_kv, l_max, d)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h_kv, l_max, d)) * 0.5, jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("cache_len", [1, 37, 64, 200, 256])
+def test_matches_oracle_at_any_length(cache_len):
+    q, k, v = _setup()
+    got = flash_decode(q, k, v, cache_len, block_k=64, interpret=True)
+    want = _xla_attention(q, k[:, :, :cache_len], v[:, :, :cache_len],
+                          True, 1.0 / 64 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_single_compile_serves_every_length():
+    """The whole point: cache_len is traced, so one jitted callable
+    decodes at every length without retracing."""
+    q, k, v = _setup()
+    traces = []
+
+    @jax.jit
+    def step(q, k, v, n):
+        traces.append(None)
+        return flash_decode(q, k, v, n, block_k=64, interpret=True)
+
+    for n in (8, 100, 256):
+        out = step(q, k, v, jnp.int32(n))
+        want = _xla_attention(q, k[:, :, :n], v[:, :, :n], True,
+                              1.0 / 64 ** 0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+    assert len(traces) == 1, "cache_len specialization caused retracing"
+
+
+def test_invalid_tail_is_ignored():
+    """Garbage (even huge values) beyond cache_len must not leak in."""
+    q, k, v = _setup()
+    cache_len = 100
+    k = k.at[:, :, cache_len:].set(1e9)
+    v = v.at[:, :, cache_len:].set(1e9)
+    got = flash_decode(q, k, v, cache_len, block_k=64, interpret=True)
+    want = _xla_attention(q, k[:, :, :cache_len], v[:, :, :cache_len],
+                          True, 1.0 / 64 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_multi_token_and_window():
+    """l_q > 1 (speculative / chunked decode) and a sliding window."""
+    q, k, v = _setup(l_q=8)
+    cache_len = 200
+    got = flash_decode(q, k, v, cache_len, block_k=64, window=50,
+                       interpret=True)
+    want = _xla_attention(q, k[:, :, :cache_len], v[:, :, :cache_len],
+                          True, 1.0 / 64 ** 0.5, window=50)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_decode():
+    q, k, v = _setup(h=4, h_kv=1)
+    got = flash_decode(q, k, v, 150, block_k=64, interpret=True)
+    want = _xla_attention(q, k[:, :, :150], v[:, :, :150], True,
+                          1.0 / 64 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
