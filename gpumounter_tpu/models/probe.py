@@ -62,8 +62,11 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * g
 
 
-def _block(x: jax.Array, p: dict, cfg: TransformerConfig) -> jax.Array:
-    b, t, d = x.shape
+def _qkv_heads(x, p, cfg):
+    """Pre-attention half of a block: rmsnorm + QKV projection split
+    into (b, n_heads, t, d_head). ONE source of truth for the block
+    math shared by full forward and cached decode."""
+    b, t, _ = x.shape
     h = _rmsnorm(x, p["ln1"])
     qkv = h @ p["wqkv"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -71,20 +74,45 @@ def _block(x: jax.Array, p: dict, cfg: TransformerConfig) -> jax.Array:
     def heads(a):
         return a.reshape(b, t, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
+    return heads(q), heads(k), heads(v)
+
+
+def _finish_block(x, attn_heads, p):
+    """Post-attention half: output projection, residual, MLP."""
+    b, _, t, _ = attn_heads.shape
+    out = attn_heads.transpose(0, 2, 1, 3).reshape(b, t, -1) @ p["wo"]
+    x = x + out
+    h = _rmsnorm(x, p["ln2"])
+    return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+
+
+def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
+           return_kv: bool = False):
+    q, k, v = _qkv_heads(x, p, cfg)
     # The framework attention op: data-driven dispatch (committed sweep)
     # picks the Pallas kernel or XLA's fused attention per shape. At
     # probe scale (d_head 32, short L) this resolves to the fused path,
     # which is also safely partitionable under the tp sharding of
     # parallel/train_step.py.
     from gpumounter_tpu.ops.flash_attention import flash_attention
-    out = flash_attention(q, k, v, causal=True)
-    out = out.transpose(0, 2, 1, 3).reshape(b, t, d) @ p["wo"]
-    x = x + out
-
-    h = _rmsnorm(x, p["ln2"])
-    x = x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+    x = _finish_block(x, flash_attention(q, k, v, causal=True), p)
+    if return_kv:
+        return x, k, v
     return x
+
+
+def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
+    """One block for one new token (b, 1, d): write this step's K/V into
+    the fixed-shape cache at position cur_len - 1, then attend through
+    ops.flash_decode (dynamic valid length — no recompilation as the
+    cache fills)."""
+    from gpumounter_tpu.ops.flash_decode import flash_decode
+
+    q, k, v = _qkv_heads(x, p, cfg)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cur_len - 1, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cur_len - 1, 0))
+    out = flash_decode(q, k_cache, v_cache, cur_len, interpret=interpret)
+    return _finish_block(x, out, p), k_cache, v_cache
 
 
 @partial(jax.jit, static_argnums=2)
@@ -95,6 +123,57 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Arra
     for blk in params["blocks"]:
         x = _block(x, blk, cfg)
     return (x @ params["embed"].T).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
+             n_new: int) -> jax.Array:
+    """Greedy autoregressive generation with a fixed-shape KV cache.
+
+    prompt: (batch, t0) int32; returns (batch, t0 + n_new). Prefill runs
+    the full forward once (harvesting per-layer K/V); the decode loop is
+    a lax.scan whose every step attends through ops.flash_decode with a
+    traced cache length — the whole call compiles exactly once per
+    (prompt shape, n_new), never per step.
+    """
+    b, t0 = prompt.shape
+    if t0 + n_new > cfg.max_len:
+        raise ValueError(f"prompt ({t0}) + n_new ({n_new}) exceeds "
+                         f"max_len ({cfg.max_len})")
+    from gpumounter_tpu.ops.flash_attention import _target_platform
+    interpret = _target_platform() != "tpu"
+
+    # Prefill: full forward over the prompt, K/V into fixed-shape caches.
+    x = params["embed"][prompt] + params["pos"][:t0]
+    caches = []
+    for blk in params["blocks"]:
+        x, k, v = _block(x, blk, cfg, return_kv=True)
+        kc = jnp.zeros((b, cfg.n_heads, cfg.max_len, cfg.d_head), k.dtype)
+        vc = jnp.zeros_like(kc)
+        caches.append((kc.at[:, :, :t0].set(k), vc.at[:, :, :t0].set(v)))
+    logits0 = (x[:, -1] @ params["embed"].T).astype(jnp.float32)
+    first_new = jnp.argmax(logits0, axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        caches, token, cur_len = carry
+        x = (params["embed"][token][:, None, :]
+             + jax.lax.dynamic_slice(
+                 params["pos"], (cur_len, 0), (1, params["pos"].shape[1])))
+        new_caches = []
+        for blk, (kc, vc) in zip(params["blocks"], caches):
+            x, kc, vc = _block_decode(x, blk, cfg, kc, vc, cur_len + 1,
+                                      interpret)
+            new_caches.append((kc, vc))
+        logits = (x[:, -1] @ params["embed"].T).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+        return (new_caches, nxt, cur_len + 1), token
+
+    # Each step consumes the token generated by the previous step (the
+    # scan's carry, seeded with the prefill's argmax) and emits it, so
+    # the collected outputs are exactly the n_new generated tokens.
+    _, toks = jax.lax.scan(
+        step, (caches, first_new, jnp.int32(t0)), None, length=n_new)
+    return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
