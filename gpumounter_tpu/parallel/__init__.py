@@ -1,4 +1,7 @@
 from gpumounter_tpu.parallel.mesh import build_mesh, mesh_shape_for
+from gpumounter_tpu.parallel.ring_attention import ring_attention
+from gpumounter_tpu.parallel.tp_attention import tp_flash_attention
 from gpumounter_tpu.parallel.train_step import make_train_step, shard_params
 
-__all__ = ["build_mesh", "mesh_shape_for", "make_train_step", "shard_params"]
+__all__ = ["build_mesh", "mesh_shape_for", "make_train_step",
+           "ring_attention", "shard_params", "tp_flash_attention"]

@@ -1,0 +1,66 @@
+"""Tensor-parallel attention: heads sharded over a mesh axis.
+
+Attention is embarrassingly parallel over heads — no collectives are
+needed, only placement — but a Pallas kernel cannot be partitioned by
+XLA's automatic sharding (a custom call is opaque to the partitioner),
+so under jit-with-shardings the kernel would force a gather to one
+device. This wrapper runs the kernel under shard_map instead: each
+device gets its head shard and runs the kernel locally, which is the
+TPU-idiomatic way to combine tp sharding with custom kernels
+(scaling-book recipe: mesh + shardings; shard_map where the compiler
+cannot infer).
+
+GQA composes when the kv heads divide evenly over the same axis
+(H_kv % axis_size == 0); each shard then holds whole q-head groups and
+the kernel's zero-copy group mapping works per shard unchanged.
+
+Combine with ring_attention for sequences too long for one device: tp
+over heads x ring over sequence is a 2-D mesh with this wrapper's
+in_specs extended by the seq axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def tp_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                       mesh: Mesh, *, head_axis: str = "model",
+                       causal: bool = True, scale: float | None = None,
+                       backend: str = "auto",
+                       window: int | None = None) -> jax.Array:
+    """(B, H, L, D) attention with H sharded over `mesh`'s `head_axis`.
+
+    q/k/v may be unsharded (shard_map places them) or already sharded
+    with P(None, head_axis, None, None). GQA: k/v may carry fewer heads;
+    both H and H_kv must divide the axis size evenly so every shard
+    holds whole groups. Dispatch (kernel vs fused XLA) happens per
+    shard via the public flash_attention entry.
+    """
+    # Lazy, like every other flash_attention consumer: keeps the Pallas
+    # import out of mesh-only startup paths.
+    from gpumounter_tpu.ops.flash_attention import flash_attention
+
+    n_shards = mesh.shape[head_axis]
+    h, h_kv = q.shape[1], k.shape[1]
+    if h % n_shards or h_kv % n_shards:
+        raise ValueError(
+            f"heads must divide the {head_axis!r} axis evenly: "
+            f"H={h}, H_kv={h_kv}, axis size {n_shards}")
+    spec = P(None, head_axis, None, None)
+    body = partial(flash_attention, causal=causal, scale=scale,
+                   backend=backend, window=window)
+    fn = jax.shard_map(lambda q, k, v: body(q, k, v), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+    return fn(q, k, v)
+
+
+def shard_heads(x: jax.Array, mesh: Mesh,
+                head_axis: str = "model") -> jax.Array:
+    """Place a (B, H, L, D) array with H split over the mesh axis."""
+    return jax.device_put(
+        x, NamedSharding(mesh, P(None, head_axis, None, None)))
