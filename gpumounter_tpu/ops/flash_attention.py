@@ -47,6 +47,15 @@ def _band_needed(iq, ik, block_q, block_k, causal, window, offset=0):
     return needed
 
 
+def _softcap(s, cap):
+    """Gemma-2-style logit soft-capping: cap·tanh(s/cap), applied to RAW
+    scores BEFORE masking (masked positions must stay at NEG_INF, which
+    tanh would crush to ±cap)."""
+    if cap is None:
+        return s
+    return cap * jnp.tanh(s / cap)
+
+
 def _band_mask(s, iq, ik, block_q, block_k, causal, window, offset=0):
     """Apply the causal / sliding-window mask to a score tile."""
     if not causal:
@@ -64,7 +73,8 @@ def _band_mask(s, iq, ik, block_q, block_k, causal, window, offset=0):
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_k: int, n_k: int, scale: float,
                   causal: bool, window: int | None = None,
-                  offset: int = 0, with_lse: bool = False):
+                  offset: int = 0, softcap: float | None = None,
+                  with_lse: bool = False):
     lse_ref = rest[0] if with_lse else None
     m_scr, l_scr, acc_scr = rest[-3:]
     ik = pl.program_id(2)
@@ -91,6 +101,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
 
+        s = _softcap(s, softcap)
         s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
 
         m_prev = m_scr[:, 0:1]                             # (block_q, 1)
@@ -132,7 +143,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, block_q: int, block_k: int,
                          n_k: int, scale: float, causal: bool,
-                         window: int | None = None, offset: int = 0):
+                         window: int | None = None, offset: int = 0,
+                         softcap: float | None = None):
     """dq = Σ_k  [p ∘ (do·vᵀ − Δ)]·k·scale, accumulated over k blocks.
 
     p is recomputed from the saved lse (p = exp(s − lse)); Δ is the
@@ -155,10 +167,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0, 0]                                # (block_q,)
         delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(
+        s_cap = _softcap(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
+            preferred_element_type=jnp.float32) * scale, softcap)
+        s = _band_mask(s_cap, iq, ik, block_q, block_k, causal, window,
+                       offset)
         # Fully-masked rows keep lse == NEG_INF; exp(s - NEG_INF) would
         # overflow, so zero them explicitly. Reshape the f32 column FIRST
         # and compare in 2-D: Mosaic cannot insert a minor dim on the i1
@@ -169,6 +182,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
+        if softcap is not None:
+            # chain rule through cap·tanh(s/cap): d/ds = 1 − (s_cap/cap)²
+            ds = ds * (1.0 - jnp.square(s_cap / softcap))
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -182,7 +198,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
                           block_k: int, n_q: int, scale: float,
                           causal: bool, window: int | None = None,
-                          offset: int = 0):
+                          offset: int = 0,
+                          softcap: float | None = None):
     """dk = Σ_q dsᵀ·q·scale and dv = Σ_q pᵀ·do, accumulated over q blocks
     for one k block (grid: (batch·heads, k-blocks, q-blocks), last axis
     sequential so the scratch accumulators persist)."""
@@ -206,10 +223,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(
+        s_cap = _softcap(jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
+            preferred_element_type=jnp.float32) * scale, softcap)
+        s = _band_mask(s_cap, iq, ik, block_q, block_k, causal, window,
+                       offset)
         lse_col = lse[:, None]
         p = jnp.where(lse_col <= NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
         dv_scr[:] += jax.lax.dot_general(
@@ -219,6 +237,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
+        if softcap is not None:
+            ds = ds * (1.0 - jnp.square(s_cap / softcap))
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -251,7 +271,8 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            block_q: int = 256, block_k: int = 512,
                            interpret: bool = False,
                            return_lse: bool = False,
-                           window: int | None = None):
+                           window: int | None = None,
+                           softcap: float | None = None):
     """(B, H, L, D) attention via the Pallas kernel. Block sizes are
     clamped to L and reduced to the largest dividing size when the
     requested blocks do not divide L.
@@ -307,7 +328,7 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
         scale=scale, causal=causal, window=window, offset=offset,
-        with_lse=return_lse)
+        softcap=softcap, with_lse=return_lse)
     # Flattened q-head index bh = i*h + j maps to kv head
     # i*h_kv + j//group == bh // group (since h = h_kv*group).
     if causal:
@@ -370,7 +391,8 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool,
-                    window: int | None = None):
+                    window: int | None = None,
+                    softcap: float | None = None):
     """Run the two backward kernels; q/do are (B, H, L, D), k/v
     (B, H_kv, L, D) with H % H_kv == 0, lse/delta (B, H, L) float32.
     Returns (dq, dk, dv) in the input dtypes; dk/dv have H_kv heads.
@@ -438,7 +460,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, n_k=n_k, scale=scale,
-                          causal=causal, window=window, offset=offset),
+                          causal=causal, window=window, offset=offset,
+                          softcap=softcap),
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -460,7 +483,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, n_q=n_q, scale=scale,
-                          causal=causal, window=window, offset=offset),
+                          causal=causal, window=window, offset=offset,
+                          softcap=softcap),
         grid=(b * h, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
@@ -498,10 +522,11 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
                              block_q: int, block_k: int, interpret: bool,
-                             window: int | None = None):
+                             window: int | None = None,
+                             softcap: float | None = None):
     """Differentiable flash attention returning (o, lse). The VJP runs
     the blockwise backward kernels (O(L·D) memory — no (L, L) score
     matrix in either direction); an incoming lse cotangent is folded
@@ -510,18 +535,18 @@ def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
     return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
                                   interpret=interpret, return_lse=True,
-                                  window=window)
+                                  window=window, softcap=softcap)
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None):
+                   window=None, softcap=None):
     o, lse = flash_attention_with_lse(q, k, v, causal, scale, block_q,
-                                      block_k, interpret, window)
+                                      block_k, interpret, window, softcap)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
-                   res, cot):
+                   softcap, res, cot):
     q, k, v, o, lse = res
     do, dlse = cot
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -529,16 +554,16 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
     dq, dk, dv = _flash_backward(q, k, v, do, lse, delta, causal=causal,
                                  scale=scale, block_q=block_q,
                                  block_k=block_k, interpret=interpret,
-                                 window=window)
+                                 window=window, softcap=softcap)
     return dq, dk, dv
 
 
 flash_attention_with_lse.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
-                               interpret, window=None):
+                               interpret, window=None, softcap=None):
     """Public-path primal: the EXACT kernel the committed sweep timed
     (no lse output). Only under differentiation does the fwd rule switch
     to the with-lse kernel — lse is a residual the backward needs anyway
@@ -546,31 +571,33 @@ def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
     agreement."""
     return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
-                                  interpret=interpret, window=window)
+                                  interpret=interpret, window=window,
+                                  softcap=softcap)
 
 
 def _trainable_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None):
+                   window=None, softcap=None):
     o, lse = flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                     block_q=block_q, block_k=block_k,
                                     interpret=interpret, return_lse=True,
-                                    window=window)
+                                    window=window, softcap=softcap)
     return o, (q, k, v, o, lse)
 
 
 def _trainable_bwd(causal, scale, block_q, block_k, interpret, window,
-                   res, do):
+                   softcap, res, do):
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     return _flash_backward(q, k, v, do, lse, delta, causal=causal,
                            scale=scale, block_q=block_q, block_k=block_k,
-                           interpret=interpret, window=window)
+                           interpret=interpret, window=window,
+                           softcap=softcap)
 
 
 _flash_attention_trainable.defvjp(_trainable_fwd, _trainable_bwd)
 
 
-def _xla_attention(q, k, v, causal, scale, window=None):
+def _xla_attention(q, k, v, causal, scale, window=None, softcap=None):
     """Naive materialized-(L, L) attention. CORRECTNESS ORACLE ONLY — it
     is deliberately the simplest possible formulation. Never benchmark
     against this (VERDICT r2 weak #1); the performance baseline is
@@ -581,6 +608,7 @@ def _xla_attention(q, k, v, causal, scale, window=None):
         k = jnp.repeat(k, reps, axis=1)
         v = jnp.repeat(v, reps, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    s = _softcap(s, softcap)
     if causal:
         l_q, l_k = q.shape[2], k.shape[2]
         # Decode convention: queries sit at the LAST l_q key positions.
@@ -651,7 +679,8 @@ def _best_blocks(l: int) -> tuple[int, int]:
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
                     backend: str = "auto",
-                    window: int | None = None) -> jax.Array:
+                    window: int | None = None,
+                    softcap: float | None = None) -> jax.Array:
     """Public entry.
 
     backend: "auto" picks per sequence length from the committed sweep
@@ -670,9 +699,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     whenever its tiles are lane-aligned (the win is structural, not
     sweep-derived) and otherwise falls back to the fused path's
     local_window_size.
+
+    softcap: Gemma-2-style logit capping cap·tanh(s/cap). ONLY the
+    kernel implements it (jax.nn's fused attention has no such knob),
+    so softcap forces the Pallas path — the interpret kernel off-TPU,
+    and a clear error on TPU shapes whose tiles cannot lane-align.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if softcap is not None and softcap <= 0:
+        raise ValueError(f"softcap must be > 0, got {softcap}")
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
     if window is not None and window < 0:
@@ -708,10 +744,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # sublane-misaligned tiles that compile poorly or not at all; XLA
     # handles those lengths fine.
     blocks_ok = bq % 128 == 0 and bk % 128 == 0
+    if backend == "xla" and softcap is not None:
+        raise ValueError("backend='xla' cannot apply softcap (the fused "
+                         "path has no logit-capping knob)")
     if backend == "pallas":
         use_pallas = True
     elif backend == "auto":
-        if window is not None:
+        if softcap is not None:
+            # Only the kernel caps logits; there is no fused fallback.
+            use_pallas = True
+            if on_tpu and not blocks_ok:
+                raise ValueError(
+                    f"flash_attention: softcap needs the Pallas kernel "
+                    f"but L_q={l}/L_k={l_k} do not tile into "
+                    f"lane-aligned blocks (fit: {bq}x{bk}); pad L to a "
+                    f"multiple of 128")
+        elif window is not None:
             use_pallas = on_tpu and blocks_ok
             if on_tpu and not blocks_ok and l_dispatch > max(_SWEEP_TABLE):
                 # Same loud refusal as the windowless beyond-sweep
@@ -754,5 +802,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # Custom-VJP wrapper: trainable (blockwise backward kernels, no
         # (L, L) matrix), and its primal is the exact swept kernel.
         return _flash_attention_trainable(q, k, v, causal, scale, bq, bk,
-                                          not on_tpu, window)
+                                          not on_tpu, window, softcap)
     return fused_xla_attention(q, k, v, causal, scale, window)

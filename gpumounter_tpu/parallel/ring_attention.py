@@ -197,6 +197,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                        causal=causal, block_q=block_q, block_k=block_k,
                        interpret=not on_tpu)
     elif impl == "xla":
+        if k.shape[1] != q.shape[1]:
+            # Only the flash body reads grouped K/V heads natively (zero
+            # copy); the einsum body needs equal heads. Broadcast rather
+            # than error so impl="auto" stays correct for GQA wherever
+            # auto resolves to the xla body (CPU, off-envelope shapes).
+            reps = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, reps, axis=1)
+            v = jnp.repeat(v, reps, axis=1)
         body = partial(_ring_attention_local, axis_name=seq_axis,
                        scale=scale, causal=causal)
     else:

@@ -31,7 +31,8 @@ def tp_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                        mesh: Mesh, *, head_axis: str = "model",
                        causal: bool = True, scale: float | None = None,
                        backend: str = "auto",
-                       window: int | None = None) -> jax.Array:
+                       window: int | None = None,
+                       softcap: float | None = None) -> jax.Array:
     """(B, H, L, D) attention with H sharded over `mesh`'s `head_axis`.
 
     q/k/v may be unsharded (shard_map places them) or already sharded
@@ -52,10 +53,9 @@ def tp_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"H={h}, H_kv={h_kv}, axis size {n_shards}")
     spec = P(None, head_axis, None, None)
     body = partial(flash_attention, causal=causal, scale=scale,
-                   backend=backend, window=window)
-    fn = jax.shard_map(lambda q, k, v: body(q, k, v), mesh=mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec,
-                       check_vma=False)
+                   backend=backend, window=window, softcap=softcap)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 

@@ -355,6 +355,53 @@ def test_sliding_window_matches_fused_local_window():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_softcap_matches_oracle(causal):
+    """Gemma-2 logit capping cap·tanh(s/cap), forward and backward."""
+    q, k, v = _qkv()
+    scale = 1.0 / q.shape[-1] ** 0.5
+    got = flash_attention_pallas(q, k, v, causal=causal, softcap=30.0,
+                                 block_q=128, block_k=128, interpret=True)
+    want = _xla_attention(q, k, v, causal, scale, softcap=30.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    gg = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, causal, scale, 128, 128, True, None, 30.0)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, causal, scale, softcap=30.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(gg, gw):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
+def test_softcap_actually_caps():
+    """With a tiny cap the outputs must differ from uncapped attention
+    (guards against the cap being silently dropped)."""
+    q, k, v = _qkv()
+    a = flash_attention_pallas(q, k, v, softcap=0.5, block_q=128,
+                               block_k=128, interpret=True)
+    b = flash_attention_pallas(q, k, v, block_q=128, block_k=128,
+                               interpret=True)
+    assert float(jnp.abs(a - b).max()) > 1e-3
+
+
+def test_softcap_public_dispatch():
+    from gpumounter_tpu.ops.flash_attention import flash_attention
+    q, k, v = _qkv()
+    got = flash_attention(q, k, v, softcap=30.0)   # forces kernel path
+    want = _xla_attention(q, k, v, True, 1.0 / q.shape[-1] ** 0.5,
+                          softcap=30.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError, match="cannot apply softcap"):
+        flash_attention(q, k, v, backend="xla", softcap=30.0)
+    with pytest.raises(ValueError, match="softcap must be > 0"):
+        flash_attention(q, k, v, softcap=-1.0)
+
+
 def test_target_platform_accepts_string_default_device():
     """jax_default_device may hold a platform STRING (jax-supported);
     _target_platform must not assume a Device object."""

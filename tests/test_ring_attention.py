@@ -105,6 +105,22 @@ def test_flash_impl_gqa():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_gqa_auto_impl_on_cpu():
+    """impl='auto' resolves to the xla body on CPU; GQA inputs must be
+    broadcast there, not crash (the flash body reads them natively)."""
+    mesh = _mesh(4)
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(2, 4, 64, 16)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 2, 64, 16)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 2, 64, 16)) * 0.3, jnp.float32)
+    want = reference_attention(q, jnp.repeat(k, 2, axis=1),
+                               jnp.repeat(v, 2, axis=1))
+    got = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh))(
+        shard_qkv(q, mesh), shard_qkv(k, mesh), shard_qkv(v, mesh))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_impl_matches_xla_impl():
     mesh = _mesh(4)
     q, k, v = _qkv(l=64)
