@@ -34,6 +34,14 @@ def _chunk_attention(q, k, v, q_pos, k_pos, m_prev, num_prev, den_prev,
     causal masking. Accumulators: m (B,H,Lq,1), num (B,H,Lq,D),
     den (B,H,Lq,1) — combined across steps in fp32.
     """
+    if k.shape[1] != q.shape[1]:
+        # GQA: broadcast INSIDE the chunk step so the ring rotates the
+        # compact H_kv heads (ICI volume and per-device K/V memory stay
+        # H_kv/H of the broadcast size); only this transient score
+        # computation sees full heads.
+        reps = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
@@ -177,6 +185,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if q.shape[1] % k.shape[1]:
+        # Same explicit check as the ops-level paths — fail here with a
+        # clear message, not deep inside shard_map with a shape error.
+        raise ValueError(f"q heads ({q.shape[1]}) must be a multiple of "
+                         f"kv heads ({k.shape[1]})")
     spec = P(None, None, seq_axis, None)
     on_tpu = any(dev.platform == "tpu" for dev in mesh.devices.flat)
     if impl == "auto":
@@ -197,14 +210,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                        causal=causal, block_q=block_q, block_k=block_k,
                        interpret=not on_tpu)
     elif impl == "xla":
-        if k.shape[1] != q.shape[1]:
-            # Only the flash body reads grouped K/V heads natively (zero
-            # copy); the einsum body needs equal heads. Broadcast rather
-            # than error so impl="auto" stays correct for GQA wherever
-            # auto resolves to the xla body (CPU, off-envelope shapes).
-            reps = q.shape[1] // k.shape[1]
-            k = jnp.repeat(k, reps, axis=1)
-            v = jnp.repeat(v, reps, axis=1)
         body = partial(_ring_attention_local, axis_name=seq_axis,
                        scale=scale, causal=causal)
     else:
