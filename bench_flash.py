@@ -74,29 +74,10 @@ def chained(attn_fn, iters):
 
 
 def _min_time(fn, q, k, v_variants) -> tuple[float, bool]:
-    """Min wall seconds over REPS calls, each on a DISTINCT v buffer,
-    each timed to a fetched OUTPUT probe. Returns (seconds, cache_served).
-
-    Two defenses, both load-bearing on this remote tunnel:
-      * distinct inputs — the r02/early-r03 sweeps reused buffers across
-        reps and repeat (executable, buffers) calls were cache-served
-        (0.003 ms / 2,792 TFLOP/s "timings");
-      * the timed window ends at np.asarray() of an 8-element output
-        probe, NOT at block_until_ready() — the latter returned before
-        execution on this tunnel (distinct buffers still yielded
-        microsecond chains). Distinct inputs imply pairwise-distinct
-        correct outputs, so identical probes prove a stale cache and the
-        measurement is marked cache_served → invalid.
-    """
-    np.asarray(fn(q, k, v_variants[-1])[0, 0, :8, 0])  # compile + warm
-    best = float("inf")
-    probes = []
-    for i in range(REPS):
-        t0 = time.perf_counter()
-        probe = np.asarray(fn(q, k, v_variants[i])[0, 0, :8, 0])
-        best = min(best, time.perf_counter() - t0)
-        probes.append(probe.tobytes())
-    return best, len(set(probes)) < len(probes)
+    """Distinct-input, probe-fetched timing (see bench_timing.py for the
+    discipline and why block_until_ready is not trusted here)."""
+    from bench_timing import min_time_probed
+    return min_time_probed(fn, q, k, v_variants, REPS)
 
 
 def entry_for(t_ms: float, flops: float, cache_served: bool = False) -> dict:

@@ -48,15 +48,8 @@ def chained(fn, iters):
 
 
 def _min_time(fn, q, k, v_variants):
-    np.asarray(fn(q, k, v_variants[-1])[0, 0, :8, 0])
-    best = float("inf")
-    probes = []
-    for i in range(REPS):
-        t0 = time.perf_counter()
-        probe = np.asarray(fn(q, k, v_variants[i])[0, 0, :8, 0])
-        best = min(best, time.perf_counter() - t0)
-        probes.append(probe.tobytes())
-    return best, len(set(probes)) < len(probes)
+    from bench_timing import min_time_probed
+    return min_time_probed(fn, q, k, v_variants, REPS)
 
 
 def delta_ms(fn, q, k, vv):

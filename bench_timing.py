@@ -1,0 +1,33 @@
+"""Shared timing discipline for the flash bench harnesses.
+
+Two defenses, both load-bearing on the remote PJRT tunnel this repo
+benches through (see bench_flash.py's module docstring for the full
+history):
+  * every timed rep consumes a DISTINCT input buffer — repeat
+    (executable, buffers) pairs were served from a cache;
+  * the timed window ends at np.asarray() of a small OUTPUT probe, not
+    at block_until_ready() — the latter returned before execution.
+Distinct inputs imply pairwise-distinct correct outputs, so identical
+probes prove a stale cache and the measurement is flagged.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def min_time_probed(fn, q, k, v_variants, reps) -> tuple[float, bool]:
+    """Min wall seconds of fn(q, k, v_variants[i]) over `reps` calls,
+    each on a distinct v buffer, each timed to a fetched 8-element
+    output probe. Returns (seconds, cache_served)."""
+    np.asarray(fn(q, k, v_variants[-1])[0, 0, :8, 0])  # compile + warm
+    best = float("inf")
+    probes = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        probe = np.asarray(fn(q, k, v_variants[i])[0, 0, :8, 0])
+        best = min(best, time.perf_counter() - t0)
+        probes.append(probe.tobytes())
+    return best, len(set(probes)) < len(probes)

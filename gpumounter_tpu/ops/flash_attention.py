@@ -249,6 +249,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _make_kv_index(group, block_q, block_k, causal, window, offset):
+    """Index map for K/V blocks on a (bh, iq, ik) grid, shared by the
+    forward and dq kernels: the GQA head fold (bh // group) plus the
+    DMA half of the band skip — clamping into [first, last] makes every
+    compute-skipped iteration re-reference the block already resident
+    in VMEM, and Mosaic elides the copy."""
+    if not causal:
+        return lambda bh, iq, ik: (bh // group, ik, 0)
+
+    def kv_index(bh, iq, ik):
+        last = (offset + iq * block_q + block_q - 1) // block_k
+        clamped = jnp.minimum(ik, last)
+        if window is not None:
+            first = jnp.maximum(
+                0, offset + iq * block_q - window) // block_k
+            clamped = jnp.maximum(clamped, first)
+        return (bh // group, clamped, 0)
+
+    return kv_index
+
+
 def _fit_block(l: int, want: int) -> int:
     """Largest divisor of l that is <= want, preferring lane-aligned
     (multiple-of-128) sizes. A valid dividing block always exists (1
@@ -331,27 +352,11 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
         softcap=softcap, with_lse=return_lse)
     # Flattened q-head index bh = i*h + j maps to kv head
     # i*h_kv + j//group == bh // group (since h = h_kv*group).
-    if causal:
-        # Band DMA skip: iterations whose whole k block is outside the
-        # attention band are compute-skipped by pl.when, but the
-        # BlockSpec would still stream their K/V from HBM — for full
-        # causal that is ~2x the necessary K/V traffic, and with a
-        # sliding window nearly all of it. Clamping the index map into
-        # [first_needed, last_needed] makes every masked-out iteration
-        # re-reference the block already resident in VMEM; Mosaic
-        # detects the unchanged index and elides the copy, so K/V
-        # traffic drops to only the needed blocks.
-        def kv_index(bh, iq, ik):
-            last_needed = (offset + iq * block_q + block_q - 1) // block_k
-            clamped = jnp.minimum(ik, last_needed)
-            if window is not None:
-                first_needed = jnp.maximum(
-                    0, offset + iq * block_q - window) // block_k
-                clamped = jnp.maximum(clamped, first_needed)
-            return (bh // group, clamped, 0)
-    else:
-        def kv_index(bh, iq, ik):
-            return (bh // group, ik, 0)
+    # Band DMA skip: without the clamp, compute-skipped iterations would
+    # still stream their K/V from HBM — ~2x the necessary traffic for
+    # full causal, nearly all of it with a sliding window.
+    kv_index = _make_kv_index(group, block_q, block_k, causal, window,
+                              offset)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
@@ -417,21 +422,12 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     lser = jnp.broadcast_to(lse.reshape(b * h, 1, l_q), (b * h, 8, l_q))
     deltar = jnp.broadcast_to(delta.reshape(b * h, 1, l_q), (b * h, 8, l_q))
 
+    kv_index = _make_kv_index(group, block_q, block_k, causal, window,
+                              offset)
     if causal:
-        # Same DMA-skip trick as the forward kernel, in both directions:
-        # dq iterates k blocks (clamped into the band), dk/dv iterates
-        # q blocks (clamped into the transposed band: q in
-        # [k, k + window]). All clamps live on the key timeline, where
-        # query row i sits at global position offset + i.
-        def kv_index(bh, iq, ik):
-            last = (offset + iq * block_q + block_q - 1) // block_k
-            clamped = jnp.minimum(ik, last)
-            if window is not None:
-                first = jnp.maximum(
-                    0, offset + iq * block_q - window) // block_k
-                clamped = jnp.maximum(clamped, first)
-            return (bh // group, clamped, 0)
-
+        # Transposed band for dk/dv: it iterates q blocks, clamped into
+        # [k, k + window] on the key timeline (query row i sits at
+        # global position offset + i).
         def _q_clamp(ik, iq):
             first = jnp.maximum(0, ik * block_k - offset) // block_q
             clamped = jnp.maximum(iq, first)
@@ -448,9 +444,6 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
         def qrow_index(bh, ik, iq):
             return (bh, 0, _q_clamp(ik, iq))
     else:
-        def kv_index(bh, iq, ik):
-            return (bh // group, ik, 0)
-
         def q_index(bh, ik, iq):
             return (bh, iq, 0)
 
