@@ -106,7 +106,7 @@ def _combine_chunks(o_prev, lse_prev, o_chunk, lse_chunk):
 
 def _ring_flash_local(q, k, v, *, axis_name: str, scale: float,
                       causal: bool, block_q: int, block_k: int,
-                      interpret: bool):
+                      interpret: bool, softcap: float | None = None):
     """Per-device ring body with the Pallas flash kernel as the inner
     chunk step. Memory is O(chunk·D) — no (Lq, Lk) score matrix even per
     chunk — and causal chunk classification is real control flow
@@ -134,8 +134,11 @@ def _ring_flash_local(q, k, v, *, axis_name: str, scale: float,
 
     def attend(q_, k_, v_, causal_):
         # custom-VJP wrapper: trainable, lse cotangent folded into Δ.
+        # softcap composes with the cross-chunk combine exactly: capping
+        # is per-score, and the lse of capped scores merges like any lse.
         return flash_attention_with_lse(q_, k_, v_, causal_, scale,
-                                        block_q, block_k, interpret)
+                                        block_q, block_k, interpret,
+                                        None, softcap)
 
     def step(carry, s):
         k_cur, v_cur, o, lse = carry
@@ -170,7 +173,8 @@ def _ring_flash_local(q, k, v, *, axis_name: str, scale: float,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    *, seq_axis: str = "seq", causal: bool = True,
                    scale: float | None = None, impl: str = "auto",
-                   block_q: int = 256, block_k: int = 512) -> jax.Array:
+                   block_q: int = 256, block_k: int = 512,
+                   softcap: float | None = None) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `seq_axis`.
 
     q, k, v: (batch, heads, seq, head_dim), sharded (or shardable) with
@@ -181,7 +185,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     cross-chunk combine, O(chunk·D) memory, causal chunks skipped by
     lax.cond — interpret mode off-TPU so it works everywhere); "xla"
     keeps the einsum online-softmax body (materializes per-chunk scores,
-    shape-robust); "auto" picks flash on TPU and xla elsewhere.
+    shape-robust); "auto" picks flash on TPU inside the measured
+    envelope (causal, head_dim 128, lane-aligned chunks) and xla
+    otherwise.
+
+    softcap: Gemma-2-style logit capping cap·tanh(s/cap), applied per
+    chunk score (it composes exactly with the lse combine). Only the
+    flash body caps, so softcap forces impl="flash": auto takes the
+    flash body even off-TPU (interpret mode), impl="xla" raises, and on
+    TPU an un-tileable chunk raises a clear error instead of failing in
+    Mosaic.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -204,12 +217,28 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         bq, bk = _fit_block(chunk, block_q), _fit_block(chunk, block_k)
         in_envelope = (causal and q.shape[-1] == _MEASURED_HEAD_DIM
                        and bq % 128 == 0 and bk % 128 == 0)
-        impl = "flash" if (on_tpu and in_envelope) else "xla"
+        if softcap is not None:
+            # Only the flash body caps logits; interpret mode covers
+            # non-TPU platforms. On TPU an out-of-envelope shape would
+            # hand Mosaic unaligned tiles — refuse loudly rather than
+            # fail deep in the compiler.
+            if on_tpu and not (bq % 128 == 0 and bk % 128 == 0):
+                raise ValueError(
+                    f"ring_attention: softcap needs the flash body but "
+                    f"the per-device chunk ({chunk}) does not tile into "
+                    f"lane-aligned blocks (fit: {bq}x{bk}); pad the "
+                    f"sequence so chunks are multiples of 128")
+            impl = "flash"
+        else:
+            impl = "flash" if (on_tpu and in_envelope) else "xla"
     if impl == "flash":
         body = partial(_ring_flash_local, axis_name=seq_axis, scale=scale,
                        causal=causal, block_q=block_q, block_k=block_k,
-                       interpret=not on_tpu)
+                       interpret=not on_tpu, softcap=softcap)
     elif impl == "xla":
+        if softcap is not None:
+            raise ValueError("softcap requires impl='flash' (the einsum "
+                             "body does not cap logits)")
         body = partial(_ring_attention_local, axis_name=seq_axis,
                        scale=scale, causal=causal)
     else:

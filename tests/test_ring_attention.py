@@ -121,6 +121,31 @@ def test_gqa_auto_impl_on_cpu():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_impl_softcap():
+    """Capped ring attention must equal capped single-device attention
+    (the per-score cap composes exactly with the lse combine)."""
+    from gpumounter_tpu.ops.flash_attention import _xla_attention
+    mesh = _mesh(4)
+    q, k, v = _qkv(l=64)
+    want = _xla_attention(q, k, v, True, 1.0 / q.shape[-1] ** 0.5,
+                          softcap=5.0)
+    got = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, impl="flash", block_q=16, block_k=16,
+        softcap=5.0))(*(shard_qkv(x, mesh) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="softcap requires impl"):
+        ring_attention(q, k, v, mesh, impl="xla", softcap=5.0)
+
+    # auto + softcap must resolve to flash even where auto would
+    # otherwise take the xla body (CPU).
+    got_auto = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, block_q=16, block_k=16, softcap=5.0))(
+        *(shard_qkv(x, mesh) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(got_auto), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_flash_impl_matches_xla_impl():
     mesh = _mesh(4)
     q, k, v = _qkv(l=64)
