@@ -26,10 +26,30 @@ class TransformerConfig:
     d_ff: int = 512
     max_len: int = 128
     dtype: type = jnp.bfloat16
+    # Attention dialect (defaults reproduce plain MHA): fewer K/V heads
+    # (GQA/MQA — ops-level kernels read them zero-copy) and a sliding
+    # window over the last `window` positions.
+    n_kv_heads: int | None = None
+    window: int | None = None
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model ({self.d_model}) must divide by "
+                             f"n_heads ({self.n_heads})")
+        if self.n_kv_heads is not None and (
+                self.n_kv_heads < 1 or self.n_heads % self.n_kv_heads):
+            raise ValueError(f"n_kv_heads ({self.n_kv_heads}) must be "
+                             f">= 1 and divide n_heads ({self.n_heads})")
+        if self.window is not None and self.window < 0:
+            raise ValueError(f"window must be >= 0, got {self.window}")
 
     @property
     def d_head(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
 
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
@@ -44,10 +64,11 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
         "pos": dense(keys[1], (cfg.max_len, cfg.d_model)),
         "blocks": [],
     }
+    kv_dim = cfg.kv_heads * cfg.d_head
     for i in range(cfg.n_layers):
         bk = jax.random.split(keys[2 + i], 6)
         params["blocks"].append({
-            "wqkv": dense(bk[0], (cfg.d_model, 3 * cfg.d_model)),
+            "wqkv": dense(bk[0], (cfg.d_model, cfg.d_model + 2 * kv_dim)),
             "wo": dense(bk[1], (cfg.d_model, cfg.d_model)),
             "w1": dense(bk[2], (cfg.d_model, cfg.d_ff)),
             "w2": dense(bk[3], (cfg.d_ff, cfg.d_model)),
@@ -64,17 +85,20 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
 
 def _qkv_heads(x, p, cfg):
     """Pre-attention half of a block: rmsnorm + QKV projection split
-    into (b, n_heads, t, d_head). ONE source of truth for the block
-    math shared by full forward and cached decode."""
+    into q (b, n_heads, t, d_head) and k/v (b, kv_heads, t, d_head).
+    ONE source of truth for the block math shared by full forward and
+    cached decode."""
     b, t, _ = x.shape
     h = _rmsnorm(x, p["ln1"])
     qkv = h @ p["wqkv"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    kv_dim = cfg.kv_heads * cfg.d_head
+    q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_dim], axis=-1)
 
-    def heads(a):
-        return a.reshape(b, t, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
+    def heads(a, n):
+        return a.reshape(b, t, n, cfg.d_head).transpose(0, 2, 1, 3)
 
-    return heads(q), heads(k), heads(v)
+    return (heads(q, cfg.n_heads), heads(k, cfg.kv_heads),
+            heads(v, cfg.kv_heads))
 
 
 def _finish_block(x, attn_heads, p):
@@ -89,13 +113,18 @@ def _finish_block(x, attn_heads, p):
 def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
            return_kv: bool = False):
     q, k, v = _qkv_heads(x, p, cfg)
-    # The framework attention op: data-driven dispatch (committed sweep)
-    # picks the Pallas kernel or XLA's fused attention per shape. At
-    # probe scale (d_head 32, short L) this resolves to the fused path,
-    # which is also safely partitionable under the tp sharding of
-    # parallel/train_step.py.
+    # Training/forward runs under jit with GSPMD shardings
+    # (parallel/train_step.py), and a pallas_call has no partitioning
+    # rule — XLA would replicate or fail to split it. So this path PINS
+    # the fused XLA attention (handles GQA natively; window maps to
+    # local_window_size with identical band semantics). The kernel
+    # routes exist where they are safe: flash_decode in the unsharded
+    # generate() loop, and tp_flash_attention / ring_attention for
+    # sharded use via shard_map.
     from gpumounter_tpu.ops.flash_attention import flash_attention
-    x = _finish_block(x, flash_attention(q, k, v, causal=True), p)
+    x = _finish_block(x, flash_attention(q, k, v, causal=True,
+                                         window=cfg.window,
+                                         backend="xla"), p)
     if return_kv:
         return x, k, v
     return x
@@ -111,7 +140,8 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
     q, k, v = _qkv_heads(x, p, cfg)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cur_len - 1, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cur_len - 1, 0))
-    out = flash_decode(q, k_cache, v_cache, cur_len, interpret=interpret)
+    out = flash_decode(q, k_cache, v_cache, cur_len, window=cfg.window,
+                       interpret=interpret)
     return _finish_block(x, out, p), k_cache, v_cache
 
 
@@ -148,7 +178,7 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
     caches = []
     for blk in params["blocks"]:
         x, k, v = _block(x, blk, cfg, return_kv=True)
-        kc = jnp.zeros((b, cfg.n_heads, cfg.max_len, cfg.d_head), k.dtype)
+        kc = jnp.zeros((b, cfg.kv_heads, cfg.max_len, cfg.d_head), k.dtype)
         vc = jnp.zeros_like(kc)
         caches.append((kc.at[:, :, :t0].set(k), vc.at[:, :, :t0].set(v)))
     logits0 = (x[:, -1] @ params["embed"].T).astype(jnp.float32)

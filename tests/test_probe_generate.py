@@ -57,6 +57,48 @@ def test_generate_single_token():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_generate_gqa_windowed_config():
+    """The flagship's GQA + sliding-window dialect: cached decode must
+    still equal full recompute (both route through the framework ops)."""
+    cfg = TransformerConfig(n_layers=2, d_model=64, n_heads=4,
+                            n_kv_heads=2, window=8, d_ff=128, max_len=64,
+                            dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(3))
+    # GQA projection: wqkv columns = d_model + 2 * kv_dim
+    assert params["blocks"][0]["wqkv"].shape == (64, 64 + 2 * 2 * 16)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab, size=(2, 6)),
+        jnp.int32)
+    got = generate(params, prompt, cfg, 10)
+    want = _naive_generate(params, prompt, cfg, 10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gqa_config_trains():
+    from gpumounter_tpu.models.probe import loss_fn
+    cfg = TransformerConfig(n_layers=1, d_model=64, n_heads=4,
+                            n_kv_heads=1, d_ff=128, max_len=32,
+                            dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(4))
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab, size=(2, 16)),
+        jnp.int32)
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, cfg))(params)
+    assert jnp.isfinite(loss)
+    flat, _ = jax.tree.flatten(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+
+
+def test_config_validates_at_construction():
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        TransformerConfig(n_heads=4, n_kv_heads=3)
+    with pytest.raises(ValueError, match="window must be"):
+        TransformerConfig(window=-1)
+    with pytest.raises(ValueError, match="d_model"):
+        TransformerConfig(d_model=100, n_heads=3)
+
+
 def test_generate_rejects_overflow():
     cfg = TransformerConfig(max_len=16)
     params = init_params(cfg, jax.random.key(2))
