@@ -67,3 +67,75 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
         in_shardings=(param_shardings, data_sharding),
         out_shardings=(param_shardings, NamedSharding(mesh, P())),
     )
+
+
+def make_train_step_optax(mesh: Mesh, cfg: TransformerConfig, tx):
+    """Sharded train step driven by an optax optimizer (adamw, lion,
+    schedules, chains — anything implementing GradientTransformation).
+
+    Returns (init_fn, step_fn):
+      opt_state = init_fn(params)                  # sharded by
+                                                   # propagation from the
+                                                   # param shardings
+      params, opt_state, loss = step_fn(params, opt_state, tokens)
+
+    Supported optimizers: transformations whose state subtrees MIRROR
+    the parameter pytree (sgd/momentum, adam(w), lion, and chains of
+    them) — those subtrees are placed on the tensor-parallel param
+    shardings. State that does not mirror the params (optax.masked,
+    multi_transform, adafactor's factored moments) cannot be placed
+    automatically; rather than silently replicate large tensors onto
+    every device (~mesh-size x memory), init_fn raises and asks the
+    caller to place that state explicitly.
+    """
+    import optax
+
+    specs = param_specs(cfg)
+    param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+    data_sharding = NamedSharding(mesh, P("data", None))
+    param_treedef = jax.tree.structure(param_shardings)
+
+    def _is_param_tree(x):
+        try:
+            return jax.tree.structure(x) == param_treedef
+        except Exception:  # noqa: BLE001 — non-pytree leaf
+            return False
+
+    def init_fn(params):
+        # jit leaves unconstrained outputs wherever the compiler likes
+        # (observed: gathered to one device), so place the state
+        # explicitly: subtrees mirroring the param pytree (Adam's mu/nu,
+        # momentum buffers...) get the tensor-parallel param shardings;
+        # everything else (step counts, scalars) is replicated.
+        state = jax.jit(tx.init)(params)
+
+        def place(x):
+            if _is_param_tree(x):
+                return jax.tree.map(jax.device_put, x, param_shardings)
+            if getattr(x, "size", 0) > 1 and getattr(x, "ndim", 0) >= 2:
+                raise ValueError(
+                    "optimizer state holds a non-scalar tensor outside a "
+                    "param-mirroring subtree (optax.masked / "
+                    "multi_transform / factored state?); automatic "
+                    "placement would replicate it onto every device — "
+                    "place this optimizer's state explicitly instead of "
+                    "using make_train_step_optax's init_fn")
+            return jax.device_put(x, NamedSharding(mesh, P()))
+
+        return jax.tree.map(place, state, is_leaf=_is_param_tree)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # opt_state sharding: None = inherit from the committed arrays that
+    # init_fn produced (propagated from param shardings).
+    return init_fn, jax.jit(
+        step,
+        in_shardings=(param_shardings, None, data_sharding),
+        out_shardings=(param_shardings, None, NamedSharding(mesh, P())),
+    )
