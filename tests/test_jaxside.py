@@ -8,9 +8,12 @@ exercised in the on-hardware e2e (bench), not here.
 from __future__ import annotations
 
 import os
+import pathlib
 
 import numpy as np
 import pytest
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
 
 from gpumounter_tpu.jaxside.visibility import (
     chips_visible_in_dev,
@@ -140,3 +143,55 @@ def test_restore_replicated_default():
     (restored,) = snap.restore(build_mesh(cpus[:2]))
     np.testing.assert_array_equal(np.asarray(restored["w"]),
                                   np.ones((4, 4), np.float32))
+
+
+def test_checkpoint_survives_process_boundary(tmp_path):
+    """save() then load() in a FRESH process: the durable half of
+    resume (worker preemption / pod restart), not just backend
+    teardown. Values AND pytree structure must round-trip exactly —
+    including a real optax state (namedtuples inside a tuple), which
+    plain orbax rewrites to dicts-in-lists."""
+    import subprocess
+    import sys
+
+    import optax
+
+    state = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "b": np.float32(7.0)}
+    opt_state = optax.adam(1e-3).init(
+        {"w": np.zeros((3, 4), np.float32)})
+    snap = HotResumable.pack(state, opt_state)
+    ckpt = str(tmp_path / "ckpt")
+    snap.save(ckpt)
+    snap.save(ckpt)  # overwrite: pointer moves, old version pruned
+
+    prog = f"""
+import sys
+sys.path.insert(0, {str(REPO_ROOT)!r})
+import numpy as np
+import jax, optax
+from gpumounter_tpu.jaxside.resume import HotResumable
+snap = HotResumable.load({ckpt!r})
+state, opt_state = snap.host_state
+assert np.array_equal(state["w"],
+                      np.arange(12, dtype=np.float32).reshape(3, 4))
+assert float(state["b"]) == 7.0
+# structure is EXACTLY what optax produced: namedtuples, usable as-is
+expect = optax.adam(1e-3).init({{"w": np.zeros((3, 4), np.float32)}})
+assert jax.tree.structure(opt_state) == jax.tree.structure(expect), (
+    jax.tree.structure(opt_state))
+assert opt_state[0].count.dtype == expect[0].count.dtype
+from jax.sharding import Mesh
+mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+restored_state, _ = snap.restore(mesh)
+assert np.array_equal(np.asarray(restored_state["w"]), state["w"])
+print("CKPT_OK")
+"""
+    out = subprocess.run([sys.executable, "-c", prog],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CKPT_OK" in out.stdout
+    # the overwrite pruned: exactly one version dir + LATEST remain
+    entries = [e for e in (tmp_path / "ckpt").iterdir()
+               if e.name.startswith("v-")]
+    assert len(entries) == 1, entries
