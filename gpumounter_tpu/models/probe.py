@@ -27,10 +27,13 @@ class TransformerConfig:
     max_len: int = 128
     dtype: type = jnp.bfloat16
     # Attention dialect (defaults reproduce plain MHA): fewer K/V heads
-    # (GQA/MQA — ops-level kernels read them zero-copy) and a sliding
-    # window over the last `window` positions.
+    # (GQA/MQA — ops-level kernels read them zero-copy), a sliding
+    # window over the last `window` positions, and rotary position
+    # embeddings (rope=True replaces the learned absolute positions).
     n_kv_heads: int | None = None
     window: int | None = None
+    rope: bool = False
+    rope_base: float = 10000.0
 
     def __post_init__(self):
         if self.d_model % self.n_heads:
@@ -42,6 +45,12 @@ class TransformerConfig:
                              f">= 1 and divide n_heads ({self.n_heads})")
         if self.window is not None and self.window < 0:
             raise ValueError(f"window must be >= 0, got {self.window}")
+        if self.rope and self.d_head % 2:
+            raise ValueError(f"rope needs an even d_head, got "
+                             f"{self.d_head}")
+        if self.rope_base <= 0:
+            raise ValueError(f"rope_base must be > 0, got "
+                             f"{self.rope_base}")
 
     @property
     def d_head(self) -> int:
@@ -61,9 +70,12 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
 
     params = {
         "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
-        "pos": dense(keys[1], (cfg.max_len, cfg.d_model)),
         "blocks": [],
     }
+    if not cfg.rope:
+        # rope computes positions analytically; no learned table, so no
+        # dead parameter to checkpoint/decay.
+        params["pos"] = dense(keys[1], (cfg.max_len, cfg.d_model))
     kv_dim = cfg.kv_heads * cfg.d_head
     for i in range(cfg.n_layers):
         bk = jax.random.split(keys[2 + i], 6)
@@ -101,6 +113,30 @@ def _qkv_heads(x, p, cfg):
             heads(v, cfg.kv_heads))
 
 
+def _rope_rotate(x, positions, cfg):
+    """Rotary position embedding for (b, h, t, d_head) at int32
+    `positions` (t,). Angles are computed directly from the positions —
+    traced positions work too, which is what lets the decode step rotate
+    at its dynamic cache offset without any table gather."""
+    half = cfg.d_head // 2
+    inv_freq = 1.0 / (cfg.rope_base ** (
+        jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    cos = jnp.cos(ang)[None, None]                       # (1, 1, t, half)
+    sin = jnp.sin(ang)[None, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _maybe_rope(q, k, cfg, positions):
+    """Rotate q and k (NOT v) when the config asks for rope. The cache
+    stores post-rotation keys, so decode only rotates the new token."""
+    if not cfg.rope:
+        return q, k
+    return _rope_rotate(q, positions, cfg), _rope_rotate(k, positions, cfg)
+
+
 def _finish_block(x, attn_heads, p):
     """Post-attention half: output projection, residual, MLP."""
     b, _, t, _ = attn_heads.shape
@@ -113,6 +149,7 @@ def _finish_block(x, attn_heads, p):
 def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
            return_kv: bool = False):
     q, k, v = _qkv_heads(x, p, cfg)
+    q, k = _maybe_rope(q, k, cfg, jnp.arange(x.shape[1], dtype=jnp.int32))
     # Training/forward runs under jit with GSPMD shardings
     # (parallel/train_step.py), and a pallas_call has no partitioning
     # rule — XLA would replicate or fail to split it. So this path PINS
@@ -138,6 +175,9 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
     from gpumounter_tpu.ops.flash_decode import flash_decode
 
     q, k, v = _qkv_heads(x, p, cfg)
+    # Rotate at the token's global position (traced); the cache already
+    # holds rotated keys, so only the new entry needs the rotation.
+    q, k = _maybe_rope(q, k, cfg, (cur_len - 1)[None])
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cur_len - 1, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cur_len - 1, 0))
     out = flash_decode(q, k_cache, v_cache, cur_len, window=cfg.window,
@@ -149,7 +189,14 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
     """Logits for int32 tokens of shape (batch, seq)."""
     b, t = tokens.shape
-    x = params["embed"][tokens] + params["pos"][:t]
+    if t > cfg.max_len:
+        # the learned-pos path fails this implicitly via broadcasting;
+        # keep max_len binding under rope too.
+        raise ValueError(f"sequence length {t} exceeds max_len "
+                         f"{cfg.max_len}")
+    x = params["embed"][tokens]
+    if not cfg.rope:  # rope replaces the learned absolute positions
+        x = x + params["pos"][:t]
     for blk in params["blocks"]:
         x = _block(x, blk, cfg)
     return (x @ params["embed"].T).astype(jnp.float32)
@@ -174,7 +221,9 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
     interpret = _target_platform() != "tpu"
 
     # Prefill: full forward over the prompt, K/V into fixed-shape caches.
-    x = params["embed"][prompt] + params["pos"][:t0]
+    x = params["embed"][prompt]
+    if not cfg.rope:
+        x = x + params["pos"][:t0]
     caches = []
     for blk in params["blocks"]:
         x, k, v = _block(x, blk, cfg, return_kv=True)
@@ -186,9 +235,10 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
 
     def step(carry, _):
         caches, token, cur_len = carry
-        x = (params["embed"][token][:, None, :]
-             + jax.lax.dynamic_slice(
-                 params["pos"], (cur_len, 0), (1, params["pos"].shape[1])))
+        x = params["embed"][token][:, None, :]
+        if not cfg.rope:
+            x = x + jax.lax.dynamic_slice(
+                params["pos"], (cur_len, 0), (1, params["pos"].shape[1]))
         new_caches = []
         for blk, (kc, vc) in zip(params["blocks"], caches):
             x, kc, vc = _block_decode(x, blk, cfg, kc, vc, cur_len + 1,

@@ -32,11 +32,13 @@ def param_specs(cfg: TransformerConfig) -> dict:
         "ln1": P(None),
         "ln2": P(None),
     }
-    return {
+    specs = {
         "embed": P(None, None),
-        "pos": P(None, None),
         "blocks": [dict(block) for _ in range(cfg.n_layers)],
     }
+    if not cfg.rope:  # rope configs carry no learned position table
+        specs["pos"] = P(None, None)
+    return specs
 
 
 def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
