@@ -137,6 +137,7 @@ def main():
     # delta below this tunnel's RTT jitter, so decode uses longer chains
     # (50/150: delta spans 100 steps).
     DEC_ITERS = 5 * ITERS
+    out["iters_chained_decode"] = DEC_ITERS
     c_short, c_long = decode_chained(DEC_ITERS), decode_chained(3 * DEC_ITERS)
 
     v_cache = vv[0]   # reuse the window section's device-resident cache
