@@ -29,9 +29,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-but-finite: -inf breaks the m==NEG_INF row fixups
 
 
-def _band_needed(iq, ik, block_q, block_k, causal, window, offset=0):
+def _band_needed(iq, ik, block_q, block_k, causal, window, offset=0,
+                 sinks=0):
     """Whether k block ik overlaps q block iq's attention band
-    [q - window, q] (full causal history when window is None).
+    [q - window, q] (full causal history when window is None), or the
+    sink region [0, sinks) that windowed attention keeps attendable
+    (StreamingLLM: the first tokens anchor the softmax when the window
+    slides past them).
 
     offset places the queries on the key timeline: query row i sits at
     global position offset + i. For self-attention offset == 0; for
@@ -41,9 +45,10 @@ def _band_needed(iq, ik, block_q, block_k, causal, window, offset=0):
         return True
     needed = ik * block_k <= offset + iq * block_q + block_q - 1
     if window is not None:
-        needed = jnp.logical_and(
-            needed,
-            ik * block_k + block_k - 1 >= offset + iq * block_q - window)
+        in_band = ik * block_k + block_k - 1 >= offset + iq * block_q - window
+        if sinks:
+            in_band = jnp.logical_or(in_band, ik * block_k < sinks)
+        needed = jnp.logical_and(needed, in_band)
     return needed
 
 
@@ -56,8 +61,10 @@ def _softcap(s, cap):
     return cap * jnp.tanh(s / cap)
 
 
-def _band_mask(s, iq, ik, block_q, block_k, causal, window, offset=0):
-    """Apply the causal / sliding-window mask to a score tile."""
+def _band_mask(s, iq, ik, block_q, block_k, causal, window, offset=0,
+               sinks=0):
+    """Apply the causal / sliding-window (+ sink) mask to a score
+    tile."""
     if not causal:
         return s
     q_idx = offset + iq * block_q + jax.lax.broadcasted_iota(
@@ -66,7 +73,10 @@ def _band_mask(s, iq, ik, block_q, block_k, causal, window, offset=0):
         jnp.int32, (block_q, block_k), 1)
     keep = k_idx <= q_idx
     if window is not None:
-        keep = jnp.logical_and(keep, k_idx >= q_idx - window)
+        in_band = k_idx >= q_idx - window
+        if sinks:
+            in_band = jnp.logical_or(in_band, k_idx < sinks)
+        keep = jnp.logical_and(keep, in_band)
     return jnp.where(keep, s, NEG_INF)
 
 
@@ -74,7 +84,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_k: int, n_k: int, scale: float,
                   causal: bool, window: int | None = None,
                   offset: int = 0, softcap: float | None = None,
-                  with_lse: bool = False):
+                  sinks: int = 0, with_lse: bool = False):
     lse_ref = rest[0] if with_lse else None
     m_scr, l_scr, acc_scr = rest[-3:]
     ik = pl.program_id(2)
@@ -90,7 +100,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     # block's attention band (future, or beyond the sliding window), the
     # whole step is a no-op — for full causal this halves the work; with
     # a window the per-row work drops to O(window).
-    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset)
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset, sinks)
 
     @pl.when(needed)
     def _compute():
@@ -102,7 +112,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
 
         s = _softcap(s, softcap)
-        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset)
+        s = _band_mask(s, iq, ik, block_q, block_k, causal, window, offset, sinks)
 
         m_prev = m_scr[:, 0:1]                             # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -144,7 +154,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_scr, *, block_q: int, block_k: int,
                          n_k: int, scale: float, causal: bool,
                          window: int | None = None, offset: int = 0,
-                         softcap: float | None = None):
+                         softcap: float | None = None, sinks: int = 0):
     """dq = Σ_k  [p ∘ (do·vᵀ − Δ)]·k·scale, accumulated over k blocks.
 
     p is recomputed from the saved lse (p = exp(s − lse)); Δ is the
@@ -157,7 +167,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset)
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset, sinks)
 
     @pl.when(needed)
     def _compute():
@@ -171,7 +181,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale, softcap)
         s = _band_mask(s_cap, iq, ik, block_q, block_k, causal, window,
-                       offset)
+                       offset, sinks)
         # Fully-masked rows keep lse == NEG_INF; exp(s - NEG_INF) would
         # overflow, so zero them explicitly. Reshape the f32 column FIRST
         # and compare in 2-D: Mosaic cannot insert a minor dim on the i1
@@ -199,7 +209,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           block_k: int, n_q: int, scale: float,
                           causal: bool, window: int | None = None,
                           offset: int = 0,
-                          softcap: float | None = None):
+                          softcap: float | None = None,
+                          sinks: int = 0):
     """dk = Σ_q dsᵀ·q·scale and dv = Σ_q pᵀ·do, accumulated over q blocks
     for one k block (grid: (batch·heads, k-blocks, q-blocks), last axis
     sequential so the scratch accumulators persist)."""
@@ -213,7 +224,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     # Band overlap is symmetric in (q block, k block), so the forward
     # helper gives the transposed condition verbatim.
-    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset)
+    needed = _band_needed(iq, ik, block_q, block_k, causal, window, offset, sinks)
 
     @pl.when(needed)
     def _compute():
@@ -227,7 +238,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale, softcap)
         s = _band_mask(s_cap, iq, ik, block_q, block_k, causal, window,
-                       offset)
+                       offset, sinks)
         lse_col = lse[:, None]
         p = jnp.where(lse_col <= NEG_INF / 2, 0.0, jnp.exp(s - lse_col))
         dv_scr[:] += jax.lax.dot_general(
@@ -249,12 +260,16 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _make_kv_index(group, block_q, block_k, causal, window, offset):
+def _make_kv_index(group, block_q, block_k, causal, window, offset, sinks):
     """Index map for K/V blocks on a (bh, iq, ik) grid, shared by the
     forward and dq kernels: the GQA head fold (bh // group) plus the
     DMA half of the band skip — clamping into [first, last] makes every
     compute-skipped iteration re-reference the block already resident
-    in VMEM, and Mosaic elides the copy."""
+    in VMEM, and Mosaic elides the copy. Sink blocks (k < sinks) keep
+    their own index so they are actually fetched; the gap iterations
+    between the sinks and the band all re-reference the band's first
+    block, which is therefore fetched once and the band continues
+    without a refetch."""
     if not causal:
         return lambda bh, iq, ik: (bh // group, ik, 0)
 
@@ -265,6 +280,9 @@ def _make_kv_index(group, block_q, block_k, causal, window, offset):
             first = jnp.maximum(
                 0, offset + iq * block_q - window) // block_k
             clamped = jnp.maximum(clamped, first)
+            if sinks:
+                clamped = jnp.where(ik * block_k < sinks,
+                                    jnp.minimum(ik, last), clamped)
         return (bh // group, clamped, 0)
 
     return kv_index
@@ -293,7 +311,8 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
                            interpret: bool = False,
                            return_lse: bool = False,
                            window: int | None = None,
-                           softcap: float | None = None):
+                           softcap: float | None = None,
+                           sinks: int = 0):
     """(B, H, L, D) attention via the Pallas kernel. Block sizes are
     clamped to L and reduced to the largest dividing size when the
     requested blocks do not divide L.
@@ -329,6 +348,10 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError("window requires causal=True")
     if window is not None and window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
+    if sinks < 0:
+        raise ValueError(f"sinks must be >= 0, got {sinks}")
+    if sinks and window is None:
+        raise ValueError("sinks only make sense with a sliding window")
     if causal and l_q > l_k:
         raise ValueError(f"causal attention needs L_q <= L_k (queries "
                          f"are the last L_q key positions); got "
@@ -349,14 +372,14 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
         scale=scale, causal=causal, window=window, offset=offset,
-        softcap=softcap, with_lse=return_lse)
+        softcap=softcap, sinks=sinks, with_lse=return_lse)
     # Flattened q-head index bh = i*h + j maps to kv head
     # i*h_kv + j//group == bh // group (since h = h_kv*group).
     # Band DMA skip: without the clamp, compute-skipped iterations would
     # still stream their K/V from HBM — ~2x the necessary traffic for
     # full causal, nearly all of it with a sliding window.
     kv_index = _make_kv_index(group, block_q, block_k, causal, window,
-                              offset)
+                              offset, sinks)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_k),
@@ -397,7 +420,7 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array, *,
 def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
                     block_q: int, block_k: int, interpret: bool,
                     window: int | None = None,
-                    softcap: float | None = None):
+                    softcap: float | None = None, sinks: int = 0):
     """Run the two backward kernels; q/do are (B, H, L, D), k/v
     (B, H_kv, L, D) with H % H_kv == 0, lse/delta (B, H, L) float32.
     Returns (dq, dk, dv) in the input dtypes; dk/dv have H_kv heads.
@@ -423,7 +446,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     deltar = jnp.broadcast_to(delta.reshape(b * h, 1, l_q), (b * h, 8, l_q))
 
     kv_index = _make_kv_index(group, block_q, block_k, causal, window,
-                              offset)
+                              offset, sinks)
     if causal:
         # Transposed band for dk/dv: it iterates q blocks, clamped into
         # [k, k + window] on the key timeline (query row i sits at
@@ -435,6 +458,10 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
                 last = jnp.clip(
                     (ik * block_k + block_k - 1 + window - offset)
                     // block_q, 0, n_q - 1)
+                if sinks:
+                    # Sink k blocks are attended by EVERY later query;
+                    # the window's upper clamp must not cut them off.
+                    last = jnp.where(ik * block_k < sinks, n_q - 1, last)
                 clamped = jnp.minimum(clamped, last)
             return clamped
 
@@ -454,7 +481,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
                           block_k=block_k, n_k=n_k, scale=scale,
                           causal=causal, window=window, offset=offset,
-                          softcap=softcap),
+                          softcap=softcap, sinks=sinks),
         grid=(b * h, n_q, n_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, iq, ik: (bh, iq, 0)),
@@ -477,7 +504,7 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                           block_k=block_k, n_q=n_q, scale=scale,
                           causal=causal, window=window, offset=offset,
-                          softcap=softcap),
+                          softcap=softcap, sinks=sinks),
         grid=(b * h, n_k, n_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
@@ -515,11 +542,13 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal: bool, scale: float,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
                              block_q: int, block_k: int, interpret: bool,
                              window: int | None = None,
-                             softcap: float | None = None):
+                             softcap: float | None = None,
+                             sinks: int = 0):
     """Differentiable flash attention returning (o, lse). The VJP runs
     the blockwise backward kernels (O(L·D) memory — no (L, L) score
     matrix in either direction); an incoming lse cotangent is folded
@@ -528,18 +557,20 @@ def flash_attention_with_lse(q, k, v, causal: bool, scale: float,
     return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
                                   interpret=interpret, return_lse=True,
-                                  window=window, softcap=softcap)
+                                  window=window, softcap=softcap,
+                                  sinks=sinks)
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None, softcap=None):
+                   window=None, softcap=None, sinks=0):
     o, lse = flash_attention_with_lse(q, k, v, causal, scale, block_q,
-                                      block_k, interpret, window, softcap)
+                                      block_k, interpret, window, softcap,
+                                      sinks)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
-                   softcap, res, cot):
+                   softcap, sinks, res, cot):
     q, k, v, o, lse = res
     do, dlse = cot
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -547,16 +578,19 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, window,
     dq, dk, dv = _flash_backward(q, k, v, do, lse, delta, causal=causal,
                                  scale=scale, block_q=block_q,
                                  block_k=block_k, interpret=interpret,
-                                 window=window, softcap=softcap)
+                                 window=window, softcap=softcap,
+                                 sinks=sinks)
     return dq, dk, dv
 
 
 flash_attention_with_lse.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
-                               interpret, window=None, softcap=None):
+                               interpret, window=None, softcap=None,
+                               sinks=0):
     """Public-path primal: the EXACT kernel the committed sweep timed
     (no lse output). Only under differentiation does the fwd rule switch
     to the with-lse kernel — lse is a residual the backward needs anyway
@@ -565,32 +599,34 @@ def _flash_attention_trainable(q, k, v, causal, scale, block_q, block_k,
     return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                   block_q=block_q, block_k=block_k,
                                   interpret=interpret, window=window,
-                                  softcap=softcap)
+                                  softcap=softcap, sinks=sinks)
 
 
 def _trainable_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None, softcap=None):
+                   window=None, softcap=None, sinks=0):
     o, lse = flash_attention_pallas(q, k, v, causal=causal, scale=scale,
                                     block_q=block_q, block_k=block_k,
                                     interpret=interpret, return_lse=True,
-                                    window=window, softcap=softcap)
+                                    window=window, softcap=softcap,
+                                    sinks=sinks)
     return o, (q, k, v, o, lse)
 
 
 def _trainable_bwd(causal, scale, block_q, block_k, interpret, window,
-                   softcap, res, do):
+                   softcap, sinks, res, do):
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     return _flash_backward(q, k, v, do, lse, delta, causal=causal,
                            scale=scale, block_q=block_q, block_k=block_k,
                            interpret=interpret, window=window,
-                           softcap=softcap)
+                           softcap=softcap, sinks=sinks)
 
 
 _flash_attention_trainable.defvjp(_trainable_fwd, _trainable_bwd)
 
 
-def _xla_attention(q, k, v, causal, scale, window=None, softcap=None):
+def _xla_attention(q, k, v, causal, scale, window=None, softcap=None,
+                   sinks=0):
     """Naive materialized-(L, L) attention. CORRECTNESS ORACLE ONLY — it
     is deliberately the simplest possible formulation. Never benchmark
     against this (VERDICT r2 weak #1); the performance baseline is
@@ -608,7 +644,10 @@ def _xla_attention(q, k, v, causal, scale, window=None, softcap=None):
         q_pos = (l_k - l_q) + jnp.arange(l_q)[:, None]
         mask = jnp.arange(l_k)[None, :] <= q_pos
         if window is not None:
-            mask = mask & (jnp.arange(l_k)[None, :] >= q_pos - window)
+            in_band = jnp.arange(l_k)[None, :] >= q_pos - window
+            if sinks:
+                in_band = in_band | (jnp.arange(l_k)[None, :] < sinks)
+            mask = mask & in_band
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
@@ -673,7 +712,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
                     backend: str = "auto",
                     window: int | None = None,
-                    softcap: float | None = None) -> jax.Array:
+                    softcap: float | None = None,
+                    sinks: int = 0) -> jax.Array:
     """Public entry.
 
     backend: "auto" picks per sequence length from the committed sweep
@@ -697,11 +737,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kernel implements it (jax.nn's fused attention has no such knob),
     so softcap forces the Pallas path — the interpret kernel off-TPU,
     and a clear error on TPU shapes whose tiles cannot lane-align.
+
+    sinks (requires window): keep the first `sinks` key positions
+    attendable alongside the sliding window (StreamingLLM attention
+    sinks — they anchor the softmax once the window slides past the
+    sequence start). Kernel-only, like softcap.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if softcap is not None and softcap <= 0:
         raise ValueError(f"softcap must be > 0, got {softcap}")
+    if sinks < 0:
+        raise ValueError(f"sinks must be >= 0, got {sinks}")
+    if sinks and window is None:
+        raise ValueError("sinks only make sense with a sliding window")
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
     if window is not None and window < 0:
@@ -740,11 +789,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if backend == "xla" and softcap is not None:
         raise ValueError("backend='xla' cannot apply softcap (the fused "
                          "path has no logit-capping knob)")
+    if backend == "xla" and sinks:
+        raise ValueError("backend='xla' cannot apply attention sinks "
+                         "(local_window_size has no sink region)")
     if backend == "pallas":
         use_pallas = True
     elif backend == "auto":
-        if softcap is not None:
-            # Only the kernel caps logits; there is no fused fallback.
+        if softcap is not None or sinks:
+            # Only the kernel caps logits / keeps sinks; there is no
+            # fused fallback for either.
             use_pallas = True
             if on_tpu and not blocks_ok:
                 raise ValueError(
@@ -795,5 +848,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         # Custom-VJP wrapper: trainable (blockwise backward kernels, no
         # (L, L) matrix), and its primal is the exact swept kernel.
         return _flash_attention_trainable(q, k, v, causal, scale, bq, bk,
-                                          not on_tpu, window, softcap)
+                                          not on_tpu, window, softcap,
+                                          sinks)
     return fused_xla_attention(q, k, v, causal, scale, window)

@@ -47,7 +47,8 @@ from gpumounter_tpu.ops.flash_attention import (
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, block_k: int, n_k: int,
-                   l_q: int, scale: float, window: int | None):
+                   l_q: int, scale: float, window: int | None,
+                   sinks: int = 0):
     ik = pl.program_id(1)
     cache_len = len_ref[0]
     offset = cache_len - l_q          # dynamic: q row 0's global position
@@ -63,7 +64,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     # is exactly `k_start < cache_len`, which is also what excludes the
     # cache's invalid tail (the newest query sits at cache_len - 1, the
     # last valid position).
-    needed = _band_needed(0, ik, l_q, block_k, True, window, offset)
+    needed = _band_needed(0, ik, l_q, block_k, True, window, offset,
+                          sinks)
 
     @pl.when(needed)
     def _compute():
@@ -73,7 +75,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _band_mask(s, 0, ik, l_q, block_k, True, window, offset)
+        s = _band_mask(s, 0, ik, l_q, block_k, True, window, offset,
+                       sinks)
 
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -97,7 +100,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
 def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                  cache_len: jax.Array | int, *,
                  scale: float | None = None, block_k: int = 4096,
-                 window: int | None = None,
+                 window: int | None = None, sinks: int = 0,
                  interpret: bool = False) -> jax.Array:
     """Attend the last l_q tokens against a fixed-shape KV cache.
 
@@ -122,6 +125,10 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                          f"({h_kv})")
     if window is not None and window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
+    if sinks < 0:
+        raise ValueError(f"sinks must be >= 0, got {sinks}")
+    if sinks and window is None:
+        raise ValueError("sinks only make sense with a sliding window")
     if l_q > l_max:
         # Below, cache_len is clipped to [l_q, l_max]; with l_q > l_max
         # that clip inverts and the offset goes negative — every query
@@ -146,6 +153,12 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             first_needed = jnp.maximum(
                 0, len_ref[0] - l_q - window) // block_k
             clamped = jnp.maximum(clamped, first_needed)
+            if sinks:
+                # Sink blocks keep their own index (fetched on the way
+                # through); gap iterations re-reference the band's first
+                # block, so it is fetched once.
+                clamped = jnp.where(ik * block_k < sinks,
+                                    jnp.minimum(ik, last_needed), clamped)
         return (bh // group, clamped, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -166,7 +179,8 @@ def flash_decode(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, block_k=block_k, n_k=n_k,
-                          l_q=l_q, scale=scale, window=window),
+                          l_q=l_q, scale=scale, window=window,
+                          sinks=sinks),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, l_q, d), q.dtype),
         compiler_params=pltpu.CompilerParams(

@@ -402,6 +402,56 @@ def test_softcap_public_dispatch():
         flash_attention(q, k, v, softcap=-1.0)
 
 
+@pytest.mark.parametrize("sinks", [4, 64])
+def test_attention_sinks_match_banded_oracle(sinks):
+    """StreamingLLM sinks: window + the first `sinks` positions stay
+    attendable. Forward and backward vs the masked oracle."""
+    q, k, v = _qkv()
+    scale = 1.0 / q.shape[-1] ** 0.5
+    got = flash_attention_pallas(q, k, v, causal=True, window=50,
+                                 sinks=sinks, block_q=64, block_k=64,
+                                 interpret=True)
+    want = _xla_attention(q, k, v, True, scale, window=50, sinks=sinks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    gg = jax.grad(lambda q, k, v: jnp.sum(flash_attention_with_lse(
+        q, k, v, True, scale, 64, 64, True, 50, None, sinks)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda q, k, v: jnp.sum(
+        _xla_attention(q, k, v, True, scale, window=50,
+                       sinks=sinks) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(gg, gw):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-3, atol=5e-3)
+
+
+def test_sinks_actually_attended():
+    """A row far past the window must still see the sink keys (output
+    differs from the pure-window result)."""
+    q, k, v = _qkv()
+    a = flash_attention_pallas(q, k, v, causal=True, window=30, sinks=8,
+                               block_q=64, block_k=64, interpret=True)
+    b = flash_attention_pallas(q, k, v, causal=True, window=30,
+                               block_q=64, block_k=64, interpret=True)
+    # rows beyond window+sinks must differ; early rows (inside window)
+    # are identical
+    assert float(jnp.abs(a[:, :, -1] - b[:, :, -1]).max()) > 1e-4
+    np.testing.assert_allclose(np.asarray(a[:, :, :20]),
+                               np.asarray(b[:, :, :20]), rtol=1e-6)
+
+
+def test_sinks_validation():
+    q, k, v = _qkv(l=128)
+    with pytest.raises(ValueError, match="sinks only make sense"):
+        flash_attention_pallas(q, k, v, causal=True, sinks=4,
+                               interpret=True)
+    from gpumounter_tpu.ops.flash_attention import flash_attention
+    with pytest.raises(ValueError, match="cannot apply attention sinks"):
+        flash_attention(q, k, v, backend="xla", window=30, sinks=4)
+
+
 def test_target_platform_accepts_string_default_device():
     """jax_default_device may hold a platform STRING (jax-supported);
     _target_platform must not assume a Device object."""

@@ -82,6 +82,23 @@ def test_multi_token_and_window():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_streaming_decode_with_sinks():
+    """StreamingLLM serving: window + sinks against a long cache — the
+    decoded token attends [0, sinks) plus the last `window` positions."""
+    q, k, v = _setup(l_q=1)
+    cache_len = 200
+    got = flash_decode(q, k, v, cache_len, block_k=64, window=40,
+                       sinks=8, interpret=True)
+    want = _xla_attention(q, k[:, :, :cache_len], v[:, :, :cache_len],
+                          True, 1.0 / 64 ** 0.5, window=40, sinks=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # Without sinks the result differs (the sinks are really attended).
+    no_sink = flash_decode(q, k, v, cache_len, block_k=64, window=40,
+                           interpret=True)
+    assert float(jnp.abs(got - no_sink).max()) > 1e-4
+
+
 def test_gqa_decode():
     q, k, v = _setup(h=4, h_kv=1)
     got = flash_decode(q, k, v, 150, block_k=64, interpret=True)
