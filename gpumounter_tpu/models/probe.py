@@ -202,21 +202,42 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Arra
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnums=(2, 3))
 def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
-             n_new: int) -> jax.Array:
-    """Greedy autoregressive generation with a fixed-shape KV cache.
+             n_new: int, key: jax.Array | None = None,
+             temperature: float | jax.Array | None = None) -> jax.Array:
+    """Autoregressive generation with a fixed-shape KV cache.
 
     prompt: (batch, t0) int32; returns (batch, t0 + n_new). Prefill runs
     the full forward once (harvesting per-layer K/V); the decode loop is
     a lax.scan whose every step attends through ops.flash_decode with a
     traced cache length — the whole call compiles exactly once per
-    (prompt shape, n_new), never per step.
+    (prompt shape, n_new), never per step. Greedy vs sampled is decided
+    by the key's PRESENCE (structurally static), and temperature is a
+    traced operand, so a temperature sweep reuses one compilation.
+
+    key None (default): greedy argmax decoding. key given: sample from
+    softmax(logits / temperature) (temperature defaults to 1.0), the
+    key split once per step inside the scan.
     """
     b, t0 = prompt.shape
     if t0 + n_new > cfg.max_len:
         raise ValueError(f"prompt ({t0}) + n_new ({n_new}) exceeds "
                          f"max_len ({cfg.max_len})")
+    sample = key is not None
+    if temperature is not None and not sample:
+        raise ValueError("temperature without a PRNG key would be "
+                         "silently ignored; pass key= to sample")
+    if temperature is None:
+        temperature = 1.0
+    if sample and isinstance(temperature, (int, float)) and             not temperature > 0:  # `not >` also rejects NaN
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
+
+    def pick(logits, k):
+        if not sample:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(k, logits / temperature, axis=-1)
     from gpumounter_tpu.ops.flash_attention import _target_platform
     interpret = _target_platform() != "tpu"
 
@@ -231,10 +252,11 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
         vc = jnp.zeros_like(kc)
         caches.append((kc.at[:, :, :t0].set(k), vc.at[:, :, :t0].set(v)))
     logits0 = (x[:, -1] @ params["embed"].T).astype(jnp.float32)
-    first_new = jnp.argmax(logits0, axis=-1).astype(prompt.dtype)
+    key, sub = jax.random.split(key)
+    first_new = pick(logits0, sub).astype(prompt.dtype)
 
     def step(carry, _):
-        caches, token, cur_len = carry
+        caches, token, cur_len, key = carry
         x = params["embed"][token][:, None, :]
         if not cfg.rope:
             x = x + jax.lax.dynamic_slice(
@@ -245,14 +267,15 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
                                       interpret)
             new_caches.append((kc, vc))
         logits = (x[:, -1] @ params["embed"].T).astype(jnp.float32)
-        nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
-        return (new_caches, nxt, cur_len + 1), token
+        key, sub = jax.random.split(key)
+        nxt = pick(logits, sub).astype(token.dtype)
+        return (new_caches, nxt, cur_len + 1, key), token
 
     # Each step consumes the token generated by the previous step (the
     # scan's carry, seeded with the prefill's argmax) and emits it, so
     # the collected outputs are exactly the n_new generated tokens.
     _, toks = jax.lax.scan(
-        step, (caches, first_new, jnp.int32(t0)), None, length=n_new)
+        step, (caches, first_new, jnp.int32(t0), key), None, length=n_new)
     return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
 
 

@@ -126,6 +126,46 @@ def test_rope_changes_attention():
     assert float(jnp.abs(a[:, -1] - c[:, -1]).max()) > 1e-5
 
 
+def test_sampled_generation():
+    """temperature > 0 samples: reproducible under the same key,
+    different under different keys, valid token range; temperature 0
+    stays exactly greedy."""
+    cfg = TransformerConfig(n_layers=1, d_model=64, n_heads=2, d_ff=128,
+                            max_len=64, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(7))
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+
+    a = generate(params, prompt, cfg, 12, jax.random.key(1), 1.0)
+    b = generate(params, prompt, cfg, 12, jax.random.key(1), 1.0)
+    c = generate(params, prompt, cfg, 12, jax.random.key(2), 1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert np.asarray(a).min() >= 0 and np.asarray(a).max() < cfg.vocab
+
+    greedy = generate(params, prompt, cfg, 12)
+    np.testing.assert_array_equal(np.asarray(greedy),
+                                  np.asarray(_naive_generate(
+                                      params, prompt, cfg, 12)))
+
+    # temperature is a traced operand: a sweep must NOT retrace
+    traces = []
+
+    @jax.jit
+    def sweep(t):
+        traces.append(None)
+        return generate(params, prompt, cfg, 4, jax.random.key(3), t)
+
+    for t in (0.6, 0.9, 1.3):
+        sweep(jnp.float32(t))
+    assert len(traces) == 1, "temperature value caused retracing"
+
+    with pytest.raises(ValueError, match="temperature without a PRNG"):
+        generate(params, prompt, cfg, 4, None, 1.0)
+    with pytest.raises(ValueError, match="must be > 0"):
+        generate(params, prompt, cfg, 4, jax.random.key(0),
+                 float("nan"))
+
+
 def test_config_validates_at_construction():
     with pytest.raises(ValueError, match="n_kv_heads"):
         TransformerConfig(n_heads=4, n_kv_heads=3)
