@@ -219,20 +219,34 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
     softmax(logits / temperature) (temperature defaults to 1.0), the
     key split once per step inside the scan.
     """
-    b, t0 = prompt.shape
-    if t0 + n_new > cfg.max_len:
-        raise ValueError(f"prompt ({t0}) + n_new ({n_new}) exceeds "
-                         f"max_len ({cfg.max_len})")
-    sample = key is not None
-    if temperature is not None and not sample:
+    if prompt.shape[1] + n_new > cfg.max_len:
+        raise ValueError(f"prompt ({prompt.shape[1]}) + n_new ({n_new}) "
+                         f"exceeds max_len ({cfg.max_len})")
+    # Validation lives OUTSIDE the jitted body: inside it a python float
+    # has already become a tracer and isinstance checks silently pass.
+    if temperature is not None and key is None:
         raise ValueError("temperature without a PRNG key would be "
                          "silently ignored; pass key= to sample")
+    if (key is not None and isinstance(temperature, (int, float))
+            and not temperature > 0):  # `not >` also rejects NaN
+        raise ValueError(f"temperature must be > 0, got {temperature}")
     if temperature is None:
         temperature = 1.0
-    if sample and isinstance(temperature, (int, float)) and             not temperature > 0:  # `not >` also rejects NaN
-        raise ValueError(f"temperature must be > 0, got {temperature}")
+    return _generate_impl(params, prompt, cfg, n_new, key,
+                          jnp.float32(temperature))
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _generate_impl(params, prompt, cfg, n_new, key, temperature):
+    b, t0 = prompt.shape
+    sample = key is not None
     if key is None:
         key = jax.random.key(0)  # unused on the greedy path
+    # Array-valued temperatures bypass the eager scalar validation, so
+    # floor them here: a 0/negative/NaN operand would otherwise turn the
+    # logits into inf/NaN and degenerate the categorical silently.
+    temperature = jnp.where(temperature > 0, temperature,
+                            jnp.float32(1e-6))
 
     def pick(logits, k):
         if not sample:

@@ -1,0 +1,114 @@
+"""Property tests for the band machinery (_band_needed / _band_mask).
+
+The load-bearing invariant: whenever the per-element mask keeps ANY
+(q, k) pair in a tile, the block-level skip condition must mark that
+tile as needed — otherwise pl.when silently drops attendable keys and
+the output is wrong with no error anywhere. Each feature (window,
+sinks, offset) moves both conditions; this sweep checks they move
+together across a randomized grid of configurations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import jax
+
+from gpumounter_tpu.ops.flash_attention import (
+    NEG_INF,
+    _band_mask,
+    _band_needed,
+)
+
+
+@pytest.fixture(autouse=True)
+def _cpu_default():
+    """Pure-python helper sweep: hundreds of tiny eager ops — keep them
+    off the (possibly remote) accelerator."""
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+
+
+def _cases():
+    rng = np.random.default_rng(0)
+    cases = []
+    for _ in range(80):
+        block_q = int(rng.choice([8, 16, 32, 64]))
+        block_k = int(rng.choice([8, 16, 32, 64]))
+        n_q = int(rng.integers(1, 4))
+        n_k = int(rng.integers(1, 5))
+        window = (None if rng.random() < 0.3
+                  else int(rng.integers(0, block_k * n_k)))
+        sinks = (0 if window is None or rng.random() < 0.4
+                 else int(rng.integers(1, block_k * 2)))
+        # offset >= 0: queries are the last l_q positions of the key
+        # timeline (l_k >= l_q)
+        max_off = max(0, block_k * n_k - block_q * n_q)
+        offset = int(rng.integers(0, max_off + 1))
+        cases.append((block_q, block_k, n_q, n_k, window, sinks, offset))
+    return cases
+
+
+def test_needed_covers_every_kept_element():
+    for (block_q, block_k, n_q, n_k, window, sinks, offset) in _cases():
+        ones = jnp.ones((block_q, block_k), jnp.float32)
+        for iq in range(n_q):
+            for ik in range(n_k):
+                kept = np.asarray(_band_mask(
+                    ones, iq, ik, block_q, block_k, True, window,
+                    offset, sinks)) > NEG_INF / 2
+                needed = bool(np.asarray(_band_needed(
+                    iq, ik, block_q, block_k, True, window, offset,
+                    sinks)))
+                if kept.any():
+                    assert needed, (
+                        f"mask keeps elements but block skipped: "
+                        f"bq={block_q} bk={block_k} iq={iq} ik={ik} "
+                        f"window={window} sinks={sinks} offset={offset}")
+
+
+def test_every_query_row_keeps_at_least_itself():
+    """Causal attention always admits the diagonal (k == q), whatever
+    window/sinks/offset — a row with zero kept keys would emit a
+    zero/NaN output."""
+    for (block_q, block_k, n_q, n_k, window, sinks, offset) in _cases():
+        l_q, l_k = block_q * n_q, block_k * n_k
+        if offset + l_q > l_k:
+            continue
+        keep = np.zeros((l_q, l_k), bool)
+        ones = jnp.ones((block_q, block_k), jnp.float32)
+        for iq in range(n_q):
+            for ik in range(n_k):
+                tile = np.asarray(_band_mask(
+                    ones, iq, ik, block_q, block_k, True, window,
+                    offset, sinks)) > NEG_INF / 2
+                keep[iq * block_q:(iq + 1) * block_q,
+                     ik * block_k:(ik + 1) * block_k] = tile
+        rows_with_keys = keep.any(axis=1)
+        assert rows_with_keys.all(), (
+            f"query row with no attendable key: bq={block_q} "
+            f"bk={block_k} window={window} sinks={sinks} offset={offset}")
+        # and the diagonal itself is always kept
+        for i in range(l_q):
+            assert keep[i, offset + i]
+
+
+def test_mask_matches_reference_set():
+    """The tile mask equals the direct set definition of the band:
+    k <= q AND (window is None OR k >= q - window OR k < sinks)."""
+    for (block_q, block_k, n_q, n_k, window, sinks, offset) in _cases()[:40]:
+        ones = jnp.ones((block_q, block_k), jnp.float32)
+        for iq in range(n_q):
+            for ik in range(n_k):
+                tile = np.asarray(_band_mask(
+                    ones, iq, ik, block_q, block_k, True, window,
+                    offset, sinks)) > NEG_INF / 2
+                q_pos = offset + iq * block_q + np.arange(block_q)[:, None]
+                k_pos = ik * block_k + np.arange(block_k)[None, :]
+                want = k_pos <= q_pos
+                if window is not None:
+                    want &= (k_pos >= q_pos - window) | (k_pos < sinks)
+                np.testing.assert_array_equal(tile, want)

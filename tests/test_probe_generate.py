@@ -127,9 +127,9 @@ def test_rope_changes_attention():
 
 
 def test_sampled_generation():
-    """temperature > 0 samples: reproducible under the same key,
-    different under different keys, valid token range; temperature 0
-    stays exactly greedy."""
+    """key given: samples reproducibly per key, differently across
+    keys, in range; no key: exactly greedy. Scalar temperature <= 0 or
+    NaN is rejected eagerly; the temperature value never retraces."""
     cfg = TransformerConfig(n_layers=1, d_model=64, n_heads=2, d_ff=128,
                             max_len=64, dtype=jnp.float32)
     params = init_params(cfg, jax.random.key(7))
@@ -164,6 +164,27 @@ def test_sampled_generation():
     with pytest.raises(ValueError, match="must be > 0"):
         generate(params, prompt, cfg, 4, jax.random.key(0),
                  float("nan"))
+
+
+def test_public_generate_is_compiled():
+    """The public wrapper must hit ONE compiled executable across calls
+    and temperatures (regression: an edit once dropped the jit from the
+    public path, silently making every call run the prefill eagerly)."""
+    from gpumounter_tpu.models.probe import _generate_impl
+    cfg = TransformerConfig(n_layers=1, d_model=64, n_heads=2, d_ff=128,
+                            max_len=32, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(8))
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    base = _generate_impl._cache_size()
+    generate(params, prompt, cfg, 4)
+    after_first = _generate_impl._cache_size()
+    assert after_first == base + 1
+    generate(params, prompt, cfg, 4)
+    for t in (0.5, 0.9):
+        generate(params, prompt, cfg, 4, jax.random.key(0), t)
+    # one more entry for the sampled variant (key pytree differs), none
+    # for repeat calls or different temperature values
+    assert _generate_impl._cache_size() == after_first + 1
 
 
 def test_config_validates_at_construction():
