@@ -34,8 +34,17 @@ class TransformerConfig:
     window: int | None = None
     rope: bool = False
     rope_base: float = 10000.0
+    # Attention implementation: "auto" lets ops.flash_attention's
+    # data-driven dispatch pick (the Pallas kernel at lengths where the
+    # committed sweep says it wins, fused XLA otherwise); "pallas" /
+    # "xla" force a path. The sharded train step honors this too — the
+    # kernel runs under shard_map there (see _attention).
+    attn_backend: str = "auto"
 
     def __post_init__(self):
+        if self.attn_backend not in ("auto", "pallas", "xla"):
+            raise ValueError(f"attn_backend must be auto|pallas|xla, "
+                             f"got {self.attn_backend!r}")
         if self.d_model % self.n_heads:
             raise ValueError(f"d_model ({self.d_model}) must divide by "
                              f"n_heads ({self.n_heads})")
@@ -95,19 +104,35 @@ def _rmsnorm(x: jax.Array, g: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * g
 
 
-def _qkv_heads(x, p, cfg):
+def _qkv_heads(x, p, cfg, mesh=None):
     """Pre-attention half of a block: rmsnorm + QKV projection split
     into q (b, n_heads, t, d_head) and k/v (b, kv_heads, t, d_head).
     ONE source of truth for the block math shared by full forward and
-    cached decode."""
+    cached decode.
+
+    Under a mesh, the head-split reshapes carry explicit sharding
+    constraints (feature dim over "model" before, head dim over "model"
+    after) so GSPMD's backward never falls into replicate-then-
+    repartition ("involuntary full rematerialization") on them."""
     b, t, _ = x.shape
+    tp = mesh.shape[mesh.axis_names[1]] if mesh is not None else 1
     h = _rmsnorm(x, p["ln1"])
     qkv = h @ p["wqkv"]
     kv_dim = cfg.kv_heads * cfg.d_head
     q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_dim], axis=-1)
 
     def heads(a, n):
-        return a.reshape(b, t, n, cfg.d_head).transpose(0, 2, 1, 3)
+        # ONE predicate for every constraint in the chain: head-sharded
+        # throughout when the heads divide the model axis, otherwise
+        # batch-sharded throughout. Mixing (e.g. feature model-sharded
+        # before the reshape, heads replicated after) would force a
+        # per-layer reshard in both directions.
+        ax = "model" if n % tp == 0 else None
+        a = _constrain(a, mesh, ("data", None, ax))
+        a = a.reshape(b, t, n, cfg.d_head)
+        a = _constrain(a, mesh, ("data", None, ax, None))
+        a = a.transpose(0, 2, 1, 3)
+        return _constrain(a, mesh, ("data", ax, None, None))
 
     return (heads(q, cfg.n_heads), heads(k, cfg.kv_heads),
             heads(v, cfg.kv_heads))
@@ -137,31 +162,89 @@ def _maybe_rope(q, k, cfg, positions):
     return _rope_rotate(q, positions, cfg), _rope_rotate(k, positions, cfg)
 
 
-def _finish_block(x, attn_heads, p):
+def _constrain(x, mesh, spec):
+    """with_sharding_constraint when a mesh is in play, identity
+    otherwise. The explicit constraints around the head split/merge
+    reshapes stop GSPMD from 'involuntarily fully rematerializing'
+    (replicate-then-repartition) those reshapes in the dp x tp
+    backward.
+
+    spec uses the SYMBOLIC names "data"/"model", translated to the
+    mesh's actual first/second axis names here — callers may name their
+    axes anything (e.g. ("dp", "tp"))."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    data_ax, model_ax = mesh.axis_names
+    names = {"data": data_ax, "model": model_ax}
+    spec = tuple(names[s] if isinstance(s, str) else s for s in spec)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
+
+
+def _finish_block(x, attn_heads, p, mesh=None):
     """Post-attention half: output projection, residual, MLP."""
     b, _, t, _ = attn_heads.shape
-    out = attn_heads.transpose(0, 2, 1, 3).reshape(b, t, -1) @ p["wo"]
-    x = x + out
+    merged = attn_heads.transpose(0, 2, 1, 3).reshape(b, t, -1)
+    # Head merge keeps the head axis's "model" sharding on the fused
+    # feature dim; wo is row-split over "model", so the product psums
+    # once and lands data-sharded only.
+    merged = _constrain(merged, mesh, ("data", None, "model"))
+    x = x + _constrain(merged @ p["wo"], mesh, ("data", None, None))
     h = _rmsnorm(x, p["ln2"])
     return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
 
 
-def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
-           return_kv: bool = False):
-    q, k, v = _qkv_heads(x, p, cfg)
-    q, k = _maybe_rope(q, k, cfg, jnp.arange(x.shape[1], dtype=jnp.int32))
-    # Training/forward runs under jit with GSPMD shardings
-    # (parallel/train_step.py), and a pallas_call has no partitioning
-    # rule — XLA would replicate or fail to split it. So this path PINS
-    # the fused XLA attention (handles GQA natively; window maps to
-    # local_window_size with identical band semantics). The kernel
-    # routes exist where they are safe: flash_decode in the unsharded
-    # generate() loop, and tp_flash_attention / ring_attention for
-    # sharded use via shard_map.
+def _attention(q, k, v, cfg, mesh=None):
+    """Block attention dispatch.
+
+    mesh=None (single-device jit / decode prefill): the public
+    ops.flash_attention entry — data-driven dispatch takes the Pallas
+    kernel at lengths where the committed sweep says it wins.
+
+    mesh given (GSPMD train step, parallel/train_step.py): a
+    pallas_call is opaque to the GSPMD partitioner (it would replicate
+    or fail to split), so the SAME public entry runs under shard_map —
+    batch over "data", heads over "model"; attention is embarrassingly
+    parallel over both, so no collectives are needed (the
+    parallel/tp_attention.py recipe, fused with dp). Falls back to
+    fused XLA (which GSPMD partitions natively) only when the
+    batch/head counts cannot split evenly over the mesh.
+    """
     from gpumounter_tpu.ops.flash_attention import flash_attention
-    x = _finish_block(x, flash_attention(q, k, v, causal=True,
-                                         window=cfg.window,
-                                         backend="xla"), p)
+    kwargs = dict(causal=True, window=cfg.window)
+    if mesh is None:
+        return flash_attention(q, k, v, backend=cfg.attn_backend, **kwargs)
+    from jax.sharding import PartitionSpec as P
+    data_ax, model_ax = mesh.axis_names
+    dp, tp = mesh.shape[data_ax], mesh.shape[model_ax]
+    b, h, h_kv = q.shape[0], q.shape[1], k.shape[1]
+    if b % dp or h % tp or h_kv % tp:
+        if cfg.attn_backend == "pallas":
+            # Forced-pallas gets the same loud refusal as the ops-level
+            # entry — silently certifying the fused path instead of the
+            # kernel the caller pinned would be a lie.
+            raise ValueError(
+                f"attn_backend='pallas' under a mesh needs batch/heads "
+                f"to split evenly: B={b} over {data_ax}={dp}, H={h}/"
+                f"H_kv={h_kv} over {model_ax}={tp}; use attn_backend="
+                f"'auto' to allow the fused-XLA fallback")
+        return flash_attention(q, k, v, backend="xla", **kwargs)
+    spec = P(data_ax, model_ax, None, None)
+    fn = jax.shard_map(
+        lambda q, k, v: flash_attention(q, k, v,
+                                        backend=cfg.attn_backend,
+                                        **kwargs),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
+           return_kv: bool = False, mesh=None):
+    q, k, v = _qkv_heads(x, p, cfg, mesh)
+    q, k = _maybe_rope(q, k, cfg, jnp.arange(x.shape[1], dtype=jnp.int32))
+    x = _finish_block(x, _attention(q, k, v, cfg, mesh), p, mesh)
     if return_kv:
         return x, k, v
     return x
@@ -185,9 +268,22 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
     return _finish_block(x, out, p), k_cache, v_cache
 
 
-@partial(jax.jit, static_argnums=2)
-def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
-    """Logits for int32 tokens of shape (batch, seq)."""
+@partial(jax.jit, static_argnums=(2, 3))
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            mesh=None) -> jax.Array:
+    """Logits for int32 tokens of shape (batch, seq).
+
+    mesh (a jax.sharding.Mesh, static): pass the training mesh when
+    calling under GSPMD shardings — attention then runs the flash
+    kernel under shard_map (heads over the second/tensor-parallel axis,
+    batch over the first/data axis) instead of being pinned to the
+    fused XLA path; see _attention. The mesh must have exactly two
+    axes, (data, model)-shaped, in that order — names are free.
+    """
+    if mesh is not None and len(mesh.axis_names) != 2:
+        raise ValueError(
+            f"forward() expects a 2-axis (data, model) mesh, got axes "
+            f"{mesh.axis_names}")
     b, t = tokens.shape
     if t > cfg.max_len:
         # the learned-pos path fails this implicitly via broadcasting;
@@ -198,7 +294,7 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Arra
     if not cfg.rope:  # rope replaces the learned absolute positions
         x = x + params["pos"][:t]
     for blk in params["blocks"]:
-        x = _block(x, blk, cfg)
+        x = _block(x, blk, cfg, mesh=mesh)
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
@@ -219,6 +315,10 @@ def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
     softmax(logits / temperature) (temperature defaults to 1.0), the
     key split once per step inside the scan.
     """
+    if n_new < 0:
+        raise ValueError(f"n_new must be >= 0, got {n_new}")
+    if n_new == 0:
+        return prompt  # the scan below runs length=n_new-1
     if prompt.shape[1] + n_new > cfg.max_len:
         raise ValueError(f"prompt ({prompt.shape[1]}) + n_new ({n_new}) "
                          f"exceeds max_len ({cfg.max_len})")
@@ -283,19 +383,25 @@ def _generate_impl(params, prompt, cfg, n_new, key, temperature):
         logits = (x[:, -1] @ params["embed"].T).astype(jnp.float32)
         key, sub = jax.random.split(key)
         nxt = pick(logits, sub).astype(token.dtype)
-        return (new_caches, nxt, cur_len + 1, key), token
+        return (new_caches, nxt, cur_len + 1, key), nxt
 
     # Each step consumes the token generated by the previous step (the
-    # scan's carry, seeded with the prefill's argmax) and emits it, so
-    # the collected outputs are exactly the n_new generated tokens.
+    # scan's carry, seeded with the prefill's pick) and emits the token
+    # it COMPUTES — so only n_new - 1 steps are needed: the prefill
+    # already produced new token #1, and an emit-the-carry scan would
+    # run one full dead decode step (all layers + logits) whose output
+    # is discarded (ADVICE r3).
     _, toks = jax.lax.scan(
-        step, (caches, first_new, jnp.int32(t0), key), None, length=n_new)
+        step, (caches, first_new, jnp.int32(t0), key), None,
+        length=n_new - 1)
+    toks = jnp.concatenate([first_new[None], toks], axis=0)
     return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
 
 
-def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            mesh=None) -> jax.Array:
     """Next-token cross-entropy (mean)."""
-    logits = forward(params, tokens, cfg)
+    logits = forward(params, tokens, cfg, mesh)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)

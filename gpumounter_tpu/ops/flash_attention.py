@@ -733,6 +733,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sweep-derived) and otherwise falls back to the fused path's
     local_window_size.
 
+    CONVENTION NOTE: window=W attends W+1 keys — positions [q-W, q],
+    matching jax.nn local_window_size=(W, 0). Mistral/HF checkpoints
+    define sliding_window=W as W keys INCLUDING self; port those
+    configs as window = sliding_window - 1 or the band is off by one.
+
     softcap: Gemma-2-style logit capping cap·tanh(s/cap). ONLY the
     kernel implements it (jax.nn's fused attention has no such knob),
     so softcap forces the Pallas path — the interpret kernel off-TPU,
@@ -794,6 +799,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                          "(local_window_size has no sink region)")
     if backend == "pallas":
         use_pallas = True
+        if on_tpu and not blocks_ok:
+            # Same actionable refusal as auto dispatch (ADVICE r3):
+            # without it a forced kernel fails deep inside Mosaic with
+            # an opaque lowering error on unaligned tiles.
+            raise ValueError(
+                f"backend='pallas': L_q={l}/L_k={l_k} do not tile into "
+                f"lane-aligned blocks (fit: {bq}x{bk}); pad L to a "
+                f"multiple of 128")
     elif backend == "auto":
         if softcap is not None or sinks:
             # Only the kernel caps logits / keeps sinks; there is no

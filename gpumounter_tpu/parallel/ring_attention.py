@@ -205,33 +205,45 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                          f"kv heads ({k.shape[1]})")
     spec = P(None, None, seq_axis, None)
     on_tpu = any(dev.platform == "tpu" for dev in mesh.devices.flat)
+    # Per-device chunk geometry, shared by auto dispatch and the
+    # forced-flash guard (ONE source of truth for the alignment rule).
+    from gpumounter_tpu.ops.flash_attention import (
+        _MEASURED_HEAD_DIM, _fit_block)
+    chunk = q.shape[2] // mesh.shape[seq_axis]
+    bq, bk = _fit_block(chunk, block_q), _fit_block(chunk, block_k)
+    blocks_ok = bq % 128 == 0 and bk % 128 == 0
+
+    def _refuse_unaligned(why: str):
+        raise ValueError(
+            f"ring_attention: {why} needs the flash body but the "
+            f"per-device chunk ({chunk}) does not tile into "
+            f"lane-aligned blocks (fit: {bq}x{bk}); pad the sequence "
+            f"so chunks are multiples of 128")
+
     if impl == "auto":
         # Same envelope discipline as ops-level auto dispatch: only take
         # the Pallas body when the per-device chunk yields lane-aligned
         # blocks and head_dim is the measured 128 — Mosaic compiles
         # unaligned tiles poorly or not at all, and the previously
         # always-XLA body handled those shapes fine.
-        from gpumounter_tpu.ops.flash_attention import (
-            _MEASURED_HEAD_DIM, _fit_block)
-        chunk = q.shape[2] // mesh.shape[seq_axis]
-        bq, bk = _fit_block(chunk, block_q), _fit_block(chunk, block_k)
         in_envelope = (causal and q.shape[-1] == _MEASURED_HEAD_DIM
-                       and bq % 128 == 0 and bk % 128 == 0)
+                       and blocks_ok)
         if softcap is not None:
             # Only the flash body caps logits; interpret mode covers
             # non-TPU platforms. On TPU an out-of-envelope shape would
             # hand Mosaic unaligned tiles — refuse loudly rather than
             # fail deep in the compiler.
-            if on_tpu and not (bq % 128 == 0 and bk % 128 == 0):
-                raise ValueError(
-                    f"ring_attention: softcap needs the flash body but "
-                    f"the per-device chunk ({chunk}) does not tile into "
-                    f"lane-aligned blocks (fit: {bq}x{bk}); pad the "
-                    f"sequence so chunks are multiples of 128")
+            if on_tpu and not blocks_ok:
+                _refuse_unaligned("softcap")
             impl = "flash"
         else:
             impl = "flash" if (on_tpu and in_envelope) else "xla"
     if impl == "flash":
+        if on_tpu and not blocks_ok:
+            # Forced flash gets the SAME actionable refusal as auto
+            # dispatch (ADVICE r3): an unaligned per-device chunk would
+            # otherwise fail deep inside Mosaic with an opaque error.
+            _refuse_unaligned("impl='flash'")
         body = partial(_ring_flash_local, axis_name=seq_axis, scale=scale,
                        causal=causal, block_q=block_q, block_k=block_k,
                        interpret=not on_tpu, softcap=softcap)

@@ -5,6 +5,11 @@ appear, the tenant rebuilds the mesh and resumes stepping with the same
 functions. Shardings: batch over "data"; attention/MLP weights over "model"
 (column/row split so XLA emits a single psum per block on ICI); everything
 jit-compiled with explicit NamedSharding in/out specs.
+
+The mesh is threaded into loss_fn, so attention executes the Pallas
+flash kernel under a shard_map nested inside the GSPMD step (heads over
+"model", batch over "data" — models/probe._attention) forward AND
+backward, rather than pinning the fused XLA path.
 """
 
 from __future__ import annotations
@@ -58,7 +63,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
 
     def step(params, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg))(params)
+            lambda p: loss_fn(p, tokens, cfg, mesh))(params)
         new_params = jax.tree.map(
             lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)
                           ).astype(p.dtype), params, grads)
@@ -129,7 +134,7 @@ def make_train_step_optax(mesh: Mesh, cfg: TransformerConfig, tx):
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg))(params)
+            lambda p: loss_fn(p, tokens, cfg, mesh))(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

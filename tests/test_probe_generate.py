@@ -202,3 +202,16 @@ def test_generate_rejects_overflow():
     prompt = jnp.zeros((1, 10), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
         generate(params, prompt, cfg, 10)
+
+
+def test_generate_zero_and_negative_n_new():
+    """n_new=0 returns the prompt unchanged (the scan runs length
+    n_new-1 since the dead-decode fix — 0 must not become -1); negative
+    raises."""
+    cfg = TransformerConfig(max_len=16)
+    params = init_params(cfg, jax.random.key(2))
+    prompt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    out = generate(params, prompt, cfg, 0)
+    assert (np.asarray(out) == np.asarray(prompt)).all()
+    with pytest.raises(ValueError, match="n_new"):
+        generate(params, prompt, cfg, -1)

@@ -1,0 +1,122 @@
+"""The flagship sharded train step executes the Pallas flash kernel.
+
+VERDICT r3 missing #1: the dp x tp step used to pin the fused XLA
+attention because a pallas_call is opaque to the GSPMD partitioner.
+models/probe._attention now runs the kernel under shard_map (heads over
+"model", batch over "data" — the parallel/tp_attention.py recipe); these
+tests pin that path's correctness against the XLA-attention step on the
+same weights/tokens, and the fallback behavior when head counts cannot
+split evenly.
+"""
+
+import dataclasses
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gpumounter_tpu.models.probe import (
+    TransformerConfig, forward, init_params, loss_fn)
+from gpumounter_tpu.parallel.mesh import build_mesh
+from gpumounter_tpu.parallel.train_step import make_train_step, shard_params
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+try:
+    # ONE source of truth for the flagship config: these tests pin the
+    # exact path the multichip dryrun certifies.
+    from __graft_entry__ import _CapturedStderr, _flagship_cfg as _dryrun_cfg
+finally:
+    sys.path.pop(0)
+
+
+def _flagship_cfg(**kw):
+    cfg = _dryrun_cfg()
+    return dataclasses.replace(cfg, **kw) if kw else cfg
+
+
+@pytest.fixture(autouse=True)
+def _cpu_default():
+    # Pin dispatch to CPU (interpret-mode kernel): the site env may keep
+    # a real TPU as the default backend, and ops dispatch follows
+    # jax.default_device (see ops.flash_attention._target_platform).
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # Explicit cpu backend: the site env may pin a real TPU platform as
+    # default (see conftest docstring).
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, "conftest provides 8 virtual CPU devices"
+    return build_mesh(devices[:8])
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return jax.random.randint(jax.random.key(1), (8, 16), 0, 256)
+
+
+def test_sharded_step_through_kernel_trains(mesh, tokens):
+    cfg = _flagship_cfg()
+    params = shard_params(init_params(cfg, jax.random.key(0)), mesh, cfg)
+    step = make_train_step(mesh, cfg, lr=0.5)
+    params, loss0 = step(params, tokens)
+    loss = loss0
+    for _ in range(29):
+        params, loss = step(params, tokens)
+    assert jnp.isfinite(loss0) and jnp.isfinite(loss)
+    # it LEARNS through the kernel: 30 sgd steps on one batch cut the
+    # from-uniform loss (ln 256 ~ 5.55) by a clear margin
+    assert float(loss) < float(loss0) - 0.5
+
+
+def test_sharded_kernel_grads_match_xla_attention(mesh, tokens):
+    cfg_p = _flagship_cfg()
+    cfg_x = dataclasses.replace(cfg_p, attn_backend="xla")
+    params = shard_params(init_params(cfg_p, jax.random.key(0)),
+                          mesh, cfg_p)
+    gp = jax.jit(jax.grad(lambda p: loss_fn(p, tokens, cfg_p, mesh)))(params)
+    gx = jax.jit(jax.grad(lambda p: loss_fn(p, tokens, cfg_x, mesh)))(params)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gx)):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - b.astype(jnp.float32))))
+        assert err < 5e-3, err
+
+
+def test_sharded_forward_matches_unsharded(mesh, tokens):
+    """mesh-aware forward (kernel under shard_map) == plain forward."""
+    cfg = _flagship_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    sharded = forward(shard_params(params, mesh, cfg), tokens, cfg, mesh)
+    plain = forward(params, tokens,
+                    dataclasses.replace(cfg, attn_backend="xla"))
+    assert jnp.max(jnp.abs(sharded - plain)) < 5e-2
+
+
+def test_fallback_when_heads_do_not_divide(mesh, tokens):
+    """4 q heads cannot split over an 8-way model axis: auto dispatch
+    must fall back to the GSPMD-partitioned fused path (not crash),
+    while FORCED pallas must refuse loudly rather than silently
+    certify the wrong implementation."""
+    cfg = _flagship_cfg(n_heads=4, n_kv_heads=2, d_model=64,
+                        attn_backend="auto")
+    params = shard_params(init_params(cfg, jax.random.key(0)), mesh, cfg)
+    params, loss = make_train_step(mesh, cfg)(params, tokens)
+    assert jnp.isfinite(loss)
+
+    cfg_forced = _flagship_cfg(n_heads=4, n_kv_heads=2, d_model=64)
+    assert cfg_forced.attn_backend == "pallas"
+    with pytest.raises(ValueError, match="attn_backend='pallas'"):
+        loss_fn(params, tokens, cfg_forced, mesh)
+
+
+def test_captured_stderr_sees_fd_writes():
+    """The dryrun's warning enforcement reads fd 2, where XLA's C++
+    logging lands (sys.stderr redirection would miss it)."""
+    with _CapturedStderr() as cap:
+        os.write(2, b"[SPMD] fake warning via raw fd\n")
+    assert "fake warning via raw fd" in cap.text
