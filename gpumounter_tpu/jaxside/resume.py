@@ -65,31 +65,46 @@ class HotResumable:
         return out
 
     def save(self, path: str) -> None:
-        """Durable on-disk checkpoint: survives process death, not just
-        backend teardown (pack/restore covers the ~ms hot-mount fast
-        path; save/load covers worker preemption and pod restarts
-        around a slice attach).
+        """Durable on-disk checkpoint: survives process death AND node
+        power loss, not just backend teardown (pack/restore covers the
+        ~ms hot-mount fast path; save/load covers worker preemption and
+        pod restarts around a slice attach).
 
-        Two properties orbax alone does not give us and this layout
-        does:
+        Properties orbax alone does not give us and this layout does:
           * EXACT pytree structure round-trip — orbax rewrites nested
             tuples to lists and namedtuples (optax states!) to dicts,
-            so we store the flattened leaves through orbax and the
-            treedef pickled alongside, and unflatten on load;
+            so we store the flattened leaves through orbax and the tree
+            STRUCTURE as a JSON skeleton alongside (structure.json —
+            not a pickle: unpickling attacker-writable checkpoint dirs
+            would execute arbitrary code, and pickled treedefs couple
+            the file to exact library versions);
           * crash-safe OVERWRITE — orbax's force=True rmtree()s the
             existing checkpoint before writing the new one, so a
             preemption mid-save would leave nothing. Here every save
             writes a fresh version directory and then atomically
-            os.replace()s a LATEST pointer file; a crash at any instant
-            leaves LATEST pointing at a complete checkpoint. The
-            previous version is pruned only after the pointer moves.
+            os.replace()s a LATEST pointer file.
+          * POWER-loss safety — every file and directory of the new
+            version is fsync()ed before the pointer swap, the pointer
+            file is fsync()ed before the rename, and the checkpoint
+            directory is fsync()ed after it: when LATEST names a
+            version, that version is durably complete even if the node
+            loses power the same instant.
+
+        After the pointer moves, ALL other v-* dirs and stale .LATEST.*
+        temp pointers are swept (not just the one the pointer
+        previously named), so crash-interrupted saves cannot accumulate
+        orphans. Concurrent savers to the SAME path are serialized by
+        an advisory flock on <path>/.lock — the sweep would otherwise
+        race a just-committed sibling version. (Concurrent load()
+        during a save can still observe a version being swept; like
+        orbax, a checkpoint dir has one writer and readers should
+        retry on a missing-version error.)
         """
+        import fcntl
         import os
-        import pickle
         import shutil
         import uuid
 
-        import jax
         import numpy as np
         import orbax.checkpoint as ocp
 
@@ -97,23 +112,33 @@ class HotResumable:
         os.makedirs(path, exist_ok=True)
         stamp = f"v-{uuid.uuid4().hex}"
         target = os.path.join(path, stamp)
-        flat, treedef = jax.tree.flatten(self.host_state)
-        leaves = {f"l{i:06d}": np.asarray(x) for i, x in enumerate(flat)}
-        ocp.PyTreeCheckpointer().save(os.path.join(target, "leaves"),
-                                      leaves)
-        with open(os.path.join(target, "treedef.pkl"), "wb") as f:
-            pickle.dump(treedef, f)
-        latest = os.path.join(path, "LATEST")
-        prev = None
-        if os.path.exists(latest):
-            with open(latest) as f:
-                prev = f.read().strip()
-        tmp = os.path.join(path, f".LATEST.{stamp}")
-        with open(tmp, "w") as f:
-            f.write(stamp)
-        os.replace(tmp, latest)                      # the atomic commit
-        if prev and prev != stamp:
-            shutil.rmtree(os.path.join(path, prev), ignore_errors=True)
+        with open(os.path.join(path, ".lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            flat, skeleton = _encode_tree(self.host_state)
+            leaves = {f"l{i:06d}": np.asarray(x)
+                      for i, x in enumerate(flat)}
+            ocp.PyTreeCheckpointer().save(os.path.join(target, "leaves"),
+                                          leaves)
+            _write_fsynced(os.path.join(target, "structure.json"),
+                           _json_dumps(skeleton).encode())
+            _fsync_dir_tree(target)             # leaves + dirs durable
+            latest = os.path.join(path, "LATEST")
+            tmp = os.path.join(path, f".LATEST.{stamp}")
+            _write_fsynced(tmp, stamp.encode())
+            os.replace(tmp, latest)             # the atomic commit
+            _fsync_path(path)                   # the rename itself
+            for entry in os.listdir(path):      # sweep ALL stale junk
+                stale_version = (entry.startswith("v-")
+                                 and entry != stamp)
+                stale_tmp_pointer = entry.startswith(".LATEST.")
+                if stale_version:
+                    shutil.rmtree(os.path.join(path, entry),
+                                  ignore_errors=True)
+                elif stale_tmp_pointer:
+                    try:
+                        os.unlink(os.path.join(path, entry))
+                    except OSError:
+                        pass
         logger.info("checkpointed %d leaves to %s (%s)",
                     len(flat), path, stamp)
 
@@ -121,8 +146,8 @@ class HotResumable:
     def load(cls, path: str) -> "HotResumable":
         """Inverse of save(); restore() then puts the state on whatever
         mesh the (possibly different) process has built."""
+        import json
         import os
-        import pickle
 
         import orbax.checkpoint as ocp
 
@@ -132,7 +157,144 @@ class HotResumable:
         target = os.path.join(path, stamp)
         leaves = ocp.PyTreeCheckpointer().restore(
             os.path.join(target, "leaves"))
-        with open(os.path.join(target, "treedef.pkl"), "rb") as f:
-            treedef = pickle.load(f)
+        with open(os.path.join(target, "structure.json")) as f:
+            skeleton = json.load(f)
         flat = [leaves[key] for key in sorted(leaves)]
-        return cls(host_state=treedef.unflatten(flat))
+        return cls(host_state=_decode_tree(skeleton, flat))
+
+
+# --- durable-write helpers ---
+
+def _write_fsynced(path: str, data: bytes) -> None:
+    import os
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_path(path: str) -> None:
+    """fsync a file or directory by fd (directories need O_RDONLY)."""
+    import os
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir_tree(root: str) -> None:
+    """fsync every file and directory under root, bottom-up — after
+    this returns, the whole version directory is on stable storage."""
+    import os
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            _fsync_path(os.path.join(dirpath, name))
+        _fsync_path(dirpath)
+
+
+# --- pytree structure codec (pickle-free) ---
+#
+# The skeleton is plain JSON; leaves are referenced by flatten index.
+# Namedtuple nodes (optax states) record module + qualname and are
+# re-imported on load, restricted to _TRUSTED_MODULE_PREFIXES — the
+# trust model is "the checkpoint dir may be attacker-writable": a
+# forged structure.json can at worst import an already-installed
+# optax/jax/flax module attribute, never run embedded code the way a
+# pickle would.
+
+_TRUSTED_MODULE_PREFIXES = ("optax", "jax", "flax", "chex",
+                            "gpumounter_tpu", "builtins")
+
+
+def _encode_tree(tree):
+    """(leaves, skeleton): walk `tree` depositing leaves in order (dict
+    keys sorted, matching the load-side walk)."""
+    leaves: list = []
+
+    def enc(node):
+        if node is None:
+            return {"t": "none"}
+        if isinstance(node, dict):
+            keys = sorted(node)
+            if any(not isinstance(key, str) for key in keys):
+                raise TypeError("checkpoint dict keys must be str, got "
+                                f"{[type(key).__name__ for key in keys]}")
+            return {"t": "dict", "keys": keys,
+                    "vals": [enc(node[key]) for key in keys]}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            cls = type(node)
+            return {"t": "namedtuple", "module": cls.__module__,
+                    "qualname": cls.__qualname__,
+                    "fields": list(node._fields),
+                    "items": [enc(x) for x in node]}
+        if isinstance(node, tuple):
+            return {"t": "tuple", "items": [enc(x) for x in node]}
+        if isinstance(node, list):
+            return {"t": "list", "items": [enc(x) for x in node]}
+        import jax
+        if not jax.tree_util.all_leaves([node]):
+            # A registered custom pytree node (flax.struct dataclass,
+            # TrainState, ...) that this pickle-free codec cannot
+            # reconstruct from data alone. Refuse LOUDLY here rather
+            # than let np.asarray mangle the container downstream.
+            raise TypeError(
+                f"checkpoint contains a {type(node).__module__}."
+                f"{type(node).__qualname__} node; the durable format "
+                f"supports dict/list/tuple/namedtuple/None containers "
+                f"only — convert custom nodes to a state dict first "
+                f"(e.g. flax.serialization.to_state_dict)")
+        leaves.append(node)
+        return {"t": "leaf", "i": len(leaves) - 1}
+
+    return leaves, enc(tree)
+
+
+def _resolve_namedtuple(module: str, qualname: str, fields: list):
+    import importlib
+    root = module.split(".")[0]
+    if root not in _TRUSTED_MODULE_PREFIXES:
+        raise ValueError(
+            f"checkpoint references namedtuple {module}.{qualname} "
+            f"outside the trusted prefixes {_TRUSTED_MODULE_PREFIXES}; "
+            f"refusing to import it")
+    obj = importlib.import_module(module)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    if not (isinstance(obj, type) and issubclass(obj, tuple)
+            and getattr(obj, "_fields", None) is not None):
+        raise ValueError(f"{module}.{qualname} is not a namedtuple class")
+    if list(obj._fields) != list(fields):
+        raise ValueError(
+            f"namedtuple {module}.{qualname} fields changed: checkpoint "
+            f"has {fields}, installed class has {list(obj._fields)} — "
+            f"library version mismatch")
+    return obj
+
+
+def _decode_tree(skeleton, flat):
+    def dec(node):
+        kind = node["t"]
+        if kind == "none":
+            return None
+        if kind == "leaf":
+            return flat[node["i"]]
+        if kind == "dict":
+            return {key: dec(val)
+                    for key, val in zip(node["keys"], node["vals"])}
+        if kind == "tuple":
+            return tuple(dec(x) for x in node["items"])
+        if kind == "list":
+            return [dec(x) for x in node["items"]]
+        if kind == "namedtuple":
+            cls = _resolve_namedtuple(node["module"], node["qualname"],
+                                      node["fields"])
+            return cls(*(dec(x) for x in node["items"]))
+        raise ValueError(f"unknown skeleton node type {kind!r}")
+
+    return dec(skeleton)
+
+
+def _json_dumps(skeleton) -> str:
+    import json
+    return json.dumps(skeleton, separators=(",", ":"))

@@ -195,3 +195,83 @@ print("CKPT_OK")
     entries = [e for e in (tmp_path / "ckpt").iterdir()
                if e.name.startswith("v-")]
     assert len(entries) == 1, entries
+
+
+def test_checkpoint_torn_write_restores_previous(tmp_path):
+    """Crash between the version write and the pointer swap: LATEST
+    still names the old complete version; load() must return it, and
+    the next save() must sweep the orphaned partial version (ADVICE r3:
+    orphans used to accumulate unboundedly)."""
+    ckpt = tmp_path / "ckpt"
+    snap = HotResumable.pack({"w": np.float32(1.0)})
+    snap.save(str(ckpt))
+
+    # Simulate the torn save: a partial v-* dir (no structure.json, no
+    # leaves) that a crash stranded before the pointer moved.
+    torn = ckpt / "v-torn0000"
+    torn.mkdir()
+    (torn / "garbage").write_bytes(b"\x00" * 16)
+
+    loaded = HotResumable.load(str(ckpt))
+    assert float(loaded.host_state[0]["w"]) == 1.0
+
+    HotResumable.pack({"w": np.float32(2.0)}).save(str(ckpt))
+    versions = [e.name for e in ckpt.iterdir() if e.name.startswith("v-")]
+    assert len(versions) == 1, versions  # torn orphan swept
+    assert float(HotResumable.load(str(ckpt)).host_state[0]["w"]) == 2.0
+
+
+def test_checkpoint_survives_kill9_mid_save(tmp_path):
+    """SIGKILL a process mid-save loop; LATEST must still name a
+    COMPLETE checkpoint (one of the fully-written versions)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    ckpt = str(tmp_path / "ckpt")
+    prog = f"""
+import sys
+sys.path.insert(0, {REPO_ROOT!r})
+import numpy as np
+from gpumounter_tpu.jaxside.resume import HotResumable
+i = 0
+while True:
+    i += 1
+    HotResumable.pack({{"step": np.int64(i),
+                        "w": np.full((64, 64), i, np.float32)}}).save({ckpt!r})
+    print(i, flush=True)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", prog],
+                            stdout=subprocess.PIPE, text=True)
+    # Let at least one save complete, then kill WITHOUT warning.
+    line = proc.stdout.readline()
+    assert line.strip()
+    time.sleep(0.45)  # land mid-save with high probability
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    loaded = HotResumable.load(ckpt)
+    step = int(loaded.host_state[0]["step"])
+    assert step >= 1
+    np.testing.assert_array_equal(
+        np.asarray(loaded.host_state[0]["w"]),
+        np.full((64, 64), step, np.float32))
+
+
+def test_checkpoint_refuses_untrusted_namedtuple(tmp_path):
+    """structure.json is data, not code: a forged namedtuple node
+    pointing outside the trusted module prefixes must be refused, never
+    imported (the pickle-era equivalent executed arbitrary code)."""
+    import json
+
+    ckpt = tmp_path / "ckpt"
+    HotResumable.pack({"w": np.float32(1.0)}).save(str(ckpt))
+    latest = (ckpt / "LATEST").read_text().strip()
+    sj = ckpt / latest / "structure.json"
+    skel = json.loads(sj.read_text())
+    evil = {"t": "namedtuple", "module": "os.path", "qualname": "join",
+            "fields": [], "items": []}
+    sj.write_text(json.dumps({"t": "tuple", "items": [evil, skel]}))
+    with pytest.raises(ValueError, match="trusted"):
+        HotResumable.load(str(ckpt))
