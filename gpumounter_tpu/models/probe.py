@@ -195,7 +195,7 @@ def _finish_block(x, attn_heads, p, mesh=None):
     return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
 
 
-def _attention(q, k, v, cfg, mesh=None):
+def _attention(q, k, v, cfg, mesh=None, train=False):
     """Block attention dispatch.
 
     mesh=None (single-device jit / decode prefill): the public
@@ -212,7 +212,7 @@ def _attention(q, k, v, cfg, mesh=None):
     batch/head counts cannot split evenly over the mesh.
     """
     from gpumounter_tpu.ops.flash_attention import flash_attention
-    kwargs = dict(causal=True, window=cfg.window)
+    kwargs = dict(causal=True, window=cfg.window, train=train)
     if mesh is None:
         return flash_attention(q, k, v, backend=cfg.attn_backend, **kwargs)
     from jax.sharding import PartitionSpec as P
@@ -241,10 +241,10 @@ def _attention(q, k, v, cfg, mesh=None):
 
 
 def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
-           return_kv: bool = False, mesh=None):
+           return_kv: bool = False, mesh=None, train=False):
     q, k, v = _qkv_heads(x, p, cfg, mesh)
     q, k = _maybe_rope(q, k, cfg, jnp.arange(x.shape[1], dtype=jnp.int32))
-    x = _finish_block(x, _attention(q, k, v, cfg, mesh), p, mesh)
+    x = _finish_block(x, _attention(q, k, v, cfg, mesh, train), p, mesh)
     if return_kv:
         return x, k, v
     return x
@@ -268,9 +268,9 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
     return _finish_block(x, out, p), k_cache, v_cache
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(2, 3, 4))
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
-            mesh=None) -> jax.Array:
+            mesh=None, train: bool = False) -> jax.Array:
     """Logits for int32 tokens of shape (batch, seq).
 
     mesh (a jax.sharding.Mesh, static): pass the training mesh when
@@ -294,7 +294,7 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     if not cfg.rope:  # rope replaces the learned absolute positions
         x = x + params["pos"][:t]
     for blk in params["blocks"]:
-        x = _block(x, blk, cfg, mesh=mesh)
+        x = _block(x, blk, cfg, mesh=mesh, train=train)
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
@@ -400,8 +400,11 @@ def _generate_impl(params, prompt, cfg, n_new, key, temperature):
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             mesh=None) -> jax.Array:
-    """Next-token cross-entropy (mean)."""
-    logits = forward(params, tokens, cfg, mesh)
+    """Next-token cross-entropy (mean). Dispatches attention with
+    train=True: the loss exists to be differentiated, so block
+    geometry must come from the fwd+grad sweep (see flash_attention's
+    train parameter)."""
+    logits = forward(params, tokens, cfg, mesh, train=True)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)

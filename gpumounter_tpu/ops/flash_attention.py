@@ -668,7 +668,7 @@ def fused_xla_attention(q, k, v, causal, scale, window=None):
 
 # --- data-driven dispatch ---
 #
-# Fitted envelope (bench_flash.py → BENCH_flash_r03.json, real v5e chip):
+# Fitted envelope (bench_flash.py → BENCH_flash_r04.json, real v5e chip):
 # causal bf16, B=4, H=8, D=128. Winners per measured L against the FUSED
 # XLA baseline. Outside the envelope (different head_dim, non-causal)
 # nothing below is assumed to transfer and auto dispatch falls back to
@@ -676,13 +676,34 @@ def fused_xla_attention(q, k, v, causal, scale, window=None):
 _MEASURED_HEAD_DIM = 128
 # seq_len → (winner, best (block_q, block_k) for the kernel at that L).
 # Values are (re)generated by bench_flash.py; keep in sync with the
-# committed BENCH_flash artifact.
+# committed BENCH_flash artifact. r04: the kernel now wins from 2048 up
+# (2048 was XLA's in r03; a wider geometry sweep found 1024x2048);
+# 1024 flipped to XLA — at 0.13 ms the dispatch is a coin toss and the
+# fused path measured 3% faster with 100-iteration chains.
+#
+# TWO tables because forward-only and training calls have different
+# feasible sets: a non-differentiated call never traces the backward
+# kernels, so it may use geometries whose bwd grid does not compile
+# (e.g. block_k=2048 at L>=4096), while a training call bakes ONE
+# geometry into fwd AND both bwd kernels. _TRAIN_TABLE holds the
+# combined (fwd + grad) winner among configs VALID IN BOTH sweeps;
+# notably the kernel wins training at every measured L — including
+# 1024, where fused XLA wins forward-only — because XLA's attention
+# grad is 3-4x slower than the backward kernels.
 _SWEEP_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
-    1024: ("pallas", (1024, 1024)),
-    2048: ("xla", (256, 1024)),
+    1024: ("xla", (256, 512)),
+    2048: ("pallas", (1024, 2048)),
+    4096: ("pallas", (1024, 2048)),
+    8192: ("pallas", (1024, 2048)),
+    16384: ("pallas", (1024, 1024)),
+    32768: ("pallas", (1024, 1024)),
+}
+_TRAIN_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
+    1024: ("pallas", (512, 512)),
+    2048: ("pallas", (512, 1024)),
     4096: ("pallas", (1024, 1024)),
     8192: ("pallas", (512, 1024)),
-    16384: ("pallas", (512, 2048)),
+    16384: ("pallas", (1024, 1024)),
     32768: ("pallas", (1024, 1024)),
 }
 
@@ -703,9 +724,10 @@ def _nearest_measured(l: int) -> int:
     return min(_SWEEP_TABLE, key=lambda m: abs(math.log(m) - math.log(l)))
 
 
-def _best_blocks(l: int) -> tuple[int, int]:
+def _best_blocks(l: int, train: bool = False) -> tuple[int, int]:
     """Fastest swept (block_q, block_k) at the nearest measured L."""
-    return _SWEEP_TABLE[_nearest_measured(l)][1]
+    table = _TRAIN_TABLE if train else _SWEEP_TABLE
+    return table[_nearest_measured(l)][1]
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -713,7 +735,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     backend: str = "auto",
                     window: int | None = None,
                     softcap: float | None = None,
-                    sinks: int = 0) -> jax.Array:
+                    sinks: int = 0,
+                    train: bool = False) -> jax.Array:
     """Public entry.
 
     backend: "auto" picks per sequence length from the committed sweep
@@ -747,6 +770,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     attendable alongside the sliding window (StreamingLLM attention
     sinks — they anchor the softmax once the window slides past the
     sequence start). Kernel-only, like softcap.
+
+    train: set True when this call will be DIFFERENTIATED (the probe's
+    loss_fn does). Training bakes one block geometry into the forward
+    and both backward kernels, so dispatch must pick winners/blocks
+    from the fwd+grad sweep (_TRAIN_TABLE) — some fwd-optimal
+    geometries do not compile backward, and the kernel beats XLA's
+    attention grad even at lengths where fused XLA wins forward-only.
+    A False hint on a differentiated call still works (the custom VJP
+    is always attached) but may pick bwd-uncompilable blocks at some
+    lengths; True on an inference call merely costs a few percent.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -784,7 +817,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     l_k = k.shape[2]
     l_dispatch = max(l, l_k)
     on_tpu = _target_platform() == "tpu"
-    want_bq, want_bk = _best_blocks(l_dispatch)
+    want_bq, want_bk = _best_blocks(l_dispatch, train)
     bq, bk = _fit_block(l, want_bq), _fit_block(l_k, want_bk)
     # auto only takes the kernel when the fitted blocks stay lane-aligned
     # — odd lengths (primes, non-multiples of 128) degrade to tiny or
@@ -850,7 +883,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     f"force backend='pallas'/'xla' explicitly")
         else:
             in_envelope = causal and d == _MEASURED_HEAD_DIM
-            winner = _SWEEP_TABLE[_nearest_measured(l_dispatch)][0]
+            table = _TRAIN_TABLE if train else _SWEEP_TABLE
+            winner = table[_nearest_measured(l_dispatch)][0]
             use_pallas = (on_tpu and blocks_ok and in_envelope
                           and winner == "pallas")
     elif backend == "xla":

@@ -487,7 +487,7 @@ def test_dispatch_table_consistency():
     import json
     import pathlib
     artifact = (pathlib.Path(__file__).resolve().parent.parent
-                / "BENCH_flash_r03.json")
+                / "BENCH_flash_r04.json")
     if not artifact.exists():
         pytest.skip("sweep artifact not present")
     table = json.loads(artifact.read_text())["dispatch_table"]
@@ -499,6 +499,22 @@ def test_dispatch_table_consistency():
             f"L={l_str}: artifact winner {ent['winner']}, shipped {winner}"
         assert list(blocks) == ent["blocks"], \
             f"L={l_str}: artifact blocks {ent['blocks']}, shipped {blocks}"
+
+    # the TRAIN table (fwd+grad winners over both-valid geometries) is
+    # pinned to the artifact the same way
+    train_table = json.loads(artifact.read_text()).get(
+        "dispatch_table_train")
+    if train_table:
+        assert set(map(int, train_table)) == set(fa._TRAIN_TABLE), \
+            "artifact and _TRAIN_TABLE cover different seq_lens"
+        for l_str, ent in train_table.items():
+            winner, blocks = fa._TRAIN_TABLE[int(l_str)]
+            assert winner == ent["winner"], \
+                f"L={l_str} train: artifact {ent['winner']}, " \
+                f"shipped {winner}"
+            assert list(blocks) == ent["blocks"], \
+                f"L={l_str} train: artifact blocks {ent['blocks']}, " \
+                f"shipped {blocks}"
 
 
 def test_auto_dispatch_respects_envelope(monkeypatch):
