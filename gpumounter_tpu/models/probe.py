@@ -40,11 +40,22 @@ class TransformerConfig:
     # "xla" force a path. The sharded train step honors this too — the
     # kernel runs under shard_map there (see _attention).
     attn_backend: str = "auto"
+    # Mixture-of-Experts: n_experts switches every block's FFN to the
+    # Switch-style top-1 routed MoE from parallel/moe.py (per-block
+    # router + stacked expert weights). Under the dp x tp mesh the
+    # expert dimension shards over the "model" axis (expert
+    # parallelism riding the same ICI-local axis tensor parallelism
+    # uses). loss_fn adds moe_aux_weight x the load-balancing loss.
+    n_experts: int | None = None
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.attn_backend not in ("auto", "pallas", "xla"):
             raise ValueError(f"attn_backend must be auto|pallas|xla, "
                              f"got {self.attn_backend!r}")
+        if self.n_experts is not None and self.n_experts < 2:
+            raise ValueError(f"n_experts must be >= 2, got "
+                             f"{self.n_experts}")
         if self.d_model % self.n_heads:
             raise ValueError(f"d_model ({self.d_model}) must divide by "
                              f"n_heads ({self.n_heads})")
@@ -88,14 +99,24 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
     kv_dim = cfg.kv_heads * cfg.d_head
     for i in range(cfg.n_layers):
         bk = jax.random.split(keys[2 + i], 6)
-        params["blocks"].append({
+        block = {
             "wqkv": dense(bk[0], (cfg.d_model, cfg.d_model + 2 * kv_dim)),
             "wo": dense(bk[1], (cfg.d_model, cfg.d_model)),
-            "w1": dense(bk[2], (cfg.d_model, cfg.d_ff)),
-            "w2": dense(bk[3], (cfg.d_ff, cfg.d_model)),
             "ln1": jnp.ones((cfg.d_model,), cfg.dtype),
             "ln2": jnp.ones((cfg.d_model,), cfg.dtype),
-        })
+        }
+        if cfg.n_experts is None:
+            block["w1"] = dense(bk[2], (cfg.d_model, cfg.d_ff))
+            block["w2"] = dense(bk[3], (cfg.d_ff, cfg.d_model))
+        else:
+            # ONE init for the MoE contract: router + stacked expert
+            # weights come from parallel.moe so the flagship and the
+            # standalone MoE layer cannot drift.
+            from gpumounter_tpu.parallel.moe import init_moe_params
+            block.update(init_moe_params(bk[2], cfg.n_experts,
+                                         cfg.d_model, cfg.d_ff,
+                                         cfg.dtype))
+        params["blocks"].append(block)
     return params
 
 
@@ -183,7 +204,11 @@ def _constrain(x, mesh, spec):
 
 
 def _finish_block(x, attn_heads, p, mesh=None):
-    """Post-attention half: output projection, residual, MLP."""
+    """Post-attention half: output projection, residual, FFN.
+
+    Returns (x, aux): aux is the MoE load-balancing loss when the block
+    carries a router (stacked 3-D expert weights), else 0.0 — dense and
+    MoE blocks share everything up to the FFN."""
     b, _, t, _ = attn_heads.shape
     merged = attn_heads.transpose(0, 2, 1, 3).reshape(b, t, -1)
     # Head merge keeps the head axis's "model" sharding on the fused
@@ -192,7 +217,12 @@ def _finish_block(x, attn_heads, p, mesh=None):
     merged = _constrain(merged, mesh, ("data", None, "model"))
     x = x + _constrain(merged @ p["wo"], mesh, ("data", None, None))
     h = _rmsnorm(x, p["ln2"])
-    return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+    if "router" in p:
+        from gpumounter_tpu.parallel.moe import moe_ffn
+        d = h.shape[-1]
+        out, aux = moe_ffn(p, h.reshape(b * t, d))
+        return x + out.reshape(b, t, d), aux
+    return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"], jnp.float32(0.0)
 
 
 def _attention(q, k, v, cfg, mesh=None, train=False):
@@ -242,12 +272,14 @@ def _attention(q, k, v, cfg, mesh=None, train=False):
 
 def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
            return_kv: bool = False, mesh=None, train=False):
+    """Returns (x, aux) — plus (k, v) when return_kv."""
     q, k, v = _qkv_heads(x, p, cfg, mesh)
     q, k = _maybe_rope(q, k, cfg, jnp.arange(x.shape[1], dtype=jnp.int32))
-    x = _finish_block(x, _attention(q, k, v, cfg, mesh, train), p, mesh)
+    x, aux = _finish_block(x, _attention(q, k, v, cfg, mesh, train),
+                           p, mesh)
     if return_kv:
-        return x, k, v
-    return x
+        return x, aux, k, v
+    return x, aux
 
 
 def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
@@ -265,7 +297,29 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cur_len - 1, 0))
     out = flash_decode(q, k_cache, v_cache, cur_len, window=cfg.window,
                        interpret=interpret)
-    return _finish_block(x, out, p), k_cache, v_cache
+    x, _aux = _finish_block(x, out, p)  # aux is a training-only signal
+    return x, k_cache, v_cache
+
+
+def _forward_impl(params, tokens, cfg, mesh, train):
+    """(logits, mean MoE aux loss) — shared by forward and loss_fn."""
+    if mesh is not None and len(mesh.axis_names) != 2:
+        raise ValueError(
+            f"forward() expects a 2-axis (data, model) mesh, got axes "
+            f"{mesh.axis_names}")
+    b, t = tokens.shape
+    if t > cfg.max_len:
+        raise ValueError(f"sequence length {t} exceeds max_len "
+                         f"{cfg.max_len}")
+    x = params["embed"][tokens]
+    if not cfg.rope:  # rope replaces the learned absolute positions
+        x = x + params["pos"][:t]
+    aux_total = jnp.float32(0.0)
+    for blk in params["blocks"]:
+        x, aux = _block(x, blk, cfg, mesh=mesh, train=train)
+        aux_total = aux_total + aux
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, aux_total / max(1, cfg.n_layers)
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4))
@@ -280,22 +334,7 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     fused XLA path; see _attention. The mesh must have exactly two
     axes, (data, model)-shaped, in that order — names are free.
     """
-    if mesh is not None and len(mesh.axis_names) != 2:
-        raise ValueError(
-            f"forward() expects a 2-axis (data, model) mesh, got axes "
-            f"{mesh.axis_names}")
-    b, t = tokens.shape
-    if t > cfg.max_len:
-        # the learned-pos path fails this implicitly via broadcasting;
-        # keep max_len binding under rope too.
-        raise ValueError(f"sequence length {t} exceeds max_len "
-                         f"{cfg.max_len}")
-    x = params["embed"][tokens]
-    if not cfg.rope:  # rope replaces the learned absolute positions
-        x = x + params["pos"][:t]
-    for blk in params["blocks"]:
-        x = _block(x, blk, cfg, mesh=mesh, train=train)
-    return (x @ params["embed"].T).astype(jnp.float32)
+    return _forward_impl(params, tokens, cfg, mesh, train)[0]
 
 
 def generate(params: dict, prompt: jax.Array, cfg: TransformerConfig,
@@ -361,7 +400,7 @@ def _generate_impl(params, prompt, cfg, n_new, key, temperature):
         x = x + params["pos"][:t0]
     caches = []
     for blk in params["blocks"]:
-        x, k, v = _block(x, blk, cfg, return_kv=True)
+        x, _aux, k, v = _block(x, blk, cfg, return_kv=True)
         kc = jnp.zeros((b, cfg.kv_heads, cfg.max_len, cfg.d_head), k.dtype)
         vc = jnp.zeros_like(kc)
         caches.append((kc.at[:, :, :t0].set(k), vc.at[:, :, :t0].set(v)))
@@ -400,13 +439,17 @@ def _generate_impl(params, prompt, cfg, n_new, key, temperature):
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             mesh=None) -> jax.Array:
-    """Next-token cross-entropy (mean). Dispatches attention with
-    train=True: the loss exists to be differentiated, so block
+    """Next-token cross-entropy (mean), plus moe_aux_weight x the mean
+    Switch load-balancing loss for MoE configs. Dispatches attention
+    with train=True: the loss exists to be differentiated, so block
     geometry must come from the fwd+grad sweep (see flash_attention's
     train parameter)."""
-    logits = forward(params, tokens, cfg, mesh, train=True)
+    logits, aux = _forward_impl(params, tokens, cfg, mesh, True)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    loss = jnp.mean(nll)
+    if cfg.n_experts is not None:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss

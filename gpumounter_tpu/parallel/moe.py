@@ -33,12 +33,15 @@ def init_moe_params(key: jax.Array, n_experts: int, d_model: int,
     }
 
 
-def moe_param_specs() -> dict:
-    """Experts over the "expert" axis; router replicated."""
+def moe_param_specs(axis: str = "expert") -> dict:
+    """Expert dim sharded over `axis`; router replicated. The
+    standalone MoE step uses a dedicated "expert" mesh axis; the
+    flagship probe rides the tensor-parallel "model" axis instead
+    (parallel/train_step.param_specs)."""
     return {
         "router": P(None, None),
-        "w1": P("expert", None, None),
-        "w2": P("expert", None, None),
+        "w1": P(axis, None, None),
+        "w2": P(axis, None, None),
     }
 
 

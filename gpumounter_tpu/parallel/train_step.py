@@ -26,17 +26,24 @@ from gpumounter_tpu.models.probe import TransformerConfig, loss_fn
 def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpecs: tensor-parallel over the "model" axis.
 
-    wqkv/w1 column-split (output dim), wo/w2 row-split (input dim) — the
-    Megatron layout; XLA inserts one reduce per block boundary.
+    Dense blocks: wqkv/w1 column-split (output dim), wo/w2 row-split
+    (input dim) — the Megatron layout; XLA inserts one reduce per block
+    boundary. MoE blocks: the stacked expert weights shard their EXPERT
+    dimension over "model" (expert parallelism riding the same
+    ICI-local axis), router replicated.
     """
     block = {
         "wqkv": P(None, "model"),
         "wo": P("model", None),
-        "w1": P(None, "model"),
-        "w2": P("model", None),
         "ln1": P(None),
         "ln2": P(None),
     }
+    if cfg.n_experts is None:
+        block["w1"] = P(None, "model")
+        block["w2"] = P("model", None)
+    else:
+        from gpumounter_tpu.parallel.moe import moe_param_specs
+        block.update(moe_param_specs(axis="model"))
     specs = {
         "embed": P(None, None),
         "blocks": [dict(block) for _ in range(cfg.n_layers)],

@@ -1,0 +1,96 @@
+"""Mixture-of-Experts composed INTO the flagship probe.
+
+parallel/moe.py's Switch-style routed FFN becomes every block's FFN
+when TransformerConfig.n_experts is set: stacked expert weights shard
+their expert dim over the "model" mesh axis (expert parallelism riding
+the tp axis) while attention stays head-sharded through the flash
+kernel — dense and MoE blocks share everything up to the FFN.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpumounter_tpu.models.probe import (
+    TransformerConfig, generate, init_params, loss_fn)
+from gpumounter_tpu.parallel.mesh import build_mesh
+from gpumounter_tpu.parallel.train_step import make_train_step, shard_params
+
+
+@pytest.fixture(autouse=True)
+def _cpu_default():
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+
+
+def _moe_cfg(**kw):
+    base = dict(n_layers=2, d_model=64, n_heads=16, d_ff=128, max_len=32,
+                n_kv_heads=8, window=8, rope=True, attn_backend="pallas",
+                n_experts=4)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="n_experts"):
+        TransformerConfig(n_experts=1)
+
+
+def test_moe_blocks_carry_router_and_stacked_experts():
+    cfg = _moe_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    blk = params["blocks"][0]
+    assert blk["router"].shape == (cfg.d_model, cfg.n_experts)
+    assert blk["w1"].shape == (cfg.n_experts, cfg.d_model, cfg.d_ff)
+    assert blk["w2"].shape == (cfg.n_experts, cfg.d_ff, cfg.d_model)
+
+
+def test_sharded_moe_step_trains_through_kernel():
+    devices = jax.devices("cpu")
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    mesh = build_mesh(devices[:8])
+    cfg = _moe_cfg(n_experts=8)
+    params = shard_params(init_params(cfg, jax.random.key(0)), mesh, cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 256)
+    step = make_train_step(mesh, cfg, lr=0.5)
+    params, loss0 = step(params, tokens)
+    loss = loss0
+    for _ in range(29):
+        params, loss = step(params, tokens)
+    assert jnp.isfinite(loss0) and jnp.isfinite(loss)
+    assert float(loss) < float(loss0) - 0.3
+
+
+def test_aux_loss_contributes():
+    cfg = _moe_cfg()
+    cfg0 = dataclasses.replace(cfg, moe_aux_weight=0.0)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 256)
+    with_aux = float(loss_fn(params, tokens, cfg))
+    without = float(loss_fn(params, tokens, cfg0))
+    # Switch aux is ~1.0 for near-uniform routing at init; weight 0.01.
+    assert with_aux > without
+    assert abs((with_aux - without) - cfg.moe_aux_weight) < 0.05
+
+
+def test_moe_generate_prefill_decode_consistent():
+    """Cached decode must produce the same tokens as full recompute —
+    the MoE FFN runs identically in prefill and per-token decode."""
+    from gpumounter_tpu.models.probe import forward
+
+    cfg = _moe_cfg(n_heads=4, n_kv_heads=2, d_model=64)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(2), (2, 6), 0, 256)
+    out = generate(params, prompt, cfg, 6)
+    assert out.shape == (2, 12)
+    # greedy self-consistency: feeding the generated prefix back in
+    # reproduces each next token
+    for t in range(6, 12):  # through the LAST generated token
+        logits = forward(params, out[:, :t], cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        np.testing.assert_array_equal(np.asarray(nxt),
+                                      np.asarray(out[:, t]))
