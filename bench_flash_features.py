@@ -167,7 +167,22 @@ def bench_window(out):
 def bench_decode(out):
     """Dynamic-length decode with a ROOFLINE: decode is memory-bound,
     so ms alone says nothing — report achieved HBM GB/s vs chip peak,
-    and a fused-XLA static-length baseline at the same shapes."""
+    and a fused-XLA static-length baseline at the same shapes.
+
+    Timing scheme (r04): the r03 scan-chain approach is unusable — any
+    XLA-loop-wrapped flash_decode now hangs the remote compile service
+    until the connection drops (reproduced repeatedly: a 5-iteration
+    scan, a traced-bound fori_loop, a decode+add fusion, and a B=16
+    variant all hang; ONLY the bare B=4 flash_decode reliably compiles,
+    ~80 s). So the chain lives on the HOST: N dependent iterations of
+    two dispatches each — the bare once-compiled decode step plus a
+    tiny mix op re-injecting the rep-specific q (attention is a
+    contracting map; without re-injection long chains converge and
+    defeat the probe-distinctness check) — timed to a fetched probe,
+    delta = (T(3N) - T(N)) / 2N. The measured two-dispatch floor (the
+    same chain around trivial ops) is recorded alongside every row:
+    ms_per_step INCLUDES it, so the roofline numbers are lower bounds
+    on kernel bandwidth."""
     from gpumounter_tpu.ops.flash_decode import flash_decode
 
     b, h, d, l_q, l_max = 4, 8, 128, 8, 32768
@@ -178,102 +193,99 @@ def bench_decode(out):
     qq = [jax.device_put(q8 + jnp.bfloat16(4e-3 * i))
           for i in range(REPS + 1)]
 
-    def decode_chained(step_fn, iters):
-        def run(q, n):
-            def body(carry, _):
-                o = step_fn(carry, n)
-                # Re-inject the rep-specific q each step: attention is a
-                # contracting map (outputs converge toward a V-average
-                # whatever the query), so a plain out->carry chain would
-                # erase the per-rep input differences the probe
-                # distinctness check depends on.
-                return (o + 0.25 * q).astype(carry.dtype), ()
-            final, _ = jax.lax.scan(body, q, None, length=iters)
-            return final
-        return jax.jit(run)
-
-    # Decode steps are ~0.05-0.8 ms; the standard 10/30 chains put the
-    # delta below this tunnel's RTT jitter, so decode uses longer chains
-    # (50/150: delta spans 100 steps).
     DEC_ITERS = 5 * ITERS
     out["iters_chained_decode"] = DEC_ITERS
 
-    def t_decode(fn, n):
-        """Same discipline as _min_time: distinct q per rep, output
-        probe fetched, duplicate probes flag a cache-served rep."""
-        np.asarray(fn(qq[-1], jnp.int32(n))[0, 0, 0, :4])
-        best = float("inf")
+    mix = jax.jit(lambda o, q0: (o + 0.25 * q0).astype(o.dtype))
+
+    def host_chain_time(step, q0, n, iters):
+        """One timed host chain: iters x (step; mix) dependent
+        dispatches, window closed by an output-probe fetch."""
+        t0 = time.perf_counter()
+        c = q0
+        for _ in range(iters):
+            c = mix(step(c, n), q0)
+        probe = np.asarray(c[(0,) * (c.ndim - 1)][:4])  # any rank
+        return time.perf_counter() - t0, probe.tobytes()
+
+    def delta_per_step(step, n):
+        """Min-over-reps of short and long host chains; distinct q per
+        rep (re-injected every step), duplicate probes flag caching."""
+        mix(step(qq[-1], n), qq[-1])  # compile both
+        best_s = best_l = float("inf")
         probes = []
         for i in range(REPS):
-            t0 = time.perf_counter()
-            probe = np.asarray(fn(qq[i], jnp.int32(n))[0, 0, 0, :4])
-            best = min(best, time.perf_counter() - t0)
-            probes.append(probe.tobytes())
-        return best, len(set(probes)) < len(probes)
+            t_s, p_s = host_chain_time(step, qq[i], n, DEC_ITERS)
+            t_l, p_l = host_chain_time(step, qq[i], n, 3 * DEC_ITERS)
+            best_s, best_l = min(best_s, t_s), min(best_l, t_l)
+            probes += [p_s, p_l]
+        ms = (best_l - best_s) / (2 * DEC_ITERS) * 1000.0
+        cached = len(set(probes)) < len(probes)
+        return round(ms, 3), bool(ms <= 0 or cached)
+
+    # Dispatch-floor calibration: the same two-dispatch host chain
+    # around trivial ops — what a do-nothing (step; mix) pair costs.
+    triv = jax.jit(lambda a: a * 1.000001 + 1e-7)
+    floor_ms, _inv = delta_per_step(lambda c, n: triv(c), None)
+    out["decode_dispatch_floor_ms"] = floor_ms
+
+    flash_step = jax.jit(
+        lambda c, n: flash_decode(c, k, v_cache, n))
 
     def roofline(ms, n):
         # Per step the kernel must stream the VALID K and V regions
         # (b*h*n*d bf16 each); q/out are ~n/l_q smaller — counted too.
         bytes_moved = (2 * b * h * n * d + 2 * b * h * l_q * d) * 2
+        res = {"bytes_per_step": bytes_moved}
         if ms and ms > 0:
             gbps = bytes_moved / (ms / 1e3) / 1e9
-            return {"bytes_per_step": bytes_moved,
-                    "achieved_gbps": round(gbps, 1),
-                    "hbm_frac": round(gbps / V5E_HBM_GBPS, 3)}
-        return {"bytes_per_step": bytes_moved}
+            res.update({"achieved_gbps": round(gbps, 1),
+                        "hbm_frac": round(gbps / V5E_HBM_GBPS, 3)})
+        return res
 
     dec = {}
-    flash_step = lambda q, n: flash_decode(q, k, v_cache, n)
-    c_short = decode_chained(flash_step, DEC_ITERS)
-    c_long = decode_chained(flash_step, 3 * DEC_ITERS)
     for n in (1024, 8192, 32768):
-        (d_s, cs), (d_l, cl) = t_decode(c_short, n), t_decode(c_long, n)
-        ms = (d_l - d_s) / (2 * DEC_ITERS) * 1000.0
-        row = {"ms_per_step": round(ms, 3),
-               "invalid_timing": bool(ms <= 0 or cs or cl)}
-        if ms <= 0 and not (cs or cl):
-            # The step is faster than this tunnel can resolve by chain
-            # differencing; the chained time / iters still bounds it
-            # from above (it includes the amortized RTT).
-            row = {"ms_per_step": None, "below_noise_floor": True,
-                   "upper_bound_ms_per_step": round(
-                       d_s / DEC_ITERS * 1000.0, 3),
-                   "invalid_timing": False}
-        row.update(roofline(row.get("ms_per_step"), n))
+        n_op = jnp.int32(n)
+        ms, invalid = delta_per_step(flash_step, n_op)
+        row = {"ms_per_step": ms, "invalid_timing": invalid,
+               "includes_dispatch_floor_ms": floor_ms}
+        row.update(roofline(ms if not invalid else None, n))
 
         # Fused-XLA baseline at the SAME length, statically sliced (one
         # compile PER length — the dynamic-length kernel needs one
         # total; per-step speed is the fair comparison, compile count
         # is the kernel's structural win).
-        def xla_step(q_, n_=n):
+        def xla_step_fn(n_=n):
             ks, vs = k[:, :, :n_], v_cache[:, :, :n_]
-            s = jnp.einsum("bhqd,bhkd->bhqk", q_, ks).astype(jnp.float32)
-            s = s / (d ** 0.5)
-            q_pos = (n_ - l_q) + jnp.arange(l_q)[:, None]
-            mask = jnp.arange(n_)[None, :] <= q_pos
-            s = jnp.where(mask[None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.einsum("bhqk,bhkd->bhqd", p,
-                              vs.astype(jnp.float32)).astype(q_.dtype)
 
-        xs = decode_chained(lambda q_, n_: xla_step(q_), DEC_ITERS)
-        xl = decode_chained(lambda q_, n_: xla_step(q_), 3 * DEC_ITERS)
-        (bx_s, cxs), (bx_l, cxl) = t_decode(xs, n), t_decode(xl, n)
-        msx = (bx_l - bx_s) / (2 * DEC_ITERS) * 1000.0
-        row["xla_static_ms_per_step"] = round(msx, 3)
-        row["xla_static_invalid"] = bool(msx <= 0 or cxs or cxl)
-        if row.get("ms_per_step") and msx > 0:
-            row["speedup_vs_xla_static"] = round(
-                msx / row["ms_per_step"], 2)
+            def f(q_, n_ignored):
+                s = jnp.einsum("bhqd,bhkd->bhqk", q_,
+                               ks).astype(jnp.float32) / (d ** 0.5)
+                q_pos = (n_ - l_q) + jnp.arange(l_q)[:, None]
+                mask = jnp.arange(n_)[None, :] <= q_pos
+                s = jnp.where(mask[None, None], s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                return jnp.einsum("bhqk,bhkd->bhqd", p,
+                                  vs.astype(jnp.float32)).astype(q_.dtype)
+            return jax.jit(f)
+
+        msx, invx = delta_per_step(xla_step_fn(), None)
+        row["xla_static_ms_per_step"] = msx
+        row["xla_static_invalid"] = invx
+        if not invalid and not invx and ms > 0 and msx > 0:
+            row["speedup_vs_xla_static"] = round(msx / ms, 2)
         dec[f"valid_len={n}"] = row
         print(json.dumps({f"valid_len={n}": row}), flush=True)
     dec["roofline_note"] = (
         "decode is memory-bound: bytes_per_step counts the valid K+V "
         "stream plus q/out at bf16; hbm_frac is achieved_gbps over the "
-        f"chip's {V5E_HBM_GBPS} GB/s peak. The xla baseline is sliced "
-        "statically per length (recompiles as the cache grows); "
-        "flash_decode compiles ONCE for all lengths.")
-    out["decode_l_q8_cache32768"] = dec
+        f"chip's {V5E_HBM_GBPS} GB/s peak. ms_per_step is a host-chain "
+        "delta and INCLUDES the recorded per-dispatch floor "
+        "(decode_dispatch_floor_ms), so achieved_gbps is a lower bound "
+        "on kernel bandwidth. The xla baseline is sliced statically "
+        "per length (recompiles as the cache grows); flash_decode "
+        "compiles ONCE for all lengths.")
+    out[f"decode_b{b}_q{l_q}_cache{l_max}"] = dec
 
 
 def bench_shardmap_overhead(out):
