@@ -48,6 +48,13 @@ class TransformerConfig:
     # uses). loss_fn adds moe_aux_weight x the load-balancing loss.
     n_experts: int | None = None
     moe_aux_weight: float = 0.01
+    # How attention parallelizes under a 2-axis mesh: "heads" (the
+    # default dp x tp layout — heads over the second axis, the flash
+    # kernel under shard_map) or "seq" (dp x sp long-context layout —
+    # the SEQUENCE over the second axis, ring attention rotating K/V
+    # chunks with ppermute; params replicated, activation memory per
+    # device O(L / n_shards)).
+    attn_parallel: str = "heads"
 
     def __post_init__(self):
         if self.attn_backend not in ("auto", "pallas", "xla"):
@@ -56,6 +63,14 @@ class TransformerConfig:
         if self.n_experts is not None and self.n_experts < 2:
             raise ValueError(f"n_experts must be >= 2, got "
                              f"{self.n_experts}")
+        if self.attn_parallel not in ("heads", "seq"):
+            raise ValueError(f"attn_parallel must be heads|seq, got "
+                             f"{self.attn_parallel!r}")
+        if self.attn_parallel == "seq" and self.window is not None:
+            raise ValueError(
+                "attn_parallel='seq' does not support sliding windows "
+                "(ring attention has no band skipping across chunks "
+                "yet); use the heads layout for windowed configs")
         if self.d_model % self.n_heads:
             raise ValueError(f"d_model ({self.d_model}) must divide by "
                              f"n_heads ({self.n_heads})")
@@ -143,6 +158,15 @@ def _qkv_heads(x, p, cfg, mesh=None):
     q, k, v = jnp.split(qkv, [cfg.d_model, cfg.d_model + kv_dim], axis=-1)
 
     def heads(a, n):
+        if cfg.attn_parallel == "seq":
+            # dp x sp: the TOKEN axis stays sharded over the second
+            # (sequence) mesh axis through the reshape/transpose; heads
+            # are replicated — ring attention shards L, not H.
+            a = _constrain(a, mesh, ("data", "second", None))
+            a = a.reshape(b, t, n, cfg.d_head)
+            a = _constrain(a, mesh, ("data", "second", None, None))
+            a = a.transpose(0, 2, 1, 3)
+            return _constrain(a, mesh, ("data", None, "second", None))
         # ONE predicate for every constraint in the chain: head-sharded
         # throughout when the heads divide the model axis, otherwise
         # batch-sharded throughout. Mixing (e.g. feature model-sharded
@@ -190,20 +214,21 @@ def _constrain(x, mesh, spec):
     (replicate-then-repartition) those reshapes in the dp x tp
     backward.
 
-    spec uses the SYMBOLIC names "data"/"model", translated to the
+    spec uses the SYMBOLIC names "data"/"model" (alias "second" for the
+    second axis — the sp axis of a dp x sp mesh), translated to the
     mesh's actual first/second axis names here — callers may name their
-    axes anything (e.g. ("dp", "tp"))."""
+    axes anything (e.g. ("dp", "tp") or ("data", "seq"))."""
     if mesh is None:
         return x
     from jax.sharding import NamedSharding, PartitionSpec as P
-    data_ax, model_ax = mesh.axis_names
-    names = {"data": data_ax, "model": model_ax}
+    data_ax, second_ax = mesh.axis_names
+    names = {"data": data_ax, "model": second_ax, "second": second_ax}
     spec = tuple(names[s] if isinstance(s, str) else s for s in spec)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*spec)))
 
 
-def _finish_block(x, attn_heads, p, mesh=None):
+def _finish_block(x, attn_heads, p, cfg, mesh=None):
     """Post-attention half: output projection, residual, FFN.
 
     Returns (x, aux): aux is the MoE load-balancing loss when the block
@@ -211,11 +236,18 @@ def _finish_block(x, attn_heads, p, mesh=None):
     MoE blocks share everything up to the FFN."""
     b, _, t, _ = attn_heads.shape
     merged = attn_heads.transpose(0, 2, 1, 3).reshape(b, t, -1)
-    # Head merge keeps the head axis's "model" sharding on the fused
-    # feature dim; wo is row-split over "model", so the product psums
-    # once and lands data-sharded only.
-    merged = _constrain(merged, mesh, ("data", None, "model"))
-    x = x + _constrain(merged @ p["wo"], mesh, ("data", None, None))
+    if cfg.attn_parallel == "seq":
+        # dp x sp: the token axis keeps its second-axis sharding; the
+        # FFN is purely token-local so everything stays put.
+        merged = _constrain(merged, mesh, ("data", "second", None))
+        x = x + _constrain(merged @ p["wo"], mesh,
+                           ("data", "second", None))
+    else:
+        # Head merge keeps the head axis's "model" sharding on the
+        # fused feature dim; wo is row-split over "model", so the
+        # product psums once and lands data-sharded only.
+        merged = _constrain(merged, mesh, ("data", None, "model"))
+        x = x + _constrain(merged @ p["wo"], mesh, ("data", None, None))
     h = _rmsnorm(x, p["ln2"])
     if "router" in p:
         from gpumounter_tpu.parallel.moe import moe_ffn
@@ -245,6 +277,19 @@ def _attention(q, k, v, cfg, mesh=None, train=False):
     kwargs = dict(causal=True, window=cfg.window, train=train)
     if mesh is None:
         return flash_attention(q, k, v, backend=cfg.attn_backend, **kwargs)
+    if cfg.attn_parallel == "seq":
+        # dp x sp: ring attention over the second (sequence) axis —
+        # K/V chunks rotate with ppermute, activation memory per device
+        # is O(L / n_shards). attn_backend maps onto the ring's inner
+        # body: pallas → the flash kernel per chunk, xla → the einsum
+        # online-softmax body, auto → the ring's own envelope dispatch.
+        from gpumounter_tpu.parallel.ring_attention import ring_attention
+        data_ax, seq_ax = mesh.axis_names
+        # Divisibility was validated once in _forward_impl.
+        impl = {"auto": "auto", "pallas": "flash",
+                "xla": "xla"}[cfg.attn_backend]
+        return ring_attention(q, k, v, mesh, seq_axis=seq_ax,
+                              data_axis=data_ax, causal=True, impl=impl)
     from jax.sharding import PartitionSpec as P
     data_ax, model_ax = mesh.axis_names
     dp, tp = mesh.shape[data_ax], mesh.shape[model_ax]
@@ -276,7 +321,7 @@ def _block(x: jax.Array, p: dict, cfg: TransformerConfig,
     q, k, v = _qkv_heads(x, p, cfg, mesh)
     q, k = _maybe_rope(q, k, cfg, jnp.arange(x.shape[1], dtype=jnp.int32))
     x, aux = _finish_block(x, _attention(q, k, v, cfg, mesh, train),
-                           p, mesh)
+                           p, cfg, mesh)
     if return_kv:
         return x, aux, k, v
     return x, aux
@@ -297,7 +342,7 @@ def _block_decode(x, p, cfg, k_cache, v_cache, cur_len, interpret):
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cur_len - 1, 0))
     out = flash_decode(q, k_cache, v_cache, cur_len, window=cfg.window,
                        interpret=interpret)
-    x, _aux = _finish_block(x, out, p)  # aux is a training-only signal
+    x, _aux = _finish_block(x, out, p, cfg)  # aux: training-only signal
     return x, k_cache, v_cache
 
 
@@ -305,8 +350,19 @@ def _forward_impl(params, tokens, cfg, mesh, train):
     """(logits, mean MoE aux loss) — shared by forward and loss_fn."""
     if mesh is not None and len(mesh.axis_names) != 2:
         raise ValueError(
-            f"forward() expects a 2-axis (data, model) mesh, got axes "
-            f"{mesh.axis_names}")
+            f"forward() expects a 2-axis mesh — (data, model) for the "
+            f"heads layout, (data, seq) for attn_parallel='seq' — got "
+            f"axes {mesh.axis_names}")
+    if mesh is not None and cfg.attn_parallel == "seq":
+        # Validate HERE, before any sharding constraint turns an uneven
+        # split into an opaque pjit divisibility error.
+        data_ax, seq_ax = mesh.axis_names
+        dp, sp = mesh.shape[data_ax], mesh.shape[seq_ax]
+        if tokens.shape[0] % dp or tokens.shape[1] % sp:
+            raise ValueError(
+                f"attn_parallel='seq' needs batch/sequence to split "
+                f"evenly: B={tokens.shape[0]} over {data_ax}={dp}, "
+                f"L={tokens.shape[1]} over {seq_ax}={sp}")
     b, t = tokens.shape
     if t > cfg.max_len:
         raise ValueError(f"sequence length {t} exceeds max_len "
@@ -332,7 +388,9 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     kernel under shard_map (heads over the second/tensor-parallel axis,
     batch over the first/data axis) instead of being pinned to the
     fused XLA path; see _attention. The mesh must have exactly two
-    axes, (data, model)-shaped, in that order — names are free.
+    axes — (data, model)-shaped for the default heads layout, or
+    (data, seq)-shaped when cfg.attn_parallel == "seq" (ring attention
+    over the second axis). Axis names are free; order is fixed.
     """
     return _forward_impl(params, tokens, cfg, mesh, train)[0]
 

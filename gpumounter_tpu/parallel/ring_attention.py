@@ -174,12 +174,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    *, seq_axis: str = "seq", causal: bool = True,
                    scale: float | None = None, impl: str = "auto",
                    block_q: int = 256, block_k: int = 512,
-                   softcap: float | None = None) -> jax.Array:
+                   softcap: float | None = None,
+                   data_axis: str | None = None) -> jax.Array:
     """Sequence-parallel attention over `mesh`'s `seq_axis`.
 
     q, k, v: (batch, heads, seq, head_dim), sharded (or shardable) with
     the sequence dimension split over `seq_axis`. Returns same shape/
     sharding. Use inside jit; XLA emits ppermute ICI transfers.
+
+    data_axis: name of a mesh axis the BATCH dim is sharded over (the
+    dp x sp training step). Without it, batch-sharded operands entering
+    the shard_map would be gathered; the ring itself still runs only
+    over seq_axis — batch shards are independent.
 
     impl: "flash" runs the Pallas flash kernel per ring chunk (lse-based
     cross-chunk combine, O(chunk·D) memory, causal chunks skipped by
@@ -203,7 +209,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         # clear message, not deep inside shard_map with a shape error.
         raise ValueError(f"q heads ({q.shape[1]}) must be a multiple of "
                          f"kv heads ({k.shape[1]})")
-    spec = P(None, None, seq_axis, None)
+    spec = P(data_axis, None, seq_axis, None)
     on_tpu = any(dev.platform == "tpu" for dev in mesh.devices.flat)
     # Per-device chunk geometry, shared by auto dispatch and the
     # forced-flash guard (ONE source of truth for the alignment rule).

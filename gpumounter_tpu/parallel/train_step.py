@@ -1,15 +1,22 @@
-"""Sharded training step over a named mesh (dp × tp).
+"""Sharded training step over a named 2-axis mesh.
 
 Used by the multi-chip dry-run and by post-hot-mount validation: after chips
 appear, the tenant rebuilds the mesh and resumes stepping with the same
-functions. Shardings: batch over "data"; attention/MLP weights over "model"
-(column/row split so XLA emits a single psum per block on ICI); everything
-jit-compiled with explicit NamedSharding in/out specs.
+functions. Two layouts, selected by TransformerConfig.attn_parallel:
 
-The mesh is threaded into loss_fn, so attention executes the Pallas
-flash kernel under a shard_map nested inside the GSPMD step (heads over
-"model", batch over "data" — models/probe._attention) forward AND
-backward, rather than pinning the fused XLA path.
+  * "heads" (dp x tp, mesh axes ("data", "model")): batch over "data";
+    attention/MLP weights over "model" (Megatron column/row split so
+    XLA emits a single psum per block on ICI); MoE expert weights shard
+    their expert dim over "model". The mesh is threaded into loss_fn,
+    so attention executes the Pallas flash kernel under a shard_map
+    nested inside the GSPMD step (models/probe._attention) forward AND
+    backward, rather than pinning the fused XLA path.
+  * "seq" (dp x sp, any axis names): long context — parameters
+    replicated, TOKENS sharded over the second axis, and every block's
+    attention is parallel/ring_attention (K/V chunks rotating on
+    ppermute), so per-device activation memory is O(L / n_shards).
+
+Everything jit-compiled with explicit NamedSharding in/out specs.
 """
 
 from __future__ import annotations
@@ -50,7 +57,23 @@ def param_specs(cfg: TransformerConfig) -> dict:
     }
     if not cfg.rope:  # rope configs carry no learned position table
         specs["pos"] = P(None, None)
+    if cfg.attn_parallel == "seq":
+        # dp x sp: parameters fully replicated — the parallelism lives
+        # in the activations (tokens over the sequence axis) and ring
+        # attention's rotating K/V chunks, so the mesh's second axis
+        # never partitions a weight.
+        specs = jax.tree.map(lambda s: P(), specs,
+                             is_leaf=lambda x: isinstance(x, P))
     return specs
+
+
+def _data_spec(mesh: Mesh, cfg: TransformerConfig) -> P:
+    """Sharding for the (batch, seq) token batch: batch over the first
+    axis always; seq over the second axis in the dp x sp layout."""
+    first, second = mesh.axis_names
+    if cfg.attn_parallel == "seq":
+        return P(first, second)
+    return P(first, None)
 
 
 def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
@@ -66,7 +89,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
     specs = param_specs(cfg)
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                                    is_leaf=lambda x: isinstance(x, P))
-    data_sharding = NamedSharding(mesh, P("data", None))
+    data_sharding = NamedSharding(mesh, _data_spec(mesh, cfg))
 
     def step(params, tokens):
         loss, grads = jax.value_and_grad(
@@ -107,7 +130,7 @@ def make_train_step_optax(mesh: Mesh, cfg: TransformerConfig, tx):
     specs = param_specs(cfg)
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                                    is_leaf=lambda x: isinstance(x, P))
-    data_sharding = NamedSharding(mesh, P("data", None))
+    data_sharding = NamedSharding(mesh, _data_spec(mesh, cfg))
     param_treedef = jax.tree.structure(param_shardings)
 
     def _is_param_tree(x):
