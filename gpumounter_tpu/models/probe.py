@@ -495,6 +495,15 @@ def _generate_impl(params, prompt, cfg, n_new, key, temperature):
     return jnp.concatenate([prompt, jnp.moveaxis(toks, 0, 1)], axis=1)
 
 
+def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token negative log-likelihood: logits (B, T, V)
+    against tokens (B, T), shifted by one. ONE implementation shared by
+    every training loss (loss_fn here, the pipeline step)."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, tokens[:, 1:][..., None], axis=-1)
+    return jnp.mean(nll)
+
+
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             mesh=None) -> jax.Array:
     """Next-token cross-entropy (mean), plus moe_aux_weight x the mean
@@ -503,11 +512,7 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     geometry must come from the fwd+grad sweep (see flash_attention's
     train parameter)."""
     logits, aux = _forward_impl(params, tokens, cfg, mesh, True)
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    loss = jnp.mean(nll)
+    loss = next_token_nll(logits, tokens)
     if cfg.n_experts is not None:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

@@ -26,19 +26,19 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
     """Per-device body under shard_map.
 
     stage_params: this stage's params, leading axis stripped (block of 1).
-    x_micro: (M, mb, d) — full microbatch buffer, replicated.
-    Returns (M, mb, d) outputs, replicated (psum at the end).
+    x_micro: (M, mb, *rest) — full microbatch buffer, replicated.
+    Returns (M, mb, *rest) outputs, replicated (psum at the end).
     """
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
     # shard_map delivers this stage's block with the stage axis kept
     # (leading size 1); strip it so stage_fn sees plain per-stage params.
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
-    n_micro, mb, d = x_micro.shape
+    n_micro = x_micro.shape[0]
     perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     out_buf = jnp.zeros_like(x_micro, dtype=jnp.float32)
-    recv = jnp.zeros((mb, d), x_micro.dtype)
+    recv = jnp.zeros(x_micro.shape[1:], x_micro.dtype)
 
     def tick(t, carry):
         recv, out_buf = carry
@@ -73,16 +73,20 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
 
 def pipeline_apply(stage_params, x: jax.Array, mesh: Mesh, stage_fn,
                    *, n_micro: int, pipe_axis: str = "pipe") -> jax.Array:
-    """Run x (B, d) through P pipeline stages with M microbatches.
+    """Run x (B, *rest) through P pipeline stages with M microbatches
+    split along the batch axis.
 
     stage_params: pytree whose leaves have a leading stage axis of size P,
-    sharded over `pipe_axis`. stage_fn(params_for_stage, x_mb) -> y_mb.
-    B must divide by n_micro.
+    sharded over `pipe_axis`. stage_fn(params_for_stage, x_mb) -> y_mb
+    (same shape). B must divide by n_micro. Differentiable: the tick
+    loop has static bounds (lowers to scan) and the stage rotation is a
+    ppermute, so jax.grad of a loss on the output back-propagates
+    through the whole schedule — make_pipeline_train_step relies on it.
     """
-    b, d = x.shape
+    b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
-    x_micro = x.reshape(n_micro, b // n_micro, d)
+    x_micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
 
     body = partial(_pipeline_local, stage_fn=stage_fn, axis_name=pipe_axis)
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
@@ -92,7 +96,7 @@ def pipeline_apply(stage_params, x: jax.Array, mesh: Mesh, stage_fn,
         out_specs=P(),
         check_vma=False)
     y_micro = fn(stage_params, x_micro)
-    return y_micro.reshape(b, d)
+    return y_micro.reshape(x.shape)
 
 
 def shard_stage_params(stage_params, mesh: Mesh, pipe_axis: str = "pipe"):

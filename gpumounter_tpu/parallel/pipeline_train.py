@@ -1,0 +1,122 @@
+"""Pipeline-parallel (pp) training of the flagship probe.
+
+GPipe over the probe's transformer blocks: a 1-axis ("pipe",) mesh of P
+devices, each owning n_layers / P consecutive blocks (stage-stacked
+parameters sharded over the axis); activations move stage-to-stage on
+ppermute inside parallel/pipeline.pipeline_apply's microbatch schedule,
+and the whole thing differentiates — the tick loop has static bounds —
+so one jitted step does forward, backward, and the SGD update.
+
+Embedding and the logits matmul live OUTSIDE the pipeline (they are
+token-local and tied to one table; only the block stack is staged).
+Inside a stage the blocks run exactly models/probe._block with
+mesh=None — which means the flash-attention kernel dispatches per the
+committed train table INSIDE the pipeline's shard_map, the same
+kernel-under-shard_map recipe as the dp x tp layout.
+
+Reference note: GPUMounter has no compute stack at all (SURVEY.md §2b);
+this completes the flagship's parallelism inventory — dp, tp, sp
+(ring), ep (MoE), and pp now all drive the same probe model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gpumounter_tpu.models.probe import (
+    TransformerConfig, _block, next_token_nll)
+from gpumounter_tpu.parallel.pipeline import (
+    pipeline_apply, shard_stage_params)
+from gpumounter_tpu.parallel.train_step import sgd_update
+
+
+def to_pipeline_params(params: dict, n_stages: int) -> dict:
+    """Regroup init_params() output for a P-stage pipeline: the block
+    list becomes stage-stacked leaves (P, L/P, ...); embed (and pos)
+    stay as-is."""
+    blocks = params["blocks"]
+    if len(blocks) % n_stages:
+        raise ValueError(f"n_layers ({len(blocks)}) must divide by "
+                         f"n_stages ({n_stages})")
+    per = len(blocks) // n_stages
+    stages = [
+        jax.tree.map(lambda *xs: jnp.stack(xs),
+                     *blocks[s * per:(s + 1) * per])
+        for s in range(n_stages)
+    ]
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["stages"] = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    return out
+
+
+def shard_pipeline_params(params: dict, mesh: Mesh,
+                          pipe_axis: str = "pipe") -> dict:
+    """Stages over the pipe axis; embed/pos replicated."""
+    placed = {k: jax.device_put(v, NamedSharding(mesh, P()))
+              for k, v in params.items() if k != "stages"}
+    placed["stages"] = shard_stage_params(params["stages"], mesh,
+                                          pipe_axis)
+    return placed
+
+
+def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig,
+                             n_micro: int, lr: float = 1e-3,
+                             pipe_axis: str = "pipe"):
+    """step(params, tokens) -> (params, loss) over a ("pipe",) mesh.
+
+    params come from to_pipeline_params(init_params(cfg, key), P).
+    Restrictions: dense FFN only (the MoE aux loss would need
+    cross-stage accumulation the schedule does not carry), and
+    attn_parallel must be "heads" (each stage attends its full
+    sequence locally; combine pp with sp/tp via nested meshes later).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide by "
+                         f"pipeline stages ({n_stages})")
+    if cfg.n_experts is not None:
+        raise ValueError("pipeline training supports dense FFN only "
+                         "(MoE aux loss is not carried across stages)")
+    if cfg.attn_parallel != "heads":
+        raise ValueError("pipeline training requires "
+                         "attn_parallel='heads'")
+    per = cfg.n_layers // n_stages
+
+    def stage_fn(stage_params, x):
+        for i in range(per):
+            blk = jax.tree.map(lambda a, i=i: a[i], stage_params)
+            # mesh=None: inside the pipeline's shard_map every stage is
+            # a single device — the kernel dispatches directly.
+            x, _aux = _block(x, blk, cfg)
+        return x
+
+    def loss_fn(params, tokens):
+        t = tokens.shape[1]
+        x = params["embed"][tokens]
+        if not cfg.rope:
+            x = x + params["pos"][:t]
+        x = pipeline_apply(params["stages"], x, mesh, stage_fn,
+                           n_micro=n_micro, pipe_axis=pipe_axis)
+        logits = (x @ params["embed"].T).astype(jnp.float32)
+        return next_token_nll(logits, tokens)
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        return sgd_update(params, grads, lr), loss
+
+    # The param structure is fully determined by cfg (pos exists iff
+    # not rope; one stacked block dict per stage), so the shardings —
+    # and the jit — are built eagerly.
+    stage_sharding = NamedSharding(mesh, P(pipe_axis))
+    repl = NamedSharding(mesh, P())
+    from gpumounter_tpu.models.probe import init_params
+    template = jax.eval_shape(
+        lambda: to_pipeline_params(
+            init_params(cfg, jax.random.key(0)), n_stages))
+    shardings = {k: (jax.tree.map(lambda _: stage_sharding, v)
+                     if k == "stages" else repl)
+                 for k, v in template.items()}
+    return jax.jit(step, in_shardings=(shardings, repl),
+                   out_shardings=(shardings, repl))

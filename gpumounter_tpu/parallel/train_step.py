@@ -84,6 +84,15 @@ def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
         is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"))
 
 
+def sgd_update(params, grads, lr: float):
+    """fp32 SGD update cast back to each param's dtype — the one
+    update rule shared by every hand-rolled step (here and the
+    pipeline step)."""
+    return jax.tree.map(
+        lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+                      ).astype(p.dtype), params, grads)
+
+
 def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
     """Returns step(params, tokens) -> (params, loss), jitted over the mesh."""
     specs = param_specs(cfg)
@@ -94,10 +103,7 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-3):
     def step(params, tokens):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, tokens, cfg, mesh))(params)
-        new_params = jax.tree.map(
-            lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)
-                          ).astype(p.dtype), params, grads)
-        return new_params, loss
+        return sgd_update(params, grads, lr), loss
 
     return jax.jit(
         step,
