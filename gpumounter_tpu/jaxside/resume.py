@@ -71,13 +71,17 @@ class HotResumable:
         pod restarts around a slice attach).
 
         Properties orbax alone does not give us and this layout does:
-          * EXACT pytree structure round-trip — orbax rewrites nested
+          * pytree structure round-trip — orbax rewrites nested
             tuples to lists and namedtuples (optax states!) to dicts,
             so we store the flattened leaves through orbax and the tree
             STRUCTURE as a JSON skeleton alongside (structure.json —
             not a pickle: unpickling attacker-writable checkpoint dirs
             would execute arbitrary code, and pickled treedefs couple
-            the file to exact library versions);
+            the file to exact library versions). Two restrictions on
+            that round-trip: dict keys must be str (save() raises
+            otherwise), and dicts come back in sorted-key order — key
+            *insertion* order is not preserved (identical under
+            jax.tree operations, which sort keys anyway);
           * crash-safe OVERWRITE — orbax's force=True rmtree()s the
             existing checkpoint before writing the new one, so a
             preemption mid-save would leave nothing. Here every save
@@ -145,16 +149,65 @@ class HotResumable:
     @classmethod
     def load(cls, path: str) -> "HotResumable":
         """Inverse of save(); restore() then puts the state on whatever
-        mesh the (possibly different) process has built."""
+        mesh the (possibly different) process has built.
+
+        Honors the reader contract save() documents: if the version
+        LATEST named is swept by a concurrent save between reading the
+        pointer and reading the files, re-read LATEST and retry. The
+        loop converges on the stamp: it retries only while each failed
+        attempt resolved a DIFFERENT version than the previous one (the
+        writer moved the pointer under us); an unchanged stamp means
+        the files are genuinely missing/corrupt, and the first error
+        surfaces. A bounded attempt cap guards the pathological case of
+        a writer outracing a slow reader forever.
+        """
+        import os
+
+        path = os.path.abspath(path)
+        last_stamp = None
+        first_err = None
+        for _ in range(8):
+            with open(os.path.join(path, "LATEST")) as f:
+                stamp = f.read().strip()
+            if first_err is not None and stamp == last_stamp:
+                raise first_err
+            last_stamp = stamp
+            try:
+                return cls._load_once(path, stamp)
+            except FileNotFoundError as err:
+                # Version fully swept between pointer read and file read.
+                first_err = first_err or err
+            except ValueError as err:
+                # A PARTIALLY swept version (rmtree deleted the OCDBT
+                # manifest but not yet the zarr metadata) surfaces from
+                # orbax/tensorstore as ValueError("NOT_FOUND: ...") —
+                # only that shape is racy; every other ValueError
+                # (legacy format, forged structure.json) is
+                # deterministic and re-restoring the leaves would just
+                # double the failure-path I/O.
+                if "NOT_FOUND" not in str(err):
+                    raise
+                first_err = first_err or err
+        raise first_err
+
+    @classmethod
+    def _load_once(cls, path: str, stamp: str) -> "HotResumable":
         import json
         import os
 
         import orbax.checkpoint as ocp
 
-        path = os.path.abspath(path)
-        with open(os.path.join(path, "LATEST")) as f:
-            stamp = f.read().strip()
         target = os.path.join(path, stamp)
+        if (not os.path.exists(os.path.join(target, "structure.json"))
+                and os.path.exists(os.path.join(target, "treedef.pkl"))):
+            # Pre-r04 layout pickled the jax treedef. Per the current
+            # trust model (checkpoint dirs may be attacker-writable) we
+            # never unpickle it — fail with an actionable message instead
+            # of a bare FileNotFoundError on structure.json.
+            raise ValueError(
+                f"checkpoint {target} is in the legacy treedef.pkl "
+                f"format; load it with the release that wrote it and "
+                f"re-save to migrate (this loader never unpickles)")
         leaves = ocp.PyTreeCheckpointer().restore(
             os.path.join(target, "leaves"))
         with open(os.path.join(target, "structure.json")) as f:

@@ -89,7 +89,11 @@ def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig,
             blk = jax.tree.map(lambda a, i=i: a[i], stage_params)
             # mesh=None: inside the pipeline's shard_map every stage is
             # a single device — the kernel dispatches directly.
-            x, _aux = _block(x, blk, cfg)
+            # train=True: this call is differentiated (value_and_grad in
+            # step), so dispatch must pick fwd+bwd-valid geometries from
+            # _TRAIN_TABLE; some fwd-only _SWEEP_TABLE winners have no
+            # compiling backward grid on real TPU.
+            x, _aux = _block(x, blk, cfg, train=True)
         return x
 
     def loss_fn(params, tokens):

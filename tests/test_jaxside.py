@@ -275,3 +275,107 @@ def test_checkpoint_refuses_untrusted_namedtuple(tmp_path):
     sj.write_text(json.dumps({"t": "tuple", "items": [evil, skel]}))
     with pytest.raises(ValueError, match="trusted"):
         HotResumable.load(str(ckpt))
+
+
+def test_checkpoint_legacy_treedef_pkl_clear_error(tmp_path):
+    """A pre-r04 checkpoint (pickled treedef, no structure.json) must
+    fail with an actionable 'legacy format' message — and must NOT be
+    unpickled (ADVICE r4: a bare FileNotFoundError left the operator
+    guessing; unpickling would violate the trust model)."""
+    ckpt = tmp_path / "ckpt"
+    legacy = ckpt / "v-legacy00"
+    legacy.mkdir(parents=True)
+    # A live poisoned pickle, handcrafted (protocol-0 GLOBAL+REDUCE:
+    # builtins.exec("raise SystemError(...)")) — pickle.loads of it
+    # raises SystemError, so the ValueError below proves load() never
+    # unpickled the file.
+    import pickle
+
+    payload = (b"cbuiltins\nexec\n"
+               b"(Vraise SystemError('treedef.pkl was unpickled')\n"
+               b"tR.")
+    with pytest.raises(SystemError):  # the payload is really armed
+        pickle.loads(payload)
+    (legacy / "treedef.pkl").write_bytes(payload)
+    (ckpt / "LATEST").write_text("v-legacy00")
+    with pytest.raises(ValueError, match="legacy treedef.pkl"):
+        HotResumable.load(str(ckpt))
+
+
+@pytest.mark.parametrize("race_error", [
+    # Version fully swept before we opened anything:
+    FileNotFoundError("v-swept/structure.json"),
+    # Version PARTIALLY swept (rmtree removed the OCDBT manifest but
+    # not yet the zarr metadata): orbax/tensorstore surfaces this as a
+    # ValueError, not FileNotFoundError (r5 review finding).
+    ValueError('NOT_FOUND: Error opening "zarr" driver'),
+])
+def test_checkpoint_load_retries_after_concurrent_sweep(tmp_path,
+                                                        monkeypatch,
+                                                        race_error):
+    """The documented reader contract: if the version LATEST named is
+    swept by a concurrent save() between pointer read and file read,
+    load() re-reads LATEST and retries once (ADVICE r4: the contract
+    was documented but nothing implemented it)."""
+    from gpumounter_tpu.jaxside import resume as resume_mod
+
+    ckpt = tmp_path / "ckpt"
+    HotResumable.pack({"w": np.float32(3.0)}).save(str(ckpt))
+
+    real_once = HotResumable._load_once.__func__
+    calls = {"n": 0}
+    stamps = []
+
+    def racy_once(cls, path, stamp):
+        calls["n"] += 1
+        stamps.append(stamp)
+        if calls["n"] == 1:
+            # Simulate the sweep AND the writer's new commit: fail this
+            # attempt and move the pointer to a fresh (identical)
+            # version so the retry resolves a different stamp.
+            import shutil
+            old = stamp
+            new = "v-recommit0"
+            shutil.copytree(str(tmp_path / "ckpt" / old),
+                            str(tmp_path / "ckpt" / new))
+            (tmp_path / "ckpt" / "LATEST").write_text(new)
+            raise race_error
+        return real_once(cls, path, stamp)
+
+    monkeypatch.setattr(resume_mod.HotResumable, "_load_once",
+                        classmethod(racy_once))
+    loaded = HotResumable.load(str(ckpt))
+    assert float(loaded.host_state[0]["w"]) == 3.0
+    assert calls["n"] == 2
+    assert stamps[0] != stamps[1]  # the retry resolved the NEW version
+
+    # And when the pointer never moves (no concurrent writer — the
+    # files are genuinely gone), the ORIGINAL error surfaces after one
+    # re-read, with no retry storm.
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    (empty / "LATEST").write_text("v-gone")
+    with pytest.raises(FileNotFoundError):
+        HotResumable.load(str(empty))
+
+
+def test_checkpoint_load_deterministic_valueerror_not_retried(
+        tmp_path, monkeypatch):
+    """Non-racy ValueErrors (forged structure.json, legacy format) are
+    deterministic: load() must raise them immediately, not re-restore
+    every leaf first (r5 review finding)."""
+    from gpumounter_tpu.jaxside import resume as resume_mod
+
+    ckpt = tmp_path / "ckpt"
+    HotResumable.pack({"w": np.float32(1.0)}).save(str(ckpt))
+    calls = {"n": 0}
+
+    def once(cls, path, stamp):
+        calls["n"] += 1
+        raise ValueError("namedtuple evil.mod outside trusted prefixes")
+
+    monkeypatch.setattr(resume_mod.HotResumable, "_load_once",
+                        classmethod(once))
+    with pytest.raises(ValueError, match="trusted"):
+        HotResumable.load(str(ckpt))
+    assert calls["n"] == 1  # no second restore of the leaves

@@ -110,3 +110,34 @@ def test_pipeline_kernel_backend():
     ref = loss_fn(init_params(cfg, jax.random.key(0)), tokens,
                   dataclasses.replace(cfg, attn_backend="xla"))
     assert abs(float(loss) - float(ref)) < 1e-2
+
+
+def test_pipeline_stage_blocks_run_in_train_mode():
+    """The stage body is differentiated (value_and_grad in step), so
+    _block must be called train=True: dispatch then draws fwd+bwd-valid
+    geometries from _TRAIN_TABLE instead of the fwd-only _SWEEP_TABLE,
+    some of whose winners have no compiling backward grid on real TPU
+    (ADVICE r4 medium)."""
+    from unittest import mock
+
+    from gpumounter_tpu.parallel import pipeline_train as pt
+
+    cfg = _cfg()
+    mesh = _mesh(2)
+    seen = []
+    real_block = pt._block
+
+    def spy(x, p, cfg_, mesh=None, train=False, **kw):
+        seen.append(train)
+        return real_block(x, p, cfg_, mesh=mesh, train=train, **kw)
+
+    with mock.patch.object(pt, "_block", spy):
+        # Build (and thus trace) the jitted step: tracing runs stage_fn.
+        step = pt.make_pipeline_train_step(mesh, cfg, n_micro=2)
+        params = pt.to_pipeline_params(
+            init_params(cfg, jax.random.key(0)), 2)
+        params = pt.shard_pipeline_params(params, mesh)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        step(params, tokens)
+    assert seen, "stage_fn never reached _block"
+    assert all(seen), f"_block called with train=False: {seen}"
