@@ -14,12 +14,26 @@ The metric is directly comparable to the north star (BASELINE.json):
 from __future__ import annotations
 
 import os
+import secrets
 import shutil
 import tempfile
 import threading
 import time
 import urllib.parse
 import urllib.request
+
+# The control plane is fail-closed (token auth) by default; the bench
+# provisions a one-shot secret exactly as a deploy would, BEFORE any
+# Config() is built, so the measured path includes the auth check.
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN",
+                      "bench-" + secrets.token_hex(8))
+_AUTH = {"Authorization":
+         f"Bearer {os.environ['TPUMOUNTER_AUTH_TOKEN']}"}
+
+
+def _get(url: str):
+    return urllib.request.urlopen(
+        urllib.request.Request(url, headers=dict(_AUTH)))
 
 
 def run_config1_full_stack(n_chips: int = 4) -> float:
@@ -86,14 +100,14 @@ def run_config1_full_stack(n_chips: int = 4) -> float:
         cluster.add_target_pod("warmup-pod")
         warm_url = (f"{base}/addtpu/namespace/default/pod/warmup-pod/"
                     f"tpu/1/isEntireMount/false")
-        with urllib.request.urlopen(warm_url) as resp:
+        with _get(warm_url) as resp:
             assert resp.status == 200, resp.read()
         warm_devs = service.collector.get_pod_devices("warmup-pod", "default")
         warm_data = urllib.parse.urlencode(
             {"uuids": ",".join(d.uuid for d in warm_devs)}).encode()
         warm_req = urllib.request.Request(
             f"{base}/removetpu/namespace/default/pod/warmup-pod/force/false",
-            data=warm_data, method="POST")
+            data=warm_data, method="POST", headers=dict(_AUTH))
         with urllib.request.urlopen(warm_req) as resp:
             assert resp.status == 200, resp.read()
         assert cluster.free_chip_count() == n_chips
@@ -109,7 +123,7 @@ def run_config1_full_stack(n_chips: int = 4) -> float:
             t0 = time.monotonic()
             url = (f"{base}/addtpu/namespace/default/pod/bench-pod/"
                    f"tpu/{n_chips}/isEntireMount/false")
-            with urllib.request.urlopen(url) as resp:
+            with _get(url) as resp:
                 assert resp.status == 200, resp.read()
             visible = [n for n in os.listdir(container_dev)
                        if n.startswith("accel")]
@@ -123,7 +137,7 @@ def run_config1_full_stack(n_chips: int = 4) -> float:
             req = urllib.request.Request(
                 f"{base}/removetpu/namespace/default/pod/bench-pod/"
                 f"force/false",
-                data=data, method="POST")
+                data=data, method="POST", headers=dict(_AUTH))
             with urllib.request.urlopen(req) as resp:
                 assert resp.status == 200, resp.read()
             assert cluster.free_chip_count() == n_chips

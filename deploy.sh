@@ -11,7 +11,21 @@ MANIFESTS=(
   deploy/service.yaml
 )
 
+ensure_auth_secret() {
+  # Per-deploy control-plane shared secret (fail-closed: daemons refuse
+  # to start without it). Generated once; reuse on redeploy so a rolling
+  # restart doesn't invalidate operator-held tokens.
+  if ! kubectl -n kube-system get secret tpumounter-auth >/dev/null 2>&1; then
+    kubectl -n kube-system create secret generic tpumounter-auth \
+      --from-literal=token="$(openssl rand -hex 32)"
+    echo "created Secret/tpumounter-auth (kube-system)"
+  fi
+  echo "control-plane token (for the CLI / curl):"
+  echo "  kubectl -n kube-system get secret tpumounter-auth -o jsonpath='{.data.token}' | base64 -d"
+}
+
 deploy() {
+  ensure_auth_secret
   for m in "${MANIFESTS[@]}"; do kubectl apply -f "$m"; done
   echo "tpumounter deployed. Label TPU nodes to opt in:"
   echo "  kubectl label node <node> tpu-mounter-enable=enable"
@@ -21,6 +35,7 @@ uninstall() {
   for ((i=${#MANIFESTS[@]}-1; i>=0; i--)); do
     kubectl delete -f "${MANIFESTS[$i]}" --ignore-not-found
   done
+  kubectl -n kube-system delete secret tpumounter-auth --ignore-not-found
 }
 
 case "${1:-}" in

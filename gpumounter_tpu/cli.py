@@ -127,9 +127,12 @@ def cmd_unmount(args) -> int:
     return rc
 
 
-def _http(method: str, url: str, form: dict | None = None) -> tuple[int, str]:
+def _http(method: str, url: str, form: dict | None = None,
+          token: str | None = None) -> tuple[int, str]:
     data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
     req = urllib.request.Request(url, data=data, method=method)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
     try:
         with urllib.request.urlopen(req) as resp:
             return resp.status, resp.read().decode()
@@ -137,11 +140,28 @@ def _http(method: str, url: str, form: dict | None = None) -> tuple[int, str]:
         return exc.code, exc.read().decode()
 
 
+def _remote_token(args) -> str | None:
+    """--token wins (--token '' forces no credentials); else
+    TPUMOUNTER_AUTH_TOKEN[_FILE] via the config. A broken token file
+    is a one-line error, not a traceback."""
+    explicit = getattr(args, "token", None)
+    if explicit is not None:
+        return explicit or None
+    from gpumounter_tpu.config import get_config
+    from gpumounter_tpu.utils.auth import AuthConfigError, resolve_token
+    try:
+        return resolve_token(get_config())
+    except AuthConfigError as exc:
+        print(f"auth: {exc} (pass --token, or --token '' to send none)",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
 def cmd_add(args) -> int:
     url = (f"{args.master.rstrip('/')}/addtpu/namespace/{args.namespace}"
            f"/pod/{args.pod}/tpu/{args.num}"
            f"/isEntireMount/{str(args.entire).lower()}")
-    status, body = _http("GET", url)
+    status, body = _http("GET", url, token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
@@ -149,7 +169,8 @@ def cmd_add(args) -> int:
 def cmd_remove(args) -> int:
     url = (f"{args.master.rstrip('/')}/removetpu/namespace/{args.namespace}"
            f"/pod/{args.pod}/force/{str(args.force).lower()}")
-    status, body = _http("POST", url, form={"uuids": args.uuids})
+    status, body = _http("POST", url, form={"uuids": args.uuids},
+                         token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
@@ -192,6 +213,9 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--pod", required=True)
     a.add_argument("--num", type=int, default=1)
     a.add_argument("--entire", action="store_true")
+    a.add_argument("--token", default=None,
+                   help="master bearer token (default: "
+                        "TPUMOUNTER_AUTH_TOKEN[_FILE])")
     a.set_defaults(fn=cmd_add)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
@@ -200,6 +224,9 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--pod", required=True)
     r.add_argument("--uuids", required=True, help="comma-separated")
     r.add_argument("--force", action="store_true")
+    r.add_argument("--token", default=None,
+                   help="master bearer token (default: "
+                        "TPUMOUNTER_AUTH_TOKEN[_FILE])")
     r.set_defaults(fn=cmd_remove)
     return p
 

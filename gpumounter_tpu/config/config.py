@@ -78,6 +78,16 @@ class Config:
         "WORKER_LABEL_SELECTOR", "app=tpu-mounter-worker"))
     worker_namespace: str = field(default_factory=lambda: _env("WORKER_NAMESPACE", "kube-system"))
 
+    # --- control-plane auth ---
+    # The reference control plane is open to any in-cluster peer
+    # (insecure gRPC dial, cmd/GPUMounter-master/main.go:82; no HTTP
+    # auth) even though force-remove kills tenant PIDs. Default here is
+    # fail-closed: mode "token" requires a shared secret; "insecure" is
+    # an explicit opt-in. See utils/auth.py.
+    auth_mode: str = field(default_factory=lambda: _env("TPUMOUNTER_AUTH", "token"))
+    auth_token: str = field(default_factory=lambda: _env("TPUMOUNTER_AUTH_TOKEN", ""))
+    auth_token_file: str = field(default_factory=lambda: _env("TPUMOUNTER_AUTH_TOKEN_FILE", ""))
+
     # --- logging ---
     log_dir: str = field(default_factory=lambda: _env("TPUMOUNTER_LOG_DIR", "/var/log/tpumounter"))
 

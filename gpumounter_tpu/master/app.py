@@ -231,14 +231,28 @@ def _parse_bool(raw: str, param: str) -> bool:
 class MasterApp:
     """Transport-independent request handling; served by build_http_server."""
 
+    #: routes that stay open without a bearer token: read-only liveness
+    #: and scrape surfaces (k8s probes and Prometheus scrapers often
+    #: cannot attach credentials). Everything else — mount/unmount,
+    #: slice ops, the worker-topology listing — requires auth.
+    UNAUTHENTICATED_ROUTES = frozenset({"index", "healthz", "metrics"})
+
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
                  registry: WorkerRegistry | None = None):
+        from gpumounter_tpu.utils.auth import required_token
         self.cfg = cfg or get_config()
+        # Fail-closed at construction (daemon startup): the reference
+        # serves its HTTP API open to any in-cluster peer even though
+        # removegpu force=true kills tenant PIDs; here serving without a
+        # secret requires the explicit TPUMOUNTER_AUTH=insecure opt-in.
+        self._token = required_token(self.cfg, "master HTTP gateway")
         self.kube = kube
         self.registry = registry or WorkerRegistry(kube, self.cfg)
+        # The default worker client forwards the same per-deploy secret
+        # the worker's gRPC interceptor checks.
         self._client_factory = worker_client_factory or (
-            lambda addr: WorkerClient(addr))
+            lambda addr: WorkerClient(addr, token=self._token))
 
     # --- plumbing ---
 
@@ -251,6 +265,7 @@ class MasterApp:
                     continue
                 match = pattern.match(path)
                 if match:
+                    self._check_auth(name, headers)
                     return getattr(self, f"_route_{name}")(match, body, headers)
             raise _HttpError(404, "404 page not found")
         except _HttpError as exc:
@@ -258,6 +273,16 @@ class MasterApp:
         except Exception as exc:  # noqa: BLE001 — boundary
             logger.exception("unhandled error for %s %s", method, path)
             return 500, "text/plain", f"Service Internal Error: {exc}\n"
+
+    def _check_auth(self, route_name: str, headers: dict[str, str]) -> None:
+        if self._token is None or route_name in self.UNAUTHENTICATED_ROUTES:
+            return
+        from gpumounter_tpu.utils.auth import check_bearer
+        value = next((v for k, v in headers.items()
+                      if k.lower() == "authorization"), None)
+        if not check_bearer(value, self._token):
+            logger.warning("unauthenticated %s request rejected", route_name)
+            raise _HttpError(401, "missing or invalid bearer token")
 
     def _worker_for_pod(self, namespace: str, pod_name: str) -> tuple[str, str]:
         """(worker_address, node_name); raises _HttpError on miss."""

@@ -12,9 +12,22 @@ from gpumounter_tpu.rpc import api
 from gpumounter_tpu.utils.lazy_grpc import grpc
 
 
+_TOKEN_FROM_CONFIG = object()  # sentinel: resolve from global config
+
+
 class WorkerClient:
     def __init__(self, address: str, timeout_s: float = 300.0,
-                 legacy: bool = False):
+                 legacy: bool = False, token=_TOKEN_FROM_CONFIG):
+        """token: the worker's shared bearer secret (utils/auth.py).
+        Default resolves TPUMOUNTER_AUTH_TOKEN[_FILE] from the global
+        config; pass None to send no credentials (rejected by a worker
+        in the default token mode)."""
+        if token is _TOKEN_FROM_CONFIG:
+            from gpumounter_tpu.config import get_config
+            from gpumounter_tpu.utils.auth import resolve_token
+            token = resolve_token(get_config())
+        self._metadata = ((("authorization", f"Bearer {token}"),)
+                          if token else None)
         self.address = address
         self.timeout_s = timeout_s
         self._channel = grpc.insecure_channel(address)
@@ -53,7 +66,8 @@ class WorkerClient:
         """(result, mounted device uuids) — uuids empty unless Success."""
         resp = self._add(api.AddTPURequest(
             pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
-            is_entire_mount=is_entire_mount), timeout=self.timeout_s)
+            is_entire_mount=is_entire_mount), timeout=self.timeout_s,
+            metadata=self._metadata)
         return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
@@ -61,5 +75,6 @@ class WorkerClient:
                    remove_all: bool = False) -> api.RemoveTPUResult:
         resp = self._remove(api.RemoveTPURequest(
             pod_name=pod_name, namespace=namespace, uuids=list(uuids),
-            force=force, remove_all=remove_all), timeout=self.timeout_s)
+            force=force, remove_all=remove_all), timeout=self.timeout_s,
+            metadata=self._metadata)
         return api.RemoveTPUResult(resp.remove_tpu_result)

@@ -1,0 +1,104 @@
+"""Control-plane authentication: a per-deploy shared bearer secret.
+
+The reference ships an UNAUTHENTICATED control plane — the master dials
+the worker over an insecure channel (cmd/GPUMounter-master/main.go:82)
+and its own HTTP API has no credential check at all — yet
+`removegpu .../force/true` kills PIDs inside the target container. Any
+in-cluster peer could kill a tenant's trainer. This module closes that:
+
+  * one shared secret per deploy (a k8s Secret, or a projected SA token
+    file), surfaced via TPUMOUNTER_AUTH_TOKEN / TPUMOUNTER_AUTH_TOKEN_FILE;
+  * worker gRPC requires `authorization: Bearer <secret>` metadata on
+    every mount RPC (the gRPC health service stays open for probes);
+  * master HTTP requires `Authorization: Bearer <secret>` on every
+    state-changing or topology-revealing route (/healthz, /metrics and
+    the index stay open — read-only liveness/scrape surfaces);
+  * running without a secret is an EXPLICIT opt-in
+    (TPUMOUNTER_AUTH=insecure); in the default "token" mode a daemon
+    with no secret refuses to start rather than serving open.
+
+Comparisons are constant-time (hmac.compare_digest).
+"""
+
+from __future__ import annotations
+
+import hmac
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("auth")
+
+AUTH_MODE_TOKEN = "token"
+AUTH_MODE_INSECURE = "insecure"
+
+
+class AuthConfigError(Exception):
+    """The daemon's auth configuration is unusable (fail-closed)."""
+
+
+def resolve_token(cfg) -> str | None:
+    """The effective shared secret, or None when none is configured.
+
+    TPUMOUNTER_AUTH_TOKEN (direct value) wins over
+    TPUMOUNTER_AUTH_TOKEN_FILE (path — the deploy manifests mount the
+    k8s Secret there). File contents are stripped of trailing newlines.
+    """
+    if getattr(cfg, "auth_token", ""):
+        return cfg.auth_token
+    path = getattr(cfg, "auth_token_file", "")
+    if path:
+        try:
+            with open(path, encoding="utf-8") as f:
+                token = f.read().strip()
+        except OSError as exc:
+            raise AuthConfigError(
+                f"auth token file {path!r} unreadable: {exc}") from exc
+        if not token:
+            raise AuthConfigError(f"auth token file {path!r} is empty")
+        return token
+    return None
+
+
+def required_token(cfg, role: str) -> str | None:
+    """Fail-closed startup resolution for a daemon.
+
+    Returns the secret in "token" mode, None in explicit "insecure"
+    mode; raises AuthConfigError when "token" mode has no secret or the
+    mode is unrecognized. `role` only labels log/error messages.
+    """
+    mode = getattr(cfg, "auth_mode", AUTH_MODE_TOKEN) or AUTH_MODE_TOKEN
+    if mode == AUTH_MODE_INSECURE:
+        logger.warning(
+            "%s starting with TPUMOUNTER_AUTH=insecure: the control plane "
+            "will accept requests from ANY in-cluster peer (force-remove "
+            "kills tenant PIDs) — use only in trusted dev environments",
+            role)
+        return None
+    if mode != AUTH_MODE_TOKEN:
+        raise AuthConfigError(
+            f"unknown TPUMOUNTER_AUTH mode {mode!r} "
+            f"(expected {AUTH_MODE_TOKEN!r} or {AUTH_MODE_INSECURE!r})")
+    token = resolve_token(cfg)
+    if not token:
+        raise AuthConfigError(
+            f"{role}: TPUMOUNTER_AUTH=token (the default) but neither "
+            f"TPUMOUNTER_AUTH_TOKEN nor TPUMOUNTER_AUTH_TOKEN_FILE is "
+            f"set; set one (deploy.sh generates a Secret) or opt in to "
+            f"TPUMOUNTER_AUTH=insecure explicitly")
+    return token
+
+
+def check_bearer(header_value: str | None, token: str) -> bool:
+    """Constant-time check of an `Authorization: Bearer <x>` value."""
+    if not header_value:
+        return False
+    scheme, _, presented = header_value.partition(" ")
+    if scheme.lower() != "bearer":
+        return False
+    # Compare as bytes: compare_digest raises TypeError on non-ASCII
+    # str, which would turn a garbage header (latin-1 from http.server)
+    # into a 500 instead of a 401. surrogateescape keeps arbitrary
+    # attacker bytes encodable.
+    return hmac.compare_digest(
+        presented.strip().encode("utf-8", "surrogateescape"),
+        token.encode("utf-8", "surrogateescape"))

@@ -282,6 +282,45 @@ class TpuMountService:
                          "until retry/reap): %s", exc)
 
 
+def _bearer_interceptor(token: str):
+    """Interceptor rejecting any mount RPC lacking
+    `authorization: Bearer <secret>` metadata.
+
+    The reference worker serves open to any in-cluster dialer
+    (cmd/GPUMounter-worker/main.go:24-33 + the master's insecure dial at
+    cmd/GPUMounter-master/main.go:82) — and RemoveGPU force=true kills
+    PIDs inside the target container. The gRPC health service stays
+    unauthenticated (liveness probes carry no credentials).
+
+    Defined inside a function because subclassing grpc.ServerInterceptor
+    at module top would defeat the lazy-grpc import policy
+    (utils/lazy_grpc.py).
+    """
+    from gpumounter_tpu.utils.auth import check_bearer
+
+    def _deny(request, context):
+        context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                      "missing or invalid bearer token "
+                      "(authorization metadata)")
+
+    deny_handler = grpc.unary_unary_rpc_method_handler(
+        _deny, request_deserializer=lambda b: b,
+        response_serializer=lambda m: m)
+
+    class _BearerTokenInterceptor(grpc.ServerInterceptor):
+        def intercept_service(self, continuation, handler_call_details):
+            if handler_call_details.method.startswith("/grpc.health."):
+                return continuation(handler_call_details)
+            meta = dict(handler_call_details.invocation_metadata or ())
+            if check_bearer(meta.get("authorization"), token):
+                return continuation(handler_call_details)
+            logger.warning("unauthenticated %s rejected",
+                           handler_call_details.method)
+            return deny_handler
+
+    return _BearerTokenInterceptor()
+
+
 def build_server(service: TpuMountService, port: int | None = None,
                  address: str | None = None,
                  max_workers: int = 8) -> grpc.Server:
@@ -290,10 +329,19 @@ def build_server(service: TpuMountService, port: int | None = None,
     Reference: worker main registers AddGPUService + RemoveGPUService on
     :1200 (cmd/GPUMounter-worker/main.go:24-33).
 
+    Fail-closed auth: in the default "token" mode this raises
+    AuthConfigError unless a shared secret is configured; serving open
+    requires the explicit TPUMOUNTER_AUTH=insecure opt-in
+    (utils/auth.py).
+
     The actually-bound port (useful with ":0") is exposed as
     `server.bound_port`.
     """
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    from gpumounter_tpu.utils.auth import required_token
+    token = required_token(service.cfg, "worker gRPC server")
+    interceptors = [_bearer_interceptor(token)] if token else []
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         interceptors=interceptors)
 
     def _handler(fn, req_cls):
         return grpc.unary_unary_rpc_method_handler(

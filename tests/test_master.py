@@ -68,8 +68,10 @@ def stack(tmp_path):
 
 
 def http(method: str, url: str, form: dict | None = None):
+    from conftest import AUTH_HEADER
     data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
-    req = urllib.request.Request(url, data=data, method=method)
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(AUTH_HEADER))
     try:
         with urllib.request.urlopen(req) as resp:
             return resp.status, resp.read().decode()

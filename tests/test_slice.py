@@ -277,8 +277,10 @@ def test_slice_http_routes(slice_stack, tmp_path):
                      for i in range(N_NODES)],
             "chipsPerHost": 4,
         }).encode()
+        from conftest import AUTH_HEADER
         req = urllib.request.Request(base + "/addslice", data=body,
-                                     method="POST")
+                                     method="POST",
+                                     headers=dict(AUTH_HEADER))
         with urllib.request.urlopen(req) as resp:
             plan = json.loads(resp.read())
         assert plan["slice"]["total_chips"] == 16
@@ -288,7 +290,8 @@ def test_slice_http_routes(slice_stack, tmp_path):
             "force": True,
         }).encode()
         req = urllib.request.Request(base + "/removeslice", data=body,
-                                     method="POST")
+                                     method="POST",
+                                     headers=dict(AUTH_HEADER))
         with urllib.request.urlopen(req) as resp:
             out = json.loads(resp.read())
         assert set(out["removed"].values()) == {"Success"}
