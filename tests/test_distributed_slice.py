@@ -21,6 +21,11 @@ import pytest
 
 REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy: run in the
+# slow lane (pytest -m slow); `-m "not slow"` is the fast
+# control-plane gate (VERDICT r4 weak #6).
+
+
 _WORKER_PROG = r"""
 import json, os, sys
 sys.path.insert(0, os.environ["TPM_REPO"])
@@ -45,6 +50,7 @@ n_expected = int(os.environ["TPM_EXPECT_DEVICES"])
 assert len(devices) == n_expected, devices
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
 
 mesh = Mesh(np.array(devices), ("data",))
 local = jnp.arange(4, dtype=jnp.float32) + 10.0 * jax.process_index()

@@ -12,6 +12,11 @@ import jax.numpy as jnp
 from gpumounter_tpu.ops.flash_attention import _xla_attention
 from gpumounter_tpu.ops.flash_decode import flash_decode
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy: run in the
+# slow lane (pytest -m slow); `-m "not slow"` is the fast
+# control-plane gate (VERDICT r4 weak #6).
+
+
 
 @pytest.fixture(autouse=True)
 def _cpu_default():

