@@ -1,16 +1,42 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+"""Pipeline parallelism: microbatch schedules over a mesh axis.
 
-TPU-first: each device on the "pipe" axis owns one stage's parameters;
+TPU-first: each device on the "pipe" axis owns its stage parameters;
 activations move stage-to-stage with `jax.lax.ppermute` (neighbor ICI
-transfers) inside a `lax.fori_loop` over M + P - 1 ticks, all under one
+transfers) inside a `lax.fori_loop` with static bounds, all under one
 jit — no host round-trips, static shapes throughout (SURVEY.md §2b: the
 collective is the JAX primitive, not a comm library).
 
-Schedule: at tick t, stage p computes microbatch (t - p) when
-0 ≤ t - p < M: stage 0 feeds itself from the microbatch buffer, later
-stages consume the activation ppermuted from stage p-1 at tick end. The
-last stage scatters its result into the output buffer, which is summed
-across the ring at the end (only the last stage wrote nonzero rows).
+Two schedules behind one entry point (`n_virtual`):
+
+* **GPipe** (`n_virtual=1`): P devices = P stages; microbatch m runs on
+  stage p at tick m + p. Bubble: P - 1 of M + P - 1 ticks.
+* **Interleaved / circular** (`n_virtual=v > 1`): each device owns v
+  non-contiguous stage *chunks* (logical stage s = k·P + d lives on
+  device d, chunk k), the schedule Megatron-LM calls "interleaved 1F1B"
+  and the scaling literature calls circular pipelining. A device runs
+  chunk k of microbatch m at tick
+
+      t = d + (m mod P) + P·(v·⌊m/P⌋ + k)
+
+  which (a) assigns every device at most one (chunk, microbatch) per
+  tick — (m, k) ↔ (t - d) is a bijection via the mixed-radix
+  decomposition r + P·(j·v + k) — and (b) keeps the data motion a
+  single forward ring ppermute per tick, because the producing tick of
+  stage s is always exactly one before the consuming tick of stage
+  s + 1 (same chunk → next device; chunk boundary → device P-1 wraps
+  to device 0 at the same +1 tick). Bubble: still P - 1 ticks, but of
+  M·v + P - 1 total — each tick is 1/v of a GPipe tick's work, so the
+  bubble *fraction* drops from (P-1)/(M+P-1) toward (P-1)/(M·v+P-1).
+
+Both schedules differentiate: the tick loop lowers to scan and the
+rotation is ppermute, so jax.grad back-propagates through the whole
+schedule (the backward of a circular forward is the mirrored circular
+backward XLA derives). What interleaving buys is the BUBBLE fraction,
+not memory: jax.grad still saves residuals for every tick, so peak
+activation memory scales with the total microbatch count M, like
+GPipe and unlike a hand-scheduled 1F1B (which caps in-flight
+activations at ~P). Size M accordingly, or wrap stage_fn in
+jax.checkpoint to trade the residuals for recompute.
 """
 
 from __future__ import annotations
@@ -22,19 +48,36 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
+def schedule_info(n_micro: int, n_stages: int, n_virtual: int = 1) -> dict:
+    """Bubble accounting for a (M, P, v) pipeline schedule.
+
+    ticks: total schedule length; busy device-ticks are M·v per device,
+    so bubble_fraction = 1 - M·v / ticks = (P - 1) / ticks.
+    """
+    ticks = n_micro * n_virtual + n_stages - 1
+    return {
+        "ticks": ticks,
+        "bubble_ticks": n_stages - 1,
+        "bubble_fraction": (n_stages - 1) / ticks,
+    }
+
+
+def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str,
+                    n_virtual: int):
     """Per-device body under shard_map.
 
-    stage_params: this stage's params, leading axis stripped (block of 1).
+    stage_params: this device's chunks, leading axes (1, v) (block of 1
+    on the pipe axis, then the chunk axis).
     x_micro: (M, mb, *rest) — full microbatch buffer, replicated.
     Returns (M, mb, *rest) outputs, replicated (psum at the end).
     """
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
-    # shard_map delivers this stage's block with the stage axis kept
-    # (leading size 1); strip it so stage_fn sees plain per-stage params.
+    # shard_map delivers this device's block with the pipe axis kept
+    # (leading size 1); strip it, keeping the chunk axis (v, ...).
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     n_micro = x_micro.shape[0]
+    v = n_virtual
     perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     out_buf = jnp.zeros_like(x_micro, dtype=jnp.float32)
@@ -42,16 +85,31 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
 
     def tick(t, carry):
         recv, out_buf = carry
-        m = t - stage                      # microbatch index for this stage
-        active = (m >= 0) & (m < n_micro)
-        # Stage 0 reads its own input; others use the received activation.
+        # Decode (microbatch m, chunk k) from u = t - stage via the
+        # mixed-radix split u = r + P·(j·v + k). For v=1 this reduces
+        # to m = u, k = 0 — exactly the GPipe schedule.
+        u = t - stage
+        uc = jnp.maximum(u, 0)
+        r = uc % n_stages
+        q = uc // n_stages
+        k = q % v
+        j = q // v
+        m = j * n_stages + r
+        active = (u >= 0) & (m < n_micro)
+        chunk = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, k, axis=0, keepdims=False),  # k = q % v is in [0, v)
+            stage_params)
+        # The first logical stage reads its own input; all others use
+        # the received activation.
         own = jax.lax.dynamic_index_in_dim(
             x_micro, jnp.clip(m, 0, n_micro - 1), axis=0, keepdims=False)
-        x_in = jnp.where(stage == 0, own, recv)
-        y = stage_fn(stage_params, x_in)
+        is_first = (stage == 0) & (k == 0)
+        x_in = jnp.where(is_first, own, recv)
+        y = stage_fn(chunk, x_in)
         y = jnp.where(active, y, jnp.zeros_like(y))
-        # Last stage records its finished microbatch.
-        is_last = stage == n_stages - 1
+        # The last logical stage records its finished microbatch.
+        is_last = (stage == n_stages - 1) & (k == v - 1)
         write_idx = jnp.clip(m, 0, n_micro - 1)
         contribution = jnp.where(active & is_last,
                                  y.astype(jnp.float32),
@@ -61,34 +119,54 @@ def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name: str):
             jax.lax.dynamic_index_in_dim(out_buf, write_idx, 0, False)
             + contribution,
             write_idx, axis=0)
-        # Rotate activations forward one stage.
+        # Rotate activations forward one stage (chunk wrap P-1 → 0
+        # rides the same ring edge).
         recv = jax.lax.ppermute(y, axis_name, perm_fwd)
         return recv, out_buf
 
     recv, out_buf = jax.lax.fori_loop(
-        0, n_micro + n_stages - 1, tick, (recv, out_buf))
+        0, n_micro * v + n_stages - 1, tick, (recv, out_buf))
     # Only the last stage holds real outputs; share them with every stage.
     return jax.lax.psum(out_buf, axis_name).astype(x_micro.dtype)
 
 
 def pipeline_apply(stage_params, x: jax.Array, mesh: Mesh, stage_fn,
-                   *, n_micro: int, pipe_axis: str = "pipe") -> jax.Array:
-    """Run x (B, *rest) through P pipeline stages with M microbatches
-    split along the batch axis.
+                   *, n_micro: int, pipe_axis: str = "pipe",
+                   n_virtual: int = 1) -> jax.Array:
+    """Run x (B, *rest) through the pipeline with M microbatches split
+    along the batch axis.
 
-    stage_params: pytree whose leaves have a leading stage axis of size P,
-    sharded over `pipe_axis`. stage_fn(params_for_stage, x_mb) -> y_mb
-    (same shape). B must divide by n_micro. Differentiable: the tick
-    loop has static bounds (lowers to scan) and the stage rotation is a
-    ppermute, so jax.grad of a loss on the output back-propagates
-    through the whole schedule — make_pipeline_train_step relies on it.
+    stage_params: pytree whose leaves carry a leading device axis of
+    size P (GPipe, n_virtual=1) or leading axes (P, v) (interleaved,
+    n_virtual=v), sharded over `pipe_axis`. stage_fn(chunk_params,
+    x_mb) -> y_mb (same shape) where chunk_params has the leading
+    axes stripped. B must divide by n_micro; the interleaved schedule
+    additionally needs n_micro % P == 0 (microbatches cycle the ring
+    in groups of P). Differentiable end to end — the train step relies
+    on it.
     """
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    n_stages = mesh.shape[pipe_axis]
+    if n_virtual < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
+    if n_virtual > 1 and n_micro % n_stages:
+        raise ValueError(
+            f"interleaved schedule needs n_micro ({n_micro}) divisible "
+            f"by the stage count ({n_stages})")
+    if n_virtual == 1:
+        # Lift (P, ...) leaves to the unified (P, v=1, ...) layout.
+        stage_params = jax.tree.map(lambda a: a[:, None], stage_params)
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != n_stages or leaf.shape[1] != n_virtual:
+            raise ValueError(
+                f"stage param leaf has leading shape {leaf.shape[:2]}, "
+                f"expected ({n_stages}, {n_virtual})")
     x_micro = x.reshape(n_micro, b // n_micro, *x.shape[1:])
 
-    body = partial(_pipeline_local, stage_fn=stage_fn, axis_name=pipe_axis)
+    body = partial(_pipeline_local, stage_fn=stage_fn,
+                   axis_name=pipe_axis, n_virtual=n_virtual)
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stage_params)
     fn = jax.shard_map(
         body, mesh=mesh,

@@ -1,11 +1,18 @@
 """Pipeline-parallel (pp) training of the flagship probe.
 
-GPipe over the probe's transformer blocks: a 1-axis ("pipe",) mesh of P
-devices, each owning n_layers / P consecutive blocks (stage-stacked
-parameters sharded over the axis); activations move stage-to-stage on
-ppermute inside parallel/pipeline.pipeline_apply's microbatch schedule,
+Microbatch-pipelined training over the probe's transformer blocks: a
+1-axis ("pipe",) mesh of P devices, each owning its share of the block
+stack (stage-stacked parameters sharded over the axis); activations
+move stage-to-stage on ppermute inside parallel/pipeline's schedule,
 and the whole thing differentiates — the tick loop has static bounds —
 so one jitted step does forward, backward, and the SGD update.
+
+Two schedules (parallel/pipeline.py): GPipe (`n_virtual=1`, each device
+one contiguous block chunk) and interleaved/circular (`n_virtual=v`,
+each device v non-contiguous chunks — logical stage k·P + d on device
+d — cutting the bubble fraction by ~v, the Megatron "interleaved 1F1B"
+family). Bubble accounting is enforced: n_micro >= n_stages, and
+schedule_info() exposes the tick/bubble arithmetic for callers.
 
 Embedding and the logits matmul live OUTSIDE the pipeline (they are
 token-local and tied to one table; only the block stack is staged).
@@ -28,24 +35,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gpumounter_tpu.models.probe import (
     TransformerConfig, _block, next_token_nll)
 from gpumounter_tpu.parallel.pipeline import (
-    pipeline_apply, shard_stage_params)
+    pipeline_apply, schedule_info, shard_stage_params)
 from gpumounter_tpu.parallel.train_step import sgd_update
 
 
-def to_pipeline_params(params: dict, n_stages: int) -> dict:
-    """Regroup init_params() output for a P-stage pipeline: the block
-    list becomes stage-stacked leaves (P, L/P, ...); embed (and pos)
-    stay as-is."""
+def to_pipeline_params(params: dict, n_stages: int,
+                       n_virtual: int = 1) -> dict:
+    """Regroup init_params() output for a pipeline of P = n_stages
+    devices and v = n_virtual chunks per device.
+
+    The block list becomes stage-stacked leaves: (P, L/P, ...) for
+    GPipe, (P, v, L/(P·v), ...) interleaved — logical stage s = k·P + d
+    (device d, chunk k) owns blocks [s·per, (s+1)·per). embed (and pos)
+    stay as-is.
+    """
     blocks = params["blocks"]
-    if len(blocks) % n_stages:
+    total = n_stages * n_virtual
+    if len(blocks) % total:
         raise ValueError(f"n_layers ({len(blocks)}) must divide by "
-                         f"n_stages ({n_stages})")
-    per = len(blocks) // n_stages
-    stages = [
-        jax.tree.map(lambda *xs: jnp.stack(xs),
-                     *blocks[s * per:(s + 1) * per])
-        for s in range(n_stages)
-    ]
+                         f"n_stages*n_virtual ({n_stages}*{n_virtual})")
+    per = len(blocks) // total
+
+    def logical_stage(s: int):
+        return jax.tree.map(lambda *xs: jnp.stack(xs),
+                            *blocks[s * per:(s + 1) * per])
+
+    if n_virtual == 1:
+        stages = [logical_stage(d) for d in range(n_stages)]
+    else:
+        # device-major, chunk-minor: leaf axes (P, v, per, ...)
+        stages = [
+            jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[logical_stage(k * n_stages + d)
+                  for k in range(n_virtual)])
+            for d in range(n_stages)
+        ]
     out = {k: v for k, v in params.items() if k != "blocks"}
     out["stages"] = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
     return out
@@ -63,30 +88,44 @@ def shard_pipeline_params(params: dict, mesh: Mesh,
 
 def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig,
                              n_micro: int, lr: float = 1e-3,
-                             pipe_axis: str = "pipe"):
+                             pipe_axis: str = "pipe",
+                             n_virtual: int = 1):
     """step(params, tokens) -> (params, loss) over a ("pipe",) mesh.
 
-    params come from to_pipeline_params(init_params(cfg, key), P).
+    params come from to_pipeline_params(init_params(cfg, key), P, v).
+    n_virtual=v > 1 selects the interleaved/circular schedule (bubble
+    fraction ~ (P-1)/(M·v+P-1) instead of GPipe's (P-1)/(M+P-1)).
     Restrictions: dense FFN only (the MoE aux loss would need
     cross-stage accumulation the schedule does not carry), and
     attn_parallel must be "heads" (each stage attends its full
     sequence locally; combine pp with sp/tp via nested meshes later).
     """
     n_stages = mesh.shape[pipe_axis]
-    if cfg.n_layers % n_stages:
+    total = n_stages * n_virtual
+    if cfg.n_layers % total:
         raise ValueError(f"n_layers ({cfg.n_layers}) must divide by "
-                         f"pipeline stages ({n_stages})")
+                         f"pipeline stages*chunks ({n_stages}*{n_virtual})")
+    if n_micro < n_stages:
+        # Bubble accounting: with M < P the ramp never fills — at least
+        # one device idles >50% of the schedule. Refuse rather than
+        # silently train at a fraction of the hardware.
+        info = schedule_info(n_micro, n_stages, n_virtual)
+        raise ValueError(
+            f"n_micro ({n_micro}) must be >= pipeline stages "
+            f"({n_stages}): bubble fraction would be "
+            f"{info['bubble_fraction']:.2f} "
+            f"({info['bubble_ticks']}/{info['ticks']} ticks)")
     if cfg.n_experts is not None:
         raise ValueError("pipeline training supports dense FFN only "
                          "(MoE aux loss is not carried across stages)")
     if cfg.attn_parallel != "heads":
         raise ValueError("pipeline training requires "
                          "attn_parallel='heads'")
-    per = cfg.n_layers // n_stages
+    per = cfg.n_layers // total
 
-    def stage_fn(stage_params, x):
+    def stage_fn(chunk_params, x):
         for i in range(per):
-            blk = jax.tree.map(lambda a, i=i: a[i], stage_params)
+            blk = jax.tree.map(lambda a, i=i: a[i], chunk_params)
             # mesh=None: inside the pipeline's shard_map every stage is
             # a single device — the kernel dispatches directly.
             # train=True: this call is differentiated (value_and_grad in
@@ -102,7 +141,8 @@ def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig,
         if not cfg.rope:
             x = x + params["pos"][:t]
         x = pipeline_apply(params["stages"], x, mesh, stage_fn,
-                           n_micro=n_micro, pipe_axis=pipe_axis)
+                           n_micro=n_micro, pipe_axis=pipe_axis,
+                           n_virtual=n_virtual)
         logits = (x @ params["embed"].T).astype(jnp.float32)
         return next_token_nll(logits, tokens)
 
@@ -118,7 +158,7 @@ def make_pipeline_train_step(mesh: Mesh, cfg: TransformerConfig,
     from gpumounter_tpu.models.probe import init_params
     template = jax.eval_shape(
         lambda: to_pipeline_params(
-            init_params(cfg, jax.random.key(0)), n_stages))
+            init_params(cfg, jax.random.key(0)), n_stages, n_virtual))
     shardings = {k: (jax.tree.map(lambda _: stage_sharding, v)
                      if k == "stages" else repl)
                  for k, v in template.items()}

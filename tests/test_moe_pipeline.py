@@ -117,3 +117,61 @@ def test_pipeline_bad_microbatch():
     x = jnp.zeros((10, 8), jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(stages, x, mesh, _stage_fn, n_micro=4)
+
+
+@pytest.mark.parametrize("n_stages,n_micro,n_virtual",
+                         [(2, 4, 2), (4, 4, 2), (4, 8, 3), (2, 2, 4)])
+def test_interleaved_pipeline_matches_serial(n_stages, n_micro, n_virtual):
+    """Circular schedule: logical stage k*P + d on device d, chunk k —
+    output must equal applying all P*v stages in logical order."""
+    cpus = _cpus(n_stages)
+    mesh = Mesh(np.array(cpus), ("pipe",))
+    d = 16
+    total = n_stages * n_virtual
+    flat = _stack_stages(jax.random.key(0), total, d)  # (S, ...) leaves
+
+    # serial oracle over the S logical stages in order
+    with jax.default_device(cpus[0]):
+        want = jnp.asarray(
+            np.random.default_rng(0).normal(size=(16, d)), jnp.float32)
+        x = want
+        for s in range(total):
+            x = _stage_fn(jax.tree.map(lambda a: a[s], flat), x)
+        want, x = x, want
+
+    # regroup to (P, v, ...): device d, chunk k = logical stage k*P + d
+    def regroup(a):
+        return jnp.stack([
+            jnp.stack([a[k * n_stages + dd] for k in range(n_virtual)])
+            for dd in range(n_stages)])
+    stages = jax.tree.map(regroup, flat)
+    sharded = shard_stage_params(stages, mesh)
+    got = jax.jit(lambda p, xx: pipeline_apply(
+        p, xx, mesh, _stage_fn, n_micro=n_micro, n_virtual=n_virtual))(
+            sharded, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_interleaved_pipeline_validations():
+    from gpumounter_tpu.parallel.pipeline import schedule_info
+
+    cpus = _cpus(2)
+    mesh = Mesh(np.array(cpus), ("pipe",))
+    stages = _stack_stages(jax.random.key(0), 2, 8)
+    x = jnp.zeros((8, 8), jnp.float32)
+    # interleaved needs n_micro % P == 0
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_apply(jax.tree.map(lambda a: a[:, None], stages), x,
+                       mesh, _stage_fn, n_micro=1, n_virtual=2)
+    # leaf shape must carry the (P, v) leading axes
+    with pytest.raises(ValueError, match="leading shape"):
+        pipeline_apply(stages, x, mesh, _stage_fn, n_micro=2,
+                       n_virtual=2)
+    # bubble accounting arithmetic
+    info = schedule_info(n_micro=8, n_stages=4, n_virtual=1)
+    assert info == {"ticks": 11, "bubble_ticks": 3,
+                    "bubble_fraction": 3 / 11}
+    info_v2 = schedule_info(n_micro=8, n_stages=4, n_virtual=2)
+    assert info_v2["ticks"] == 19
+    assert info_v2["bubble_fraction"] < info["bubble_fraction"]

@@ -146,3 +146,68 @@ def test_pipeline_stage_blocks_run_in_train_mode():
         step(params, tokens)
     assert seen, "stage_fn never reached _block"
     assert all(seen), f"_block called with train=False: {seen}"
+
+
+def test_interleaved_pipeline_matches_unsharded_reference():
+    """4-stage interleaved (v=2 chunks/device, 8 logical stages): one
+    pipeline SGD step == one single-device SGD step, losses AND updated
+    params (regrouped) agreeing — grads flow through the circular
+    schedule's chunk wraps."""
+    cfg = _cfg(n_layers=8)
+    mesh = _mesh(4)
+    lr = 0.5
+    params0 = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 256)
+
+    pp = shard_pipeline_params(
+        to_pipeline_params(params0, 4, n_virtual=2), mesh)
+    step = make_pipeline_train_step(mesh, cfg, n_micro=4, lr=lr,
+                                    n_virtual=2)
+    pp_new, loss_pp = step(pp, tokens)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: loss_fn(p, tokens, cfg))(params0)
+    ref_new = jax.tree.map(
+        lambda p, g: (p.astype(jnp.float32)
+                      - lr * g.astype(jnp.float32)).astype(p.dtype),
+        params0, ref_grads)
+    assert abs(float(loss_pp) - float(ref_loss)) < 1e-3
+    ref_pp = to_pipeline_params(ref_new, 4, n_virtual=2)
+    for a, b in zip(jax.tree.leaves(pp_new), jax.tree.leaves(ref_pp)):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - b.astype(jnp.float32))))
+        assert err < 5e-3, err
+
+
+def test_bubble_accounting_rejects_starved_schedule():
+    """VERDICT r5 item 7: microbatches >= stages is asserted, with the
+    bubble arithmetic in the error."""
+    from gpumounter_tpu.parallel.pipeline import schedule_info
+
+    cfg = _cfg(n_layers=4)
+    mesh = _mesh(4)
+    with pytest.raises(ValueError, match="bubble fraction"):
+        make_pipeline_train_step(mesh, cfg, n_micro=2)
+    # and the arithmetic the message is built from
+    assert schedule_info(2, 4)["bubble_fraction"] == 3 / 5
+    # interleaving shrinks the fraction at fixed M, P
+    assert (schedule_info(4, 4, 2)["bubble_fraction"]
+            < schedule_info(4, 4, 1)["bubble_fraction"])
+
+
+def test_interleaved_layer_grouping():
+    """to_pipeline_params must assign logical stage k*P + d to device d
+    chunk k — the layout the circular ring rotation assumes."""
+    cfg = _cfg(n_layers=8)
+    params = init_params(cfg, jax.random.key(0))
+    pp = to_pipeline_params(params, 4, n_virtual=2)
+    leaves = jax.tree.leaves(pp["stages"])
+    # leading axes (P=4, v=2, per=1, ...)
+    assert all(l.shape[:3] == (4, 2, 1) for l in leaves)
+    # pick one weight and check placement: layer s lives at [s%4, s//4, 0]
+    flat_blocks = params["blocks"]
+    key0 = sorted(flat_blocks[0])[0]
+    for s in range(8):
+        got = pp["stages"][key0][s % 4, s // 4, 0]
+        want = flat_blocks[s][key0]
+        assert jnp.array_equal(got, want), f"stage {s} misplaced"
