@@ -4,7 +4,9 @@ from gpumounter_tpu.k8s.client import (
     KubeClient,
     NotFoundError,
     RestKubeClient,
+    default_client,
     in_cluster_client,
+    kubeconfig_client,
 )
 from gpumounter_tpu.k8s.types import Pod
 
@@ -15,5 +17,7 @@ __all__ = [
     "NotFoundError",
     "Pod",
     "RestKubeClient",
+    "default_client",
     "in_cluster_client",
+    "kubeconfig_client",
 ]

@@ -1,4 +1,5 @@
-"""Minimal Kubernetes REST client (stdlib only).
+"""Minimal Kubernetes REST client (stdlib only; pyyaml needed only for
+the out-of-cluster kubeconfig path).
 
 Replaces the reference's client-go usage (pkg/config/config.go:30-45 — a
 sync.Once in-cluster clientset). The image has no `kubernetes` Python package
@@ -285,6 +286,145 @@ class _WatchStream:
         if not self._done:
             self._done = True
             self._conn.close()
+
+
+def kubeconfig_client(path: str | None = None,
+                      context: str | None = None) -> RestKubeClient:
+    """Build a client from a kubeconfig file (out-of-cluster path).
+
+    The reference stubs this out — `kubeConfigPath` is a placeholder
+    string and inCluster is hardwired true (config.go:20,31) — so its
+    binaries only ever run inside the cluster. This loader makes the
+    daemons and CLI usable from a laptop against kind/minikube/GKE:
+
+      * path: explicit arg > $KUBECONFIG > ~/.kube/config
+      * context: explicit arg > current-context
+      * cluster: server URL, certificate-authority[-data],
+        insecure-skip-tls-verify
+      * user: token / token-file bearer auth, or client-certificate[-data]
+        + client-key[-data] mTLS (the kind default). exec plugins are
+        refused with an actionable error — running arbitrary
+        credential helpers is out of scope for a privileged daemon.
+    """
+    import base64
+    import shutil
+    import tempfile
+
+    import yaml
+
+    path = path or os.environ.get("KUBECONFIG") \
+        or os.path.expanduser("~/.kube/config")
+    with open(path, encoding="utf-8") as f:
+        cfg = yaml.safe_load(f) or {}
+
+    def _by_name(section: str, name: str) -> dict:
+        for entry in cfg.get(section, []):
+            if entry.get("name") == name:
+                return entry
+        raise ValueError(f"kubeconfig {path}: no {section!r} entry "
+                         f"named {name!r}")
+
+    ctx_name = context or cfg.get("current-context")
+    if not ctx_name:
+        raise ValueError(f"kubeconfig {path}: no current-context and no "
+                         f"context argument given")
+    ctx = _by_name("contexts", ctx_name).get("context", {})
+    cluster = _by_name("clusters", ctx.get("cluster", "")).get("cluster", {})
+    user = _by_name("users", ctx.get("user", "")).get("user", {})
+
+    server = cluster.get("server", "")
+    parsed = urllib.parse.urlsplit(server)
+    if parsed.scheme != "https":
+        raise ValueError(f"kubeconfig cluster server must be https, "
+                         f"got {server!r}")
+    host = parsed.hostname or ""
+    port = parsed.port or 443
+
+    ca_file = cluster.get("certificate-authority") or None
+    ca_data = None
+    if cluster.get("certificate-authority-data"):
+        # cadata goes straight into the SSL context — no key/cert
+        # material is ever written to disk for the *-data variants.
+        ca_data = base64.b64decode(
+            cluster["certificate-authority-data"]).decode()
+        ca_file = None
+    verify = not cluster.get("insecure-skip-tls-verify", False)
+
+    if "exec" in user:
+        raise ValueError(
+            "kubeconfig user uses an exec credential plugin; this client "
+            "does not run external helpers — extract a token (e.g. "
+            "`kubectl create token ...`) and use the token field")
+    token = user.get("token", "")
+    if not token and user.get("tokenFile"):
+        with open(user["tokenFile"], encoding="utf-8") as f:
+            token = f.read().strip()
+    has_cert = bool(user.get("client-certificate")
+                    or user.get("client-certificate-data"))
+    has_key = bool(user.get("client-key") or user.get("client-key-data"))
+    if not token and not has_cert:
+        raise ValueError(
+            f"kubeconfig user {ctx.get('user')!r} has neither a token nor "
+            f"a client certificate; cannot authenticate")
+    if has_cert and not has_key:
+        raise ValueError("client-certificate given without client-key")
+
+    client = RestKubeClient(host, port, token,
+                            ca_file=ca_file if verify else None,
+                            verify=verify)
+    if verify and ca_data:
+        client.ctx.load_verify_locations(cadata=ca_data)
+    if has_cert:
+        # load_cert_chain wants file paths and reads them eagerly, so
+        # inline *-data key material only touches disk inside a private
+        # temp dir that is removed before returning.
+        tmp = None
+        try:
+            cert_path = user.get("client-certificate")
+            key_path = user.get("client-key")
+            if user.get("client-certificate-data") or \
+                    user.get("client-key-data"):
+                tmp = tempfile.mkdtemp(prefix="tpumounter-kc-")
+                os.chmod(tmp, 0o700)
+                if user.get("client-certificate-data"):
+                    cert_path = os.path.join(tmp, "client.crt")
+                    with open(cert_path, "wb") as f:
+                        f.write(base64.b64decode(
+                            user["client-certificate-data"]))
+                if user.get("client-key-data"):
+                    key_path = os.path.join(tmp, "client.key")
+                    with open(key_path, "wb") as f:
+                        f.write(base64.b64decode(user["client-key-data"]))
+                    os.chmod(key_path, 0o600)
+            client.ctx.load_cert_chain(cert_path, key_path)
+        finally:
+            if tmp is not None:
+                shutil.rmtree(tmp, ignore_errors=True)
+    logger.info("kubeconfig client: context=%s server=%s auth=%s",
+                ctx_name, server, "mtls" if has_cert else "token")
+    return client
+
+
+def default_client() -> RestKubeClient:
+    """In-cluster when the service-account token exists (the deployed
+    daemons), kubeconfig otherwise (laptop / dev)."""
+    token_file = os.environ.get("TPUMOUNTER_TOKEN_FILE",
+                                os.path.join(SA_DIR, "token"))
+    if os.path.exists(token_file):
+        return in_cluster_client()
+    try:
+        return kubeconfig_client()
+    except Exception as exc:
+        # In a pod, landing here usually means the SA token was never
+        # mounted (automountServiceAccountToken: false) — name THAT
+        # problem instead of surfacing a kubeconfig/yaml error from a
+        # fallback path container images don't even support.
+        raise RuntimeError(
+            f"no service-account token at {token_file} and the "
+            f"kubeconfig fallback failed ({type(exc).__name__}: {exc}); "
+            f"in-cluster: check automountServiceAccountToken / the "
+            f"projected token volume; on a laptop: check $KUBECONFIG "
+            f"(pyyaml required)") from exc
 
 
 def in_cluster_client() -> RestKubeClient:

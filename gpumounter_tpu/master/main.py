@@ -15,10 +15,10 @@ logger = get_logger("master.main")
 def main() -> None:
     cfg = get_config()
     init_logger(cfg.log_dir, "tpumounter-master.log")
-    from gpumounter_tpu.k8s.client import in_cluster_client
+    from gpumounter_tpu.k8s import default_client
     from gpumounter_tpu.master.app import MasterApp, build_http_server
 
-    kube = in_cluster_client()
+    kube = default_client()
     app = MasterApp(kube, cfg=cfg)
     httpd = build_http_server(app)
     logger.info("tpumounter master serving on :%d", cfg.master_port)

@@ -55,11 +55,11 @@ def main() -> None:
     init_logger(cfg.log_dir, "tpumounter-worker.log")
     logger.info("tpumounter worker starting (port %d)", cfg.worker_port)
 
-    from gpumounter_tpu.k8s.client import in_cluster_client
+    from gpumounter_tpu.k8s import default_client
     from gpumounter_tpu.worker.reaper import SlaveReaper
     from gpumounter_tpu.worker.server import TpuMountService, build_server
 
-    kube = in_cluster_client()
+    kube = default_client()
     service = TpuMountService(kube, cfg=cfg)
     server = build_server(service)
     ops = serve_ops(cfg.metrics_port)
