@@ -42,7 +42,7 @@ Proven with PYTHONMALLOC=debug (zeroed header bytes on the next heap
 block) and fixed by padding every bpf attr to BPF_ATTR_SIZE=256 zeroed
 bytes; 20/20 consecutive green runs after the fix.
 
-Usage: sudo python bench_e2e_real.py   → writes BENCH_e2e_real_r03.json
+Usage: sudo python bench_e2e_real.py   → writes BENCH_e2e_real_r05.json
 """
 
 from __future__ import annotations
@@ -63,7 +63,7 @@ sys.path.insert(0, REPO)
 
 # Overridable so test runs don't clobber the committed real-chip artifact.
 ARTIFACT = os.environ.get("TPM_E2E_ARTIFACT",
-                          os.path.join(REPO, "BENCH_e2e_real_r03.json"))
+                          os.path.join(REPO, "BENCH_e2e_real_r05.json"))
 
 V1_ROOT = "/sys/fs/cgroup/devices"
 V2_ROOT_CANDIDATES = ("/sys/fs/cgroup/unified", "/sys/fs/cgroup")
@@ -297,7 +297,7 @@ def host_halves() -> dict[int, bool]:
 
 def main() -> None:
     results: dict = {
-        "schema": "tpumounter-e2e-real/r03",
+        "schema": "tpumounter-e2e-real/r05",
         "host": {
             "kernel": platform.release(),
             "local_accel_nodes": sorted(
