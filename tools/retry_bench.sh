@@ -1,0 +1,12 @@
+#!/bin/bash
+# retry driver: $1 = per-attempt timeout seconds, rest = command
+PER=$1; shift
+for i in $(seq 1 12); do
+  echo "=== attempt $i: $* (cap ${PER}s) ==="
+  timeout "$PER" "$@" && exit 0
+  code=$?
+  echo "=== attempt $i exited $code; killing strays, retrying ==="
+  ps aux | grep -E "bench_flash" | grep -v grep | awk '{print $2}' | xargs -r kill -9
+  sleep 5
+done
+exit 1
