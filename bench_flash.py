@@ -29,7 +29,7 @@ artifact so shipped constants and committed evidence cannot disagree.
 Fitted envelope: causal, bf16, B=4, H=8, D=128.
 
 Not part of the driver contract (bench.py is); run by hand on hardware.
-Writes BENCH_flash_r04.json. Sections can be run selectively:
+Writes BENCH_flash_r05.json. Sections can be run selectively:
 `python bench_flash.py [fwd] [bwd] [diag] [train]` (default: all);
 partial runs merge into an existing artifact.
 """
@@ -43,6 +43,10 @@ import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from bench_timing import enable_compile_cache
+
+enable_compile_cache()  # remote-compile relay wedge mitigation
 
 from gpumounter_tpu.ops.flash_attention import (
     _xla_attention,
@@ -69,7 +73,7 @@ def iters_for(l: int) -> int:
     return ITERS
 V5E_BF16_PEAK_TFLOPS = 197.0
 ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_flash_r04.json")
+                        "BENCH_flash_r05.json")
 
 SEQ_LENS = (1024, 2048, 4096, 8192, 16384, 32768)
 BLOCK_CONFIGS = ((256, 512), (256, 1024), (512, 512), (512, 1024),
@@ -81,8 +85,18 @@ EXTRA_BLOCKS = {
            (1024, 2048), (2048, 1024), (2048, 2048)),
     4096: ((1024, 2048), (2048, 1024)),
     8192: ((1024, 2048), (2048, 1024)),
-    16384: ((1024, 2048), (2048, 1024), (2048, 2048), (512, 4096)),
-    32768: ((1024, 2048), (2048, 1024), (2048, 2048)),
+    # r05 (VERDICT r4 #3): the whole block_q=2048 family at 16k/32k
+    # errored in the REMOTE COMPILE SERVICE in r04 (INTERNAL from
+    # /remote_compile) and was never actually measured — retry it, and
+    # widen with 4096-tall/4096-wide candidates. Rationale: K/V band
+    # re-streaming scales with L/block_q (8.6 GB per fwd at 32k with
+    # bq=1024, ~10.5 ms of the 819 GB/s budget), so taller q blocks cut
+    # HBM traffic 2-4x; VMEM fits (scratch+blocks ~6 MB at 2048, ~12 MB
+    # at 4096 of the ~16 MB/core).
+    16384: ((1024, 2048), (2048, 1024), (2048, 2048), (512, 4096),
+            (2048, 512), (4096, 512), (4096, 1024), (1024, 4096)),
+    32768: ((1024, 2048), (2048, 1024), (2048, 2048),
+            (2048, 512), (4096, 512), (4096, 1024), (1024, 4096)),
 }
 
 # Nominal FLOP convention (FlashAttention-2 accounting), causal-halved:
@@ -529,7 +543,7 @@ def main():
         with open(ARTIFACT) as f:
             results = json.load(f)
     results.update({
-        "schema": "tpumounter-flash-sweep/r04",
+        "schema": "tpumounter-flash-sweep/r05",
         "device": f"{dev.device_kind} ({dev.platform})",
         "iters_chained": ITERS, "reps": REPS,
         "peak_bf16_tflops": V5E_BF16_PEAK_TFLOPS,

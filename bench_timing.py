@@ -31,3 +31,24 @@ def min_time_probed(fn, q, k, v_variants, reps) -> tuple[float, bool]:
         best = min(best, time.perf_counter() - t0)
         probes.append(probe.tobytes())
     return best, len(set(probes)) < len(probes)
+
+
+def enable_compile_cache():
+    """Persistent JAX compile cache for every on-chip bench.
+
+    The remote compile relay intermittently wedges mid-compile (r04:
+    decode, 7/7; r05: reproduced — client blocked in tcp_sendmsg to
+    /remote_compile). With this cache a successful compile is never
+    re-requested, so a retry driver (tools/retry_bench.sh) converges
+    instead of re-rolling the same dice each attempt. Verified to work
+    through the axon PJRT plugin (5.2 s first, 0.8 s next process).
+    """
+    import os
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     "/root/.jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

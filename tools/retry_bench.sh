@@ -1,12 +1,20 @@
 #!/bin/bash
-# retry driver: $1 = per-attempt timeout seconds, rest = command
+# Retry driver for on-chip benches behind the flaky remote-compile
+# relay: $1 = per-attempt timeout seconds, rest = command. With the
+# persistent JAX compile cache enabled in the bench, successful
+# compiles are never re-requested, so attempts converge.
 PER=$1; shift
 for i in $(seq 1 12); do
-  echo "=== attempt $i: $* (cap ${PER}s) ==="
+  echo "=== attempt $i: $* (cap ${PER}s) ===" 
   timeout "$PER" "$@" && exit 0
   code=$?
-  echo "=== attempt $i exited $code; killing strays, retrying ==="
-  ps aux | grep -E "bench_flash" | grep -v grep | awk '{print $2}' | xargs -r kill -9
+  echo "=== attempt $i exited $code; killing stray pythons, retrying ==="
+  # Kill stray python processes whose EXECUTABLE is python* and whose
+  # first argument is a bench script. Matching the bench name anywhere
+  # in the line would also match this driver's own cmdline (bash
+  # retry_bench.sh ... python bench_...) and kill the retry loop.
+  ps aux | awk '$11 ~ /(^|\/)python[0-9.]*$/ && $12 ~ /bench_/ {print $2}' \
+    | xargs -r kill -9
   sleep 5
 done
 exit 1
