@@ -163,7 +163,10 @@ def entry_for(t_ms: float, flops: float, cache_served: bool = False) -> dict:
     return {"ms": round(t_ms, 4),
             "tflops": round(tflops, 1),
             "mfu": round(tflops / V5E_BF16_PEAK_TFLOPS, 3),
-            "invalid_timing": bool(tflops > 1.1 * V5E_BF16_PEAK_TFLOPS
+            # MFU > 1 is impossible under this exact FLOP convention —
+            # 1.02 leaves rounding room only (r05: a 1.012 "winner"
+            # slipped under the old 1.1 band and poisoned the table).
+            "invalid_timing": bool(tflops > 1.02 * V5E_BF16_PEAK_TFLOPS
                                    or cache_served),
             "cache_served": cache_served}
 
@@ -261,6 +264,10 @@ def sweep_fwd(results, on_tpu):
                 row["pallas"][f"{bq}x{bk}"] = {
                     "error": f"{type(exc).__name__}: "
                              f"{str(exc).splitlines()[0][:160]}"}
+        from bench_timing import merge_min_rows
+        prior_row = prior.get(l, {})
+        merge_min_rows(row, prior_row, "pallas", results.get("kernel_rev"))
+        row["kernel_rev"] = results.get("kernel_rev")
         ok = {key: val for key, val in row["pallas"].items()
               if val.get("valid")}
         if ok:
@@ -312,6 +319,22 @@ def derive_dispatch_tables(results):
                         row["best_pallas"]["blocks"].split("x"))
                   if pallas_ok else (256, 1024))
         table[l] = (winner, blocks)
+    # Staleness audit: table rows whose measurements predate the
+    # current kernel are named, not silently blended — a partial
+    # re-sweep after a kernel change must show what still needs
+    # re-measuring before the shipped tables are synced.
+    current = results.get("kernel_rev")
+    stale = sorted(
+        {f"fwd:{row['seq_len']}" for row in results.get("sweep", [])
+         if row.get("kernel_rev") != current}
+        | {f"bwd:{row['seq_len']}" for row in results.get("sweep_bwd", [])
+           if row.get("kernel_rev") != current})
+    results["dispatch_table_stale_rows"] = stale
+    if stale:
+        print(json.dumps({"WARNING_stale_rows":
+                          f"rows {stale} measured with an older "
+                          f"kernel_rev; re-sweep before syncing "
+                          f"_SWEEP_TABLE/_TRAIN_TABLE"}), flush=True)
     results["dispatch_table"] = {
         str(l): {"winner": w, "blocks": list(blk)}
         for l, (w, blk) in table.items()}
@@ -389,6 +412,10 @@ def sweep_bwd(results, on_tpu):
                 row["pallas"][blocks] = {
                     "error": f"{type(exc).__name__}: "
                              f"{str(exc).splitlines()[0][:160]}"}
+        from bench_timing import merge_min_rows
+        prior_row = prior.get(l, {})
+        merge_min_rows(row, prior_row, "pallas", results.get("kernel_rev"))
+        row["kernel_rev"] = results.get("kernel_rev")
         ok = {key: val for key, val in row["pallas"].items()
               if val.get("valid")}
         if ok:
@@ -542,7 +569,13 @@ def main():
     if os.path.exists(ARTIFACT):
         with open(ARTIFACT) as f:
             results = json.load(f)
+    # kernel_rev: hash of the kernel source — min-merge only joins
+    # runs of the SAME kernel (a kernel change must replace rows, not
+    # inherit a faster predecessor's timings).
+    from bench_timing import kernel_revision
+    kernel_rev = kernel_revision()
     results.update({
+        "kernel_rev": kernel_rev,
         "schema": "tpumounter-flash-sweep/r05",
         "device": f"{dev.device_kind} ({dev.platform})",
         "iters_chained": ITERS, "reps": REPS,

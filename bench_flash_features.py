@@ -86,18 +86,6 @@ def _mk(rng, shape):
         rng.normal(size=shape) * 0.3, jnp.bfloat16))
 
 
-def _merge_min(cell: dict, prior: dict, ms_key: str,
-               invalid_key: str) -> None:
-    """Keep the per-cell MIN of valid timings across sweep runs; a
-    prior valid timing also rescues a cell the current run flagged."""
-    prior_ms = prior.get(ms_key)
-    if prior_ms is None or prior.get(invalid_key, True):
-        return
-    if cell.get(invalid_key) or prior_ms < cell[ms_key]:
-        cell[ms_key] = prior_ms
-        cell[invalid_key] = False
-
-
 def bench_gqa(out, save=None):
     """h_kv x block geometry x {grouped, broadcast-control}."""
     b, h, l, d = 4, 8, 8192, 128
@@ -114,7 +102,14 @@ def bench_gqa(out, save=None):
     # KV-bytes ladder by luck. Each re-run keeps the per-cell MIN of
     # valid timings across sessions; best/best_of_strategy and the
     # generated dispatch table are then derived from the merged cells.
+    # Guarded by kernel_rev like bench_flash.py: a kernel change must
+    # replace GQA measurements, never inherit a predecessor's minima.
+    from bench_timing import kernel_revision
+
+    kernel_rev = kernel_revision()
     prior_gqa = out.get("gqa_L8192", {})
+    if prior_gqa.get("kernel_rev") != kernel_rev:
+        prior_gqa = {}
     for h_kv in (8, 4, 2, 1):
         k = _mk(rng, (b, h_kv, l, d))
         v0 = jnp.asarray(rng.normal(size=(b, h_kv, l, d)) * 0.3,
@@ -144,10 +139,11 @@ def bench_gqa(out, save=None):
                 cell["broadcast_control_invalid"] = invb
             prior_cell = prior_gqa.get(f"h_kv={h_kv}", {}).get(
                 "geoms", {}).get(f"{bq}x{bk}", {})
-            _merge_min(cell, prior_cell, "ms", "invalid_timing")
+            from bench_timing import merge_min_cell
+            merge_min_cell(cell, prior_cell, "ms", "invalid_timing")
             if "broadcast_control_ms" in cell:
-                _merge_min(cell, prior_cell, "broadcast_control_ms",
-                           "broadcast_control_invalid")
+                merge_min_cell(cell, prior_cell, "broadcast_control_ms",
+                               "broadcast_control_invalid")
             row["geoms"][f"{bq}x{bk}"] = cell
             print(json.dumps({f"h_kv={h_kv}": {f"{bq}x{bk}": cell}}),
                   flush=True)
@@ -236,6 +232,7 @@ def bench_gqa(out, save=None):
         best_so_far = min(best_so_far, ms)
     gqa["best_of_strategy_monotone_in_kv_bytes"] = ok
     gqa["ladder_ms_by_h_kv"] = {f"h_kv={h}": m for h, m in ladder}
+    gqa["kernel_rev"] = kernel_rev
     out["gqa_L8192"] = gqa
 
 

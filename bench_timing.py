@@ -52,3 +52,82 @@ def enable_compile_cache():
                                      "/root/.jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def merge_min_rows(row: dict, prior_row: dict, cell_key: str,
+                   current_rev, xla_too: bool = True) -> None:
+    """Min-over-runs merge policy, shared by every sweep.
+
+    Keeps the per-config MIN of valid timings across runs OF THE SAME
+    KERNEL (prior rows from a different kernel_rev are ignored — a
+    kernel change must replace measurements, never inherit a faster
+    predecessor's). Merges the XLA baseline symmetrically so winner
+    derivation is unbiased."""
+    if prior_row.get("kernel_rev") != current_rev:
+        return
+    for key, pv in prior_row.get(cell_key, {}).items():
+        val = row.get(cell_key, {}).get(key)
+        if not (pv and pv.get("valid") and "ms" in pv):
+            continue
+        if val is None:
+            # config swept in a prior run but not this one (e.g. the
+            # bwd candidate set follows fwd_best): keep the valid data
+            row.setdefault(cell_key, {})[key] = pv
+        elif not val.get("valid") or pv["ms"] < val.get("ms", 1e9):
+            row[cell_key][key] = pv
+    if xla_too:
+        px = prior_row.get("xla")
+        if (px and px.get("valid") and "ms" in px
+                and (not (row.get("xla") or {}).get("valid")
+                     or px["ms"] < row["xla"].get("ms", 1e9))):
+            row["xla"] = px
+
+
+def kernel_revision() -> str:
+    """Hash of the KERNEL SOURCE — the functions whose code determines
+    measured timings — not the whole module file. Comment, docstring,
+    dispatch-table, or module-level edits must not invalidate
+    measurements; an actual kernel change must. Hashes the AST dump
+    (comments never reach the AST; docstrings are stripped) of every
+    function on the measured path, including the DMA index maps
+    (_make_kv_index implements the band skip's traffic half — changing
+    it changes timings as surely as the kernel body)."""
+    import ast
+    import hashlib
+    import importlib
+    import inspect
+    import textwrap
+
+    # the ops package re-exports the flash_attention FUNCTION under the
+    # same name; import the module explicitly
+    fa = importlib.import_module("gpumounter_tpu.ops.flash_attention")
+
+    parts = []
+    for fn in (fa._band_needed, fa._band_mask, fa._softcap,
+               fa._make_kv_index, fa._fit_block, fa._flash_kernel,
+               fa._flash_bwd_dq_kernel, fa._flash_bwd_dkv_kernel,
+               fa.flash_attention_pallas, fa._flash_backward):
+        tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+        for node in ast.walk(tree):
+            body = getattr(node, "body", None)
+            if (isinstance(body, list) and body
+                    and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                node.body = body[1:] or [ast.Pass()]
+        parts.append(ast.dump(tree))
+    return hashlib.sha256("".join(parts).encode()).hexdigest()[:16]
+
+
+def merge_min_cell(cell: dict, prior: dict, ms_key: str,
+                   invalid_key: str) -> None:
+    """Per-cell variant of the min-over-runs policy (cells that carry
+    several timing columns, e.g. the GQA fold/broadcast pairs). The
+    CALLER gates on kernel_rev — this helper only implements the
+    min/rescue rule, identically to merge_min_rows' inner step."""
+    prior_ms = prior.get(ms_key)
+    if prior_ms is None or prior.get(invalid_key, True):
+        return
+    if cell.get(invalid_key) or prior_ms < cell[ms_key]:
+        cell[ms_key] = prior_ms
+        cell[invalid_key] = False

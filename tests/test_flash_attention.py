@@ -495,7 +495,20 @@ def test_dispatch_table_consistency():
                 / "BENCH_flash_r05.json")
     if not artifact.exists():
         pytest.skip("sweep artifact not present")
-    table = json.loads(artifact.read_text())["dispatch_table"]
+    evidence = json.loads(artifact.read_text())
+    # Evidence coherence (r5 review): every sweep row must carry the
+    # artifact's kernel_rev and the staleness audit must be clean —
+    # shipped tables must never be derived from mixed-kernel timings.
+    rev = evidence.get("kernel_rev")
+    if rev:
+        for key in ("sweep", "sweep_bwd"):
+            for row in evidence.get(key, []):
+                assert row.get("kernel_rev") == rev, \
+                    f"{key} L={row.get('seq_len')} measured with " \
+                    f"{row.get('kernel_rev')}, artifact is {rev}"
+        assert evidence.get("dispatch_table_stale_rows") in ([], None), \
+            evidence.get("dispatch_table_stale_rows")
+    table = evidence["dispatch_table"]
     assert set(map(int, table)) == set(fa._SWEEP_TABLE), \
         "artifact and _SWEEP_TABLE cover different seq_lens"
     for l_str, ent in table.items():
