@@ -128,9 +128,16 @@ def cmd_unmount(args) -> int:
 
 
 def _http(method: str, url: str, form: dict | None = None,
-          token: str | None = None) -> tuple[int, str]:
-    data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
+          token: str | None = None,
+          json_body: dict | None = None) -> tuple[int, str]:
+    if json_body is not None:
+        data = json.dumps(json_body).encode()
+    else:
+        data = (urllib.parse.urlencode(form, doseq=True).encode()
+                if form else None)
     req = urllib.request.Request(url, data=data, method=method)
+    if json_body is not None:
+        req.add_header("Content-Type", "application/json")
     if token:
         req.add_header("Authorization", f"Bearer {token}")
     try:
@@ -170,6 +177,43 @@ def cmd_remove(args) -> int:
     url = (f"{args.master.rstrip('/')}/removetpu/namespace/{args.namespace}"
            f"/pod/{args.pod}/force/{str(args.force).lower()}")
     status, body = _http("POST", url, form={"uuids": args.uuids},
+                         token=_remote_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def _intent_url(args, with_pod: bool = True) -> str:
+    base = f"{args.master.rstrip('/')}/intents"
+    if with_pod:
+        return f"{base}/{args.namespace}/{args.pod}"
+    return base
+
+
+def cmd_intent_set(args) -> int:
+    payload = {"desiredChips": args.chips, "minChips": args.min_chips,
+               "priority": args.priority}
+    status, body = _http("PUT", _intent_url(args), json_body=payload,
+                         token=_remote_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def cmd_intent_get(args) -> int:
+    status, body = _http("GET", _intent_url(args),
+                         token=_remote_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def cmd_intent_delete(args) -> int:
+    status, body = _http("DELETE", _intent_url(args),
+                         token=_remote_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def cmd_intent_list(args) -> int:
+    status, body = _http("GET", _intent_url(args, with_pod=False),
                          token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
@@ -217,6 +261,43 @@ def build_parser() -> argparse.ArgumentParser:
                    help="master bearer token (default: "
                         "TPUMOUNTER_AUTH_TOKEN[_FILE])")
     a.set_defaults(fn=cmd_add)
+
+    # Elastic intents: declare desired chip counts; the master's
+    # reconciler converges and keeps converging (self-healing).
+    it = sub.add_parser("intent", help="declarative chip-count intents")
+    it_sub = it.add_subparsers(dest="intent_verb", required=True)
+
+    def _intent_common(sp, with_pod=True):
+        sp.add_argument("--master", required=True)
+        if with_pod:
+            sp.add_argument("--namespace", default="default")
+            sp.add_argument("--pod", required=True)
+        sp.add_argument("--token", default=None,
+                        help="master bearer token (default: "
+                             "TPUMOUNTER_AUTH_TOKEN[_FILE])")
+
+    iset = it_sub.add_parser("set", help="declare desired chips for a pod")
+    _intent_common(iset)
+    iset.add_argument("--chips", type=int, required=True,
+                      help="desired chip count the reconciler converges to")
+    iset.add_argument("--min-chips", type=int, default=0,
+                      help="acceptable floor under capacity pressure")
+    iset.add_argument("--priority", type=int, default=0,
+                      help="higher reconciles first when contended")
+    iset.set_defaults(fn=cmd_intent_set)
+
+    iget = it_sub.add_parser("get", help="show one pod's intent + status")
+    _intent_common(iget)
+    iget.set_defaults(fn=cmd_intent_get)
+
+    idel = it_sub.add_parser("delete",
+                             help="stop managing a pod (keeps its chips)")
+    _intent_common(idel)
+    idel.set_defaults(fn=cmd_intent_delete)
+
+    ilist = it_sub.add_parser("list", help="all declared intents")
+    _intent_common(ilist, with_pod=False)
+    ilist.set_defaults(fn=cmd_intent_list)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

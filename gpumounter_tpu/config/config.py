@@ -78,6 +78,23 @@ class Config:
         "WORKER_LABEL_SELECTOR", "app=tpu-mounter-worker"))
     worker_namespace: str = field(default_factory=lambda: _env("WORKER_NAMESPACE", "kube-system"))
 
+    # --- elastic intent controller (master side) ---
+    # Full-state resync period: every intent re-enters the workqueue this
+    # often, so a reconciler restart or a missed edge self-corrects.
+    elastic_resync_interval_s: float = field(default_factory=lambda: float(
+        _env("ELASTIC_RESYNC_INTERVAL_S", "10")))
+    # Per-pod exponential backoff on reconcile failure (base doubles up to
+    # the cap, plus jitter) — a broken mount must not hot-loop the worker.
+    elastic_backoff_base_s: float = field(default_factory=lambda: float(
+        _env("ELASTIC_BACKOFF_BASE_S", "0.5")))
+    elastic_backoff_cap_s: float = field(default_factory=lambda: float(
+        _env("ELASTIC_BACKOFF_CAP_S", "60")))
+    # Global floor between any two reconcile passes (rate limit across
+    # all pods; one sick intent shares the budget with the healthy ones).
+    elastic_min_reconcile_interval_s: float = field(
+        default_factory=lambda: float(
+            _env("ELASTIC_MIN_RECONCILE_INTERVAL_S", "0.05")))
+
     # --- control-plane auth ---
     # The reference control plane is open to any in-cluster peer
     # (insecure gRPC dial, cmd/GPUMounter-master/main.go:82; no HTTP

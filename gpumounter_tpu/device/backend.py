@@ -57,6 +57,24 @@ class DeviceBackend(abc.ABC):
         return scan_proc_for_device(device.major, device.minor,
                                     path_hint=device.device_path)
 
+    def probe_device(self, device: TpuDevice) -> tuple[bool, str]:
+        """(healthy, reason) for one chip — the worker-side health probe.
+
+        A chip is dead when its host device node vanished, stopped being
+        a character device, or changed identity (major:minor moved: the
+        driver re-enumerated and this handle now points elsewhere).
+        """
+        try:
+            major, minor, is_char = stat_device_numbers(device.device_path)
+        except OSError as exc:
+            return False, f"device node stat failed: {exc}"
+        if not is_char:
+            return False, "device node is no longer a character device"
+        if (major, minor) != (device.major, device.minor):
+            return False, (f"device identity changed: {major}:{minor} != "
+                           f"{device.major}:{device.minor}")
+        return True, ""
+
 
 class RealAccelBackend(DeviceBackend):
     """Enumerates accel-class TPU chardevs under device_dir (default /dev).
@@ -355,6 +373,24 @@ class FakeDeviceBackend(DeviceBackend):
         # Fake devices cloned from /dev/null share its rdev; rdev matching
         # would report every process holding /dev/null. Match by path only.
         return scan_proc_for_device(None, None, path_hint=device.device_path)
+
+    def mark_dead(self, rel: str, dead: bool = True) -> None:
+        """Fault injection: flag a fake node (e.g. "accel2") dead so
+        probe_device reports it unhealthy without disturbing enumeration
+        (a dead real chip usually still has its /dev node)."""
+        meta_path = os.path.join(self.root, self.META)
+        meta = self._meta()
+        meta.setdefault(rel, {})["dead"] = dead
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+
+    def probe_device(self, device: TpuDevice) -> tuple[bool, str]:
+        rel = os.path.relpath(device.device_path, self.root)
+        if self._meta().get(rel, {}).get("dead"):
+            return False, "chip marked dead (fault injection)"
+        if not os.path.exists(device.device_path):
+            return False, "device node missing"
+        return True, ""
 
 
 def scan_proc_for_device(major: int | None, minor: int | None,

@@ -1,0 +1,29 @@
+"""Elastic intent controller: declarative chip counts with health-probing,
+self-healing reconciliation. See intents.py (store), workqueue.py
+(backoff/rate-limit queue), reconciler.py (the loop)."""
+
+from gpumounter_tpu.elastic.intents import (
+    ANNOT_DESIRED,
+    ANNOT_MIN,
+    ANNOT_PRIORITY,
+    ANNOT_REPLACED,
+    Intent,
+    IntentError,
+    IntentStore,
+)
+from gpumounter_tpu.elastic.reconciler import ElasticReconciler, ReconcileError
+from gpumounter_tpu.elastic.workqueue import BackoffPolicy, RateLimitedQueue
+
+__all__ = [
+    "ANNOT_DESIRED",
+    "ANNOT_MIN",
+    "ANNOT_PRIORITY",
+    "ANNOT_REPLACED",
+    "BackoffPolicy",
+    "ElasticReconciler",
+    "Intent",
+    "IntentError",
+    "IntentStore",
+    "RateLimitedQueue",
+    "ReconcileError",
+]

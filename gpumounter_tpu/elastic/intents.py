@@ -1,0 +1,146 @@
+"""Intent store: declarative per-pod chip counts, persisted as annotations.
+
+No reference analog — GPUMounter is purely imperative (one /addgpu call
+per mount; SURVEY.md §5 "no reconciliation at all"). Here clients declare
+*desired* state and the reconciler converges toward it, the way FlexNPU
+reallocates accelerators between colocated workloads (PAPERS.md).
+
+The store has no database: the pod object IS the record. Intents live in
+annotations on the target pod (`tpumounter.io/desired-chips`, ...), so
+
+  * they survive master restarts and re-elections for free,
+  * `kubectl annotate` is a valid (if raw) client,
+  * deleting the pod deletes its intent — no orphaned desires.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("elastic.intents")
+
+ANNOT_DESIRED = "tpumounter.io/desired-chips"
+ANNOT_MIN = "tpumounter.io/min-chips"
+ANNOT_PRIORITY = "tpumounter.io/priority"
+#: stamped by the reconciler after a heal; jaxside watches it to trigger
+#: the HotResumable pack/restore cycle (jaxside/heal.py).
+ANNOT_REPLACED = "tpumounter.io/chip-replaced"
+
+
+class IntentError(ValueError):
+    """Client-supplied intent is malformed (maps to HTTP 400)."""
+
+
+@dataclass(frozen=True)
+class Intent:
+    desired_chips: int
+    #: acceptable floor under capacity pressure: the reconciler keeps
+    #: retrying for desired_chips but treats >= min_chips as "degraded",
+    #: not "failed". 0 = desired is all-or-nothing best effort.
+    min_chips: int = 0
+    #: higher reconciles first when the queue is contended.
+    priority: int = 0
+
+    def validate(self, max_chips: int) -> "Intent":
+        if not 0 <= self.desired_chips <= max_chips:
+            raise IntentError(
+                f"desired_chips must be 0..{max_chips}, "
+                f"got {self.desired_chips}")
+        if not 0 <= self.min_chips <= self.desired_chips:
+            raise IntentError(
+                f"min_chips must be 0..desired_chips "
+                f"({self.desired_chips}), got {self.min_chips}")
+        return self
+
+    @classmethod
+    def from_annotations(cls, annotations: dict[str, str]) -> "Intent | None":
+        raw = annotations.get(ANNOT_DESIRED)
+        if raw is None:
+            return None
+        try:
+            return cls(desired_chips=int(raw),
+                       min_chips=int(annotations.get(ANNOT_MIN, "0")),
+                       priority=int(annotations.get(ANNOT_PRIORITY, "0")))
+        except ValueError as exc:
+            raise IntentError(f"malformed intent annotations: {exc}")
+
+    def to_annotations(self) -> dict[str, str]:
+        return {ANNOT_DESIRED: str(self.desired_chips),
+                ANNOT_MIN: str(self.min_chips),
+                ANNOT_PRIORITY: str(self.priority)}
+
+    def to_json(self) -> dict:
+        return {"desiredChips": self.desired_chips,
+                "minChips": self.min_chips, "priority": self.priority}
+
+    @classmethod
+    def from_json(cls, payload: dict) -> "Intent":
+        if not isinstance(payload, dict):
+            raise IntentError('body must be a JSON object with "desiredChips"')
+        try:
+            desired = int(payload["desiredChips"])
+            minimum = int(payload.get("minChips", 0))
+            priority = int(payload.get("priority", 0))
+        except KeyError:
+            raise IntentError('missing required field "desiredChips"')
+        except (TypeError, ValueError) as exc:
+            raise IntentError(f"intent fields must be integers: {exc}")
+        return cls(desired_chips=desired, min_chips=minimum,
+                   priority=priority)
+
+
+class IntentStore:
+    """CRUD over intent annotations. Raises k8s NotFoundError when the
+    target pod does not exist (the intent has nothing to live on)."""
+
+    def __init__(self, kube: KubeClient, cfg=None):
+        self.kube = kube
+        self.cfg = cfg or get_config()
+
+    def put(self, namespace: str, pod_name: str, intent: Intent) -> Intent:
+        intent.validate(self.cfg.max_tpu_per_request)
+        self.kube.patch_pod(namespace, pod_name, {
+            "metadata": {"annotations": intent.to_annotations()}})
+        logger.info("intent set: %s/%s desired=%d min=%d priority=%d",
+                    namespace, pod_name, intent.desired_chips,
+                    intent.min_chips, intent.priority)
+        return intent
+
+    def get(self, namespace: str, pod_name: str) -> Intent | None:
+        pod = Pod(self.kube.get_pod(namespace, pod_name))
+        return Intent.from_annotations(pod.annotations)
+
+    def delete(self, namespace: str, pod_name: str) -> bool:
+        """Remove the intent (and the heal marker); the pod keeps its
+        currently-mounted chips — deletion stops management, it does not
+        unmount. Returns whether an intent was present."""
+        pod = Pod(self.kube.get_pod(namespace, pod_name))
+        had = ANNOT_DESIRED in pod.annotations
+        self.kube.patch_pod(namespace, pod_name, {
+            "metadata": {"annotations": {
+                ANNOT_DESIRED: None, ANNOT_MIN: None,
+                ANNOT_PRIORITY: None, ANNOT_REPLACED: None}}})
+        if had:
+            logger.info("intent deleted: %s/%s", namespace, pod_name)
+        return had
+
+    def list(self) -> list[tuple[str, str, Intent]]:
+        """Every (namespace, pod, intent) in the cluster — one LIST, used
+        by the reconciler's periodic resync."""
+        out = []
+        for pod_json in self.kube.list_pods():
+            pod = Pod(pod_json)
+            try:
+                intent = Intent.from_annotations(pod.annotations)
+            except IntentError as exc:
+                logger.warning("skipping malformed intent on %s/%s: %s",
+                               pod.namespace, pod.name, exc)
+                continue
+            if intent is not None:
+                out.append((pod.namespace, pod.name, intent))
+        return out

@@ -1,0 +1,390 @@
+"""Elastic reconciler: converge actual chip counts toward declared intents.
+
+Master-side control loop, the controller-pattern counterpart of the
+imperative /addtpu route:
+
+    intent (pod annotations)      actual (worker's ProbeTPU RPC)
+              \\                        /
+               diff -> plan -> drive AddTPU / RemoveTPU
+                        |
+             workqueue: per-pod keys, exponential backoff
+             with jitter on failure, global rate limit
+
+Healing: the prober reports a chip dead (host node vanished/changed, or
+the injected node disappeared from the target's /dev) -> the reconciler
+force-removes it, mounts a healthy replacement through the slice
+coordinator's all-or-nothing path, posts a TPUChipReplaced Event on the
+owner pod, and stamps `tpumounter.io/chip-replaced` — the annotation
+jaxside watches to trigger its HotResumable pack/restore cycle (the
+CRIUgpu stance from PAPERS.md: accelerator state survives disruption).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.elastic.intents import (
+    ANNOT_REPLACED,
+    Intent,
+    IntentError,
+    IntentStore,
+)
+from gpumounter_tpu.elastic.workqueue import BackoffPolicy, RateLimitedQueue
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("elastic.reconciler")
+
+RECONCILE_DURATION = REGISTRY.histogram(
+    "tpumounter_reconcile_duration_seconds",
+    "Wall time of one reconcile pass")
+RECONCILE_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpumounter_reconcile_queue_depth",
+    "Pods waiting in the elastic reconcile workqueue")
+CHIPS_HEALED = REGISTRY.counter(
+    "tpumounter_chips_healed_total",
+    "Dead chips replaced with healthy ones by the reconciler")
+INTENTS_REGISTERED = REGISTRY.gauge(
+    "tpumounter_intents_registered",
+    "Pods with a declared elastic intent")
+
+
+class ReconcileError(RuntimeError):
+    """One pass failed; the key re-enters the queue with backoff."""
+
+
+def _post_pod_event(kube: KubeClient, pod: Pod, reason: str, message: str,
+                    event_type: str = "Normal") -> None:
+    """Best-effort k8s Event from the elastic controller (mirrors the
+    worker's event shape, different source component)."""
+    import secrets as _secrets
+
+    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    manifest = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{pod.name[:200]}.tpumounter.{_secrets.token_hex(4)}",
+            "namespace": pod.namespace,
+        },
+        "involvedObject": {"kind": "Pod", "name": pod.name,
+                           "namespace": pod.namespace, "uid": pod.uid},
+        "reason": reason,
+        "message": message[:1024],
+        "type": event_type,
+        "source": {"component": "tpumounter-elastic"},
+        "firstTimestamp": ts,
+        "lastTimestamp": ts,
+        "count": 1,
+    }
+    try:
+        kube.create_event(pod.namespace, manifest)
+    except Exception as exc:  # noqa: BLE001 — events are advisory
+        logger.debug("event post failed: %s", exc)
+
+
+class ElasticReconciler:
+    def __init__(self, kube: KubeClient, registry, client_factory,
+                 cfg=None, store: IntentStore | None = None,
+                 backoff: BackoffPolicy | None = None):
+        """registry/client_factory: the MasterApp's WorkerRegistry and
+        worker-client factory — the reconciler drives the same RPCs the
+        imperative routes do."""
+        self.cfg = cfg or get_config()
+        self.kube = kube
+        self.registry = registry
+        self.client_factory = client_factory
+        self.store = store or IntentStore(kube, self.cfg)
+        self.queue = RateLimitedQueue(
+            backoff=backoff or BackoffPolicy(
+                base_s=self.cfg.elastic_backoff_base_s,
+                cap_s=self.cfg.elastic_backoff_cap_s),
+            min_interval_s=self.cfg.elastic_min_reconcile_interval_s,
+            depth_gauge=RECONCILE_QUEUE_DEPTH)
+        self.resync_interval_s = self.cfg.elastic_resync_interval_s
+        #: key -> last outcome (served by GET /intents for observability)
+        self.status: dict[str, dict] = {}
+        #: key -> monotonic timestamps of recent passes (bounded; lets
+        #: tests assert backoff spreads attempts instead of hot-looping)
+        self.attempts: dict[str, list[float]] = {}
+        #: key -> dead-chip uuids removed in passes whose replacement
+        #: mount has not yet landed: a heal split across passes (remove
+        #: succeeded, grow failed, retry mounted) must still be recorded
+        #: — dropping it would leave jaxside unaware it has to repack.
+        self._pending_heal: dict[str, list[str]] = {}
+        self._status_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle ---
+
+    def start(self) -> "ElasticReconciler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="elastic-reconciler",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def enqueue(self, namespace: str, pod_name: str,
+                priority: int = 0) -> None:
+        self.queue.add(f"{namespace}/{pod_name}", priority=priority)
+
+    def status_for(self, namespace: str, pod_name: str) -> dict | None:
+        with self._status_lock:
+            entry = self.status.get(f"{namespace}/{pod_name}")
+            return dict(entry) if entry else None
+
+    # --- the loop ---
+
+    def _loop(self) -> None:
+        next_resync = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now >= next_resync:
+                self._resync()
+                next_resync = now + self.resync_interval_s
+            key = self.queue.get(
+                timeout_s=min(0.2, max(0.01, next_resync - now)))
+            if key is not None:
+                self._process(key)
+
+    def _resync(self) -> None:
+        try:
+            intents = self.store.list()
+        except Exception as exc:  # noqa: BLE001 — keep the loop alive
+            logger.warning("intent resync LIST failed: %s", exc)
+            return
+        INTENTS_REGISTERED.set(float(len(intents)))
+        for namespace, pod_name, intent in intents:
+            self.enqueue(namespace, pod_name, priority=intent.priority)
+
+    def _process(self, key: str) -> None:
+        namespace, _, pod_name = key.partition("/")
+        started = time.monotonic()
+        with self._status_lock:
+            self.attempts.setdefault(key, []).append(started)
+            del self.attempts[key][:-50]
+        try:
+            outcome = self.reconcile_once(namespace, pod_name)
+        except Exception as exc:  # noqa: BLE001 — backoff instead of dying
+            if not isinstance(exc, ReconcileError):
+                logger.exception("unexpected reconcile failure for %s", key)
+            delay = self.queue.retry(key)
+            outcome = {"phase": "backoff", "error": str(exc),
+                       "retry_in_s": round(delay, 3),
+                       "failures": self.queue.failures(key)}
+            logger.warning("reconcile %s failed (%s); retry in %.2fs",
+                           key, exc, delay)
+        else:
+            if outcome.get("phase") == "degraded":
+                # Converged to >= min_chips but < desired: keep trying
+                # for desired on the backoff schedule.
+                self.queue.retry(key)
+            else:
+                self.queue.forget(key)
+        finally:
+            RECONCILE_DURATION.observe(time.monotonic() - started)
+        with self._status_lock:
+            if outcome.get("phase") == "gone":
+                self.status.pop(key, None)
+                self.attempts.pop(key, None)
+            else:
+                outcome["at"] = time.time()
+                self.status[key] = outcome
+
+    # --- one convergence pass (public: tests drive it directly) ---
+
+    def reconcile_once(self, namespace: str, pod_name: str) -> dict:
+        key = f"{namespace}/{pod_name}"
+        try:
+            pod = Pod(self.kube.get_pod(namespace, pod_name))
+        except NotFoundError:
+            self.queue.forget(key)
+            self._pending_heal.pop(key, None)
+            return {"phase": "gone"}
+        try:
+            intent = Intent.from_annotations(pod.annotations)
+        except IntentError as exc:
+            # Permanent config error (hand-edited annotation): retrying
+            # cannot fix it — park the key until the annotation changes
+            # (the resync will re-enqueue; this pass stays cheap).
+            self.queue.forget(key)
+            logger.warning("invalid intent on %s: %s", key, exc)
+            return {"phase": "invalid", "error": str(exc)}
+        if intent is None:
+            self.queue.forget(key)
+            return {"phase": "unmanaged"}
+        if not pod.node_name:
+            raise ReconcileError(f"pod {pod_name} is not scheduled yet")
+        address = self.registry.worker_address(pod.node_name)
+        if address is None:
+            raise ReconcileError(
+                f"no tpumounter worker on node {pod.node_name}")
+
+        chips = self._probe(address, pod)
+        dead = [c for c in chips if not c.healthy]
+        healthy = [c for c in chips if c.healthy]
+
+        removed_now = self._remove_chips(
+            address, pod, [c.uuid for c in dead], force=True)
+        # Journal removals BEFORE attempting the replacement mount: if
+        # this pass dies in _grow, the retry pass sees no dead chips any
+        # more, and without the journal the heal would never be recorded
+        # (no chip-replaced marker -> jaxside never repacks).
+        pending = self._pending_heal.setdefault(key, [])
+        pending.extend(u for u in removed_now if u not in pending)
+        removed_dead = list(pending)
+
+        actual = len(healthy)
+        desired = intent.desired_chips
+        degraded = False
+        if actual < desired:
+            degraded = not self._grow(address, pod, intent,
+                                      desired - actual, actual)
+        elif actual > desired:
+            # Declarative scale-down: force is the designed path — libtpu
+            # holds chips for the life of the JAX process, so a polite
+            # remove would always report Busy (SURVEY.md §7).
+            excess = [c.uuid for c in healthy[desired:]]
+            self._remove_chips(address, pod, excess, force=True)
+
+        after = self._probe(address, pod)
+        healthy_after = [c for c in after if c.healthy]
+        added = sorted({c.uuid for c in healthy_after}
+                       - {c.uuid for c in healthy})
+        if removed_dead:
+            self._record_heal(pod, removed_dead, added)
+            self._pending_heal.pop(key, None)
+
+        outcome = {
+            "phase": "degraded" if degraded else "converged",
+            "desired": desired,
+            "actual": len(healthy_after),
+            "healed": len(removed_dead),
+            "removed_dead": removed_dead,
+            "added": added,
+        }
+        if not degraded and len(healthy_after) != desired:
+            # The cluster moved under us between probe and re-probe;
+            # surface it and let the backoff schedule re-drive.
+            raise ReconcileError(
+                f"post-reconcile count {len(healthy_after)} != desired "
+                f"{desired} for {namespace}/{pod_name}")
+        logger.info("reconciled %s/%s: %s", namespace, pod_name, outcome)
+        return outcome
+
+    # --- steps ---
+
+    def _probe(self, address: str, pod: Pod) -> list[api.ChipHealth]:
+        try:
+            with self.client_factory(address) as client:
+                result, chips = client.probe_tpu(pod.name, pod.namespace)
+        except Exception as exc:  # noqa: BLE001 — gRPC boundary
+            raise ReconcileError(f"probe RPC failed: {exc}")
+        if result != api.ProbeTPUResult.Success:
+            raise ReconcileError(f"probe returned {result.name}")
+        return chips
+
+    def _remove_chips(self, address: str, pod: Pod, uuids: list[str],
+                      force: bool) -> list[str]:
+        removed: list[str] = []
+        for uuid in uuids:
+            try:
+                with self.client_factory(address) as client:
+                    result = client.remove_tpu(pod.name, pod.namespace,
+                                               [uuid], force=force)
+            except Exception as exc:  # noqa: BLE001 — gRPC boundary
+                raise ReconcileError(f"remove of {uuid} failed: {exc}")
+            if result not in (api.RemoveTPUResult.Success,
+                              api.RemoveTPUResult.TPUNotFound):
+                raise ReconcileError(
+                    f"remove of {uuid} returned {result.name}")
+            removed.append(uuid)
+        return removed
+
+    def _grow(self, address: str, pod: Pod, intent: Intent, gap: int,
+              actual: int) -> bool:
+        """Mount `gap` chips through the slice coordinator's
+        all-or-nothing path (its rollback covers multi-chip deltas and
+        transport-level failures). Returns True when desired was reached,
+        False when only the min_chips floor could be satisfied."""
+        from gpumounter_tpu.master.slice_ops import (
+            SliceCoordinator,
+            SliceError,
+            SliceTarget,
+        )
+
+        coordinator = SliceCoordinator(self.kube, self.registry,
+                                       self.client_factory, self.cfg)
+        target = SliceTarget(namespace=pod.namespace, pod=pod.name)
+        try:
+            coordinator.mount_slice([target], gap, entire=False)
+            return True
+        except SliceError as exc:
+            if exc.status != 503:
+                raise ReconcileError(f"mount of {gap} chip(s) failed: {exc}")
+        # Capacity exhausted. Already at or above the declared floor:
+        # that is the documented "degraded, not failed" state — keep
+        # retrying for desired on the backoff schedule without alarming.
+        floor_gap = intent.min_chips - actual
+        if floor_gap <= 0:
+            logger.warning(
+                "capacity-limited: %s/%s holds %d >= min_chips %d "
+                "(desired %d); will keep retrying", pod.namespace,
+                pod.name, actual, intent.min_chips, intent.desired_chips)
+            return False
+        # Below the floor: a smaller mount may still satisfy it.
+        if floor_gap < gap:
+            try:
+                coordinator.mount_slice([target], floor_gap, entire=False)
+                logger.warning(
+                    "capacity-limited: %s/%s at min_chips floor %d "
+                    "(desired %d); will keep retrying", pod.namespace,
+                    pod.name, intent.min_chips, intent.desired_chips)
+                return False
+            except SliceError as exc:
+                raise ReconcileError(
+                    f"floor mount of {floor_gap} chip(s) failed: {exc}")
+        raise ReconcileError(
+            f"insufficient capacity for {gap} chip(s) "
+            f"(actual={actual}, min={intent.min_chips})")
+
+    def _record_heal(self, pod: Pod, removed: list[str],
+                     added: list[str]) -> None:
+        CHIPS_HEALED.inc(len(removed))
+        previous = {}
+        try:
+            previous = json.loads(pod.annotations.get(ANNOT_REPLACED, "{}"))
+        except ValueError:
+            pass
+        marker = {
+            "generation": int(previous.get("generation", 0)) + 1,
+            "removed": removed,
+            "added": added,
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        try:
+            self.kube.patch_pod(pod.namespace, pod.name, {
+                "metadata": {"annotations": {
+                    ANNOT_REPLACED: json.dumps(marker)}}})
+        except Exception as exc:  # noqa: BLE001 — marker is advisory
+            logger.warning("chip-replaced annotation patch failed: %s", exc)
+        _post_pod_event(
+            self.kube, pod, "TPUChipReplaced",
+            f"replaced {len(removed)} dead chip(s) "
+            f"{', '.join(removed)} with {', '.join(added) or '(pending)'}",
+            event_type="Warning")
+        logger.info("healed %s/%s: removed %s added %s",
+                    pod.namespace, pod.name, removed, added)

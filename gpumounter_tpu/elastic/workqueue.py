@@ -1,0 +1,144 @@
+"""Rate-limited workqueue with per-key exponential backoff.
+
+The shape client-go controllers are built on (workqueue +
+rate-limiter), reduced to what the elastic reconciler needs:
+
+  * per-pod keys, deduplicated while queued — N intent edits for one pod
+    cost one reconcile pass;
+  * per-key exponential backoff with jitter on failure, reset on
+    success — a pod whose mounts keep failing retries at 0.5s, 1s, 2s,
+    ... up to a cap instead of hot-looping the worker;
+  * a global floor between dequeues — one sick intent cannot starve the
+    API server or the workers of everything else;
+  * priority breaks ties among keys that are ready at the same moment.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    base_s: float = 0.5
+    factor: float = 2.0
+    cap_s: float = 60.0
+    #: fraction of the delay added uniformly at random, so a thundering
+    #: herd of same-aged failures decorrelates.
+    jitter: float = 0.1
+
+    def delay_for(self, failures: int) -> float:
+        if failures <= 0:
+            return 0.0
+        delay = min(self.base_s * self.factor ** (failures - 1), self.cap_s)
+        if self.jitter:
+            delay *= 1.0 + random.uniform(0.0, self.jitter)
+        return delay
+
+
+class RateLimitedQueue:
+    def __init__(self, backoff: BackoffPolicy | None = None,
+                 min_interval_s: float = 0.0,
+                 depth_gauge=None):
+        self.backoff = backoff or BackoffPolicy()
+        self.min_interval_s = min_interval_s
+        self._depth_gauge = depth_gauge
+        self._lock = threading.Condition()
+        self._heap: list[tuple[float, int, int, str]] = []  # (ready, -prio, seq, key)
+        self._queued: set[str] = set()
+        self._failures: dict[str, int] = {}
+        #: last declared priority per key — retries must keep competing
+        #: at the intent's priority, not fall back to 0.
+        self._priority: dict[str, int] = {}
+        self._seq = itertools.count()
+        self._last_pop = 0.0
+
+    # --- producers ---
+
+    def add(self, key: str, priority: int = 0, delay_s: float = 0.0) -> None:
+        """Enqueue; a key already waiting is not enqueued twice (but a key
+        currently being processed may re-queue — standard dirty/processing
+        workqueue semantics, collapsed to "dedupe while queued")."""
+        with self._lock:
+            self._priority[key] = priority
+            if key in self._queued:
+                return
+            self._queued.add(key)
+            heapq.heappush(self._heap, (time.monotonic() + delay_s,
+                                        -priority, next(self._seq), key))
+            self._update_depth()
+            self._lock.notify_all()
+
+    def retry(self, key: str, priority: int | None = None) -> float:
+        """Re-enqueue after a failure with the key's next backoff delay
+        (at its last declared priority unless overridden); returns the
+        delay chosen."""
+        with self._lock:
+            failures = self._failures.get(key, 0) + 1
+            self._failures[key] = failures
+            if priority is None:
+                priority = self._priority.get(key, 0)
+        delay = self.backoff.delay_for(failures)
+        self.add(key, priority=priority, delay_s=delay)
+        return delay
+
+    def forget(self, key: str) -> None:
+        """Success (or key gone): reset the key's backoff history.
+        The remembered priority goes too — the next add() (resync or
+        intent edit) re-declares it, and keys for deleted pods must not
+        accumulate state forever."""
+        with self._lock:
+            self._failures.pop(key, None)
+            if key not in self._queued:
+                self._priority.pop(key, None)
+
+    def failures(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+    # --- consumer ---
+
+    def get(self, timeout_s: float) -> str | None:
+        """Next ready key, honoring per-key ready times and the global
+        rate-limit floor; None when nothing becomes ready in time."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                wait = deadline - now
+                if self._heap:
+                    first_ready = max(self._heap[0][0],
+                                      self._last_pop + self.min_interval_s)
+                    if first_ready <= now:
+                        # Everything whose ready time has passed competes
+                        # on priority (the heap alone would serve oldest
+                        # first regardless of priority).
+                        ready = []
+                        while self._heap and self._heap[0][0] <= now:
+                            ready.append(heapq.heappop(self._heap))
+                        ready.sort(key=lambda item: (item[1], item[2]))
+                        chosen = ready.pop(0)
+                        for item in ready:
+                            heapq.heappush(self._heap, item)
+                        key = chosen[3]
+                        self._queued.discard(key)
+                        self._last_pop = now
+                        self._update_depth()
+                        return key
+                    wait = min(wait, first_ready - now)
+                if wait <= 0:
+                    return None
+                self._lock.wait(wait)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def _update_depth(self) -> None:  # caller holds _lock
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(float(len(self._heap)))

@@ -15,11 +15,17 @@ from gpumounter_tpu.jaxside.visibility import (
     wait_for_chips,
 )
 from gpumounter_tpu.jaxside.resume import HotResumable
+from gpumounter_tpu.jaxside.heal import (
+    chip_replacement,
+    watch_chip_replacements,
+)
 
 __all__ = [
     "chips_visible_in_dev",
+    "chip_replacement",
     "refresh_devices",
     "set_topology_env",
     "wait_for_chips",
+    "watch_chip_replacements",
     "HotResumable",
 ]

@@ -1,0 +1,101 @@
+"""Tenant-side hook for reconciler heals.
+
+When the elastic reconciler replaces a dead chip it stamps
+`tpumounter.io/chip-replaced` on the owner pod (elastic/reconciler.py).
+The running JAX process must react — the replacement chip is a different
+device, so the PJRT backend has to be rebuilt and live arrays repacked.
+That choreography already exists (HotResumable + wait_for_chips); this
+module is the trigger: watch the annotation and call back on each heal.
+
+    def on_heal(marker):
+        state = HotResumable.pack(params, opt_state)
+        wait_for_chips(expected_count)
+        params, opt_state = state.restore(build_mesh())
+
+    watch_chip_replacements(kube, "default", "trainer", on_heal)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections.abc import Callable
+
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxside.heal")
+
+ANNOT_REPLACED = "tpumounter.io/chip-replaced"  # mirror of elastic.intents
+
+
+def chip_replacement(annotations: dict[str, str]) -> dict | None:
+    """Parse the heal marker ({generation, removed, added, at}) or None."""
+    raw = annotations.get(ANNOT_REPLACED)
+    if not raw:
+        return None
+    try:
+        marker = json.loads(raw)
+    except ValueError:
+        logger.warning("unparseable %s annotation: %r", ANNOT_REPLACED, raw)
+        return None
+    return marker if isinstance(marker, dict) else None
+
+
+def watch_chip_replacements(kube: KubeClient, namespace: str, pod_name: str,
+                            on_replace: Callable[[dict], None],
+                            stop: threading.Event | None = None,
+                            watch_timeout_s: float = 30.0) -> None:
+    """Blocking loop: invoke on_replace(marker) every time the heal
+    marker's generation advances. The marker present at start is the
+    baseline — only NEW heals fire (a restarted tenant already built its
+    backend against the current chip set)."""
+    stop = stop or threading.Event()
+    try:
+        pod = Pod(kube.get_pod(namespace, pod_name))
+    except NotFoundError:
+        logger.warning("pod %s/%s not found; nothing to watch",
+                       namespace, pod_name)
+        return
+    baseline = chip_replacement(pod.annotations)
+    state = {"generation":
+             int(baseline.get("generation", 0)) if baseline else 0}
+
+    def _deliver(annotations: dict[str, str]) -> None:
+        marker = chip_replacement(annotations)
+        if marker is None:
+            return
+        generation = int(marker.get("generation", 0))
+        if generation > state["generation"]:
+            state["generation"] = generation
+            logger.info("chip heal observed (generation %d): %s",
+                        generation, marker)
+            on_replace(marker)
+
+    while not stop.is_set():
+        try:
+            # Subscribe FIRST, then re-read the pod: a heal stamped while
+            # the previous watch was down/closed is caught by the re-read,
+            # one stamped after it is already queued on the open watch —
+            # the same missed-event pattern KubeClient.wait_for_pod uses.
+            watch = kube.watch_pods(
+                namespace, field_selector=f"metadata.name={pod_name}",
+                timeout_s=watch_timeout_s)
+            try:
+                _deliver(Pod(kube.get_pod(namespace, pod_name)).annotations)
+            except NotFoundError:
+                logger.info("pod %s/%s deleted; heal watch ends",
+                            namespace, pod_name)
+                return
+            for etype, pod_json in watch:
+                if stop.is_set():
+                    return
+                if etype == "DELETED":
+                    logger.info("pod %s/%s deleted; heal watch ends",
+                                namespace, pod_name)
+                    return
+                _deliver(Pod(pod_json).annotations)
+        except Exception as exc:  # noqa: BLE001 — keep watching
+            logger.warning("heal watch failed (%s); retrying", exc)
+            stop.wait(1.0)

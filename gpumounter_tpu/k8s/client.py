@@ -75,6 +75,13 @@ class KubeClient(abc.ABC):
                   field_selector: str = "") -> list[dict]: ...
 
     @abc.abstractmethod
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        """RFC 7386 merge-patch: dicts merge recursively, an explicit None
+        deletes the key. Used to persist declarative state (elastic intent
+        annotations) on pods so it survives master restarts."""
+        ...
+
+    @abc.abstractmethod
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
                    resource_version: str = "") -> Iterator[tuple[str, dict]]:
@@ -167,7 +174,8 @@ class RestKubeClient(KubeClient):
     # --- low-level ---
 
     def _request(self, method: str, path: str, query: dict | None = None,
-                 body: dict | None = None, timeout: float = 30.0):
+                 body: dict | None = None, timeout: float = 30.0,
+                 content_type: str = "application/json"):
         import http.client
         qs = ("?" + urllib.parse.urlencode(query)) if query else ""
         conn = http.client.HTTPSConnection(self.host, self.port,
@@ -179,13 +187,15 @@ class RestKubeClient(KubeClient):
         payload = None
         if body is not None:
             payload = json.dumps(body)
-            headers["Content-Type"] = "application/json"
+            headers["Content-Type"] = content_type
         conn.request(method, path + qs, body=payload, headers=headers)
         return conn, conn.getresponse()
 
     def _json(self, method: str, path: str, query: dict | None = None,
-              body: dict | None = None) -> dict:
-        conn, resp = self._request(method, path, query, body)
+              body: dict | None = None,
+              content_type: str = "application/json") -> dict:
+        conn, resp = self._request(method, path, query, body,
+                                   content_type=content_type)
         try:
             data = resp.read().decode("utf-8", "replace")
             if resp.status >= 400:
@@ -208,6 +218,12 @@ class RestKubeClient(KubeClient):
                        query={"gracePeriodSeconds": grace_period_seconds})
         except NotFoundError:
             pass
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        return self._json("PATCH",
+                          f"/api/v1/namespaces/{namespace}/pods/{name}",
+                          body=patch,
+                          content_type="application/merge-patch+json")
 
     def create_event(self, namespace: str, manifest: dict) -> dict:
         return self._json("POST", f"/api/v1/namespaces/{namespace}/events",

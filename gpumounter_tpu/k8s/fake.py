@@ -44,6 +44,19 @@ def _match_field_selector(pod: dict, selector: str) -> bool:
     return True
 
 
+def _merge_patch(target: dict, patch: dict) -> None:
+    """RFC 7386 merge-patch, matching the API server's PATCH semantics
+    for application/merge-patch+json: None deletes, dicts recurse,
+    everything else replaces."""
+    for key, value in patch.items():
+        if value is None:
+            target.pop(key, None)
+        elif isinstance(value, dict) and isinstance(target.get(key), dict):
+            _merge_patch(target[key], value)
+        else:
+            target[key] = value
+
+
 class FakeKubeClient(KubeClient):
     def __init__(self, scheduler_hook: SchedulerHook | None = None,
                  scheduler_delay_s: float = 0.0,
@@ -167,6 +180,16 @@ class FakeKubeClient(KubeClient):
                 yield etype, copy.deepcopy(pod)
             if time.monotonic() >= deadline:
                 return
+
+    def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            _merge_patch(pod, copy.deepcopy(patch))
+            stored = copy.deepcopy(pod)
+        self._emit("MODIFIED", stored)
+        return stored
 
     def create_event(self, namespace: str, manifest: dict) -> dict:
         with self._lock:

@@ -215,6 +215,15 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/workers$"), "workers"),
     ("POST", re.compile(r"^/addslice$"), "addslice"),
     ("POST", re.compile(r"^/removeslice$"), "removeslice"),
+    # Elastic intents: declarative chip counts the reconciler converges
+    # toward (gpumounter_tpu/elastic/). CRUD over pod annotations.
+    ("GET", re.compile(r"^/intents$"), "intents_list"),
+    ("GET", re.compile(
+        r"^/intents/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "intent_get"),
+    ("PUT", re.compile(
+        r"^/intents/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "intent_put"),
+    ("DELETE", re.compile(
+        r"^/intents/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "intent_delete"),
 ]
 
 
@@ -253,6 +262,13 @@ class MasterApp:
         # the worker's gRPC interceptor checks.
         self._client_factory = worker_client_factory or (
             lambda addr: WorkerClient(addr, token=self._token))
+        # Elastic intent controller: constructed here so the routes and
+        # the loop share one store/queue; the loop thread only runs after
+        # an explicit elastic.start() (master/main.py — tests drive
+        # reconcile_once directly or start it themselves).
+        from gpumounter_tpu.elastic import ElasticReconciler
+        self.elastic = ElasticReconciler(
+            kube, self.registry, self._client_factory, cfg=self.cfg)
 
     # --- plumbing ---
 
@@ -384,6 +400,68 @@ class MasterApp:
                  sorted(self.registry.registry_snapshot().items())]
         return 200, "text/plain", "\n".join(lines) + "\n"
 
+    # --- elastic intents ---
+
+    def _intent_status(self, ns: str, pod: str, intent) -> dict:
+        entry = {"namespace": ns, "pod": pod, **intent.to_json()}
+        status = self.elastic.status_for(ns, pod)
+        if status is not None:
+            entry["status"] = status
+        return entry
+
+    def _route_intents_list(self, match, body, headers):
+        import json as jsonlib
+        items = [self._intent_status(ns, pod, intent)
+                 for ns, pod, intent in self.elastic.store.list()]
+        return 200, "application/json", \
+            jsonlib.dumps({"intents": items}, indent=1) + "\n"
+
+    def _route_intent_get(self, match, body, headers):
+        import json as jsonlib
+        ns, pod = match.group("ns"), match.group("pod")
+        try:
+            intent = self.elastic.store.get(ns, pod)
+        except NotFoundError:
+            raise _HttpError(404, f"No pod: {pod} in namespace: {ns}")
+        if intent is None:
+            raise _HttpError(404, f"no intent declared for {ns}/{pod}")
+        return 200, "application/json", \
+            jsonlib.dumps(self._intent_status(ns, pod, intent),
+                          indent=1) + "\n"
+
+    def _route_intent_put(self, match, body, headers):
+        import json as jsonlib
+
+        from gpumounter_tpu.elastic import Intent, IntentError
+        ns, pod = match.group("ns"), match.group("pod")
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        try:
+            intent = Intent.from_json(payload)
+            self.elastic.store.put(ns, pod, intent)
+        except IntentError as exc:
+            raise _HttpError(400, str(exc))
+        except NotFoundError:
+            raise _HttpError(404, f"No pod: {pod} in namespace: {ns}")
+        logger.info("intent declared: %s/%s -> %s", ns, pod,
+                    intent.to_json())
+        self.elastic.enqueue(ns, pod, priority=intent.priority)
+        return 200, "application/json", \
+            jsonlib.dumps(self._intent_status(ns, pod, intent),
+                          indent=1) + "\n"
+
+    def _route_intent_delete(self, match, body, headers):
+        import json as jsonlib
+        ns, pod = match.group("ns"), match.group("pod")
+        try:
+            had = self.elastic.store.delete(ns, pod)
+        except NotFoundError:
+            raise _HttpError(404, f"No pod: {pod} in namespace: {ns}")
+        return 200, "application/json", \
+            jsonlib.dumps({"deleted": had}) + "\n"
+
     def _route_add(self, match, body, headers):
         ns = match.group("ns")
         pod_name = match.group("pod")
@@ -475,6 +553,8 @@ def build_http_server(app: MasterApp, port: int | None = None,
 
         do_GET = _dispatch
         do_POST = _dispatch
+        do_PUT = _dispatch
+        do_DELETE = _dispatch
 
         def log_message(self, fmt, *args):
             logger.debug("http: " + fmt, *args)

@@ -21,12 +21,18 @@ def main() -> None:
     kube = default_client()
     app = MasterApp(kube, cfg=cfg)
     httpd = build_http_server(app)
-    logger.info("tpumounter master serving on :%d", cfg.master_port)
+    # The elastic loop re-reads intents from pod annotations on start, so
+    # declared desires survive master restarts with no extra store.
+    app.elastic.start()
+    logger.info("tpumounter master serving on :%d (elastic reconciler on, "
+                "resync %.0fs)", cfg.master_port,
+                cfg.elastic_resync_interval_s)
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        app.elastic.stop()
         httpd.shutdown()
 
 

@@ -60,6 +60,17 @@ def device_node_path(dev_dir: str, dev: TpuDevice) -> str:
     return os.path.join(dev_dir, dev.rel_path)
 
 
+def device_node_exists(path: str, pid: int | None = None) -> bool:
+    """Does the node exist — in the mount namespace of `pid` when given
+    (via nsexec's stat subcommand), else in ours? Used by the worker's
+    health prober to notice an injected node vanishing from a container."""
+    if pid is None:
+        return os.path.exists(path)
+    proc = subprocess.run([_nsexec_path(), "stat", str(pid), path],
+                          capture_output=True, text=True, timeout=30)
+    return proc.returncode == 0
+
+
 def _mknod_at(target_path: str, major: int, minor: int,
               source_path: str = "", pid: int | None = None) -> None:
     """Create one char device node (idempotent), parents included."""

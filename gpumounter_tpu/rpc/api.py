@@ -75,9 +75,48 @@ class RemoveTPUResponse(Message):
     ]
 
 
+# --- chip health probing (no reference analog) ---
+#
+# The elastic reconciler's eyes on each node: which chips does this pod
+# actually hold, and are they alive? "Alive" = the host device node still
+# stats as the same char device AND the injected node is still present in
+# the target's /dev; holder_count carries the /proc fd-scan result so
+# callers can distinguish a dead-but-held chip (JAX process wedged on it)
+# from an idle one.
+
+
+class ProbeTPUResult(enum.IntEnum):
+    Success = 0
+    PodNotFound = 1
+
+
+class ProbeTPURequest(Message):
+    FIELDS = [
+        Field(1, "pod_name", "string"),
+        Field(2, "namespace", "string"),
+    ]
+
+
+class ChipHealth(Message):
+    FIELDS = [
+        Field(1, "uuid", "string"),
+        Field(2, "healthy", "bool"),
+        Field(3, "reason", "string"),
+        Field(4, "holder_count", "int32"),
+    ]
+
+
+class ProbeTPUResponse(Message):
+    FIELDS = [
+        Field(1, "probe_tpu_result", "enum"),
+        Field(2, "chips", "message", repeated=True, message=ChipHealth),
+    ]
+
+
 # gRPC method descriptors: (service_full_name, method, request_cls, response_cls)
 ADD_SERVICE_TPU = "tpu_mount.AddTPUService"
 REMOVE_SERVICE_TPU = "tpu_mount.RemoveTPUService"
+PROBE_SERVICE_TPU = "tpu_mount.ProbeTPUService"  # our extension; no legacy name
 # Reference service names (api.proto:21-23, 43-45) for drop-in clients.
 ADD_SERVICE_LEGACY = "gpu_mount.AddGPUService"
 REMOVE_SERVICE_LEGACY = "gpu_mount.RemoveGPUService"
@@ -86,3 +125,4 @@ ADD_METHOD = "AddGPU"          # reference method name (api.proto:22)
 REMOVE_METHOD = "RemoveGPU"    # reference method name (api.proto:44)
 ADD_METHOD_TPU = "AddTPU"
 REMOVE_METHOD_TPU = "RemoveTPU"
+PROBE_METHOD_TPU = "ProbeTPU"

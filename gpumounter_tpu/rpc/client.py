@@ -44,6 +44,12 @@ class WorkerClient:
             f"/{rem_service}/{rem_method}",
             request_serializer=lambda m: m.encode(),
             response_deserializer=api.RemoveTPUResponse.decode)
+        # Probe has no legacy analog; a reference worker answers
+        # UNIMPLEMENTED, which callers treat as "health unknown".
+        self._probe = self._channel.unary_unary(
+            f"/{api.PROBE_SERVICE_TPU}/{api.PROBE_METHOD_TPU}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=api.ProbeTPUResponse.decode)
 
     def close(self) -> None:
         self._channel.close()
@@ -69,6 +75,14 @@ class WorkerClient:
             is_entire_mount=is_entire_mount), timeout=self.timeout_s,
             metadata=self._metadata)
         return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
+
+    def probe_tpu(self, pod_name: str, namespace: str,
+                  ) -> tuple[api.ProbeTPUResult, list[api.ChipHealth]]:
+        """(result, per-chip health for every chip the pod holds)."""
+        resp = self._probe(api.ProbeTPURequest(
+            pod_name=pod_name, namespace=namespace), timeout=self.timeout_s,
+            metadata=self._metadata)
+        return api.ProbeTPUResult(resp.probe_tpu_result), list(resp.chips)
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                    force: bool = False,

@@ -43,10 +43,13 @@ class _FakeNode:
         # chip id (device-plugin view) -> (namespace, pod) or None
         self.assignment: dict[str, tuple[str, str] | None] = {
             str(d.index): None for d in self.backend.list_devices()}
+        # chip ids killed via kill_chip: excluded from scheduling even
+        # after their owner releases them (a dead chip never heals).
+        self.dead: set[str] = set()
 
     def free_ids(self) -> list[str]:
         return sorted((cid for cid, o in self.assignment.items()
-                       if o is None), key=int)
+                       if o is None and cid not in self.dead), key=int)
 
 
 class FakeCluster:
@@ -153,6 +156,21 @@ class FakeCluster:
                 node.kubelet.claims = [
                     c for c in node.kubelet.claims
                     if not (c[0] == p.name and c[1] == p.namespace)]
+
+    # --- fault injection ---
+
+    def kill_chip(self, chip_id: int | str, node: str | None = None) -> None:
+        """Mark one chip dead: the fake backend's health probe reports it
+        unhealthy and the fake scheduler never assigns it again (real
+        dead chips don't resurrect). Any current owner keeps its claim —
+        exactly the state the elastic prober must detect and heal."""
+        target = self.node(node)
+        cid = str(chip_id)
+        with self._alloc_lock:
+            if cid not in target.assignment:
+                raise KeyError(f"no chip {cid} on node {target.name}")
+            target.dead.add(cid)
+        target.backend.mark_dead(f"accel{cid}")
 
     # --- convenience ---
 

@@ -40,6 +40,48 @@ class Counter:
         return lines
 
 
+@dataclass
+class Gauge:
+    """A value that can go up and down (queue depths, registered counts).
+
+    Unlike Counter, an unlabeled gauge renders 0 until first set so
+    scrapers see the series exist from process start.
+    """
+
+    name: str
+    help: str
+    _values: dict[tuple, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def get(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for key, val in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+        return lines
+
+
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
 
 
@@ -87,6 +129,12 @@ class Registry:
         with self._lock:
             self._metrics.append(c)
         return c
+
+    def gauge(self, name: str, help: str) -> Gauge:
+        g = Gauge(name, help)
+        with self._lock:
+            self._metrics.append(g)
+        return g
 
     def histogram(self, name: str, help: str, buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
         h = Histogram(name, help, buckets)

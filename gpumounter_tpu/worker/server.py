@@ -16,6 +16,7 @@ unchanged (rpc/api.py). Response enums match api.proto values exactly.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 from concurrent import futures
 
@@ -33,6 +34,7 @@ from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.worker.mounter import MountError, TpuBusyError, TpuMounter
 from gpumounter_tpu.cgroup.ebpf import device_rule
+from gpumounter_tpu.nsutil import ns as nsutil
 from gpumounter_tpu.utils.lazy_grpc import grpc
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.timing import PhaseTimer
@@ -159,6 +161,53 @@ class TpuMountService:
             f"(phases ms: {timer.summary_ms()})")
         return api.AddTPUResponse(add_tpu_result=api.AddTPUResult.Success,
                                   uuids=[d.uuid for d in devices])
+
+    # --- ProbeTPU (elastic health prober; no reference analog) ---
+
+    def probe_tpu(self, request: api.ProbeTPURequest,
+                  context: grpc.ServicerContext) -> api.ProbeTPUResponse:
+        """Per-chip health for everything the pod holds: stat the host
+        device node (backend.probe_device), verify the injected node is
+        still present in the target's /dev, and re-run the /proc holder
+        scan. Read-only — healing decisions belong to the master-side
+        reconciler, which owns the scheduler's books."""
+        try:
+            pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
+        except NotFoundError:
+            return api.ProbeTPUResponse(
+                probe_tpu_result=api.ProbeTPUResult.PodNotFound)
+        self.collector.update_status()
+        slave_names = {s.name for s in self.allocator.slave_pods_for(pod)}
+        devices = self.collector.get_pod_devices(
+            pod.name, pod.namespace, slave_pod_names=slave_names,
+            refresh=False)
+        try:
+            target = self.mounter.resolve_target(pod)
+        except MountError:
+            # Container gone/restarting: chip-level health is still
+            # reportable; the injected-node check just can't run.
+            target = None
+        chips = []
+        for dev in devices:
+            healthy, reason = self.collector.backend.probe_device(dev)
+            if healthy and target is not None:
+                injected = nsutil.device_node_path(target.dev_dir, dev)
+                present = (nsutil.device_node_exists(injected,
+                                                     pid=target.ns_pid)
+                           if target.ns_pid is not None
+                           else os.path.exists(injected))
+                if not present:
+                    healthy = False
+                    reason = "injected device node vanished from target /dev"
+            if target is not None:
+                holders = self.mounter.holder_pids(target, dev)
+            else:
+                holders = self.collector.backend.running_pids(dev)
+            chips.append(api.ChipHealth(uuid=dev.uuid, healthy=healthy,
+                                        reason=reason,
+                                        holder_count=len(holders)))
+        return api.ProbeTPUResponse(
+            probe_tpu_result=api.ProbeTPUResult.Success, chips=chips)
 
     # --- RemoveTPU (reference: server.go:101-179) ---
 
@@ -350,12 +399,14 @@ def build_server(service: TpuMountService, port: int | None = None,
 
     add = _handler(service.add_tpu, api.AddTPURequest)
     remove = _handler(service.remove_tpu, api.RemoveTPURequest)
+    probe = _handler(service.probe_tpu, api.ProbeTPURequest)
     registrations = {
         api.ADD_SERVICE_TPU: {api.ADD_METHOD_TPU: add, api.ADD_METHOD: add},
         api.ADD_SERVICE_LEGACY: {api.ADD_METHOD: add},
         api.REMOVE_SERVICE_TPU: {api.REMOVE_METHOD_TPU: remove,
                                  api.REMOVE_METHOD: remove},
         api.REMOVE_SERVICE_LEGACY: {api.REMOVE_METHOD: remove},
+        api.PROBE_SERVICE_TPU: {api.PROBE_METHOD_TPU: probe},
     }
     for service_name, methods in registrations.items():
         server.add_generic_rpc_handlers(

@@ -1,0 +1,236 @@
+"""Elastic intent store + workqueue + master /intents routes + CLI.
+
+The declarative half of the elastic subsystem, hermetic on the fake kube
+client: intents persist as pod annotations (surviving master restarts),
+the workqueue spreads retries exponentially, and the HTTP/CLI surfaces
+speak the same store.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from conftest import AUTH_HEADER
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.elastic import (
+    ANNOT_DESIRED,
+    BackoffPolicy,
+    Intent,
+    IntentError,
+    IntentStore,
+    RateLimitedQueue,
+)
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.k8s.types import Pod
+
+
+def _pod(name, namespace="default"):
+    return {
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"containers": [{"name": "main"}]},
+    }
+
+
+@pytest.fixture()
+def kube():
+    client = FakeKubeClient()
+    client.create_pod("default", _pod("trainer"))
+    return client
+
+
+# --- Intent + store ---
+
+
+def test_intent_annotation_roundtrip():
+    intent = Intent(desired_chips=4, min_chips=2, priority=7)
+    assert Intent.from_annotations(intent.to_annotations()) == intent
+    assert Intent.from_annotations({}) is None
+
+
+def test_intent_validation():
+    with pytest.raises(IntentError):
+        Intent(desired_chips=-1).validate(64)
+    with pytest.raises(IntentError):
+        Intent(desired_chips=65).validate(64)
+    with pytest.raises(IntentError):
+        Intent(desired_chips=2, min_chips=3).validate(64)
+    with pytest.raises(IntentError):
+        Intent.from_json({"desiredChips": "lots"})
+    with pytest.raises(IntentError):
+        Intent.from_json({})
+
+
+def test_store_crud_persists_as_annotations(kube):
+    store = IntentStore(kube, Config())
+    assert store.get("default", "trainer") is None
+    store.put("default", "trainer", Intent(desired_chips=4, min_chips=2))
+
+    # The pod object IS the record.
+    pod = Pod(kube.get_pod("default", "trainer"))
+    assert pod.annotations[ANNOT_DESIRED] == "4"
+
+    # A fresh store (= restarted master) sees the same intent: no other
+    # persistence exists to lose.
+    restarted = IntentStore(kube, Config())
+    assert restarted.get("default", "trainer") == \
+        Intent(desired_chips=4, min_chips=2)
+    assert restarted.list() == [
+        ("default", "trainer", Intent(desired_chips=4, min_chips=2))]
+
+    assert restarted.delete("default", "trainer") is True
+    assert restarted.get("default", "trainer") is None
+    assert restarted.delete("default", "trainer") is False
+    assert ANNOT_DESIRED not in \
+        Pod(kube.get_pod("default", "trainer")).annotations
+
+
+def test_store_list_skips_malformed(kube):
+    kube.patch_pod("default", "trainer", {
+        "metadata": {"annotations": {ANNOT_DESIRED: "many"}}})
+    assert IntentStore(kube, Config()).list() == []
+
+
+# --- workqueue ---
+
+
+def test_workqueue_dedupes_and_orders_by_priority():
+    q = RateLimitedQueue(backoff=BackoffPolicy(jitter=0.0))
+    q.add("a/low", priority=0)
+    q.add("a/low", priority=0)  # duplicate collapses
+    q.add("b/high", priority=5)
+    assert q.depth() == 2
+    assert q.get(1.0) == "b/high"
+    assert q.get(1.0) == "a/low"
+    assert q.get(0.05) is None
+
+
+def test_workqueue_backoff_grows_and_resets():
+    policy = BackoffPolicy(base_s=0.5, factor=2.0, cap_s=4.0, jitter=0.0)
+    assert [policy.delay_for(n) for n in (0, 1, 2, 3, 4, 10)] == \
+        [0.0, 0.5, 1.0, 2.0, 4.0, 4.0]
+
+    q = RateLimitedQueue(backoff=policy)
+    assert q.retry("k") == 0.5
+    assert q.get(1.0) == "k"
+    assert q.retry("k") == 1.0
+    assert q.get(2.0) == "k"
+    q.forget("k")
+    assert q.retry("k") == 0.5  # history reset
+
+
+def test_workqueue_retry_preserves_declared_priority():
+    """A retry without an explicit priority keeps competing at the key's
+    last declared priority (not a silent fall-back to 0)."""
+    q = RateLimitedQueue(backoff=BackoffPolicy(base_s=0.0, jitter=0.0))
+    q.add("high", priority=5)
+    assert q.get(1.0) == "high"
+    q.retry("high")          # failure path: no priority argument
+    q.add("low", priority=0)
+    assert q.get(1.0) == "high"
+
+
+def test_workqueue_rate_limit_spaces_dequeues():
+    q = RateLimitedQueue(min_interval_s=0.1)
+    q.add("a")
+    q.add("b")
+    t0 = time.monotonic()
+    assert q.get(1.0) is not None
+    assert q.get(1.0) is not None
+    assert time.monotonic() - t0 >= 0.1
+
+
+def test_workqueue_depth_gauge():
+    from gpumounter_tpu.utils.metrics import Gauge
+    gauge = Gauge("test_depth", "d")
+    q = RateLimitedQueue(depth_gauge=gauge)
+    q.add("x")
+    q.add("y")
+    assert gauge.get() == 2.0
+    q.get(1.0)
+    assert gauge.get() == 1.0
+
+
+def test_gauge_renders_prometheus_text():
+    from gpumounter_tpu.utils.metrics import Gauge
+    g = Gauge("tpumounter_test_gauge", "help text")
+    assert "tpumounter_test_gauge 0" in "\n".join(g.collect())
+    g.set(3, kind="x")
+    g.inc(2, kind="x")
+    g.dec(1, kind="x")
+    out = "\n".join(g.collect())
+    assert "# TYPE tpumounter_test_gauge gauge" in out
+    assert 'tpumounter_test_gauge{kind="x"} 4.0' in out
+
+
+# --- master routes + CLI (no worker needed for intent CRUD) ---
+
+
+@pytest.fixture()
+def app(kube):
+    from gpumounter_tpu.master.app import MasterApp
+    return MasterApp(kube, cfg=Config())
+
+
+def _call(app, method, path, body=b"", auth=True):
+    headers = dict(AUTH_HEADER) if auth else {}
+    return app.handle(method, path, body, headers)
+
+
+def test_intent_routes_crud(app, kube):
+    status, _, body = _call(app, "PUT", "/intents/default/trainer",
+                            json.dumps({"desiredChips": 4,
+                                        "minChips": 2}).encode())
+    assert status == 200, body
+    assert json.loads(body)["desiredChips"] == 4
+
+    status, _, body = _call(app, "GET", "/intents/default/trainer")
+    assert status == 200 and json.loads(body)["minChips"] == 2
+
+    status, _, body = _call(app, "GET", "/intents")
+    assert status == 200
+    assert [i["pod"] for i in json.loads(body)["intents"]] == ["trainer"]
+
+    # declaring also enqueues the pod for reconciliation
+    assert app.elastic.queue.depth() == 1
+
+    status, _, body = _call(app, "DELETE", "/intents/default/trainer")
+    assert status == 200 and json.loads(body)["deleted"] is True
+    assert _call(app, "GET", "/intents/default/trainer")[0] == 404
+
+
+def test_intent_routes_reject_bad_input(app):
+    assert _call(app, "PUT", "/intents/default/trainer", b"not json")[0] == 400
+    assert _call(app, "PUT", "/intents/default/trainer",
+                 json.dumps({"desiredChips": -2}).encode())[0] == 400
+    assert _call(app, "PUT", "/intents/default/ghost",
+                 json.dumps({"desiredChips": 1}).encode())[0] == 404
+    assert _call(app, "GET", "/intents/default/ghost")[0] == 404
+    # mutating the intent plane requires the bearer token
+    assert _call(app, "PUT", "/intents/default/trainer",
+                 json.dumps({"desiredChips": 1}).encode(),
+                 auth=False)[0] == 401
+    assert _call(app, "GET", "/intents", auth=False)[0] == 401
+
+
+def test_intent_cli_verbs(app):
+    """tpumounter intent set/get/list/delete against a live master."""
+    from gpumounter_tpu.cli import main as cli_main
+    from gpumounter_tpu.master.app import build_http_server
+
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        common = ["--master", base, "--pod", "trainer"]
+        assert cli_main(["intent", "set", *common, "--chips", "3",
+                         "--min-chips", "1", "--priority", "2"]) == 0
+        assert cli_main(["intent", "get", *common]) == 0
+        assert cli_main(["intent", "list", "--master", base]) == 0
+        assert cli_main(["intent", "delete", *common]) == 0
+        assert cli_main(["intent", "get", *common]) == 1  # gone now
+    finally:
+        httpd.shutdown()

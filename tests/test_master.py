@@ -239,6 +239,62 @@ def test_busy_maps_to_400(stack):
         holder.close()
 
 
+def test_registry_recovers_after_dropped_watch(tmp_path):
+    """Robustness: a watch stream that starts failing must not blind the
+    registry — a worker arriving while the watch is down is found via the
+    rate-limited miss re-LIST (_miss_refresh), and once the watch comes
+    back the loop resumes streaming deltas."""
+    import time as _time
+
+    cluster = FakeCluster(str(tmp_path), n_chips=1).start()
+    try:
+        cfg = cluster.cfg
+        kube = cluster.kube
+        kube.create_pod(cfg.worker_namespace,
+                        _worker_pod("w1", "node-a", "10.0.0.1",
+                                    cfg.worker_namespace))
+        reg = WorkerRegistry(kube, cfg)
+        try:
+            assert reg.worker_address("node-a") is not None
+
+            orig_watch = kube.watch_pods
+            broken = threading.Event()
+            broken.set()
+
+            def flaky_watch(*args, **kwargs):
+                if broken.is_set():
+                    raise RuntimeError("watch dropped (apiserver restart)")
+                return orig_watch(*args, **kwargs)
+
+            kube.watch_pods = flaky_watch
+            # A brand-new worker lands while the watch is down: the read
+            # path must heal via one rate-limited re-LIST, not 500.
+            kube.create_pod(cfg.worker_namespace,
+                            _worker_pod("w2", "node-b", "10.0.0.2",
+                                        cfg.worker_namespace))
+            reg._last_list = -1e9  # age the stamp: allow the miss re-LIST
+            assert reg.worker_address("node-b") == \
+                f"10.0.0.2:{cfg.worker_port}"
+            # Watch restored: the loop re-opens and streams deltas again.
+            broken.clear()
+            kube.create_pod(cfg.worker_namespace,
+                            _worker_pod("w3", "node-c", "10.0.0.3",
+                                        cfg.worker_namespace))
+            deadline = _time.monotonic() + 8.0
+            while _time.monotonic() < deadline:
+                with reg._lock:
+                    if "node-c" in reg._cache:
+                        break
+                _time.sleep(0.05)
+            with reg._lock:
+                assert "node-c" in reg._cache, \
+                    "watch loop never recovered after the drop"
+        finally:
+            reg.stop()
+    finally:
+        cluster.stop()
+
+
 def test_registry_refresh_does_not_lose_racing_watch_event(tmp_path):
     """ADVICE r2 low: a watch DELETED applied between the LIST response
     and the cache swap must not be resurrected by the swap (it used to be

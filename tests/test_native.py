@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import stat as statmod
 import subprocess
 
 import pytest
@@ -27,15 +28,35 @@ def build_native():
                    capture_output=True)
 
 
+@pytest.fixture(scope="module")
+def mknod_capable(tmp_path_factory) -> bool:
+    """Probe for ACTUAL mknod capability. euid==0 is not sufficient:
+    unprivileged containers and user namespaces run as root without
+    CAP_MKNOD, where os.mknod raises a raw PermissionError — the tests
+    must skip cleanly there, not error."""
+    probe = str(tmp_path_factory.mktemp("mknod-probe") / "probe-node")
+    try:
+        null = os.stat("/dev/null")
+        os.mknod(probe, 0o666 | statmod.S_IFCHR, null.st_rdev)
+    except OSError:
+        return False
+    os.unlink(probe)
+    return True
+
+
+def _require_mknod(mknod_capable: bool) -> None:
+    if not mknod_capable:
+        pytest.skip("no CAP_MKNOD (unprivileged host/container)")
+
+
 def test_nsexec_usage_exit_code():
     proc = subprocess.run([NSEXEC], capture_output=True)
     assert proc.returncode == 2
 
 
-def test_nsexec_mknod_rm_own_ns(tmp_path):
+def test_nsexec_mknod_rm_own_ns(tmp_path, mknod_capable):
     """pid = our own: setns into our own mount ns, then mknod/stat/rm."""
-    if os.geteuid() != 0:
-        pytest.skip("needs CAP_MKNOD/CAP_SYS_ADMIN")
+    _require_mknod(mknod_capable)
     pid = str(os.getpid())
     node = str(tmp_path / "accel9")
     null = os.stat("/dev/null")
@@ -106,11 +127,10 @@ def test_native_scanner_matches_python(tmp_path):
         holder.close()
 
 
-def test_native_enum_accel(tmp_path):
+def test_native_enum_accel(tmp_path, mknod_capable):
     from gpumounter_tpu import native
     native.reset_for_tests()
-    if os.geteuid() != 0:
-        pytest.skip("needs mknod")
+    _require_mknod(mknod_capable)
     null = os.stat("/dev/null")
     for i in (0, 1, 3):
         os.mknod(str(tmp_path / f"accel{i}"), 0o666 | 0o020000, null.st_rdev)
@@ -132,10 +152,9 @@ def test_libtpu_probe_reports():
     assert report.startswith(("loaded:", "unavailable:"))
 
 
-def test_nsexec_via_nsutil(tmp_path, monkeypatch):
+def test_nsexec_via_nsutil(tmp_path, monkeypatch, mknod_capable):
     """nsutil drives nsexec end-to-end with pid set (own namespace)."""
-    if os.geteuid() != 0:
-        pytest.skip("needs CAP_MKNOD/CAP_SYS_ADMIN")
+    _require_mknod(mknod_capable)
     from gpumounter_tpu.device.tpu import TpuDevice
     from gpumounter_tpu.nsutil import ns as nsutil
 
