@@ -125,12 +125,22 @@ class TpuAllocator:
     # --- allocation (reference: GetAvailableGPU, allocator.go:40-96) ---
 
     def get_available_tpus(self, owner: Pod, total_tpu_num: int,
-                           tpu_num_per_pod: int) -> tuple[list[TpuDevice], list[str]]:
+                           tpu_num_per_pod: int,
+                           prefer_ici: bool = False,
+                           ) -> tuple[list[TpuDevice], list[str]]:
         """Create slave pods and return (devices, slave_pod_names).
 
         total_tpu_num must be divisible by tpu_num_per_pod (entire-mount:
         one slave holding all; single-mount: one slave per chip —
         server.go:61-66).
+
+        prefer_ici: allocate-and-trim toward an ICI-contiguous block
+        (allocator/placement.py). Only meaningful for single-chip slaves
+        — the device plugin picks the chips, so the only lever is to
+        claim a few MORE single-chip slaves than asked (bounded by
+        cfg.alloc_ici_slack, opportunistic: capacity exhaustion just
+        stops the widening), keep the best-connected subset, and release
+        the rest. Entire-mounts get whatever block the plugin assigned.
         """
         if total_tpu_num <= 0 or total_tpu_num % tpu_num_per_pod != 0:
             raise ValueError(
@@ -141,8 +151,13 @@ class TpuAllocator:
                 f"owner pod {owner.namespace}/{owner.name} is not scheduled")
         n_pods = total_tpu_num // tpu_num_per_pod
         with self._alloc_mutex:
-            return self._allocate_locked(owner, total_tpu_num,
-                                         tpu_num_per_pod, n_pods)
+            devices, created = self._allocate_locked(
+                owner, total_tpu_num, tpu_num_per_pod, n_pods)
+            if prefer_ici and tpu_num_per_pod == 1 \
+                    and self.cfg.alloc_ici_slack > 0:
+                devices, created = self._trim_to_ici_block(
+                    owner, devices, total_tpu_num)
+            return devices, created
 
     def _allocate_locked(self, owner: Pod, total_tpu_num: int,
                          tpu_num_per_pod: int,
@@ -181,6 +196,77 @@ class TpuAllocator:
         logger.info("allocated %d chip(s) via %d slave pod(s) for %s/%s",
                     len(devices), n_pods, owner.namespace, owner.name)
         return devices, created
+
+    def _trim_to_ici_block(self, owner: Pod, devices: list[TpuDevice],
+                           want: int,
+                           ) -> tuple[list[TpuDevice], list[str]]:
+        """Widen the candidate set with up to alloc_ici_slack extra
+        single-chip slaves, keep the `want` chips with the most internal
+        ICI links, release the others. Failure anywhere in the widening
+        never fails the allocation — the already-secured chips win.
+        Caller holds _alloc_mutex."""
+        from gpumounter_tpu.allocator import placement
+
+        # Batch-create the slack pods so they schedule concurrently,
+        # then wait per pod (tolerating Unschedulable individually) —
+        # a serial create+wait cycle per extra would hold _alloc_mutex
+        # for slack × pod-startup latency.
+        pending: list[str] = []
+        for _ in range(self.cfg.alloc_ici_slack):
+            try:
+                pending.append(Pod(self.kube.create_pod(
+                    self.cfg.pool_namespace,
+                    self._slave_pod_manifest(owner, 1))).name)
+            except Exception as exc:  # noqa: BLE001 — widening is optional
+                logger.warning("ICI widening create stopped: %s", exc)
+                break
+        extras: list[str] = []
+        for name in pending:
+            try:
+                self._wait_all_running([name])
+                extras.append(name)
+            except Exception as exc:  # noqa: BLE001 — widening is optional
+                try:
+                    self.delete_slave_pods([name], wait=False)
+                except Exception as undo_exc:  # noqa: BLE001
+                    logger.warning("slack slave %s cleanup failed "
+                                   "(reaper will catch it): %s",
+                                   name, undo_exc)
+                if not isinstance(exc, InsufficientTpuError):
+                    logger.warning("ICI widening stopped: %s", exc)
+        by_slave: dict[str, TpuDevice] = {d.pod_name: d for d in devices}
+        if extras:
+            try:
+                self.collector.update_status(strict=True)
+                for name in extras:
+                    devs = self.collector.get_slave_pod_devices(
+                        name, refresh=False)
+                    if len(devs) == 1:
+                        by_slave[name] = devs[0]
+            except Exception as exc:  # noqa: BLE001 — widening is optional
+                logger.warning("ICI widening readback failed: %s", exc)
+
+        candidates = sorted(by_slave.values(), key=lambda d: d.index)
+        chosen_idx = set(placement.best_block(
+            [d.index for d in candidates], want))
+        keep = [d for d in candidates if d.index in chosen_idx]
+        keep_slaves = {d.pod_name for d in keep}
+        # Release over (mapped ∪ created-extras): an extra whose device
+        # read-back failed is not in by_slave but still books a chip.
+        drop = sorted((set(by_slave) | set(extras)) - keep_slaves)
+        if drop:
+            logger.info(
+                "ICI placement for %s/%s: kept chips %s (score %d), "
+                "released %d slack slave(s)", owner.namespace, owner.name,
+                sorted(chosen_idx),
+                placement.contiguity_score(sorted(chosen_idx)), len(drop))
+            try:
+                self.delete_slave_pods(drop, wait=False)
+            except Exception as exc:  # noqa: BLE001
+                # The kept chips are secured; a release hiccup must not
+                # fail the allocation (the reaper sweeps orphans).
+                logger.warning("slack slave release failed: %s", exc)
+        return keep, sorted(keep_slaves)
 
     def _wait_all_running(self, names: list[str]) -> None:
         errors: dict[str, Exception] = {}

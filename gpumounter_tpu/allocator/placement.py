@@ -1,0 +1,87 @@
+"""ICI-aware chip placement: prefer blocks of chips joined by ICI links.
+
+No reference analog — GPUMounter picks whatever GPUs the scheduler hands
+it, which is fine over NVLink/PCIe but wasteful on TPU hosts: a v5e/v5p
+host arranges its chips on 2x2 trays in a physical grid, and collectives
+between chips that share an ICI link run at fabric speed while a
+scattered set bounces through extra hops. When a mount can choose among
+free chips (migration re-mounts, defragmentation), choosing the most
+ICI-connected block is free bandwidth.
+
+Host model: chip index i sits at grid coordinate (i % 2, i // 2) — the
+accel-device numbering on v5e/v5p single hosts walks the 2xN grid
+row-major (4-chip host = 2x2 tray pair, 8-chip host = 2x4). Two chips
+are ICI neighbors when their grid coordinates differ by one step in one
+axis. This deliberately models ONE host: cross-host placement is the
+slice coordinator's topology problem (master/topology.py), not the
+allocator's.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+
+def chip_coord(index: int) -> tuple[int, int]:
+    """Grid coordinate of a chip on its host (2-wide, row-major)."""
+    return index % 2, index // 2
+
+
+def ici_neighbors(a: int, b: int) -> bool:
+    """True when chips a and b share a direct ICI link on this host."""
+    ax, ay = chip_coord(a)
+    bx, by = chip_coord(b)
+    return abs(ax - bx) + abs(ay - by) == 1
+
+
+def contiguity_score(indices: list[int]) -> int:
+    """Number of intra-set ICI links — higher is better-connected.
+    A 2x2 block of 4 scores 4; the same 4 chips scattered score 0."""
+    return sum(1 for a, b in itertools.combinations(set(indices), 2)
+               if ici_neighbors(a, b))
+
+
+#: above this many candidate subsets, fall back to greedy growth —
+#: C(12,6)=924 is fine to enumerate, C(32,16) is not.
+_EXHAUSTIVE_LIMIT = 4096
+
+
+def best_block(free: list[int], want: int) -> list[int]:
+    """The `want`-sized subset of `free` with the most internal ICI
+    links; ties break toward the lowest indices (deterministic — a
+    retried allocation converges on the same chips). Returns a sorted
+    list; raises ValueError when free has fewer than want chips."""
+    free = sorted(set(free))
+    if want <= 0:
+        return []
+    if len(free) < want:
+        raise ValueError(f"need {want} chip(s), only {len(free)} free")
+    if len(free) == want:
+        return free
+
+    n_subsets = 1
+    for i in range(want):
+        n_subsets = n_subsets * (len(free) - i) // (i + 1)
+    if n_subsets <= _EXHAUSTIVE_LIMIT:
+        best = max(itertools.combinations(free, want),
+                   key=lambda c: (contiguity_score(list(c)),
+                                  [-i for i in c]))
+        return list(best)
+
+    # Greedy: grow from each seed by repeatedly adding the chip that
+    # gains the most links; keep the best-scoring grown set.
+    best_set: list[int] = []
+    best_score = -1
+    for seed in free:
+        chosen = [seed]
+        pool = [c for c in free if c != seed]
+        while len(chosen) < want:
+            gain = max(pool, key=lambda c: (
+                sum(1 for x in chosen if ici_neighbors(c, x)), -c))
+            chosen.append(gain)
+            pool.remove(gain)
+        score = contiguity_score(chosen)
+        if score > best_score:
+            best_score = score
+            best_set = sorted(chosen)
+    return best_set

@@ -13,9 +13,17 @@ Two families of verbs:
   QuickStart curl examples):
     add     --master URL --namespace NS --pod POD --num N [--entire]
     remove  --master URL --namespace NS --pod POD --uuids U,U [--force]
+    migrate start|status|abort     live chip migration between pods
 
 The reference has no CLI at all (interaction is raw curl,
 docs/guide/QuickStart.md).
+
+Exit codes (scriptable — a bad request is not a rollback):
+    0  success
+    1  generic error (transport failure, unexpected status)
+    2  request rejected before anything moved (source == destination,
+       unknown pod, already-migrating: any HTTP 4xx)
+    3  migration failed mid-flight (rolled back / failed / aborted)
 """
 
 from __future__ import annotations
@@ -219,6 +227,78 @@ def cmd_intent_list(args) -> int:
     return 0 if status == 200 else 1
 
 
+EXIT_OK = 0
+EXIT_ERROR = 1
+EXIT_REJECTED = 2    # 4xx: bad request, nothing moved
+EXIT_FAILED = 3      # migration went terminal without succeeding
+
+
+def _terminal_exit(journal: dict) -> int:
+    return EXIT_OK if journal.get("outcome") == "succeeded" else EXIT_FAILED
+
+
+def cmd_migrate_start(args) -> int:
+    import time
+
+    payload = {
+        "source": {"namespace": args.namespace, "pod": args.pod},
+        "destination": {"namespace": args.dest_namespace or args.namespace,
+                        "pod": args.dest_pod},
+    }
+    token = _remote_token(args)
+    status, body = _http("POST", f"{args.master.rstrip('/')}/migrate",
+                         json_body=payload, token=token)
+    print(body.rstrip())
+    if 400 <= status < 500:
+        return EXIT_REJECTED
+    if status != 200:
+        return EXIT_ERROR
+    if not args.wait:
+        return EXIT_OK
+    mid = json.loads(body)["id"]
+    deadline = time.monotonic() + args.wait_timeout
+    while time.monotonic() < deadline:
+        # Transient poll failures (master restarting, blip) must not
+        # abort the wait: the journal survives in pod annotations and a
+        # restarted master re-adopts the migration, so keep polling
+        # until the deadline.
+        try:
+            status, body = _http(
+                "GET", f"{args.master.rstrip('/')}/migrations/{mid}",
+                token=token)
+        except (urllib.error.URLError, OSError):
+            status = None
+        if status == 200:
+            journal = json.loads(body)
+            if journal.get("outcome"):
+                print(json.dumps(journal, indent=1))
+                return _terminal_exit(journal)
+        time.sleep(args.poll_interval)
+    print(f"error: migration {mid} not terminal within "
+          f"{args.wait_timeout}s", file=sys.stderr)
+    return EXIT_ERROR
+
+
+def cmd_migrate_status(args) -> int:
+    base = f"{args.master.rstrip('/')}/migrations"
+    url = f"{base}/{args.id}" if args.id else base
+    status, body = _http("GET", url, token=_remote_token(args))
+    print(body.rstrip())
+    if 400 <= status < 500:
+        return EXIT_REJECTED
+    return EXIT_OK if status == 200 else EXIT_ERROR
+
+
+def cmd_migrate_abort(args) -> int:
+    status, body = _http(
+        "POST", f"{args.master.rstrip('/')}/migrations/{args.id}/abort",
+        token=_remote_token(args))
+    print(body.rstrip())
+    if 400 <= status < 500:
+        return EXIT_REJECTED
+    return EXIT_OK if status == 200 else EXIT_ERROR
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpumounter")
     sub = p.add_subparsers(dest="verb", required=True)
@@ -298,6 +378,42 @@ def build_parser() -> argparse.ArgumentParser:
     ilist = it_sub.add_parser("list", help="all declared intents")
     _intent_common(ilist, with_pod=False)
     ilist.set_defaults(fn=cmd_intent_list)
+
+    # Live migration: drain, snapshot, and re-mount a tenant's chip set
+    # on another pod without restarting the tenant.
+    mg = sub.add_parser("migrate", help="live chip migration between pods")
+    mg_sub = mg.add_subparsers(dest="migrate_verb", required=True)
+
+    def _migrate_common(sp):
+        sp.add_argument("--master", required=True)
+        sp.add_argument("--token", default=None,
+                        help="master bearer token (default: "
+                             "TPUMOUNTER_AUTH_TOKEN[_FILE])")
+
+    ms = mg_sub.add_parser("start", help="migrate a pod's chips to "
+                                         "another pod")
+    _migrate_common(ms)
+    ms.add_argument("--namespace", default="default")
+    ms.add_argument("--pod", required=True, help="source pod")
+    ms.add_argument("--dest-namespace", default=None,
+                    help="destination namespace (default: --namespace)")
+    ms.add_argument("--dest-pod", required=True, help="destination pod")
+    ms.add_argument("--wait", action="store_true",
+                    help="block until the migration is terminal")
+    ms.add_argument("--wait-timeout", type=float, default=300.0)
+    ms.add_argument("--poll-interval", type=float, default=0.5)
+    ms.set_defaults(fn=cmd_migrate_start)
+
+    mst = mg_sub.add_parser("status", help="one migration (--id) or all")
+    _migrate_common(mst)
+    mst.add_argument("--id", default=None)
+    mst.set_defaults(fn=cmd_migrate_status)
+
+    mab = mg_sub.add_parser("abort", help="abort an in-flight migration "
+                                          "(rolls back to the source)")
+    _migrate_common(mab)
+    mab.add_argument("--id", required=True)
+    mab.set_defaults(fn=cmd_migrate_abort)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

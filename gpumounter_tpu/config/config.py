@@ -95,6 +95,29 @@ class Config:
         default_factory=lambda: float(
             _env("ELASTIC_MIN_RECONCILE_INTERVAL_S", "0.05")))
 
+    # --- live migration (master side) ---
+    # How long the orchestrator waits for the tenant's quiesce ack
+    # (jaxside.watch_migration pack + annotation) before draining anyway
+    # — RemoveTPU is forced either way, so a hookless tenant just loses
+    # the warm pack/restore path, not the migration.
+    migrate_quiesce_timeout_s: float = field(default_factory=lambda: float(
+        _env("MIGRATE_QUIESCE_TIMEOUT_S", "30")))
+    # How long to wait for the destination tenant's resume ack before
+    # declaring the downtime window closed at the signal instead.
+    migrate_resume_timeout_s: float = field(default_factory=lambda: float(
+        _env("MIGRATE_RESUME_TIMEOUT_S", "30")))
+    migrate_poll_interval_s: float = field(default_factory=lambda: float(
+        _env("MIGRATE_POLL_INTERVAL_S", "0.2")))
+
+    # --- ICI-aware placement (worker allocator) ---
+    # Extra single-chip slave pods the allocator may create opportunistically
+    # when asked to prefer ICI-contiguous chips: allocate-and-trim widens
+    # the candidate set, the best-connected block is kept, the rest are
+    # released. 0 disables over-allocation (the preference then only
+    # orders what the device plugin handed us).
+    alloc_ici_slack: int = field(default_factory=lambda: int(
+        _env("ALLOC_ICI_SLACK", "2")))
+
     # --- control-plane auth ---
     # The reference control plane is open to any in-cluster peer
     # (insecure gRPC dial, cmd/GPUMounter-master/main.go:82; no HTTP

@@ -61,32 +61,9 @@ class ReconcileError(RuntimeError):
 
 def _post_pod_event(kube: KubeClient, pod: Pod, reason: str, message: str,
                     event_type: str = "Normal") -> None:
-    """Best-effort k8s Event from the elastic controller (mirrors the
-    worker's event shape, different source component)."""
-    import secrets as _secrets
-
-    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    manifest = {
-        "apiVersion": "v1",
-        "kind": "Event",
-        "metadata": {
-            "name": f"{pod.name[:200]}.tpumounter.{_secrets.token_hex(4)}",
-            "namespace": pod.namespace,
-        },
-        "involvedObject": {"kind": "Pod", "name": pod.name,
-                           "namespace": pod.namespace, "uid": pod.uid},
-        "reason": reason,
-        "message": message[:1024],
-        "type": event_type,
-        "source": {"component": "tpumounter-elastic"},
-        "firstTimestamp": ts,
-        "lastTimestamp": ts,
-        "count": 1,
-    }
-    try:
-        kube.create_event(pod.namespace, manifest)
-    except Exception as exc:  # noqa: BLE001 — events are advisory
-        logger.debug("event post failed: %s", exc)
+    from gpumounter_tpu.k8s.events import post_pod_event
+    post_pod_event(kube, pod, reason, message, event_type,
+                   component="tpumounter-elastic")
 
 
 class ElasticReconciler:
@@ -188,9 +165,11 @@ class ElasticReconciler:
             logger.warning("reconcile %s failed (%s); retry in %.2fs",
                            key, exc, delay)
         else:
-            if outcome.get("phase") == "degraded":
-                # Converged to >= min_chips but < desired: keep trying
-                # for desired on the backoff schedule.
+            if outcome.get("phase") in ("degraded", "migrating"):
+                # degraded: converged to >= min_chips but < desired —
+                # keep trying for desired on the backoff schedule.
+                # migrating: paused for an in-flight migration — check
+                # back the same way until it finishes.
                 self.queue.retry(key)
             else:
                 self.queue.forget(key)
@@ -226,6 +205,18 @@ class ElasticReconciler:
         if intent is None:
             self.queue.forget(key)
             return {"phase": "unmanaged"}
+        from gpumounter_tpu.migrate.journal import migration_active
+        mid = migration_active(pod.annotations, kube=self.kube)
+        if mid is not None:
+            # A live migration owns this pod's chip set (source or
+            # destination side); converging toward the intent now would
+            # fight the orchestrator's drain/re-mount. Park the pass —
+            # _process re-queues it on the backoff schedule, and the
+            # resync keeps it coming back until the migration is
+            # terminal.
+            logger.info("reconcile of %s paused: migration %s in flight",
+                        key, mid)
+            return {"phase": "migrating", "migration": mid}
         if not pod.node_name:
             raise ReconcileError(f"pod {pod_name} is not scheduled yet")
         address = self.registry.worker_address(pod.node_name)

@@ -19,13 +19,19 @@ from gpumounter_tpu.jaxside.heal import (
     chip_replacement,
     watch_chip_replacements,
 )
+from gpumounter_tpu.jaxside.migrate import (
+    migration_signal,
+    watch_migration,
+)
 
 __all__ = [
     "chips_visible_in_dev",
     "chip_replacement",
+    "migration_signal",
     "refresh_devices",
     "set_topology_env",
     "wait_for_chips",
     "watch_chip_replacements",
+    "watch_migration",
     "HotResumable",
 ]

@@ -1,0 +1,150 @@
+"""Tenant-side hook for live migrations.
+
+The migration orchestrator (gpumounter_tpu/migrate/) signals the tenant
+through the `tpumounter.io/migration-phase` annotation: "quiesce" on the
+source pod before the drain, "resume" on the destination pod after the
+re-mount. The tenant's half of the choreography is the same HotResumable
+pack/restore cycle the heal watcher drives, split across two pods:
+
+    # source-pod process
+    def on_quiesce(signal):
+        state = HotResumable.pack(params, opt_state)
+        state.save(SHARED_CKPT)          # crosses pods via shared storage
+
+    # destination-pod process
+    def on_resume(signal):
+        wait_for_chips(len(signal["chips"]))
+        params, opt_state = HotResumable.load(SHARED_CKPT).restore(
+            build_mesh())
+
+    watch_migration(kube, ns, pod, on_quiesce, on_resume)
+
+After each callback returns, the watcher acks by stamping
+`tpumounter.io/migration-ack` — the worker's QuiesceStatus RPC reads it
+back so the orchestrator knows state is packed before it pulls the
+chips (and closes the downtime clock when the restore lands).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections.abc import Callable
+
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxside.migrate")
+
+# mirrors of migrate.journal — the tenant side deliberately does not
+# import the master-side package.
+ANNOT_PHASE = "tpumounter.io/migration-phase"
+ANNOT_ACK = "tpumounter.io/migration-ack"
+
+#: signal phase -> (callback slot, ack phase)
+_PHASE_MAP = {"quiesce": ("on_quiesce", "quiesced"),
+              "resume": ("on_resume", "resumed")}
+
+
+def migration_signal(annotations: dict[str, str]) -> dict | None:
+    """Parse the migration-phase signal ({id, phase, ...}) or None."""
+    raw = annotations.get(ANNOT_PHASE)
+    if not raw:
+        return None
+    try:
+        signal = json.loads(raw)
+    except ValueError:
+        logger.warning("unparseable %s annotation: %r", ANNOT_PHASE, raw)
+        return None
+    return signal if isinstance(signal, dict) and signal.get("id") else None
+
+
+def watch_migration(kube: KubeClient, namespace: str, pod_name: str,
+                    on_quiesce: Callable[[dict], None],
+                    on_resume: Callable[[dict], None] | None = None,
+                    stop: threading.Event | None = None,
+                    watch_timeout_s: float = 30.0,
+                    ack: bool = True) -> None:
+    """Blocking loop mirroring watch_chip_replacements: invoke the phase
+    callback each time the migration signal changes, then (ack=True)
+    stamp the ack annotation the orchestrator is polling for.
+
+    Unlike the heal watcher there is NO baseline skip: a signal already
+    present at start is delivered. A tenant process that (re)starts
+    mid-migration must still pack or restore — the orchestrator is
+    actively waiting on exactly that ack, whereas a heal marker present
+    at startup describes a chip set the fresh backend already saw.
+    Duplicate (id, phase) observations fire once.
+    """
+    stop = stop or threading.Event()
+    state: dict = {"last": None}
+
+    def _deliver(annotations: dict[str, str]) -> None:
+        signal = migration_signal(annotations)
+        if signal is None:
+            return
+        phase = signal.get("phase")
+        key = (signal["id"], phase)
+        if key == state["last"]:
+            return
+        if phase not in _PHASE_MAP:
+            state["last"] = key  # terminal phases ("done") dedupe too
+            return
+        slot, ack_phase = _PHASE_MAP[phase]
+        callback = on_quiesce if slot == "on_quiesce" else on_resume
+        logger.info("migration %s: %s signal received", signal["id"], phase)
+        if callback is None:
+            # No handler registered for this phase: record it seen but
+            # do NOT ack — an ack claims the work (pack/restore)
+            # happened, and a phantom "resumed" would close the
+            # orchestrator's downtime clock on a restore that never ran.
+            state["last"] = key
+            return
+        # A raising callback (chips not visible yet, transient restore
+        # failure) propagates to the outer loop, which re-subscribes and
+        # re-reads — the signal is only marked consumed AFTER the
+        # callback returns, so it is retried instead of silently dropped
+        # with its ack.
+        callback(signal)
+        state["last"] = key
+        if ack:
+            marker = {"id": signal["id"], "phase": ack_phase,
+                      "at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())}
+            try:
+                kube.patch_pod(namespace, pod_name, {
+                    "metadata": {"annotations": {
+                        ANNOT_ACK: json.dumps(marker)}}})
+                logger.info("migration %s: acked %s", signal["id"],
+                            ack_phase)
+            except Exception as exc:  # noqa: BLE001 — orchestrator will
+                logger.warning("migration ack failed: %s", exc)  # time out
+
+    while not stop.is_set():
+        try:
+            # Subscribe FIRST, then re-read: a signal stamped while the
+            # previous watch was down is caught by the re-read, one
+            # stamped after is queued on the open watch (same pattern as
+            # jaxside.heal.watch_chip_replacements).
+            watch = kube.watch_pods(
+                namespace, field_selector=f"metadata.name={pod_name}",
+                timeout_s=watch_timeout_s)
+            try:
+                _deliver(Pod(kube.get_pod(namespace, pod_name)).annotations)
+            except NotFoundError:
+                logger.info("pod %s/%s deleted; migration watch ends",
+                            namespace, pod_name)
+                return
+            for etype, pod_json in watch:
+                if stop.is_set():
+                    return
+                if etype == "DELETED":
+                    logger.info("pod %s/%s deleted; migration watch ends",
+                                namespace, pod_name)
+                    return
+                _deliver(Pod(pod_json).annotations)
+        except Exception as exc:  # noqa: BLE001 — keep watching
+            logger.warning("migration watch failed (%s); retrying", exc)
+            stop.wait(1.0)

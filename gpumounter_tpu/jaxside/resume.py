@@ -41,7 +41,8 @@ class HotResumable:
 
     def restore(self, mesh, specs: Any = None) -> tuple:
         """Re-shard onto `mesh`. specs mirrors the packed trees (a pytree of
-        PartitionSpec per tree, or None for fully-replicated)."""
+        PartitionSpec per tree — e.g. jax.tree.map(lambda _: P(...), tree)
+        over the same structure — or None for fully-replicated)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -50,10 +51,16 @@ class HotResumable:
                 return jax.tree.map(
                     lambda x: jax.device_put(
                         x, NamedSharding(mesh, P())), tree)
+            # Walk BOTH trees by the default pytree rules — the same
+            # traversal pack() used. An earlier is_leaf ("any
+            # non-dict/list/tuple is a leaf") diverged from that
+            # structure on None nodes (structural under jax.tree, a
+            # device_put'able leaf under the lambda) and on registered
+            # custom containers, so spec trees mirroring packed optax
+            # states failed to line up.
             return jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-                tree, tree_specs,
-                is_leaf=lambda x: not isinstance(x, (dict, list, tuple)))
+                tree, tree_specs)
 
         if specs is None:
             out = tuple(_put(t, None) for t in self.host_state)

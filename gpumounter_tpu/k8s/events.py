@@ -1,0 +1,47 @@
+"""Best-effort Kubernetes Events on pods.
+
+The reference writes logs only (SURVEY.md §5 "no events on the Pod");
+here every control-plane component surfaces outcomes where operators
+actually look — `kubectl describe pod`. One shared manifest builder so
+the worker, the elastic reconciler, the slice coordinator, and the
+migration orchestrator emit the same shape under different `source`
+components. Failures are logged and swallowed: events are advisory and
+must never fail the operation they describe.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("k8s.events")
+
+
+def post_pod_event(kube, pod: Pod, reason: str, message: str,
+                   event_type: str = "Normal",
+                   component: str = "tpumounter") -> None:
+    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    manifest = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{pod.name[:200]}.tpumounter.{secrets.token_hex(4)}",
+            "namespace": pod.namespace,
+        },
+        "involvedObject": {"kind": "Pod", "name": pod.name,
+                           "namespace": pod.namespace, "uid": pod.uid},
+        "reason": reason,
+        "message": message[:1024],
+        "type": event_type,
+        "source": {"component": component},
+        "firstTimestamp": ts,
+        "lastTimestamp": ts,
+        "count": 1,
+    }
+    try:
+        kube.create_event(pod.namespace, manifest)
+    except Exception as exc:  # noqa: BLE001 — events are advisory
+        logger.debug("event post failed: %s", exc)

@@ -224,6 +224,13 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
         r"^/intents/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "intent_put"),
     ("DELETE", re.compile(
         r"^/intents/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "intent_delete"),
+    # Live migration: move a tenant's whole chip set between pods
+    # without a restart (gpumounter_tpu/migrate/).
+    ("POST", re.compile(r"^/migrate$"), "migrate_start"),
+    ("GET", re.compile(r"^/migrations$"), "migrations_list"),
+    ("GET", re.compile(r"^/migrations/(?P<mid>[^/]+)$"), "migration_get"),
+    ("POST", re.compile(
+        r"^/migrations/(?P<mid>[^/]+)/abort$"), "migration_abort"),
 ]
 
 
@@ -268,6 +275,12 @@ class MasterApp:
         # reconcile_once directly or start it themselves).
         from gpumounter_tpu.elastic import ElasticReconciler
         self.elastic = ElasticReconciler(
+            kube, self.registry, self._client_factory, cfg=self.cfg)
+        # Live-migration orchestrator: shares the registry and worker
+        # client factory; interrupted migrations are re-adopted by an
+        # explicit migrations.resume_interrupted() (master/main.py).
+        from gpumounter_tpu.migrate import MigrationCoordinator
+        self.migrations = MigrationCoordinator(
             kube, self.registry, self._client_factory, cfg=self.cfg)
 
     # --- plumbing ---
@@ -374,10 +387,11 @@ class MasterApp:
         entire = bool(payload.get("isEntireMount", True))
         accel_type = payload.get("acceleratorType") or None
         topology_hint = payload.get("topology") or None
+        prefer_ici = bool(payload.get("preferIci", False))
         try:
             plan = self._slice_coordinator().mount_slice(
                 targets, chips, entire, accel_type=accel_type,
-                topology_hint=topology_hint)
+                topology_hint=topology_hint, prefer_ici=prefer_ici)
         except SliceError as exc:
             raise _HttpError(exc.status, str(exc))
         return 200, "application/json", jsonlib.dumps(plan, indent=1) + "\n"
@@ -461,6 +475,62 @@ class MasterApp:
             raise _HttpError(404, f"No pod: {pod} in namespace: {ns}")
         return 200, "application/json", \
             jsonlib.dumps({"deleted": had}) + "\n"
+
+    # --- live migration ---
+
+    def _route_migrate_start(self, match, body, headers):
+        import json as jsonlib
+
+        from gpumounter_tpu.migrate import MigrationError
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        if not isinstance(payload, dict):
+            raise _HttpError(400, 'body must be a JSON object with '
+                                  '"source" and "destination"')
+
+        def _ref(key):
+            entry = payload.get(key)
+            if not isinstance(entry, dict) or not entry.get("pod"):
+                raise _HttpError(
+                    400, f'"{key}" must be {{"namespace": ..., '
+                         f'"pod": ...}}')
+            return entry.get("namespace", "default"), entry["pod"]
+
+        src_ns, src_pod = _ref("source")
+        dst_ns, dst_pod = _ref("destination")
+        try:
+            journal = self.migrations.begin(src_ns, src_pod,
+                                            dst_ns, dst_pod)
+        except MigrationError as exc:
+            raise _HttpError(exc.status, str(exc))
+        return 200, "application/json", \
+            jsonlib.dumps(journal, indent=1) + "\n"
+
+    def _route_migrations_list(self, match, body, headers):
+        import json as jsonlib
+        return 200, "application/json", jsonlib.dumps(
+            {"migrations": self.migrations.list_migrations()},
+            indent=1) + "\n"
+
+    def _route_migration_get(self, match, body, headers):
+        import json as jsonlib
+        journal = self.migrations.get(match.group("mid"))
+        if journal is None:
+            raise _HttpError(404, f"no migration {match.group('mid')}")
+        return 200, "application/json", \
+            jsonlib.dumps(journal, indent=1) + "\n"
+
+    def _route_migration_abort(self, match, body, headers):
+        import json as jsonlib
+
+        from gpumounter_tpu.migrate import MigrationError
+        try:
+            out = self.migrations.abort(match.group("mid"))
+        except MigrationError as exc:
+            raise _HttpError(exc.status, str(exc))
+        return 200, "application/json", jsonlib.dumps(out) + "\n"
 
     def _route_add(self, match, body, headers):
         ns = match.group("ns")

@@ -24,6 +24,13 @@ def main() -> None:
     # The elastic loop re-reads intents from pod annotations on start, so
     # declared desires survive master restarts with no extra store.
     app.elastic.start()
+    # Migrations journal to pod annotations the same way: a master that
+    # died mid-migration re-adopts and re-drives it from the recorded
+    # phase instead of leaving a tenant half-drained.
+    adopted = app.migrations.resume_interrupted()
+    if adopted:
+        logger.warning("re-driving %d interrupted migration(s): %s",
+                       len(adopted), ", ".join(adopted))
     logger.info("tpumounter master serving on :%d (elastic reconciler on, "
                 "resync %.0fs)", cfg.master_port,
                 cfg.elastic_resync_interval_s)

@@ -189,7 +189,8 @@ class SliceCoordinator:
 
     def mount_slice(self, targets: list[SliceTarget], chips_per_host: int,
                     entire: bool = True, accel_type: str | None = None,
-                    topology_hint: str | None = None) -> dict:
+                    topology_hint: str | None = None,
+                    prefer_ici: bool = False) -> dict:
         if len(targets) < 1:
             raise SliceError("empty slice", 400)
         resolved = self._resolve(targets)
@@ -206,7 +207,8 @@ class SliceCoordinator:
             try:
                 with self.client_factory(address) as client:
                     results[i] = client.add_tpu_detailed(
-                        t.pod, t.namespace, chips_per_host, entire)
+                        t.pod, t.namespace, chips_per_host, entire,
+                        prefer_ici=prefer_ici)
             except Exception as exc:  # noqa: BLE001 — per-host gRPC boundary
                 results[i] = exc
 
@@ -268,6 +270,22 @@ class SliceCoordinator:
             detail = "; ".join(
                 f"{resolved[i][0].pod}: {_fmt(r)}"
                 for i, r in failures.items())
+            # Surface the all-or-nothing rollback where operators look
+            # (`kubectl describe pod`), not just in master logs: one
+            # Warning Event per pod whose successful mount was undone.
+            from gpumounter_tpu.k8s.events import post_pod_event
+            for i in succeeded:
+                t = resolved[i][0]
+                try:
+                    pod = Pod(self.kube.get_pod(t.namespace, t.pod))
+                except Exception:  # noqa: BLE001 — pod may be gone
+                    continue
+                post_pod_event(
+                    self.kube, pod, "TPUSliceRollback",
+                    f"slice mount rolled back: {len(failures)}/"
+                    f"{len(targets)} host(s) failed ({detail}); removed "
+                    f"the {chips_per_host} chip(s) mounted here",
+                    event_type="Warning", component="tpumounter-master")
             insufficient = any(
                 isinstance(r, tuple)
                 and r[0] == api.AddTPUResult.InsufficientTPU

@@ -1,0 +1,35 @@
+"""Live chip migration: drain, snapshot, and re-mount a tenant's TPU set
+across pods without a restart. See journal.py (annotation-persisted,
+crash-safe state) and orchestrator.py (the five-phase machine)."""
+
+from gpumounter_tpu.migrate.journal import (
+    ANNOT_ACK,
+    ANNOT_JOURNAL,
+    ANNOT_LOCK,
+    ANNOT_PHASE,
+    PHASE_DONE,
+    PHASES,
+    migration_active,
+    new_journal,
+    parse_journal,
+)
+from gpumounter_tpu.migrate.orchestrator import (
+    MigrationCoordinator,
+    MigrationError,
+    MigrationRejected,
+)
+
+__all__ = [
+    "ANNOT_ACK",
+    "ANNOT_JOURNAL",
+    "ANNOT_LOCK",
+    "ANNOT_PHASE",
+    "MigrationCoordinator",
+    "MigrationError",
+    "MigrationRejected",
+    "PHASES",
+    "PHASE_DONE",
+    "migration_active",
+    "new_journal",
+    "parse_journal",
+]

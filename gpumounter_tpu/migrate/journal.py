@@ -1,0 +1,121 @@
+"""Migration journal: crash-safe state for the live-migration machine.
+
+The same stance as the elastic intent store (elastic/intents.py): the
+pod object IS the database. The full journal of a migration lives in one
+annotation on the SOURCE pod, updated on every phase transition, so
+
+  * an interrupted migration is resumable after a master restart — the
+    new master scans for non-terminal journals and re-drives them,
+  * `kubectl get pod -o jsonpath` is a valid (if raw) status client,
+  * deleting the source pod deletes the journal — no orphaned state.
+
+Annotation map (tpumounter.io/*):
+  migration        the journal JSON (source pod; master-owned)
+  migration-lock   {"id", "role"} on the destination while in flight, so
+                   the elastic reconciler pauses for BOTH pods
+  migration-phase  {"id", "phase": "quiesce"|"resume"|"done", ...} — the
+                   tenant-facing signal jaxside.watch_migration consumes
+  migration-ack    {"id", "phase": "quiesced"|"resumed"} — stamped by
+                   the tenant, read back via the worker's QuiesceStatus
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+ANNOT_JOURNAL = "tpumounter.io/migration"
+ANNOT_LOCK = "tpumounter.io/migration-lock"
+ANNOT_PHASE = "tpumounter.io/migration-phase"
+ANNOT_ACK = "tpumounter.io/migration-ack"
+
+#: the machine's phases, in order; "done" is terminal.
+PHASES = ("quiesce", "drain", "remount", "resume", "verify")
+PHASE_DONE = "done"
+
+#: terminal outcomes (journal["outcome"]; None while in flight)
+OUTCOMES = ("succeeded", "rolled-back", "failed", "aborted")
+
+
+def new_journal(mid: str, source_ns: str, source_pod: str,
+                dest_ns: str, dest_pod: str) -> dict:
+    now = time.time()
+    return {
+        "id": mid,
+        "source": {"namespace": source_ns, "pod": source_pod},
+        "destination": {"namespace": dest_ns, "pod": dest_pod},
+        "phase": PHASES[0],
+        "outcome": None,
+        "error": None,
+        "chips": [],          # uuids drained from the source
+        "dest_before": None,  # dest's pre-existing chip set (remount diff)
+        "dest_chips": [],     # uuids mounted on the destination
+        "quiesced": None,     # tenant acked the quiesce signal in time
+        "resumed": None,      # destination tenant acked the resume signal
+        "downtime_started_at": None,
+        "downtime_s": None,
+        "phase_durations_s": {},
+        "created_at": now,
+        "updated_at": now,
+    }
+
+
+def parse_journal(annotations: dict[str, str]) -> dict | None:
+    raw = annotations.get(ANNOT_JOURNAL)
+    if not raw:
+        return None
+    try:
+        journal = json.loads(raw)
+    except ValueError:
+        return None
+    return journal if isinstance(journal, dict) and journal.get("id") \
+        else None
+
+
+def migration_active(annotations: dict[str, str],
+                     kube=None) -> str | None:
+    """Migration id holding this pod (source or destination side), or
+    None. The elastic reconciler checks this and pauses: two controllers
+    mutating one pod's chip set would fight.
+
+    A destination-side lock is normally cleared by the orchestrator at
+    terminal; if that one patch was lost, the lock would wedge the pod
+    forever. With `kube` provided, a lock is cross-checked against its
+    source pod's journal and treated as stale (inactive) when that
+    migration is terminal or gone — self-healing instead of a manual
+    `kubectl annotate` rescue."""
+    journal = parse_journal(annotations)
+    if journal is not None and journal.get("outcome") is None:
+        return str(journal["id"])
+    raw = annotations.get(ANNOT_LOCK)
+    if not raw:
+        return None
+    try:
+        lock = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(lock, dict) or not lock.get("id"):
+        return None
+    mid = str(lock["id"])
+    source = lock.get("source")
+    if kube is None or not (isinstance(source, dict) and source.get("pod")):
+        return mid
+    from gpumounter_tpu.k8s.client import NotFoundError
+    from gpumounter_tpu.k8s.types import Pod
+    try:
+        src_journal = parse_journal(Pod(kube.get_pod(
+            source.get("namespace", "default"),
+            source["pod"])).annotations)
+    except NotFoundError:
+        return None  # source pod (and its journal) gone: lock is stale
+    except Exception:  # noqa: BLE001 — can't prove staleness: stay safe
+        return mid
+    if src_journal is None or src_journal.get("id") != mid \
+            or src_journal.get("outcome") is not None:
+        return None
+    return mid
+
+
+def dump(journal: dict) -> str:
+    journal["updated_at"] = time.time()
+    return json.dumps(journal, separators=(",", ":"))

@@ -1,0 +1,722 @@
+"""Live chip migration: drain, snapshot, and re-mount a tenant's TPU set
+across pods without a restart.
+
+The first subsystem that composes every existing plane into one
+crash-safe workflow:
+
+    quiesce   signal the tenant (tpumounter.io/migration-phase) so
+              jaxside.watch_migration packs state with HotResumable;
+              poll the worker's QuiesceStatus read-back for the ack
+    drain     RemoveTPU (forced) of the whole set on the source pod
+    remount   AddTPU on the destination via the slice coordinator —
+              its all-or-nothing rollback covers the multi-chip set —
+              with ICI-contiguous placement (allocator/placement.py)
+    resume    flip the annotation on the destination so its jaxside
+              rebuilds the mesh and restores; downtime clock closes on
+              the tenant's resume ack
+    verify    worker ProbeTPU on every moved chip; any unhealthy chip
+              rolls the whole migration back to the source pod
+
+Crash safety: the journal (migrate/journal.py) is persisted to the
+source pod's annotations on every transition, so a master restart
+re-drives an interrupted migration from the phase it died in
+(resume_interrupted). Every phase is written to tolerate re-entry: drain
+re-removes only what is still held, remount diffs against the recorded
+pre-mount destination set before mounting again.
+
+CRIUgpu (PAPERS.md) is the stance: transparent checkpoint/restore is the
+right primitive for accelerator workloads — here the checkpoint is the
+tenant's own HotResumable pack (device state cannot cross pods through
+the kernel; it crosses through host/disk state the tenant owns), and the
+control plane choreographs when to pack, where the chips land, and when
+to restore. FlexNPU motivates the why: migration is the mechanism behind
+dynamic co-location and defragmentation.
+"""
+
+from __future__ import annotations
+
+import copy
+import secrets
+import threading
+import time
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.events import post_pod_event
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.migrate.journal import (
+    ANNOT_JOURNAL,
+    ANNOT_LOCK,
+    ANNOT_PHASE,
+    PHASE_DONE,
+    dump,
+    migration_active,
+    new_journal,
+    parse_journal,
+)
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("migrate")
+
+MIGRATIONS_TOTAL = REGISTRY.counter(
+    "tpumounter_migrations_total",
+    "Finished migrations by final phase reached and outcome")
+MIGRATION_PHASE_DURATION = REGISTRY.histogram(
+    "tpumounter_migration_phase_duration_seconds",
+    "Wall time per migration phase")
+MIGRATION_DOWNTIME = REGISTRY.histogram(
+    "tpumounter_migration_downtime_seconds",
+    "Tenant pack->restore gap (drain start to resume ack)")
+
+
+class MigrationError(RuntimeError):
+    """Mid-flight failure: the machine rolls back to the source."""
+
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
+
+
+class MigrationRejected(MigrationError):
+    """Client error before anything moved (maps to HTTP 4xx)."""
+
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message, status)
+
+
+class _Aborted(Exception):
+    pass
+
+
+class MigrationCoordinator:
+    """Master-side orchestrator; one background thread per migration."""
+
+    #: phases during which an abort request still triggers a rollback —
+    #: past remount the chips live on the destination and finishing
+    #: forward is strictly safer than a second move.
+    ABORTABLE_PHASES = ("quiesce", "drain", "remount")
+
+    def __init__(self, kube: KubeClient, registry, client_factory,
+                 cfg=None):
+        self.cfg = cfg or get_config()
+        self.kube = kube
+        self.registry = registry
+        self.client_factory = client_factory
+        self._lock = threading.Lock()
+        # Serializes begin(): the already-migrating check and the journal
+        # persist must be atomic, or two concurrent /migrate requests for
+        # one pod both pass validation and stomp each other's journal.
+        self._admission = threading.Lock()
+        self._journals: dict[str, dict] = {}   # id -> last persisted copy
+        self._threads: dict[str, threading.Thread] = {}
+        self._aborts: set[str] = set()
+
+    # --- public API (HTTP routes + CLI land here) ---
+
+    def begin(self, source_ns: str, source_pod: str,
+              dest_ns: str, dest_pod: str) -> dict:
+        """Validate, journal phase=quiesce, and start the machine.
+        Raises MigrationRejected (4xx) before anything has moved."""
+        if (source_ns, source_pod) == (dest_ns, dest_pod):
+            raise MigrationRejected(
+                "source and destination are the same pod", 400)
+        # Slow validation (pod GETs, worker resolution, the probe RPC)
+        # runs OUTSIDE the admission mutex so one flaky worker cannot
+        # serialize every unrelated /migrate behind its timeout; the
+        # chip set is re-read at drain time anyway.
+        src_addr = self._worker_addr(source_ns, source_pod)
+        self._worker_addr(dest_ns, dest_pod)  # dest must be servable too
+        chips = self._probe(src_addr, source_ns, source_pod)
+        if not chips:
+            raise MigrationRejected(
+                f"pod {source_ns}/{source_pod} holds no tpumounter-"
+                f"managed chips; nothing to migrate", 400)
+        with self._admission:
+            # Atomic admit: re-read both pods, check neither is taken,
+            # and persist the journal AND the destination lock before
+            # releasing — a concurrent begin() for either pod then sees
+            # the claim. (The machine only stamps the tenant-facing
+            # phase annotation; the ownership markers are laid here.)
+            src = self._get_pod_checked(source_ns, source_pod)
+            dst = self._get_pod_checked(dest_ns, dest_pod)
+            for pod in (src, dst):
+                active = migration_active(pod.annotations, kube=self.kube)
+                if active:
+                    raise MigrationRejected(
+                        f"pod {pod.namespace}/{pod.name} is already part "
+                        f"of migration {active}", 409)
+            mid = f"mig-{secrets.token_hex(5)}"
+            journal = new_journal(mid, source_ns, source_pod,
+                                  dest_ns, dest_pod)
+            self._persist(journal)
+            try:
+                self._stamp(journal["destination"], ANNOT_LOCK, {
+                    "id": mid, "role": "destination",
+                    "source": journal["source"]})
+            except Exception as exc:  # noqa: BLE001 — undo the claim:
+                # a persisted journal with no driving thread would wedge
+                # both pods (409 on retry, elastic paused) until a
+                # master restart's resume_interrupted scan.
+                logger.error("destination lock stamp failed; "
+                             "withdrawing migration %s: %s", mid, exc)
+                try:
+                    self.kube.patch_pod(source_ns, source_pod, {
+                        "metadata": {"annotations": {ANNOT_JOURNAL:
+                                                     None}}})
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+                with self._lock:
+                    self._journals.pop(mid, None)
+                raise MigrationError(
+                    f"could not lock destination pod: {exc}", 500)
+        post_pod_event(
+            self.kube, src, "TPUMigrationStarted",
+            f"migration {mid}: moving {len(chips)} chip(s) to "
+            f"{dest_ns}/{dest_pod}", component="tpumounter-migrate")
+        # Copy BEFORE spawning: the machine thread mutates this dict,
+        # and a deepcopy racing it can die mid-iteration.
+        response = copy.deepcopy(journal)
+        self._spawn(journal)
+        return response
+
+    def get(self, mid: str) -> dict | None:
+        with self._lock:
+            journal = self._journals.get(mid)
+            if journal is not None:
+                return copy.deepcopy(journal)
+        for journal in self._scan():
+            if journal["id"] == mid:
+                return journal
+        return None
+
+    def list_migrations(self) -> list[dict]:
+        out: dict[str, dict] = {j["id"]: j for j in self._scan()}
+        with self._lock:
+            for mid, journal in self._journals.items():
+                out[mid] = copy.deepcopy(journal)  # in-memory is fresher
+        return sorted(out.values(), key=lambda j: j.get("created_at", 0))
+
+    def abort(self, mid: str) -> dict:
+        journal = self.get(mid)
+        if journal is None:
+            raise MigrationRejected(f"no migration {mid}", 404)
+        if journal.get("outcome"):
+            raise MigrationRejected(
+                f"migration {mid} already finished "
+                f"({journal['outcome']})", 409)
+        if journal["phase"] not in self.ABORTABLE_PHASES:
+            raise MigrationRejected(
+                f"too late to abort {mid}: phase {journal['phase']} has "
+                f"already re-mounted the chips", 409)
+        with self._lock:
+            self._aborts.add(mid)
+        return {"id": mid, "aborting": True}
+
+    def wait(self, mid: str, timeout_s: float = 60.0) -> dict | None:
+        """Test/CLI convenience: block until the machine finishes."""
+        with self._lock:
+            thread = self._threads.get(mid)
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+        return self.get(mid)
+
+    def resume_interrupted(self) -> list[str]:
+        """Adopt and re-drive every non-terminal journal found in pod
+        annotations — the master-restart path. Returns adopted ids."""
+        adopted = []
+        for journal in self._scan():
+            if journal.get("outcome") is not None:
+                continue
+            with self._lock:
+                if journal["id"] in self._threads:
+                    continue
+            logger.warning("adopting interrupted migration %s (phase %s)",
+                           journal["id"], journal["phase"])
+            self._spawn(journal)
+            adopted.append(journal["id"])
+        return adopted
+
+    def stop(self) -> None:
+        with self._lock:
+            threads = list(self._threads.values())
+        for thread in threads:
+            thread.join(timeout=5.0)
+
+    # --- the machine ---
+
+    def _spawn(self, journal: dict) -> None:
+        with self._lock:
+            self._journals[journal["id"]] = copy.deepcopy(journal)
+            thread = threading.Thread(
+                target=self._run, args=(journal,),
+                name=f"migration-{journal['id']}", daemon=True)
+            self._threads[journal["id"]] = thread
+        thread.start()
+
+    def _run(self, journal: dict) -> None:
+        mid = journal["id"]
+        final_phase = journal["phase"]
+        try:
+            while journal["phase"] != PHASE_DONE:
+                phase = journal["phase"]
+                final_phase = phase
+                if mid in self._aborts and phase in self.ABORTABLE_PHASES:
+                    raise _Aborted(f"abort requested during {phase}")
+                started = time.monotonic()
+                next_phase = getattr(self, f"_phase_{phase}")(journal)
+                elapsed = time.monotonic() - started
+                MIGRATION_PHASE_DURATION.observe(elapsed, phase=phase)
+                journal["phase_durations_s"][phase] = round(elapsed, 3)
+                journal["phase"] = next_phase
+                self._persist(journal)
+            if mid in self._aborts:
+                # Abort accepted while remount was finishing: too late to
+                # honor, but the caller was told "aborting" — record that
+                # it was overtaken rather than dropping it silently.
+                journal["abort_too_late"] = True
+                logger.warning("migration %s: abort request arrived after "
+                               "the chips moved; finished forward", mid)
+                self._persist(journal)
+            logger.info("migration %s finished: %s", mid,
+                        journal["outcome"])
+        except _Aborted as exc:
+            self._rollback(journal, str(exc), outcome="aborted")
+        except Exception as exc:  # noqa: BLE001 — terminal boundary
+            if not isinstance(exc, MigrationError):
+                logger.exception("migration %s: unexpected failure in "
+                                 "phase %s", mid, final_phase)
+            if journal.get("outcome") == "succeeded":
+                # Post-success housekeeping failed (terminal persist on a
+                # just-deleted source pod, a stamp hiccup). The chips are
+                # verified healthy on the destination and the tenant is
+                # running — rolling back now would yank them from under
+                # it. Keep the success, and make the in-memory copy
+                # terminal so get()/wait() report it even though the
+                # on-pod persist was lost.
+                logger.warning("migration %s: post-success cleanup "
+                               "failed (%s); outcome stays succeeded",
+                               mid, exc)
+                journal["phase"] = PHASE_DONE
+                with self._lock:
+                    self._journals[mid] = copy.deepcopy(journal)
+            else:
+                self._rollback(journal, str(exc))
+        finally:
+            MIGRATIONS_TOTAL.inc(phase=final_phase,
+                                 outcome=journal.get("outcome") or "failed")
+            with self._lock:
+                self._aborts.discard(mid)
+                self._threads.pop(mid, None)
+
+    # --- phases (each idempotent under re-entry after a master crash) ---
+
+    def _phase_quiesce(self, journal: dict) -> str:
+        src = journal["source"]
+        self._stamp(src, ANNOT_PHASE, {
+            "id": journal["id"], "phase": "quiesce",
+            "destination": journal["destination"]})
+        journal["quiesced"] = self._await_ack(
+            src, journal["id"], "quiesced",
+            self.cfg.migrate_quiesce_timeout_s, abortable=True)
+        if not journal["quiesced"]:
+            logger.warning(
+                "migration %s: no quiesce ack from %s/%s within %.0fs; "
+                "draining anyway (tenant loses the warm pack/restore "
+                "path, not the chips' state on disk)", journal["id"],
+                src["namespace"], src["pod"],
+                self.cfg.migrate_quiesce_timeout_s)
+        return "drain"
+
+    def _phase_drain(self, journal: dict) -> str:
+        src = journal["source"]
+        address = self._worker_addr(src["namespace"], src["pod"])
+        held = [c.uuid for c in
+                self._probe(address, src["namespace"], src["pod"])]
+        if not journal["chips"]:
+            if not held:
+                raise MigrationError(
+                    f"source {src['namespace']}/{src['pod']} holds no "
+                    f"chips at drain time")
+            journal["chips"] = sorted(held)
+        if journal["downtime_started_at"] is None:
+            journal["downtime_started_at"] = time.time()
+        # The chip list and the downtime clock are journaled BEFORE any
+        # removal: a crash between remove and the next persist must not
+        # forget what the source owned.
+        self._persist(journal)
+        to_remove = [u for u in journal["chips"] if u in set(held)]
+        if to_remove:
+            with self.client_factory(address) as client:
+                result = client.remove_tpu(src["pod"], src["namespace"],
+                                           to_remove, force=True)
+            if result not in (api.RemoveTPUResult.Success,
+                              api.RemoveTPUResult.TPUNotFound):
+                raise MigrationError(
+                    f"drain of {len(to_remove)} chip(s) returned "
+                    f"{result.name}")
+        return "remount"
+
+    def _phase_remount(self, journal: dict) -> str:
+        dst = journal["destination"]
+        address = self._worker_addr(dst["namespace"], dst["pod"])
+        want = len(journal["chips"])
+        if journal["dest_before"] is None:
+            journal["dest_before"] = sorted(
+                c.uuid for c in
+                self._probe(address, dst["namespace"], dst["pod"]))
+            self._persist(journal)
+        current = {c.uuid for c in
+                   self._probe(address, dst["namespace"], dst["pod"])}
+        moved = sorted(current - set(journal["dest_before"]))
+        if not moved:
+            # The slice coordinator's all-or-nothing path: a multi-chip
+            # mount either fully lands or is fully rolled back, and the
+            # allocator prefers an ICI-contiguous block on the new host.
+            from gpumounter_tpu.master.slice_ops import (
+                SliceCoordinator,
+                SliceError,
+                SliceTarget,
+            )
+            coordinator = SliceCoordinator(self.kube, self.registry,
+                                           self.client_factory, self.cfg)
+            target = SliceTarget(namespace=dst["namespace"],
+                                 pod=dst["pod"])
+            try:
+                coordinator.mount_slice([target], want, entire=False,
+                                        prefer_ici=True)
+            except SliceError as exc:
+                raise MigrationError(
+                    f"re-mount of {want} chip(s) on "
+                    f"{dst['namespace']}/{dst['pod']} failed: {exc}",
+                    exc.status)
+            current = {c.uuid for c in
+                       self._probe(address, dst["namespace"], dst["pod"])}
+            moved = sorted(current - set(journal["dest_before"]))
+        if len(moved) != want:
+            raise MigrationError(
+                f"destination gained {len(moved)} chip(s), expected "
+                f"{want} ({moved})")
+        journal["dest_chips"] = moved
+        return "resume"
+
+    def _phase_resume(self, journal: dict) -> str:
+        dst = journal["destination"]
+        self._stamp(dst, ANNOT_PHASE, {
+            "id": journal["id"], "phase": "resume",
+            "chips": journal["dest_chips"], "source": journal["source"]})
+        signaled_at = time.time()
+        journal["resumed"] = self._await_ack(
+            dst, journal["id"], "resumed",
+            self.cfg.migrate_resume_timeout_s)
+        if journal["downtime_started_at"] is not None \
+                and journal["downtime_s"] is None:
+            # Ack observed: close the window now. No ack (hookless
+            # tenant): close it at the signal — the chips were usable
+            # from the stamp on, and the idle ack-timeout must not
+            # inflate the headline downtime metric (config.py contract).
+            closed_at = time.time() if journal["resumed"] else signaled_at
+            journal["downtime_s"] = round(
+                closed_at - journal["downtime_started_at"], 3)
+            MIGRATION_DOWNTIME.observe(journal["downtime_s"])
+        return "verify"
+
+    def _phase_verify(self, journal: dict) -> str:
+        dst = journal["destination"]
+        address = self._worker_addr(dst["namespace"], dst["pod"])
+        by_uuid = {c.uuid: c for c in
+                   self._probe(address, dst["namespace"], dst["pod"])}
+        bad = [u for u in journal["dest_chips"]
+               if u not in by_uuid or not by_uuid[u].healthy]
+        if bad:
+            raise MigrationError(
+                f"verify failed: moved chip(s) missing/unhealthy on "
+                f"{dst['namespace']}/{dst['pod']}: {bad}")
+        journal["outcome"] = "succeeded"
+        self._transfer_intent(journal)
+        self._stamp(journal["source"], ANNOT_PHASE,
+                    {"id": journal["id"], "phase": "done"})
+        self._clear_lock(journal)
+        src_pod = self._try_pod(journal["source"])
+        if src_pod is not None:
+            post_pod_event(
+                self.kube, src_pod, "TPUMigrationSucceeded",
+                f"migration {journal['id']}: {len(journal['dest_chips'])} "
+                f"chip(s) now on {dst['namespace']}/{dst['pod']} "
+                f"(downtime {journal['downtime_s']}s)",
+                component="tpumounter-migrate")
+        return PHASE_DONE
+
+    def _transfer_intent(self, journal: dict) -> None:
+        """The declared elastic intent follows the tenant: left on the
+        evacuated source, the reconciler would re-mount fresh chips
+        there the moment the migration-pause lifts — silently undoing
+        the evacuation. Best-effort: a failure here leaves a double
+        intent (operator-visible), never a failed migration."""
+        from gpumounter_tpu.elastic.intents import (
+            ANNOT_DESIRED,
+            ANNOT_MIN,
+            ANNOT_PRIORITY,
+            Intent,
+            IntentError,
+        )
+        src, dst = journal["source"], journal["destination"]
+        src_pod = self._try_pod(src)
+        if src_pod is None:
+            return
+        try:
+            intent = Intent.from_annotations(src_pod.annotations)
+        except IntentError:
+            intent = None
+        try:
+            if intent is not None:
+                dst_pod = self._try_pod(dst)
+                has_own = dst_pod is not None and \
+                    ANNOT_DESIRED in dst_pod.annotations
+                if not has_own:  # an explicit destination intent wins
+                    self.kube.patch_pod(dst["namespace"], dst["pod"], {
+                        "metadata": {"annotations":
+                                     intent.to_annotations()}})
+                self.kube.patch_pod(src["namespace"], src["pod"], {
+                    "metadata": {"annotations": {
+                        ANNOT_DESIRED: None, ANNOT_MIN: None,
+                        ANNOT_PRIORITY: None}}})
+                logger.info("migration %s: moved elastic intent "
+                            "(desired=%d) from %s/%s to %s/%s",
+                            journal["id"], intent.desired_chips,
+                            src["namespace"], src["pod"],
+                            dst["namespace"], dst["pod"])
+        except Exception as exc:  # noqa: BLE001 — advisory
+            logger.warning("intent transfer for migration %s failed: %s",
+                           journal["id"], exc)
+
+    # --- rollback ---
+
+    def _rollback(self, journal: dict, reason: str,
+                  outcome: str = "rolled-back") -> None:
+        logger.error("migration %s rolling back (%s): %s",
+                     journal["id"], outcome, reason)
+        src = journal["source"]
+        want = len(journal["chips"])
+        failure: str | None = None
+
+        # Step 1: reclaim whatever landed on the destination. Falls back
+        # to a live diff against the journaled pre-mount set when a
+        # remount partially landed without being recorded (crash between
+        # the mount and the journal write, or a count-mismatch raise).
+        dst = journal["destination"]
+        try:
+            cleanup = list(journal.get("dest_chips") or [])
+            if not cleanup and journal.get("dest_before") is not None:
+                address = self._worker_addr(dst["namespace"], dst["pod"])
+                current = {c.uuid for c in
+                           self._probe(address, dst["namespace"],
+                                       dst["pod"])}
+                cleanup = sorted(current - set(journal["dest_before"]))
+            if cleanup:
+                address = self._worker_addr(dst["namespace"], dst["pod"])
+                with self.client_factory(address) as client:
+                    client.remove_tpu(dst["pod"], dst["namespace"],
+                                      cleanup, force=True)
+        except Exception as exc:  # noqa: BLE001 — keep restoring
+            failure = f"destination cleanup failed: {exc}"
+
+        # Step 2: restore the source's chip count.
+        try:
+            if want:
+                address = self._worker_addr(src["namespace"], src["pod"])
+                held = self._probe(address, src["namespace"], src["pod"])
+                missing = want - len(held)
+                if missing > 0:
+                    from gpumounter_tpu.master.slice_ops import (
+                        SliceCoordinator,
+                        SliceTarget,
+                    )
+                    SliceCoordinator(
+                        self.kube, self.registry, self.client_factory,
+                        self.cfg).mount_slice(
+                            [SliceTarget(namespace=src["namespace"],
+                                         pod=src["pod"])],
+                            missing, entire=False, prefer_ici=True)
+        except Exception as exc:  # noqa: BLE001 — still unfreeze below
+            failure = failure or f"source restore failed: {exc}"
+
+        # Step 3: ALWAYS flip the source tenant back to "resume" — even
+        # when the restore above failed or nothing was ever drained
+        # (want == 0), a tenant paused on the quiesce signal must not
+        # stay frozen forever. The signal carries the chips the source
+        # holds NOW (the restore mounts fresh uuids, not the drained
+        # ones); the original set is the fallback when the probe fails.
+        try:
+            chips_now = list(journal["chips"])
+            try:
+                address = self._worker_addr(src["namespace"], src["pod"])
+                chips_now = sorted(
+                    c.uuid for c in self._probe(address, src["namespace"],
+                                                src["pod"]))
+            except Exception:  # noqa: BLE001 — fall back to the old set
+                pass
+            self._stamp(src, ANNOT_PHASE,
+                        {"id": journal["id"], "phase": "resume",
+                         "chips": chips_now})
+        except Exception as exc:  # noqa: BLE001 — record, don't die
+            failure = failure or f"source resume signal failed: {exc}"
+
+        # Step 4: verify the source is whole again.
+        try:
+            if want:
+                address = self._worker_addr(src["namespace"], src["pod"])
+                healthy = [c for c in
+                           self._probe(address, src["namespace"],
+                                       src["pod"]) if c.healthy]
+                journal["rollback_healthy"] = len(healthy)
+                if len(healthy) < want:
+                    failure = failure or (
+                        f"source restored with only {len(healthy)}/{want} "
+                        f"healthy chip(s)")
+        except Exception as exc:  # noqa: BLE001 — record, don't die
+            failure = failure or str(exc)
+        journal["outcome"] = outcome if failure is None else "failed"
+        journal["error"] = reason if failure is None \
+            else f"{reason}; rollback incomplete: {failure}"
+        journal["phase"] = PHASE_DONE
+        self._clear_lock(journal)
+        try:
+            self._persist(journal)
+        except Exception as exc:  # noqa: BLE001 — source pod may be gone
+            logger.warning("terminal journal persist failed: %s", exc)
+            with self._lock:  # keep the in-memory copy authoritative
+                self._journals[journal["id"]] = copy.deepcopy(journal)
+        src_pod = self._try_pod(src)
+        if src_pod is not None:
+            post_pod_event(
+                self.kube, src_pod, "TPUMigrationRolledBack",
+                f"migration {journal['id']} {journal['outcome']}: "
+                f"{journal['error']}", event_type="Warning",
+                component="tpumounter-migrate")
+
+    # --- plumbing ---
+
+    def _scan(self) -> list[dict]:
+        out = []
+        try:
+            pods = self.kube.list_pods()
+        except Exception as exc:  # noqa: BLE001 — LIST is best-effort here
+            logger.warning("migration journal scan failed: %s", exc)
+            return out
+        for pod_json in pods:
+            journal = parse_journal(Pod(pod_json).annotations)
+            if journal is not None:
+                out.append(journal)
+        return out
+
+    def _persist(self, journal: dict) -> None:
+        src = journal["source"]
+        try:
+            self.kube.patch_pod(src["namespace"], src["pod"], {
+                "metadata": {"annotations": {ANNOT_JOURNAL:
+                                             dump(journal)}}})
+        except NotFoundError:
+            raise MigrationError(
+                f"source pod {src['namespace']}/{src['pod']} disappeared "
+                f"mid-migration")
+        with self._lock:
+            self._journals[journal["id"]] = copy.deepcopy(journal)
+
+    def _stamp(self, ref: dict, annotation: str, payload: dict) -> None:
+        import json as jsonlib
+        payload = {**payload,
+                   "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        try:
+            self.kube.patch_pod(ref["namespace"], ref["pod"], {
+                "metadata": {"annotations": {
+                    annotation: jsonlib.dumps(payload)}}})
+        except NotFoundError:
+            logger.warning("cannot stamp %s on %s/%s: pod gone",
+                           annotation, ref["namespace"], ref["pod"])
+
+    def _clear_lock(self, journal: dict) -> None:
+        dst = journal["destination"]
+        for attempt in range(3):
+            try:
+                self.kube.patch_pod(dst["namespace"], dst["pod"], {
+                    "metadata": {"annotations": {ANNOT_LOCK: None}}})
+                return
+            except NotFoundError:
+                return  # destination pod gone: nothing left to unlock
+            except Exception as exc:  # noqa: BLE001 — retry, then rely on
+                # the stale-lock cross-check in migration_active()
+                logger.warning("lock clear on %s/%s failed (try %d): %s",
+                               dst["namespace"], dst["pod"],
+                               attempt + 1, exc)
+                time.sleep(0.2)
+
+    def _await_ack(self, ref: dict, mid: str, phase: str,
+                   timeout_s: float, abortable: bool = False) -> bool:
+        """Poll the worker's QuiesceStatus read-back until the tenant
+        acks `phase` for this migration id, the timeout passes, or
+        (abortable phases only) an abort lands."""
+        address = self._worker_addr(ref["namespace"], ref["pod"])
+        deadline = time.monotonic() + timeout_s
+        # One channel for the whole wait: a fresh connect per 0.2s poll
+        # would be ~150 connect/teardown cycles over a 30s timeout.
+        with self.client_factory(address) as client:
+            while time.monotonic() < deadline:
+                if abortable and mid in self._aborts:
+                    # Cut the wait short only in abortable phases
+                    # (nothing has moved yet; the abort lands at the
+                    # next phase boundary). The resume-ack wait must run
+                    # to completion: the chips are already on the
+                    # destination and a late-arriving abort must not
+                    # fake a timed-out tenant.
+                    return False
+                try:
+                    result, status = client.quiesce_status(
+                        ref["pod"], ref["namespace"])
+                except Exception as exc:  # noqa: BLE001 — keep polling
+                    logger.warning("quiesce-status poll failed: %s", exc)
+                    time.sleep(self.cfg.migrate_poll_interval_s)
+                    continue
+                if result == api.QuiesceStatusResult.Success \
+                        and status.acked_id == mid \
+                        and status.acked_phase == phase:
+                    return True
+                time.sleep(self.cfg.migrate_poll_interval_s)
+        return False
+
+    def _get_pod_checked(self, namespace: str, pod_name: str) -> Pod:
+        try:
+            return Pod(self.kube.get_pod(namespace, pod_name))
+        except NotFoundError:
+            raise MigrationRejected(
+                f"No pod: {pod_name} in namespace: {namespace}", 404)
+
+    def _try_pod(self, ref: dict) -> Pod | None:
+        try:
+            return Pod(self.kube.get_pod(ref["namespace"], ref["pod"]))
+        except Exception:  # noqa: BLE001 — event targets are best-effort
+            return None
+
+    def _worker_addr(self, namespace: str, pod_name: str) -> str:
+        pod = self._get_pod_checked(namespace, pod_name)
+        if not pod.node_name:
+            raise MigrationRejected(
+                f"Pod {pod_name} is not scheduled yet", 400)
+        address = self.registry.worker_address(pod.node_name)
+        if address is None:
+            raise MigrationError(
+                f"no tpumounter worker on node {pod.node_name}", 503)
+        return address
+
+    def _probe(self, address: str, namespace: str,
+               pod_name: str) -> list[api.ChipHealth]:
+        try:
+            with self.client_factory(address) as client:
+                result, chips = client.probe_tpu(pod_name, namespace)
+        except Exception as exc:  # noqa: BLE001 — gRPC boundary
+            raise MigrationError(f"probe RPC failed: {exc}")
+        if result != api.ProbeTPUResult.Success:
+            raise MigrationError(
+                f"probe of {namespace}/{pod_name} returned {result.name}")
+        return chips

@@ -33,12 +33,16 @@ class RemoveTPUResult(enum.IntEnum):
 
 
 class AddTPURequest(Message):
-    # Reference: AddGPURequest (api.proto:4-9)
+    # Reference: AddGPURequest (api.proto:4-9). Field 5 is our extension:
+    # ask the allocator to prefer an ICI-contiguous chip block
+    # (allocator/placement.py — allocate-and-trim). Wire-compatible:
+    # legacy peers skip the unknown field and see reference semantics.
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
         Field(3, "tpu_num", "int32"),
         Field(4, "is_entire_mount", "bool"),
+        Field(5, "prefer_ici", "bool"),
     ]
 
 
@@ -113,10 +117,43 @@ class ProbeTPUResponse(Message):
     ]
 
 
+# --- migration quiesce read-back (no reference analog) ---
+#
+# The migration orchestrator signals the tenant through the
+# tpumounter.io/migration-phase annotation (jaxside.watch_migration) and
+# needs eyes on the other side: did the tenant ack the phase (it packs
+# state and stamps tpumounter.io/migration-ack), and do any processes
+# still hold the chips? The worker is the natural reader — it already
+# resolves the pod's container and runs the /proc holder scan.
+
+
+class QuiesceStatusResult(enum.IntEnum):
+    Success = 0
+    PodNotFound = 1
+
+
+class QuiesceStatusRequest(Message):
+    FIELDS = [
+        Field(1, "pod_name", "string"),
+        Field(2, "namespace", "string"),
+    ]
+
+
+class QuiesceStatusResponse(Message):
+    FIELDS = [
+        Field(1, "quiesce_status_result", "enum"),
+        Field(2, "acked_id", "string"),      # migration id the tenant acked
+        Field(3, "acked_phase", "string"),   # "quiesced" / "resumed" / ""
+        Field(4, "holder_count", "int32"),   # PIDs holding any chip
+        Field(5, "chip_count", "int32"),     # chips the pod currently holds
+    ]
+
+
 # gRPC method descriptors: (service_full_name, method, request_cls, response_cls)
 ADD_SERVICE_TPU = "tpu_mount.AddTPUService"
 REMOVE_SERVICE_TPU = "tpu_mount.RemoveTPUService"
 PROBE_SERVICE_TPU = "tpu_mount.ProbeTPUService"  # our extension; no legacy name
+QUIESCE_SERVICE_TPU = "tpu_mount.QuiesceStatusService"  # ditto
 # Reference service names (api.proto:21-23, 43-45) for drop-in clients.
 ADD_SERVICE_LEGACY = "gpu_mount.AddGPUService"
 REMOVE_SERVICE_LEGACY = "gpu_mount.RemoveGPUService"
@@ -126,3 +163,4 @@ REMOVE_METHOD = "RemoveGPU"    # reference method name (api.proto:44)
 ADD_METHOD_TPU = "AddTPU"
 REMOVE_METHOD_TPU = "RemoveTPU"
 PROBE_METHOD_TPU = "ProbeTPU"
+QUIESCE_METHOD_TPU = "QuiesceStatus"

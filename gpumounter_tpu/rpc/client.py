@@ -44,12 +44,16 @@ class WorkerClient:
             f"/{rem_service}/{rem_method}",
             request_serializer=lambda m: m.encode(),
             response_deserializer=api.RemoveTPUResponse.decode)
-        # Probe has no legacy analog; a reference worker answers
+        # Probe/quiesce have no legacy analog; a reference worker answers
         # UNIMPLEMENTED, which callers treat as "health unknown".
         self._probe = self._channel.unary_unary(
             f"/{api.PROBE_SERVICE_TPU}/{api.PROBE_METHOD_TPU}",
             request_serializer=lambda m: m.encode(),
             response_deserializer=api.ProbeTPUResponse.decode)
+        self._quiesce = self._channel.unary_unary(
+            f"/{api.QUIESCE_SERVICE_TPU}/{api.QUIESCE_METHOD_TPU}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=api.QuiesceStatusResponse.decode)
 
     def close(self) -> None:
         self._channel.close()
@@ -68,13 +72,25 @@ class WorkerClient:
 
     def add_tpu_detailed(self, pod_name: str, namespace: str, tpu_num: int,
                          is_entire_mount: bool = False,
+                         prefer_ici: bool = False,
                          ) -> tuple[api.AddTPUResult, list[str]]:
         """(result, mounted device uuids) — uuids empty unless Success."""
         resp = self._add(api.AddTPURequest(
             pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
-            is_entire_mount=is_entire_mount), timeout=self.timeout_s,
+            is_entire_mount=is_entire_mount, prefer_ici=prefer_ici),
+            timeout=self.timeout_s,
             metadata=self._metadata)
         return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
+
+    def quiesce_status(self, pod_name: str, namespace: str,
+                       ) -> tuple["api.QuiesceStatusResult",
+                                  "api.QuiesceStatusResponse"]:
+        """(result, raw response) — the migration orchestrator's read-back
+        of the tenant's ack annotation + live chip holder count."""
+        resp = self._quiesce(api.QuiesceStatusRequest(
+            pod_name=pod_name, namespace=namespace), timeout=self.timeout_s,
+            metadata=self._metadata)
+        return api.QuiesceStatusResult(resp.quiesce_status_result), resp
 
     def probe_tpu(self, pod_name: str, namespace: str,
                   ) -> tuple[api.ProbeTPUResult, list[api.ChipHealth]]:

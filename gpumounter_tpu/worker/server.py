@@ -41,6 +41,11 @@ from gpumounter_tpu.utils.timing import PhaseTimer
 
 logger = get_logger("worker.server")
 
+#: stamped by the tenant's jaxside.watch_migration hook after it packs
+#: (or restores) state; mirror of migrate.journal.ANNOT_ACK — the worker
+#: only reads it back for the orchestrator's QuiesceStatus poll.
+ANNOT_MIGRATION_ACK = "tpumounter.io/migration-ack"
+
 
 class _KeyedLocks:
     """Per-key mutual exclusion without unbounded growth: entries are
@@ -117,7 +122,8 @@ class TpuMountService:
         with timer.phase("slave_pod_schedule"):
             try:
                 devices, slaves = self.allocator.get_available_tpus(
-                    pod, request.tpu_num, per_pod)
+                    pod, request.tpu_num, per_pod,
+                    prefer_ici=bool(request.prefer_ici))
             except InsufficientTpuError as exc:
                 logger.warning("insufficient TPU: %s", exc)
                 return api.AddTPUResponse(
@@ -164,6 +170,27 @@ class TpuMountService:
 
     # --- ProbeTPU (elastic health prober; no reference analog) ---
 
+    def _pod_devices_and_target(self, pod: Pod):
+        """Shared probe/quiesce gathering: one collector refresh, the
+        pod's devices (slave-held included), and its container target —
+        None when the container is gone/restarting (chip-level facts are
+        still reportable; the in-container checks just can't run)."""
+        self.collector.update_status()
+        slave_names = {s.name for s in self.allocator.slave_pods_for(pod)}
+        devices = self.collector.get_pod_devices(
+            pod.name, pod.namespace, slave_pod_names=slave_names,
+            refresh=False)
+        try:
+            target = self.mounter.resolve_target(pod)
+        except MountError:
+            target = None
+        return devices, target
+
+    def _holder_pids(self, target, dev) -> list[int]:
+        if target is not None:
+            return self.mounter.holder_pids(target, dev)
+        return self.collector.backend.running_pids(dev)
+
     def probe_tpu(self, request: api.ProbeTPURequest,
                   context: grpc.ServicerContext) -> api.ProbeTPUResponse:
         """Per-chip health for everything the pod holds: stat the host
@@ -176,17 +203,7 @@ class TpuMountService:
         except NotFoundError:
             return api.ProbeTPUResponse(
                 probe_tpu_result=api.ProbeTPUResult.PodNotFound)
-        self.collector.update_status()
-        slave_names = {s.name for s in self.allocator.slave_pods_for(pod)}
-        devices = self.collector.get_pod_devices(
-            pod.name, pod.namespace, slave_pod_names=slave_names,
-            refresh=False)
-        try:
-            target = self.mounter.resolve_target(pod)
-        except MountError:
-            # Container gone/restarting: chip-level health is still
-            # reportable; the injected-node check just can't run.
-            target = None
+        devices, target = self._pod_devices_and_target(pod)
         chips = []
         for dev in devices:
             healthy, reason = self.collector.backend.probe_device(dev)
@@ -199,15 +216,50 @@ class TpuMountService:
                 if not present:
                     healthy = False
                     reason = "injected device node vanished from target /dev"
-            if target is not None:
-                holders = self.mounter.holder_pids(target, dev)
-            else:
-                holders = self.collector.backend.running_pids(dev)
+            holders = self._holder_pids(target, dev)
             chips.append(api.ChipHealth(uuid=dev.uuid, healthy=healthy,
                                         reason=reason,
                                         holder_count=len(holders)))
         return api.ProbeTPUResponse(
             probe_tpu_result=api.ProbeTPUResult.Success, chips=chips)
+
+    # --- QuiesceStatus (migration orchestrator read-back; no reference
+    # analog) ---
+
+    def quiesce_status(self, request: api.QuiesceStatusRequest,
+                       context: grpc.ServicerContext,
+                       ) -> api.QuiesceStatusResponse:
+        """What the migration orchestrator cannot see from the master:
+        the tenant's ack annotation AND whether any process still holds
+        the chips. Read-only, like probe_tpu."""
+        import json as jsonlib
+
+        try:
+            pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
+        except NotFoundError:
+            return api.QuiesceStatusResponse(
+                quiesce_status_result=api.QuiesceStatusResult.PodNotFound)
+        acked_id = ""
+        acked_phase = ""
+        raw = pod.annotations.get(ANNOT_MIGRATION_ACK)
+        if raw:
+            try:
+                ack = jsonlib.loads(raw)
+                if isinstance(ack, dict):
+                    acked_id = str(ack.get("id", ""))
+                    acked_phase = str(ack.get("phase", ""))
+            except ValueError:
+                logger.warning("unparseable %s annotation on %s/%s: %r",
+                               ANNOT_MIGRATION_ACK, pod.namespace,
+                               pod.name, raw)
+        devices, target = self._pod_devices_and_target(pod)
+        holders: set[int] = set()
+        for dev in devices:
+            holders.update(self._holder_pids(target, dev))
+        return api.QuiesceStatusResponse(
+            quiesce_status_result=api.QuiesceStatusResult.Success,
+            acked_id=acked_id, acked_phase=acked_phase,
+            holder_count=len(holders), chip_count=len(devices))
 
     # --- RemoveTPU (reference: server.go:101-179) ---
 
@@ -281,31 +333,9 @@ class TpuMountService:
         """Surface mount/unmount outcomes as k8s Events on the target pod
         (the reference writes logs only — SURVEY.md §5 'no events on the
         Pod'). Best-effort: failures are logged, never raised."""
-        import secrets as _secrets
-        import time as _time
-
-        ts = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
-        manifest = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {
-                "name": f"{pod.name[:200]}.tpumounter.{_secrets.token_hex(4)}",
-                "namespace": pod.namespace,
-            },
-            "involvedObject": {"kind": "Pod", "name": pod.name,
-                               "namespace": pod.namespace, "uid": pod.uid},
-            "reason": reason,
-            "message": message[:1024],
-            "type": event_type,
-            "source": {"component": "tpumounter-worker"},
-            "firstTimestamp": ts,
-            "lastTimestamp": ts,
-            "count": 1,
-        }
-        try:
-            self.kube.create_event(pod.namespace, manifest)
-        except Exception as exc:  # noqa: BLE001 — events are advisory
-            logger.debug("event post failed: %s", exc)
+        from gpumounter_tpu.k8s.events import post_pod_event
+        post_pod_event(self.kube, pod, reason, message, event_type,
+                       component="tpumounter-worker")
 
     def _release_slaves_for(self, requested: list, unmounted: list) -> None:
         """Delete slave pods whose every requested chip was unmounted.
@@ -400,6 +430,7 @@ def build_server(service: TpuMountService, port: int | None = None,
     add = _handler(service.add_tpu, api.AddTPURequest)
     remove = _handler(service.remove_tpu, api.RemoveTPURequest)
     probe = _handler(service.probe_tpu, api.ProbeTPURequest)
+    quiesce = _handler(service.quiesce_status, api.QuiesceStatusRequest)
     registrations = {
         api.ADD_SERVICE_TPU: {api.ADD_METHOD_TPU: add, api.ADD_METHOD: add},
         api.ADD_SERVICE_LEGACY: {api.ADD_METHOD: add},
@@ -407,6 +438,7 @@ def build_server(service: TpuMountService, port: int | None = None,
                                  api.REMOVE_METHOD: remove},
         api.REMOVE_SERVICE_LEGACY: {api.REMOVE_METHOD: remove},
         api.PROBE_SERVICE_TPU: {api.PROBE_METHOD_TPU: probe},
+        api.QUIESCE_SERVICE_TPU: {api.QUIESCE_METHOD_TPU: quiesce},
     }
     for service_name, methods in registrations.items():
         server.add_generic_rpc_handlers(

@@ -278,7 +278,7 @@ class _ScriptedWorker:
             self.chips.pop(u, None)
         return api.RemoveTPUResult.Success
 
-    def add_tpu_detailed(self, pod, ns, n, entire=False):
+    def add_tpu_detailed(self, pod, ns, n, entire=False, prefer_ici=False):
         if self.fail_mounts:
             raise RuntimeError("forced mount failure")
         added = []
@@ -335,7 +335,8 @@ def test_capacity_exhaustion_above_floor_is_degraded(tmp_path):
     cluster = FakeCluster(str(tmp_path), n_chips=4).start()
     try:
         class _FullWorker(_ScriptedWorker):
-            def add_tpu_detailed(self, pod, ns, n, entire=False):
+            def add_tpu_detailed(self, pod, ns, n, entire=False,
+                                 prefer_ici=False):
                 return api.AddTPUResult.InsufficientTPU, []
 
         worker = _FullWorker({f"chip-{i}": True for i in range(3)})

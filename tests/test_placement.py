@@ -1,0 +1,114 @@
+"""ICI-aware placement: the pure scoring model and the allocator's
+allocate-and-trim path on FakeCluster."""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.allocator import placement
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+
+
+def test_grid_model_and_scores():
+    # 2-wide row-major grid: 0,1 share a tray edge; 0,2 are a column.
+    assert placement.chip_coord(0) == (0, 0)
+    assert placement.chip_coord(3) == (1, 1)
+    assert placement.ici_neighbors(0, 1)
+    assert placement.ici_neighbors(0, 2)
+    assert not placement.ici_neighbors(0, 3)  # diagonal: no direct link
+    assert not placement.ici_neighbors(1, 2)
+    # A 2x2 block has 4 internal links; a scattered 4-set has none.
+    assert placement.contiguity_score([0, 1, 2, 3]) == 4
+    assert placement.contiguity_score([0, 3, 4, 7]) == 0
+    assert placement.contiguity_score([4, 5, 6, 7]) == 4
+
+
+def test_best_block_prefers_contiguous():
+    # Fragmented host: 1,2 gone -> the 4..7 block beats 0,3,4,5.
+    assert placement.best_block([0, 3, 4, 5, 6, 7], 4) == [4, 5, 6, 7]
+    # Ties break to the lowest indices (deterministic retries).
+    assert placement.best_block([0, 1, 2, 3, 4, 5], 4) == [0, 1, 2, 3]
+    assert placement.best_block([0, 1, 4, 5], 2) == [0, 1]
+    # Degenerate shapes.
+    assert placement.best_block([2, 5, 7], 3) == [2, 5, 7]
+    assert placement.best_block([3], 0) == []
+    with pytest.raises(ValueError):
+        placement.best_block([1, 2], 3)
+
+
+def test_best_block_greedy_path_is_sane():
+    """Above the exhaustive-enumeration limit the greedy fallback must
+    still find a fully-connected block when one exists."""
+    free = list(range(40))           # 2x20 grid, C(40,8) >> limit
+    chosen = placement.best_block(free, 8)
+    assert len(chosen) == 8
+    # 8 chips in a 2x4 window have 10 internal links; greedy must land
+    # on a fully-packed window, not a straggly chain.
+    assert placement.contiguity_score(chosen) == 10
+
+
+@pytest.fixture()
+def node_stack(tmp_path):
+    """Single 8-chip node with a live collector + allocator."""
+    from gpumounter_tpu.allocator.allocator import TpuAllocator
+
+    cluster = FakeCluster(str(tmp_path), n_chips=8).start()
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    allocator = TpuAllocator(cluster.kube, collector, cfg=cluster.cfg)
+    yield cluster, allocator
+    cluster.stop()
+
+
+def test_allocator_trims_to_ici_block(node_stack):
+    """Fragmented node (chips 1,2 dead): a prefer_ici single-mount of 2
+    widens with slack slaves, keeps an ICI-linked pair instead of the
+    plugin's scattered {0,3}, and releases the surplus bookings."""
+    cluster, allocator = node_stack
+    cluster.kill_chip(1)
+    cluster.kill_chip(2)
+    owner = cluster.add_target_pod("trainer")
+
+    devices, slaves = allocator.get_available_tpus(owner, 2, 1,
+                                                   prefer_ici=True)
+    # Candidates 0,3 (allocated) + 4,5 (slack): the linked pairs are
+    # {3,5} and {4,5} (score 1 each); the lowest-index tie-break picks
+    # {3,5} over the plugin's scattered {0,3}.
+    assert sorted(d.index for d in devices) == [3, 5]
+    assert len(slaves) == 2
+    # The slack slaves were released: only the keepers hold bookings.
+    pool = cluster.kube.list_pods(
+        cluster.cfg.pool_namespace,
+        label_selector=f"tpumounter.io/owner-uid={owner.uid}")
+    assert sorted(p["metadata"]["name"] for p in pool) == sorted(slaves)
+
+
+def test_allocator_without_preference_keeps_plugin_order(node_stack):
+    """prefer_ici=False is the reference behavior: first free chips win
+    and no extra slave pods are created."""
+    cluster, allocator = node_stack
+    cluster.kill_chip(1)
+    cluster.kill_chip(2)
+    owner = cluster.add_target_pod("trainer")
+    creates_before = cluster.kube.create_calls
+    devices, slaves = allocator.get_available_tpus(owner, 2, 1)
+    assert sorted(d.index for d in devices) == [0, 3]
+    assert cluster.kube.create_calls - creates_before == 2
+
+
+def test_allocator_prefer_ici_survives_no_slack_capacity(node_stack):
+    """Widening is opportunistic: when the node has exactly the asked
+    chips free, prefer_ici must not fail the allocation."""
+    cluster, allocator = node_stack
+    for chip in (0, 1, 2, 3):
+        cluster.kill_chip(chip)
+    owner = cluster.add_target_pod("trainer")
+    devices, slaves = allocator.get_available_tpus(owner, 4, 1,
+                                                   prefer_ici=True)
+    assert sorted(d.index for d in devices) == [4, 5, 6, 7]
+    assert len(slaves) == 4

@@ -1,0 +1,108 @@
+"""Backoff/rate-limit edge cases for the elastic workqueue (ISSUE 2
+satellite): jitter bounds, per-key reset on success, the global floor
+under concurrent producers, and dedup-while-queued."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from gpumounter_tpu.elastic.workqueue import BackoffPolicy, RateLimitedQueue
+
+
+def test_jitter_stays_within_bounds():
+    policy = BackoffPolicy(base_s=0.5, factor=2.0, cap_s=60.0, jitter=0.1)
+    for failures, base in ((1, 0.5), (2, 1.0), (3, 2.0), (5, 8.0)):
+        for _ in range(200):
+            delay = policy.delay_for(failures)
+            assert base <= delay <= base * 1.1, (failures, delay)
+    # The cap bounds the un-jittered delay; jitter rides on top of it.
+    for _ in range(200):
+        assert 60.0 <= policy.delay_for(50) <= 66.0
+    # Zero failures -> no delay; zero jitter -> exact schedule.
+    assert policy.delay_for(0) == 0.0
+    exact = BackoffPolicy(base_s=0.5, factor=2.0, cap_s=60.0, jitter=0.0)
+    assert [exact.delay_for(n) for n in (1, 2, 3)] == [0.5, 1.0, 2.0]
+
+
+def test_backoff_resets_after_success():
+    q = RateLimitedQueue(backoff=BackoffPolicy(base_s=0.5, factor=2.0,
+                                               cap_s=60.0, jitter=0.0))
+    assert q.retry("pod") == 0.5
+    assert q.retry("pod") == 1.0
+    assert q.retry("pod") == 2.0
+    assert q.failures("pod") == 3
+    # Drain the queued entry, then mark success: history must clear and
+    # the NEXT failure starts the schedule over at the base.
+    while q.depth():
+        q.get(timeout_s=3.0)
+    q.forget("pod")
+    assert q.failures("pod") == 0
+    assert q.retry("pod") == 0.5
+    # Other keys' histories are independent.
+    assert q.retry("other") == 0.5
+
+
+def test_global_rate_limit_under_concurrent_enqueues():
+    """N producer threads slam the queue at once; consecutive dequeues
+    must still be spaced by the global floor."""
+    floor = 0.05
+    q = RateLimitedQueue(min_interval_s=floor)
+    n_keys = 8
+    barrier = threading.Barrier(n_keys)
+
+    def _producer(i: int) -> None:
+        barrier.wait()
+        q.add(f"pod-{i}")
+
+    threads = [threading.Thread(target=_producer, args=(i,), daemon=True)
+               for i in range(n_keys)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    pops = []
+    while len(pops) < n_keys:
+        key = q.get(timeout_s=5.0)
+        assert key is not None, f"queue starved after {len(pops)} pops"
+        pops.append((time.monotonic(), key))
+    assert sorted(k for _, k in pops) == sorted(f"pod-{i}"
+                                                for i in range(n_keys))
+    gaps = [b - a for (a, _), (b, _) in zip(pops, pops[1:])]
+    # Allow a small epsilon for monotonic-clock rounding.
+    assert all(gap >= floor - 0.005 for gap in gaps), gaps
+
+
+def test_concurrent_adds_of_same_key_dedupe():
+    q = RateLimitedQueue()
+    barrier = threading.Barrier(8)
+
+    def _producer() -> None:
+        barrier.wait()
+        for _ in range(50):
+            q.add("hot-pod")
+
+    threads = [threading.Thread(target=_producer, daemon=True)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert q.depth() == 1
+    assert q.get(timeout_s=1.0) == "hot-pod"
+    assert q.get(timeout_s=0.05) is None
+
+
+def test_retry_keeps_declared_priority():
+    """A failing high-priority key must keep outranking fresh
+    low-priority work on re-entry."""
+    q = RateLimitedQueue(backoff=BackoffPolicy(base_s=0.01, factor=1.0,
+                                               cap_s=0.01, jitter=0.0))
+    q.add("vip", priority=10)
+    assert q.get(timeout_s=1.0) == "vip"
+    q.retry("vip")          # re-enqueued with backoff, priority remembered
+    q.add("steerage", priority=0)
+    time.sleep(0.05)        # let vip's 10ms backoff elapse
+    assert q.get(timeout_s=1.0) == "vip"
+    assert q.get(timeout_s=1.0) == "steerage"
