@@ -118,6 +118,44 @@ class Config:
     alloc_ici_slack: int = field(default_factory=lambda: int(
         _env("ALLOC_ICI_SLACK", "2")))
 
+    # --- RPC resilience (master -> worker) ---
+    # Per-method deadlines. AddTPU covers slave-pod scheduling + N mounts
+    # and keeps the reference-era budget; RemoveTPU is bounded by the
+    # force-kill path; Probe/QuiesceStatus are read-only scans and must
+    # fail fast (the reconciler and the migration ack poll sit on them).
+    rpc_add_timeout_s: float = field(default_factory=lambda: float(
+        _env("RPC_ADD_TIMEOUT_S", "300")))
+    rpc_remove_timeout_s: float = field(default_factory=lambda: float(
+        _env("RPC_REMOVE_TIMEOUT_S", "120")))
+    rpc_probe_timeout_s: float = field(default_factory=lambda: float(
+        _env("RPC_PROBE_TIMEOUT_S", "15")))
+    rpc_quiesce_timeout_s: float = field(default_factory=lambda: float(
+        _env("RPC_QUIESCE_TIMEOUT_S", "15")))
+    # Bounded capped-exponential retry for retriable transport codes
+    # (UNAVAILABLE, DEADLINE_EXCEEDED). Safe to retry mutations: AddTPU /
+    # RemoveTPU carry idempotency keys, Probe/Quiesce are read-only.
+    rpc_max_attempts: int = field(default_factory=lambda: int(
+        _env("RPC_MAX_ATTEMPTS", "3")))
+    rpc_retry_base_s: float = field(default_factory=lambda: float(
+        _env("RPC_RETRY_BASE_S", "0.1")))
+    rpc_retry_cap_s: float = field(default_factory=lambda: float(
+        _env("RPC_RETRY_CAP_S", "2")))
+    # Per-worker circuit breaker: after this many consecutive transport
+    # failures the worker is degraded (master answers 503 + Retry-After,
+    # reconciler backs off) until a half-open probe succeeds.
+    breaker_failure_threshold: int = field(default_factory=lambda: int(
+        _env("BREAKER_FAILURE_THRESHOLD", "5")))
+    breaker_reset_s: float = field(default_factory=lambda: float(
+        _env("BREAKER_RESET_S", "30")))
+
+    # --- k8s write retries (reconciler / migrate journal persistence) ---
+    # Merge-patches here are self-contained annotation writes, so a 409
+    # conflict or transient 5xx is safe to re-apply; attempts are bounded.
+    k8s_write_attempts: int = field(default_factory=lambda: int(
+        _env("K8S_WRITE_ATTEMPTS", "3")))
+    k8s_write_retry_base_s: float = field(default_factory=lambda: float(
+        _env("K8S_WRITE_RETRY_BASE_S", "0.1")))
+
     # --- control-plane auth ---
     # The reference control plane is open to any in-cluster peer
     # (insecure gRPC dial, cmd/GPUMounter-master/main.go:82; no HTTP

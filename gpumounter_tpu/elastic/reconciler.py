@@ -33,7 +33,12 @@ from gpumounter_tpu.elastic.intents import (
     IntentStore,
 )
 from gpumounter_tpu.elastic.workqueue import BackoffPolicy, RateLimitedQueue
-from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.k8s.client import (
+    KubeClient,
+    NotFoundError,
+    patch_pod_with_retry,
+)
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.utils.log import get_logger
@@ -187,6 +192,10 @@ class ElasticReconciler:
 
     def reconcile_once(self, namespace: str, pod_name: str) -> dict:
         key = f"{namespace}/{pod_name}"
+        # Failpoint: a crash/error here models the reconciler dying at the
+        # top of a pass — _process's boundary turns it into workqueue
+        # backoff, the same recovery a restarted reconciler would get.
+        failpoints.fire("elastic.reconcile", key=key)
         try:
             pod = Pod(self.kube.get_pod(namespace, pod_name))
         except NotFoundError:
@@ -242,6 +251,11 @@ class ElasticReconciler:
         desired = intent.desired_chips
         degraded = False
         if actual < desired:
+            # Crash site between the journaled removal above and the
+            # replacement mount: the _pending_heal journal must carry the
+            # heal record across the induced retry.
+            failpoints.fire("elastic.before_grow", key=key,
+                            gap=desired - actual)
             degraded = not self._grow(address, pod, intent,
                                       desired - actual, actual)
         elif actual > desired:
@@ -324,7 +338,10 @@ class ElasticReconciler:
             coordinator.mount_slice([target], gap, entire=False)
             return True
         except SliceError as exc:
-            if exc.status != 503:
+            # A degraded worker (circuit open, retry_after_s set) is also
+            # 503 but is NOT capacity exhaustion — back off, don't start
+            # shrinking toward the min_chips floor.
+            if exc.status != 503 or exc.retry_after_s is not None:
                 raise ReconcileError(f"mount of {gap} chip(s) failed: {exc}")
         # Capacity exhausted. Already at or above the declared floor:
         # that is the documented "degraded, not failed" state — keep
@@ -367,9 +384,12 @@ class ElasticReconciler:
             "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
         try:
-            self.kube.patch_pod(pod.namespace, pod.name, {
-                "metadata": {"annotations": {
-                    ANNOT_REPLACED: json.dumps(marker)}}})
+            patch_pod_with_retry(
+                self.kube, pod.namespace, pod.name,
+                {"metadata": {"annotations": {
+                    ANNOT_REPLACED: json.dumps(marker)}}},
+                attempts=self.cfg.k8s_write_attempts,
+                base_s=self.cfg.k8s_write_retry_base_s)
         except Exception as exc:  # noqa: BLE001 — marker is advisory
             logger.warning("chip-replaced annotation patch failed: %s", exc)
         _post_pod_event(

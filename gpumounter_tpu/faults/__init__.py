@@ -1,0 +1,39 @@
+"""Failpoint fault-injection framework (see faults/failpoints.py)."""
+
+from gpumounter_tpu.faults.failpoints import (
+    ENV_VAR,
+    CrashError,
+    FailpointError,
+    FailpointSpecError,
+    InjectedUnavailable,
+    Registry,
+    active,
+    arm,
+    arm_spec,
+    armed,
+    disarm,
+    disarm_all,
+    fire,
+    hits,
+    is_armed,
+    value,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "CrashError",
+    "FailpointError",
+    "FailpointSpecError",
+    "InjectedUnavailable",
+    "Registry",
+    "active",
+    "arm",
+    "arm_spec",
+    "armed",
+    "disarm",
+    "disarm_all",
+    "fire",
+    "hits",
+    "is_armed",
+    "value",
+]

@@ -26,6 +26,7 @@ import urllib.parse
 from collections.abc import Iterator
 from typing import Any
 
+from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("k8s")
@@ -56,6 +57,55 @@ def _raise_for(status: int, body: str) -> None:
     if status == 409:
         raise ConflictError(body)
     raise ApiError(status, body)
+
+
+def inject_write_fault(op: str, namespace: str, name: str) -> None:
+    """Failpoint hook shared by the real REST client and the test fake so
+    the chaos harness can inject API-server behavior on either path:
+
+      k8s.<op>           delay / error / crash at the write
+      k8s.<op>.status    return(409) → ConflictError, return(5xx) → ApiError
+
+    ops in use: patch_pod, create_pod, delete_pod."""
+    failpoints.fire(f"k8s.{op}", namespace=namespace, name=name)
+    status = failpoints.value(f"k8s.{op}.status", None,
+                              namespace=namespace, name=name)
+    if status is not None:
+        _raise_for(int(status),
+                   f"failpoint k8s.{op}.status on {namespace}/{name}")
+
+
+def patch_pod_with_retry(kube: "KubeClient", namespace: str, name: str,
+                         patch: dict, attempts: int = 3,
+                         base_s: float = 0.1, cap_s: float = 2.0) -> dict:
+    """Bounded-retry merge-patch for control-plane writers (the elastic
+    reconciler's heal marker, the migration journal/phase stamps).
+
+    A merge-patch carries no resourceVersion, so re-applying it after a
+    409 conflict or a transient 5xx is safe — the writes retried here are
+    self-contained annotation updates, last-writer-wins by design. 404
+    propagates immediately (the pod is gone; retrying cannot help), as
+    does the final failure after `attempts` tries. The transport deadline
+    per attempt is the REST client's per-request timeout."""
+    from gpumounter_tpu.rpc.resilience import RetryPolicy  # stdlib-only
+    policy = RetryPolicy(max_attempts=max(1, attempts), base_s=base_s,
+                         cap_s=cap_s)
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return kube.patch_pod(namespace, name, patch)
+        except NotFoundError:
+            raise
+        except ApiError as exc:
+            retriable = exc.status == 409 or exc.status >= 500
+            if not retriable or attempt >= policy.max_attempts:
+                raise
+            delay = policy.delay_for(attempt)
+            logger.warning(
+                "patch of %s/%s failed (%s, attempt %d/%d); retrying in "
+                "%.2fs", namespace, name, exc.status, attempt,
+                policy.max_attempts, delay)
+            time.sleep(delay)
+    raise AssertionError("unreachable")
 
 
 class KubeClient(abc.ABC):
@@ -210,16 +260,22 @@ class RestKubeClient(KubeClient):
         return self._json("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
 
     def create_pod(self, namespace: str, manifest: dict) -> dict:
+        inject_write_fault("create_pod", namespace,
+                           manifest.get("metadata", {}).get("name", ""))
         return self._json("POST", f"/api/v1/namespaces/{namespace}/pods", body=manifest)
 
     def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
         try:
+            # Inject inside the try: a simulated 404 must behave exactly
+            # like a real one (delete-of-missing is a silent no-op).
+            inject_write_fault("delete_pod", namespace, name)
             self._json("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}",
                        query={"gracePeriodSeconds": grace_period_seconds})
         except NotFoundError:
             pass
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        inject_write_fault("patch_pod", namespace, name)
         return self._json("PATCH",
                           f"/api/v1/namespaces/{namespace}/pods/{name}",
                           body=patch,

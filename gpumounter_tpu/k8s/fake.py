@@ -16,7 +16,12 @@ import time
 import uuid as uuidlib
 from collections.abc import Callable, Iterator
 
-from gpumounter_tpu.k8s.client import ConflictError, KubeClient, NotFoundError
+from gpumounter_tpu.k8s.client import (
+    ConflictError,
+    KubeClient,
+    NotFoundError,
+    inject_write_fault,
+)
 from gpumounter_tpu.k8s.types import Pod, match_label_selector
 
 SchedulerHook = Callable[[dict], None]
@@ -89,6 +94,10 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(pod)
 
     def create_pod(self, namespace: str, manifest: dict) -> dict:
+        # Same injection surface as the REST client, so chaos schedules
+        # hit the fake API server exactly like a real one.
+        inject_write_fault("create_pod", namespace,
+                           manifest.get("metadata", {}).get("name", ""))
         pod = copy.deepcopy(manifest)
         meta = pod.setdefault("metadata", {})
         meta.setdefault("namespace", namespace)
@@ -121,6 +130,10 @@ class FakeKubeClient(KubeClient):
         return copy.deepcopy(pod)
 
     def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
+        try:
+            inject_write_fault("delete_pod", namespace, name)
+        except NotFoundError:
+            return  # match the REST client: delete-of-missing is a no-op
         with self._lock:
             pod = self._pods.pop((namespace, name), None)
             self.delete_calls += 1
@@ -182,6 +195,7 @@ class FakeKubeClient(KubeClient):
                 return
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        inject_write_fault("patch_pod", namespace, name)
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:

@@ -56,6 +56,15 @@ class WorkerRegistry:
     def __init__(self, kube: KubeClient, cfg=None):
         self.kube = kube
         self.cfg = cfg or get_config()
+        # Per-worker circuit breaker, keyed by worker address: shared by
+        # every WorkerClient the master builds, so consecutive transport
+        # failures anywhere in the control plane degrade the entry (the
+        # HTTP routes answer 503 + Retry-After, the reconciler backs off)
+        # until a half-open probe succeeds (rpc/resilience.py).
+        from gpumounter_tpu.rpc.resilience import CircuitBreaker
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.cfg.breaker_failure_threshold,
+            reset_s=self.cfg.breaker_reset_s)
         # node name → (worker pod IP, worker pod name). The pod name makes
         # DELETED eviction exact even when the terminal event no longer
         # carries a podIP (names are unique per namespace at any instant).
@@ -114,6 +123,8 @@ class WorkerRegistry:
             self._apply_to(self._cache, etype, pod)
             if self._journal is not None:  # a LIST is in flight: journal too
                 self._journal.append((etype, pod))
+        if etype == "DELETED":
+            self._prune_breaker()
 
     def _refresh(self) -> None:
         with self._refresh_mu:
@@ -142,6 +153,7 @@ class WorkerRegistry:
         finally:
             with self._lock:
                 self._journal = None
+        self._prune_breaker()
         self._primed.set()
 
     def _watch_loop(self) -> None:
@@ -161,6 +173,15 @@ class WorkerRegistry:
             except Exception as exc:  # noqa: BLE001 — keep the informer up
                 logger.warning("worker watch failed (%s); retrying", exc)
                 self._stop.wait(2.0)
+
+    def _prune_breaker(self) -> None:
+        """Evicted workers take their breaker state (and any standing
+        degraded gauge) with them — a replaced worker at a new IP must
+        not leave a permanently-open series for the dead address."""
+        with self._lock:
+            active = {f"{ip}:{self.cfg.worker_port}"
+                      for ip, _ in self._cache.values()}
+        self.breaker.prune(active)
 
     # --- reads (cache-only; one rate-limited LIST on miss) ---
 
@@ -194,10 +215,12 @@ class WorkerRegistry:
 
 
 class _HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: dict[str, str] | None = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or {}
 
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
@@ -266,9 +289,12 @@ class MasterApp:
         self.kube = kube
         self.registry = registry or WorkerRegistry(kube, self.cfg)
         # The default worker client forwards the same per-deploy secret
-        # the worker's gRPC interceptor checks.
+        # the worker's gRPC interceptor checks, and reports transport
+        # outcomes to the registry's shared per-worker circuit breaker.
         self._client_factory = worker_client_factory or (
-            lambda addr: WorkerClient(addr, token=self._token))
+            lambda addr: WorkerClient(addr, token=self._token, cfg=self.cfg,
+                                      breaker=self.registry.breaker,
+                                      breaker_key=addr))
         # Elastic intent controller: constructed here so the routes and
         # the loop share one store/queue; the loop thread only runs after
         # an explicit elastic.start() (master/main.py — tests drive
@@ -286,8 +312,9 @@ class MasterApp:
     # --- plumbing ---
 
     def handle(self, method: str, path: str, body: bytes,
-               headers: dict[str, str]) -> tuple[int, str, str]:
-        """Returns (status, content_type, body)."""
+               headers: dict[str, str]
+               ) -> tuple[int, str, str, dict[str, str]]:
+        """Returns (status, content_type, body, response_headers)."""
         try:
             for m, pattern, name in _ROUTES:
                 if m != method:
@@ -295,13 +322,16 @@ class MasterApp:
                 match = pattern.match(path)
                 if match:
                     self._check_auth(name, headers)
-                    return getattr(self, f"_route_{name}")(match, body, headers)
+                    out = getattr(self, f"_route_{name}")(match, body,
+                                                          headers)
+                    status, ctype, text = out
+                    return status, ctype, text, {}
             raise _HttpError(404, "404 page not found")
         except _HttpError as exc:
-            return exc.status, "text/plain", exc.message + "\n"
+            return exc.status, "text/plain", exc.message + "\n", exc.headers
         except Exception as exc:  # noqa: BLE001 — boundary
             logger.exception("unhandled error for %s %s", method, path)
-            return 500, "text/plain", f"Service Internal Error: {exc}\n"
+            return 500, "text/plain", f"Service Internal Error: {exc}\n", {}
 
     def _check_auth(self, route_name: str, headers: dict[str, str]) -> None:
         if self._token is None or route_name in self.UNAUTHENTICATED_ROUTES:
@@ -327,6 +357,17 @@ class MasterApp:
         if address is None:
             logger.error("no tpumounter worker on node %s", node)
             raise _HttpError(500, "Service Internal Error")
+        # Degraded worker: answer 503 + Retry-After immediately instead of
+        # queueing the request behind a dial that is known to hang. Pure
+        # view (retry_after, not allow) so the route never consumes the
+        # breaker's single half-open probe slot — the actual RPC does.
+        retry_after = self.registry.breaker.retry_after(address)
+        if retry_after is not None:
+            raise _HttpError(
+                503,
+                f"worker on node {node} is degraded (circuit breaker "
+                f"open); retry in {retry_after:.0f}s",
+                headers={"Retry-After": str(max(1, int(retry_after + 0.5)))})
         return address, node
 
     # --- routes ---
@@ -393,7 +434,8 @@ class MasterApp:
                 targets, chips, entire, accel_type=accel_type,
                 topology_hint=topology_hint, prefer_ici=prefer_ici)
         except SliceError as exc:
-            raise _HttpError(exc.status, str(exc))
+            raise _HttpError(exc.status, str(exc),
+                             headers=_slice_headers(exc))
         return 200, "application/json", jsonlib.dumps(plan, indent=1) + "\n"
 
     def _route_removeslice(self, match, body, headers):
@@ -405,7 +447,8 @@ class MasterApp:
         try:
             outcome = self._slice_coordinator().remove_slice(targets, force)
         except SliceError as exc:
-            raise _HttpError(exc.status, str(exc))
+            raise _HttpError(exc.status, str(exc),
+                             headers=_slice_headers(exc))
         return 200, "application/json", jsonlib.dumps(outcome) + "\n"
 
     def _route_workers(self, match, body, headers):
@@ -553,7 +596,7 @@ class MasterApp:
                 result = client.add_tpu(pod_name, ns, tpu_num, entire)
             except Exception as exc:  # noqa: BLE001 — gRPC boundary
                 logger.error("worker AddTPU failed: %s", exc)
-                raise _HttpError(500, f"Service Internal Error: {_grpc_detail(exc)}")
+                raise _degraded_or_500(exc)
         if result == api.AddTPUResult.Success:
             return 200, "text/plain", "Add TPU Success\n"
         if result == api.AddTPUResult.InsufficientTPU:
@@ -581,7 +624,7 @@ class MasterApp:
                 result = client.remove_tpu(pod_name, ns, uuids, force)
             except Exception as exc:  # noqa: BLE001 — gRPC boundary
                 logger.error("worker RemoveTPU failed: %s", exc)
-                raise _HttpError(500, f"Service Internal Error: {_grpc_detail(exc)}")
+                raise _degraded_or_500(exc)
         joined = ", ".join(uuids)
         if result == api.RemoveTPUResult.Success:
             return 200, "text/plain", f"Remove {len(uuids)} TPUs Success\n"
@@ -602,6 +645,25 @@ def _grpc_detail(exc: Exception) -> str:
     return str(exc)
 
 
+def _slice_headers(exc) -> dict[str, str] | None:
+    retry_after = getattr(exc, "retry_after_s", None)
+    if retry_after is None:
+        return None
+    return {"Retry-After": str(max(1, int(retry_after + 0.5)))}
+
+
+def _degraded_or_500(exc: Exception) -> _HttpError:
+    """Map a worker-call failure to HTTP: a breaker that opened (or was
+    found open) mid-call is 503 + Retry-After, anything else 500."""
+    from gpumounter_tpu.rpc.resilience import BreakerOpenError
+    if isinstance(exc, BreakerOpenError):
+        return _HttpError(
+            503, f"worker degraded (circuit breaker open): {exc}",
+            headers={"Retry-After":
+                     str(max(1, int(exc.retry_after_s + 0.5)))})
+    return _HttpError(500, f"Service Internal Error: {_grpc_detail(exc)}")
+
+
 def build_http_server(app: MasterApp, port: int | None = None,
                       host: str = "0.0.0.0") -> ThreadingHTTPServer:
     cfg = app.cfg
@@ -612,12 +674,14 @@ def build_http_server(app: MasterApp, port: int | None = None,
         def _dispatch(self):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            status, ctype, text = app.handle(
+            status, ctype, text, extra = app.handle(
                 self.command, self.path, body, dict(self.headers))
             payload = text.encode()
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
+            for key, value in extra.items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(payload)
 

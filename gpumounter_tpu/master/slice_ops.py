@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 
+from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import NotFoundError
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.rpc import api
@@ -31,9 +32,13 @@ logger = get_logger("master.slice")
 
 
 class SliceError(RuntimeError):
-    def __init__(self, message: str, status: int = 500):
+    def __init__(self, message: str, status: int = 500,
+                 retry_after_s: float | None = None):
         super().__init__(message)
         self.status = status
+        #: set when the failure is a degraded worker (circuit open): the
+        #: HTTP layer turns it into a Retry-After header.
+        self.retry_after_s = retry_after_s
 
 
 @dataclass(frozen=True)
@@ -193,6 +198,8 @@ class SliceCoordinator:
                     prefer_ici: bool = False) -> dict:
         if len(targets) < 1:
             raise SliceError("empty slice", 400)
+        failpoints.fire("master.slice.mount",
+                        pods=[t.pod for t in targets])
         resolved = self._resolve(targets)
         # Build (and thereby VALIDATE) the topology plan before touching
         # any worker: a bad acceleratorType/host-count must fail the
@@ -228,6 +235,12 @@ class SliceCoordinator:
             logger.error("slice mount failed on %d/%d host(s); rolling "
                          "back %d", len(failures), len(targets),
                          len(succeeded))
+            if failpoints.value("master.slice.rollback.skip", False):
+                # Deliberate invariant breaker (chaos harness negative
+                # test): leave the partially-mounted slice in place.
+                logger.error("slice rollback SKIPPED by failpoint; "
+                             "%d host mount(s) leaked", len(succeeded))
+                succeeded = []
             for i in succeeded:
                 t, _, addr, _ip = resolved[i]
                 _, mounted_uuids = results[i]  # type: ignore[misc]
@@ -290,10 +303,16 @@ class SliceCoordinator:
                 isinstance(r, tuple)
                 and r[0] == api.AddTPUResult.InsufficientTPU
                 for r in failures.values())
-            # 503: capacity exhaustion is retryable-after-scale-up and
-            # must be distinguishable from an internal fault.
-            raise SliceError(f"slice mount failed ({detail})",
-                             503 if insufficient else 500)
+            # 503: capacity exhaustion is retryable-after-scale-up, and a
+            # degraded worker (circuit open) is retryable-after-cooldown —
+            # both must be distinguishable from an internal fault.
+            from gpumounter_tpu.rpc.resilience import BreakerOpenError
+            breaker = next((r for r in failures.values()
+                            if isinstance(r, BreakerOpenError)), None)
+            raise SliceError(
+                f"slice mount failed ({detail})",
+                503 if insufficient or breaker else 500,
+                retry_after_s=breaker.retry_after_s if breaker else None)
         logger.info("slice mounted: %d host(s) × %d chip(s)",
                     len(targets), chips_per_host)
         return plan
@@ -326,5 +345,11 @@ class SliceCoordinator:
         bad = [p for p, r in outcome.items()
                if r not in ("Success", "TPUNotFound")]
         if bad:
-            raise SliceError(f"slice remove incomplete: {outcome}", 500)
+            from gpumounter_tpu.rpc.resilience import BreakerOpenError
+            breaker = next((r for r in results.values()
+                            if isinstance(r, BreakerOpenError)), None)
+            raise SliceError(
+                f"slice remove incomplete: {outcome}",
+                503 if breaker else 500,
+                retry_after_s=breaker.retry_after_s if breaker else None)
         return {"removed": outcome}

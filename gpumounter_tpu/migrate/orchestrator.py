@@ -41,7 +41,13 @@ import threading
 import time
 
 from gpumounter_tpu.config import get_config
-from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.faults.failpoints import CrashError
+from gpumounter_tpu.k8s.client import (
+    KubeClient,
+    NotFoundError,
+    patch_pod_with_retry,
+)
 from gpumounter_tpu.k8s.events import post_pod_event
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.migrate.journal import (
@@ -258,12 +264,19 @@ class MigrationCoordinator:
     def _run(self, journal: dict) -> None:
         mid = journal["id"]
         final_phase = journal["phase"]
+        crashed = False
         try:
             while journal["phase"] != PHASE_DONE:
                 phase = journal["phase"]
                 final_phase = phase
                 if mid in self._aborts and phase in self.ABORTABLE_PHASES:
                     raise _Aborted(f"abort requested during {phase}")
+                # Crash site at every journal-phase boundary: the chaos
+                # harness arms migrate.phase.<name>=crash to kill the
+                # machine exactly between persisted transitions, then
+                # proves resume_interrupted() re-drives to a terminal
+                # state from whatever the journal recorded.
+                failpoints.fire(f"migrate.phase.{phase}", id=mid)
                 started = time.monotonic()
                 next_phase = getattr(self, f"_phase_{phase}")(journal)
                 elapsed = time.monotonic() - started
@@ -283,6 +296,15 @@ class MigrationCoordinator:
                         journal["outcome"])
         except _Aborted as exc:
             self._rollback(journal, str(exc), outcome="aborted")
+        except CrashError as exc:
+            # Simulated master death: NO rollback, NO outcome — exactly
+            # what a real crash leaves behind. The journal stays at its
+            # last persisted phase; a restart's resume_interrupted()
+            # (or the chaos harness calling it) re-adopts and re-drives.
+            crashed = True
+            logger.error("migration %s: simulated crash (%s); journal "
+                         "left at phase %s for resume", mid, exc,
+                         journal["phase"])
         except Exception as exc:  # noqa: BLE001 — terminal boundary
             if not isinstance(exc, MigrationError):
                 logger.exception("migration %s: unexpected failure in "
@@ -304,8 +326,10 @@ class MigrationCoordinator:
             else:
                 self._rollback(journal, str(exc))
         finally:
-            MIGRATIONS_TOTAL.inc(phase=final_phase,
-                                 outcome=journal.get("outcome") or "failed")
+            if not crashed:  # a crashed machine is resumed, not finished
+                MIGRATIONS_TOTAL.inc(
+                    phase=final_phase,
+                    outcome=journal.get("outcome") or "failed")
             with self._lock:
                 self._aborts.discard(mid)
                 self._threads.pop(mid, None)
@@ -613,10 +637,18 @@ class MigrationCoordinator:
 
     def _persist(self, journal: dict) -> None:
         src = journal["source"]
+        # Crash site between a phase completing and its journal write —
+        # the classic lost-update instant; every phase is re-entrant so
+        # the resumed machine re-drives from the previous record.
+        failpoints.fire("migrate.persist", id=journal["id"],
+                        phase=journal["phase"])
         try:
-            self.kube.patch_pod(src["namespace"], src["pod"], {
-                "metadata": {"annotations": {ANNOT_JOURNAL:
-                                             dump(journal)}}})
+            patch_pod_with_retry(
+                self.kube, src["namespace"], src["pod"],
+                {"metadata": {"annotations": {ANNOT_JOURNAL:
+                                              dump(journal)}}},
+                attempts=self.cfg.k8s_write_attempts,
+                base_s=self.cfg.k8s_write_retry_base_s)
         except NotFoundError:
             raise MigrationError(
                 f"source pod {src['namespace']}/{src['pod']} disappeared "
@@ -629,19 +661,28 @@ class MigrationCoordinator:
         payload = {**payload,
                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
         try:
-            self.kube.patch_pod(ref["namespace"], ref["pod"], {
-                "metadata": {"annotations": {
-                    annotation: jsonlib.dumps(payload)}}})
+            patch_pod_with_retry(
+                self.kube, ref["namespace"], ref["pod"],
+                {"metadata": {"annotations": {
+                    annotation: jsonlib.dumps(payload)}}},
+                attempts=self.cfg.k8s_write_attempts,
+                base_s=self.cfg.k8s_write_retry_base_s)
         except NotFoundError:
             logger.warning("cannot stamp %s on %s/%s: pod gone",
                            annotation, ref["namespace"], ref["pod"])
 
     def _clear_lock(self, journal: dict) -> None:
         dst = journal["destination"]
+        # Outer loop covers transport-level failures (connection errors
+        # raised before any HTTP status exists) that patch_pod_with_retry
+        # — which only retries ApiError 409/5xx — re-raises immediately.
         for attempt in range(3):
             try:
-                self.kube.patch_pod(dst["namespace"], dst["pod"], {
-                    "metadata": {"annotations": {ANNOT_LOCK: None}}})
+                patch_pod_with_retry(
+                    self.kube, dst["namespace"], dst["pod"],
+                    {"metadata": {"annotations": {ANNOT_LOCK: None}}},
+                    attempts=max(3, self.cfg.k8s_write_attempts),
+                    base_s=max(0.2, self.cfg.k8s_write_retry_base_s))
                 return
             except NotFoundError:
                 return  # destination pod gone: nothing left to unlock

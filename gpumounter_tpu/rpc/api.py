@@ -35,14 +35,19 @@ class RemoveTPUResult(enum.IntEnum):
 class AddTPURequest(Message):
     # Reference: AddGPURequest (api.proto:4-9). Field 5 is our extension:
     # ask the allocator to prefer an ICI-contiguous chip block
-    # (allocator/placement.py — allocate-and-trim). Wire-compatible:
-    # legacy peers skip the unknown field and see reference semantics.
+    # (allocator/placement.py — allocate-and-trim). Field 6 makes retries
+    # safe: the worker remembers recently-completed keys and answers a
+    # retried mount from that record instead of mounting again (the
+    # client's bounded retry + the chaos harness depend on it).
+    # Wire-compatible: legacy peers skip the unknown fields and see
+    # reference semantics.
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
         Field(3, "tpu_num", "int32"),
         Field(4, "is_entire_mount", "bool"),
         Field(5, "prefer_ici", "bool"),
+        Field(6, "idempotency_key", "string"),
     ]
 
 
@@ -61,14 +66,17 @@ class AddTPUResponse(Message):
 class RemoveTPURequest(Message):
     # Reference: RemoveGPURequest (api.proto:25-30); uuids -> device ids.
     # Field 5 is our extension: remove every slave-held chip regardless of
-    # mount type (the slice coordinator's remove path; wire-compatible —
-    # legacy peers skip the unknown field and see reference semantics).
+    # mount type (the slice coordinator's remove path). Field 6 mirrors
+    # AddTPURequest: a retried remove whose first attempt landed answers
+    # Success from the worker's idempotency record. Wire-compatible —
+    # legacy peers skip the unknown fields and see reference semantics.
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
         Field(3, "uuids", "string", repeated=True),
         Field(4, "force", "bool"),
         Field(5, "remove_all", "bool"),
+        Field(6, "idempotency_key", "string"),
     ]
 
 

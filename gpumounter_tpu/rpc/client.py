@@ -4,32 +4,95 @@ Reference parity: the master dials workerIP:1200 insecure and calls
 AddGPU/RemoveGPU (cmd/GPUMounter-master/main.go:82-96, 185-199). This client
 speaks the TPU-native service names; `legacy=True` switches to the
 reference's gpu_mount.* names for cross-testing.
+
+Resilience (rpc/resilience.py): every call gets a per-method deadline
+from config (overridable per call via `timeout_s=`), a bounded
+capped-exponential retry on retriable transport codes, and — when the
+caller wires one in — a per-worker circuit breaker that fails fast while
+the worker is degraded. AddTPU/RemoveTPU carry idempotency keys so a
+retried mutation is answered from the worker's completion record instead
+of mounting twice. Transport failures surface as typed errors
+(DeadlineExceededError, WorkerUnavailableError, BreakerOpenError).
+
+Failpoint sites (gpumounter_tpu/faults):
+  rpc.client.call       delay / drop (unavailable) / error every outbound
+                        attempt (ctx: method, address)
+  rpc.client.deadline   return(seconds) overrides the resolved deadline
 """
 
 from __future__ import annotations
 
-from gpumounter_tpu.rpc import api
-from gpumounter_tpu.utils.lazy_grpc import grpc
+import secrets
+import time
 
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.rpc.resilience import (
+    RPC_RETRIES,
+    BreakerOpenError,
+    CircuitBreaker,
+    DeadlineExceededError,
+    RetryPolicy,
+    WorkerUnavailableError,
+)
+from gpumounter_tpu.utils.lazy_grpc import grpc
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("rpc.client")
 
 _TOKEN_FROM_CONFIG = object()  # sentinel: resolve from global config
 
+#: gRPC codes worth another bounded attempt. Safe for mutations because
+#: AddTPU/RemoveTPU are idempotent under their key; Probe/Quiesce are
+#: read-only.
+_RETRIABLE_CODE_NAMES = frozenset({"UNAVAILABLE", "DEADLINE_EXCEEDED"})
+
+#: methods whose retry safety depends on the worker honoring the
+#: idempotency key — a legacy (reference) worker skips that field, so
+#: retrying them against one could mount twice.
+_MUTATION_METHODS = frozenset({"AddTPU", "RemoveTPU"})
+
 
 class WorkerClient:
-    def __init__(self, address: str, timeout_s: float = 300.0,
-                 legacy: bool = False, token=_TOKEN_FROM_CONFIG):
+    def __init__(self, address: str, timeout_s: float | None = None,
+                 legacy: bool = False, token=_TOKEN_FROM_CONFIG,
+                 cfg=None, retry: RetryPolicy | None = None,
+                 breaker: CircuitBreaker | None = None,
+                 breaker_key: str | None = None):
         """token: the worker's shared bearer secret (utils/auth.py).
         Default resolves TPUMOUNTER_AUTH_TOKEN[_FILE] from the global
         config; pass None to send no credentials (rejected by a worker
-        in the default token mode)."""
-        if token is _TOKEN_FROM_CONFIG:
+        in the default token mode).
+
+        timeout_s: uniform deadline override for every method; None (the
+        default) uses the per-method deadlines from config
+        (rpc_{add,remove,probe,quiesce}_timeout_s).
+
+        breaker/breaker_key: a shared CircuitBreaker (usually the
+        WorkerRegistry's) and the key to report under; omitted = no
+        breaker participation (standalone/CLI use)."""
+        if cfg is None:
             from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        if token is _TOKEN_FROM_CONFIG:
             from gpumounter_tpu.utils.auth import resolve_token
-            token = resolve_token(get_config())
+            token = resolve_token(cfg)
         self._metadata = ((("authorization", f"Bearer {token}"),)
                           if token else None)
         self.address = address
         self.timeout_s = timeout_s
+        self.timeouts = {
+            "AddTPU": cfg.rpc_add_timeout_s,
+            "RemoveTPU": cfg.rpc_remove_timeout_s,
+            "ProbeTPU": cfg.rpc_probe_timeout_s,
+            "QuiesceStatus": cfg.rpc_quiesce_timeout_s,
+        }
+        self.retry = retry or RetryPolicy(
+            max_attempts=cfg.rpc_max_attempts,
+            base_s=cfg.rpc_retry_base_s, cap_s=cfg.rpc_retry_cap_s)
+        self.breaker = breaker
+        self.breaker_key = breaker_key or address
+        self._legacy = legacy
         self._channel = grpc.insecure_channel(address)
         add_service = api.ADD_SERVICE_LEGACY if legacy else api.ADD_SERVICE_TPU
         rem_service = (api.REMOVE_SERVICE_LEGACY if legacy
@@ -56,55 +119,153 @@ class WorkerClient:
             response_deserializer=api.QuiesceStatusResponse.decode)
 
     def close(self) -> None:
-        self._channel.close()
+        channel, self._channel = self._channel, None
+        if channel is not None:  # idempotent: with-block + explicit close
+            channel.close()
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
+        return False
+
+    # --- the resilient call core ---
+
+    @staticmethod
+    def _code_name(exc: Exception) -> str:
+        if isinstance(exc, failpoints.InjectedUnavailable):
+            return "UNAVAILABLE"
+        code = getattr(exc, "code", None)
+        if callable(code):
+            try:
+                return getattr(code(), "name", "") or "UNKNOWN"
+            except Exception:  # noqa: BLE001 — non-grpc .code() callables
+                return "UNKNOWN"
+        return ""
+
+    def _call(self, method: str, stub, request, timeout_s: float | None):
+        if self._channel is None:
+            raise RuntimeError(f"WorkerClient for {self.address} is closed")
+        deadline = (timeout_s if timeout_s is not None
+                    else self.timeout_s if self.timeout_s is not None
+                    else self.timeouts[method])
+        deadline = float(failpoints.value("rpc.client.deadline", deadline,
+                                          method=method))
+        last_exc: Exception | None = None
+        for attempt in range(1, self.retry.max_attempts + 1):
+            if self.breaker is not None:
+                retry_after = self.breaker.allow(self.breaker_key)
+                if retry_after is not None:
+                    raise BreakerOpenError(
+                        f"worker {self.address} degraded (circuit open); "
+                        f"retry in {retry_after:.1f}s", retry_after,
+                        self.address, method) from last_exc
+            try:
+                failpoints.fire("rpc.client.call", method=method,
+                                address=self.address)
+                response = stub(request, timeout=deadline,
+                                metadata=self._metadata)
+            except Exception as exc:  # noqa: BLE001 — gRPC boundary
+                code = self._code_name(exc)
+                transport = code in _RETRIABLE_CODE_NAMES
+                # A legacy peer ignores the idempotency key, so a retried
+                # mutation could land twice there — never retry those.
+                retriable = transport and not (
+                    self._legacy and method in _MUTATION_METHODS)
+                if self.breaker is not None:
+                    # Only transport-level failures degrade the worker: an
+                    # application error (FAILED_PRECONDITION, INTERNAL...)
+                    # proves it is alive and answering.
+                    if transport:
+                        self.breaker.record_failure(self.breaker_key)
+                    else:
+                        self.breaker.record_success(self.breaker_key)
+                if not retriable or attempt >= self.retry.max_attempts:
+                    raise self._typed(exc, code, method) from exc
+                last_exc = exc
+                delay = self.retry.delay_for(attempt)
+                RPC_RETRIES.inc(method=method)
+                logger.warning(
+                    "%s to %s failed (%s, attempt %d/%d); retrying in "
+                    "%.2fs", method, self.address, code or exc, attempt,
+                    self.retry.max_attempts, delay)
+                time.sleep(delay)
+            else:
+                if self.breaker is not None:
+                    self.breaker.record_success(self.breaker_key)
+                return response
+        raise AssertionError("unreachable")  # loop always returns/raises
+
+    def _typed(self, exc: Exception, code: str, method: str) -> Exception:
+        if code == "DEADLINE_EXCEEDED":
+            return DeadlineExceededError(
+                f"{method} to {self.address} exceeded its deadline",
+                self.address, method)
+        if code == "UNAVAILABLE":
+            return WorkerUnavailableError(
+                f"{method} to {self.address}: worker unavailable ({exc})",
+                self.address, method)
+        return exc  # non-transport errors keep their original type
+
+    # --- methods ---
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
-                is_entire_mount: bool = False) -> api.AddTPUResult:
+                is_entire_mount: bool = False,
+                timeout_s: float | None = None) -> api.AddTPUResult:
         result, _ = self.add_tpu_detailed(pod_name, namespace, tpu_num,
-                                          is_entire_mount)
+                                          is_entire_mount,
+                                          timeout_s=timeout_s)
         return result
 
     def add_tpu_detailed(self, pod_name: str, namespace: str, tpu_num: int,
                          is_entire_mount: bool = False,
                          prefer_ici: bool = False,
+                         timeout_s: float | None = None,
+                         idempotency_key: str | None = None,
                          ) -> tuple[api.AddTPUResult, list[str]]:
-        """(result, mounted device uuids) — uuids empty unless Success."""
-        resp = self._add(api.AddTPURequest(
+        """(result, mounted device uuids) — uuids empty unless Success.
+
+        One idempotency key covers the whole bounded-retry loop: a retry
+        whose first attempt actually landed on the worker gets the
+        recorded response back instead of a second mount."""
+        request = api.AddTPURequest(
             pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
-            is_entire_mount=is_entire_mount, prefer_ici=prefer_ici),
-            timeout=self.timeout_s,
-            metadata=self._metadata)
+            is_entire_mount=is_entire_mount, prefer_ici=prefer_ici,
+            idempotency_key=idempotency_key or f"add-{secrets.token_hex(8)}")
+        resp = self._call("AddTPU", self._add, request, timeout_s)
         return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
 
     def quiesce_status(self, pod_name: str, namespace: str,
+                       timeout_s: float | None = None,
                        ) -> tuple["api.QuiesceStatusResult",
                                   "api.QuiesceStatusResponse"]:
         """(result, raw response) — the migration orchestrator's read-back
         of the tenant's ack annotation + live chip holder count."""
-        resp = self._quiesce(api.QuiesceStatusRequest(
-            pod_name=pod_name, namespace=namespace), timeout=self.timeout_s,
-            metadata=self._metadata)
+        resp = self._call("QuiesceStatus", self._quiesce,
+                          api.QuiesceStatusRequest(
+                              pod_name=pod_name, namespace=namespace),
+                          timeout_s)
         return api.QuiesceStatusResult(resp.quiesce_status_result), resp
 
     def probe_tpu(self, pod_name: str, namespace: str,
+                  timeout_s: float | None = None,
                   ) -> tuple[api.ProbeTPUResult, list[api.ChipHealth]]:
         """(result, per-chip health for every chip the pod holds)."""
-        resp = self._probe(api.ProbeTPURequest(
-            pod_name=pod_name, namespace=namespace), timeout=self.timeout_s,
-            metadata=self._metadata)
+        resp = self._call("ProbeTPU", self._probe,
+                          api.ProbeTPURequest(
+                              pod_name=pod_name, namespace=namespace),
+                          timeout_s)
         return api.ProbeTPUResult(resp.probe_tpu_result), list(resp.chips)
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                    force: bool = False,
-                   remove_all: bool = False) -> api.RemoveTPUResult:
-        resp = self._remove(api.RemoveTPURequest(
+                   remove_all: bool = False,
+                   timeout_s: float | None = None,
+                   idempotency_key: str | None = None) -> api.RemoveTPUResult:
+        request = api.RemoveTPURequest(
             pod_name=pod_name, namespace=namespace, uuids=list(uuids),
-            force=force, remove_all=remove_all), timeout=self.timeout_s,
-            metadata=self._metadata)
+            force=force, remove_all=remove_all,
+            idempotency_key=idempotency_key or f"rm-{secrets.token_hex(8)}")
+        resp = self._call("RemoveTPU", self._remove, request, timeout_s)
         return api.RemoveTPUResult(resp.remove_tpu_result)

@@ -1,0 +1,207 @@
+"""RPC resilience primitives: typed errors, bounded retry, circuit breaker.
+
+The reference master dials each worker with no deadline discipline beyond
+a single huge timeout and no failure memory at all: one wedged worker
+node makes every request that routes to it hang for the full timeout,
+serially, forever. Here every master→worker call gets
+
+  * a per-method deadline (config-driven, overridable per call),
+  * a capped-exponential bounded retry for retriable transport codes
+    (safe because AddTPU/RemoveTPU carry idempotency keys and
+    Probe/QuiesceStatus are read-only),
+  * a per-worker circuit breaker: after `failure_threshold` consecutive
+    transport failures the worker's WorkerRegistry entry is degraded —
+    calls fail fast with BreakerOpenError, the master's HTTP routes turn
+    that into 503 + Retry-After, and the elastic reconciler's workqueue
+    backoff absorbs it. After `reset_s` one half-open probe is let
+    through; success closes the breaker, failure re-opens it.
+
+Stdlib-only; grpc types are touched only by the client (lazy-grpc policy).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("rpc.resilience")
+
+BREAKER_OPEN = REGISTRY.gauge(
+    "tpumounter_worker_breaker_open",
+    "1 while the named worker's circuit breaker is open (degraded)")
+BREAKER_TRIPS = REGISTRY.counter(
+    "tpumounter_worker_breaker_trips_total",
+    "Circuit-breaker open transitions by worker")
+RPC_RETRIES = REGISTRY.counter(
+    "tpumounter_rpc_retries_total",
+    "Worker RPC attempts retried after a retriable transport failure")
+
+
+class RpcCallError(RuntimeError):
+    """Base for typed master→worker RPC failures.
+
+    `code` is the gRPC status name ("DEADLINE_EXCEEDED", "UNAVAILABLE",
+    ...) or a synthetic one ("BREAKER_OPEN", "INJECTED")."""
+
+    def __init__(self, message: str, code: str = "UNKNOWN",
+                 address: str = "", method: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.address = address
+        self.method = method
+
+
+class DeadlineExceededError(RpcCallError):
+    """The per-call deadline elapsed (grpc DEADLINE_EXCEEDED)."""
+
+    def __init__(self, message: str, address: str = "", method: str = ""):
+        super().__init__(message, "DEADLINE_EXCEEDED", address, method)
+
+
+class WorkerUnavailableError(RpcCallError):
+    """Transport-level failure: connection refused/dropped (UNAVAILABLE)."""
+
+    def __init__(self, message: str, address: str = "", method: str = ""):
+        super().__init__(message, "UNAVAILABLE", address, method)
+
+
+class BreakerOpenError(RpcCallError):
+    """The worker's circuit breaker is open; fail fast, retry later."""
+
+    def __init__(self, message: str, retry_after_s: float,
+                 address: str = "", method: str = ""):
+        super().__init__(message, "BREAKER_OPEN", address, method)
+        self.retry_after_s = retry_after_s
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff between bounded attempts.
+
+    `max_attempts` counts the first try: max_attempts=3 means at most two
+    retries. Worst-case wall time per logical call is therefore
+    max_attempts * deadline + sum(delays) — bounded by construction."""
+
+    max_attempts: int = 3
+    base_s: float = 0.1
+    factor: float = 2.0
+    cap_s: float = 2.0
+
+    def delay_for(self, attempt: int) -> float:
+        """Sleep before retry number `attempt` (1-based)."""
+        if attempt <= 0:
+            return 0.0
+        return min(self.base_s * self.factor ** (attempt - 1), self.cap_s)
+
+
+class CircuitBreaker:
+    """Per-key (worker address) consecutive-failure breaker.
+
+    States: closed (normal) → open after `failure_threshold` consecutive
+    transport failures → half-open after `reset_s` (exactly one probe
+    call allowed through) → closed on probe success / open on failure.
+    """
+
+    def __init__(self, failure_threshold: int = 5, reset_s: float = 30.0):
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_s = reset_s
+        self._lock = threading.Lock()
+        #: key -> [consecutive_failures, opened_at or None, probe_in_flight]
+        self._entries: dict[str, list] = {}
+
+    # --- views (non-mutating; the master's route pre-check) ---
+
+    def state(self, key: str) -> str:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[1] is None:
+                return "closed"
+            if time.monotonic() - entry[1] >= self.reset_s:
+                return "half-open"
+            return "open"
+
+    def retry_after(self, key: str) -> float | None:
+        """Seconds until a retry is worth making, or None when calls may
+        proceed. Pure read: does NOT consume the half-open probe slot —
+        callers that actually dial must still pass allow()."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[1] is None:
+                return None
+            remaining = self.reset_s - (time.monotonic() - entry[1])
+            return max(0.0, remaining) if remaining > 0 else None
+
+    def snapshot(self) -> dict[str, str]:
+        with self._lock:
+            keys = list(self._entries)
+        return {k: self.state(k) for k in keys}
+
+    def prune(self, active_keys) -> None:
+        """Drop state for workers that no longer exist (registry churn):
+        without this, a replaced worker's open breaker pins its degraded
+        gauge forever and _entries grows with every churned address."""
+        active = set(active_keys)
+        with self._lock:
+            stale = [k for k in self._entries if k not in active]
+            removed = [(k, self._entries.pop(k)) for k in stale]
+        for key, entry in removed:
+            if entry[1] is not None:  # was open/half-open: clear the alert
+                logger.info("circuit breaker for %s pruned (worker gone)",
+                            key)
+                BREAKER_OPEN.set(0.0, worker=key)
+
+    # --- the dialing contract ---
+
+    def allow(self, key: str) -> float | None:
+        """None = proceed (and in half-open, this call claims the single
+        probe slot); a float = open, retry after that many seconds."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry[1] is None:
+                return None
+            elapsed = time.monotonic() - entry[1]
+            if elapsed < self.reset_s:
+                return self.reset_s - elapsed
+            if entry[2]:  # half-open, probe already in flight
+                return 1.0
+            entry[2] = True
+            return None
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            # Steady state (no entry): nothing to clear, and no gauge
+            # write — healthy workers must not pay a metric mutation per
+            # RPC or grow a labeled series each.
+            if key not in self._entries:
+                return
+            entry = self._entries.pop(key)
+            was_open = entry[1] is not None
+        if was_open:
+            logger.info("circuit breaker for %s closed (probe ok)", key)
+            BREAKER_OPEN.set(0.0, worker=key)
+
+    def record_failure(self, key: str) -> None:
+        tripped = False
+        with self._lock:
+            entry = self._entries.setdefault(key, [0, None, False])
+            entry[0] += 1
+            if entry[1] is not None:
+                # open/half-open: failure (the probe, or a racer) re-opens
+                # and restarts the reset clock.
+                entry[1] = time.monotonic()
+                entry[2] = False
+            elif entry[0] >= self.failure_threshold:
+                entry[1] = time.monotonic()
+                entry[2] = False
+                tripped = True
+        if tripped:
+            logger.error(
+                "circuit breaker for %s OPEN after %d consecutive "
+                "failures; degrading for %.0fs", key,
+                self.failure_threshold, self.reset_s)
+            BREAKER_TRIPS.inc(worker=key)
+            BREAKER_OPEN.set(1.0, worker=key)

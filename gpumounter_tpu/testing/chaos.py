@@ -1,0 +1,472 @@
+"""Seeded crash-consistency chaos harness over the fake cluster.
+
+Runs the real control plane — MasterApp + slice coordinator + elastic
+reconciler + migration orchestrator over real loopback gRPC workers on a
+multi-node FakeCluster — under randomized-but-reproducible failpoint
+schedules (gpumounter_tpu/faults), then asserts the global safety
+invariants after convergence:
+
+  1. no chip held by two pods (no double-mounted /dev/accel* node),
+  2. no ownerless grant (every injected node is backed by a scheduler
+     booking — a node without one is a leaked mount),
+  3. accounting parity (every booked chip is actually mounted: slave-pod
+     books match injected nodes),
+  4. every migration journal is terminal: outcome succeeded / rolled-back
+     / aborted with phase=done — never stranded, never half-rolled-back.
+
+Determinism: all randomness flows from one seed (`random.Random(seed)`);
+the executed schedule is logged step by step and embedded in the
+InvariantViolation message so a failing run reproduces from its seed.
+Fault schedules are count-limited one-shots armed immediately before
+each operation and cleared right after it, so no fault leaks into the
+convergence phase — convergence is exactly what a healed production
+cluster would do (reconciler passes + resume_interrupted re-drives).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+logger = get_logger("testing.chaos")
+
+NODE_A, NODE_B = "chaos-a", "chaos-b"
+
+
+class InvariantViolation(AssertionError):
+    """A global safety invariant failed to hold after convergence."""
+
+
+#: (failpoint name, action) pools the scenarios draw from. Everything is
+#: count-limited so an armed-but-unfired fault cannot outlive its op
+#: (the harness also disarms after every op as a belt-and-braces).
+FAULTS_COMMON = [
+    ("rpc.client.call", "1*unavailable(chaos drop)"),
+    ("rpc.client.call", "1*delay(0.05)"),
+    ("worker.rpc", "1*delay(0.05)"),
+    ("worker.mount.mknod", "1*error(chaos mknod)"),
+    ("worker.mount.mknod", "1*pass->1*error(chaos mknod 2nd)"),
+    ("worker.mount.before_grant", "1*crash(chaos)"),
+    ("worker.mount.after_grant", "1*crash(chaos)"),
+    ("k8s.patch_pod.status", "1*return(409)"),
+    ("k8s.patch_pod.status", "1*return(500)"),
+]
+FAULTS_ELASTIC = FAULTS_COMMON + [
+    ("elastic.reconcile", "1*crash(chaos)"),
+    ("elastic.before_grow", "1*crash(chaos)"),
+]
+FAULTS_MIGRATE = FAULTS_COMMON + [
+    ("migrate.phase.quiesce", "1*crash(chaos)"),
+    ("migrate.phase.drain", "1*crash(chaos)"),
+    ("migrate.phase.remount", "1*crash(chaos)"),
+    ("migrate.phase.resume", "1*crash(chaos)"),
+    ("migrate.phase.verify", "1*crash(chaos)"),
+    ("migrate.persist", "1*error(chaos persist)"),
+]
+
+
+class ChaosHarness:
+    """One fake two-node cluster + live control plane per scenario run."""
+
+    def __init__(self, root: str, seed: int,
+                 nodes: dict[str, int] | None = None):
+        self.root = root
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.schedule: list[str] = []
+        self.cluster = FakeCluster(
+            root, nodes=nodes or {NODE_A: 6, NODE_B: 6})
+        self.cfg = self.cluster.cfg.replace(
+            migrate_quiesce_timeout_s=0.3,
+            migrate_resume_timeout_s=0.3,
+            migrate_poll_interval_s=0.02,
+            elastic_resync_interval_s=30.0,
+            elastic_backoff_base_s=0.05,
+            elastic_backoff_cap_s=0.2,
+            elastic_min_reconcile_interval_s=0.0,
+            rpc_probe_timeout_s=5.0,
+            rpc_quiesce_timeout_s=5.0,
+            rpc_retry_base_s=0.02,
+            rpc_retry_cap_s=0.1,
+            k8s_write_retry_base_s=0.02,
+            # High threshold: chaos injects isolated transport faults by
+            # design; the breaker's own behavior has dedicated tests.
+            breaker_failure_threshold=50)
+        self.services: dict[str, TpuMountService] = {}
+        self._servers = []
+        self._port_by_ip: dict[str, int] = {}
+        #: (namespace, pod) -> node, for every target pod we created
+        self.pods: dict[tuple[str, str], str] = {}
+        self.app: MasterApp | None = None
+
+    # --- lifecycle ---
+
+    def start(self) -> "ChaosHarness":
+        self.cluster.start()
+        for i, name in enumerate(self.cluster.node_names):
+            node_cfg = self.cluster.node_cfg(name, self.cfg)
+            node = self.cluster.node(name)
+            collector = TpuCollector(
+                backend=node.backend,
+                podresources=PodResourcesClient(node.kubelet_socket,
+                                                timeout_s=5.0),
+                cfg=node_cfg)
+            mounter = TpuMounter(node.backend, cfg=node_cfg,
+                                 kube=self.cluster.kube)
+            dev_base = os.path.join(self.root, f"container-dev-{name}")
+            os.makedirs(dev_base, exist_ok=True)
+
+            def _resolver(pod, _base=dev_base):
+                d = os.path.join(_base, f"{pod.namespace}-{pod.name}")
+                os.makedirs(d, exist_ok=True)
+                return MountTarget(
+                    dev_dir=d, description=f"{pod.namespace}/{pod.name}",
+                    pod=pod)
+
+            mounter.resolve_target = _resolver
+            service = TpuMountService(self.cluster.kube,
+                                      collector=collector,
+                                      mounter=mounter, cfg=node_cfg)
+            server = build_server(service, address="localhost:0")
+            server.start()
+            self._servers.append(server)
+            ip = f"10.9.0.{i + 1}"
+            self._port_by_ip[ip] = server.bound_port
+            self.services[name] = service
+            self.cluster.kube.create_pod(self.cfg.worker_namespace, {
+                "metadata": {"name": f"chaos-worker-{name}",
+                             "namespace": self.cfg.worker_namespace,
+                             "labels": {"app": "tpu-mounter-worker"}},
+                "spec": {"nodeName": name, "containers": [{"name": "w"}]},
+                "status": {"phase": "Running", "podIP": ip},
+            })
+
+        def client_factory(address: str):
+            ip = address.rsplit(":", 1)[0]
+            return WorkerClient(f"localhost:{self._port_by_ip[ip]}",
+                                cfg=self.cfg)
+
+        self.app = MasterApp(self.cluster.kube, cfg=self.cfg,
+                             worker_client_factory=client_factory,
+                             registry=WorkerRegistry(self.cluster.kube,
+                                                     self.cfg))
+        return self
+
+    def stop(self) -> None:
+        failpoints.disarm_all()
+        if self.app is not None:
+            self.app.elastic.stop()
+            self.app.migrations.stop()
+            self.app.registry.stop()
+        for server in self._servers:
+            server.stop(grace=None)
+        self.cluster.stop()
+
+    def __enter__(self) -> "ChaosHarness":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- plumbing ---
+
+    def record(self, event: str) -> None:
+        self.schedule.append(event)
+        logger.info("chaos[seed=%d] %s", self.seed, event)
+
+    def add_pod(self, name: str, node: str, namespace: str = "default",
+                ) -> Pod:
+        pod = self.cluster.add_target_pod(name, namespace=namespace,
+                                          node=node)
+        self.pods[(namespace, name)] = node
+        return pod
+
+    def _coordinator(self):
+        from gpumounter_tpu.master.slice_ops import SliceCoordinator
+        return SliceCoordinator(self.cluster.kube, self.app.registry,
+                                self.app._client_factory, self.cfg)
+
+    def _client_for_node(self, node: str) -> WorkerClient:
+        address = self.app.registry.worker_address(node)
+        return self.app._client_factory(address)
+
+    def probe(self, namespace: str, pod: str):
+        node = self.pods[(namespace, pod)]
+        with self._client_for_node(node) as client:
+            _, chips = client.probe_tpu(pod, namespace)
+        return chips
+
+    def _arm_random(self, pool) -> None:
+        name, action = self.rng.choice(pool)
+        self.record(f"arm {name}={action}")
+        failpoints.arm(name, action)
+
+    def _op(self, pool, description: str, fn, fault_p: float = 0.7) -> None:
+        """Run one chaos operation: maybe arm a fault, execute, log the
+        outcome, clear any unfired one-shots."""
+        if self.rng.random() < fault_p:
+            self._arm_random(pool)
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 — failures ARE the test
+            self.record(f"{description} -> {type(exc).__name__}: {exc}")
+        else:
+            self.record(f"{description} -> ok")
+        finally:
+            failpoints.disarm_all()
+
+    # --- scenarios ---
+
+    def run_mount_scenario(self, n_ops: int = 10) -> None:
+        """Imperative add/remove traffic with declared intents as the
+        repair substrate: whatever the faults leave behind, converging to
+        the intent must restore the safety invariants."""
+        from gpumounter_tpu.elastic.intents import Intent
+        # Two pods share NODE_A so the double-hold invariant has teeth.
+        pods = [("default", "m-a", NODE_A), ("default", "m-b", NODE_B),
+                ("default", "m-c", NODE_A)]
+        for ns, name, node in pods:
+            self.add_pod(name, node, namespace=ns)
+            desired = self.rng.randint(1, 2)
+            self.app.elastic.store.put(ns, name, Intent(
+                desired_chips=desired, min_chips=1))
+            self.record(f"intent {ns}/{name} desired={desired}")
+        from gpumounter_tpu.master.slice_ops import SliceTarget
+        for _ in range(n_ops):
+            ns, name, node = self.rng.choice(pods)
+            kind = self.rng.choice(["add", "remove", "reconcile"])
+            if kind == "add":
+                n = self.rng.randint(1, 2)
+                self._op(FAULTS_COMMON, f"add {n} to {name}",
+                         lambda t=SliceTarget(namespace=ns, pod=name), n=n:
+                         self._coordinator().mount_slice([t], n,
+                                                         entire=False))
+            elif kind == "remove":
+                held = [c.uuid for c in self.probe(ns, name)]
+                if not held:
+                    continue
+                uuid = self.rng.choice(held)
+
+                def _remove(ns=ns, name=name, node=node, uuid=uuid):
+                    with self._client_for_node(node) as client:
+                        client.remove_tpu(name, ns, [uuid], force=True)
+
+                self._op(FAULTS_COMMON, f"remove {uuid} from {name}",
+                         _remove)
+            else:
+                self._op(FAULTS_ELASTIC, f"reconcile {name}",
+                         lambda ns=ns, name=name:
+                         self.app.elastic.reconcile_once(ns, name))
+        self.converge()
+
+    def run_elastic_scenario(self, n_ops: int = 10) -> None:
+        """Declarative convergence under chip deaths and induced faults."""
+        from gpumounter_tpu.elastic.intents import Intent
+        pods = [("default", "e-a", NODE_A), ("default", "e-b", NODE_B)]
+        for ns, name, node in pods:
+            self.add_pod(name, node, namespace=ns)
+            self.app.elastic.store.put(ns, name, Intent(
+                desired_chips=2, min_chips=1))
+        kills = 0
+        for _ in range(n_ops):
+            ns, name, node = self.rng.choice(pods)
+            roll = self.rng.random()
+            if roll < 0.2 and kills < 2:
+                # Kill a chip the pod currently holds (if any): the heal
+                # path must converge through it.
+                held = self.probe(ns, name)
+                if held:
+                    victim = self.rng.choice(held)
+                    index = next(
+                        (str(d.index) for d in
+                         self.cluster.node(node).backend.list_devices()
+                         if d.uuid == victim.uuid), None)
+                    if index is not None:
+                        self.record(f"kill chip {victim.uuid} on {node}")
+                        self.cluster.kill_chip(index, node)
+                        kills += 1
+                        continue
+            amount = self.rng.choice([1, 2, 3])
+            if roll < 0.35:
+                self.record(f"intent {name} desired={amount}")
+                self.app.elastic.store.put(ns, name, Intent(
+                    desired_chips=amount, min_chips=1))
+            self._op(FAULTS_ELASTIC, f"reconcile {name}",
+                     lambda ns=ns, name=name:
+                     self.app.elastic.reconcile_once(ns, name))
+        self.converge()
+
+    def run_migrate_scenario(self, n_migrations: int = 2) -> None:
+        """Live migrations with crashes at journal-phase boundaries; every
+        journal must reach a terminal state via resume_interrupted."""
+        from gpumounter_tpu.master.slice_ops import SliceTarget
+        self.add_pod("src", NODE_A)
+        self.add_pod("dst", NODE_B)
+        self._coordinator().mount_slice(
+            [SliceTarget(namespace="default", pod="src")], 2, entire=False)
+        self.record("mounted 2 chips on default/src")
+        source, dest = ("default", "src"), ("default", "dst")
+        for _ in range(n_migrations):
+            if self.rng.random() < 0.8:
+                self._arm_random(FAULTS_MIGRATE)
+            try:
+                journal = self.app.migrations.begin(
+                    source[0], source[1], dest[0], dest[1])
+            except Exception as exc:  # noqa: BLE001 — rejection is fine
+                self.record(f"migrate begin -> {type(exc).__name__}: {exc}")
+                failpoints.disarm_all()
+                continue
+            mid = journal["id"]
+            self.record(f"migrate {mid}: {source[1]} -> {dest[1]}")
+            self._drive_to_terminal(mid)
+            failpoints.disarm_all()
+            final = self.app.migrations.get(mid) or {}
+            self.record(f"migrate {mid} -> {final.get('outcome')}")
+            if final.get("outcome") == "succeeded":
+                source, dest = dest, source  # ping-pong back
+        self.converge()
+
+    def _drive_to_terminal(self, mid: str, timeout_s: float = 30.0) -> None:
+        """Wait out the machine; re-adopt after simulated master crashes
+        (failpoints cleared first — the 'restarted master' is clean)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            journal = self.app.migrations.wait(mid, timeout_s=5.0)
+            if journal is not None and journal.get("outcome"):
+                return
+            failpoints.disarm_all()
+            adopted = self.app.migrations.resume_interrupted()
+            if adopted:
+                self.record(f"resumed interrupted: {adopted}")
+
+    # --- convergence + invariants ---
+
+    def converge(self, timeout_s: float = 30.0) -> None:
+        """Disarm everything, finish interrupted migrations, and drive
+        every declared intent to a converged outcome."""
+        failpoints.disarm_all()
+        deadline = time.monotonic() + timeout_s
+        # 1. migrations must all be terminal
+        while time.monotonic() < deadline:
+            pending = [j for j in self.app.migrations.list_migrations()
+                       if not j.get("outcome")]
+            if not pending:
+                break
+            self.app.migrations.resume_interrupted()
+            for j in pending:
+                self.app.migrations.wait(j["id"], timeout_s=5.0)
+        # 2. every intent reconciles clean
+        try:
+            intents = self.app.elastic.store.list()
+        except Exception:  # noqa: BLE001
+            intents = []
+        for namespace, pod_name, _intent in intents:
+            while time.monotonic() < deadline:
+                try:
+                    outcome = self.app.elastic.reconcile_once(namespace,
+                                                              pod_name)
+                except Exception as exc:  # noqa: BLE001 — keep driving
+                    self.record(f"converge {pod_name}: retrying ({exc})")
+                    time.sleep(0.05)
+                    continue
+                if outcome.get("phase") in ("converged", "unmanaged",
+                                            "gone", "invalid"):
+                    break
+                time.sleep(0.05)
+
+    def held_chips(self) -> dict[tuple[str, str], set[str]]:
+        """(namespace, pod) -> uuids whose device node is present in the
+        pod's container /dev — what the tenant can actually touch."""
+        held: dict[tuple[str, str], set[str]] = {}
+        for (namespace, name), node in self.pods.items():
+            dev_dir = os.path.join(self.root, f"container-dev-{node}",
+                                   f"{namespace}-{name}")
+            chips = set()
+            for dev in self.cluster.node(node).backend.list_devices():
+                if os.path.exists(os.path.join(dev_dir, dev.rel_path)):
+                    chips.add(dev.uuid)
+            held[(namespace, name)] = chips
+        return held
+
+    def booked_chips(self) -> dict[tuple[str, str], set[str]]:
+        """(namespace, pod) -> uuids the scheduler's books say the pod
+        owns (device-plugin claims, slave pods included)."""
+        booked: dict[tuple[str, str], set[str]] = {}
+        for (namespace, name), node in self.pods.items():
+            service = self.services[node]
+            try:
+                pod = Pod(self.cluster.kube.get_pod(namespace, name))
+            except NotFoundError:
+                booked[(namespace, name)] = set()
+                continue
+            service.collector.update_status()
+            slaves = {s.name for s in
+                      service.allocator.slave_pods_for(pod)}
+            devices = service.collector.get_pod_devices(
+                name, namespace, slave_pod_names=slaves, refresh=False)
+            booked[(namespace, name)] = {d.uuid for d in devices}
+        return booked
+
+    def check_invariants(self) -> None:
+        violations: list[str] = []
+        held = self.held_chips()
+        booked = self.booked_chips()
+
+        # 1. no chip held by two pods. Chip identity is (node, uuid): the
+        # fake backend reuses uuids across nodes, exactly like two hosts
+        # each having their own /dev/accel0.
+        owners: dict[tuple[str, str], list[tuple[str, str]]] = {}
+        for key, chips in held.items():
+            node = self.pods[key]
+            for uuid in chips:
+                owners.setdefault((node, uuid), []).append(key)
+        for (node, uuid), holders in owners.items():
+            if len(holders) > 1:
+                violations.append(
+                    f"double-hold: chip {uuid} on {node} mounted in "
+                    f"{[f'{ns}/{p}' for ns, p in holders]}")
+
+        for key in self.pods:
+            namespace, name = key
+            # 2. no ownerless grant
+            leaked = held[key] - booked[key]
+            if leaked:
+                violations.append(
+                    f"ownerless grant: {namespace}/{name} has injected "
+                    f"node(s) {sorted(leaked)} with no scheduler booking")
+            # 3. accounting parity
+            phantom = booked[key] - held[key]
+            if phantom:
+                violations.append(
+                    f"accounting mismatch: {namespace}/{name} books "
+                    f"{sorted(phantom)} but the node(s) are not mounted")
+
+        # 4. every migration journal terminal
+        for journal in self.app.migrations.list_migrations():
+            outcome = journal.get("outcome")
+            if outcome not in ("succeeded", "rolled-back", "aborted") or \
+                    journal.get("phase") != "done":
+                violations.append(
+                    f"journal {journal.get('id')} not terminal/clean: "
+                    f"phase={journal.get('phase')} outcome={outcome} "
+                    f"error={journal.get('error')}")
+
+        if violations:
+            tail = "\n  ".join(self.schedule[-25:])
+            raise InvariantViolation(
+                f"chaos invariants violated (seed={self.seed}):\n- "
+                + "\n- ".join(violations)
+                + f"\nschedule tail:\n  {tail}")

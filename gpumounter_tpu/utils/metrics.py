@@ -161,3 +161,7 @@ MOUNT_LATENCY = REGISTRY.histogram(
     "tpumounter_mount_latency_seconds", "End-to-end hot-mount latency")
 PHASE_LATENCY = REGISTRY.histogram(
     "tpumounter_phase_latency_seconds", "Per-phase latency (phase label)")
+MOUNT_ROLLBACK_FAILURES = REGISTRY.counter(
+    "tpumounter_mount_rollback_failures_total",
+    "Failed grant undos during mount rollback — each one is a leaked "
+    "cgroup grant needing operator attention")

@@ -36,10 +36,18 @@ from gpumounter_tpu.cgroup.ebpf import DEFAULT_CONTAINER_RULES, DeviceRule
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.backend import DeviceBackend, scan_proc_for_device
 from gpumounter_tpu.device.tpu import TpuDevice
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.faults.failpoints import CrashError
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.nsutil import ns as nsutil
 from gpumounter_tpu.utils.log import get_logger
-from gpumounter_tpu.utils.metrics import MOUNT_LATENCY, MOUNT_TOTAL, PHASE_LATENCY, UNMOUNT_TOTAL
+from gpumounter_tpu.utils.metrics import (
+    MOUNT_LATENCY,
+    MOUNT_ROLLBACK_FAILURES,
+    MOUNT_TOTAL,
+    PHASE_LATENCY,
+    UNMOUNT_TOTAL,
+)
 from gpumounter_tpu.utils.timing import PhaseTimer
 
 logger = get_logger("mounter")
@@ -92,6 +100,7 @@ class MountTarget:
     cgroup_dirs: list[str] = field(default_factory=list)
     ns_pid: int | None = None        # PID whose namespaces we enter; None = ours
     description: str = "local"
+    pod: Pod | None = None           # event target when resolved from a pod
 
     @property
     def has_cgroup(self) -> bool:
@@ -99,8 +108,12 @@ class MountTarget:
 
 
 class TpuMounter:
-    def __init__(self, backend: DeviceBackend, cfg=None):
+    def __init__(self, backend: DeviceBackend, cfg=None, kube=None):
+        """kube: optional KubeClient — when given, a failed grant
+        rollback is surfaced as a Warning Event on the target pod
+        (leaked grants must be operator-visible, not log-only)."""
         self.cfg = cfg or get_config()
+        self.kube = kube
         self.backend = backend
         version = self.cfg.cgroup_version
         self.cgroup_version = (detect_cgroup_version(self.cfg.cgroup_root)
@@ -135,7 +148,8 @@ class TpuMounter:
                 f"(looked in {cgroup_dirs})")
         return MountTarget(dev_dir="/dev", cgroup_dirs=cgroup_dirs,
                            ns_pid=ns_pid,
-                           description=f"{pod.namespace}/{pod.name}")
+                           description=f"{pod.namespace}/{pod.name}",
+                           pod=pod)
 
     # --- busy detection (reference: GetPodGPUProcesses, util.go:152-196) ---
 
@@ -210,6 +224,13 @@ class TpuMounter:
         timer = PhaseTimer()
         granted: list[str] = []
         try:
+            # Crash sites bracketing the grant: a worker dying here leaves
+            # either nothing (before) or a grant with no injected node
+            # (after) — the states the chaos harness drives convergence
+            # through (the prober reports the half-mounted chip unhealthy
+            # and the reconciler heals it).
+            failpoints.fire("worker.mount.before_grant", device=dev.uuid,
+                            target=target.description)
             with timer.phase("cgroup_grant"):
                 if target.cgroup_dirs and self.cgroup_version == 2:
                     # The controller captures base rules only at FIRST
@@ -226,19 +247,30 @@ class TpuMounter:
                     else:
                         self.controller.grant(cg, dev)
                     granted.append(cg)
+            failpoints.fire("worker.mount.after_grant", device=dev.uuid,
+                            target=target.description)
             with timer.phase("device_inject"):
+                failpoints.fire("worker.mount.mknod", device=dev.uuid,
+                                target=target.description)
                 nsutil.inject_device_file(target.dev_dir, dev,
                                           pid=target.ns_pid)
+        except CrashError:
+            # Simulated process death: a real crash gets no undo pass —
+            # re-raise before the rollback below so the chaos harness
+            # exercises the leaked-grant recovery path for real.
+            MOUNT_TOTAL.inc(result="error")
+            raise
         except Exception as exc:
             # Undo partial grants: without this, a failed injection leaves
             # the container with kernel-level access to a chip the caller's
             # rollback is about to hand back to the scheduler.
             for cg in granted:
                 try:
+                    failpoints.fire("worker.mount.rollback", cgroup=cg,
+                                    device=dev.uuid)
                     self.controller.revoke(cg, dev)
                 except Exception as undo_exc:  # noqa: BLE001
-                    logger.error("grant rollback on %s failed: %s",
-                                 cg, undo_exc)
+                    self._rollback_failed(target, dev, cg, undo_exc)
             MOUNT_TOTAL.inc(result="error")
             if isinstance(exc, MountError):
                 raise
@@ -255,6 +287,23 @@ class TpuMounter:
         logger.info("mounted %s into %s (%s)", dev, target.description, summary)
         return summary
 
+    def _rollback_failed(self, target: MountTarget, dev: TpuDevice,
+                         cgroup: str, exc: Exception) -> None:
+        """A grant undo failed: the container keeps kernel access to a
+        chip the scheduler is about to re-book. Log-only was how these
+        leaked silently — now the counter trips alerting and a Warning
+        Event lands where operators look (`kubectl describe pod`)."""
+        logger.error("grant rollback on %s failed: %s", cgroup, exc)
+        MOUNT_ROLLBACK_FAILURES.inc()
+        if self.kube is not None and target.pod is not None:
+            from gpumounter_tpu.k8s.events import post_pod_event
+            post_pod_event(
+                self.kube, target.pod, "TPUMountRollbackFailed",
+                f"could not revoke {dev.uuid} from cgroup {cgroup} after a "
+                f"failed mount ({exc}); the container retains kernel "
+                f"access to the chip — revoke manually or restart the pod",
+                event_type="Warning", component="tpumounter-worker")
+
     # --- unmount (reference: UnmountGPU, util.go:73-150) ---
 
     def unmount(self, target: MountTarget, dev: TpuDevice,
@@ -269,6 +318,8 @@ class TpuMounter:
                 f"{target.description}; use force (libtpu holds chips for "
                 "the life of the process)")
         try:
+            failpoints.fire("worker.unmount.before_revoke", device=dev.uuid,
+                            target=target.description)
             with timer.phase("cgroup_revoke"):
                 for cg in target.cgroup_dirs:
                     self.controller.revoke(cg, dev)
@@ -281,6 +332,9 @@ class TpuMounter:
                     nsutil.kill_pids_in_ns(holders, pid=target.ns_pid)
         except TpuBusyError:
             raise
+        except CrashError:
+            UNMOUNT_TOTAL.inc(result="error")
+            raise  # simulated process death: no wrapping, no cleanup
         except MountError:
             UNMOUNT_TOTAL.inc(result="error")
             raise

@@ -18,6 +18,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from concurrent import futures
 
 from gpumounter_tpu.allocator.allocator import (
@@ -29,6 +30,7 @@ from gpumounter_tpu.allocator.allocator import (
 from gpumounter_tpu.collector.collector import TpuCollector
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.backend import backend_from_config
+from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.rpc import api
@@ -73,8 +75,58 @@ class _KeyedLocks:
                     self._entries[key] = (lock, refs - 1)
 
 
+class _IdempotencyCache:
+    """Recently-completed mutation keys → their responses.
+
+    A master whose AddTPU attempt died at the transport layer cannot know
+    whether the mount landed; its bounded retry re-sends the same
+    idempotency key, and a key that already completed is answered from
+    this record — the retried mount is a no-op on the worker. Bounded
+    (LRU by insertion) and TTL'd so an abandoned key cannot pin a
+    response forever."""
+
+    def __init__(self, capacity: int = 1024, ttl_s: float = 600.0):
+        self.capacity = capacity
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[float, object]] = {}
+
+    def get(self, key: str):
+        if not key:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            stamp, response = entry
+            if now - stamp > self.ttl_s:
+                del self._entries[key]
+                return None
+            return response
+
+    def put(self, key: str, response) -> None:
+        if not key:
+            return
+        with self._lock:
+            while len(self._entries) >= self.capacity:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[key] = (time.monotonic(), response)
+
+
 class TpuMountService:
-    """The business logic shared by both wire service registrations."""
+    """The business logic shared by both wire service registrations.
+
+    Failpoint sites (gpumounter_tpu/faults):
+      worker.rpc                     every service-method entry (ctx:
+                                     method) — slow replies, crashes
+                                     mid-RPC (the client sees the
+                                     connection die with no answer)
+      worker.addtpu.rollback.skip    return(true) disables the mount-
+                                     failure rollback's unmount loop —
+                                     the deliberate invariant breaker the
+                                     chaos harness proves it can detect
+    """
 
     def __init__(self, kube: KubeClient, collector: TpuCollector | None = None,
                  allocator: TpuAllocator | None = None,
@@ -85,30 +137,55 @@ class TpuMountService:
         self.allocator = allocator or TpuAllocator(kube, self.collector,
                                                    cfg=self.cfg)
         self.mounter = mounter or TpuMounter(self.collector.backend,
-                                             cfg=self.cfg)
+                                             cfg=self.cfg, kube=kube)
         # Per-pod (UID-keyed) serialization of the CanMount-gate →
         # allocate → mount / remove critical sections. Without it two
         # concurrent AddTPU(entire) calls can both observe MountType.NONE
         # and both mount (TOCTOU the reference shares, server.go:57).
         self._pod_locks = _KeyedLocks()
+        self._idem = _IdempotencyCache()
 
     # --- AddTPU (reference: server.go:34-99) ---
 
     def add_tpu(self, request: api.AddTPURequest,
                 context: grpc.ServicerContext) -> api.AddTPUResponse:
         timer = PhaseTimer()
+        failpoints.fire("worker.rpc", method="AddTPU",
+                        pod=request.pod_name)
         logger.info("AddTPU %s/%s num=%d entire=%s", request.namespace,
                     request.pod_name, request.tpu_num, request.is_entire_mount)
         if request.tpu_num <= 0:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"invalid tpu_num {request.tpu_num}")
+        # Replay check BEFORE the pod fetch: a retried mutation whose
+        # first attempt completed must get its recorded answer even if
+        # the pod has since been deleted (completion records are
+        # immutable, so no lock is needed here).
+        cached = self._idem.get(f"add:{request.idempotency_key}"
+                                if request.idempotency_key else "")
+        if cached is not None:
+            return cached
         try:
             pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
         except NotFoundError:
             return api.AddTPUResponse(
                 add_tpu_result=api.AddTPUResult.PodNotFound)
+        key = (f"add:{request.idempotency_key}"
+               if request.idempotency_key else "")
         with self._pod_locks.held(pod.uid):
-            return self._add_tpu_locked(request, context, pod, timer)
+            # Re-check under the pod lock so a retry racing its original
+            # waits for the first execution, then reads its answer.
+            cached = self._idem.get(key)
+            if cached is not None:
+                logger.info("AddTPU %s/%s replay (idempotency key %s): "
+                            "answering from completion record",
+                            request.namespace, request.pod_name,
+                            request.idempotency_key)
+                return cached
+            response = self._add_tpu_locked(request, context, pod, timer)
+            if response.add_tpu_result == api.AddTPUResult.Success:
+                self._idem.put(key, response)
+            return response
 
     def _add_tpu_locked(self, request: api.AddTPURequest,
                         context: grpc.ServicerContext, pod: Pod,
@@ -150,12 +227,19 @@ class TpuMountService:
             # books (reference only does the latter, server.go:86-91).
             logger.error("mount failed, rolling back %d mount(s) + slaves: %s",
                          len(mounted), exc)
-            for dev in mounted:
-                try:
-                    self.mounter.unmount(target, dev, force=True)
-                except MountError as undo_exc:
-                    logger.error("rollback unmount of %s failed: %s",
-                                 dev.uuid, undo_exc)
+            if failpoints.value("worker.addtpu.rollback.skip", False):
+                # Deliberate invariant breaker for the chaos harness: the
+                # books are freed below but the injected nodes stay — the
+                # exact leak the invariant checker must catch.
+                logger.error("rollback unmounts SKIPPED by failpoint; "
+                             "%d injected node(s) leaked", len(mounted))
+            else:
+                for dev in mounted:
+                    try:
+                        self.mounter.unmount(target, dev, force=True)
+                    except MountError as undo_exc:
+                        logger.error("rollback unmount of %s failed: %s",
+                                     dev.uuid, undo_exc)
             self.allocator.delete_slave_pods(slaves, wait=False)
             self._post_event(pod, "TPUMountFailed", str(exc), "Warning")
             context.abort(grpc.StatusCode.INTERNAL, str(exc))
@@ -198,6 +282,8 @@ class TpuMountService:
         still present in the target's /dev, and re-run the /proc holder
         scan. Read-only — healing decisions belong to the master-side
         reconciler, which owns the scheduler's books."""
+        failpoints.fire("worker.rpc", method="ProbeTPU",
+                        pod=request.pod_name)
         try:
             pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
         except NotFoundError:
@@ -234,6 +320,8 @@ class TpuMountService:
         the chips. Read-only, like probe_tpu."""
         import json as jsonlib
 
+        failpoints.fire("worker.rpc", method="QuiesceStatus",
+                        pod=request.pod_name)
         try:
             pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
         except NotFoundError:
@@ -265,15 +353,34 @@ class TpuMountService:
 
     def remove_tpu(self, request: api.RemoveTPURequest,
                    context: grpc.ServicerContext) -> api.RemoveTPUResponse:
+        failpoints.fire("worker.rpc", method="RemoveTPU",
+                        pod=request.pod_name)
         logger.info("RemoveTPU %s/%s uuids=%s force=%s", request.namespace,
                     request.pod_name, request.uuids, request.force)
+        # "rm:"-namespaced: a key reused across AddTPU/RemoveTPU must
+        # never replay a wrong-typed response.
+        key = (f"rm:{request.idempotency_key}"
+               if request.idempotency_key else "")
+        cached = self._idem.get(key)
+        if cached is not None:  # completed before the pod (maybe) vanished
+            return cached
         try:
             pod = Pod(self.kube.get_pod(request.namespace, request.pod_name))
         except NotFoundError:
             return api.RemoveTPUResponse(
                 remove_tpu_result=api.RemoveTPUResult.PodNotFound)
         with self._pod_locks.held(pod.uid):
-            return self._remove_tpu_locked(request, context, pod)
+            cached = self._idem.get(key)
+            if cached is not None:
+                logger.info("RemoveTPU %s/%s replay (idempotency key %s): "
+                            "answering from completion record",
+                            request.namespace, request.pod_name,
+                            request.idempotency_key)
+                return cached
+            response = self._remove_tpu_locked(request, context, pod)
+            if response.remove_tpu_result == api.RemoveTPUResult.Success:
+                self._idem.put(key, response)
+            return response
 
     def _remove_tpu_locked(self, request: api.RemoveTPURequest,
                            context: grpc.ServicerContext,

@@ -259,6 +259,6 @@ def test_master_fail_closed_without_token(cluster):
     with pytest.raises(AuthConfigError):
         MasterApp(cluster.kube, cfg=bare)
     app = MasterApp(cluster.kube, cfg=bare.replace(auth_mode="insecure"))
-    status, _ctype, _body = app.handle("GET", "/healthz", b"", {})
+    status, _ctype, _body, _headers = app.handle("GET", "/healthz", b"", {})
     assert status == 200
     app.registry.stop()

@@ -177,7 +177,7 @@ def app(kube):
 
 def _call(app, method, path, body=b"", auth=True):
     headers = dict(AUTH_HEADER) if auth else {}
-    return app.handle(method, path, body, headers)
+    return app.handle(method, path, body, headers)[:3]
 
 
 def test_intent_routes_crud(app, kube):

@@ -1,0 +1,141 @@
+"""Failpoint registry unit tests (gpumounter_tpu/faults).
+
+The chaos harness and the RPC resilience tests both stand on this
+module, so its semantics — count-limited terms, sequencing, value
+overrides, restore-on-exit — are pinned here first.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpumounter_tpu.faults import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def test_disabled_registry_is_inert():
+    failpoints.fire("never.armed", anything="goes")
+    assert failpoints.value("never.armed", 41) == 41
+    assert failpoints.active() == {}
+
+
+def test_error_action_and_hit_count():
+    failpoints.arm("site.a", "error(boom)")
+    with pytest.raises(failpoints.FailpointError, match="boom"):
+        failpoints.fire("site.a")
+    with pytest.raises(failpoints.FailpointError):
+        failpoints.fire("site.a")  # unlimited: keeps firing
+    assert failpoints.hits("site.a") == 2
+
+
+def test_count_limited_action_disarms_itself():
+    failpoints.arm("site.b", "2*error(x)")
+    for _ in range(2):
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fire("site.b")
+    failpoints.fire("site.b")  # spent: no-op
+    assert not failpoints.is_armed("site.b")
+
+
+def test_sequenced_terms_pass_then_fail():
+    failpoints.arm("site.seq", "1*pass->1*error(second)")
+    failpoints.fire("site.seq")  # first activation passes through
+    with pytest.raises(failpoints.FailpointError, match="second"):
+        failpoints.fire("site.seq")
+    assert not failpoints.is_armed("site.seq")
+
+
+def test_crash_and_unavailable_types():
+    failpoints.arm("site.crash", "1*crash(dead)")
+    with pytest.raises(failpoints.CrashError):
+        failpoints.fire("site.crash")
+    failpoints.arm("site.drop", "1*unavailable(gone)")
+    with pytest.raises(failpoints.InjectedUnavailable):
+        failpoints.fire("site.drop")
+
+
+def test_delay_action_sleeps():
+    failpoints.arm("site.slow", "1*delay(0.05)")
+    start = time.monotonic()
+    failpoints.fire("site.slow")
+    assert time.monotonic() - start >= 0.05
+
+
+def test_value_override_and_json_parsing():
+    failpoints.arm("site.v", "return(409)")
+    assert failpoints.value("site.v", None) == 409
+    failpoints.arm("site.flag", "return(true)")
+    assert failpoints.value("site.flag", False) is True
+    failpoints.arm("site.str", "return(hello)")
+    assert failpoints.value("site.str", "") == "hello"
+
+
+def test_asterisk_inside_arg_is_not_a_count():
+    failpoints.arm("site.star", "1*error(reset by peer *)")
+    with pytest.raises(failpoints.FailpointError, match=r"reset by peer \*"):
+        failpoints.fire("site.star")
+    failpoints.arm("site.star2", "return(a*b)")
+    assert failpoints.value("site.star2", "") == "a*b"
+
+
+def test_value_site_accepts_error_actions():
+    failpoints.arm("site.v2", "1*error(kapow)")
+    with pytest.raises(failpoints.FailpointError, match="kapow"):
+        failpoints.value("site.v2", "default")
+    assert failpoints.value("site.v2", "default") == "default"
+
+
+def test_arm_spec_and_off():
+    failpoints.arm_spec("a=1*error(x); b=delay(0.0), c=return(1)")
+    assert set(failpoints.active()) == {"a", "b", "c"}
+    failpoints.arm("b", "off")
+    assert set(failpoints.active()) == {"a", "c"}
+
+
+def test_arm_spec_commas_inside_args_survive():
+    failpoints.arm_spec("j=return([409, 500]);k=error(a, b)")
+    assert failpoints.value("j", None) == [409, 500]
+    with pytest.raises(failpoints.FailpointError, match="a, b"):
+        failpoints.fire("k")
+
+
+def test_spec_errors():
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm("x", "zap(1)")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm("x", "0*error(y)")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm_spec("missing-equals-sign")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm("x", "delay(not-a-number)")
+    with pytest.raises(failpoints.FailpointSpecError):
+        # a non-final unlimited term would shadow the rest of the chain
+        failpoints.arm("x", "error(a)->1*error(b)")
+
+
+def test_armed_context_manager_restores_prior_state():
+    failpoints.arm("outer", "3*error(kept)")
+    with failpoints.armed({"inner": "1*error(tmp)", "outer": "1*pass"}):
+        failpoints.fire("outer")  # consumes the override's pass
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fire("inner")
+    assert not failpoints.is_armed("inner")
+    # the pre-existing point is back with its full count
+    for _ in range(3):
+        with pytest.raises(failpoints.FailpointError, match="kept"):
+            failpoints.fire("outer")
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv(failpoints.ENV_VAR, "env.site=1*error(from-env)")
+    failpoints._arm_from_env()
+    with pytest.raises(failpoints.FailpointError, match="from-env"):
+        failpoints.fire("env.site")
