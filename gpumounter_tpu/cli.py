@@ -14,6 +14,10 @@ Two families of verbs:
     add     --master URL --namespace NS --pod POD --num N [--entire]
     remove  --master URL --namespace NS --pod POD --uuids U,U [--force]
     migrate start|status|abort     live chip migration between pods
+    audit   [--pod POD] [--trace ID] [--op PREFIX]   the audit trail
+    trace ID                       all buffered spans for one trace
+                                   (accepts --read-token: the read-only
+                                   observability scope)
 
 The reference has no CLI at all (interaction is raw curl,
 docs/guide/QuickStart.md).
@@ -227,6 +231,36 @@ def cmd_intent_list(args) -> int:
     return 0 if status == 200 else 1
 
 
+def _obs_token(args) -> str | None:
+    """--read-token (the read-only observability scope) wins over the
+    mutate token resolution — scrape/debug boxes usually hold only it."""
+    read = getattr(args, "read_token", None)
+    if read:
+        return read
+    return _remote_token(args)
+
+
+def cmd_audit(args) -> int:
+    params = {k: v for k, v in (
+        ("namespace", args.namespace), ("pod", args.pod), ("op", args.op),
+        ("trace", args.trace), ("outcome", args.outcome),
+        ("limit", str(args.limit))) if v}
+    url = (f"{args.master.rstrip('/')}/audit?"
+           f"{urllib.parse.urlencode(params)}")
+    status, body = _http("GET", url, token=_obs_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def cmd_trace(args) -> int:
+    url = f"{args.master.rstrip('/')}/trace/{args.id}"
+    status, body = _http("GET", url, token=_obs_token(args))
+    print(body.rstrip())
+    if status == 404:
+        return 2  # unknown/expired trace id: rejected, not a failure
+    return 0 if status == 200 else 1
+
+
 EXIT_OK = 0
 EXIT_ERROR = 1
 EXIT_REJECTED = 2    # 4xx: bad request, nothing moved
@@ -414,6 +448,37 @@ def build_parser() -> argparse.ArgumentParser:
     _migrate_common(mab)
     mab.add_argument("--id", required=True)
     mab.set_defaults(fn=cmd_migrate_abort)
+
+    # Observability reads: what happened to a pod's chips, when, and
+    # why was it slow (docs/RUNBOOK.md "Debugging a slow mount").
+    def _obs_common(sp):
+        sp.add_argument("--master", required=True)
+        sp.add_argument("--token", default=None,
+                        help="master bearer token (default: "
+                             "TPUMOUNTER_AUTH_TOKEN[_FILE])")
+        sp.add_argument("--read-token", default=None,
+                        help="read-only observability token "
+                             "(TPUMOUNTER_AUTH_READ_TOKEN scope)")
+
+    au = sub.add_parser("audit", help="query the mutating-operation "
+                                      "audit trail")
+    _obs_common(au)
+    au.add_argument("--namespace", default=None)
+    au.add_argument("--pod", default=None)
+    au.add_argument("--op", default=None,
+                    help="operation prefix (http., worker., migrate...)")
+    au.add_argument("--trace", default=None, help="exact trace id")
+    au.add_argument("--outcome", default=None,
+                    help="outcome prefix (Success, error, http 4...)")
+    au.add_argument("--limit", type=int, default=100)
+    au.set_defaults(fn=cmd_audit)
+
+    tr = sub.add_parser("trace", help="dump all buffered spans for one "
+                                      "trace id")
+    _obs_common(tr)
+    tr.add_argument("id", help="trace id (X-Tpumounter-Trace response "
+                               "header / audit record trace_id)")
+    tr.set_defaults(fn=cmd_trace)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

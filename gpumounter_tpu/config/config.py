@@ -165,6 +165,28 @@ class Config:
     auth_mode: str = field(default_factory=lambda: _env("TPUMOUNTER_AUTH", "token"))
     auth_token: str = field(default_factory=lambda: _env("TPUMOUNTER_AUTH_TOKEN", ""))
     auth_token_file: str = field(default_factory=lambda: _env("TPUMOUNTER_AUTH_TOKEN_FILE", ""))
+    # Optional read-only scope for the observability routes (/metrics,
+    # /audit, /trace/<id>): scrapers and dashboards get a credential
+    # that cannot mutate. Unset = /metrics stays open (probe/scrape
+    # back-compat) and /audit + /trace require the mutate token.
+    auth_read_token: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_AUTH_READ_TOKEN", ""))
+    auth_read_token_file: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_AUTH_READ_TOKEN_FILE", ""))
+
+    # --- observability (gpumounter_tpu/obs) ---
+    # Append-only JSONL sinks for finished spans and audit records
+    # ("" = in-memory ring buffers only). The rings always run: last
+    # trace_ring_capacity spans / audit_capacity records are queryable
+    # via /trace/<id> and /audit with no config at all.
+    trace_jsonl: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_TRACE_JSONL", ""))
+    audit_jsonl: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_AUDIT_JSONL", ""))
+    trace_ring_capacity: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_TRACE_RING", "2048")))
+    audit_capacity: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_AUDIT_CAPACITY", "4096")))
 
     # --- logging ---
     log_dir: str = field(default_factory=lambda: _env("TPUMOUNTER_LOG_DIR", "/var/log/tpumounter"))

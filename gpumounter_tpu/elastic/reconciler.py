@@ -40,6 +40,8 @@ from gpumounter_tpu.k8s.client import (
     patch_pod_with_retry,
 )
 from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
@@ -191,6 +193,32 @@ class ElasticReconciler:
     # --- one convergence pass (public: tests drive it directly) ---
 
     def reconcile_once(self, namespace: str, pod_name: str) -> dict:
+        """One traced convergence pass. The loop has no inbound request,
+        so the span mints a fresh trace id per pass — worker-side spans
+        for the probes/removes/mounts it drives all join it (the heal
+        audit record carries the same id).
+
+        Deferred export: a converged steady-state resync (every
+        elastic_resync_interval_s, per pod, forever) would rotate real
+        operation traces out of the span ring — so a pass's spans are
+        buffered and published only when the pass changed something or
+        failed; no-op passes are dropped."""
+        with trace.deferred() as pending:
+            try:
+                with trace.span("elastic.reconcile",
+                                pod=f"{namespace}/{pod_name}"):
+                    outcome = self._reconcile_traced(namespace, pod_name)
+            except BaseException:
+                pending.publish()
+                raise
+            if outcome.get("phase") not in ("converged", "unmanaged",
+                                            "gone") \
+                    or outcome.get("healed") or outcome.get("added") \
+                    or outcome.get("removed_excess"):
+                pending.publish()
+        return outcome
+
+    def _reconcile_traced(self, namespace: str, pod_name: str) -> dict:
         key = f"{namespace}/{pod_name}"
         # Failpoint: a crash/error here models the reconciler dying at the
         # top of a pass — _process's boundary turns it into workqueue
@@ -258,12 +286,14 @@ class ElasticReconciler:
                             gap=desired - actual)
             degraded = not self._grow(address, pod, intent,
                                       desired - actual, actual)
-        elif actual > desired:
+        removed_excess: list[str] = []
+        if actual > desired:
             # Declarative scale-down: force is the designed path — libtpu
             # holds chips for the life of the JAX process, so a polite
             # remove would always report Busy (SURVEY.md §7).
             excess = [c.uuid for c in healthy[desired:]]
-            self._remove_chips(address, pod, excess, force=True)
+            removed_excess = self._remove_chips(address, pod, excess,
+                                                force=True)
 
         after = self._probe(address, pod)
         healthy_after = [c for c in after if c.healthy]
@@ -279,6 +309,7 @@ class ElasticReconciler:
             "actual": len(healthy_after),
             "healed": len(removed_dead),
             "removed_dead": removed_dead,
+            "removed_excess": removed_excess,
             "added": added,
         }
         if not degraded and len(healthy_after) != desired:
@@ -372,6 +403,10 @@ class ElasticReconciler:
     def _record_heal(self, pod: Pod, removed: list[str],
                      added: list[str]) -> None:
         CHIPS_HEALED.inc(len(removed))
+        AUDIT.record(
+            "elastic.heal", actor="reconciler", namespace=pod.namespace,
+            pod=pod.name, chips=added, outcome="success",
+            removed=sorted(removed))
         previous = {}
         try:
             previous = json.loads(pod.annotations.get(ANNOT_REPLACED, "{}"))

@@ -30,6 +30,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
 from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT, audited
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.rpc.client import WorkerClient
 from gpumounter_tpu.utils.log import get_logger
@@ -254,6 +256,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/migrations/(?P<mid>[^/]+)$"), "migration_get"),
     ("POST", re.compile(
         r"^/migrations/(?P<mid>[^/]+)/abort$"), "migration_abort"),
+    # Observability reads (gpumounter_tpu/obs). The audit pattern
+    # captures its own query string because the dispatcher matches the
+    # raw request path (no other route accepts queries).
+    ("GET", re.compile(r"^/audit(?:\?(?P<query>.*))?$"), "audit"),
+    ("GET", re.compile(r"^/trace/(?P<tid>[^/?]+)$"), "trace"),
 ]
 
 
@@ -271,21 +278,41 @@ class MasterApp:
     """Transport-independent request handling; served by build_http_server."""
 
     #: routes that stay open without a bearer token: read-only liveness
-    #: and scrape surfaces (k8s probes and Prometheus scrapers often
-    #: cannot attach credentials). Everything else — mount/unmount,
-    #: slice ops, the worker-topology listing — requires auth.
-    UNAUTHENTICATED_ROUTES = frozenset({"index", "healthz", "metrics"})
+    #: surfaces (k8s probes cannot attach credentials). Everything else
+    #: — mount/unmount, slice ops, the worker-topology listing —
+    #: requires auth.
+    UNAUTHENTICATED_ROUTES = frozenset({"index", "healthz"})
+
+    #: read-only observability routes: a distinct read scope
+    #: (TPUMOUNTER_AUTH_READ_TOKEN[_FILE]) instead of piggybacking on
+    #: the mutate token. With a read token configured they accept it
+    #: (the mutate token always implies read); without one, /metrics
+    #: stays open (probe/scrape back-compat) while /audit and /trace —
+    #: which reveal pod names and chip movements — require the mutate
+    #: token.
+    READ_ROUTES = frozenset({"metrics", "audit", "trace"})
+
+    #: mutating routes whose edge outcome lands in the audit trail
+    #: (worker-side records carry the chip-level detail for the same
+    #: trace id).
+    AUDITED_ROUTES = frozenset({
+        "add", "remove", "addslice", "removeslice", "intent_put",
+        "intent_delete", "migrate_start", "migration_abort"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
                  registry: WorkerRegistry | None = None):
-        from gpumounter_tpu.utils.auth import required_token
+        from gpumounter_tpu.utils.auth import (
+            required_token,
+            resolve_read_token,
+        )
         self.cfg = cfg or get_config()
         # Fail-closed at construction (daemon startup): the reference
         # serves its HTTP API open to any in-cluster peer even though
         # removegpu force=true kills tenant PIDs; here serving without a
         # secret requires the explicit TPUMOUNTER_AUTH=insecure opt-in.
         self._token = required_token(self.cfg, "master HTTP gateway")
+        self._read_token = resolve_read_token(self.cfg)
         self.kube = kube
         self.registry = registry or WorkerRegistry(kube, self.cfg)
         # The default worker client forwards the same per-deploy secret
@@ -321,11 +348,8 @@ class MasterApp:
                     continue
                 match = pattern.match(path)
                 if match:
-                    self._check_auth(name, headers)
-                    out = getattr(self, f"_route_{name}")(match, body,
-                                                          headers)
-                    status, ctype, text = out
-                    return status, ctype, text, {}
+                    return self._dispatch(name, match, method, path,
+                                          body, headers)
             raise _HttpError(404, "404 page not found")
         except _HttpError as exc:
             return exc.status, "text/plain", exc.message + "\n", exc.headers
@@ -333,12 +357,98 @@ class MasterApp:
             logger.exception("unhandled error for %s %s", method, path)
             return 500, "text/plain", f"Service Internal Error: {exc}\n", {}
 
+    #: probe/scrape surfaces a cluster hits every few seconds: never
+    #: traced — ~14k spans/day of healthz+metrics noise would rotate
+    #: the 2048-span ring and evict the mount traces operators actually
+    #: query (RUNBOOK "Debugging a slow mount").
+    UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics"})
+
+    def _dispatch(self, name: str, match, method: str, path: str,
+                  body: bytes, headers: dict[str, str]
+                  ) -> tuple[int, str, str, dict[str, str]]:
+        """One routed request = one root span. The trace id is minted
+        HERE (the HTTP edge) unless the caller supplied a valid
+        x-tpumounter-trace header, and is echoed on the response so
+        callers can pull the story later (`tpumounter trace <id>`).
+
+        Auth runs BEFORE the span opens: an unauthenticated peer must
+        not be able to churn the span ring or — via the inbound trace
+        header — inject spans into a victim's trace id."""
+        self._check_auth(name, headers)
+        if name in self.UNTRACED_ROUTES:
+            status, ctype, text = getattr(
+                self, f"_route_{name}")(match, body, headers)
+            return status, ctype, text, {}
+        inbound = next((v for k, v in headers.items()
+                        if k.lower() == trace.TRACE_HEADER), None)
+        extra: dict[str, str] = {}
+        # Exceptions are caught OUTSIDE the span so the root http.<name>
+        # span closes with status=error — a 500 whose edge span read
+        # "ok" would misreport the failure to `tpumounter trace <id>`.
+        try:
+            with trace.span(f"http.{name}", wire_parent=inbound,
+                            http_method=method) as ctx:
+                extra = {trace.RESPONSE_HEADER: ctx.trace_id}
+                if name in self.AUDITED_ROUTES:
+                    status, ctype, text = self._audited_route(
+                        name, match, body, headers)
+                else:
+                    status, ctype, text = getattr(
+                        self, f"_route_{name}")(match, body, headers)
+                return status, ctype, text, extra
+        except _HttpError as exc:
+            exc.headers = {**extra, **exc.headers}
+            raise
+        except Exception as exc:  # noqa: BLE001 — keep the header
+            logger.exception("unhandled error for %s %s", method, path)
+            return (500, "text/plain",
+                    f"Service Internal Error: {exc}\n", extra)
+
+    def _audited_route(self, name: str, match, body: bytes,
+                       headers: dict[str, str]) -> tuple[int, str, str]:
+        """Every mutating route leaves an audit record: actor (the
+        optional x-tpumounter-actor header, else "http"), the pod when
+        the route names one, the HTTP outcome, duration, and the edge
+        trace id. Worker-side records add the chip set for the same
+        trace."""
+        groups = match.groupdict()
+        actor = next((v for k, v in headers.items()
+                      if k.lower() == "x-tpumounter-actor"), "") or "http"
+        with audited(f"http.{name}", actor=actor,
+                     namespace=groups.get("ns", ""),
+                     pod=groups.get("pod", "")) as rec:
+            try:
+                status, ctype, text = getattr(
+                    self, f"_route_{name}")(match, body, headers)
+            except _HttpError as exc:
+                rec["outcome"] = f"http {exc.status}"
+                raise
+            rec["outcome"] = f"http {status}"
+            return status, ctype, text
+
     def _check_auth(self, route_name: str, headers: dict[str, str]) -> None:
-        if self._token is None or route_name in self.UNAUTHENTICATED_ROUTES:
+        if route_name in self.UNAUTHENTICATED_ROUTES:
             return
         from gpumounter_tpu.utils.auth import check_bearer
         value = next((v for k, v in headers.items()
                       if k.lower() == "authorization"), None)
+        if route_name in self.READ_ROUTES:
+            if self._read_token is not None:
+                # Distinct read scope: the read token or the mutate
+                # token (mutate implies read) — nothing else.
+                if check_bearer(value, self._read_token) or (
+                        self._token is not None
+                        and check_bearer(value, self._token)):
+                    return
+                logger.warning("unauthorized %s read rejected", route_name)
+                raise _HttpError(
+                    401, "missing or invalid bearer token (read scope)")
+            if route_name == "metrics":
+                return  # legacy open scrape surface (probes/scrapers)
+            # /audit and /trace reveal pod names and chip movements:
+            # without a read token they require the mutate token below.
+        if self._token is None:
+            return
         if not check_bearer(value, self._token):
             logger.warning("unauthenticated %s request rejected", route_name)
             raise _HttpError(401, "missing or invalid bearer token")
@@ -380,6 +490,35 @@ class MasterApp:
 
     def _route_metrics(self, match, body, headers):
         return 200, "text/plain; version=0.0.4", REGISTRY.render()
+
+    def _route_audit(self, match, body, headers):
+        """Query the append-only audit trail. Filters (all optional):
+        ?namespace= &pod= &op= (prefix) &trace= &outcome= (prefix)
+        &limit= (default 100). The query contract lives in
+        obs.audit.query_from_params, shared with the worker ops port."""
+        import json as jsonlib
+        params = urllib.parse.parse_qs(match.group("query") or "")
+        from gpumounter_tpu.obs.audit import query_from_params
+        try:
+            payload = query_from_params(params)
+        except ValueError:
+            raise _HttpError(400, f"Invalid limit: {params.get('limit')!r}")
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
+
+    def _route_trace(self, match, body, headers):
+        """All buffered spans for one trace id (master-side view; the
+        worker's ops port serves its half of the same trace via the
+        shared obs.trace.trace_payload contract)."""
+        import json as jsonlib
+        tid = match.group("tid")
+        payload = trace.trace_payload(tid)
+        if payload is None:
+            raise _HttpError(
+                404, f"no spans buffered for trace {tid} (expired from "
+                     f"the ring, or minted elsewhere)")
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
 
     def _parse_slice_body(self, body: bytes):
         import json as jsonlib
@@ -693,4 +832,8 @@ def build_http_server(app: MasterApp, port: int | None = None,
         def log_message(self, fmt, *args):
             logger.debug("http: " + fmt, *args)
 
-    return ThreadingHTTPServer((host, port or cfg.master_port), Handler)
+    # `is None`, not falsy: port=0 means "ephemeral, kernel-assigned"
+    # (the test stacks) — `port or ...` silently rebound it to the
+    # config port, colliding with any concurrently-bound master.
+    return ThreadingHTTPServer(
+        (host, cfg.master_port if port is None else port), Handler)

@@ -15,6 +15,9 @@ logger = get_logger("master.main")
 def main() -> None:
     cfg = get_config()
     init_logger(cfg.log_dir, "tpumounter-master.log")
+    from gpumounter_tpu.obs import audit, trace
+    trace.configure(cfg)
+    audit.configure(cfg)
     from gpumounter_tpu.k8s import default_client
     from gpumounter_tpu.master.app import MasterApp, build_http_server
 

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import NotFoundError
 from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.obs import trace
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.utils.log import get_logger
 
@@ -209,10 +210,17 @@ class SliceCoordinator:
             [ip for _, _, _, ip in resolved], chips_per_host,
             accel_type=accel_type, topology_hint=topology_hint)
         results: dict[int, tuple[api.AddTPUResult, list[str]] | Exception] = {}
+        # Contextvars don't cross threads: capture the ambient trace
+        # context here and re-attach it in each fan-out worker so every
+        # per-host mount span joins the caller's trace.
+        trace_ctx = trace.current()
 
         def _mount(i: int, address: str, t: SliceTarget) -> None:
             try:
-                with self.client_factory(address) as client:
+                with trace.attached(trace_ctx), \
+                        trace.span("slice.mount_host", pod=t.pod,
+                                   chips=chips_per_host), \
+                        self.client_factory(address) as client:
                     results[i] = client.add_tpu_detailed(
                         t.pod, t.namespace, chips_per_host, entire,
                         prefer_ici=prefer_ici)
@@ -321,10 +329,13 @@ class SliceCoordinator:
                      force: bool = False) -> dict:
         resolved = self._resolve(targets)
         results = {}
+        trace_ctx = trace.current()
 
         def _remove(i: int, address: str, t: SliceTarget) -> None:
             try:
-                with self.client_factory(address) as client:
+                with trace.attached(trace_ctx), \
+                        trace.span("slice.remove_host", pod=t.pod), \
+                        self.client_factory(address) as client:
                     results[i] = client.remove_tpu(t.pod, t.namespace, [],
                                                    force=force,
                                                    remove_all=True)

@@ -60,6 +60,8 @@ from gpumounter_tpu.migrate.journal import (
     new_journal,
     parse_journal,
 )
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
@@ -156,6 +158,11 @@ class MigrationCoordinator:
             mid = f"mig-{secrets.token_hex(5)}"
             journal = new_journal(mid, source_ns, source_pod,
                                   dest_ns, dest_pod)
+            # The whole migration — every phase, on whatever master
+            # drives it after a crash — runs under the trace the HTTP
+            # edge minted for /migrate; the journal is the carrier.
+            journal["trace_id"] = trace.current_trace_id() \
+                or trace.new_trace_id()
             self._persist(journal)
             try:
                 self._stamp(journal["destination"], ANNOT_LOCK, {
@@ -262,6 +269,16 @@ class MigrationCoordinator:
         thread.start()
 
     def _run(self, journal: dict) -> None:
+        # Re-attach the journal's trace on this machine thread: phase
+        # spans (and the worker spans behind their RPCs) join the trace
+        # minted at the /migrate edge — surviving master restarts,
+        # because the id rides in the persisted journal.
+        ctx = trace.TraceContext(journal.get("trace_id")
+                                 or trace.new_trace_id())
+        with trace.attached(ctx):
+            self._run_traced(journal)
+
+    def _run_traced(self, journal: dict) -> None:
         mid = journal["id"]
         final_phase = journal["phase"]
         crashed = False
@@ -276,9 +293,10 @@ class MigrationCoordinator:
                 # machine exactly between persisted transitions, then
                 # proves resume_interrupted() re-drives to a terminal
                 # state from whatever the journal recorded.
-                failpoints.fire(f"migrate.phase.{phase}", id=mid)
                 started = time.monotonic()
-                next_phase = getattr(self, f"_phase_{phase}")(journal)
+                with trace.span(f"migrate.{phase}", id=mid):
+                    failpoints.fire(f"migrate.phase.{phase}", id=mid)
+                    next_phase = getattr(self, f"_phase_{phase}")(journal)
                 elapsed = time.monotonic() - started
                 MIGRATION_PHASE_DURATION.observe(elapsed, phase=phase)
                 journal["phase_durations_s"][phase] = round(elapsed, 3)
@@ -330,6 +348,19 @@ class MigrationCoordinator:
                 MIGRATIONS_TOTAL.inc(
                     phase=final_phase,
                     outcome=journal.get("outcome") or "failed")
+                # Terminal audit record: even a machine adopted after a
+                # crash closes its migration in the trail (the chaos
+                # harness asserts every terminal journal has one).
+                src = journal["source"]
+                AUDIT.record(
+                    "migrate", actor="orchestrator",
+                    namespace=src["namespace"], pod=src["pod"],
+                    chips=journal.get("chips"),
+                    outcome=journal.get("outcome") or "failed",
+                    duration_s=time.time() - journal.get("created_at", 0.0),
+                    id=mid,
+                    destination=f"{journal['destination']['namespace']}/"
+                                f"{journal['destination']['pod']}")
             with self._lock:
                 self._aborts.discard(mid)
                 self._threads.pop(mid, None)
@@ -643,12 +674,14 @@ class MigrationCoordinator:
         failpoints.fire("migrate.persist", id=journal["id"],
                         phase=journal["phase"])
         try:
-            patch_pod_with_retry(
-                self.kube, src["namespace"], src["pod"],
-                {"metadata": {"annotations": {ANNOT_JOURNAL:
-                                              dump(journal)}}},
-                attempts=self.cfg.k8s_write_attempts,
-                base_s=self.cfg.k8s_write_retry_base_s)
+            with trace.span("migrate.journal_persist", id=journal["id"],
+                            phase=journal["phase"]):
+                patch_pod_with_retry(
+                    self.kube, src["namespace"], src["pod"],
+                    {"metadata": {"annotations": {ANNOT_JOURNAL:
+                                                  dump(journal)}}},
+                    attempts=self.cfg.k8s_write_attempts,
+                    base_s=self.cfg.k8s_write_retry_base_s)
         except NotFoundError:
             raise MigrationError(
                 f"source pod {src['namespace']}/{src['pod']} disappeared "

@@ -1,0 +1,23 @@
+"""Operations observability: distributed tracing + audit trail.
+
+The control plane mutates running pods from three cooperating planes
+(slice ops, elastic reconciler, migration orchestrator). This package
+answers the operator question those planes cannot: "what happened to
+pod X's chips, when, and why was it slow" —
+
+  * obs.trace — contextvar-based spans with a trace id minted at the
+    master HTTP edge and propagated over the RPC wire to the worker
+    (rpc/api.py trace_context fields), covering every phase of
+    mount/unmount/heal/migrate; in-memory ring-buffer + JSONL exporters.
+  * obs.audit — an append-only structured record of every mutating
+    operation (actor, pod, chips, idempotency key, outcome, duration,
+    trace id), queryable via the master's /audit route and the
+    `tpumounter audit` / `tpumounter trace <id>` CLI verbs.
+
+Stdlib-only on purpose: imported by the mount path, which must stay
+importable without grpc (utils/lazy_grpc.py policy).
+"""
+
+from gpumounter_tpu.obs import audit, trace
+
+__all__ = ["audit", "trace"]

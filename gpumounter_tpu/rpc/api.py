@@ -39,6 +39,10 @@ class AddTPURequest(Message):
     # safe: the worker remembers recently-completed keys and answers a
     # retried mount from that record instead of mounting again (the
     # client's bounded retry + the chaos harness depend on it).
+    # Field 7 carries the caller's trace context (obs/trace.py,
+    # "<trace_id>-<span_id>") so worker-side spans join the trace minted
+    # at the master HTTP edge; the worker tolerates absent/malformed
+    # values (legacy or buggy peers) by starting a fresh trace.
     # Wire-compatible: legacy peers skip the unknown fields and see
     # reference semantics.
     FIELDS = [
@@ -48,6 +52,7 @@ class AddTPURequest(Message):
         Field(4, "is_entire_mount", "bool"),
         Field(5, "prefer_ici", "bool"),
         Field(6, "idempotency_key", "string"),
+        Field(7, "trace_context", "string"),
     ]
 
 
@@ -68,8 +73,9 @@ class RemoveTPURequest(Message):
     # Field 5 is our extension: remove every slave-held chip regardless of
     # mount type (the slice coordinator's remove path). Field 6 mirrors
     # AddTPURequest: a retried remove whose first attempt landed answers
-    # Success from the worker's idempotency record. Wire-compatible —
-    # legacy peers skip the unknown fields and see reference semantics.
+    # Success from the worker's idempotency record. Field 7 mirrors
+    # AddTPURequest's trace context. Wire-compatible — legacy peers skip
+    # the unknown fields and see reference semantics.
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
@@ -77,6 +83,7 @@ class RemoveTPURequest(Message):
         Field(4, "force", "bool"),
         Field(5, "remove_all", "bool"),
         Field(6, "idempotency_key", "string"),
+        Field(7, "trace_context", "string"),
     ]
 
 
@@ -106,6 +113,7 @@ class ProbeTPURequest(Message):
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
+        Field(3, "trace_context", "string"),
     ]
 
 
@@ -144,6 +152,7 @@ class QuiesceStatusRequest(Message):
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
+        Field(3, "trace_context", "string"),
     ]
 
 

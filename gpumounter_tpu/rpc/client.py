@@ -26,6 +26,7 @@ import secrets
 import time
 
 from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.obs import trace
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.rpc.resilience import (
     RPC_RETRIES,
@@ -147,6 +148,14 @@ class WorkerClient:
     def _call(self, method: str, stub, request, timeout_s: float | None):
         if self._channel is None:
             raise RuntimeError(f"WorkerClient for {self.address} is closed")
+        with trace.span(f"rpc.{method}", address=self.address):
+            # Stamp the span we just opened onto the wire: the worker's
+            # server-side span parents to THIS rpc span, not the caller's.
+            request.trace_context = trace.wire_context()
+            return self._call_attempts(method, stub, request, timeout_s)
+
+    def _call_attempts(self, method: str, stub, request,
+                       timeout_s: float | None):
         deadline = (timeout_s if timeout_s is not None
                     else self.timeout_s if self.timeout_s is not None
                     else self.timeouts[method])

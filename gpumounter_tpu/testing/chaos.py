@@ -12,7 +12,13 @@ invariants after convergence:
   3. accounting parity (every booked chip is actually mounted: slave-pod
      books match injected nodes),
   4. every migration journal is terminal: outcome succeeded / rolled-back
-     / aborted with phase=done — never stranded, never half-rolled-back.
+     / aborted with phase=done — never stranded, never half-rolled-back,
+  5. observability closure (gpumounter_tpu/obs): no orphan open spans —
+     every span entered was exited, even through injected crashes,
+  6. every operation leaves a terminal audit record: each terminal
+     migration journal has a matching audit record, and every audit
+     record carries an outcome and a trace id (a crashed-and-resumed
+     operation must not vanish from the trail).
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -35,6 +41,8 @@ from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import NotFoundError
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
 from gpumounter_tpu.rpc.client import WorkerClient
 from gpumounter_tpu.testing.cluster import FakeCluster
 from gpumounter_tpu.utils.log import get_logger
@@ -115,6 +123,10 @@ class ChaosHarness:
     # --- lifecycle ---
 
     def start(self) -> "ChaosHarness":
+        # Per-scenario observability baseline: the closure invariants
+        # (open spans, audit records) must judge THIS run only.
+        trace.TRACER.reset()
+        AUDIT.reset()
         self.cluster.start()
         for i, name in enumerate(self.cluster.node_names):
             node_cfg = self.cluster.node_cfg(name, self.cfg)
@@ -455,7 +467,8 @@ class ChaosHarness:
                     f"{sorted(phantom)} but the node(s) are not mounted")
 
         # 4. every migration journal terminal
-        for journal in self.app.migrations.list_migrations():
+        journals = self.app.migrations.list_migrations()
+        for journal in journals:
             outcome = journal.get("outcome")
             if outcome not in ("succeeded", "rolled-back", "aborted") or \
                     journal.get("phase") != "done":
@@ -463,6 +476,35 @@ class ChaosHarness:
                     f"journal {journal.get('id')} not terminal/clean: "
                     f"phase={journal.get('phase')} outcome={outcome} "
                     f"error={journal.get('error')}")
+
+        # 5. no orphan open spans: every span entered was exited, even
+        # through injected crashes (the exporter's finally discipline).
+        orphans = trace.TRACER.open_spans()
+        if orphans:
+            violations.append(f"orphan open span(s): {orphans}")
+
+        # 6. terminal audit records. Every terminal journal must appear
+        # in the audit trail (crashed-and-resumed machines included),
+        # and no record may be outcome-less or trace-less.
+        audit_records = AUDIT.snapshot()
+        migrate_ids = {r.get("details", {}).get("id")
+                       for r in audit_records
+                       if r["operation"] == "migrate"}
+        for journal in journals:
+            if journal.get("outcome") and journal["id"] not in migrate_ids:
+                violations.append(
+                    f"migration {journal['id']} finished "
+                    f"({journal['outcome']}) but left no terminal audit "
+                    f"record")
+        for rec in audit_records:
+            if not rec.get("outcome"):
+                violations.append(
+                    f"audit record without outcome: seq={rec['seq']} "
+                    f"op={rec['operation']} pod={rec['pod']}")
+            if not rec.get("trace_id"):
+                violations.append(
+                    f"audit record without trace id: seq={rec['seq']} "
+                    f"op={rec['operation']} pod={rec['pod']}")
 
         if violations:
             tail = "\n  ".join(self.schedule[-25:])

@@ -59,6 +59,28 @@ def resolve_token(cfg) -> str | None:
     return None
 
 
+def resolve_read_token(cfg) -> str | None:
+    """Optional read-only scope secret for observability routes
+    (/metrics, /audit, /trace): scrapers and dashboards present this
+    token and can read, never mutate. None when not configured — the
+    routes then keep their legacy behavior (metrics open; audit/trace
+    gated on the mutate token). The mutate token always implies read."""
+    if getattr(cfg, "auth_read_token", ""):
+        return cfg.auth_read_token
+    path = getattr(cfg, "auth_read_token_file", "")
+    if path:
+        try:
+            with open(path, encoding="utf-8") as f:
+                token = f.read().strip()
+        except OSError as exc:
+            raise AuthConfigError(
+                f"read token file {path!r} unreadable: {exc}") from exc
+        if not token:
+            raise AuthConfigError(f"read token file {path!r} is empty")
+        return token
+    return None
+
+
 def required_token(cfg, role: str) -> str | None:
     """Fail-closed startup resolution for a daemon.
 

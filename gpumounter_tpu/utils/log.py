@@ -3,10 +3,20 @@
 Reference parity: pkg/util/log/log.go:9-30 (zap SugaredLogger, console encoder,
 ISO8601 timestamps, Debug level, dual sink stdout + /var/log/GPUMounter/<file>.log).
 Here: stdlib logging with an ISO8601 console formatter and optional file sink.
+
+Two output modes (TPUMOUNTER_LOG_FORMAT, or init_logger(json_mode=...)):
+  console  the zap-style tab-separated line (default)
+  json     one JSON object per line: ts/level/logger/msg — and, whenever
+           an obs.trace span is active, the trace id, so log lines and
+           spans correlate (`tpumounter trace <id>` + grep trace_id).
+The trace id is stamped by a logging.Filter in BOTH modes (console
+formatting just doesn't render it); obs.trace is imported lazily inside
+the filter because obs.trace itself logs through this module.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -19,30 +29,79 @@ _FMT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
 _DATEFMT = "%Y-%m-%dT%H:%M:%S%z"
 
 
+class _TraceIdFilter(logging.Filter):
+    """Stamp the ambient trace id (obs.trace contextvar) on every record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from gpumounter_tpu.obs.trace import current_trace_id
+            record.trace_id = current_trace_id()
+        except Exception:  # noqa: BLE001 — logging must never raise
+            record.trace_id = ""
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; trace_id present only when traced."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": self.formatTime(record, _DATEFMT),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        trace_id = getattr(record, "trace_id", "")
+        if trace_id:
+            out["trace_id"] = trace_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def _make_formatter(json_mode: bool) -> logging.Formatter:
+    if json_mode:
+        return JsonFormatter(datefmt=_DATEFMT)
+    return logging.Formatter(_FMT, datefmt=_DATEFMT)
+
+
 def init_logger(log_dir: str | None = None, filename: str | None = None,
-                level: int = logging.DEBUG) -> logging.Logger:
+                level: int = logging.DEBUG,
+                json_mode: bool | None = None) -> logging.Logger:
     """Initialise root logging: stdout always; file sink if log_dir given.
 
     Mirrors InitLogger(log.go:9-17): distinct filenames per daemon
     ("tpumounter-master.log" / "tpumounter-worker.log"), multi-sink.
     Safe to call more than once; later calls only adjust the level.
+
+    json_mode: True emits structured JSON lines with the active trace id
+    stamped on every record; None reads TPUMOUNTER_LOG_FORMAT ("json"
+    enables it).
     """
     global _INITIALIZED
+    if json_mode is None:
+        json_mode = os.environ.get(
+            "TPUMOUNTER_LOG_FORMAT", "console").strip().lower() == "json"
     root = logging.getLogger("gpumounter_tpu")
     with _LOCK:
         if _INITIALIZED:
             root.setLevel(level)
             return root
         root.setLevel(level)
-        formatter = logging.Formatter(_FMT, datefmt=_DATEFMT)
+        formatter = _make_formatter(json_mode)
+        # Filter lives on the HANDLERS: child-logger records propagate
+        # to root's handlers without running root's logger-level filters.
+        trace_filter = _TraceIdFilter()
         stream = logging.StreamHandler(sys.stdout)
         stream.setFormatter(formatter)
+        stream.addFilter(trace_filter)
         root.addHandler(stream)
         if log_dir and filename:
             try:
                 os.makedirs(log_dir, exist_ok=True)
                 fileh = logging.FileHandler(os.path.join(log_dir, filename))
                 fileh.setFormatter(formatter)
+                fileh.addFilter(trace_filter)
                 root.addHandler(fileh)
             except OSError:
                 root.warning("cannot open log file in %s; stdout only", log_dir)

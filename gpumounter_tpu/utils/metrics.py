@@ -11,6 +11,14 @@ import threading
 from dataclasses import dataclass, field
 
 
+def _fmt_float(value: float) -> str:
+    """Prometheus-style bucket bound: integral bounds render bare
+    ("1", "30"), everything else as the shortest float repr."""
+    if float(value) == int(value):
+        return str(int(value))
+    return repr(float(value))
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
@@ -29,6 +37,10 @@ class Counter:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
 
     def collect(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
@@ -71,6 +83,10 @@ class Gauge:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
     def collect(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} gauge"]
@@ -104,6 +120,10 @@ class Histogram:
             counts[-1] += 1  # +Inf
             entry[1] += value
 
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
     def collect(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
@@ -111,7 +131,7 @@ class Histogram:
                 labels = dict(key)
                 for i, b in enumerate(self.buckets):
                     lines.append(
-                        f"{self.name}_bucket{_fmt_labels({**labels, 'le': repr(b)})} {counts[i]}"
+                        f"{self.name}_bucket{_fmt_labels({**labels, 'le': _fmt_float(b)})} {counts[i]}"
                     )
                 lines.append(f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {counts[-1]}")
                 lines.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
@@ -149,6 +169,16 @@ class Registry:
         for m in metrics:
             lines.extend(m.collect())
         return "\n".join(lines) + "\n"
+
+    def reset_all(self) -> None:
+        """Zero every registered metric's samples (the instruments stay
+        registered — module-level handles keep working). Test hook: the
+        conftest fixture calls this between tests so exposition tests
+        cannot bleed counters across the suite."""
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            m.reset()
 
 
 REGISTRY = Registry()

@@ -23,29 +23,95 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 logger = get_logger("worker.main")
 
 
-class _OpsHandler(BaseHTTPRequestHandler):
-    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        if self.path == "/healthz":
-            body = b"ok\n"
-            ctype = "text/plain"
-        elif self.path == "/metrics":
-            body = REGISTRY.render().encode()
-            ctype = "text/plain; version=0.0.4"
-        else:
-            self.send_error(404)
-            return
-        self.send_response(200)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+def _make_ops_handler(read_token: str | None, mutate_token: str | None):
+    """Worker ops surface: liveness, Prometheus exposition, and the
+    worker's halves of the observability stores — /audit and /trace/<id>
+    render through the same obs contracts the master routes use
+    (obs.audit.query_from_params / obs.trace.trace_payload) so the two
+    daemons cannot drift.
 
-    def log_message(self, fmt, *args):  # quiet
-        pass
+    Auth mirrors the master's read scope: /audit + /trace — and
+    /metrics when a read token is configured — accept the read token or
+    the worker's mutate secret; without a read token, /metrics stays
+    open (scrape back-compat) while /audit + /trace require the mutate
+    secret (they reveal pod names and chip movements; the master gates
+    them the same way). /healthz is always open for probes."""
+
+    def _read_allowed(auth_header: str | None) -> bool:
+        from gpumounter_tpu.utils.auth import check_bearer
+        if read_token is not None:
+            return check_bearer(auth_header, read_token) or (
+                mutate_token is not None
+                and check_bearer(auth_header, mutate_token))
+        if mutate_token is None:
+            return True  # explicit TPUMOUNTER_AUTH=insecure opt-in
+        return check_bearer(auth_header, mutate_token)
+
+    class _OpsHandler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            import json
+            import urllib.parse
+
+            from gpumounter_tpu.obs import trace
+            from gpumounter_tpu.obs.audit import query_from_params
+
+            parsed = urllib.parse.urlsplit(self.path)
+            auth = self.headers.get("Authorization")
+            if parsed.path == "/healthz":
+                body = b"ok\n"
+                ctype = "text/plain"
+            elif parsed.path == "/metrics":
+                if read_token is not None and not _read_allowed(auth):
+                    self.send_error(401)
+                    return
+                body = REGISTRY.render().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif parsed.path == "/audit":
+                if not _read_allowed(auth):
+                    self.send_error(401)
+                    return
+                try:
+                    payload = query_from_params(
+                        urllib.parse.parse_qs(parsed.query))
+                except ValueError:
+                    self.send_error(400)
+                    return
+                body = (json.dumps(payload, indent=1) + "\n").encode()
+                ctype = "application/json"
+            elif parsed.path.startswith("/trace/"):
+                if not _read_allowed(auth):
+                    self.send_error(401)
+                    return
+                payload = trace.trace_payload(
+                    parsed.path[len("/trace/"):])
+                if payload is None:
+                    self.send_error(404)
+                    return
+                body = (json.dumps(payload, indent=1) + "\n").encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    return _OpsHandler
 
 
-def serve_ops(port: int) -> ThreadingHTTPServer:
-    httpd = ThreadingHTTPServer(("0.0.0.0", port), _OpsHandler)
+def serve_ops(port: int, cfg=None) -> ThreadingHTTPServer:
+    from gpumounter_tpu.utils.auth import required_token, resolve_read_token
+    cfg = cfg or get_config()
+    # required_token: None only under the explicit insecure opt-in —
+    # the same fail-closed resolution the gRPC server already did.
+    handler = _make_ops_handler(resolve_read_token(cfg),
+                                required_token(cfg, "worker ops port"))
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd
 
@@ -53,6 +119,9 @@ def serve_ops(port: int) -> ThreadingHTTPServer:
 def main() -> None:
     cfg = get_config()
     init_logger(cfg.log_dir, "tpumounter-worker.log")
+    from gpumounter_tpu.obs import audit, trace
+    trace.configure(cfg)
+    audit.configure(cfg)
     logger.info("tpumounter worker starting (port %d)", cfg.worker_port)
 
     from gpumounter_tpu.k8s import default_client

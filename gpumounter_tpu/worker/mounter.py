@@ -40,6 +40,7 @@ from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.faults.failpoints import CrashError
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.nsutil import ns as nsutil
+from gpumounter_tpu.obs import trace
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import (
     MOUNT_LATENCY,
@@ -231,7 +232,9 @@ class TpuMounter:
             # and the reconciler heals it).
             failpoints.fire("worker.mount.before_grant", device=dev.uuid,
                             target=target.description)
-            with timer.phase("cgroup_grant"):
+            with timer.phase("cgroup_grant"), \
+                    trace.span("mount.cgroup_grant", device=dev.uuid,
+                               target=target.description):
                 if target.cgroup_dirs and self.cgroup_version == 2:
                     # The controller captures base rules only at FIRST
                     # grant per cgroup; skip the /dev walk (a /proc tree
@@ -249,7 +252,9 @@ class TpuMounter:
                     granted.append(cg)
             failpoints.fire("worker.mount.after_grant", device=dev.uuid,
                             target=target.description)
-            with timer.phase("device_inject"):
+            with timer.phase("device_inject"), \
+                    trace.span("mount.mknod", device=dev.uuid,
+                               target=target.description):
                 failpoints.fire("worker.mount.mknod", device=dev.uuid,
                                 target=target.description)
                 nsutil.inject_device_file(target.dev_dir, dev,
@@ -264,13 +269,15 @@ class TpuMounter:
             # Undo partial grants: without this, a failed injection leaves
             # the container with kernel-level access to a chip the caller's
             # rollback is about to hand back to the scheduler.
-            for cg in granted:
-                try:
-                    failpoints.fire("worker.mount.rollback", cgroup=cg,
-                                    device=dev.uuid)
-                    self.controller.revoke(cg, dev)
-                except Exception as undo_exc:  # noqa: BLE001
-                    self._rollback_failed(target, dev, cg, undo_exc)
+            with trace.span("mount.rollback", device=dev.uuid,
+                            cgroups=len(granted)):
+                for cg in granted:
+                    try:
+                        failpoints.fire("worker.mount.rollback", cgroup=cg,
+                                        device=dev.uuid)
+                        self.controller.revoke(cg, dev)
+                    except Exception as undo_exc:  # noqa: BLE001
+                        self._rollback_failed(target, dev, cg, undo_exc)
             MOUNT_TOTAL.inc(result="error")
             if isinstance(exc, MountError):
                 raise
@@ -320,10 +327,14 @@ class TpuMounter:
         try:
             failpoints.fire("worker.unmount.before_revoke", device=dev.uuid,
                             target=target.description)
-            with timer.phase("cgroup_revoke"):
+            with timer.phase("cgroup_revoke"), \
+                    trace.span("unmount.cgroup_revoke", device=dev.uuid,
+                               target=target.description):
                 for cg in target.cgroup_dirs:
                     self.controller.revoke(cg, dev)
-            with timer.phase("device_remove"):
+            with timer.phase("device_remove"), \
+                    trace.span("unmount.device_remove", device=dev.uuid,
+                               target=target.description):
                 nsutil.remove_device_file(target.dev_dir, dev,
                                           pid=target.ns_pid)
             if force and holders:

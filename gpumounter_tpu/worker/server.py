@@ -32,6 +32,8 @@ from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.backend import backend_from_config
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import audited
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.worker.mounter import MountError, TpuBusyError, TpuMounter
@@ -149,6 +151,22 @@ class TpuMountService:
 
     def add_tpu(self, request: api.AddTPURequest,
                 context: grpc.ServicerContext) -> api.AddTPUResponse:
+        """Observability shell: the worker-side span joins the trace the
+        client stamped on the wire (fresh trace when absent/malformed —
+        legacy peers), and every outcome — replay, abort, crash — leaves
+        a terminal audit record (the audited() finally)."""
+        with trace.span("worker.AddTPU", wire_parent=request.trace_context,
+                        pod=f"{request.namespace}/{request.pod_name}"), \
+                audited("worker.AddTPU", actor="rpc",
+                        namespace=request.namespace, pod=request.pod_name,
+                        idempotency_key=request.idempotency_key) as rec:
+            response = self._add_tpu_op(request, context)
+            rec["chips"] = list(response.uuids)
+            rec["outcome"] = api.AddTPUResult(response.add_tpu_result).name
+            return response
+
+    def _add_tpu_op(self, request: api.AddTPURequest,
+                    context: grpc.ServicerContext) -> api.AddTPUResponse:
         timer = PhaseTimer()
         failpoints.fire("worker.rpc", method="AddTPU",
                         pod=request.pod_name)
@@ -282,6 +300,13 @@ class TpuMountService:
         still present in the target's /dev, and re-run the /proc holder
         scan. Read-only — healing decisions belong to the master-side
         reconciler, which owns the scheduler's books."""
+        with trace.span("worker.ProbeTPU",
+                        wire_parent=request.trace_context,
+                        pod=f"{request.namespace}/{request.pod_name}"):
+            return self._probe_tpu_op(request, context)
+
+    def _probe_tpu_op(self, request: api.ProbeTPURequest,
+                      context: grpc.ServicerContext) -> api.ProbeTPUResponse:
         failpoints.fire("worker.rpc", method="ProbeTPU",
                         pod=request.pod_name)
         try:
@@ -318,6 +343,14 @@ class TpuMountService:
         """What the migration orchestrator cannot see from the master:
         the tenant's ack annotation AND whether any process still holds
         the chips. Read-only, like probe_tpu."""
+        with trace.span("worker.QuiesceStatus",
+                        wire_parent=request.trace_context,
+                        pod=f"{request.namespace}/{request.pod_name}"):
+            return self._quiesce_status_op(request, context)
+
+    def _quiesce_status_op(self, request: api.QuiesceStatusRequest,
+                           context: grpc.ServicerContext,
+                           ) -> api.QuiesceStatusResponse:
         import json as jsonlib
 
         failpoints.fire("worker.rpc", method="QuiesceStatus",
@@ -353,6 +386,23 @@ class TpuMountService:
 
     def remove_tpu(self, request: api.RemoveTPURequest,
                    context: grpc.ServicerContext) -> api.RemoveTPUResponse:
+        """Observability shell mirroring add_tpu: wire-joined span +
+        guaranteed-terminal audit record."""
+        with trace.span("worker.RemoveTPU",
+                        wire_parent=request.trace_context,
+                        pod=f"{request.namespace}/{request.pod_name}"), \
+                audited("worker.RemoveTPU", actor="rpc",
+                        namespace=request.namespace, pod=request.pod_name,
+                        chips=list(request.uuids),
+                        idempotency_key=request.idempotency_key) as rec:
+            response = self._remove_tpu_op(request, context)
+            rec["outcome"] = \
+                api.RemoveTPUResult(response.remove_tpu_result).name
+            return response
+
+    def _remove_tpu_op(self, request: api.RemoveTPURequest,
+                       context: grpc.ServicerContext
+                       ) -> api.RemoveTPUResponse:
         failpoints.fire("worker.rpc", method="RemoveTPU",
                         pod=request.pod_name)
         logger.info("RemoveTPU %s/%s uuids=%s force=%s", request.namespace,

@@ -31,6 +31,21 @@ import pytest  # noqa: E402
 from gpumounter_tpu.config import Config, set_config  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """Metrics / trace / audit state is module-global (the daemons'
+    design); without a reset between tests, exposition tests would see
+    counters bled from whatever ran before them. Runs after every test:
+    zeroes every registered metric's samples, drops buffered spans and
+    open-span records, and clears the audit trail."""
+    yield
+    from gpumounter_tpu.obs import audit, trace
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    REGISTRY.reset_all()
+    trace.TRACER.reset()
+    audit.AUDIT.reset()
+
+
 @pytest.fixture()
 def fake_device_dir(tmp_path):
     """A fake chip inventory with 4 devices (BASELINE config 1 substrate)."""

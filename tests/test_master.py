@@ -62,6 +62,7 @@ def stack(tmp_path):
     yield base, cluster, str(container_dev), service
 
     httpd.shutdown()
+    httpd.server_close()  # shutdown() alone leaks the bound socket
     app.registry.stop()
     grpc_server.stop(grace=None)
     cluster.stop()

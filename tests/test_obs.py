@@ -1,0 +1,735 @@
+"""Observability subsystem (gpumounter_tpu/obs): tracing, audit, the
+master /audit + /trace routes, the read-scope auth split, Prometheus
+exposition parseability, and the end-to-end acceptance path — a trace
+id minted at the master /addtpu edge visible on the worker-side spans
+and in the audit record of the same operation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.obs import audit as audit_mod
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AuditLog, audited
+from gpumounter_tpu.obs.trace import TraceContext, Tracer
+
+
+# --- trace primitives ---
+
+
+def test_span_nesting_builds_parent_chain():
+    tracer = Tracer()
+    with trace.span("root", tracer=tracer) as root:
+        with trace.span("child", tracer=tracer) as child:
+            assert child.trace_id == root.trace_id
+            with trace.span("grandchild", tracer=tracer):
+                assert trace.current_trace_id() == root.trace_id
+    spans = {s["name"]: s for s in tracer.ring.snapshot()}
+    assert spans["child"]["parent_id"] == root.span_id
+    assert spans["grandchild"]["parent_id"] == spans["child"]["span_id"]
+    assert spans["root"]["parent_id"] == ""
+    assert tracer.open_spans() == []
+
+
+def test_span_without_parent_mints_fresh_trace():
+    with trace.span("a") as a, trace.span("b"):
+        pass
+    with trace.span("c") as c:
+        pass
+    assert a.trace_id != c.trace_id
+    assert trace.current() is None  # nothing leaks out of the blocks
+
+
+def test_span_records_error_status_and_still_closes():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError):
+        with trace.span("doomed", tracer=tracer):
+            raise RuntimeError("boom")
+    (rec,) = tracer.ring.snapshot()
+    assert rec["status"] == "error" and "boom" in rec["error"]
+    assert tracer.open_spans() == []
+
+
+def test_span_closes_through_injected_crash():
+    """The chaos invariant's foundation: a simulated process death
+    (CrashError bypasses business-logic cleanup on purpose) must still
+    exit the span — the context manager's finally is not cleanup logic,
+    it is the recorder."""
+    from gpumounter_tpu.faults.failpoints import CrashError
+
+    tracer = Tracer()
+    with pytest.raises(CrashError):
+        with trace.span("crashing", tracer=tracer):
+            raise CrashError("simulated death")
+    assert tracer.open_spans() == []
+    (rec,) = tracer.ring.snapshot()
+    assert rec["status"] == "error"
+
+
+def test_wire_context_roundtrip_and_attached_cross_thread():
+    seen = {}
+
+    def worker(ctx):
+        with trace.attached(ctx):
+            seen["tid"] = trace.current_trace_id()
+
+    with trace.span("edge") as ctx:
+        t = threading.Thread(target=worker, args=(trace.current(),))
+        t.start()
+        t.join()
+        assert seen["tid"] == ctx.trace_id
+    # attached(None) is a no-op, not an error
+    with trace.attached(None):
+        assert trace.current() is None
+
+
+def test_span_joins_wire_parent_and_ignores_malformed():
+    tracer = Tracer()
+    parent = TraceContext(trace.new_trace_id(), "ab" * 8)
+    with trace.span("joined", wire_parent=parent.to_wire(),
+                    tracer=tracer) as ctx:
+        assert ctx.trace_id == parent.trace_id
+    with trace.span("fresh", wire_parent="not-a-context",
+                    tracer=tracer) as ctx2:
+        assert ctx2.trace_id != parent.trace_id
+
+
+def test_ring_buffer_bounded_and_queryable():
+    tracer = Tracer(ring_capacity=10)
+    for i in range(25):
+        with trace.span(f"s{i}", tracer=tracer):
+            pass
+    assert len(tracer.ring.snapshot()) == 10
+    names = [s["name"] for s in tracer.ring.snapshot()]
+    assert names[0] == "s15" and names[-1] == "s24"
+
+
+def test_deferred_spans_publish_or_drop():
+    """High-frequency loops buffer their spans and publish only the
+    passes worth keeping — a dropped no-op pass leaves zero ring churn."""
+    tracer = Tracer()
+    with trace.deferred(tracer) as pending:
+        with trace.span("noop-pass", tracer=tracer):
+            with trace.span("probe", tracer=tracer):
+                pass
+    # never published: nothing in the ring
+    assert tracer.ring.snapshot() == []
+    with trace.deferred(tracer) as pending:
+        with trace.span("healing-pass", tracer=tracer):
+            pass
+        pending.publish()
+        pending.publish()  # idempotent
+    assert [s["name"] for s in tracer.ring.snapshot()] == ["healing-pass"]
+    # outside any deferred block, spans export directly again
+    with trace.span("direct", tracer=tracer):
+        pass
+    assert [s["name"] for s in tracer.ring.snapshot()] == \
+        ["healing-pass", "direct"]
+
+
+def test_deferred_publish_on_failure_keeps_spans():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError):
+        with trace.deferred(tracer) as pending:
+            try:
+                with trace.span("failing-pass", tracer=tracer):
+                    raise RuntimeError("pass died")
+            except BaseException:
+                pending.publish()
+                raise
+    (rec,) = tracer.ring.snapshot()
+    assert rec["name"] == "failing-pass" and rec["status"] == "error"
+
+
+def test_noop_reconcile_spans_are_dropped_mutating_published():
+    """The reconciler wiring of deferred(): a pass that changed nothing
+    exports no spans; a pass that healed/grew publishes its whole tree."""
+    from gpumounter_tpu.elastic.reconciler import ElasticReconciler
+
+    rec = ElasticReconciler.__new__(ElasticReconciler)
+
+    def run(outcome, exc=None):
+        trace.TRACER.reset()
+
+        def fake_pass(ns, pod):
+            with trace.span("rpc.ProbeTPU"):
+                pass
+            if exc is not None:
+                raise exc
+            return outcome
+
+        rec._reconcile_traced = fake_pass
+        try:
+            ElasticReconciler.reconcile_once(rec, "default", "p")
+        except Exception:
+            pass
+        return {s["name"] for s in trace.TRACER.ring.snapshot()}
+
+    assert run({"phase": "converged", "healed": 0, "added": []}) == set()
+    assert run({"phase": "unmanaged"}) == set()
+    mutated = run({"phase": "converged", "healed": 1, "added": ["a1"]})
+    assert {"elastic.reconcile", "rpc.ProbeTPU"} <= mutated
+    failed = run(None, exc=RuntimeError("probe down"))
+    assert "elastic.reconcile" in failed
+
+
+def test_jsonl_exporter_writes_spans(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    tracer = Tracer()
+    tracer.configure_jsonl(path)
+    with trace.span("persisted", tracer=tracer, pod="ns/p") as ctx:
+        pass
+    lines = [json.loads(line) for line in
+             open(path, encoding="utf-8").read().splitlines()]
+    assert lines[0]["name"] == "persisted"
+    assert lines[0]["trace_id"] == ctx.trace_id
+    assert lines[0]["attrs"] == {"pod": "ns/p"}
+
+
+# --- audit primitives ---
+
+
+def test_audited_success_and_enrichment():
+    log = AuditLog()
+    with audited("op.test", actor="t", namespace="ns", pod="p",
+                 log=log) as rec:
+        rec["chips"] = ["accel0"]
+        rec["outcome"] = "Success"
+    (record,) = log.snapshot()
+    assert record["outcome"] == "Success"
+    assert record["chips"] == ["accel0"]
+    assert record["duration_s"] >= 0.0
+
+
+def test_audited_error_outcome_on_exception():
+    log = AuditLog()
+    with pytest.raises(ValueError):
+        with audited("op.fail", pod="p", log=log):
+            raise ValueError("nope")
+    (record,) = log.snapshot()
+    assert record["outcome"].startswith("error: ValueError")
+
+
+def test_audited_terminal_record_survives_injected_crash():
+    from gpumounter_tpu.faults.failpoints import CrashError
+
+    log = AuditLog()
+    with pytest.raises(CrashError):
+        with audited("op.crash", pod="p", log=log):
+            raise CrashError("simulated death")
+    (record,) = log.snapshot()
+    assert "CrashError" in record["outcome"]
+
+
+def test_audit_stamps_ambient_trace_id():
+    log = AuditLog()
+    with trace.span("enclosing") as ctx:
+        log.record("op", pod="p", outcome="Success")
+    assert log.snapshot()[0]["trace_id"] == ctx.trace_id
+
+
+def test_audit_query_filters_and_bound():
+    log = AuditLog(capacity=8)
+    for i in range(12):
+        log.record("worker.AddTPU" if i % 2 else "http.add",
+                   namespace="default", pod=f"pod-{i % 3}",
+                   outcome="Success" if i % 3 else "error: boom",
+                   trace_id=f"t{i}")
+    assert len(log.snapshot()) == 8  # bounded
+    adds = log.query(operation="worker.")
+    assert adds and all(r["operation"] == "worker.AddTPU" for r in adds)
+    errs = log.query(outcome="error")
+    assert errs and all(r["outcome"].startswith("error") for r in errs)
+    by_trace = log.query(trace_id="t11")
+    assert len(by_trace) == 1 and by_trace[0]["pod"] == "pod-2"
+    assert len(log.query(limit=3)) == 3
+    newest = log.query(limit=1)[0]
+    assert newest["trace_id"] == "t11"  # newest first
+
+
+def test_audit_jsonl_sink(tmp_path):
+    path = str(tmp_path / "audit.jsonl")
+    log = AuditLog()
+    log.configure_jsonl(path)
+    log.record("op.a", pod="p", outcome="Success")
+    log.record("op.b", pod="q", outcome="Success")
+    lines = [json.loads(line) for line in
+             open(path, encoding="utf-8").read().splitlines()]
+    assert [ln["operation"] for ln in lines] == ["op.a", "op.b"]
+
+
+# --- structured JSON logs stamp the trace id (satellite) ---
+
+
+def test_json_formatter_includes_trace_id():
+    from gpumounter_tpu.utils.log import JsonFormatter, _TraceIdFilter
+
+    formatter = JsonFormatter()
+    filt = _TraceIdFilter()
+    record = logging.LogRecord("gpumounter_tpu.x", logging.INFO, "f.py", 1,
+                               "mounted %s", ("accel0",), None)
+    with trace.span("log-span") as ctx:
+        filt.filter(record)
+    out = json.loads(formatter.format(record))
+    assert out["msg"] == "mounted accel0"
+    assert out["trace_id"] == ctx.trace_id
+    assert out["level"] == "INFO"
+
+    untraced = logging.LogRecord("gpumounter_tpu.x", logging.INFO, "f.py", 1,
+                                 "quiet", (), None)
+    filt.filter(untraced)
+    assert "trace_id" not in json.loads(formatter.format(untraced))
+
+
+# --- Prometheus exposition ---
+
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*.*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? [-+0-9.eE]+)$")
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Minimal text-format parser: asserts every line is well-formed,
+    returns {series-with-labels: value}."""
+    series = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        assert _PROM_LINE.match(line), f"unparseable exposition line: {line!r}"
+        if line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        series[name] = float(value.replace("Inf", "inf"))
+    return series
+
+
+def test_registry_renders_parseable_histogram():
+    from gpumounter_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    hist = reg.histogram("t_latency_seconds", "test latency")
+    hist.observe(0.004)
+    hist.observe(0.3, phase="grant")
+    hist.observe(7.0)
+    series = parse_prometheus(reg.render())
+    assert series['t_latency_seconds_bucket{le="0.005"}'] == 1
+    assert series['t_latency_seconds_bucket{le="+Inf"}'] == 2
+    assert series['t_latency_seconds_count'] == 2
+    assert series['t_latency_seconds_bucket{le="1",phase="grant"}'] == 1
+    assert abs(series['t_latency_seconds_sum'] - 7.004) < 1e-9
+
+
+def test_metrics_reset_fixture_prevents_counter_bleed_a():
+    """Paired with _b below: each half observes a pristine registry —
+    the autouse conftest fixture resets between tests."""
+    from gpumounter_tpu.utils.metrics import MOUNT_TOTAL, REGISTRY
+
+    assert "tpumounter_mount_total 0" in REGISTRY.render()
+    MOUNT_TOTAL.inc(result="success")
+    assert 'tpumounter_mount_total{result="success"} 1' in REGISTRY.render()
+
+
+def test_metrics_reset_fixture_prevents_counter_bleed_b():
+    from gpumounter_tpu.utils.metrics import MOUNT_TOTAL, REGISTRY
+
+    assert "tpumounter_mount_total 0" in REGISTRY.render()
+    MOUNT_TOTAL.inc(result="success")
+    assert 'tpumounter_mount_total{result="success"} 1' in REGISTRY.render()
+
+
+def test_trace_audit_reset_fixture_a():
+    with trace.span("bleed-check"):
+        audit_mod.AUDIT.record("bleed.op", pod="p", outcome="Success")
+    assert len(audit_mod.AUDIT.snapshot()) == 1
+    assert len(trace.TRACER.ring.snapshot()) == 1
+
+
+def test_trace_audit_reset_fixture_b():
+    assert audit_mod.AUDIT.snapshot() == []
+    assert trace.TRACER.ring.snapshot() == []
+
+
+# --- master routes + read-scope auth ---
+
+
+@pytest.fixture()
+def app(test_config):
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+
+    return MasterApp(FakeKubeClient(), cfg=test_config)
+
+
+def _auth():
+    from conftest import AUTH_HEADER
+    return dict(AUTH_HEADER)
+
+
+def test_audit_route_serves_filtered_records(app):
+    audit_mod.AUDIT.record("worker.AddTPU", namespace="default", pod="p1",
+                           chips=["accel0"], outcome="Success",
+                           trace_id="t1")
+    audit_mod.AUDIT.record("worker.RemoveTPU", namespace="default",
+                           pod="p2", outcome="error: boom", trace_id="t2")
+    status, _, body, headers = app.handle("GET", "/audit", b"", _auth())
+    assert status == 200
+    assert len(json.loads(body)["records"]) == 2
+    assert headers["X-Tpumounter-Trace"]  # edge span minted an id
+
+    status, _, body, _ = app.handle(
+        "GET", "/audit?pod=p1&op=worker.", b"", _auth())
+    (rec,) = json.loads(body)["records"]
+    assert rec["chips"] == ["accel0"] and rec["trace_id"] == "t1"
+
+    status, _, body, _ = app.handle("GET", "/audit?trace=t2", b"", _auth())
+    (rec,) = json.loads(body)["records"]
+    assert rec["operation"] == "worker.RemoveTPU"
+
+    status, _, _, _ = app.handle("GET", "/audit?limit=junk", b"", _auth())
+    assert status == 400
+
+
+def test_trace_route_serves_spans_sorted(app):
+    with trace.span("outer-op") as ctx:
+        with trace.span("inner-op"):
+            pass
+    status, _, body, _ = app.handle(
+        "GET", f"/trace/{ctx.trace_id}", b"", _auth())
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["trace"] == ctx.trace_id
+    assert [s["name"] for s in payload["spans"]] == ["outer-op", "inner-op"]
+
+    status, _, _, _ = app.handle("GET", "/trace/ffffffff", b"", _auth())
+    assert status == 404
+
+
+def test_traced_routes_stamp_header_probe_routes_do_not(app):
+    """Operational routes carry the trace header; probe/scrape routes
+    (healthz, metrics, index) are never traced — a 10s liveness probe
+    must not rotate real mount traces out of the span ring."""
+    for path in ("/workers", "/audit", "/intents"):
+        _, _, _, headers = app.handle("GET", path, b"", _auth())
+        assert re.fullmatch(r"[0-9a-f]{32}",
+                            headers["X-Tpumounter-Trace"]), path
+    trace.TRACER.reset()
+    for path in ("/healthz", "/metrics", "/"):
+        _, _, _, headers = app.handle("GET", path, b"", _auth())
+        assert "X-Tpumounter-Trace" not in headers, path
+    assert trace.TRACER.ring.snapshot() == []  # no probe spans buffered
+
+
+def test_edge_honors_caller_supplied_trace_header(app):
+    wire = f"{trace.new_trace_id()}-{'cd' * 8}"
+    _, _, _, headers = app.handle(
+        "GET", "/workers", b"", {**_auth(), "X-Tpumounter-Trace": wire})
+    assert headers["X-Tpumounter-Trace"] == wire.split("-")[0]
+
+
+def test_unhandled_route_exception_closes_span_as_error(app):
+    """A 500 from an unexpected exception must keep the trace header
+    AND close the edge span with status=error — a trace whose edge
+    reads 'ok' for a failed request misleads the RUNBOOK workflow."""
+    def _boom(match, body, headers):
+        raise RuntimeError("kube client bug")
+
+    app._route_workers = _boom
+    status, _, body, headers = app.handle("GET", "/workers", b"", _auth())
+    assert status == 500 and "kube client bug" in body
+    tid = headers["X-Tpumounter-Trace"]
+    (span_rec,) = trace.TRACER.ring.spans_for(tid)
+    assert span_rec["name"] == "http.workers"
+    assert span_rec["status"] == "error"
+    assert "kube client bug" in span_rec["error"]
+
+
+def test_unauthenticated_request_buffers_no_span(app):
+    """Auth runs before the span opens: a 401 must not let an
+    unauthenticated peer churn the ring or join a victim's trace."""
+    trace.TRACER.reset()
+    wire = f"{trace.new_trace_id()}-{'ef' * 8}"
+    status, _, _, headers = app.handle(
+        "GET", "/workers", b"", {"X-Tpumounter-Trace": wire})
+    assert status == 401
+    assert "X-Tpumounter-Trace" not in headers
+    assert trace.TRACER.ring.snapshot() == []
+
+
+def test_mutating_route_leaves_edge_audit_record(app):
+    status, _, _, headers = app.handle(
+        "POST", "/removetpu/namespace/default/pod/ghost/force/false",
+        b"uuids=accel0", _auth())
+    assert status == 404  # pod doesn't exist — still audited
+    (rec,) = audit_mod.AUDIT.query(operation="http.remove")
+    assert rec["outcome"] == "http 404"
+    assert rec["pod"] == "ghost" and rec["namespace"] == "default"
+    assert rec["trace_id"] == headers["X-Tpumounter-Trace"]
+
+
+def test_read_scope_split(test_config):
+    """With a read token configured, the observability routes accept it
+    (or the mutate token) and nothing else; the read token must NOT
+    unlock mutate routes; without one, /metrics stays open and
+    /audit + /trace require the mutate token."""
+    from conftest import TEST_AUTH_TOKEN
+
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+
+    cfg = test_config.replace(auth_read_token="scrape-only-secret")
+    app = MasterApp(FakeKubeClient(), cfg=cfg)
+    read = {"Authorization": "Bearer scrape-only-secret"}
+    mutate = {"Authorization": f"Bearer {TEST_AUTH_TOKEN}"}
+
+    for path in ("/metrics", "/audit?limit=1", "/trace/00"):
+        want = 404 if path.startswith("/trace") else 200
+        assert app.handle("GET", path, b"", read)[0] == want, path
+        assert app.handle("GET", path, b"", mutate)[0] == want, path
+        assert app.handle("GET", path, b"", {})[0] == 401, path
+        bad = {"Authorization": "Bearer wrong"}
+        assert app.handle("GET", path, b"", bad)[0] == 401, path
+    # read scope must not mutate
+    status, _, _, _ = app.handle(
+        "POST", "/removetpu/namespace/default/pod/p/force/false",
+        b"uuids=a", read)
+    assert status == 401
+    # liveness stays open regardless
+    assert app.handle("GET", "/healthz", b"", {})[0] == 200
+
+    # no read token: metrics open, audit/trace gated on the mutate token
+    app2 = MasterApp(FakeKubeClient(), cfg=test_config)
+    assert app2.handle("GET", "/metrics", b"", {})[0] == 200
+    assert app2.handle("GET", "/audit", b"", {})[0] == 401
+    assert app2.handle("GET", "/audit", b"", mutate)[0] == 200
+    assert app2.handle("GET", "/trace/00", b"", {})[0] == 401
+
+
+def test_audit_and_trace_cli_verbs(app, capsys):
+    """tpumounter audit / tpumounter trace <id> against a live master."""
+    from gpumounter_tpu.cli import main as cli_main
+    from gpumounter_tpu.master.app import build_http_server
+
+    with trace.span("cli-op") as ctx:
+        audit_mod.AUDIT.record("worker.AddTPU", namespace="default",
+                               pod="cli-pod", chips=["accel1"],
+                               outcome="Success")
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        assert cli_main(["audit", "--master", base, "--pod", "cli-pod",
+                         "--op", "worker."]) == 0
+        out = capsys.readouterr().out
+        assert "accel1" in out and ctx.trace_id in out
+        assert cli_main(["trace", ctx.trace_id, "--master", base]) == 0
+        out = capsys.readouterr().out
+        assert "cli-op" in out
+        assert cli_main(["trace", "0" * 32, "--master", base]) == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_read_token_file_resolution(tmp_path, test_config):
+    from gpumounter_tpu.utils.auth import resolve_read_token
+
+    path = tmp_path / "read-token"
+    path.write_text("from-file\n")
+    cfg = test_config.replace(auth_read_token_file=str(path))
+    assert resolve_read_token(cfg) == "from-file"
+    assert resolve_read_token(test_config) is None
+
+
+# --- end-to-end acceptance ---
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    """Live HTTP master + gRPC worker over a FakeCluster (the
+    test_master.py stack shape)."""
+    from http.server import ThreadingHTTPServer  # noqa: F401 — doc only
+
+    from gpumounter_tpu.collector.collector import TpuCollector
+    from gpumounter_tpu.collector.podresources import PodResourcesClient
+    from gpumounter_tpu.master.app import (
+        MasterApp,
+        WorkerRegistry,
+        build_http_server,
+    )
+    from gpumounter_tpu.testing.cluster import FakeCluster
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+    from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev), description=f"{pod.namespace}/{pod.name}")
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cluster.cfg)
+    grpc_server = build_server(service, address="localhost:0")
+    grpc_server.start()
+    cfg = cluster.cfg.replace(worker_port=grpc_server.bound_port)
+    cluster.kube.create_pod(cfg.worker_namespace, {
+        "metadata": {"name": "tpu-mounter-worker-obs",
+                     "namespace": cfg.worker_namespace,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": cluster.node_name,
+                 "containers": [{"name": "worker"}]},
+        "status": {"phase": "Running", "podIP": "127.0.0.1"},
+    })
+    app = MasterApp(cluster.kube, cfg=cfg,
+                    registry=WorkerRegistry(cluster.kube, cfg))
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    yield base, cluster
+
+    httpd.shutdown()
+    httpd.server_close()  # shutdown() alone leaks the bound socket
+    app.registry.stop()
+    grpc_server.stop(grace=None)
+    cluster.stop()
+
+
+def _http(method, url, form=None, headers=None):
+    data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={**_auth(), **(headers or {})})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def test_addtpu_trace_spans_audit_and_metrics_end_to_end(stack):
+    """The ISSUE acceptance criterion, in one flow: the trace id minted
+    at the master /addtpu edge is visible on worker-side spans and in
+    the audit record of the same operation; /metrics on master AND
+    worker serves parseable Prometheus text including a mount-latency
+    Histogram."""
+    base, cluster = stack
+    cluster.add_target_pod("obs-pod")
+
+    status, _, headers = _http(
+        "GET", base + "/addtpu/namespace/default/pod/obs-pod"
+                      "/tpu/1/isEntireMount/false")
+    assert status == 200
+    tid = headers["X-Tpumounter-Trace"]
+    assert re.fullmatch(r"[0-9a-f]{32}", tid)
+
+    # Worker-side spans joined the edge trace THROUGH the wire field
+    # (the gRPC handler thread has no ambient context to inherit).
+    spans = trace.TRACER.ring.spans_for(tid)
+    by_name = {s["name"]: s for s in spans}
+    for expected in ("http.add", "rpc.AddTPU", "worker.AddTPU",
+                     "mount.cgroup_grant", "mount.mknod"):
+        assert expected in by_name, (expected, sorted(by_name))
+    assert by_name["worker.AddTPU"]["parent_id"] == \
+        by_name["rpc.AddTPU"]["span_id"]
+    assert trace.TRACER.open_spans() == []
+
+    # Audit: the edge record and the worker record share the trace id,
+    # and the worker record names the mounted chip.
+    edge = audit_mod.AUDIT.query(operation="http.add", trace_id=tid)
+    assert edge and edge[0]["outcome"] == "http 200"
+    worker_recs = audit_mod.AUDIT.query(operation="worker.AddTPU",
+                                        trace_id=tid)
+    assert worker_recs and worker_recs[0]["outcome"] == "Success"
+    assert len(worker_recs[0]["chips"]) == 1
+    assert worker_recs[0]["idempotency_key"]
+
+    # The /trace route tells the whole story for the returned id.
+    status, body, _ = _http("GET", f"{base}/trace/{tid}")
+    assert status == 200
+    assert {"http.add", "worker.AddTPU"} <= \
+        {s["name"] for s in json.loads(body)["spans"]}
+
+    # /audit?trace=<id> joins the other way.
+    status, body, _ = _http("GET", f"{base}/audit?trace={tid}")
+    ops = {r["operation"] for r in json.loads(body)["records"]}
+    assert {"http.add", "worker.AddTPU"} <= ops
+
+    # Prometheus exposition: master HTTP route...
+    status, body, _ = _http("GET", base + "/metrics")
+    assert status == 200
+    series = parse_prometheus(body)
+    assert series['tpumounter_mount_latency_seconds_bucket{le="+Inf"}'] >= 1
+    assert series["tpumounter_mount_latency_seconds_count"] >= 1
+    assert 'tpumounter_mount_total{result="success"}' in series
+
+    # ...and the worker ops server (worker/main.py), same registry
+    # rendering, plus its /trace half of the same trace. /metrics is
+    # open (no read token configured), but /audit + /trace need the
+    # worker secret — pod names and chip movements must not leak to
+    # any unauthenticated in-cluster peer.
+    from gpumounter_tpu.worker.main import serve_ops
+
+    def _ops_get(url, authed=True):
+        req = urllib.request.Request(
+            url, headers=_auth() if authed else {})
+        with urllib.request.urlopen(req) as resp:
+            return resp.read().decode()
+
+    ops_httpd = serve_ops(0)
+    try:
+        port = ops_httpd.server_address[1]
+        ops_base = f"http://127.0.0.1:{port}"
+        worker_series = parse_prometheus(
+            _ops_get(f"{ops_base}/metrics", authed=False))
+        assert worker_series[
+            'tpumounter_mount_latency_seconds_bucket{le="+Inf"}'] >= 1
+        worker_view = json.loads(_ops_get(f"{ops_base}/trace/{tid}"))
+        assert "worker.AddTPU" in {s["name"] for s in worker_view["spans"]}
+        worker_audit = json.loads(_ops_get(f"{ops_base}/audit?op=worker."))
+        assert worker_audit["records"]
+        for path in (f"/trace/{tid}", "/audit"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _ops_get(ops_base + path, authed=False)
+            assert err.value.code == 401, path
+    finally:
+        ops_httpd.shutdown()
+        ops_httpd.server_close()
+
+
+def test_failed_mount_leaves_error_audit_record_same_trace(stack):
+    """A mount the failpoint kills mid-mknod must still close the
+    books: error-status spans, a terminal worker audit record, and the
+    edge record showing the 500 — all under one trace id."""
+    from gpumounter_tpu.faults import failpoints
+
+    base, cluster = stack
+    cluster.add_target_pod("obs-fail-pod")
+    with failpoints.armed({"worker.mount.mknod": "1*error(obs drill)"}):
+        status, _, headers = _http(
+            "GET", base + "/addtpu/namespace/default/pod/obs-fail-pod"
+                          "/tpu/1/isEntireMount/false")
+    assert status == 500
+    tid = headers["X-Tpumounter-Trace"]
+    worker_recs = audit_mod.AUDIT.query(operation="worker.AddTPU",
+                                        trace_id=tid)
+    assert worker_recs and worker_recs[0]["outcome"].startswith("error")
+    edge = audit_mod.AUDIT.query(operation="http.add", trace_id=tid)
+    assert edge and edge[0]["outcome"] == "http 500"
+    names = {s["name"]: s for s in trace.TRACER.ring.spans_for(tid)}
+    assert names["mount.mknod"]["status"] == "error"
+    assert "mount.rollback" in names
+    assert trace.TRACER.open_spans() == []

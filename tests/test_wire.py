@@ -113,3 +113,88 @@ def test_api_enums_match_reference_values():
     assert api.RemoveTPUResult.TPUBusy == 1
     assert api.RemoveTPUResult.TPUNotFound == 4
     assert 3 not in set(api.RemoveTPUResult)
+
+
+# --- trace-context round-tripping (obs/trace.py over rpc/api.py) ---
+#
+# The trace_context field is our extension: a legacy (reference) peer
+# never sends it, skips it on receipt, and a buggy peer can fill it
+# with garbage. The codec must round-trip it faithfully; the tolerant
+# parse (obs.trace.parse_wire_context) must map every degenerate form
+# to None so the worker starts a fresh trace instead of failing the RPC.
+
+
+def _all_request_classes():
+    return [api.AddTPURequest, api.RemoveTPURequest,
+            api.ProbeTPURequest, api.QuiesceStatusRequest]
+
+
+def test_trace_context_roundtrips_on_every_request_message():
+    from gpumounter_tpu.obs import trace
+
+    wire = f"{trace.new_trace_id()}-{'ab' * 4}"
+    for cls in _all_request_classes():
+        msg = cls(pod_name="p", namespace="ns", trace_context=wire)
+        decoded = cls.decode(msg.encode())
+        assert decoded.trace_context == wire, cls.__name__
+        ctx = trace.parse_wire_context(decoded.trace_context)
+        assert ctx is not None and ctx.to_wire() == wire
+
+
+def test_trace_context_absent_from_legacy_peer_decodes_empty():
+    """A reference client's AddGPURequest has no field 7: decoding its
+    bytes must leave trace_context at the proto3 default ("") and the
+    parse must yield None — a fresh trace, not an error."""
+    from gpumounter_tpu.obs import trace
+
+    legacy = api.AddTPURequest(pod_name="p", namespace="ns", tpu_num=2)
+    legacy.trace_context = ""  # encoded as absent (proto3 default)
+    decoded = api.AddTPURequest.decode(legacy.encode())
+    assert decoded.trace_context == ""
+    assert trace.parse_wire_context(decoded.trace_context) is None
+
+
+def test_trace_context_unknown_to_legacy_decoder_is_skipped():
+    """The reverse direction: a legacy decoder (modeled by a class
+    without field 7) must skip our trace_context unharmed."""
+
+    class LegacyAddRequest(Message):
+        FIELDS = [
+            Field(1, "pod_name", "string"),
+            Field(2, "namespace", "string"),
+            Field(3, "tpu_num", "int32"),
+            Field(4, "is_entire_mount", "bool"),
+        ]
+
+    ours = api.AddTPURequest(pod_name="p", namespace="ns", tpu_num=2,
+                             trace_context="aa" * 16 + "-" + "bb" * 8)
+    decoded = LegacyAddRequest.decode(ours.encode())
+    assert decoded.pod_name == "p" and decoded.tpu_num == 2
+
+
+@pytest.mark.parametrize("malformed", [
+    "garbage",
+    "no-hyphen-here-at-all-xyz",
+    "UPPERCASE0123456789ABCDEF01234567-0011223344556677",  # not lowercase hex
+    "abcd-0011223344556677",            # trace id too short
+    "a" * 32,                           # no span id
+    "-".join(["a" * 32, ""]),           # empty span id
+    "a" * 32 + "-" + "b" * 40,          # span id too long
+    "\x00\x01\x02",
+    " " * 10,
+])
+def test_trace_context_malformed_from_wire_parses_to_none(malformed):
+    from gpumounter_tpu.obs import trace
+
+    msg = api.AddTPURequest(pod_name="p", namespace="ns",
+                            trace_context=malformed)
+    decoded = api.AddTPURequest.decode(msg.encode())
+    assert decoded.trace_context == malformed  # codec is faithful...
+    assert trace.parse_wire_context(decoded.trace_context) is None  # ...parse is tolerant
+
+
+def test_trace_context_non_string_parses_to_none():
+    from gpumounter_tpu.obs import trace
+
+    for bad in (None, 7, b"aa" * 16, ["x"], {"trace": "y"}):
+        assert trace.parse_wire_context(bad) is None
