@@ -52,15 +52,57 @@ class SlavePodError(RuntimeError):
     pass
 
 
+def base_slave_manifest(cfg, name: str, node_name: str, tpu_num: int,
+                        labels: dict, annotations: dict | None = None,
+                        ) -> dict:
+    """Shared placeholder-pod body: the allocator's cold slaves and the
+    warm pool's holders differ only in name/labels/ownership, so the
+    spec (image, sleep loop, TPU request, node pin, tolerations) lives
+    once — a future spec change (runtime class, new toleration) cannot
+    drift between the two."""
+    meta: dict = {"name": name, "namespace": cfg.pool_namespace,
+                  "labels": labels}
+    if annotations:
+        meta["annotations"] = annotations
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {
+            "nodeSelector": {"kubernetes.io/hostname": node_name},
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "placeholder",
+                "image": cfg.slave_pod_image,
+                "command": ["sleep", "infinity"],
+                "resources": {
+                    "limits": {cfg.tpu_resource_name: str(tpu_num)},
+                    "requests": {cfg.tpu_resource_name: str(tpu_num)},
+                },
+            }],
+            # Never restarted, never evicted for priority: plain pod.
+            "tolerations": [{"key": "google.com/tpu",
+                             "operator": "Exists",
+                             "effect": "NoSchedule"}],
+        },
+    }
+
+
 class InsufficientTpuError(SlavePodError):
     """Scheduler cannot place the slave pods: not enough free chips."""
 
 
 class TpuAllocator:
-    def __init__(self, kube: KubeClient, collector: TpuCollector, cfg=None):
+    def __init__(self, kube: KubeClient, collector: TpuCollector, cfg=None,
+                 pool=None):
+        """pool: optional allocator.pool.WarmPodPool — single-chip
+        allocations then adopt pre-scheduled warm holders (a label
+        patch) instead of paying create + schedule + wait; whatever the
+        pool cannot cover falls through to the cold path below."""
         self.kube = kube
         self.collector = collector
         self.cfg = cfg or get_config()
+        self.pool = pool
         # Serializes slave-pod allocation on this node. Two concurrent
         # requests that together exceed capacity would otherwise both
         # create slaves, both observe Unschedulable, and both roll back
@@ -83,44 +125,18 @@ class TpuAllocator:
         # still hot-mounted. We instead record ownership in labels (used by
         # every ownership query) and reap orphans ourselves
         # (worker.reaper.SlaveReaper).
-        return {
-            "apiVersion": "v1",
-            "kind": "Pod",
-            "metadata": {
-                "name": name,
-                "namespace": self.cfg.pool_namespace,
-                # The UID label is the authoritative ownership key (UIDs
-                # are 36 chars, always label-legal); pod *names* can exceed
-                # the 63-char label-value cap, so full names live in
-                # annotations and the name labels are display-truncated.
-                "labels": {"app": "tpu-pool",
-                           "tpumounter.io/owner-uid": owner.uid,
-                           "tpumounter.io/owner": owner.name[:63],
-                           "tpumounter.io/owner-namespace":
-                               owner.namespace[:63]},
-                "annotations": {
-                    "tpumounter.io/owner": owner.name,
-                    "tpumounter.io/owner-namespace": owner.namespace,
-                },
-            },
-            "spec": {
-                "nodeSelector": {"kubernetes.io/hostname": owner.node_name},
-                "restartPolicy": "Never",
-                "containers": [{
-                    "name": "placeholder",
-                    "image": self.cfg.slave_pod_image,
-                    "command": ["sleep", "infinity"],
-                    "resources": {
-                        "limits": {self.cfg.tpu_resource_name: str(tpu_num)},
-                        "requests": {self.cfg.tpu_resource_name: str(tpu_num)},
-                    },
-                }],
-                # Never restarted, never evicted for priority: plain pod.
-                "tolerations": [{"key": "google.com/tpu",
-                                 "operator": "Exists",
-                                 "effect": "NoSchedule"}],
-            },
-        }
+        # The UID label is the authoritative ownership key (UIDs are 36
+        # chars, always label-legal); pod *names* can exceed the 63-char
+        # label-value cap, so full names live in annotations and the
+        # name labels are display-truncated.
+        return base_slave_manifest(
+            self.cfg, name, owner.node_name, tpu_num,
+            labels={"app": "tpu-pool",
+                    "tpumounter.io/owner-uid": owner.uid,
+                    "tpumounter.io/owner": owner.name[:63],
+                    "tpumounter.io/owner-namespace": owner.namespace[:63]},
+            annotations={"tpumounter.io/owner": owner.name,
+                         "tpumounter.io/owner-namespace": owner.namespace})
 
     # --- allocation (reference: GetAvailableGPU, allocator.go:40-96) ---
 
@@ -162,14 +178,26 @@ class TpuAllocator:
     def _allocate_locked(self, owner: Pod, total_tpu_num: int,
                          tpu_num_per_pod: int,
                          n_pods: int) -> tuple[list[TpuDevice], list[str]]:
-        created: list[str] = []
+        # Warm fast path: adopt pre-scheduled holders first (single-chip
+        # slaves only — an entire-mount needs one pod holding all chips,
+        # which the pool does not stock). Adopted pods are already
+        # Running, so only the cold remainder pays the schedule wait.
+        adopted: list[str] = []
+        if self.pool is not None and tpu_num_per_pod == 1:
+            adopted = self.pool.acquire(owner, n_pods)
+        created: list[str] = list(adopted)
         try:
-            for _ in range(n_pods):
+            cold: list[str] = []
+            for _ in range(n_pods - len(adopted)):
                 manifest = self._slave_pod_manifest(owner, tpu_num_per_pod)
                 pod = self.kube.create_pod(self.cfg.pool_namespace, manifest)
-                created.append(Pod(pod).name)
-            self._wait_all_running(created)
+                cold.append(Pod(pod).name)
+                created.append(cold[-1])
+            self._wait_all_running(cold)
         except Exception:
+            # Adopted holders roll back too: they carry owner labels now,
+            # and deleting them frees their chips back to the scheduler
+            # (the pool refills with fresh holders asynchronously).
             self._rollback(created)
             raise
         devices: list[TpuDevice] = []

@@ -1,0 +1,388 @@
+"""Warm slave-pod pool: pre-scheduled holder pods, adopted on mount.
+
+The dominant cost of the reference mount path is pure control plane:
+every GetAvailableGPU creates slave pods and then waits for the
+scheduler to place them (allocator.go:40-96 — create, busy-poll phase).
+BENCH_e2e_real shows the kernel half of a mount at ~1-4 ms, so on a
+quiet cluster the schedule-and-wait IS the mount latency. Elastic
+resource managers solve this with standby capacity (the warm-pool /
+hedging patterns in PAPERS.md — Singularity's standby nodes, Tail at
+Scale's request hedging): pay for a little idle capacity, keep the
+critical path free of the scheduler.
+
+Here: the pool keeps `warm_pool_size` single-chip holder pods Running
+per node (label `app=tpu-pool, tpumounter.io/warm=true`, no owner).
+Adoption is a merge-patch that stamps the owner labels/annotations and
+drops the warm marker — Kubernetes pods cannot be renamed, so identity
+stays with the warm pod's name and ownership moves by label exactly as
+it does for cold-created slaves (the allocator's ownership queries are
+label-driven, allocator.slave_pods_for). Refill runs on ONE background
+thread off the critical path; a drained pool degrades gracefully to the
+cold create-and-wait path.
+
+Lifecycle safety:
+  * adoption is serialized by the pool lock, so two concurrent mounts
+    can never adopt the same holder (no double-adopt);
+  * a refill whose pod never reaches Running deletes that pod before
+    backing off — failed refills do not strand holder pods;
+  * `ensure_node` re-adopts Running warm pods left by a previous worker
+    process (restart continuity) and deletes non-Running strays;
+  * warm pods carry no owner labels, so the SlaveReaper's orphan sweep
+    ignores them (worker/reaper.py: "not ours / hand-made pod").
+
+Failpoint sites (gpumounter_tpu/faults):
+  pool.refill   fired before each refill pod create (ctx: node) —
+                inject errors/delays to prove refill failures are
+                contained off the mount path.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("allocator.pool")
+
+WARM_LABEL = "tpumounter.io/warm"
+WARM_SELECTOR = f"app=tpu-pool,{WARM_LABEL}=true"
+
+WARM_POOL_HITS = REGISTRY.counter(
+    "tpumounter_warm_pool_hits_total",
+    "Chips served by adopting a pre-scheduled warm holder pod")
+WARM_POOL_MISSES = REGISTRY.counter(
+    "tpumounter_warm_pool_misses_total",
+    "Chips that fell back to the cold create-and-wait slave-pod path")
+WARM_POOL_READY = REGISTRY.gauge(
+    "tpumounter_warm_pool_ready",
+    "Warm holder pods Running and adoptable, by node")
+WARM_POOL_REFILLS = REGISTRY.counter(
+    "tpumounter_warm_pool_refills_total",
+    "Warm holder pods successfully refilled into the pool")
+WARM_POOL_REFILL_FAILURES = REGISTRY.counter(
+    "tpumounter_warm_pool_refill_failures_total",
+    "Refill attempts that failed (pod deleted, node backed off)")
+
+
+class WarmPodPool:
+    def __init__(self, kube: KubeClient, cfg=None,
+                 refill_async: bool = True):
+        """refill_async=False disables the background refiller entirely:
+        nothing refills unless the caller invokes refill_once() —
+        deterministic mode for tests that must not race a thread. The
+        daemons use the default background refiller, which keeps refills
+        off the mount critical path."""
+        self.kube = kube
+        self.cfg = cfg or get_config()
+        self.size = max(0, int(self.cfg.warm_pool_size))
+        self.refill_async = refill_async
+        self._lock = threading.Lock()
+        self._ready: dict[str, list[str]] = {}     # node -> holder names
+        self._pending: dict[str, int] = {}         # node -> creates in flight
+        self._backoff_until: dict[str, float] = {}  # node -> monotonic stamp
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if self.enabled and self.cfg.node_name:
+            self.ensure_node(self.cfg.node_name)
+
+    @property
+    def enabled(self) -> bool:
+        return self.size > 0
+
+    # --- lifecycle ---
+
+    def stop(self) -> None:
+        """Stop the refiller. Warm pods are left Running on purpose: a
+        restarted worker re-adopts them via ensure_node's resync."""
+        self._stop.set()
+        self._wake.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def _kick(self) -> None:
+        if not self.enabled or self._stop.is_set():
+            return
+        if not self.refill_async:
+            return  # deterministic mode: tests call refill_once()
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._refill_loop, name="warm-pool-refill",
+                    daemon=True)
+                self._thread.start()
+        self._wake.set()
+
+    # --- registration / resync ---
+
+    def ensure_node(self, node_name: str) -> None:
+        """Register a node with the pool (idempotent). First sight of a
+        node resyncs from the API server: Running warm pods from a
+        previous worker process are re-adopted into the ready list,
+        non-Running strays (a refill that died mid-wait) are deleted."""
+        if not self.enabled or not node_name:
+            return
+        with self._lock:
+            if node_name in self._ready:
+                return
+            self._ready[node_name] = []
+            self._pending.setdefault(node_name, 0)
+        self._resync(node_name)
+        self._kick()
+
+    def _resync(self, node_name: str) -> None:
+        try:
+            pods = self.kube.list_pods(self.cfg.pool_namespace,
+                                       label_selector=WARM_SELECTOR)
+        except Exception as exc:  # noqa: BLE001 — resync is best-effort
+            logger.warning("warm-pool resync list failed: %s", exc)
+            return
+        readopted, strays = [], []
+        for pod_json in pods:
+            p = Pod(pod_json)
+            # Membership is by placement AND by target: an unscheduled
+            # holder belongs to the node its manifest pins (another
+            # worker's refill mid-wait must not be reaped as a stray
+            # just because its nodeName is still empty).
+            selector = (pod_json.get("spec", {}).get("nodeSelector")
+                        or {}).get("kubernetes.io/hostname", "")
+            if p.node_name:
+                if p.node_name != node_name:
+                    continue
+            elif selector != node_name:
+                continue
+            if p.phase == "Running":
+                readopted.append(p.name)
+            else:
+                strays.append(p.name)
+        for name in strays:
+            try:
+                self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                     grace_period_seconds=0)
+                logger.info("warm-pool: deleted stray holder %s "
+                            "(phase never reached Running)", name)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("warm-pool stray delete %s failed: %s",
+                               name, exc)
+        if readopted:
+            with self._lock:
+                bucket = self._ready.setdefault(node_name, [])
+                bucket.extend(n for n in readopted if n not in bucket)
+                WARM_POOL_READY.set(float(len(bucket)), node=node_name)
+            logger.info("warm-pool: re-adopted %d Running holder(s) on %s",
+                        len(readopted), node_name)
+
+    # --- adoption (the mount critical path) ---
+
+    def ready_count(self, node_name: str) -> int:
+        with self._lock:
+            return len(self._ready.get(node_name, []))
+
+    def wait_ready(self, node_name: str, count: int | None = None,
+                   timeout_s: float = 10.0) -> bool:
+        """Block until `count` (default: pool size) holders are ready on
+        the node. Test/bench helper — production callers never wait on
+        the pool; they fall through to the cold path."""
+        want = self.size if count is None else count
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.ready_count(node_name) >= want:
+                return True
+            self._wake.set()
+            time.sleep(0.02)
+        return self.ready_count(node_name) >= want
+
+    def acquire(self, owner: Pod, count: int) -> list[str]:
+        """Adopt up to `count` warm holders on the owner's node; returns
+        the adopted (now owner-labeled) slave-pod names. Never blocks on
+        the scheduler: whatever is not ready is the caller's cold-path
+        remainder (recorded as misses)."""
+        if not self.enabled or count <= 0:
+            return []
+        node = owner.node_name
+        self.ensure_node(node)
+        adopted: list[str] = []
+        while len(adopted) < count:
+            with self._lock:
+                bucket = self._ready.get(node, [])
+                name = bucket.pop(0) if bucket else None
+                if name is not None:
+                    WARM_POOL_READY.set(float(len(bucket)), node=node)
+            if name is None:
+                break
+            if self._adopt(name, owner):
+                adopted.append(name)
+        if adopted:
+            WARM_POOL_HITS.inc(float(len(adopted)))
+            logger.info("warm-pool: adopted %d holder(s) for %s/%s: %s",
+                        len(adopted), owner.namespace, owner.name, adopted)
+        missed = count - len(adopted)
+        if missed:
+            WARM_POOL_MISSES.inc(float(missed))
+        self._kick()  # replace what we consumed, off the critical path
+        return adopted
+
+    def _adopt(self, name: str, owner: Pod) -> bool:
+        """Stamp ownership on one pooled holder. The pod was popped from
+        the ready list under the lock, so no concurrent mount can reach
+        it; the patch is the durable half of the handoff."""
+        patch = {"metadata": {
+            "labels": {WARM_LABEL: None,
+                       "tpumounter.io/owner-uid": owner.uid,
+                       "tpumounter.io/owner": owner.name[:63],
+                       "tpumounter.io/owner-namespace": owner.namespace[:63]},
+            "annotations": {"tpumounter.io/owner": owner.name,
+                            "tpumounter.io/owner-namespace": owner.namespace},
+        }}
+        try:
+            patched = Pod(self.kube.patch_pod(self.cfg.pool_namespace,
+                                              name, patch))
+        except NotFoundError:
+            logger.warning("warm holder %s vanished before adoption", name)
+            return False
+        except Exception as exc:  # noqa: BLE001 — adoption is best-effort
+            # The holder is already popped from the ready list; leaving
+            # it Running-but-untracked would book a chip forever (the
+            # reaper skips ownerless pods). Delete it — the refiller
+            # replaces it — and fall through to the cold path.
+            logger.warning("warm holder %s adoption patch failed (%s); "
+                           "deleting it to free its chip", name, exc)
+            try:
+                self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                     grace_period_seconds=0)
+            except Exception as del_exc:  # noqa: BLE001
+                logger.error("stranded warm holder %s could not be "
+                             "deleted (%s); it books a chip until the "
+                             "next resync", name, del_exc)
+            return False
+        if patched.phase != "Running":
+            # Died while pooled: delete so its booking frees; the refill
+            # replaces it.
+            logger.warning("warm holder %s no longer Running (%s); "
+                           "discarding", name, patched.phase)
+            try:
+                self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                     grace_period_seconds=0)
+            except Exception:  # noqa: BLE001
+                pass
+            return False
+        return True
+
+    # --- refill (background; never on the mount path) ---
+
+    def _warm_manifest(self, node_name: str) -> dict:
+        from gpumounter_tpu.allocator.allocator import base_slave_manifest
+        return base_slave_manifest(
+            self.cfg, f"warm-slave-{secrets.token_hex(4)}", node_name,
+            tpu_num=1, labels={"app": "tpu-pool", WARM_LABEL: "true"})
+
+    def _refill_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.refill_once()
+            except Exception as exc:  # noqa: BLE001 — keep the loop alive
+                logger.warning("warm-pool refill pass failed: %s", exc)
+
+    def refill_once(self) -> int:
+        """One refill pass over every registered node; returns holders
+        added. Public so tests and the sync mode can drive it."""
+        added = 0
+        with self._lock:
+            nodes = list(self._ready)
+        for node in nodes:
+            with self._lock:
+                if time.monotonic() < self._backoff_until.get(node, 0.0):
+                    continue
+                gap = (self.size - len(self._ready.get(node, []))
+                       - self._pending.get(node, 0))
+                if gap <= 0:
+                    continue
+                self._pending[node] = self._pending.get(node, 0) + gap
+            try:
+                added += self._refill_node(node, gap)
+            finally:
+                with self._lock:
+                    self._pending[node] = max(
+                        0, self._pending.get(node, 0) - gap)
+        return added
+
+    def _refill_node(self, node: str, gap: int) -> int:
+        """Create `gap` holders, then wait for Running concurrently (the
+        creates already schedule concurrently). Any holder that fails to
+        reach Running is deleted — never stranded — and the node backs
+        off so a full node is not hammered with doomed creates."""
+        created: list[str] = []
+        for _ in range(gap):
+            try:
+                failpoints.fire("pool.refill", node=node)
+                pod = self.kube.create_pod(self.cfg.pool_namespace,
+                                           self._warm_manifest(node))
+                created.append(Pod(pod).name)
+            except Exception as exc:  # noqa: BLE001 — refill is best-effort
+                logger.warning("warm-pool refill create on %s failed: %s",
+                               node, exc)
+                WARM_POOL_REFILL_FAILURES.inc()
+                self._backoff(node)
+                break
+        if not created:
+            return 0
+        # Sequential waits under ONE shared deadline: the creates above
+        # already schedule concurrently, so once the first holder is
+        # Running the rest usually answer instantly — no thread-per-wait
+        # churn (and no per-thread keep-alive TLS connection abandoned
+        # at thread death).
+        outcomes: dict[str, bool] = {}
+        deadline = time.monotonic() + self.cfg.slave_pod_timeout_s
+        for name in created:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                outcomes[name] = False
+                continue
+            try:
+                result = self.kube.wait_for_pod(
+                    self.cfg.pool_namespace, name,
+                    lambda pj: pj is not None and Pod(pj).phase == "Running",
+                    timeout_s=remaining)
+                outcomes[name] = result is not None
+            except Exception:  # noqa: BLE001
+                outcomes[name] = False
+        added = 0
+        for name in created:
+            if outcomes.get(name):
+                with self._lock:
+                    bucket = self._ready.setdefault(node, [])
+                    bucket.append(name)
+                    WARM_POOL_READY.set(float(len(bucket)), node=node)
+                WARM_POOL_REFILLS.inc()
+                added += 1
+            else:
+                WARM_POOL_REFILL_FAILURES.inc()
+                try:
+                    self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                         grace_period_seconds=0)
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning("warm-pool cleanup of %s failed "
+                                   "(reaper-invisible; retried next "
+                                   "resync): %s", name, exc)
+                self._backoff(node)
+        if added:
+            logger.info("warm-pool: refilled %d holder(s) on %s",
+                        added, node)
+        return added
+
+    def _backoff(self, node: str) -> None:
+        with self._lock:
+            self._backoff_until[node] = (time.monotonic()
+                                         + self.cfg.warm_pool_retry_s)

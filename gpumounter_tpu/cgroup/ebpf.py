@@ -570,6 +570,40 @@ class V2DeviceController:
         with self._mu:
             self._grant_locked(cgroup_dir, dev, base_rules)
 
+    def grant_many(self, cgroup_dir: str, devs: list[TpuDevice],
+                   base_rules: list[DeviceRule] | None = None) -> None:
+        """Grant a batch of chips with ONE program swap.
+
+        The replacement program carries the full rule set anyway, so N
+        chips cost the same bpf(BPF_PROG_LOAD)+attach cycle as one —
+        the worker's batch mount path (mounter.mount_many) uses this
+        instead of N swap cycles. All-or-nothing: a failed swap restores
+        the tracked rule set exactly (no chip from the batch granted).
+        """
+        with self._mu:
+            st = self._get_state(cgroup_dir, base_rules)
+            priors = {}
+            for dev in devs:
+                key = (dev.major, dev.minor)
+                priors[key] = st.granted.get(key)
+                st.granted[key] = (device_rule(dev),) + tuple(
+                    DeviceRule("c", comp.major, comp.minor, "rw")
+                    for comp in dev.companions)
+            try:
+                self._swap_program(st)
+            except BpfError:
+                for key, prior in priors.items():
+                    if prior is None:
+                        st.granted.pop(key, None)
+                    else:
+                        st.granted[key] = prior
+                if not st.granted and st.our_fd is None:
+                    self._close_state(cgroup_dir)
+                raise
+            self._persist(cgroup_dir, st)
+            logger.info("cgroup v2: granted %d chip rule(s) on %s in one "
+                        "program swap", len(devs), cgroup_dir)
+
     def _grant_locked(self, cgroup_dir: str, dev: TpuDevice,
                       base_rules: list[DeviceRule] | None = None) -> None:
         st = self._get_state(cgroup_dir, base_rules)

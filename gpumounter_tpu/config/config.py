@@ -67,6 +67,36 @@ class Config:
     slave_pod_timeout_s: float = field(default_factory=lambda: float(_env("SLAVE_POD_TIMEOUT_S", "120")))
     slave_pod_name_suffix: str = "-slave-pod-"
 
+    # --- mount fast path (warm pool / channel pool / parallel mount) ---
+    # This worker's node (downward-API spec.nodeName in the DaemonSet);
+    # when set, the warm pool pre-warms it at worker startup instead of
+    # waiting for the first mount request to discover the node.
+    node_name: str = field(default_factory=lambda: _env("NODE_NAME", ""))
+    # Warm slave-pod pool: this many pre-scheduled single-chip holder
+    # pods are kept Running per node so a mount adopts one (a label
+    # patch) instead of paying create + schedule + wait on the critical
+    # path. 0 disables the pool (cold create-and-wait, the reference
+    # behavior). NOTE each warm pod books one chip while idle — see
+    # docs/FAQ.md on the idle-quota cost.
+    warm_pool_size: int = field(default_factory=lambda: int(
+        _env("WARM_POOL_SIZE", "0")))
+    # Floor between refill attempts for a node whose last refill failed
+    # (typically capacity exhaustion): the pool must not hot-loop pod
+    # creates against a full node.
+    warm_pool_retry_s: float = field(default_factory=lambda: float(
+        _env("WARM_POOL_RETRY_S", "5")))
+    # Per-chip mount fan-out width: mknod/verify for a multi-chip mount
+    # runs on this many threads (1 = serial, the old behavior).
+    mount_concurrency: int = field(default_factory=lambda: int(
+        _env("MOUNT_CONCURRENCY", "4")))
+    # Master->worker channel pool: cached per-address gRPC channels with
+    # TCP keepalive. Idle channels are evicted after this long; the
+    # keepalive ping keeps NAT/conntrack state warm in between.
+    channel_idle_evict_s: float = field(default_factory=lambda: float(
+        _env("CHANNEL_IDLE_EVICT_S", "300")))
+    channel_keepalive_time_s: float = field(default_factory=lambda: float(
+        _env("CHANNEL_KEEPALIVE_TIME_S", "30")))
+
     # --- master-side request validation ---
     # Reference accepts any int32 gpuNum incl. 0/negative at L1
     # (cmd/GPUMounter-master/main.go:31-43 parses but never range-checks);

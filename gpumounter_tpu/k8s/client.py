@@ -21,6 +21,7 @@ import json
 import os
 import socket
 import ssl
+import threading
 import time
 import urllib.parse
 from collections.abc import Iterator
@@ -210,6 +211,11 @@ class KubeClient(abc.ABC):
 
 
 class RestKubeClient(KubeClient):
+    #: request exceptions that mean "the kept-alive connection went
+    #: stale under us" (apiserver idle close, LB reset, TLS teardown) —
+    #: safe to reconnect-and-resend once for idempotent methods.
+    _STALE_RETRY_METHODS = frozenset({"GET", "PUT", "DELETE", "PATCH"})
+
     def __init__(self, host: str, port: int, token: str,
                  ca_file: str | None = None, verify: bool = True):
         self.host = host
@@ -220,39 +226,112 @@ class RestKubeClient(KubeClient):
             self.ctx = ssl.create_default_context()
             self.ctx.check_hostname = False
             self.ctx.verify_mode = ssl.CERT_NONE
+        # One kept-alive connection per calling thread (http.client
+        # connections are not thread-safe; a lock would serialize every
+        # API call through one socket instead).
+        self._conn_local = threading.local()
 
     # --- low-level ---
+
+    def _connect(self, timeout: float = 30.0):
+        import http.client
+        return http.client.HTTPSConnection(self.host, self.port,
+                                           context=self.ctx,
+                                           timeout=timeout)
 
     def _request(self, method: str, path: str, query: dict | None = None,
                  body: dict | None = None, timeout: float = 30.0,
                  content_type: str = "application/json"):
-        import http.client
+        """Dedicated-connection request: the caller owns (conn, resp).
+        Used by the watch stream, whose connection outlives the call and
+        must never be shared with the pooled request path."""
         qs = ("?" + urllib.parse.urlencode(query)) if query else ""
-        conn = http.client.HTTPSConnection(self.host, self.port,
-                                           context=self.ctx, timeout=timeout)
+        conn = self._connect(timeout)
+        headers = self._headers(body, content_type)
+        payload = json.dumps(body) if body is not None else None
+        conn.request(method, path + qs, body=payload, headers=headers)
+        return conn, conn.getresponse()
+
+    def _headers(self, body, content_type: str) -> dict:
         headers = {
             "Authorization": f"Bearer {self.token}",
             "Accept": "application/json",
         }
-        payload = None
         if body is not None:
-            payload = json.dumps(body)
             headers["Content-Type"] = content_type
-        conn.request(method, path + qs, body=payload, headers=headers)
-        return conn, conn.getresponse()
+        return headers
+
+    def _stale_exceptions(self) -> tuple:
+        import http.client
+        return (http.client.NotConnected, http.client.CannotSendRequest,
+                http.client.BadStatusLine, http.client.ImproperConnectionState,
+                ConnectionError, BrokenPipeError, ssl.SSLEOFError)
+
+    def _drop_pooled(self) -> None:
+        conn = getattr(self._conn_local, "conn", None)
+        self._conn_local.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — teardown of a dead socket
+                pass
 
     def _json(self, method: str, path: str, query: dict | None = None,
               body: dict | None = None,
               content_type: str = "application/json") -> dict:
-        conn, resp = self._request(method, path, query, body,
-                                   content_type=content_type)
-        try:
-            data = resp.read().decode("utf-8", "replace")
+        """Keep-alive request: reuses this thread's cached connection
+        (one TCP+TLS handshake per thread, not per API call — the
+        reference-era shape dialed fresh for every GET/POST, a SURVEY §3
+        control-plane tax). A connection gone stale mid-reuse is rebuilt
+        and the request re-sent once — but only for idempotent methods;
+        a POST whose first send may have landed must surface the error
+        (its callers' retry layers own that decision)."""
+        qs = ("?" + urllib.parse.urlencode(query)) if query else ""
+        headers = self._headers(body, content_type)
+        payload = json.dumps(body) if body is not None else None
+        stale_excs = self._stale_exceptions()
+        last_exc: Exception | None = None
+        for attempt in (1, 2):
+            conn = getattr(self._conn_local, "conn", None)
+            fresh = conn is None
+            if fresh:
+                conn = self._connect()
+                self._conn_local.conn = conn
+            sent = False
+            try:
+                conn.request(method, path + qs, body=payload,
+                             headers=headers)
+                sent = True
+                resp = conn.getresponse()
+            except stale_excs as exc:
+                self._drop_pooled()
+                last_exc = exc
+                # Send-phase failure: the request never reached the
+                # server, so resending is safe for ANY method (POST
+                # included). Response-phase failure is ambiguous — the
+                # server may have processed the request — so only
+                # idempotent methods retry there. A brand-new connection
+                # failing is a real error either way, not staleness.
+                retriable = (not sent
+                             or method in self._STALE_RETRY_METHODS)
+                if fresh or not retriable or attempt == 2:
+                    raise
+                logger.debug("kept-alive connection stale (%s); "
+                             "reconnecting", exc)
+                continue
+            except Exception:
+                self._drop_pooled()
+                raise
+            try:
+                data = resp.read().decode("utf-8", "replace")
+            except Exception:
+                # Half-read responses poison connection reuse.
+                self._drop_pooled()
+                raise
             if resp.status >= 400:
                 _raise_for(resp.status, data)
             return json.loads(data) if data else {}
-        finally:
-            conn.close()
+        raise last_exc  # pragma: no cover — loop always returns/raises
 
     # --- pods ---
 

@@ -10,6 +10,7 @@ config 4).
 from __future__ import annotations
 
 import copy
+import heapq
 import itertools
 import threading
 import time
@@ -23,6 +24,9 @@ from gpumounter_tpu.k8s.client import (
     inject_write_fault,
 )
 from gpumounter_tpu.k8s.types import Pod, match_label_selector
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("k8s.fake")
 
 SchedulerHook = Callable[[dict], None]
 """Called (with the stored pod dict, mutable) right after create_pod.
@@ -76,6 +80,15 @@ class FakeKubeClient(KubeClient):
         self.create_calls = 0
         self.delete_calls = 0
         self.events_posted: list[tuple[str, dict]] = []
+        # Single-worker async scheduler: created pods enqueue a due-time
+        # into this heap and ONE thread drains it (created lazily,
+        # retires when idle). The previous shape spawned a daemon thread
+        # per pod — a 64-pod warm-pool refill meant 64 threads churning
+        # in every test process.
+        self._sched_cv = threading.Condition()
+        self._sched_q: list[tuple[float, int, str, str]] = []
+        self._sched_seq = itertools.count(1)
+        self._sched_thread: threading.Thread | None = None
 
     # --- event plumbing ---
 
@@ -113,21 +126,56 @@ class FakeKubeClient(KubeClient):
             self.create_calls += 1
         self._emit("ADDED", pod)
         if self.scheduler_hook is not None:
-            def _schedule():
-                if self.scheduler_delay_s:
-                    time.sleep(self.scheduler_delay_s)
-                # Mutate the stored pod under the store lock: concurrent
-                # get/list/watch deepcopy the store and must never observe
-                # a half-written status. (Condition() wraps an RLock, so
-                # _emit's re-acquisition inside is fine.)
+            self._enqueue_schedule(namespace, name)
+        return copy.deepcopy(pod)
+
+    # --- the single-worker async scheduler ---
+
+    def _enqueue_schedule(self, namespace: str, name: str) -> None:
+        due = time.monotonic() + self.scheduler_delay_s
+        with self._sched_cv:
+            heapq.heappush(self._sched_q,
+                           (due, next(self._sched_seq), namespace, name))
+            if self._sched_thread is None:
+                self._sched_thread = threading.Thread(
+                    target=self._sched_loop, name="fake-scheduler",
+                    daemon=True)
+                self._sched_thread.start()
+            self._sched_cv.notify()
+
+    def _sched_loop(self) -> None:
+        """Drain the due-time heap. Concurrent creates still schedule
+        concurrently — their due times all start the same delay apart
+        from now, and the heap fires each when due — but on one thread.
+        Retires after a short idle linger; the next create restarts it."""
+        while True:
+            with self._sched_cv:
+                if not self._sched_q:
+                    self._sched_cv.wait(timeout=0.05)
+                    if not self._sched_q:
+                        self._sched_thread = None
+                        return
+                due, _, namespace, name = self._sched_q[0]
+                now = time.monotonic()
+                if due > now:
+                    self._sched_cv.wait(timeout=due - now)
+                    continue
+                heapq.heappop(self._sched_q)
+            # Mutate the stored pod under the store lock: concurrent
+            # get/list/watch deepcopy the store and must never observe
+            # a half-written status. (Condition() wraps an RLock, so
+            # _emit's re-acquisition inside is fine.)
+            try:
                 with self._lock:
                     stored = self._pods.get((namespace, name))
                     if stored is None:
-                        return
+                        continue
                     self.scheduler_hook(stored)
                     self._emit("MODIFIED", stored)
-            threading.Thread(target=_schedule, daemon=True).start()
-        return copy.deepcopy(pod)
+            except Exception:  # noqa: BLE001 — a bad hook must not
+                # take the shared scheduler down with it
+                logger.exception("scheduler hook failed for %s/%s",
+                                 namespace, name)
 
     def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
         try:

@@ -67,6 +67,16 @@ class WorkerRegistry:
         self.breaker = CircuitBreaker(
             failure_threshold=self.cfg.breaker_failure_threshold,
             reset_s=self.cfg.breaker_reset_s)
+        # Shared per-address channel pool (rpc/client.py): every
+        # WorkerClient the master builds borrows its worker's cached
+        # channel instead of dialing fresh TCP per request. Kept honest
+        # by the same lifecycle that prunes the breaker, plus the
+        # breaker's open transition (a degraded worker's channel is
+        # dropped so recovery starts from a fresh dial).
+        from gpumounter_tpu.rpc.client import ChannelPool
+        self.channel_pool = ChannelPool(cfg=self.cfg)
+        self.breaker.on_open = (
+            lambda key: self.channel_pool.invalidate(key, "breaker-open"))
         # node name → (worker pod IP, worker pod name). The pod name makes
         # DELETED eviction exact even when the terminal event no longer
         # carries a podIP (names are unique per namespace at any instant).
@@ -99,6 +109,7 @@ class WorkerRegistry:
 
     def stop(self) -> None:
         self._stop.set()
+        self.channel_pool.close_all()
 
     # --- cache maintenance ---
 
@@ -122,9 +133,16 @@ class WorkerRegistry:
 
     def _apply(self, etype: str, pod: Pod) -> None:
         with self._lock:
+            old = self._cache.get(pod.node_name) if pod.node_name else None
             self._apply_to(self._cache, etype, pod)
+            new = self._cache.get(pod.node_name) if pod.node_name else None
             if self._journal is not None:  # a LIST is in flight: journal too
                 self._journal.append((etype, pod))
+        if old is not None and (new is None or new[0] != old[0]):
+            # The node's worker address changed or vanished: its cached
+            # channel must not serve one more RPC to the old IP.
+            self.channel_pool.invalidate(
+                f"{old[0]}:{self.cfg.worker_port}", "address-change")
         if etype == "DELETED":
             self._prune_breaker()
 
@@ -178,12 +196,14 @@ class WorkerRegistry:
 
     def _prune_breaker(self) -> None:
         """Evicted workers take their breaker state (and any standing
-        degraded gauge) with them — a replaced worker at a new IP must
-        not leave a permanently-open series for the dead address."""
+        degraded gauge) and their pooled channel with them — a replaced
+        worker at a new IP must not leave a permanently-open series or
+        a cached connection for the dead address."""
         with self._lock:
             active = {f"{ip}:{self.cfg.worker_port}"
                       for ip, _ in self._cache.values()}
         self.breaker.prune(active)
+        self.channel_pool.retain(active)
 
     # --- reads (cache-only; one rate-limited LIST on miss) ---
 
@@ -316,12 +336,15 @@ class MasterApp:
         self.kube = kube
         self.registry = registry or WorkerRegistry(kube, self.cfg)
         # The default worker client forwards the same per-deploy secret
-        # the worker's gRPC interceptor checks, and reports transport
-        # outcomes to the registry's shared per-worker circuit breaker.
+        # the worker's gRPC interceptor checks, reports transport
+        # outcomes to the registry's shared per-worker circuit breaker,
+        # and borrows the registry's pooled channel (no fresh TCP dial
+        # per request — SURVEY §3 control-plane hot path).
         self._client_factory = worker_client_factory or (
-            lambda addr: WorkerClient(addr, token=self._token, cfg=self.cfg,
-                                      breaker=self.registry.breaker,
-                                      breaker_key=addr))
+            lambda addr: WorkerClient(
+                addr, token=self._token, cfg=self.cfg,
+                breaker=self.registry.breaker, breaker_key=addr,
+                channel_pool=self.registry.channel_pool))
         # Elastic intent controller: constructed here so the routes and
         # the loop share one store/queue; the loop thread only runs after
         # an explicit elastic.start() (master/main.py — tests drive

@@ -84,8 +84,14 @@ def _mknod_at(target_path: str, major: int, minor: int,
         return
     os.makedirs(os.path.dirname(target_path), exist_ok=True)
     try:
-        os.mknod(target_path, DEVICE_FILE_MODE | statmod.S_IFCHR,
-                 os.makedev(major, minor))
+        try:
+            os.mknod(target_path, DEVICE_FILE_MODE | statmod.S_IFCHR,
+                     os.makedev(major, minor))
+        except FileExistsError:
+            # Idempotent under concurrency: two chips sharing a companion
+            # node (vfio container) may inject it in parallel from the
+            # batch-mount fan-out; the loser of the mknod race is fine.
+            return
         os.chmod(target_path, DEVICE_FILE_MODE)  # mknod mode is umask-masked
     except (OSError, PermissionError) as exc:
         # Unprivileged dry-run fallback, fake devices only: copying a real

@@ -23,6 +23,7 @@ Failpoint sites (gpumounter_tpu/faults):
 from __future__ import annotations
 
 import secrets
+import threading
 import time
 
 from gpumounter_tpu.faults import failpoints
@@ -38,8 +39,173 @@ from gpumounter_tpu.rpc.resilience import (
 )
 from gpumounter_tpu.utils.lazy_grpc import grpc
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("rpc.client")
+
+CHANNEL_POOL_HITS = REGISTRY.counter(
+    "tpumounter_channel_pool_hits_total",
+    "Worker RPCs served over an already-established pooled channel")
+CHANNEL_POOL_MISSES = REGISTRY.counter(
+    "tpumounter_channel_pool_misses_total",
+    "Pool lookups that had to dial a fresh channel")
+CHANNEL_POOL_EVICTIONS = REGISTRY.counter(
+    "tpumounter_channel_pool_evictions_total",
+    "Pooled channels closed, by reason (idle / invalidated / pruned / "
+    "shutdown)")
+CHANNEL_POOL_SIZE = REGISTRY.gauge(
+    "tpumounter_channel_pool_size",
+    "Live channels currently held by the pool")
+
+
+class ChannelPool:
+    """Per-address cached gRPC channels with keepalive + idle eviction.
+
+    The reference master dials a brand-new TCP connection for every RPC
+    (cmd/GPUMounter-master/main.go:82,185), paying connect + HTTP/2
+    handshake on the mount critical path each time; round 3 of this
+    build inherited that via `_client_factory` constructing a fresh
+    `WorkerClient` (and channel) per request. The pool makes the dial a
+    once-per-worker cost: every `WorkerClient` built with `channel_pool=`
+    borrows the shared channel and its `close()` only drops the
+    reference — the pool owns channel lifetime.
+
+    Invalidation keeps cached channels honest:
+      * `invalidate(address)` — wired to the circuit breaker's open
+        transition (a worker that just ate `failure_threshold` transport
+        errors gets a fresh dial when it comes back) and to registry
+        address changes (a replaced worker pod's old IP must not serve
+        one more RPC);
+      * `retain(active)` — registry churn sweep, same lifecycle as
+        CircuitBreaker.prune;
+      * idle eviction after `channel_idle_evict_s` on the lookup path.
+
+    Accounting (`stats()`) is exact — dialed == closed + live always —
+    so the chaos harness can assert no channel leaks (invariant 7).
+    """
+
+    def __init__(self, cfg=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.idle_evict_s = cfg.channel_idle_evict_s
+        self.keepalive_time_s = cfg.channel_keepalive_time_s
+        self._lock = threading.Lock()
+        #: address -> [channel, last_used_monotonic, borrowers]
+        self._channels: dict[str, list] = {}
+        self._dialed = 0
+        self._closed = 0
+        self._shutdown = False
+
+    # --- the borrow path ---
+
+    def channel(self, address: str):
+        now = time.monotonic()
+        to_close = []
+        try:
+            with self._lock:
+                if self._shutdown:
+                    raise RuntimeError("channel pool is shut down")
+                to_close = self._sweep_locked(now)
+                entry = self._channels.get(address)
+                if entry is not None:
+                    entry[1] = now
+                    entry[2] += 1
+                    CHANNEL_POOL_HITS.inc()
+                    return entry[0]
+                ch = grpc.insecure_channel(address, options=(
+                    ("grpc.keepalive_time_ms",
+                     int(self.keepalive_time_s * 1000)),
+                    ("grpc.keepalive_timeout_ms", 5000),
+                    ("grpc.keepalive_permit_without_calls", 1),
+                ))
+                self._channels[address] = [ch, now, 1]
+                self._dialed += 1
+                CHANNEL_POOL_MISSES.inc()
+                CHANNEL_POOL_SIZE.set(float(len(self._channels)))
+                return ch
+        finally:
+            self._close_channels(to_close, "idle")
+
+    def release(self, address: str) -> None:
+        """A borrower (WorkerClient.close) is done with the channel: it
+        stays pooled, but the idle clock restarts now and the in-use
+        guard drops. No-op if the entry was invalidated meanwhile."""
+        with self._lock:
+            entry = self._channels.get(address)
+            if entry is not None:
+                entry[1] = time.monotonic()
+                entry[2] = max(0, entry[2] - 1)
+
+    def _sweep_locked(self, now: float) -> list:
+        """Caller holds the lock; returns channels to close outside it.
+        In-use entries (live borrowers) are never idle-evicted — a slow
+        RPC on worker A must not have its transport closed because a
+        lookup for worker B happened to sweep."""
+        if self.idle_evict_s <= 0:
+            return []
+        stale = [addr for addr, (_, used, refs) in self._channels.items()
+                 if refs <= 0 and now - used > self.idle_evict_s]
+        out = [self._channels.pop(addr)[0] for addr in stale]
+        if out:
+            self._closed += len(out)
+            CHANNEL_POOL_SIZE.set(float(len(self._channels)))
+        return out
+
+    def _close_channels(self, channels: list, reason: str) -> None:
+        """Close channels already removed (and counted) under the lock."""
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception as exc:  # noqa: BLE001 — grpc teardown
+                logger.warning("pooled channel close failed: %s", exc)
+            CHANNEL_POOL_EVICTIONS.inc(reason=reason)
+
+    # --- invalidation ---
+
+    def invalidate(self, address: str, reason: str = "invalidated") -> None:
+        """Drop an address even if borrowed: the callers (breaker-open,
+        address change) know the transport is dead/wrong — an in-flight
+        RPC on it is failing anyway."""
+        with self._lock:
+            entry = self._channels.pop(address, None)
+            if entry is not None:
+                self._closed += 1
+            CHANNEL_POOL_SIZE.set(float(len(self._channels)))
+        if entry is not None:
+            logger.info("channel to %s invalidated (%s)", address, reason)
+            self._close_channels([entry[0]], reason)
+
+    def retain(self, active_addresses) -> None:
+        """Close every pooled channel whose address is not in the active
+        set (registry churn: replaced/deleted workers)."""
+        active = set(active_addresses)
+        with self._lock:
+            stale = [a for a in self._channels if a not in active]
+            out = [self._channels.pop(a)[0] for a in stale]
+            self._closed += len(out)
+            CHANNEL_POOL_SIZE.set(float(len(self._channels)))
+        self._close_channels(out, "pruned")
+
+    def close_all(self) -> None:
+        with self._lock:
+            out = [entry[0] for entry in self._channels.values()]
+            self._closed += len(out)
+            self._channels.clear()
+            self._shutdown = True
+            CHANNEL_POOL_SIZE.set(0.0)
+        self._close_channels(out, "shutdown")
+
+    # --- accounting (chaos invariant 7) ---
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._channels)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"live": len(self._channels), "dialed": self._dialed,
+                    "closed": self._closed}
 
 _TOKEN_FROM_CONFIG = object()  # sentinel: resolve from global config
 
@@ -59,7 +225,8 @@ class WorkerClient:
                  legacy: bool = False, token=_TOKEN_FROM_CONFIG,
                  cfg=None, retry: RetryPolicy | None = None,
                  breaker: CircuitBreaker | None = None,
-                 breaker_key: str | None = None):
+                 breaker_key: str | None = None,
+                 channel_pool: ChannelPool | None = None):
         """token: the worker's shared bearer secret (utils/auth.py).
         Default resolves TPUMOUNTER_AUTH_TOKEN[_FILE] from the global
         config; pass None to send no credentials (rejected by a worker
@@ -71,7 +238,12 @@ class WorkerClient:
 
         breaker/breaker_key: a shared CircuitBreaker (usually the
         WorkerRegistry's) and the key to report under; omitted = no
-        breaker participation (standalone/CLI use)."""
+        breaker participation (standalone/CLI use).
+
+        channel_pool: a shared ChannelPool — the client borrows the
+        pooled per-address channel (reused across requests, keepalive
+        on) and its close() only drops the reference; omitted = the
+        client dials and owns a private channel (old behavior)."""
         if cfg is None:
             from gpumounter_tpu.config import get_config
             cfg = get_config()
@@ -94,7 +266,13 @@ class WorkerClient:
         self.breaker = breaker
         self.breaker_key = breaker_key or address
         self._legacy = legacy
-        self._channel = grpc.insecure_channel(address)
+        self._pool = channel_pool
+        if channel_pool is not None:
+            self._channel = channel_pool.channel(address)
+            self._owns_channel = False
+        else:
+            self._channel = grpc.insecure_channel(address)
+            self._owns_channel = True
         add_service = api.ADD_SERVICE_LEGACY if legacy else api.ADD_SERVICE_TPU
         rem_service = (api.REMOVE_SERVICE_LEGACY if legacy
                        else api.REMOVE_SERVICE_TPU)
@@ -121,8 +299,14 @@ class WorkerClient:
 
     def close(self) -> None:
         channel, self._channel = self._channel, None
-        if channel is not None:  # idempotent: with-block + explicit close
+        if channel is None:  # idempotent: with-block + explicit close
+            return
+        if self._owns_channel:
             channel.close()
+        elif self._pool is not None:
+            # Pooled channels stay open — the pool owns their lifetime;
+            # release drops the in-use guard and restarts the idle clock.
+            self._pool.release(self.address)
 
     def __enter__(self):
         return self

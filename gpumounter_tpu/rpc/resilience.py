@@ -112,6 +112,12 @@ class CircuitBreaker:
         self._lock = threading.Lock()
         #: key -> [consecutive_failures, opened_at or None, probe_in_flight]
         self._entries: dict[str, list] = {}
+        #: called (outside the lock) with the key on every closed→open
+        #: and half-open→open transition. The WorkerRegistry wires this
+        #: to ChannelPool.invalidate so a degraded worker's cached
+        #: channel is dropped — when the worker recovers, the half-open
+        #: probe gets a fresh dial instead of a wedged connection.
+        self.on_open = None
 
     # --- views (non-mutating; the master's route pre-check) ---
 
@@ -186,12 +192,14 @@ class CircuitBreaker:
 
     def record_failure(self, key: str) -> None:
         tripped = False
+        reopened = False
         with self._lock:
             entry = self._entries.setdefault(key, [0, None, False])
             entry[0] += 1
             if entry[1] is not None:
                 # open/half-open: failure (the probe, or a racer) re-opens
                 # and restarts the reset clock.
+                reopened = entry[2]  # a half-open probe just failed
                 entry[1] = time.monotonic()
                 entry[2] = False
             elif entry[0] >= self.failure_threshold:
@@ -205,3 +213,10 @@ class CircuitBreaker:
                 self.failure_threshold, self.reset_s)
             BREAKER_TRIPS.inc(worker=key)
             BREAKER_OPEN.set(1.0, worker=key)
+        if tripped or reopened:
+            on_open = self.on_open
+            if on_open is not None:
+                try:
+                    on_open(key)
+                except Exception as exc:  # noqa: BLE001 — advisory hook
+                    logger.warning("breaker on_open hook failed: %s", exc)

@@ -18,7 +18,11 @@ invariants after convergence:
   6. every operation leaves a terminal audit record: each terminal
      migration journal has a matching audit record, and every audit
      record carries an outcome and a trace id (a crashed-and-resumed
-     operation must not vanish from the trail).
+     operation must not vanish from the trail),
+  7. no leaked channels: the shared ChannelPool's books stay exact —
+     dialed == live + closed, and the live set never exceeds the
+     worker count (a WorkerClient that closed a pooled channel, or a
+     pool that lost one, breaks the identity).
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -43,7 +47,7 @@ from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
-from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.rpc.client import ChannelPool, WorkerClient
 from gpumounter_tpu.testing.cluster import FakeCluster
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
@@ -116,6 +120,10 @@ class ChaosHarness:
         self.services: dict[str, TpuMountService] = {}
         self._servers = []
         self._port_by_ip: dict[str, int] = {}
+        # Pooled channels, like the production master: the harness's
+        # invariant 7 asserts the pool's books stay exact under chaos
+        # (every dialed channel either live in the cache or closed).
+        self.channel_pool = ChannelPool(cfg=self.cfg)
         #: (namespace, pod) -> node, for every target pod we created
         self.pods: dict[tuple[str, str], str] = {}
         self.app: MasterApp | None = None
@@ -169,7 +177,8 @@ class ChaosHarness:
         def client_factory(address: str):
             ip = address.rsplit(":", 1)[0]
             return WorkerClient(f"localhost:{self._port_by_ip[ip]}",
-                                cfg=self.cfg)
+                                cfg=self.cfg,
+                                channel_pool=self.channel_pool)
 
         self.app = MasterApp(self.cluster.kube, cfg=self.cfg,
                              worker_client_factory=client_factory,
@@ -183,6 +192,7 @@ class ChaosHarness:
             self.app.elastic.stop()
             self.app.migrations.stop()
             self.app.registry.stop()
+        self.channel_pool.close_all()
         for server in self._servers:
             server.stop(grace=None)
         self.cluster.stop()
@@ -505,6 +515,17 @@ class ChaosHarness:
                 violations.append(
                     f"audit record without trace id: seq={rec['seq']} "
                     f"op={rec['operation']} pod={rec['pod']}")
+
+        # 7. no leaked channels: exact pool accounting under chaos.
+        stats = self.channel_pool.stats()
+        if stats["dialed"] != stats["live"] + stats["closed"]:
+            violations.append(
+                f"channel-pool books off: dialed={stats['dialed']} != "
+                f"live={stats['live']} + closed={stats['closed']}")
+        if stats["live"] > len(self._port_by_ip):
+            violations.append(
+                f"channel leak: {stats['live']} live channel(s) for "
+                f"{len(self._port_by_ip)} worker(s)")
 
         if violations:
             tail = "\n  ".join(self.schedule[-25:])

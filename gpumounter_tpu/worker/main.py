@@ -149,6 +149,10 @@ def main() -> None:
     signal.signal(signal.SIGINT, _term)
     stop.wait()
     reaper.stop()
+    if service.pool is not None:
+        # Warm holders stay Running — the restarted worker re-adopts
+        # them (pool.ensure_node resync); only the refiller stops.
+        service.pool.stop()
     server.stop(grace=5).wait()
     ops.shutdown()
 

@@ -22,6 +22,9 @@ TPU-native deltas (SURVEY.md §7):
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent import futures
 from dataclasses import dataclass, field
 
 from gpumounter_tpu.allocator.allocator import MountType
@@ -222,77 +225,217 @@ class TpuMounter:
     def mount(self, target: MountTarget, dev: TpuDevice,
               base_rules: list[DeviceRule] | None = None) -> dict:
         """Grant + inject one chip. Returns phase timings (ms)."""
+        return self.mount_many(target, [dev], base_rules=base_rules)
+
+    def mount_many(self, target: MountTarget, devices: list[TpuDevice],
+                   base_rules: list[DeviceRule] | None = None) -> dict:
+        """Grant + inject a batch of chips, all-or-nothing.
+
+        The reference mounts serially, one full grant+mknod round trip
+        per chip (server.go:74-79 calling util.go:17-71 in a loop). Here
+        the batch pays ONE cgroup-grant phase (a single eBPF program
+        swap on v2 carrying every chip's rule — grant_many — instead of
+        N swap cycles) and then fans mknod+verify out across
+        `cfg.mount_concurrency` threads. Any failure rolls the whole
+        batch back: every granted rule revoked, every injected node
+        removed — callers never see a half-mounted batch.
+
+        Returns phase timings (ms). Phase/span names match the serial
+        path (mount.cgroup_grant, mount.mknod per chip, mount.rollback)
+        so `tpumounter trace` shows the same story, just wider.
+        """
+        if not devices:
+            return {}
         timer = PhaseTimer()
-        granted: list[str] = []
+        granted: list[tuple[str, TpuDevice]] = []
+        injected: list[TpuDevice] = []
+        uuids = ",".join(d.uuid for d in devices)
         try:
             # Crash sites bracketing the grant: a worker dying here leaves
-            # either nothing (before) or a grant with no injected node
+            # either nothing (before) or grants with no injected nodes
             # (after) — the states the chaos harness drives convergence
-            # through (the prober reports the half-mounted chip unhealthy
-            # and the reconciler heals it).
-            failpoints.fire("worker.mount.before_grant", device=dev.uuid,
+            # through (the prober reports half-mounted chips unhealthy
+            # and the reconciler heals them).
+            failpoints.fire("worker.mount.before_grant", device=uuids,
                             target=target.description)
             with timer.phase("cgroup_grant"), \
-                    trace.span("mount.cgroup_grant", device=dev.uuid,
+                    trace.span("mount.cgroup_grant", device=uuids,
+                               chips=len(devices),
                                target=target.description):
-                if target.cgroup_dirs and self.cgroup_version == 2:
-                    # The controller captures base rules only at FIRST
-                    # grant per cgroup; skip the /dev walk (a /proc tree
-                    # scan) when every target cgroup is already tracked —
-                    # an entire-mount calls mount() once per chip.
-                    has_state = getattr(self.controller, "has_state",
-                                        lambda cg: False)
-                    if not all(has_state(cg) for cg in target.cgroup_dirs):
-                        base_rules = self._v2_base_rules(target, base_rules)
-                for cg in target.cgroup_dirs:
-                    if self.cgroup_version == 2:
-                        self.controller.grant(cg, dev, base_rules=base_rules)
-                    else:
-                        self.controller.grant(cg, dev)
-                    granted.append(cg)
-            failpoints.fire("worker.mount.after_grant", device=dev.uuid,
+                self._grant_batch(target, devices, base_rules, granted)
+            failpoints.fire("worker.mount.after_grant", device=uuids,
                             target=target.description)
-            with timer.phase("device_inject"), \
-                    trace.span("mount.mknod", device=dev.uuid,
-                               target=target.description):
-                failpoints.fire("worker.mount.mknod", device=dev.uuid,
-                                target=target.description)
-                nsutil.inject_device_file(target.dev_dir, dev,
-                                          pid=target.ns_pid)
+            with timer.phase("device_inject"):
+                self._inject_batch(target, devices, injected)
         except CrashError:
             # Simulated process death: a real crash gets no undo pass —
             # re-raise before the rollback below so the chaos harness
             # exercises the leaked-grant recovery path for real.
-            MOUNT_TOTAL.inc(result="error")
+            MOUNT_TOTAL.inc(float(len(devices)), result="error")
             raise
         except Exception as exc:
-            # Undo partial grants: without this, a failed injection leaves
-            # the container with kernel-level access to a chip the caller's
-            # rollback is about to hand back to the scheduler.
-            with trace.span("mount.rollback", device=dev.uuid,
-                            cgroups=len(granted)):
-                for cg in granted:
-                    try:
-                        failpoints.fire("worker.mount.rollback", cgroup=cg,
-                                        device=dev.uuid)
-                        self.controller.revoke(cg, dev)
-                    except Exception as undo_exc:  # noqa: BLE001
-                        self._rollback_failed(target, dev, cg, undo_exc)
-            MOUNT_TOTAL.inc(result="error")
+            # Undo the whole batch: without this, a failed injection
+            # leaves the container with kernel-level access to chips the
+            # caller's rollback is about to hand back to the scheduler.
+            self._rollback_batch(target, granted, injected)
+            MOUNT_TOTAL.inc(float(len(devices)), result="error")
             if isinstance(exc, MountError):
                 raise
             # Normalize lower-layer failures (CgroupError, BpfError,
             # NamespaceError, OSError) so callers' rollback paths fire on
             # a single exception type.
             raise MountError(
-                f"mount of {dev.uuid} into {target.description}: {exc}") from exc
-        MOUNT_TOTAL.inc(result="success")
+                f"mount of {uuids} into {target.description}: "
+                f"{exc}") from exc
+        MOUNT_TOTAL.inc(float(len(devices)), result="success")
         MOUNT_LATENCY.observe(timer.total())
         for phase, seconds in timer.phases.items():
             PHASE_LATENCY.observe(seconds, phase=phase)
         summary = timer.summary_ms()
-        logger.info("mounted %s into %s (%s)", dev, target.description, summary)
+        logger.info("mounted %d chip(s) [%s] into %s (%s)",
+                    len(devices), uuids, target.description, summary)
         return summary
+
+    def _grant_batch(self, target: MountTarget, devices: list[TpuDevice],
+                     base_rules: list[DeviceRule] | None,
+                     granted: list[tuple[str, TpuDevice]]) -> None:
+        """Grant every chip on every target cgroup, appending to
+        `granted` as rules land so the caller can roll back exactly what
+        took effect."""
+        if not target.cgroup_dirs:
+            return
+        if self.cgroup_version == 2:
+            # The controller captures base rules only at FIRST grant per
+            # cgroup; skip the /dev walk (a /proc tree scan) when every
+            # target cgroup is already tracked.
+            has_state = getattr(self.controller, "has_state",
+                                lambda cg: False)
+            if not all(has_state(cg) for cg in target.cgroup_dirs):
+                base_rules = self._v2_base_rules(target, base_rules)
+            grant_many = getattr(self.controller, "grant_many", None)
+            for cg in target.cgroup_dirs:
+                if grant_many is not None:
+                    # One program swap for the whole batch.
+                    grant_many(cg, devices, base_rules=base_rules)
+                    granted.extend((cg, d) for d in devices)
+                else:
+                    for dev in devices:
+                        self.controller.grant(cg, dev,
+                                              base_rules=base_rules)
+                        granted.append((cg, dev))
+        else:
+            for cg in target.cgroup_dirs:
+                for dev in devices:
+                    self.controller.grant(cg, dev)
+                    granted.append((cg, dev))
+
+    def _inject_batch(self, target: MountTarget, devices: list[TpuDevice],
+                      injected: list[TpuDevice]) -> None:
+        """mknod + visibility verify for every chip, fanned out across
+        at most cfg.mount_concurrency threads. `injected` accumulates
+        in place so the caller's rollback sees exactly the nodes that
+        landed even when a sibling task failed."""
+        width = max(1, min(int(self.cfg.mount_concurrency), len(devices)))
+        if width == 1 or len(devices) == 1:
+            for dev in devices:
+                self._inject_one(target, dev)
+                injected.append(dev)
+            return
+        ctx = trace.current()
+        lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def _task(dev: TpuDevice) -> None:
+            try:
+                # Contextvars don't cross threads: re-attach the batch's
+                # trace so each mknod span joins the caller's story.
+                with trace.attached(ctx):
+                    self._inject_one(target, dev)
+                with lock:
+                    injected.append(dev)
+            except BaseException as exc:  # noqa: BLE001 — gathered below
+                with lock:
+                    errors.append(exc)
+
+        with futures.ThreadPoolExecutor(
+                max_workers=width,
+                thread_name_prefix="mount-inject") as pool:
+            list(pool.map(_task, devices))
+        if errors:
+            for exc in errors:
+                if isinstance(exc, CrashError):
+                    raise exc  # crash wins: no rollback, like the serial path
+            raise errors[0]
+
+    def _inject_one(self, target: MountTarget, dev: TpuDevice) -> None:
+        with trace.span("mount.mknod", device=dev.uuid,
+                        target=target.description):
+            failpoints.fire("worker.mount.mknod", device=dev.uuid,
+                            target=target.description)
+            nsutil.inject_device_file(target.dev_dir, dev,
+                                      pid=target.ns_pid)
+            # Verify the node is actually visible where the tenant will
+            # look — a mknod that "succeeded" against a torn-down
+            # namespace must fail the batch now, not at first open.
+            path = nsutil.device_node_path(target.dev_dir, dev)
+            present = (nsutil.device_node_exists(path, pid=target.ns_pid)
+                       if target.ns_pid is not None
+                       else os.path.exists(path))
+            if not present:
+                raise MountError(
+                    f"injected node {path} not visible in "
+                    f"{target.description} after mknod")
+
+    def _rollback_batch(self, target: MountTarget,
+                        granted: list[tuple[str, TpuDevice]],
+                        injected: list[TpuDevice]) -> None:
+        """All-or-nothing undo: remove every injected node, revoke every
+        granted rule. The worker.addtpu.rollback.skip failpoint disables
+        it wholesale — the deliberate invariant breaker the chaos
+        harness proves it can detect."""
+        if failpoints.value("worker.addtpu.rollback.skip", False):
+            logger.error("batch rollback SKIPPED by failpoint; %d "
+                         "grant(s) / %d injected node(s) leaked",
+                         len(granted), len(injected))
+            return
+        with trace.span("mount.rollback", cgroups=len(granted),
+                        injected=len(injected)):
+            # A tenant process may have opened an injected node in the
+            # window before a sibling chip failed; cgroup revoke only
+            # gates future open()s, so those fds must be killed like a
+            # forced unmount would (the pre-batch path rolled back via
+            # unmount(force=True)). Gather holders BEFORE removing the
+            # nodes — the scan needs them present.
+            holders: set[int] = set()
+            for dev in injected:
+                try:
+                    holders.update(self.holder_pids(target, dev))
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("rollback holder scan of %s failed: %s",
+                                 dev.uuid, exc)
+            for dev in injected:
+                try:
+                    nsutil.remove_device_file(target.dev_dir, dev,
+                                              pid=target.ns_pid)
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("rollback node removal of %s failed: %s",
+                                 dev.uuid, exc)
+            for cg, dev in granted:
+                try:
+                    failpoints.fire("worker.mount.rollback", cgroup=cg,
+                                    device=dev.uuid)
+                    self.controller.revoke(cg, dev)
+                except Exception as undo_exc:  # noqa: BLE001
+                    self._rollback_failed(target, dev, cg, undo_exc)
+            if holders:
+                logger.warning("rollback killing %d holder PID(s) of "
+                               "rolled-back chips: %s", len(holders),
+                               sorted(holders))
+                try:
+                    nsutil.kill_pids_in_ns(sorted(holders),
+                                           pid=target.ns_pid)
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("rollback holder kill failed: %s", exc)
 
     def _rollback_failed(self, target: MountTarget, dev: TpuDevice,
                          cgroup: str, exc: Exception) -> None:

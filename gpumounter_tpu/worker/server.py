@@ -124,20 +124,33 @@ class TpuMountService:
                                      method) — slow replies, crashes
                                      mid-RPC (the client sees the
                                      connection die with no answer)
-      worker.addtpu.rollback.skip    return(true) disables the mount-
-                                     failure rollback's unmount loop —
-                                     the deliberate invariant breaker the
+      worker.addtpu.rollback.skip    return(true) disables the batch
+                                     mount's all-or-nothing rollback
+                                     (mounter._rollback_batch) — the
+                                     deliberate invariant breaker the
                                      chaos harness proves it can detect
     """
 
     def __init__(self, kube: KubeClient, collector: TpuCollector | None = None,
                  allocator: TpuAllocator | None = None,
-                 mounter: TpuMounter | None = None, cfg=None):
+                 mounter: TpuMounter | None = None, cfg=None,
+                 pool=None):
         self.cfg = cfg or get_config()
         self.kube = kube
         self.collector = collector or TpuCollector(cfg=self.cfg)
+        # Warm slave-pod pool (allocator/pool.py): stocked only when
+        # warm_pool_size > 0; pre-warms cfg.node_name at construction
+        # when the DaemonSet passes it down. An explicit allocator=
+        # (tests) keeps whatever pool that allocator was built with —
+        # building one here that the allocator never draws from would
+        # book chips for nothing.
+        if pool is None and allocator is None \
+                and self.cfg.warm_pool_size > 0:
+            from gpumounter_tpu.allocator.pool import WarmPodPool
+            pool = WarmPodPool(kube, cfg=self.cfg)
+        self.pool = pool
         self.allocator = allocator or TpuAllocator(kube, self.collector,
-                                                   cfg=self.cfg)
+                                                   cfg=self.cfg, pool=pool)
         self.mounter = mounter or TpuMounter(self.collector.backend,
                                              cfg=self.cfg, kube=kube)
         # Per-pod (UID-keyed) serialization of the CanMount-gate →
@@ -231,33 +244,24 @@ class TpuMountService:
         base_rules = [device_rule(d) for d in self.collector.snapshot()
                       if d.pod_name == pod.name
                       and d.namespace == pod.namespace]
-        mounted: list = []
         try:
             with timer.phase("mount"):
                 target = self.mounter.resolve_target(pod)
-                for dev in devices:
-                    self.mounter.mount(target, dev, base_rules=base_rules)
-                    mounted.append(dev)
+                # Batch pipeline: one cgroup-grant phase for the whole
+                # chip set, mknod/verify fanned out across threads, and
+                # all-or-nothing rollback inside the mounter (grants
+                # revoked, injected nodes removed — unless the
+                # worker.addtpu.rollback.skip failpoint deliberately
+                # leaks them for the chaos harness to detect). The
+                # reference mounts serially with no undo of grants at
+                # all (server.go:74-91).
+                self.mounter.mount_many(target, devices,
+                                        base_rules=base_rules)
         except MountError as exc:
-            # Rollback: revoke what was already granted — otherwise the
-            # target keeps kernel-level access to chips the scheduler is
-            # about to hand to someone else — then free the scheduler's
-            # books (reference only does the latter, server.go:86-91).
-            logger.error("mount failed, rolling back %d mount(s) + slaves: %s",
-                         len(mounted), exc)
-            if failpoints.value("worker.addtpu.rollback.skip", False):
-                # Deliberate invariant breaker for the chaos harness: the
-                # books are freed below but the injected nodes stay — the
-                # exact leak the invariant checker must catch.
-                logger.error("rollback unmounts SKIPPED by failpoint; "
-                             "%d injected node(s) leaked", len(mounted))
-            else:
-                for dev in mounted:
-                    try:
-                        self.mounter.unmount(target, dev, force=True)
-                    except MountError as undo_exc:
-                        logger.error("rollback unmount of %s failed: %s",
-                                     dev.uuid, undo_exc)
+            # The mounter already rolled the batch back; what remains is
+            # freeing the scheduler's books (reference: server.go:86-91).
+            logger.error("mount failed (batch rolled back), releasing "
+                         "%d slave pod(s): %s", len(slaves), exc)
             self.allocator.delete_slave_pods(slaves, wait=False)
             self._post_event(pod, "TPUMountFailed", str(exc), "Warning")
             context.abort(grpc.StatusCode.INTERNAL, str(exc))

@@ -1,0 +1,191 @@
+"""Channel-pool lifecycle (rpc/client.py ChannelPool, ISSUE 5).
+
+Reuse across requests, idle eviction, invalidation when the circuit
+breaker opens, eviction on registry address change, and exact
+no-leak accounting (the chaos harness asserts the same books as its
+invariant 7).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.master.app import WorkerRegistry
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.rpc.client import ChannelPool, WorkerClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+
+@pytest.fixture()
+def worker(tmp_path):
+    """(address, cluster) — a live worker gRPC server on loopback."""
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev), description=f"{pod.namespace}/{pod.name}")
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cluster.cfg)
+    server = build_server(service, address="localhost:0")
+    server.start()
+    yield f"localhost:{server.bound_port}", cluster
+    server.stop(grace=None)
+    cluster.stop()
+
+
+def test_channel_reused_across_clients(worker):
+    address, cluster = worker
+    cluster.add_target_pod("trainer")
+    pool = ChannelPool(cfg=cluster.cfg)
+    try:
+        for _ in range(3):
+            with WorkerClient(address, channel_pool=pool) as client:
+                result, chips = client.probe_tpu("trainer", "default")
+                assert result == api.ProbeTPUResult.Success
+        stats = pool.stats()
+        # One dial total; the two later clients were pure cache hits,
+        # and closing a client never closed the pooled channel.
+        assert stats == {"live": 1, "dialed": 1, "closed": 0}
+    finally:
+        pool.close_all()
+    assert pool.stats() == {"live": 0, "dialed": 1, "closed": 1}
+
+
+def test_client_close_does_not_close_pooled_channel(worker):
+    address, cluster = worker
+    cluster.add_target_pod("trainer")
+    pool = ChannelPool(cfg=cluster.cfg)
+    try:
+        client = WorkerClient(address, channel_pool=pool)
+        client.close()
+        client.close()  # idempotent
+        # The channel survives the client: a fresh borrow still works.
+        with WorkerClient(address, channel_pool=pool) as c2:
+            result, _ = c2.probe_tpu("trainer", "default")
+            assert result == api.ProbeTPUResult.Success
+        assert pool.stats()["dialed"] == 1
+        # A closed client refuses further calls instead of crashing in
+        # grpc internals.
+        with pytest.raises(RuntimeError):
+            client.probe_tpu("trainer", "default")
+    finally:
+        pool.close_all()
+
+
+def test_idle_eviction(worker):
+    address, cluster = worker
+    pool = ChannelPool(cfg=cluster.cfg.replace(channel_idle_evict_s=0.05))
+    try:
+        pool.channel(address)
+        pool.release(address)  # borrower done; idle clock starts
+        time.sleep(0.1)
+        pool.channel("localhost:1")  # any lookup sweeps
+        stats = pool.stats()
+        assert stats["closed"] == 1  # the idle one
+        assert stats["live"] == 1
+    finally:
+        pool.close_all()
+
+
+def test_idle_sweep_never_evicts_borrowed_channel(worker):
+    """An in-flight RPC's channel must not be closed under it just
+    because another address's lookup triggered the idle sweep."""
+    address, cluster = worker
+    cluster.add_target_pod("trainer")
+    pool = ChannelPool(cfg=cluster.cfg.replace(channel_idle_evict_s=0.05))
+    try:
+        client = WorkerClient(address, channel_pool=pool)  # borrowed
+        time.sleep(0.1)  # well past the idle window
+        pool.channel("localhost:1")  # sweeps — must skip the borrowed one
+        assert pool.stats()["closed"] == 0
+        # The borrowed channel still works end to end.
+        result, _ = client.probe_tpu("trainer", "default")
+        assert result == api.ProbeTPUResult.Success
+        client.close()  # released: idle clock restarts from now
+        time.sleep(0.1)
+        pool.channel("localhost:2")
+        assert pool.stats()["closed"] == 1  # now it was evictable
+    finally:
+        pool.close_all()
+
+
+def test_breaker_open_invalidates_channel():
+    """The registry wires CircuitBreaker.on_open -> pool.invalidate:
+    when a worker degrades, its cached channel is dropped so recovery
+    starts from a fresh dial."""
+    kube = FakeKubeClient()
+    from gpumounter_tpu.config import Config
+    cfg = Config().replace(breaker_failure_threshold=2)
+    registry = WorkerRegistry(kube, cfg)
+    try:
+        addr = "10.0.0.9:1200"
+        registry.channel_pool.channel(addr)
+        assert registry.channel_pool.live_count() == 1
+        registry.breaker.record_failure(addr)
+        assert registry.channel_pool.live_count() == 1  # not yet open
+        registry.breaker.record_failure(addr)  # trips
+        assert registry.breaker.state(addr) == "open"
+        assert registry.channel_pool.live_count() == 0
+    finally:
+        registry.stop()
+
+
+def test_registry_address_change_invalidates_channel(tmp_path):
+    """A worker pod whose IP changes (restart/reschedule) must take its
+    cached channel with it — the next request dials the new address."""
+    kube = FakeKubeClient()
+    from gpumounter_tpu.config import Config
+    cfg = Config().replace(worker_namespace="kube-system",
+                           worker_label_selector="app=tpu-mounter-worker")
+    kube.create_pod("kube-system", {
+        "metadata": {"name": "w1", "namespace": "kube-system",
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": "node-a", "containers": [{"name": "w"}]},
+        "status": {"phase": "Running", "podIP": "10.0.0.1"},
+    })
+    registry = WorkerRegistry(kube, cfg)
+    try:
+        addr = registry.worker_address("node-a")
+        assert addr == f"10.0.0.1:{cfg.worker_port}"
+        registry.channel_pool.channel(addr)
+        assert registry.channel_pool.live_count() == 1
+        kube.set_pod_status("kube-system", "w1", podIP="10.0.0.2")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if registry.worker_address("node-a") == \
+                    f"10.0.0.2:{cfg.worker_port}" and \
+                    registry.channel_pool.live_count() == 0:
+                break
+            time.sleep(0.02)
+        assert registry.worker_address("node-a") == \
+            f"10.0.0.2:{cfg.worker_port}"
+        assert registry.channel_pool.live_count() == 0
+    finally:
+        registry.stop()
+
+
+def test_registry_stop_closes_pool(tmp_path):
+    kube = FakeKubeClient()
+    from gpumounter_tpu.config import Config
+    registry = WorkerRegistry(kube, Config())
+    registry.channel_pool.channel("10.0.0.1:1200")
+    registry.channel_pool.channel("10.0.0.2:1200")
+    registry.stop()
+    stats = registry.channel_pool.stats()
+    assert stats["live"] == 0
+    assert stats["dialed"] == stats["closed"] == 2
+    with pytest.raises(RuntimeError):
+        registry.channel_pool.channel("10.0.0.3:1200")

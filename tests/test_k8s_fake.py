@@ -130,3 +130,57 @@ def test_unschedulable_condition():
     c.mark_unschedulable("ns", "p")
     pod = Pod(c.get_pod("ns", "p"))
     assert pod.unschedulable_reason()
+
+
+def test_scheduler_burst_uses_one_worker_thread():
+    """ISSUE 5 satellite: a burst of creates (a 64-pod warm-pool refill)
+    must not spawn a daemon thread per pod — ONE shared scheduler thread
+    drains a due-time heap, and concurrent delays still overlap."""
+    scheduled = []
+
+    def hook(pod):
+        pod.setdefault("status", {})["phase"] = "Running"
+        scheduled.append(pod["metadata"]["name"])
+
+    preexisting = set(threading.enumerate())  # other tests' clients
+    c = FakeKubeClient(scheduler_hook=hook, scheduler_delay_s=0.05)
+    t0 = time.monotonic()
+    for i in range(64):
+        c.create_pod("ns", make_pod(f"p{i}", "ns"))
+    new_workers = [t for t in threading.enumerate()
+                   if t.name == "fake-scheduler" and t not in preexisting]
+    assert len(new_workers) == 1
+
+    deadline = time.monotonic() + 5.0
+    while len(scheduled) < 64 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    elapsed = time.monotonic() - t0
+    assert len(scheduled) == 64
+    # Delays overlap (due-time heap), so the burst completes in ~one
+    # delay, not 64 serialized delays (which would be 3.2s).
+    assert elapsed < 1.5
+    # This client's worker retires when idle instead of parking forever.
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        if c._sched_thread is None:
+            break
+        time.sleep(0.02)
+    assert c._sched_thread is None
+
+
+def test_scheduler_thread_restarts_after_retiring():
+    def hook(pod):
+        pod.setdefault("status", {})["phase"] = "Running"
+
+    c = FakeKubeClient(scheduler_hook=hook)
+    c.create_pod("ns", make_pod("first", "ns"))
+    got = c.wait_for_pod("ns", "first",
+                         lambda pod: pod and Pod(pod).phase == "Running",
+                         timeout_s=5.0)
+    assert got
+    time.sleep(0.15)  # let the worker retire
+    c.create_pod("ns", make_pod("second", "ns"))
+    got = c.wait_for_pod("ns", "second",
+                         lambda pod: pod and Pod(pod).phase == "Running",
+                         timeout_s=5.0)
+    assert got

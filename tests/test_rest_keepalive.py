@@ -1,0 +1,131 @@
+"""RestKubeClient keep-alive reuse (ISSUE 5 satellite).
+
+The REST client used to open a fresh HTTPS connection per API call;
+now each thread keeps one alive, reconnecting once on a stale socket —
+but only for idempotent methods (a POST whose first send may have
+landed must surface the error, not silently double-create).
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+
+import pytest
+
+from gpumounter_tpu.k8s.client import RestKubeClient
+
+
+class FakeResponse:
+    def __init__(self, status=200, body=b"{}"):
+        self.status = status
+        self._body = body
+
+    def read(self):
+        return self._body
+
+
+class FakeConn:
+    """Stands in for http.client.HTTPSConnection; scripted staleness."""
+
+    instances: list["FakeConn"] = []
+
+    def __init__(self, host, port, context=None, timeout=None):
+        FakeConn.instances.append(self)
+        self.requests: list[tuple[str, str]] = []
+        self.stale_next = False          # fail at getresponse (ambiguous)
+        self.stale_on_request = False    # fail at send (never reached server)
+        self.closed = False
+
+    def request(self, method, url, body=None, headers=None):
+        if self.stale_on_request:
+            self.stale_on_request = False
+            raise BrokenPipeError("stale at send")
+        self.requests.append((method, url))
+
+    def getresponse(self):
+        if self.stale_next:
+            self.stale_next = False
+            raise http.client.BadStatusLine("")
+        return FakeResponse()
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture()
+def client(monkeypatch):
+    FakeConn.instances = []
+    monkeypatch.setattr(http.client, "HTTPSConnection", FakeConn)
+    return RestKubeClient("apiserver", 443, "tok", verify=False)
+
+
+def test_connection_reused_across_calls(client):
+    client.get_pod("ns", "a")
+    client.get_pod("ns", "b")
+    client.list_pods("ns")
+    assert len(FakeConn.instances) == 1
+    assert len(FakeConn.instances[0].requests) == 3
+
+
+def test_stale_connection_rebuilt_and_get_retried(client):
+    client.get_pod("ns", "a")
+    FakeConn.instances[0].stale_next = True
+    pod = client.get_pod("ns", "b")  # retried transparently
+    assert pod == {}
+    assert len(FakeConn.instances) == 2
+    assert FakeConn.instances[0].closed
+    # The replacement connection carries the retried request.
+    assert FakeConn.instances[1].requests[-1][0] == "GET"
+
+
+def test_post_retried_when_send_never_reached_server(client):
+    """A send-phase failure means the server never saw the request —
+    resending a POST there cannot double-create."""
+    client.get_pod("ns", "a")  # warm the pooled connection
+    FakeConn.instances[0].stale_on_request = True
+    assert client.create_pod("ns", {"metadata": {"name": "p"}}) == {}
+    assert len(FakeConn.instances) == 2
+    assert FakeConn.instances[1].requests[-1][0] == "POST"
+
+
+def test_post_is_never_retried_on_ambiguous_stale(client):
+    """Response-phase failure is ambiguous (the server may have
+    processed the create) — POST must surface it, not resend."""
+    client.get_pod("ns", "a")  # warm the pooled connection
+    FakeConn.instances[0].stale_next = True
+    with pytest.raises(http.client.BadStatusLine):
+        client.create_pod("ns", {"metadata": {"name": "p"}})
+    # The dead connection was dropped, not left pooled...
+    assert FakeConn.instances[0].closed
+    # ...so the next call works on a fresh one.
+    client.get_pod("ns", "a")
+    assert len(FakeConn.instances) == 2
+
+
+def test_fresh_connection_failure_is_not_retried(client):
+    """Staleness only explains failures on REUSED connections — a
+    brand-new one failing means the apiserver is really unreachable."""
+    def stale_ctor(host, port, context=None, timeout=None):
+        conn = FakeConn(host, port, context=context, timeout=timeout)
+        conn.stale_next = True
+        return conn
+
+    import gpumounter_tpu.k8s.client as mod  # noqa: F401 — for clarity
+    http.client.HTTPSConnection = stale_ctor
+    with pytest.raises(http.client.BadStatusLine):
+        client.get_pod("ns", "a")
+    assert len(FakeConn.instances) == 1
+
+
+def test_each_thread_gets_its_own_connection(client):
+    done = threading.Event()
+
+    def other():
+        client.get_pod("ns", "x")
+        done.set()
+
+    client.get_pod("ns", "a")
+    threading.Thread(target=other, daemon=True).start()
+    assert done.wait(5.0)
+    assert len(FakeConn.instances) == 2

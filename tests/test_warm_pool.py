@@ -1,0 +1,292 @@
+"""Warm slave-pod pool correctness (allocator/pool.py, ISSUE 5).
+
+Adoption must be atomic (no double-adopt under concurrent mounts), a
+drained pool must degrade gracefully to the cold create-and-wait path,
+failpoint-injected refill failures must not strand holder pods, a
+restarted worker must re-adopt its warm pods, and the elastic heal path
+must draw from the pool like any other mount.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from gpumounter_tpu.allocator.allocator import TpuAllocator
+from gpumounter_tpu.allocator.pool import (
+    WARM_LABEL,
+    WARM_POOL_HITS,
+    WARM_POOL_MISSES,
+    WARM_SELECTOR,
+    WarmPodPool,
+)
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.testing.cluster import FakeCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = FakeCluster(str(tmp_path), n_chips=4).start()
+    yield c
+    c.stop()
+
+
+def _counter(metric) -> float:
+    return metric._values.get((), 0.0)
+
+
+def build(cluster, pool_size: int, **cfg_overrides):
+    """(allocator, pool, cfg) with a deterministic (synchronous-refill)
+    warm pool of the given size."""
+    cfg = cluster.cfg.replace(warm_pool_size=pool_size, **cfg_overrides)
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cfg.kubelet_socket, timeout_s=5.0),
+        cfg=cfg)
+    pool = WarmPodPool(cluster.kube, cfg=cfg, refill_async=False)
+    allocator = TpuAllocator(cluster.kube, collector, cfg=cfg, pool=pool)
+    return allocator, pool, cfg
+
+
+def warm_pods(cluster):
+    return cluster.kube.list_pods(cluster.cfg.pool_namespace,
+                                  label_selector=WARM_SELECTOR)
+
+
+def test_adoption_uses_prescheduled_holders(cluster):
+    allocator, pool, cfg = build(cluster, pool_size=2)
+    pool.ensure_node(cluster.node_name)
+    pool.refill_once()
+    assert pool.ready_count(cluster.node_name) == 2
+    pooled_names = {p["metadata"]["name"] for p in warm_pods(cluster)}
+
+    owner = cluster.add_target_pod("trainer")
+    hits0 = _counter(WARM_POOL_HITS)
+    devices, slaves = allocator.get_available_tpus(owner, 2, 1)
+
+    assert len(devices) == 2
+    # Both slaves ARE the pre-scheduled holders (no create-and-wait on
+    # the request path), relabeled to the owner.
+    assert set(slaves) == pooled_names
+    assert _counter(WARM_POOL_HITS) - hits0 == 2
+    for name in slaves:
+        meta = cluster.kube.get_pod(cfg.pool_namespace, name)["metadata"]
+        assert meta["labels"]["tpumounter.io/owner-uid"] == owner.uid
+        assert WARM_LABEL not in meta["labels"]
+        assert meta["annotations"]["tpumounter.io/owner"] == "trainer"
+    # Ownership queries see the adopted holders like any cold slave.
+    assert {p.name for p in allocator.slave_pods_for(owner)} == set(slaves)
+    # A refill pass replaces the consumed slots (async in the daemons).
+    pool.refill_once()
+    assert pool.ready_count(cluster.node_name) == 2
+
+
+def test_drained_pool_degrades_to_cold_path(cluster):
+    allocator, pool, _ = build(cluster, pool_size=2)
+    # No ensure_node/refill: the pool is registered lazily by acquire and
+    # is empty at adoption time — the request must fall through cold.
+    owner = cluster.add_target_pod("trainer")
+    misses0 = _counter(WARM_POOL_MISSES)
+    devices, slaves = allocator.get_available_tpus(owner, 2, 1)
+    assert len(devices) == 2
+    assert all(s.startswith("trainer-slave-pod-") for s in slaves)
+    assert _counter(WARM_POOL_MISSES) - misses0 == 2
+
+
+def test_no_double_adopt_under_concurrent_mounts(cluster):
+    """Two concurrent single-chip mounts with one warm holder: exactly
+    one adopts it, the other goes cold — never the same holder twice."""
+    allocator, pool, _ = build(cluster, pool_size=1)
+    pool.ensure_node(cluster.node_name)
+    pool.refill_once()
+    assert pool.ready_count(cluster.node_name) == 1
+
+    owners = [cluster.add_target_pod(f"tenant-{i}") for i in range(2)]
+    results: dict[int, tuple] = {}
+
+    def _mount(i):
+        results[i] = allocator.get_available_tpus(owners[i], 1, 1)
+
+    threads = [threading.Thread(target=_mount, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    slaves0, slaves1 = results[0][1], results[1][1]
+    assert len(slaves0) == 1 and len(slaves1) == 1
+    assert set(slaves0).isdisjoint(slaves1)
+    uuids = {results[0][0][0].uuid, results[1][0][0].uuid}
+    assert len(uuids) == 2  # distinct chips too
+    # Each adopted/created slave belongs to exactly its owner.
+    for owner, slaves in zip(owners, (slaves0, slaves1)):
+        assert {p.name for p in allocator.slave_pods_for(owner)} \
+            == set(slaves)
+
+
+def test_refill_failures_leave_no_stranded_holders(cluster):
+    allocator, pool, _ = build(cluster, pool_size=2,
+                               warm_pool_retry_s=0.05)
+    pool.ensure_node(cluster.node_name)
+    with failpoints.armed({"pool.refill": "error(refill boom)"}):
+        pool.refill_once()
+        assert pool.ready_count(cluster.node_name) == 0
+        assert warm_pods(cluster) == []  # nothing half-created
+        # Mounts still work cold while the pool is down.
+        owner = cluster.add_target_pod("trainer")
+        devices, _ = allocator.get_available_tpus(owner, 1, 1)
+        assert len(devices) == 1
+    # Backoff expires, failpoint gone: the pool recovers on its own.
+    time.sleep(0.06)
+    pool.refill_once()
+    assert pool.ready_count(cluster.node_name) == 2
+
+
+def test_unschedulable_refill_deletes_holder_and_backs_off(cluster):
+    """A full node cannot place warm holders: the refill's wait times
+    out, the doomed pod is deleted (not stranded Pending forever), and
+    the node backs off instead of hot-looping creates."""
+    allocator, pool, _ = build(cluster, pool_size=1,
+                               slave_pod_timeout_s=0.4,
+                               warm_pool_retry_s=30.0)
+    owner = cluster.add_target_pod("hog")
+    allocator.get_available_tpus(owner, 4, 1)  # occupy every chip (cold)
+    creates0 = cluster.kube.create_calls
+    pool.ensure_node(cluster.node_name)
+    pool.refill_once()
+    assert pool.ready_count(cluster.node_name) == 0
+    assert warm_pods(cluster) == []
+    assert cluster.kube.create_calls == creates0 + 1
+    # Backed off: another pass creates nothing until warm_pool_retry_s.
+    pool.refill_once()
+    assert cluster.kube.create_calls == creates0 + 1
+
+
+def test_worker_restart_readopts_running_holders(cluster):
+    """Pool state is reconstructable from the API server: a new pool
+    (worker restart) re-adopts Running warm pods and deletes strays that
+    never reached Running (a refill that died mid-wait)."""
+    _, pool1, cfg = build(cluster, pool_size=2)
+    pool1.ensure_node(cluster.node_name)
+    pool1.refill_once()
+    assert pool1.ready_count(cluster.node_name) == 2
+    pool1.stop()
+
+    def _warm_manifest(name, node, chips="1"):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": cfg.pool_namespace,
+                         "labels": {"app": "tpu-pool",
+                                    WARM_LABEL: "true"}},
+            "spec": {"nodeSelector": {"kubernetes.io/hostname": node},
+                     "containers": [{"name": "p", "resources": {
+                         "limits": {cfg.tpu_resource_name: chips},
+                         "requests": {cfg.tpu_resource_name: chips}}}]},
+        }
+
+    # A stray on OUR node: warm-labeled but unschedulable (requests more
+    # chips than the node has), stuck Pending — a refill that died
+    # mid-wait. And a foreign holder: pinned to another node, still
+    # unscheduled — NOT ours to reap.
+    cluster.kube.create_pod(cfg.pool_namespace,
+                            _warm_manifest("warm-slave-stray",
+                                           cluster.node_name, chips="9"))
+    cluster.kube.create_pod(cfg.pool_namespace,
+                            _warm_manifest("warm-slave-foreign", "ghost"))
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:  # let the fake scheduler mark it
+        pod = cluster.kube.get_pod(cfg.pool_namespace, "warm-slave-stray")
+        if pod["status"]["phase"] == "Pending" and pod["status"].get(
+                "conditions"):
+            break
+        time.sleep(0.01)
+
+    creates0 = cluster.kube.create_calls
+    pool2 = WarmPodPool(cluster.kube, cfg=cfg, refill_async=False)
+    pool2.ensure_node(cluster.node_name)
+    assert pool2.ready_count(cluster.node_name) == 2
+    assert cluster.kube.create_calls == creates0  # re-adopted, not rebuilt
+    names = {p["metadata"]["name"] for p in warm_pods(cluster)}
+    assert "warm-slave-stray" not in names   # our stray: deleted
+    assert "warm-slave-foreign" in names     # another node's: untouched
+    assert len(names) == 3
+
+
+def test_elastic_heal_draws_from_pool(cluster, tmp_path):
+    """ISSUE 5 integration: the reconciler's heal path replaces a dead
+    chip by adopting a warm holder — no create-and-wait on the heal."""
+    from gpumounter_tpu.elastic import Intent
+    from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+    from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+    cfg = cluster.cfg.replace(warm_pool_size=1)
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cfg.kubelet_socket, timeout_s=5.0),
+        cfg=cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cfg)
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev),
+        description=f"{pod.namespace}/{pod.name}")
+    pool = WarmPodPool(cluster.kube, cfg=cfg, refill_async=False)
+    allocator = TpuAllocator(cluster.kube, collector, cfg=cfg, pool=pool)
+    service = TpuMountService(cluster.kube, collector=collector,
+                              allocator=allocator, mounter=mounter, cfg=cfg)
+    grpc_server = build_server(service, address="localhost:0")
+    grpc_server.start()
+    master_cfg = cfg.replace(worker_port=grpc_server.bound_port)
+    cluster.kube.create_pod(master_cfg.worker_namespace, {
+        "metadata": {"name": "tpu-mounter-worker-abc",
+                     "namespace": master_cfg.worker_namespace,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": cluster.node_name,
+                 "containers": [{"name": "worker"}]},
+        "status": {"phase": "Running", "podIP": "127.0.0.1"},
+    })
+    app = MasterApp(cluster.kube, cfg=master_cfg,
+                    registry=WorkerRegistry(cluster.kube, master_cfg))
+    try:
+        pod = cluster.add_target_pod("trainer")
+        app.elastic.store.put("default", "trainer",
+                              Intent(desired_chips=2, min_chips=1))
+        outcome = app.elastic.reconcile_once("default", "trainer")
+        assert outcome["actual"] == 2
+        # Stock the pool, then kill one mounted chip.
+        pool.ensure_node(cluster.node_name)
+        pool.refill_once()
+        assert pool.ready_count(cluster.node_name) == 1
+        mounted = {d.uuid for d in collector.get_pod_devices(
+            "trainer", "default")}
+        victim = sorted(mounted)[0]
+        cluster.kill_chip(victim.removeprefix("tpu-fake-accel"))
+        hits0 = _counter(WARM_POOL_HITS)
+        outcome = app.elastic.reconcile_once("default", "trainer")
+        assert outcome["healed"] == 1 and outcome["actual"] == 2
+        # The replacement chip came from the warm pool.
+        assert _counter(WARM_POOL_HITS) - hits0 == 1
+        assert len(allocator.slave_pods_for(pod)) == 2
+    finally:
+        app.registry.stop()
+        grpc_server.stop(grace=None)
+
+
+def test_entire_mount_bypasses_pool(cluster):
+    """The pool stocks single-chip holders only; an entire-mount (one
+    slave holding N chips) must not adopt them."""
+    allocator, pool, _ = build(cluster, pool_size=2)
+    pool.ensure_node(cluster.node_name)
+    pool.refill_once()
+    owner = cluster.add_target_pod("trainer")
+    hits0 = _counter(WARM_POOL_HITS)
+    devices, slaves = allocator.get_available_tpus(owner, 2, 2)
+    assert len(devices) == 2 and len(slaves) == 1
+    assert slaves[0].startswith("trainer-slave-pod-")
+    assert _counter(WARM_POOL_HITS) == hits0
+    assert pool.ready_count(cluster.node_name) == 2
