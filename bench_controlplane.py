@@ -23,8 +23,14 @@ clusters pay ~1-4 s; SURVEY §3 / GPUMounter's checkCreateState). The
 warm path's win is architectural (no schedule on the critical path), so
 the measured ratio *understates* production gains.
 
+The warm run's master additionally serves the fleet telemetry plane
+(/fleet + /slo, ISSUE 6): the end-of-run rollup — per-node mount
+p50/p95, warm-pool hit rate, SLO burn rates — is embedded in the
+artifact under "fleet" so a perf regression can be read against the
+same run's fleet health.
+
 Usage:
-  python bench_controlplane.py                 -> writes BENCH_ctrl_r05.json
+  python bench_controlplane.py                 -> writes BENCH_ctrl_r06.json
   python bench_controlplane.py --check FILE    -> runs fresh, compares the
       warm p50 against the committed artifact; exits 1 on >25% regression
       or if the fresh run loses the 2x cold/warm target. The budget is
@@ -52,7 +58,7 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-ctrl-secret")
 os.environ["TPUMOUNTER_AUTH"] = "token"
 
-ARTIFACT = os.path.join(REPO, "BENCH_ctrl_r05.json")
+ARTIFACT = os.path.join(REPO, "BENCH_ctrl_r06.json")
 SCHED_DELAY_S = 0.05
 ITERS = 30
 WARM_POOL = 2
@@ -181,6 +187,16 @@ class Stack:
         _, body = http("GET", self.base + "/metrics")
         return body
 
+    def fleet(self) -> dict:
+        """The federated fleet rollup + SLO evaluation at end of run —
+        recorded into the artifact so a perf regression can be read
+        against the same run's warm-pool hit rate, per-node p95, and
+        burn rates."""
+        _, body = http("GET", self.base + "/fleet")
+        rollup = json.loads(body)
+        _, body = http("GET", self.base + "/slo")
+        return {"rollup": rollup, "slo": json.loads(body)}
+
     def stop(self) -> None:
         if self.pool is not None:
             self.pool.stop()
@@ -196,7 +212,7 @@ def percentile(samples: list[float], pct: float) -> float:
     return ordered[idx]
 
 
-def run_mode(warm: bool) -> tuple[dict, str]:
+def run_mode(warm: bool) -> tuple[dict, str, dict]:
     with tempfile.TemporaryDirectory(
             prefix=f"tpm-ctrl-{'warm' if warm else 'cold'}-") as root:
         stack = Stack(root, warm=warm)
@@ -204,6 +220,7 @@ def run_mode(warm: bool) -> tuple[dict, str]:
             stack.mount_cycle_ms()  # one untimed warmup cycle
             samples = [stack.mount_cycle_ms() for _ in range(ITERS)]
             metrics = stack.metrics()
+            fleet = stack.fleet() if warm else {}
         finally:
             stack.stop()
     return ({
@@ -213,7 +230,7 @@ def run_mode(warm: bool) -> tuple[dict, str]:
         "min_ms": round(min(samples), 3),
         "max_ms": round(max(samples), 3),
         "samples_ms": [round(s, 3) for s in samples],
-    }, metrics)
+    }, metrics, fleet)
 
 
 def scrape(metrics: str, prefixes: tuple[str, ...]) -> list[str]:
@@ -222,8 +239,8 @@ def scrape(metrics: str, prefixes: tuple[str, ...]) -> list[str]:
 
 
 def run_bench() -> dict:
-    cold, _ = run_mode(warm=False)
-    warm, warm_metrics = run_mode(warm=True)
+    cold, _, _ = run_mode(warm=False)
+    warm, warm_metrics, fleet = run_mode(warm=True)
     excerpt = scrape(warm_metrics, (
         "tpumounter_warm_pool_", "tpumounter_channel_pool_"))
 
@@ -235,7 +252,7 @@ def run_bench() -> dict:
 
     speedup = (cold["p50_ms"] / warm["p50_ms"]) if warm["p50_ms"] else 0.0
     return {
-        "schema": "tpumounter-ctrl/r05",
+        "schema": "tpumounter-ctrl/r06",
         "sched_delay_ms": SCHED_DELAY_S * 1000.0,
         "iterations": ITERS,
         "warm_pool_size": WARM_POOL,
@@ -251,6 +268,9 @@ def run_bench() -> dict:
         "channel_pool_misses": metric_value(
             "tpumounter_channel_pool_misses_total"),
         "metrics_excerpt": excerpt,
+        # fleet/SLO snapshot from the warm run's master (/fleet + /slo):
+        # per-node p50/p95, warm-pool hit rate, burn rates at end of run.
+        "fleet": fleet,
     }
 
 

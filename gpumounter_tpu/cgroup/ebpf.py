@@ -47,12 +47,18 @@ from dataclasses import dataclass
 
 from gpumounter_tpu.device.tpu import TpuDevice
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY, _fmt_labels
 
 logger = get_logger("cgroup.ebpf")
 
 # --- kernel ABI constants (linux/bpf.h) ---
 
 SYS_BPF = 321  # x86_64
+BPF_MAP_CREATE = 0
+BPF_MAP_LOOKUP_ELEM = 1
+BPF_MAP_UPDATE_ELEM = 2
+BPF_MAP_DELETE_ELEM = 3
+BPF_MAP_GET_NEXT_KEY = 4
 BPF_PROG_LOAD = 5
 BPF_OBJ_PIN = 6
 BPF_OBJ_GET = 7
@@ -60,6 +66,11 @@ BPF_PROG_ATTACH = 8
 BPF_PROG_DETACH = 9
 BPF_PROG_GET_FD_BY_ID = 13
 BPF_PROG_QUERY = 16
+
+BPF_MAP_TYPE_HASH = 1
+BPF_NOEXIST = 1        # map_update flag: create only, keep existing value
+BPF_PSEUDO_MAP_FD = 1  # ld_imm64 src marking the imm as a map fd
+BPF_FUNC_map_lookup_elem = 1
 
 BPF_PROG_TYPE_CGROUP_DEVICE = 15
 BPF_CGROUP_DEVICE = 6
@@ -74,18 +85,35 @@ BPF_DEVCG_ACC_WRITE = 4
 # --- instruction opcodes ---
 
 OP_LDX_MEM_W = 0x61   # dst = *(u32 *)(src + off)
+OP_STX_MEM_DW = 0x7B  # *(u64 *)(dst + off) = src
+OP_LD_IMM64 = 0x18    # 16-byte: dst = imm64 (src=BPF_PSEUDO_MAP_FD -> map)
 OP_MOV64_IMM = 0xB7
 OP_MOV64_REG = 0xBF
+OP_ADD64_IMM = 0x07
 OP_AND64_IMM = 0x57
+OP_OR64_REG = 0x4F
+OP_LSH64_IMM = 0x67
 OP_RSH64_IMM = 0x77
 OP_JNE_IMM = 0x55
+OP_JEQ_IMM = 0x15
+OP_CALL = 0x85
 OP_EXIT = 0x95
+OP_XADD_DW = 0xDB     # lock *(u64 *)(dst + off) += src
 
 INSN_SIZE = 8
 
 
 def insn(op: int, dst: int = 0, src: int = 0, off: int = 0, imm: int = 0) -> bytes:
     return struct.pack("<BBhi", op, (src << 4) | dst, off, imm)
+
+
+def insn_ld_imm64(dst: int, imm: int, src: int = 0) -> bytes:
+    """The only 16-byte eBPF instruction: dst = 64-bit immediate. With
+    src=BPF_PSEUDO_MAP_FD the verifier relocates imm (a map fd) into a
+    map pointer at load time."""
+    return (struct.pack("<BBhi", OP_LD_IMM64, (src << 4) | dst, 0,
+                        imm & 0xFFFFFFFF)
+            + struct.pack("<BBhi", 0, 0, 0, (imm >> 32) & 0xFFFFFFFF))
 
 
 _ACCESS_BITS = {"r": BPF_DEVCG_ACC_READ, "w": BPF_DEVCG_ACC_WRITE,
@@ -131,9 +159,49 @@ def device_rule(dev: TpuDevice, access: str = "rw") -> DeviceRule:
     return DeviceRule("c", dev.major, dev.minor, access)
 
 
-def build_device_program(rules: list[DeviceRule] | tuple[DeviceRule, ...]) -> bytes:
-    """Assemble the allow-list program; returns raw bpf_insn bytes."""
+def telemetry_key(major: int, minor: int) -> int:
+    """Map key for one device: (major << 32) | minor — what the in-kernel
+    counter block computes and what the userspace reader looks up."""
+    return ((major & 0xFFFFFFFF) << 32) | (minor & 0xFFFFFFFF)
+
+
+def _telemetry_block(map_fd: int) -> bytes:
+    """Instruction preamble counting every access attempt in a per-cgroup
+    BPF hash map, gpu_ext-style: key = (major<<32)|minor, value = u64
+    attempt count bumped with an atomic add. Runs BEFORE the policy
+    decision so denied attempts are counted too. Keys are seeded by the
+    controller at grant time (hash-map lookup misses are skipped, so
+    un-granted devices cost two loads and a failed lookup, nothing
+    more). The collector reads the map with bpf(BPF_MAP_LOOKUP_ELEM) —
+    no program swap is ever needed to read or reset telemetry."""
     out = bytearray()
+    out += insn(OP_MOV64_REG, dst=6, src=1)            # save ctx (r1 dies at call)
+    out += insn(OP_LDX_MEM_W, dst=4, src=1, off=4)     # major
+    out += insn(OP_LDX_MEM_W, dst=5, src=1, off=8)     # minor
+    out += insn(OP_LSH64_IMM, dst=4, imm=32)
+    out += insn(OP_OR64_REG, dst=4, src=5)             # r4 = key
+    out += insn(OP_STX_MEM_DW, dst=10, src=4, off=-8)  # key -> stack
+    out += insn_ld_imm64(dst=1, imm=map_fd, src=BPF_PSEUDO_MAP_FD)
+    out += insn(OP_MOV64_REG, dst=2, src=10)
+    out += insn(OP_ADD64_IMM, dst=2, imm=-8)           # r2 = &key
+    out += insn(OP_CALL, imm=BPF_FUNC_map_lookup_elem)
+    out += insn(OP_JEQ_IMM, dst=0, off=2, imm=0)       # not seeded: skip
+    out += insn(OP_MOV64_IMM, dst=1, imm=1)
+    out += insn(OP_XADD_DW, dst=0, src=1, off=0)       # lock (*value)++
+    out += insn(OP_MOV64_REG, dst=1, src=6)            # restore ctx
+    return bytes(out)
+
+
+def build_device_program(rules: list[DeviceRule] | tuple[DeviceRule, ...],
+                         telemetry_map_fd: int | None = None) -> bytes:
+    """Assemble the allow-list program; returns raw bpf_insn bytes.
+
+    With `telemetry_map_fd`, the program additionally counts every
+    device-access attempt into that map (see _telemetry_block) — the
+    allow/deny semantics are unchanged."""
+    out = bytearray()
+    if telemetry_map_fd is not None:
+        out += _telemetry_block(telemetry_map_fd)
     # prologue: unpack ctx (r1) into r2=type, r3=access, r4=major, r5=minor
     out += insn(OP_LDX_MEM_W, dst=2, src=1, off=0)
     out += insn(OP_MOV64_REG, dst=3, src=2)
@@ -305,6 +373,199 @@ def obj_get(path: str) -> int:
     return fd
 
 
+# --- maps (the telemetry half of the gpu_ext-style policy engine) ---
+#
+# union bpf_attr map-op layout: map_fd at offset 0, then 8-byte-aligned
+# key / value-or-next_key / flags pointers+fields.
+
+_MAP_OP_FMT = "<I4xQQQ"
+
+
+def map_create(key_size: int = 8, value_size: int = 8,
+               max_entries: int = 1024, name: str = "tpum_telemetry") -> int:
+    """Create a BPF_MAP_TYPE_HASH; returns the map fd. Raises BpfError
+    where maps are unavailable (pre-3.19 kernels, no CAP_BPF/SYS_ADMIN,
+    seccomp) — callers degrade to userspace counting."""
+    attr = struct.pack("<IIIIIII16s", BPF_MAP_TYPE_HASH, key_size,
+                       value_size, max_entries, 0, 0, 0,
+                       name.encode()[:15])
+    fd, _ = _bpf(BPF_MAP_CREATE, attr)
+    if fd < 0:
+        err = ctypes.get_errno()
+        raise BpfError(err, f"BPF_MAP_CREATE: {os.strerror(err)}")
+    return fd
+
+
+def map_lookup(map_fd: int, key: int) -> int | None:
+    """u64 value for a u64 key, or None when absent. A pure read — never
+    touches the attached program (the zero-swap collection contract)."""
+    key_buf = ctypes.create_string_buffer(struct.pack("<Q", key), 8)
+    val_buf = ctypes.create_string_buffer(8)
+    attr = struct.pack(_MAP_OP_FMT, map_fd, ctypes.addressof(key_buf),
+                       ctypes.addressof(val_buf), 0)
+    ret, _ = _bpf(BPF_MAP_LOOKUP_ELEM, attr)
+    if ret < 0:
+        return None
+    return struct.unpack("<Q", val_buf.raw)[0]
+
+
+def map_update(map_fd: int, key: int, value: int = 0,
+               flags: int = 0) -> None:
+    key_buf = ctypes.create_string_buffer(struct.pack("<Q", key), 8)
+    val_buf = ctypes.create_string_buffer(struct.pack("<Q", value), 8)
+    attr = struct.pack(_MAP_OP_FMT, map_fd, ctypes.addressof(key_buf),
+                       ctypes.addressof(val_buf), flags)
+    ret, _ = _bpf(BPF_MAP_UPDATE_ELEM, attr)
+    if ret < 0:
+        err = ctypes.get_errno()
+        if flags & BPF_NOEXIST and err == 17:  # EEXIST: already seeded
+            return
+        raise BpfError(err, f"BPF_MAP_UPDATE_ELEM: {os.strerror(err)}")
+
+
+def map_keys(map_fd: int, limit: int = 4096) -> list[int]:
+    """Every u64 key in the map (BPF_MAP_GET_NEXT_KEY iteration)."""
+    keys: list[int] = []
+    key_buf = ctypes.create_string_buffer(8)
+    next_buf = ctypes.create_string_buffer(8)
+    # First call with an invalid (unset) key yields the first real key.
+    have_cursor = False
+    while len(keys) < limit:
+        attr = struct.pack(_MAP_OP_FMT, map_fd,
+                           ctypes.addressof(key_buf) if have_cursor else 0,
+                           ctypes.addressof(next_buf), 0)
+        ret, _ = _bpf(BPF_MAP_GET_NEXT_KEY, attr)
+        if ret < 0:
+            break  # ENOENT: iteration done
+        key = struct.unpack("<Q", next_buf.raw)[0]
+        keys.append(key)
+        key_buf = ctypes.create_string_buffer(next_buf.raw, 8)
+        have_cursor = True
+    return keys
+
+
+def probe_map_support() -> bool:
+    """One-shot probe: can this kernel/privilege level create BPF maps?"""
+    try:
+        fd = map_create(max_entries=1)
+    except BpfError:
+        return False
+    os.close(fd)
+    return True
+
+
+# --- per-tenant device-access telemetry (read side) ---
+
+PROGRAM_SWAPS = REGISTRY.counter(
+    "tpumounter_ebpf_program_swaps_total",
+    "Device-program replacement cycles (grant/revoke). Telemetry "
+    "collection reads maps only and must never move this counter")
+
+TELEMETRY_OVERFLOW_TENANT = "_overflow"
+
+
+class DeviceAccessTelemetry:
+    """Per-tenant device-access counters, the read-side table the fleet
+    collector and worker /metrics consume.
+
+    Two sources merge here:
+      * the userspace fallback — mount-path grants recorded by the
+        worker (`record`) wherever the in-kernel path is unavailable
+        (cgroup v1, fake backends, kernels without BPF maps);
+      * kernel readers — each V2DeviceController attaches a callable
+        that reads its per-cgroup BPF hash maps (attempt counts bumped
+        by the device program itself, see _telemetry_block) with plain
+        map lookups. Reads never swap programs (PROGRAM_SWAPS is the
+        proof) and never reset kernel counters.
+
+    Tenant cardinality is bounded: beyond `max_tenants` distinct
+    tenants, new ones fold into the "_overflow" bucket so a churny
+    namespace cannot explode the /metrics exposition (the CI
+    cardinality guard enforces the budget downstream).
+    """
+
+    def __init__(self, max_tenants: int = 256):
+        self.max_tenants = max_tenants
+        self._lock = threading.Lock()
+        self._counts: dict[tuple[str, str], float] = {}  # (tenant, kind)
+        self._readers: list = []
+
+    def _bucket(self, tenant: str) -> str:
+        tenants = {t for t, _ in self._counts}
+        if tenant in tenants or len(tenants) < self.max_tenants:
+            return tenant
+        return TELEMETRY_OVERFLOW_TENANT
+
+    def record(self, tenant: str, kind: str, count: float = 1.0) -> None:
+        if not tenant or count <= 0:
+            return
+        with self._lock:
+            key = (self._bucket(tenant), kind)
+            self._counts[key] = self._counts.get(key, 0.0) + count
+
+    def attach_kernel_reader(self, reader) -> None:
+        """reader: () -> dict[(tenant, kind), float] — absolute counts
+        read from kernel maps."""
+        with self._lock:
+            if reader not in self._readers:
+                self._readers.append(reader)
+
+    def detach_kernel_reader(self, reader) -> None:
+        with self._lock:
+            if reader in self._readers:
+                self._readers.remove(reader)
+
+    def counts(self) -> dict[tuple[str, str], float]:
+        """Merged (tenant, kind) -> count view: fallback records plus
+        every attached kernel reader's map contents."""
+        with self._lock:
+            merged = dict(self._counts)
+            readers = list(self._readers)
+        for reader in readers:
+            try:
+                for key, value in reader().items():
+                    merged[key] = merged.get(key, 0.0) + value
+            except Exception as exc:  # noqa: BLE001 — telemetry is advisory
+                logger.warning("kernel telemetry reader failed: %s", exc)
+        return merged
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+DEVICE_TELEMETRY = DeviceAccessTelemetry()
+
+
+class _DeviceAccessMetric:
+    """Registry adapter exposing the telemetry table as per-tenant
+    Prometheus series on worker /metrics — samples live in the table
+    (and kernel maps), collected on render."""
+
+    name = "tpumounter_device_access_total"
+    help = ("Device-access events by tenant and kind (grant = mount-path "
+            "cgroup grant; attempt = in-kernel access check, BPF-map "
+            "counted)")
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        counts = DEVICE_TELEMETRY.counts()
+        if not counts:
+            lines.append(f"{self.name} 0")
+        for (tenant, kind), value in sorted(counts.items()):
+            lines.append(
+                f"{self.name}"
+                f"{_fmt_labels({'tenant': tenant, 'kind': kind})} {value}")
+        return lines
+
+    def reset(self) -> None:
+        DEVICE_TELEMETRY.reset()
+
+
+REGISTRY.register(_DeviceAccessMetric())
+
+
 # --- controller ---
 
 @dataclass
@@ -318,6 +579,14 @@ class _CgroupState:
     # companion another chip still needs.
     granted: dict[tuple[int, int], tuple[DeviceRule, ...]]
     base_rules: list[DeviceRule]
+    # Telemetry half (gpu_ext-style): the per-cgroup attempt-counter map
+    # the device program increments, and the tenant ("ns/pod") the
+    # counts attribute to. fd None = kernel maps unavailable (userspace
+    # fallback counting only). Maps are NOT persisted across worker
+    # restarts — attempt counters restart at 0, which the fleet rollup
+    # treats like any counter reset.
+    telemetry_fd: int | None = None
+    tenant: str = ""
 
 
 class V2DeviceController:
@@ -351,6 +620,15 @@ class V2DeviceController:
         # (reaper thread): GC closes fds that an in-flight revoke would
         # otherwise keep using after recycling.
         self._mu = threading.RLock()
+        # In-kernel access telemetry: when this kernel can create BPF
+        # maps, every cgroup's replacement program also counts access
+        # attempts into a per-cgroup hash map the collector reads with
+        # plain lookups (no program swap). Without map support the
+        # worker's userspace fallback counting still runs.
+        self._telemetry_maps = probe_map_support()
+        if self._telemetry_maps:
+            DEVICE_TELEMETRY.attach_kernel_reader(
+                self._kernel_telemetry_counts)
         if self._pinning:
             self._restore_all()
 
@@ -524,9 +802,17 @@ class V2DeviceController:
                 exc.errno or 0,
                 f"cannot query existing device progs on {cgroup_dir} "
                 f"({exc}); refusing to grant blindly") from exc
+        telemetry_fd = None
+        if self._telemetry_maps:
+            try:
+                telemetry_fd = map_create()
+            except BpfError as exc:
+                logger.warning("telemetry map create failed for %s: %s "
+                               "(userspace counting only)", cgroup_dir, exc)
         st = _CgroupState(cgroup_fd=cgroup_fd, original_fds=original_fds,
                           our_fd=None, granted={},
-                          base_rules=list(base_rules or []))
+                          base_rules=list(base_rules or []),
+                          telemetry_fd=telemetry_fd)
         self._state[cgroup_dir] = st
         return st
 
@@ -541,7 +827,9 @@ class V2DeviceController:
         return out
 
     def _swap_program(self, st: _CgroupState) -> None:
-        new_fd = prog_load(build_device_program(self._rules(st)))
+        PROGRAM_SWAPS.inc()
+        new_fd = prog_load(build_device_program(
+            self._rules(st), telemetry_map_fd=st.telemetry_fd))
         try:
             prog_attach(st.cgroup_fd, new_fd)
         except BpfError:
@@ -565,13 +853,56 @@ class V2DeviceController:
         with self._mu:
             return cgroup_dir in self._state
 
-    def grant(self, cgroup_dir: str, dev: TpuDevice,
-              base_rules: list[DeviceRule] | None = None) -> None:
+    def _seed_telemetry(self, st: _CgroupState, devs: list[TpuDevice],
+                        tenant: str) -> None:
+        """Register the grant with the telemetry table: remember the
+        tenant and seed the map keys (hash-map lookups in the program
+        skip unseeded keys). BPF_NOEXIST keeps an already-counting key's
+        value across re-grants."""
+        if tenant:
+            st.tenant = tenant
+        if st.telemetry_fd is None:
+            return
+        for dev in devs:
+            try:
+                map_update(st.telemetry_fd, telemetry_key(dev.major, dev.minor),
+                           0, flags=BPF_NOEXIST)
+            except BpfError as exc:
+                logger.warning("telemetry key seed for %d:%d failed: %s",
+                               dev.major, dev.minor, exc)
+
+    def _kernel_telemetry_counts(self) -> dict[tuple[str, str], float]:
+        """DEVICE_TELEMETRY kernel reader: per-tenant attempt counts from
+        every tracked cgroup's map — pure bpf(BPF_MAP_LOOKUP_ELEM) reads,
+        zero program swaps (the collection contract PROGRAM_SWAPS
+        proves). The whole read runs under _mu: a concurrent revoke or
+        GC closes telemetry fds, and a lookup on a recycled fd number
+        would silently read another cgroup's map."""
+        out: dict[tuple[str, str], float] = {}
         with self._mu:
-            self._grant_locked(cgroup_dir, dev, base_rules)
+            for cg, st in self._state.items():
+                if st.telemetry_fd is None:
+                    continue
+                tenant = st.tenant or cg
+                total = 0.0
+                for key in map_keys(st.telemetry_fd):
+                    value = map_lookup(st.telemetry_fd, key)
+                    if value:
+                        total += value
+                if total:
+                    out[(tenant, "attempt")] = out.get(
+                        (tenant, "attempt"), 0.0) + total
+        return out
+
+    def grant(self, cgroup_dir: str, dev: TpuDevice,
+              base_rules: list[DeviceRule] | None = None,
+              tenant: str = "") -> None:
+        with self._mu:
+            self._grant_locked(cgroup_dir, dev, base_rules, tenant=tenant)
 
     def grant_many(self, cgroup_dir: str, devs: list[TpuDevice],
-                   base_rules: list[DeviceRule] | None = None) -> None:
+                   base_rules: list[DeviceRule] | None = None,
+                   tenant: str = "") -> None:
         """Grant a batch of chips with ONE program swap.
 
         The replacement program carries the full rule set anyway, so N
@@ -582,6 +913,7 @@ class V2DeviceController:
         """
         with self._mu:
             st = self._get_state(cgroup_dir, base_rules)
+            self._seed_telemetry(st, devs, tenant)
             priors = {}
             for dev in devs:
                 key = (dev.major, dev.minor)
@@ -605,8 +937,10 @@ class V2DeviceController:
                         "program swap", len(devs), cgroup_dir)
 
     def _grant_locked(self, cgroup_dir: str, dev: TpuDevice,
-                      base_rules: list[DeviceRule] | None = None) -> None:
+                      base_rules: list[DeviceRule] | None = None,
+                      tenant: str = "") -> None:
         st = self._get_state(cgroup_dir, base_rules)
+        self._seed_telemetry(st, [dev], tenant)
         key = (dev.major, dev.minor)
         prior = st.granted.get(key)
         st.granted[key] = (device_rule(dev),) + tuple(
@@ -697,9 +1031,28 @@ class V2DeviceController:
             os.close(fd)
         if st.our_fd is not None:
             os.close(st.our_fd)
+        if st.telemetry_fd is not None:
+            # Fold the map's final attempt counts into the userspace
+            # table before the fd (and with it the map) goes away: the
+            # exported per-tenant counter must stay monotonic across
+            # revoke/GC, or scrapers read the drop as a counter reset.
+            try:
+                total = 0.0
+                for key in map_keys(st.telemetry_fd):
+                    value = map_lookup(st.telemetry_fd, key)
+                    if value:
+                        total += value
+                if total:
+                    DEVICE_TELEMETRY.record(st.tenant or cgroup_dir,
+                                            "attempt", total)
+            except Exception as exc:  # noqa: BLE001 — telemetry advisory
+                logger.warning("final telemetry harvest for %s failed: %s",
+                               cgroup_dir, exc)
+            os.close(st.telemetry_fd)
         os.close(st.cgroup_fd)
 
     def close(self) -> None:
+        DEVICE_TELEMETRY.detach_kernel_reader(self._kernel_telemetry_counts)
         with self._mu:
             for cgroup_dir in list(self._state):
                 self._close_state(cgroup_dir)

@@ -16,8 +16,10 @@ Two families of verbs:
     migrate start|status|abort     live chip migration between pods
     audit   [--pod POD] [--trace ID] [--op PREFIX]   the audit trail
     trace ID                       all buffered spans for one trace
-                                   (accepts --read-token: the read-only
-                                   observability scope)
+    fleet                          federated per-node fleet rollup
+    slo                            SLO burn-rate evaluation
+                                   (the four above accept --read-token:
+                                   the read-only observability scope)
 
 The reference has no CLI at all (interaction is raw curl,
 docs/guide/QuickStart.md).
@@ -261,6 +263,29 @@ def cmd_trace(args) -> int:
     return 0 if status == 200 else 1
 
 
+def cmd_fleet(args) -> int:
+    status, body = _http("GET", f"{args.master.rstrip('/')}/fleet",
+                         token=_obs_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def cmd_slo(args) -> int:
+    """Print the SLO evaluation; exit 3 when any objective is in breach
+    (scriptable: a deploy gate can `tpumounter slo && roll`)."""
+    status, body = _http("GET", f"{args.master.rstrip('/')}/slo",
+                         token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        breached = any(o.get("breached")
+                       for o in json.loads(body).get("objectives", []))
+    except ValueError:
+        return 1
+    return 3 if breached else 0
+
+
 EXIT_OK = 0
 EXIT_ERROR = 1
 EXIT_REJECTED = 2    # 4xx: bad request, nothing moved
@@ -479,6 +504,17 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("id", help="trace id (X-Tpumounter-Trace response "
                                "header / audit record trace_id)")
     tr.set_defaults(fn=cmd_trace)
+
+    fl = sub.add_parser("fleet", help="federated fleet rollup: per-node "
+                                      "mount p50/p95, warm-pool hit "
+                                      "rate, breaker state")
+    _obs_common(fl)
+    fl.set_defaults(fn=cmd_fleet)
+
+    sl = sub.add_parser("slo", help="SLO burn-rate evaluation (exit 3 "
+                                    "when any objective is in breach)")
+    _obs_common(sl)
+    sl.set_defaults(fn=cmd_slo)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

@@ -161,6 +161,10 @@ class Config:
         _env("RPC_PROBE_TIMEOUT_S", "15")))
     rpc_quiesce_timeout_s: float = field(default_factory=lambda: float(
         _env("RPC_QUIESCE_TIMEOUT_S", "15")))
+    # CollectTelemetry is an in-memory snapshot read — it must fail fast
+    # so one wedged worker cannot stall a whole fleet-collection pass.
+    rpc_telemetry_timeout_s: float = field(default_factory=lambda: float(
+        _env("RPC_TELEMETRY_TIMEOUT_S", "10")))
     # Bounded capped-exponential retry for retriable transport codes
     # (UNAVAILABLE, DEADLINE_EXCEEDED). Safe to retry mutations: AddTPU /
     # RemoveTPU carry idempotency keys, Probe/Quiesce are read-only.
@@ -217,6 +221,30 @@ class Config:
         "TPUMOUNTER_TRACE_RING", "2048")))
     audit_capacity: int = field(default_factory=lambda: int(_env(
         "TPUMOUNTER_AUDIT_CAPACITY", "4096")))
+
+    # --- fleet telemetry + SLO engine (gpumounter_tpu/obs/fleet|slo) ---
+    # How often the master federates every worker's telemetry (RPC with
+    # HTTP-scrape fallback). Also the staleness bound for an on-demand
+    # /fleet read. Cost scales with node count: one CollectTelemetry (a
+    # few KB) per worker per interval over the already-pooled channels
+    # — see docs/FAQ.md on scrape cadence.
+    fleet_scrape_interval_s: float = field(default_factory=lambda: float(
+        _env("FLEET_SCRAPE_INTERVAL_S", "15")))
+    # Declarative SLO objectives as a JSON list (obs/slo.py schema);
+    # "" = the built-in defaults (warm-mount latency, mount success,
+    # heal success).
+    slo_objectives: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_SLO_OBJECTIVES", ""))
+    # Multi-window burn-rate evaluation: a breach needs the burn rate
+    # over BOTH windows to exceed the threshold (fast window = react in
+    # minutes, slow window = ignore blips), the standard multiwindow
+    # alerting shape.
+    slo_fast_window_s: float = field(default_factory=lambda: float(
+        _env("SLO_FAST_WINDOW_S", "300")))
+    slo_slow_window_s: float = field(default_factory=lambda: float(
+        _env("SLO_SLOW_WINDOW_S", "3600")))
+    slo_burn_threshold: float = field(default_factory=lambda: float(
+        _env("SLO_BURN_THRESHOLD", "2.0")))
 
     # --- logging ---
     log_dir: str = field(default_factory=lambda: _env("TPUMOUNTER_LOG_DIR", "/var/log/tpumounter"))

@@ -57,6 +57,11 @@ RECONCILE_QUEUE_DEPTH = REGISTRY.gauge(
 CHIPS_HEALED = REGISTRY.counter(
     "tpumounter_chips_healed_total",
     "Dead chips replaced with healthy ones by the reconciler")
+CHIPS_HEAL_FAILURES = REGISTRY.counter(
+    "tpumounter_chips_heal_failures_total",
+    "Heal passes that found dead chips but failed before recording the "
+    "heal (workqueue backoff re-drives them). With chips_healed_total "
+    "this is the SLO engine's heal-success ratio (obs/slo.py)")
 INTENTS_REGISTERED = REGISTRY.gauge(
     "tpumounter_intents_registered",
     "Pods with a declared elastic intent")
@@ -264,7 +269,26 @@ class ElasticReconciler:
         chips = self._probe(address, pod)
         dead = [c for c in chips if not c.healthy]
         healthy = [c for c in chips if c.healthy]
+        if dead or self._pending_heal.get(key):
+            return self._heal_counted(key, namespace, pod_name, pod,
+                                      intent, address, dead, healthy)
+        return self._converge(key, namespace, pod_name, pod, intent,
+                              address, dead, healthy)
 
+    def _heal_counted(self, key, namespace, pod_name, pod, intent,
+                      address, dead, healthy) -> dict:
+        """A pass with dead chips (or a journaled half-done heal) is a
+        heal attempt: a failure before _record_heal lands counts toward
+        the heal-success SLO (the workqueue still re-drives it)."""
+        try:
+            return self._converge(key, namespace, pod_name, pod, intent,
+                                  address, dead, healthy)
+        except BaseException:
+            CHIPS_HEAL_FAILURES.inc()
+            raise
+
+    def _converge(self, key, namespace, pod_name, pod, intent, address,
+                  dead, healthy) -> dict:
         removed_now = self._remove_chips(
             address, pod, [c.uuid for c in dead], force=True)
         # Journal removals BEFORE attempting the replacement mount: if

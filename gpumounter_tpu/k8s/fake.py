@@ -127,7 +127,12 @@ class FakeKubeClient(KubeClient):
         self._emit("ADDED", pod)
         if self.scheduler_hook is not None:
             self._enqueue_schedule(namespace, name)
-        return copy.deepcopy(pod)
+        # Copy under the store lock: with a zero scheduler delay the
+        # hook thread can be mutating this very dict already, and an
+        # unlocked deepcopy races it ("dictionary changed size during
+        # iteration" — seen as a tier-1 flake).
+        with self._lock:
+            return copy.deepcopy(pod)
 
     # --- the single-worker async scheduler ---
 

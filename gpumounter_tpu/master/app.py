@@ -281,6 +281,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     # raw request path (no other route accepts queries).
     ("GET", re.compile(r"^/audit(?:\?(?P<query>.*))?$"), "audit"),
     ("GET", re.compile(r"^/trace/(?P<tid>[^/?]+)$"), "trace"),
+    # Fleet telemetry plane (gpumounter_tpu/obs/fleet.py + slo.py): one
+    # pane over every node's mount latency / warm-pool / device-access
+    # telemetry, and the SLO burn-rate evaluation over it.
+    ("GET", re.compile(r"^/fleet$"), "fleet"),
+    ("GET", re.compile(r"^/slo$"), "slo"),
 ]
 
 
@@ -307,10 +312,10 @@ class MasterApp:
     #: (TPUMOUNTER_AUTH_READ_TOKEN[_FILE]) instead of piggybacking on
     #: the mutate token. With a read token configured they accept it
     #: (the mutate token always implies read); without one, /metrics
-    #: stays open (probe/scrape back-compat) while /audit and /trace —
-    #: which reveal pod names and chip movements — require the mutate
-    #: token.
-    READ_ROUTES = frozenset({"metrics", "audit", "trace"})
+    #: stays open (probe/scrape back-compat) while /audit, /trace,
+    #: /fleet and /slo — which reveal pod/tenant names and chip
+    #: movements — require the mutate token.
+    READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -358,6 +363,18 @@ class MasterApp:
         from gpumounter_tpu.migrate import MigrationCoordinator
         self.migrations = MigrationCoordinator(
             kube, self.registry, self._client_factory, cfg=self.cfg)
+        # Fleet telemetry plane: the collector federates every worker's
+        # telemetry over the same pooled channels and feeds the SLO
+        # burn-rate engine; breaches land as k8s Events + audit records.
+        # The background poll loop only runs after an explicit
+        # fleet.start() (master/main.py) — the /fleet and /slo routes
+        # collect on demand when the rollup is stale, so tests and the
+        # CLI work without it.
+        from gpumounter_tpu.obs.fleet import FleetCollector
+        from gpumounter_tpu.obs.slo import SloEngine
+        self.slo = SloEngine(cfg=self.cfg, kube=kube)
+        self.fleet = FleetCollector(self.registry, self._client_factory,
+                                    cfg=self.cfg, slo=self.slo)
 
     # --- plumbing ---
 
@@ -383,8 +400,10 @@ class MasterApp:
     #: probe/scrape surfaces a cluster hits every few seconds: never
     #: traced — ~14k spans/day of healthz+metrics noise would rotate
     #: the 2048-span ring and evict the mount traces operators actually
-    #: query (RUNBOOK "Debugging a slow mount").
-    UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics"})
+    #: query (RUNBOOK "Debugging a slow mount"). /fleet and /slo are
+    #: dashboard-polled scrape surfaces of the same kind.
+    UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
+                                 "slo"})
 
     def _dispatch(self, name: str, match, method: str, path: str,
                   body: bytes, headers: dict[str, str]
@@ -512,7 +531,36 @@ class MasterApp:
         return 200, "text/plain", "ok\n"
 
     def _route_metrics(self, match, body, headers):
+        accept = next((v for k, v in headers.items()
+                       if k.lower() == "accept"), "")
+        if "application/openmetrics-text" in accept:
+            # OpenMetrics negotiation: histogram bucket lines carry
+            # their trace-id exemplars (utils/metrics.py) — the join
+            # from a latency outlier to `tpumounter trace <id>`.
+            return (200, "application/openmetrics-text; version=1.0.0",
+                    REGISTRY.render(openmetrics=True))
         return 200, "text/plain; version=0.0.4", REGISTRY.render()
+
+    def _route_fleet(self, match, body, headers):
+        """The federated fleet rollup: per-node mount p50/p95, warm-pool
+        hit rate, breaker state, device-access telemetry — collected on
+        demand when the cached rollup is older than the scrape
+        interval."""
+        import json as jsonlib
+        payload = self.fleet.payload(
+            max_age_s=self.cfg.fleet_scrape_interval_s)
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
+
+    def _route_slo(self, match, body, headers):
+        """SLO burn-rate evaluation over the fleet rollup. Refreshes the
+        rollup first when stale so the burn numbers describe now, not
+        the last background pass (refresh only — building the full
+        fleet payload here would be discarded work)."""
+        import json as jsonlib
+        self.fleet.refresh_if_stale(self.cfg.fleet_scrape_interval_s)
+        return 200, "application/json", \
+            jsonlib.dumps(self.slo.payload(), indent=1) + "\n"
 
     def _route_audit(self, match, body, headers):
         """Query the append-only audit trail. Filters (all optional):

@@ -27,6 +27,12 @@ def main() -> None:
     # The elastic loop re-reads intents from pod annotations on start, so
     # declared desires survive master restarts with no extra store.
     app.elastic.start()
+    # Fleet telemetry poll loop: federate every worker's telemetry each
+    # FLEET_SCRAPE_INTERVAL_S and evaluate the SLO burn rates (breaches
+    # emit k8s Events + audit records). Restart-safe: workers report
+    # absolute counters and the rollup is node-keyed, so a restarted
+    # collector never double-counts.
+    app.fleet.start()
     # Migrations journal to pod annotations the same way: a master that
     # died mid-migration re-adopts and re-drives it from the recorded
     # phase instead of leaving a tenant half-drained.
@@ -42,6 +48,7 @@ def main() -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        app.fleet.stop()
         app.elastic.stop()
         httpd.shutdown()
 

@@ -13,9 +13,17 @@ pod X's chips, when, and why was it slow" —
     operation (actor, pod, chips, idempotency key, outcome, duration,
     trace id), queryable via the master's /audit route and the
     `tpumounter audit` / `tpumounter trace <id>` CLI verbs.
+  * obs.fleet — master-side federation of every worker's telemetry
+    (CollectTelemetry RPC over the pooled channels, HTTP-scrape
+    fallback for legacy workers) into a node-keyed fleet rollup
+    served at /fleet and by `tpumounter fleet`.
+  * obs.slo — declarative objectives with multi-window burn-rate
+    evaluation over the fleet rollup (/slo, `tpumounter slo`);
+    breaches post k8s Events and audit records.
 
 Stdlib-only on purpose: imported by the mount path, which must stay
-importable without grpc (utils/lazy_grpc.py policy).
+importable without grpc (utils/lazy_grpc.py policy — obs.fleet takes
+its RPC transport as an injected client factory).
 """
 
 from gpumounter_tpu.obs import audit, trace

@@ -1,0 +1,540 @@
+"""Fleet telemetry plane: master-side federation of worker metrics.
+
+After PR 4 each daemon answers only for itself; this module gives the
+master one pane over every node. A FleetCollector periodically pulls
+each worker's telemetry snapshot — mount-latency histogram (with trace
+exemplars), mount/unmount counters, warm-pool hit rate, per-tenant
+device-access counts, eBPF program-swap count — over the existing
+pooled channels via the CollectTelemetry RPC, degrades to scraping the
+worker's HTTP /metrics exposition for legacy workers (UNIMPLEMENTED or
+an unparseable payload), and rolls everything into a node-keyed fleet
+model served at /fleet and fed to the SLO burn-rate engine (obs/slo.py).
+
+No double counting by construction: per-node state is a dict keyed by
+node name whose entries are replaced wholesale each pass, and every
+worker-reported number is an absolute counter/histogram value, never a
+delta — so a restarted collector (or an extra collection pass) cannot
+inflate the rollup. The chaos harness asserts exactly that (invariant 8).
+
+Stdlib-only (lazy-grpc policy: the worker imports the snapshot half on
+its mount path; RPC transport is injected via the client factory).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.request
+from concurrent import futures
+
+from gpumounter_tpu.cgroup.ebpf import DEVICE_TELEMETRY
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    estimate_quantile,
+)
+
+logger = get_logger("obs.fleet")
+
+TELEMETRY_SCHEMA = "tpumounter-telemetry/1"
+
+FLEET_COLLECTIONS = REGISTRY.counter(
+    "tpumounter_fleet_collections_total",
+    "Fleet telemetry collection passes by node outcome (rpc / scrape / "
+    "error)")
+FLEET_NODES = REGISTRY.gauge(
+    "tpumounter_fleet_nodes",
+    "Nodes in the last fleet rollup")
+FLEET_COLLECT_DURATION = REGISTRY.histogram(
+    "tpumounter_fleet_collect_duration_seconds",
+    "Wall time of one whole-fleet collection pass")
+
+#: (exposition name, snapshot counter key) — the counters a worker
+#: snapshot carries and the scrape fallback recovers. Reading by name
+#: through REGISTRY.find keeps this module import-light (it must not
+#: drag worker-only modules into the master).
+_COUNTER_NAMES = (
+    ("tpumounter_mount_total", "mount_total"),
+    ("tpumounter_unmount_total", "unmount_total"),
+    ("tpumounter_warm_pool_hits_total", "warm_pool_hits"),
+    ("tpumounter_warm_pool_misses_total", "warm_pool_misses"),
+    ("tpumounter_mount_rollback_failures_total", "rollback_failures"),
+    ("tpumounter_ebpf_program_swaps_total", "ebpf_program_swaps"),
+)
+
+#: master-side counters folded into the rollup (heal / migration story
+#: lives in the master process, not on workers).
+_MASTER_COUNTER_NAMES = (
+    ("tpumounter_chips_healed_total", "heals"),
+    ("tpumounter_chips_heal_failures_total", "heal_failures"),
+    ("tpumounter_migrations_total", "migrations"),
+    ("tpumounter_worker_breaker_trips_total", "breaker_trips"),
+)
+
+
+def _labeled_totals(metric) -> dict[str, float]:
+    """Counter snapshot folded to {single-label-value or "": total}."""
+    if metric is None or not isinstance(metric, (Counter, Gauge)):
+        return {}
+    out: dict[str, float] = {}
+    for key, value in metric.snapshot().items():
+        label = key[0][1] if key else ""
+        out[label] = out.get(label, 0.0) + value
+    return out
+
+
+def worker_telemetry_snapshot(cfg=None, registry=None) -> dict:
+    """This process's telemetry snapshot — the CollectTelemetry payload
+    and the worker ops port's /telemetry body. All values are absolute
+    (counters since process start), so consumers can diff or re-read
+    freely without double counting."""
+    reg = registry or REGISTRY
+    latency = reg.find("tpumounter_mount_latency_seconds")
+    mount_hist: dict = {"buckets": [], "count": 0, "sum": 0.0,
+                       "exemplars": []}
+    if isinstance(latency, Histogram):
+        counts = [0] * (len(latency.buckets) + 1)
+        total = 0.0
+        exemplars = []
+        for entry in latency.snapshot().values():
+            for i, c in enumerate(entry["counts"]):
+                counts[i] += c
+            total += entry["sum"]
+            for idx, (tid, value, ts) in entry["exemplars"].items():
+                bound = (latency.buckets[idx]
+                         if idx < len(latency.buckets) else "+Inf")
+                exemplars.append({"le": bound, "trace_id": tid,
+                                  "value": value, "at": ts})
+        mount_hist = {
+            "buckets": [[b, counts[i]] for i, b in enumerate(latency.buckets)],
+            "count": counts[-1],
+            "sum": round(total, 6),
+            "exemplars": exemplars,
+        }
+    counters: dict[str, dict[str, float]] = {}
+    for name, key in _COUNTER_NAMES:
+        counters[key] = _labeled_totals(reg.find(name))
+    device_access: dict[str, dict[str, float]] = {}
+    for (tenant, kind), value in DEVICE_TELEMETRY.counts().items():
+        device_access.setdefault(tenant, {})[kind] = value
+    snap = {
+        "schema": TELEMETRY_SCHEMA,
+        "at": round(time.time(), 3),
+        "mount_latency": mount_hist,
+        "counters": counters,
+        "device_access": device_access,
+    }
+    if cfg is not None and getattr(cfg, "node_name", ""):
+        snap["node"] = cfg.node_name
+    return snap
+
+
+def parse_telemetry(raw: object) -> dict | None:
+    """Tolerant payload parse: absent (empty/None), wrong-typed,
+    non-JSON, non-object, or wrong-schema input — anything a legacy or
+    buggy peer could put on the wire — yields None, never an exception.
+    The collector then falls back to the HTTP scrape path."""
+    if not raw or not isinstance(raw, str):
+        return None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != TELEMETRY_SCHEMA:
+        return None
+    return doc
+
+
+# --- HTTP-scrape fallback (legacy workers) ---
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^{}]*)\})?\s+(?P<value>[-+0-9.eE]+|[+-]?Inf|NaN)")
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_prometheus_text(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Prometheus text exposition -> {metric name: [(labels, value)]}.
+    Unparseable lines are skipped (a legacy worker's exposition is not
+    under our control)."""
+    out: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        match = _SERIES_RE.match(line)
+        if match is None:
+            continue
+        labels = dict(_LABEL_RE.findall(match.group("labels") or ""))
+        try:
+            value = float(match.group("value").replace("Inf", "inf"))
+        except ValueError:
+            continue
+        out.setdefault(match.group("name"), []).append((labels, value))
+    return out
+
+
+def snapshot_from_prometheus(text: str) -> dict:
+    """Build the same snapshot shape worker_telemetry_snapshot produces
+    from a scraped /metrics exposition — the degraded path for workers
+    without the telemetry RPC (no exemplars there; the classic text
+    format cannot carry them)."""
+    series = parse_prometheus_text(text)
+    buckets: dict[float, float] = {}
+    inf_count = 0.0
+    for labels, value in series.get("tpumounter_mount_latency_seconds_bucket",
+                                    []):
+        le = labels.get("le", "")
+        if le == "+Inf":
+            inf_count += value
+        else:
+            try:
+                bound = float(le)
+            except ValueError:
+                continue
+            buckets[bound] = buckets.get(bound, 0.0) + value
+    total = sum(v for _, v in
+                series.get("tpumounter_mount_latency_seconds_sum", []))
+    counters: dict[str, dict[str, float]] = {}
+    for name, key in _COUNTER_NAMES:
+        folded: dict[str, float] = {}
+        for labels, value in series.get(name, []):
+            label = next(iter(sorted(labels.values())), "")
+            folded[label] = folded.get(label, 0.0) + value
+        counters[key] = folded
+    device_access: dict[str, dict[str, float]] = {}
+    for labels, value in series.get("tpumounter_device_access_total", []):
+        tenant = labels.get("tenant", "")
+        if tenant:
+            device_access.setdefault(tenant, {})[
+                labels.get("kind", "")] = value
+    return {
+        "schema": TELEMETRY_SCHEMA,
+        "at": round(time.time(), 3),
+        "mount_latency": {
+            "buckets": [[b, buckets[b]] for b in sorted(buckets)],
+            "count": inf_count,
+            "sum": total,
+            "exemplars": [],
+        },
+        "counters": counters,
+        "device_access": device_access,
+    }
+
+
+# --- rollup helpers ---
+
+def _hist_quantile_ms(hist: dict, q: float) -> float:
+    pairs = hist.get("buckets") or []
+    count = hist.get("count", 0)
+    if not pairs or not count:
+        return 0.0
+    bounds = tuple(b for b, _ in pairs)
+    counts = [c for _, c in pairs] + [count]
+    return round(estimate_quantile(bounds, counts, q) * 1000.0, 3)
+
+
+def _counter(snapshot: dict, key: str, label: str | None = None) -> float:
+    folded = (snapshot.get("counters") or {}).get(key) or {}
+    if label is None:
+        return float(sum(folded.values()))
+    return float(folded.get(label, 0.0))
+
+
+def _node_rollup(snapshot: dict) -> dict:
+    hist = snapshot.get("mount_latency") or {}
+    hits = _counter(snapshot, "warm_pool_hits")
+    misses = _counter(snapshot, "warm_pool_misses")
+    lookups = hits + misses
+    return {
+        "mount": {
+            "count": hist.get("count", 0),
+            "p50_ms": _hist_quantile_ms(hist, 0.50),
+            "p95_ms": _hist_quantile_ms(hist, 0.95),
+            "success": _counter(snapshot, "mount_total", "success"),
+            "error": _counter(snapshot, "mount_total", "error"),
+            # raw cumulative bucket pairs so the fleet view can merge
+            # histograms across nodes (same bucket layout everywhere)
+            "buckets": list(hist.get("buckets") or []),
+        },
+        "warm_pool": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+        },
+        "rollback_failures": _counter(snapshot, "rollback_failures"),
+        "ebpf_program_swaps": _counter(snapshot, "ebpf_program_swaps"),
+        "device_access": snapshot.get("device_access") or {},
+        "exemplars": (snapshot.get("mount_latency") or {}).get(
+            "exemplars", []),
+    }
+
+
+class FleetCollector:
+    """Periodic master-side federation of every worker's telemetry.
+
+    `workers` is the WorkerRegistry (node -> address + the shared
+    circuit breaker); `client_factory` builds WorkerClients over the
+    pooled channels. Collection per node: CollectTelemetry RPC first;
+    UNIMPLEMENTED (legacy worker) or an unparseable payload degrades to
+    scraping http://<ip>:<metrics_port>/metrics. A node that answers
+    neither keeps its previous entry, marked stale with the error — a
+    blip must not blank a node out of the fleet view.
+    """
+
+    def __init__(self, workers, client_factory, cfg=None, slo=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        self.workers = workers
+        self.client_factory = client_factory
+        self.slo = slo
+        self.interval_s = cfg.fleet_scrape_interval_s
+        #: per-node collection fan-out width: a few wedged workers each
+        #: burn their full RPC deadline, so a serial pass would stall
+        #: the whole fleet behind them.
+        self.collect_width = 16
+        self._lock = threading.Lock()
+        # Single-flight guard: concurrent stale observers (dashboards
+        # polling /fleet at the interval edge) must not each launch
+        # their own whole-fleet fan-out. RLock: collect_once holds it,
+        # and refresh_if_stale re-enters it around the re-check.
+        self._collect_mu = threading.RLock()
+        #: node name -> node entry; replaced per pass, keyed by node, so
+        #: collector restarts and repeated passes cannot double-count.
+        self._nodes: dict[str, dict] = {}
+        self._collected_at = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- collection ---
+
+    def _scrape_url(self, ip: str) -> str:
+        return f"http://{ip}:{self.cfg.metrics_port}/metrics"
+
+    def _scrape_token(self) -> str | None:
+        from gpumounter_tpu.utils.auth import resolve_read_token, resolve_token
+        try:
+            return resolve_read_token(self.cfg) or resolve_token(self.cfg)
+        except Exception:  # noqa: BLE001 — scrape just goes credential-less
+            return None
+
+    def _scrape(self, ip: str) -> dict:
+        req = urllib.request.Request(self._scrape_url(ip))
+        token = self._scrape_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=self.cfg.rpc_telemetry_timeout_s) as resp:
+            return snapshot_from_prometheus(resp.read().decode())
+
+    @staticmethod
+    def _is_unimplemented(exc: Exception) -> bool:
+        code = getattr(exc, "code", None)
+        if callable(code):
+            try:
+                return getattr(code(), "name", "") == "UNIMPLEMENTED"
+            except Exception:  # noqa: BLE001 — non-grpc .code()
+                return False
+        return False
+
+    def _collect_node(self, node: str, address: str) -> dict:
+        ip = address.rsplit(":", 1)[0]
+        entry = {"address": address, "collected_at": round(time.time(), 3)}
+        snapshot = None
+        mode = "rpc"
+        try:
+            with self.client_factory(address) as client:
+                resp = client.collect_telemetry()
+            snapshot = parse_telemetry(resp.telemetry)
+            if snapshot is None:
+                logger.warning(
+                    "worker %s answered CollectTelemetry with an "
+                    "absent/unparseable payload; falling back to scrape",
+                    node)
+                mode = "scrape"
+        except Exception as exc:  # noqa: BLE001 — gRPC boundary
+            if not self._is_unimplemented(exc):
+                raise
+            mode = "scrape"  # legacy (reference) worker: no telemetry RPC
+        if snapshot is None:
+            snapshot = self._scrape(ip)
+        entry["mode"] = mode
+        entry.update(_node_rollup(snapshot))
+        return entry
+
+    def _collect_one(self, node: str, ip: str) -> tuple[str, dict]:
+        """One node's collection, exception-safe (runs on the fan-out
+        pool). Collection spans are scrape noise: deferred-and-dropped
+        so steady-state passes never rotate real operation traces out
+        of the ring (per-thread contextvar, so this applies to the
+        pool thread regardless of who triggered the pass)."""
+        address = f"{ip}:{self.cfg.worker_port}"
+        try:
+            with trace.deferred():
+                entry = self._collect_node(node, address)
+            FLEET_COLLECTIONS.inc(outcome=entry["mode"])
+        except Exception as exc:  # noqa: BLE001 — one node must not
+            FLEET_COLLECTIONS.inc(outcome="error")  # fail the pass
+            logger.warning("telemetry collection for %s failed: %s",
+                           node, exc)
+            with self._lock:
+                prior = self._nodes.get(node)
+            entry = dict(prior) if prior else {"address": address}
+            entry["stale"] = True
+            entry["error"] = f"{type(exc).__name__}: {exc}"
+        retry_after = None
+        breaker = getattr(self.workers, "breaker", None)
+        if breaker is not None:
+            retry_after = breaker.retry_after(address)
+        entry["breaker"] = "open" if retry_after is not None else "closed"
+        return node, entry
+
+    def collect_once(self) -> dict:
+        """One whole-fleet pass; returns the fresh rollup. Nodes come
+        from the registry snapshot (the watch-maintained cache), so the
+        pass costs zero Kubernetes API calls; per-node collection fans
+        out across a bounded pool so a few deadline-burning workers
+        cannot stall the pass serially. Single-flight under
+        _collect_mu."""
+        with self._collect_mu:
+            t0 = time.monotonic()
+            items = sorted(self.workers.registry_snapshot().items())
+            fresh: dict[str, dict] = {}
+            if items:
+                width = max(1, min(self.collect_width, len(items)))
+                with futures.ThreadPoolExecutor(
+                        max_workers=width,
+                        thread_name_prefix="fleet-collect") as pool:
+                    for node, entry in pool.map(
+                            lambda it: self._collect_one(*it), items):
+                        fresh[node] = entry
+            with self._lock:
+                self._nodes = fresh
+                self._collected_at = time.time()
+            FLEET_NODES.set(float(len(fresh)))
+            FLEET_COLLECT_DURATION.observe(time.monotonic() - t0)
+            rollup = self.payload(max_age_s=None)
+            if self.slo is not None:
+                self.slo.ingest(rollup)
+                self.slo.evaluate()
+            return rollup
+
+    def refresh_if_stale(self, max_age_s: float | None) -> None:
+        """Collect only when the cached rollup is older than max_age_s.
+        Single-flight: a caller that lost the race re-checks under the
+        collection lock and returns the winner's fresh rollup instead
+        of launching a second fan-out (the FAQ's 'polling faster than
+        the interval gets the cache' promise)."""
+        if max_age_s is None:
+            return
+
+        def _stale() -> bool:
+            with self._lock:
+                return (time.time() - self._collected_at) > max_age_s
+
+        if not _stale():
+            return
+        with self._collect_mu:
+            if _stale():
+                self.collect_once()
+
+    # --- the fleet model ---
+
+    def payload(self, max_age_s: float | None = None) -> dict:
+        """The /fleet response. With `max_age_s`, a stale (or empty)
+        rollup triggers a synchronous (single-flight) collection first —
+        so the route works without the background loop (tests, CLI,
+        bench)."""
+        self.refresh_if_stale(max_age_s)
+        with self._lock:
+            nodes = {n: dict(e) for n, e in self._nodes.items()}
+            at = self._collected_at
+        fleet = {
+            "nodes": len(nodes),
+            "mount_count": 0,
+            "mount_success": 0.0,
+            "mount_error": 0.0,
+            "warm_pool_hits": 0.0,
+            "warm_pool_misses": 0.0,
+            "breakers_open": 0,
+            "rollback_failures": 0.0,
+        }
+        worst_p95 = 0.0
+        for entry in nodes.values():
+            mount = entry.get("mount") or {}
+            fleet["mount_count"] += mount.get("count", 0)
+            fleet["mount_success"] += mount.get("success", 0.0)
+            fleet["mount_error"] += mount.get("error", 0.0)
+            warm = entry.get("warm_pool") or {}
+            fleet["warm_pool_hits"] += warm.get("hits", 0.0)
+            fleet["warm_pool_misses"] += warm.get("misses", 0.0)
+            fleet["rollback_failures"] += entry.get("rollback_failures", 0.0)
+            if entry.get("breaker") == "open":
+                fleet["breakers_open"] += 1
+            worst_p95 = max(worst_p95, mount.get("p95_ms", 0.0))
+        lookups = fleet["warm_pool_hits"] + fleet["warm_pool_misses"]
+        fleet["warm_pool_hit_rate"] = (
+            round(fleet["warm_pool_hits"] / lookups, 4) if lookups else 0.0)
+        fleet["worst_node_p95_ms"] = worst_p95
+        # Fleet-wide latency quantiles from the merged histograms: sum
+        # per-bound cumulative counts across nodes (same bucket layout
+        # everywhere — one Histogram class).
+        merged: dict[float, float] = {}
+        merged_count = 0.0
+        for entry in nodes.values():
+            mount = entry.get("mount") or {}
+            for bound, cum in mount.get("buckets") or []:
+                merged[float(bound)] = merged.get(float(bound), 0.0) + cum
+            merged_count += mount.get("count", 0)
+        merged_hist = {"buckets": [[b, merged[b]] for b in sorted(merged)],
+                       "count": merged_count}
+        fleet["p50_ms"] = _hist_quantile_ms(merged_hist, 0.50)
+        fleet["p95_ms"] = _hist_quantile_ms(merged_hist, 0.95)
+        fleet["mount_buckets"] = merged_hist["buckets"]
+        master = {key: (REGISTRY.find(name).total()
+                        if isinstance(REGISTRY.find(name), Counter) else 0.0)
+                  for name, key in _MASTER_COUNTER_NAMES}
+        return {
+            "at": round(at, 3),
+            "interval_s": self.interval_s,
+            "nodes": nodes,
+            "fleet": fleet,
+            "master": master,
+        }
+
+    # --- the poll loop (master/main.py) ---
+
+    def start(self) -> "FleetCollector":
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="fleet-collector", daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                # Collection passes are background maintenance: deferred
+                # spans keep steady-state scraping from rotating real
+                # operation traces out of the ring (same discipline as
+                # the elastic resync).
+                with trace.deferred():
+                    self.collect_once()
+            except Exception as exc:  # noqa: BLE001 — keep the loop up
+                logger.warning("fleet collection pass failed: %s", exc)
+            self._stop.wait(self.interval_s)

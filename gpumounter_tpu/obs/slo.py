@@ -1,0 +1,322 @@
+"""Declarative SLOs with multi-window burn-rate evaluation.
+
+An Objective declares a good-events target over the fleet rollup
+(obs/fleet.py): either a latency objective ("this fraction of mounts
+must complete within threshold_s", computed from the merged mount
+histogram's buckets) or a ratio objective (good vs bad counter keys,
+e.g. heal success). The engine keeps cumulative (good, total) samples
+per objective and evaluates the burn rate — the fraction of the error
+budget being consumed — over two windows:
+
+    burn = (bad / total within window) / (1 - target)
+
+A breach requires the burn to exceed the threshold over BOTH the fast
+window (react within minutes) and the slow window (ignore blips) with
+observed traffic in the fast window — the standard multiwindow
+multi-burn-rate alerting shape. Breach transitions emit a Kubernetes
+Event (reason TPUSLOBurnRate) and an audit record carrying the
+evaluation's trace id, so "the pager fired" joins the same story the
+/audit and /trace routes tell.
+
+Counter resets (worker restarts) can only shrink cumulative values;
+window deltas are clamped at zero so a restart reads as "no traffic",
+never as negative burn. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("obs.slo")
+
+SLO_BURN_RATE = REGISTRY.gauge(
+    "tpumounter_slo_burn_rate",
+    "Error-budget burn rate by objective and window (1.0 = consuming "
+    "budget exactly at the sustainable rate)")
+SLO_BREACHES = REGISTRY.counter(
+    "tpumounter_slo_breaches_total",
+    "Multi-window burn-rate breach transitions by objective")
+SLO_BREACHED = REGISTRY.gauge(
+    "tpumounter_slo_breached",
+    "1 while the objective is in breach (both windows over threshold)")
+
+
+class ObjectiveError(ValueError):
+    """An SLO objective declaration is invalid."""
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str
+    kind: str                 # "latency" | "ratio"
+    target: float             # good fraction target in (0, 1)
+    threshold_s: float = 0.0  # latency: the histogram bound that is "good"
+    good: str = ""            # ratio: rollup counter key for good events
+    bad: str = ""             # ratio: rollup counter key for bad events
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "ratio"):
+            raise ObjectiveError(f"{self.name}: unknown kind {self.kind!r}")
+        if not 0.0 < self.target < 1.0:
+            raise ObjectiveError(
+                f"{self.name}: target must be in (0, 1), got {self.target}")
+        if self.kind == "latency" and self.threshold_s <= 0:
+            raise ObjectiveError(f"{self.name}: latency needs threshold_s")
+        if self.kind == "ratio" and not (self.good and self.bad):
+            raise ObjectiveError(f"{self.name}: ratio needs good and bad keys")
+
+
+#: the built-in objectives (overridable via TPUMOUNTER_SLO_OBJECTIVES):
+#: warm-mount latency (the PR 5 fast path's p95 < 50 ms promise, stated
+#: as "95% of mounts within 50 ms"), mount success, and heal success.
+DEFAULT_OBJECTIVES: tuple[Objective, ...] = (
+    Objective(name="mount-latency-50ms", kind="latency", threshold_s=0.05,
+              target=0.95,
+              description="95% of hot-mounts complete within 50 ms "
+                          "(warm-path latency promise)"),
+    Objective(name="mount-success", kind="ratio", target=0.999,
+              good="mount_success", bad="mount_error",
+              description="99.9% of mount operations succeed"),
+    Objective(name="heal-success", kind="ratio", target=0.99,
+              good="heals", bad="heal_failures",
+              description="99% of chip heals succeed"),
+)
+
+
+def objectives_from_config(cfg) -> tuple[Objective, ...]:
+    """TPUMOUNTER_SLO_OBJECTIVES (a JSON list of Objective fields) or
+    the defaults. A malformed declaration fails loudly at startup —
+    silently alerting on nothing would be worse than not booting."""
+    raw = getattr(cfg, "slo_objectives", "") or ""
+    if not raw.strip():
+        return DEFAULT_OBJECTIVES
+    try:
+        docs = json.loads(raw)
+    except ValueError as exc:
+        raise ObjectiveError(f"TPUMOUNTER_SLO_OBJECTIVES is not JSON: {exc}")
+    if not isinstance(docs, list):
+        raise ObjectiveError("TPUMOUNTER_SLO_OBJECTIVES must be a JSON list")
+    return tuple(Objective(**doc) for doc in docs)
+
+
+def _good_total(objective: Objective, rollup: dict) -> tuple[float, float]:
+    """Cumulative (good, total) for one objective from a fleet rollup."""
+    fleet = rollup.get("fleet") or {}
+    if objective.kind == "latency":
+        total = float(fleet.get("mount_count", 0))
+        good = 0.0
+        best_bound = None
+        for bound, cum in fleet.get("mount_buckets") or []:
+            # the largest bucket bound <= threshold carries the
+            # cumulative count of "good" (fast-enough) mounts
+            if float(bound) <= objective.threshold_s + 1e-12 and \
+                    (best_bound is None or float(bound) > best_bound):
+                best_bound = float(bound)
+                good = float(cum)
+        return good, total
+    counters = {**(rollup.get("master") or {}),
+                "mount_success": fleet.get("mount_success", 0.0),
+                "mount_error": fleet.get("mount_error", 0.0)}
+    good = float(counters.get(objective.good, 0.0))
+    bad = float(counters.get(objective.bad, 0.0))
+    return good, good + bad
+
+
+@dataclass
+class _ObjectiveState:
+    objective: Objective
+    #: cumulative (monotonic time, good, total) samples, newest last
+    samples: deque = field(default_factory=lambda: deque(maxlen=4096))
+    breached: bool = False
+
+
+class SloEngine:
+    """Ingests fleet rollups, evaluates burn rates, emits breaches."""
+
+    def __init__(self, cfg=None, kube=None,
+                 objectives: tuple[Objective, ...] | None = None,
+                 clock=time.monotonic):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        self.kube = kube
+        self.clock = clock
+        self.fast_window_s = cfg.slo_fast_window_s
+        self.slow_window_s = cfg.slo_slow_window_s
+        self.burn_threshold = cfg.slo_burn_threshold
+        self._states = {
+            o.name: _ObjectiveState(o)
+            for o in (objectives if objectives is not None
+                      else objectives_from_config(cfg))}
+        self._evaluated_at = 0.0
+        # The background collector thread ingests while /slo request
+        # threads evaluate: sample deques and breach-state transitions
+        # share one lock (breach emission — Event POST, audit — runs
+        # outside it so a slow API server cannot stall ingestion).
+        self._lock = threading.Lock()
+
+    # --- sampling ---
+
+    def ingest(self, rollup: dict) -> None:
+        """Record one fleet rollup's cumulative counts (called by the
+        FleetCollector after every pass). Idempotent per pass — values
+        are cumulative, so re-ingesting the same rollup adds a sample
+        with identical counts, never double-counts events."""
+        now = self.clock()
+        with self._lock:
+            for state in self._states.values():
+                good, total = _good_total(state.objective, rollup)
+                state.samples.append((now, good, total))
+
+    def _window_burn(self, state: _ObjectiveState, now: float,
+                     window_s: float) -> tuple[float, float]:
+        """(burn rate, total events) over the trailing window. Baseline
+        is the newest sample at or before the window start — or zero
+        when history is shorter than the window (an engine that just
+        started alerts on everything it has seen, by design: a breach
+        in progress must not hide behind a restart)."""
+        samples = list(state.samples)
+        if not samples:
+            return 0.0, 0.0
+        latest_t, latest_good, latest_total = samples[-1]
+        base_good = base_total = 0.0
+        for t, good, total in reversed(samples):
+            if now - t >= window_s:
+                base_good, base_total = good, total
+                break
+        # clamp: a counter reset (worker restart) shrinks cumulative
+        # values — read as "no traffic", never negative burn.
+        d_total = max(0.0, latest_total - base_total)
+        d_good = min(max(0.0, latest_good - base_good), d_total)
+        if d_total <= 0:
+            return 0.0, 0.0
+        bad_ratio = (d_total - d_good) / d_total
+        budget = 1.0 - state.objective.target
+        return bad_ratio / budget if budget > 0 else 0.0, d_total
+
+    # --- evaluation ---
+
+    def evaluate(self) -> dict:
+        """Evaluate every objective over both windows; emit Events +
+        audit records on breach transitions. Returns the /slo payload."""
+        now = self.clock()
+        out = []
+        breaches: list[tuple[Objective, float, float]] = []
+        with self._lock:
+            for state in self._states.values():
+                burn_fast, events_fast = self._window_burn(
+                    state, now, self.fast_window_s)
+                burn_slow, _ = self._window_burn(state, now,
+                                                 self.slow_window_s)
+                SLO_BURN_RATE.set(round(burn_fast, 4),
+                                  objective=state.objective.name,
+                                  window="fast")
+                SLO_BURN_RATE.set(round(burn_slow, 4),
+                                  objective=state.objective.name,
+                                  window="slow")
+                breached = (events_fast > 0
+                            and burn_fast >= self.burn_threshold
+                            and burn_slow >= self.burn_threshold)
+                if breached and not state.breached:
+                    # transition recorded under the lock (exactly one
+                    # concurrent evaluator wins); emission happens after
+                    breaches.append((state.objective, burn_fast,
+                                     burn_slow))
+                elif state.breached and not breached:
+                    logger.info("SLO %s recovered (burn fast=%.2f "
+                                "slow=%.2f)", state.objective.name,
+                                burn_fast, burn_slow)
+                state.breached = breached
+                SLO_BREACHED.set(1.0 if breached else 0.0,
+                                 objective=state.objective.name)
+                latest = (state.samples[-1] if state.samples
+                          else (now, 0.0, 0.0))
+                _, good, total = latest
+                out.append({
+                    **asdict(state.objective),
+                    "sli": round(good / total, 6) if total else None,
+                    "good_events": good,
+                    "total_events": total,
+                    "burn_fast": round(burn_fast, 4),
+                    "burn_slow": round(burn_slow, 4),
+                    "breached": breached,
+                })
+        for objective, burn_fast, burn_slow in breaches:
+            self._emit_breach(objective, burn_fast, burn_slow)
+        self._evaluated_at = time.time()
+        return {
+            "evaluated_at": round(self._evaluated_at, 3),
+            "burn_threshold": self.burn_threshold,
+            "windows_s": {"fast": self.fast_window_s,
+                          "slow": self.slow_window_s},
+            "objectives": out,
+        }
+
+    def payload(self) -> dict:
+        return self.evaluate()
+
+    # --- breach emission ---
+
+    def _emit_breach(self, objective: Objective, burn_fast: float,
+                     burn_slow: float) -> None:
+        """One breach transition: counter, audit record (inside a span,
+        so the record carries a trace id — the audit trail's invariant),
+        and a Kubernetes Event where operators look."""
+        SLO_BREACHES.inc(objective=objective.name)
+        message = (
+            f"SLO {objective.name} burning error budget at "
+            f"{burn_fast:.1f}x (fast window) / {burn_slow:.1f}x (slow "
+            f"window), threshold {self.burn_threshold:.1f}x: "
+            f"{objective.description or objective.kind}")
+        logger.warning("%s", message)
+        with trace.span("slo.breach", objective=objective.name):
+            AUDIT.record(
+                "slo.breach", actor="slo-engine",
+                outcome=f"breach: {objective.name}",
+                burn_fast=round(burn_fast, 4),
+                burn_slow=round(burn_slow, 4),
+                target=objective.target)
+            self._post_event(objective, message)
+
+    def _post_event(self, objective: Objective, message: str) -> None:
+        if self.kube is None:
+            return
+        import secrets
+        ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        namespace = self.cfg.worker_namespace
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"tpumounter-slo-{objective.name[:100]}"
+                        f".{secrets.token_hex(4)}",
+                "namespace": namespace,
+            },
+            # The master Service is the natural anchor: the breach is a
+            # fleet-level condition, not one pod's.
+            "involvedObject": {"kind": "Service",
+                               "name": "tpumounter-master",
+                               "namespace": namespace},
+            "reason": "TPUSLOBurnRate",
+            "message": message[:1024],
+            "type": "Warning",
+            "source": {"component": "tpumounter-master"},
+            "firstTimestamp": ts,
+            "lastTimestamp": ts,
+            "count": 1,
+        }
+        try:
+            self.kube.create_event(namespace, manifest)
+        except Exception as exc:  # noqa: BLE001 — events are advisory
+            logger.warning("SLO breach event post failed: %s", exc)

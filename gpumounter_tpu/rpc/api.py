@@ -166,11 +166,47 @@ class QuiesceStatusResponse(Message):
     ]
 
 
+# --- fleet telemetry collection (no reference analog) ---
+#
+# The master's fleet collector (obs/fleet.py) periodically pulls every
+# worker's local telemetry — mount-latency histogram, warm-pool and
+# mount counters, per-tenant device-access counts, program-swap count —
+# over the pooled channels it already holds. The payload travels as one
+# JSON document in a string field (schema obs.fleet.TELEMETRY_SCHEMA):
+# the rollup shape evolves faster than the wire should, and proto3
+# string fields keep legacy decoders skipping it cleanly. A legacy
+# (reference) worker has no TelemetryService at all and answers
+# UNIMPLEMENTED — the collector then degrades to scraping the worker's
+# HTTP /metrics exposition. Absent or malformed payloads parse to None
+# (obs.fleet.parse_telemetry) and trigger the same scrape fallback,
+# never an error.
+
+
+class CollectTelemetryResult(enum.IntEnum):
+    Success = 0
+
+
+class CollectTelemetryRequest(Message):
+    FIELDS = [
+        Field(1, "trace_context", "string"),
+    ]
+
+
+class CollectTelemetryResponse(Message):
+    FIELDS = [
+        Field(1, "collect_telemetry_result", "enum"),
+        Field(2, "node_name", "string"),   # informational; the collector
+                                           # keys by the node it dialed
+        Field(3, "telemetry", "string"),   # JSON telemetry snapshot
+    ]
+
+
 # gRPC method descriptors: (service_full_name, method, request_cls, response_cls)
 ADD_SERVICE_TPU = "tpu_mount.AddTPUService"
 REMOVE_SERVICE_TPU = "tpu_mount.RemoveTPUService"
 PROBE_SERVICE_TPU = "tpu_mount.ProbeTPUService"  # our extension; no legacy name
 QUIESCE_SERVICE_TPU = "tpu_mount.QuiesceStatusService"  # ditto
+TELEMETRY_SERVICE_TPU = "tpu_mount.TelemetryService"    # ditto
 # Reference service names (api.proto:21-23, 43-45) for drop-in clients.
 ADD_SERVICE_LEGACY = "gpu_mount.AddGPUService"
 REMOVE_SERVICE_LEGACY = "gpu_mount.RemoveGPUService"
@@ -181,3 +217,4 @@ ADD_METHOD_TPU = "AddTPU"
 REMOVE_METHOD_TPU = "RemoveTPU"
 PROBE_METHOD_TPU = "ProbeTPU"
 QUIESCE_METHOD_TPU = "QuiesceStatus"
+TELEMETRY_METHOD_TPU = "CollectTelemetry"

@@ -259,6 +259,7 @@ class WorkerClient:
             "RemoveTPU": cfg.rpc_remove_timeout_s,
             "ProbeTPU": cfg.rpc_probe_timeout_s,
             "QuiesceStatus": cfg.rpc_quiesce_timeout_s,
+            "CollectTelemetry": cfg.rpc_telemetry_timeout_s,
         }
         self.retry = retry or RetryPolicy(
             max_attempts=cfg.rpc_max_attempts,
@@ -296,6 +297,13 @@ class WorkerClient:
             f"/{api.QUIESCE_SERVICE_TPU}/{api.QUIESCE_METHOD_TPU}",
             request_serializer=lambda m: m.encode(),
             response_deserializer=api.QuiesceStatusResponse.decode)
+        # Telemetry has no legacy analog either; a reference worker
+        # answers UNIMPLEMENTED and the fleet collector falls back to
+        # scraping the worker's HTTP /metrics (obs/fleet.py).
+        self._telemetry = self._channel.unary_unary(
+            f"/{api.TELEMETRY_SERVICE_TPU}/{api.TELEMETRY_METHOD_TPU}",
+            request_serializer=lambda m: m.encode(),
+            response_deserializer=api.CollectTelemetryResponse.decode)
 
     def close(self) -> None:
         channel, self._channel = self._channel, None
@@ -440,6 +448,14 @@ class WorkerClient:
                               pod_name=pod_name, namespace=namespace),
                           timeout_s)
         return api.QuiesceStatusResult(resp.quiesce_status_result), resp
+
+    def collect_telemetry(self, timeout_s: float | None = None,
+                          ) -> "api.CollectTelemetryResponse":
+        """One worker's telemetry snapshot (raw response; the JSON in
+        .telemetry parses via obs.fleet.parse_telemetry). Read-only —
+        safe to retry like Probe/Quiesce."""
+        return self._call("CollectTelemetry", self._telemetry,
+                          api.CollectTelemetryRequest(), timeout_s)
 
     def probe_tpu(self, pod_name: str, namespace: str,
                   timeout_s: float | None = None,

@@ -22,7 +22,12 @@ invariants after convergence:
   7. no leaked channels: the shared ChannelPool's books stay exact —
      dialed == live + closed, and the live set never exceeds the
      worker count (a WorkerClient that closed a pooled channel, or a
-     pool that lost one, breaks the identity).
+     pool that lost one, breaks the identity),
+  8. fleet rollups never double-count a node across collector restarts:
+     two freshly-constructed FleetCollectors (a "restart") rolling up
+     the converged cluster agree exactly — same node set (every worker
+     once), same per-node mount counts, and the fleet total is the sum
+     of the per-node counts in both.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -515,6 +520,41 @@ class ChaosHarness:
                 violations.append(
                     f"audit record without trace id: seq={rec['seq']} "
                     f"op={rec['operation']} pod={rec['pod']}")
+
+        # 8. fleet rollups never double-count a node across collector
+        # restarts: the rollup is node-keyed and workers report absolute
+        # counters, so a restarted collector (second fresh instance)
+        # must reproduce the first one's numbers exactly.
+        from gpumounter_tpu.obs.fleet import FleetCollector
+        rollups = []
+        for _ in range(2):  # second construction = "restarted collector"
+            collector = FleetCollector(self.app.registry,
+                                       self.app._client_factory,
+                                       cfg=self.cfg)
+            rollups.append(collector.collect_once())
+        first, second = rollups
+        expected_nodes = set(self.services)
+        for which, rollup in (("first", first), ("second", second)):
+            if set(rollup["nodes"]) != expected_nodes:
+                violations.append(
+                    f"fleet rollup ({which}) nodes "
+                    f"{sorted(rollup['nodes'])} != workers "
+                    f"{sorted(expected_nodes)}")
+            node_sum = sum(e.get("mount", {}).get("count", 0)
+                           for e in rollup["nodes"].values())
+            if rollup["fleet"]["mount_count"] != node_sum:
+                violations.append(
+                    f"fleet rollup ({which}) total "
+                    f"{rollup['fleet']['mount_count']} != per-node sum "
+                    f"{node_sum} (a node counted twice or dropped)")
+        for node in expected_nodes & set(first["nodes"]) \
+                & set(second["nodes"]):
+            a = first["nodes"][node].get("mount", {}).get("count", 0)
+            b = second["nodes"][node].get("mount", {}).get("count", 0)
+            if a != b:
+                violations.append(
+                    f"collector restart changed node {node} mount count "
+                    f"{a} -> {b} (rollup not restart-stable)")
 
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()

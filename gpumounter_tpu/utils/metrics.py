@@ -3,11 +3,22 @@
 The reference exposes no metrics (SURVEY.md §5). Both daemons here serve
 /metrics with counters and histograms for mount/unmount operations and their
 phase latencies. Implemented on stdlib only (no prometheus_client in image).
+
+Thread-safety contract (audited for the MOUNT_CONCURRENCY fan-out, where
+mount_many's inject pool and the gRPC handler threads observe/inc the same
+instruments concurrently while scrapes render): every mutation of an
+instrument's samples — inc/set/observe, exemplar capture included — and
+every read — collect/snapshot/get — happens under that instrument's own
+lock; Registry mutations (register) and render's metric-list copy happen
+under the registry lock. Nothing touches `_values`/`_counts` outside a
+lock. tests/test_metrics.py stress-proves the histogram under a
+thread-pool of concurrent observers racing a renderer.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 
@@ -37,6 +48,21 @@ class Counter:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def snapshot(self) -> dict[tuple, float]:
+        """Labels-tuple -> value copy (the fleet telemetry reader)."""
+        with self._lock:
+            return dict(self._values)
+
+    def total(self) -> float:
+        """Sum across every labelset."""
+        with self._lock:
+            return sum(self._values.values())
 
     def reset(self) -> None:
         with self._lock:
@@ -83,6 +109,10 @@ class Gauge:
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def snapshot(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._values)
+
     def reset(self) -> None:
         with self._lock:
             self._values.clear()
@@ -101,42 +131,109 @@ class Gauge:
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
 
 
+def estimate_quantile(buckets: tuple, counts: list, q: float) -> float:
+    """Quantile estimate from cumulative bucket counts (the standard
+    Prometheus histogram_quantile linear interpolation). `counts` is the
+    per-bucket cumulative count list with the +Inf total last; returns
+    seconds (the last finite bound when the quantile lands in +Inf)."""
+    total = counts[-1]
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0
+    for i, bound in enumerate(buckets):
+        if counts[i] >= rank:
+            span = counts[i] - prev_count
+            if span <= 0:
+                return bound
+            return prev_bound + (bound - prev_bound) * (rank - prev_count) / span
+        prev_bound, prev_count = bound, counts[i]
+    return float(buckets[-1]) if buckets else 0.0
+
+
 @dataclass
 class Histogram:
     name: str
     help: str
     buckets: tuple = _DEFAULT_BUCKETS
+    #: labels-tuple -> [cumulative counts (+Inf last), sum,
+    #:                  {bucket index -> (trace_id, value, unix ts)}]
     _counts: dict[tuple, list] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, trace_id: str = "",
+                **labels: str) -> None:
+        """Record one observation. `trace_id` (optional) attaches an
+        OpenMetrics-style exemplar to the bucket the value lands in —
+        the join key from a latency outlier back to its distributed
+        trace (`tpumounter trace <id>`). Exemplars are last-write-wins
+        per bucket and ride the same lock as the counts."""
         key = tuple(sorted(labels.items()))
         with self._lock:
-            entry = self._counts.setdefault(key, [[0] * (len(self.buckets) + 1), 0.0])
-            counts, _ = entry
+            entry = self._counts.setdefault(
+                key, [[0] * (len(self.buckets) + 1), 0.0, {}])
+            counts = entry[0]
+            bucket_idx = len(self.buckets)  # +Inf
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    bucket_idx = min(bucket_idx, i)
             counts[-1] += 1  # +Inf
             entry[1] += value
+            if trace_id:
+                entry[2][bucket_idx] = (trace_id, value,
+                                        round(time.time(), 3))
+
+    def snapshot(self) -> dict[tuple, dict]:
+        """Labels-tuple -> {"counts": [...], "sum": float, "exemplars":
+        {bucket index: (trace_id, value, ts)}} deep copy."""
+        with self._lock:
+            return {key: {"counts": list(entry[0]), "sum": entry[1],
+                          "exemplars": dict(entry[2])}
+                    for key, entry in self._counts.items()}
+
+    def quantile(self, q: float, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            entry = self._counts.get(key)
+            counts = list(entry[0]) if entry else []
+        if not counts:
+            return 0.0
+        return estimate_quantile(self.buckets, counts, q)
 
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
 
-    def collect(self) -> list[str]:
+    def collect(self, openmetrics: bool = False) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
-            for key, (counts, total) in sorted(self._counts.items()):
+            for key, (counts, total, exemplars) in sorted(self._counts.items()):
                 labels = dict(key)
                 for i, b in enumerate(self.buckets):
-                    lines.append(
-                        f"{self.name}_bucket{_fmt_labels({**labels, 'le': _fmt_float(b)})} {counts[i]}"
-                    )
-                lines.append(f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {counts[-1]}")
+                    line = (f"{self.name}_bucket"
+                            f"{_fmt_labels({**labels, 'le': _fmt_float(b)})} "
+                            f"{counts[i]}")
+                    lines.append(self._with_exemplar(
+                        line, exemplars.get(i), openmetrics))
+                inf_line = (f"{self.name}_bucket"
+                            f"{_fmt_labels({**labels, 'le': '+Inf'})} "
+                            f"{counts[-1]}")
+                lines.append(self._with_exemplar(
+                    inf_line, exemplars.get(len(self.buckets)), openmetrics))
                 lines.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
                 lines.append(f"{self.name}_count{_fmt_labels(labels)} {counts[-1]}")
         return lines
+
+    @staticmethod
+    def _with_exemplar(line: str, exemplar, openmetrics: bool) -> str:
+        """OpenMetrics exemplar suffix — only in openmetrics renders; the
+        classic text/plain;version=0.0.4 exposition stays byte-clean for
+        strict parsers."""
+        if not openmetrics or exemplar is None:
+            return line
+        trace_id, value, ts = exemplar
+        return f'{line} # {{trace_id="{trace_id}"}} {value} {ts}'
 
 
 class Registry:
@@ -162,13 +259,44 @@ class Registry:
             self._metrics.append(h)
         return h
 
-    def render(self) -> str:
+    def register(self, metric) -> None:
+        """Add a custom collector: any object with name, collect() ->
+        list[str], and reset(). Used by adapters whose samples live
+        outside this module (the eBPF device-access telemetry table)."""
+        with self._lock:
+            self._metrics.append(metric)
+
+    def find(self, name: str):
+        """The registered instrument with this name, or None. Lets the
+        fleet telemetry reader consume instruments by exposition name
+        without importing the modules that own them (a master-side
+        reader must not drag in worker-only modules)."""
+        with self._lock:
+            for m in self._metrics:
+                if getattr(m, "name", None) == name:
+                    return m
+        return None
+
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition. `openmetrics=True` additionally
+        stamps histogram bucket lines with their trace-id exemplars
+        (served when the scraper negotiates application/openmetrics-text
+        via Accept)."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
-            lines.extend(m.collect())
+            if openmetrics and isinstance(m, Histogram):
+                lines.extend(m.collect(openmetrics=True))
+            else:
+                lines.extend(m.collect())
         return "\n".join(lines) + "\n"
+
+    def series_count(self) -> int:
+        """Number of exposed sample lines (non-comment) — the CI
+        cardinality guard's measure of exposition size."""
+        return sum(1 for line in self.render().splitlines()
+                   if line and not line.startswith("#"))
 
     def reset_all(self) -> None:
         """Zero every registered metric's samples (the instruments stay

@@ -24,18 +24,21 @@ logger = get_logger("worker.main")
 
 
 def _make_ops_handler(read_token: str | None, mutate_token: str | None):
-    """Worker ops surface: liveness, Prometheus exposition, and the
-    worker's halves of the observability stores — /audit and /trace/<id>
-    render through the same obs contracts the master routes use
+    """Worker ops surface: liveness, Prometheus exposition (OpenMetrics
+    trace exemplars when the scraper negotiates them via Accept), the
+    fleet collector's /telemetry snapshot, and the worker's halves of
+    the observability stores — /audit and /trace/<id> render through
+    the same obs contracts the master routes use
     (obs.audit.query_from_params / obs.trace.trace_payload) so the two
     daemons cannot drift.
 
-    Auth mirrors the master's read scope: /audit + /trace — and
-    /metrics when a read token is configured — accept the read token or
-    the worker's mutate secret; without a read token, /metrics stays
-    open (scrape back-compat) while /audit + /trace require the mutate
-    secret (they reveal pod names and chip movements; the master gates
-    them the same way). /healthz is always open for probes."""
+    Auth mirrors the master's read scope: /audit, /trace, /telemetry —
+    and /metrics when a read token is configured — accept the read
+    token or the worker's mutate secret; without a read token, /metrics
+    stays open (scrape back-compat) while /audit, /trace and /telemetry
+    require the mutate secret (they reveal pod names, tenants, and chip
+    movements; the master gates its /fleet + /slo the same way).
+    /healthz is always open for probes."""
 
     def _read_allowed(auth_header: str | None) -> bool:
         from gpumounter_tpu.utils.auth import check_bearer
@@ -64,8 +67,27 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
                 if read_token is not None and not _read_allowed(auth):
                     self.send_error(401)
                     return
-                body = REGISTRY.render().encode()
-                ctype = "text/plain; version=0.0.4"
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    body = REGISTRY.render(openmetrics=True).encode()
+                    ctype = "application/openmetrics-text; version=1.0.0"
+                else:
+                    body = REGISTRY.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+            elif parsed.path == "/telemetry":
+                # The fleet collector's JSON snapshot — same payload the
+                # CollectTelemetry RPC carries (obs/fleet.py schema).
+                # Read-scope gated like /audit: it names tenants.
+                if not _read_allowed(auth):
+                    self.send_error(401)
+                    return
+                from gpumounter_tpu.config import get_config
+                from gpumounter_tpu.obs.fleet import (
+                    worker_telemetry_snapshot,
+                )
+                body = (json.dumps(worker_telemetry_snapshot(
+                    cfg=get_config()), indent=1) + "\n").encode()
+                ctype = "application/json"
             elif parsed.path == "/audit":
                 if not _read_allowed(auth):
                     self.send_error(401)

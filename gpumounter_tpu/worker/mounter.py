@@ -288,7 +288,18 @@ class TpuMounter:
                 f"mount of {uuids} into {target.description}: "
                 f"{exc}") from exc
         MOUNT_TOTAL.inc(float(len(devices)), result="success")
-        MOUNT_LATENCY.observe(timer.total())
+        # Exemplar: the ambient trace id rides the latency bucket this
+        # batch landed in, linking a histogram outlier straight to its
+        # span tree (`tpumounter trace <id>`; served on OpenMetrics
+        # renders and in the fleet telemetry payload).
+        MOUNT_LATENCY.observe(timer.total(),
+                              trace_id=trace.current_trace_id())
+        # Fallback half of the per-tenant device-access telemetry: on
+        # kernels where the eBPF map path counts in-kernel attempts this
+        # adds the grant events alongside; everywhere else (cgroup v1,
+        # fake backends) it is the whole signal.
+        from gpumounter_tpu.cgroup.ebpf import DEVICE_TELEMETRY
+        DEVICE_TELEMETRY.record(target.description, "grant", len(devices))
         for phase, seconds in timer.phases.items():
             PHASE_LATENCY.observe(seconds, phase=phase)
         summary = timer.summary_ms()
@@ -315,13 +326,17 @@ class TpuMounter:
             grant_many = getattr(self.controller, "grant_many", None)
             for cg in target.cgroup_dirs:
                 if grant_many is not None:
-                    # One program swap for the whole batch.
-                    grant_many(cg, devices, base_rules=base_rules)
+                    # One program swap for the whole batch. The tenant
+                    # tag attributes the cgroup's in-kernel access
+                    # telemetry (ebpf.DEVICE_TELEMETRY) to this pod.
+                    grant_many(cg, devices, base_rules=base_rules,
+                               tenant=target.description)
                     granted.extend((cg, d) for d in devices)
                 else:
                     for dev in devices:
                         self.controller.grant(cg, dev,
-                                              base_rules=base_rules)
+                                              base_rules=base_rules,
+                                              tenant=target.description)
                         granted.append((cg, dev))
         else:
             for cg in target.cgroup_dirs:

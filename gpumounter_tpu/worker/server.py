@@ -386,6 +386,29 @@ class TpuMountService:
             acked_id=acked_id, acked_phase=acked_phase,
             holder_count=len(holders), chip_count=len(devices))
 
+    # --- CollectTelemetry (fleet collector's pull; no reference analog) ---
+
+    def collect_telemetry(self, request: api.CollectTelemetryRequest,
+                          context: grpc.ServicerContext,
+                          ) -> api.CollectTelemetryResponse:
+        """This worker's telemetry snapshot as one JSON payload: the
+        mount-latency histogram (trace exemplars included), mount and
+        warm-pool counters, per-tenant device-access counts (read from
+        the eBPF telemetry table with plain map lookups — collection
+        never swaps a program), and the program-swap count that proves
+        it. Read-only and allocation-free beyond the JSON encode."""
+        import json as jsonlib
+
+        from gpumounter_tpu.obs.fleet import worker_telemetry_snapshot
+        with trace.span("worker.CollectTelemetry",
+                        wire_parent=request.trace_context):
+            failpoints.fire("worker.rpc", method="CollectTelemetry")
+            snapshot = worker_telemetry_snapshot(cfg=self.cfg)
+            return api.CollectTelemetryResponse(
+                collect_telemetry_result=api.CollectTelemetryResult.Success,
+                node_name=self.cfg.node_name or "",
+                telemetry=jsonlib.dumps(snapshot))
+
     # --- RemoveTPU (reference: server.go:101-179) ---
 
     def remove_tpu(self, request: api.RemoveTPURequest,
@@ -563,8 +586,13 @@ def _bearer_interceptor(token: str):
 
 def build_server(service: TpuMountService, port: int | None = None,
                  address: str | None = None,
-                 max_workers: int = 8) -> grpc.Server:
+                 max_workers: int = 8,
+                 include_telemetry: bool = True) -> grpc.Server:
     """gRPC server with the service registered under all four names.
+
+    include_telemetry=False builds a legacy-worker shape (no
+    TelemetryService, like the reference) for cross-testing the fleet
+    collector's UNIMPLEMENTED -> HTTP-scrape fallback.
 
     Reference: worker main registers AddGPUService + RemoveGPUService on
     :1200 (cmd/GPUMounter-worker/main.go:24-33).
@@ -601,6 +629,10 @@ def build_server(service: TpuMountService, port: int | None = None,
         api.PROBE_SERVICE_TPU: {api.PROBE_METHOD_TPU: probe},
         api.QUIESCE_SERVICE_TPU: {api.QUIESCE_METHOD_TPU: quiesce},
     }
+    if include_telemetry:
+        registrations[api.TELEMETRY_SERVICE_TPU] = {
+            api.TELEMETRY_METHOD_TPU: _handler(
+                service.collect_telemetry, api.CollectTelemetryRequest)}
     for service_name, methods in registrations.items():
         server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(service_name, methods),))

@@ -1,0 +1,937 @@
+"""Fleet telemetry plane (gpumounter_tpu/obs/fleet.py + slo.py): the
+CollectTelemetry RPC, the HTTP-scrape fallback for legacy workers, the
+node-keyed rollup (no double counting across collector restarts), the
+SLO burn-rate engine with its breach Event + audit record, the /fleet +
+/slo routes and their read-scope auth, the worker /telemetry surface,
+trace exemplars, and the e2e acceptance storm.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.cgroup import ebpf
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.obs import audit as audit_mod
+from gpumounter_tpu.obs import fleet as fleet_mod
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.fleet import (
+    FleetCollector,
+    parse_prometheus_text,
+    parse_telemetry,
+    snapshot_from_prometheus,
+    worker_telemetry_snapshot,
+)
+from gpumounter_tpu.obs.slo import (
+    DEFAULT_OBJECTIVES,
+    Objective,
+    ObjectiveError,
+    SloEngine,
+    objectives_from_config,
+)
+from gpumounter_tpu.utils.metrics import MOUNT_LATENCY, MOUNT_TOTAL, REGISTRY
+
+
+# --- telemetry snapshot + payload parsing ---
+
+
+def test_worker_snapshot_roundtrips_through_json():
+    MOUNT_LATENCY.observe(0.02, trace_id="ab" * 16)
+    MOUNT_TOTAL.inc(result="success")
+    ebpf.DEVICE_TELEMETRY.record("default/p", "grant", 2)
+    snap = worker_telemetry_snapshot()
+    doc = parse_telemetry(json.dumps(snap))
+    assert doc is not None
+    assert doc["mount_latency"]["count"] == 1
+    assert doc["counters"]["mount_total"] == {"success": 1.0}
+    assert doc["device_access"] == {"default/p": {"grant": 2.0}}
+    (ex,) = doc["mount_latency"]["exemplars"]
+    assert ex["trace_id"] == "ab" * 16 and ex["value"] == 0.02
+
+
+@pytest.mark.parametrize("bad", [
+    "", None, 7, b"bytes", "not json", "[1, 2]", '"a string"',
+    '{"schema": "some-other-schema/9"}', "{}",
+])
+def test_parse_telemetry_tolerates_garbage(bad):
+    """Absent / wrong-typed / malformed / wrong-schema payloads — what a
+    legacy or buggy worker could send — parse to None (the collector
+    then falls back to the HTTP scrape), never raise."""
+    assert parse_telemetry(bad) is None
+
+
+def test_prometheus_scrape_recovers_snapshot():
+    """The legacy-worker fallback parses the classic exposition back
+    into the same snapshot shape the RPC carries."""
+    MOUNT_LATENCY.observe(0.02)
+    MOUNT_LATENCY.observe(0.3)
+    MOUNT_TOTAL.inc(2.0, result="success")
+    ebpf.DEVICE_TELEMETRY.record("ns/pod-1", "grant")
+    text = REGISTRY.render()
+    snap = snapshot_from_prometheus(text)
+    assert snap["mount_latency"]["count"] == 2.0
+    assert abs(snap["mount_latency"]["sum"] - 0.32) < 1e-9
+    assert snap["counters"]["mount_total"] == {"success": 2.0}
+    assert snap["device_access"] == {"ns/pod-1": {"grant": 1.0}}
+    # bucket cumulative counts survive
+    buckets = dict((b, c) for b, c in snap["mount_latency"]["buckets"])
+    assert buckets[0.025] == 1.0 and buckets[0.5] == 2.0
+
+
+def test_parse_prometheus_text_skips_junk_lines():
+    series = parse_prometheus_text(
+        "# HELP x y\nx{a=\"b\"} 1\nnot a line at all {{{\nx 2\n")
+    assert series == {"x": [({"a": "b"}, 1.0), ({}, 2.0)]}
+
+
+# --- SLO engine ---
+
+
+def _slo_cfg(**kw):
+    base = dict(slo_fast_window_s=1.0, slo_slow_window_s=2.0,
+                slo_burn_threshold=2.0)
+    base.update(kw)
+    return Config().replace(**base)
+
+
+def _rollup(count=0, buckets=(), success=0.0, error=0.0, heals=0.0,
+            heal_failures=0.0):
+    return {"fleet": {"mount_count": count,
+                      "mount_buckets": [list(b) for b in buckets],
+                      "mount_success": success, "mount_error": error},
+            "master": {"heals": heals, "heal_failures": heal_failures}}
+
+
+class _FakeKube:
+    def __init__(self):
+        self.events = []
+
+    def create_event(self, namespace, manifest):
+        self.events.append((namespace, manifest))
+
+
+def test_slo_breach_emits_event_audit_and_metrics_once():
+    kube = _FakeKube()
+    clock = [100.0]
+    eng = SloEngine(cfg=_slo_cfg(), kube=kube, clock=lambda: clock[0])
+    # cold start after a slow storm: every mount slower than 50 ms
+    eng.ingest(_rollup(count=10, buckets=[(0.05, 0), (0.1, 10)],
+                       success=10))
+    out = eng.evaluate()
+    by = {o["name"]: o for o in out["objectives"]}
+    assert by["mount-latency-50ms"]["breached"] is True
+    assert by["mount-latency-50ms"]["burn_fast"] >= 2.0
+    assert by["mount-success"]["breached"] is False
+    (ns, manifest), = kube.events
+    assert manifest["reason"] == "TPUSLOBurnRate"
+    assert "mount-latency-50ms" in manifest["message"]
+    (rec,) = audit_mod.AUDIT.query(operation="slo.breach")
+    assert rec["outcome"] == "breach: mount-latency-50ms"
+    assert rec["trace_id"]  # emitted inside a span: joins the trail
+    # persisting breach: no duplicate Event/audit
+    eng.ingest(_rollup(count=10, buckets=[(0.05, 0), (0.1, 10)],
+                       success=10))
+    eng.evaluate()
+    assert len(kube.events) == 1
+    assert len(audit_mod.AUDIT.query(operation="slo.breach")) == 1
+    # burn gauges exposed
+    rendered = REGISTRY.render()
+    assert 'tpumounter_slo_breached{objective="mount-latency-50ms"} 1.0' \
+        in rendered
+    assert ('tpumounter_slo_breaches_total'
+            '{objective="mount-latency-50ms"} 1.0') in rendered
+
+
+def test_slo_recovers_when_fast_traffic_flushes_windows():
+    clock = [0.0]
+    eng = SloEngine(cfg=_slo_cfg(), kube=None, clock=lambda: clock[0])
+    eng.ingest(_rollup(count=4, buckets=[(0.05, 0), (0.1, 4)]))
+    assert eng.evaluate()["objectives"][0]["breached"] is True
+    clock[0] += 3.0  # old slow mounts age out of both windows
+    eng.ingest(_rollup(count=1004, buckets=[(0.05, 1000), (0.1, 1004)]))
+    out = eng.evaluate()
+    assert out["objectives"][0]["breached"] is False
+
+
+def test_slo_no_breach_without_fast_window_traffic():
+    """Multi-window discipline: a stale breach condition with zero new
+    events in the fast window must not page."""
+    clock = [0.0]
+    eng = SloEngine(cfg=_slo_cfg(), kube=None, clock=lambda: clock[0])
+    eng.ingest(_rollup(count=10, buckets=[(0.05, 0), (0.1, 10)]))
+    clock[0] += 3.0
+    eng.ingest(_rollup(count=10, buckets=[(0.05, 0), (0.1, 10)]))
+    out = eng.evaluate()  # no delta inside the fast window
+    assert out["objectives"][0]["breached"] is False
+
+
+def test_slo_counter_reset_clamps_to_zero_burn():
+    """A worker restart shrinks cumulative counters; the window delta
+    must clamp to 'no traffic', never negative burn."""
+    clock = [0.0]
+    eng = SloEngine(cfg=_slo_cfg(), kube=None, clock=lambda: clock[0])
+    eng.ingest(_rollup(count=100, buckets=[(0.05, 100), (0.1, 100)]))
+    clock[0] += 3.0
+    eng.ingest(_rollup(count=5, buckets=[(0.05, 0), (0.1, 5)]))
+    out = eng.evaluate()
+    obj = out["objectives"][0]
+    assert obj["burn_fast"] == 0.0 and obj["breached"] is False
+
+
+def test_heal_failure_counter_feeds_heal_slo(monkeypatch):
+    """A reconcile pass that found dead chips and died before recording
+    the heal increments tpumounter_chips_heal_failures_total — the bad
+    half of the heal-success SLO ratio."""
+    from gpumounter_tpu.elastic.reconciler import (
+        CHIPS_HEAL_FAILURES,
+        ElasticReconciler,
+    )
+
+    rec = ElasticReconciler.__new__(ElasticReconciler)
+    rec._pending_heal = {}
+
+    def boom(*a, **kw):
+        raise RuntimeError("remove RPC died mid-heal")
+
+    monkeypatch.setattr(ElasticReconciler, "_converge", boom)
+    with pytest.raises(RuntimeError):
+        ElasticReconciler._heal_counted(
+            rec, "ns/p", "ns", "p", None, None, "addr",
+            dead=[object()], healthy=[])
+    assert CHIPS_HEAL_FAILURES.total() == 1.0
+
+
+def test_slo_heal_objective_reads_master_counters():
+    clock = [0.0]
+    eng = SloEngine(cfg=_slo_cfg(), kube=None, clock=lambda: clock[0])
+    eng.ingest(_rollup(heals=1.0, heal_failures=9.0))
+    by = {o["name"]: o for o in eng.evaluate()["objectives"]}
+    assert by["heal-success"]["breached"] is True
+    assert by["heal-success"]["sli"] == 0.1
+
+
+def test_objectives_from_config_and_validation():
+    assert objectives_from_config(Config()) == DEFAULT_OBJECTIVES
+    cfg = Config().replace(slo_objectives=json.dumps([
+        {"name": "x", "kind": "ratio", "target": 0.9,
+         "good": "heals", "bad": "heal_failures"}]))
+    (obj,) = objectives_from_config(cfg)
+    assert obj.name == "x" and obj.kind == "ratio"
+    with pytest.raises(ObjectiveError):
+        objectives_from_config(Config().replace(slo_objectives="{not json"))
+    with pytest.raises(ObjectiveError):
+        objectives_from_config(Config().replace(slo_objectives='{"a": 1}'))
+    with pytest.raises(ObjectiveError):
+        Objective(name="bad", kind="latency", target=0.9)  # no threshold
+    with pytest.raises(ObjectiveError):
+        Objective(name="bad", kind="ratio", target=1.5, good="g", bad="b")
+    with pytest.raises(ObjectiveError):
+        Objective(name="bad", kind="nope", target=0.9)
+
+
+# --- eBPF telemetry table ---
+
+
+def test_device_telemetry_bounds_tenant_cardinality():
+    table = ebpf.DeviceAccessTelemetry(max_tenants=3)
+    for i in range(10):
+        table.record(f"ns/pod-{i}", "grant")
+    counts = table.counts()
+    tenants = {t for t, _ in counts}
+    assert len(tenants) == 4  # 3 real + _overflow
+    assert ebpf.TELEMETRY_OVERFLOW_TENANT in tenants
+    assert counts[(ebpf.TELEMETRY_OVERFLOW_TENANT, "grant")] == 7.0
+
+
+def test_device_telemetry_merges_kernel_reader():
+    table = ebpf.DeviceAccessTelemetry()
+    table.record("ns/p", "grant", 2)
+    table.attach_kernel_reader(lambda: {("ns/p", "attempt"): 5.0})
+    assert table.counts() == {("ns/p", "grant"): 2.0,
+                              ("ns/p", "attempt"): 5.0}
+    # a broken reader degrades, never raises
+    def boom():
+        raise RuntimeError("map read failed")
+    table.attach_kernel_reader(boom)
+    assert table.counts()[("ns/p", "grant")] == 2.0
+
+
+def test_telemetry_program_counts_attempts_without_changing_policy():
+    """The instrumented device program: identical allow/deny semantics,
+    plus an atomic per-(major,minor) attempt count in the map — executed
+    here on an interpreter extended with map emulation (no kernel
+    needed; the real-syscall path is behind TPUMOUNTER_EBPF_TESTS)."""
+    import struct
+
+    from gpumounter_tpu.cgroup.ebpf import (
+        BPF_DEVCG_ACC_READ,
+        BPF_DEVCG_ACC_WRITE,
+        BPF_DEVCG_DEV_CHAR,
+        DEFAULT_CONTAINER_RULES,
+        build_device_program,
+        device_rule,
+        telemetry_key,
+    )
+    from gpumounter_tpu.device.tpu import TpuDevice
+
+    MAP_FD = 77
+    fake_map: dict[int, int] = {}
+
+    def interp(prog, dev_type, access, major, minor):
+        regs = {i: 0 for i in range(11)}
+        regs[10] = "fp"
+        stack: dict[int, int] = {}
+        ctx = {0: (access << 16) | dev_type, 4: major, 8: minor}
+        regs[1] = "ctx"
+        insns = [struct.unpack("<BBhi", prog[i:i + 8])
+                 for i in range(0, len(prog), 8)]
+        pc, steps = 0, 0
+        while pc < len(insns):
+            steps += 1
+            assert steps < 10_000
+            op, regbyte, off, imm = insns[pc]
+            dst, src = regbyte & 0xF, regbyte >> 4
+            if op == 0x61:    # LDX_MEM_W
+                assert regs[src] == "ctx"
+                regs[dst] = ctx[off]
+            elif op == 0x7B:  # STX_MEM_DW
+                assert regs[dst] == "fp"
+                stack[off] = regs[src]
+            elif op == 0x18:  # LD_IMM64 (16-byte; src=1 -> map fd)
+                assert src == ebpf.BPF_PSEUDO_MAP_FD
+                _, _, _, imm_hi = insns[pc + 1]
+                regs[dst] = ("map", imm | (imm_hi << 32))
+                pc += 1
+            elif op == 0xB7:
+                regs[dst] = imm & (2**64 - 1) if imm >= 0 else imm + 2**64
+            elif op == 0xBF:
+                regs[dst] = regs[src]
+            elif op == 0x07:  # ADD64_IMM
+                if regs[dst] == "fp":
+                    regs[dst] = ("fp+", imm)
+                else:
+                    regs[dst] = (regs[dst] + imm) & (2**64 - 1)
+            elif op == 0x57:
+                imm64 = imm & (2**64 - 1) if imm >= 0 else imm + 2**64
+                regs[dst] &= imm64
+            elif op == 0x4F:  # OR64_REG
+                regs[dst] |= regs[src]
+            elif op == 0x67:  # LSH64_IMM
+                regs[dst] = (regs[dst] << imm) & (2**64 - 1)
+            elif op == 0x77:
+                regs[dst] >>= imm
+            elif op == 0x85:  # CALL map_lookup_elem
+                assert imm == ebpf.BPF_FUNC_map_lookup_elem
+                map_ref, keyptr = regs[1], regs[2]
+                assert map_ref == ("map", MAP_FD)
+                assert keyptr == ("fp+", -8)
+                key = stack[-8]
+                regs[0] = ("val", key) if key in fake_map else 0
+                for r in (1, 2, 3, 4, 5):
+                    regs[r] = "clobbered"
+            elif op == 0xDB:  # XADD_DW
+                ref = regs[dst]
+                assert isinstance(ref, tuple) and ref[0] == "val"
+                fake_map[ref[1]] += regs[src]
+            elif op == 0x15:  # JEQ_IMM
+                if regs[dst] == (imm & (2**64 - 1)):
+                    pc += off
+            elif op == 0x55:  # JNE_IMM
+                imm64 = imm & (2**64 - 1) if imm >= 0 else imm + 2**64
+                if regs[dst] != imm64:
+                    pc += off
+            elif op == 0x95:
+                return regs[0]
+            else:
+                raise AssertionError(f"unknown opcode {op:#x}")
+            pc += 1
+        raise AssertionError("fell off end")
+
+    dev = TpuDevice(index=0, device_path="/dev/accel0", major=250, minor=0,
+                    uuid="u")
+    rules = list(DEFAULT_CONTAINER_RULES) + [device_rule(dev)]
+    plain = build_device_program(rules)
+    instrumented = build_device_program(rules, telemetry_map_fd=MAP_FD)
+    assert len(instrumented) > len(plain)
+    fake_map[telemetry_key(250, 0)] = 0  # seeded at grant time
+
+    RW = BPF_DEVCG_ACC_READ | BPF_DEVCG_ACC_WRITE
+    cases = [
+        (BPF_DEVCG_DEV_CHAR, RW, 250, 0),    # granted chip: allowed
+        (BPF_DEVCG_DEV_CHAR, RW, 250, 1),    # other chip: denied
+        (BPF_DEVCG_DEV_CHAR, RW, 1, 3),      # /dev/null: allowed
+    ]
+    for dev_type, access, major, minor in cases:
+        assert interp(instrumented, dev_type, access, major, minor) == \
+            interp(plain, dev_type, access, major, minor), \
+            (dev_type, access, major, minor)
+    # attempts counted for the seeded key on the instrumented program
+    # only (allowed AND denied accesses alike); unseeded keys skipped
+    fake_map[telemetry_key(250, 0)] = 0
+    assert interp(instrumented, BPF_DEVCG_DEV_CHAR, RW, 250, 0) == 1
+    assert interp(instrumented, BPF_DEVCG_DEV_CHAR,
+                  BPF_DEVCG_ACC_READ, 250, 0) == 1
+    assert interp(plain, BPF_DEVCG_DEV_CHAR, RW, 250, 0) == 1
+    assert fake_map[telemetry_key(250, 0)] == 2
+    assert telemetry_key(250, 1) not in fake_map  # unseeded: untouched
+
+
+# --- the live stack ---
+
+
+NODE_A, NODE_B = "fleet-a", "fleet-b"
+
+
+class FleetStack:
+    """Two-node fake cluster + two live gRPC workers + HTTP master, the
+    chaos-harness shape with a warm pool on node A."""
+
+    def __init__(self, root: str, cfg: Config, warm_on_a: bool = True,
+                 telemetry_on_b: bool = True):
+        import os
+
+        from gpumounter_tpu.allocator.pool import WarmPodPool
+        from gpumounter_tpu.collector.collector import TpuCollector
+        from gpumounter_tpu.collector.podresources import PodResourcesClient
+        from gpumounter_tpu.master.app import (
+            MasterApp,
+            WorkerRegistry,
+            build_http_server,
+        )
+        from gpumounter_tpu.rpc.client import WorkerClient
+        from gpumounter_tpu.testing.cluster import FakeCluster
+        from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+        from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+        self.root = root
+        self.cluster = FakeCluster(root, nodes={NODE_A: 4, NODE_B: 4},
+                                   cfg=cfg).start()
+        self.cfg = self.cluster.cfg
+        self.services = {}
+        self.pools = []
+        self._servers = []
+        self._port_by_ip = {}
+        for i, name in enumerate([NODE_A, NODE_B]):
+            node_cfg = self.cluster.node_cfg(name, self.cfg)
+            if name == NODE_A and warm_on_a:
+                node_cfg = node_cfg.replace(warm_pool_size=1)
+            node = self.cluster.node(name)
+            collector = TpuCollector(
+                backend=node.backend,
+                podresources=PodResourcesClient(node.kubelet_socket,
+                                                timeout_s=5.0),
+                cfg=node_cfg)
+            mounter = TpuMounter(node.backend, cfg=node_cfg,
+                                 kube=self.cluster.kube)
+            dev_base = os.path.join(root, f"container-dev-{name}")
+            os.makedirs(dev_base, exist_ok=True)
+
+            def _resolver(pod, _base=dev_base):
+                d = os.path.join(_base, f"{pod.namespace}-{pod.name}")
+                os.makedirs(d, exist_ok=True)
+                return MountTarget(
+                    dev_dir=d, description=f"{pod.namespace}/{pod.name}",
+                    pod=pod)
+
+            mounter.resolve_target = _resolver
+            pool = None
+            if node_cfg.warm_pool_size > 0:
+                pool = WarmPodPool(self.cluster.kube, cfg=node_cfg)
+                self.pools.append(pool)
+            service = TpuMountService(self.cluster.kube,
+                                      collector=collector,
+                                      mounter=mounter, cfg=node_cfg,
+                                      pool=pool)
+            server = build_server(
+                service, address="localhost:0",
+                include_telemetry=telemetry_on_b or name == NODE_A)
+            server.start()
+            self._servers.append(server)
+            ip = f"10.77.0.{i + 1}"
+            self._port_by_ip[ip] = server.bound_port
+            self.services[name] = service
+            self.cluster.kube.create_pod(self.cfg.worker_namespace, {
+                "metadata": {"name": f"fleet-worker-{name}",
+                             "namespace": self.cfg.worker_namespace,
+                             "labels": {"app": "tpu-mounter-worker"}},
+                "spec": {"nodeName": name, "containers": [{"name": "w"}]},
+                "status": {"phase": "Running", "podIP": ip},
+            })
+            if pool is not None:
+                pool.ensure_node(name)
+                assert pool.wait_ready(name, timeout_s=15.0)
+
+        def client_factory(address: str):
+            ip = address.rsplit(":", 1)[0]
+            return WorkerClient(f"localhost:{self._port_by_ip[ip]}",
+                                cfg=self.cfg)
+
+        self.app = MasterApp(self.cluster.kube, cfg=self.cfg,
+                             worker_client_factory=client_factory,
+                             registry=WorkerRegistry(self.cluster.kube,
+                                                     self.cfg))
+        self.httpd = build_http_server(self.app, port=0, host="127.0.0.1")
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.base = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        for pool in self.pools:
+            pool.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.app.fleet.stop()
+        self.app.registry.stop()
+        for server in self._servers:
+            server.stop(grace=None)
+        self.cluster.stop()
+
+
+def _auth():
+    from conftest import AUTH_HEADER
+    return dict(AUTH_HEADER)
+
+
+def _http(method, url, form=None, headers=None):
+    data = urllib.parse.urlencode(form, doseq=True).encode() if form else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={**_auth(), **(headers or {})})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+@pytest.fixture()
+def storm_stack(tmp_path):
+    """Live two-node stack with an SLO tuned to breach on any mount
+    (threshold below the smallest histogram bucket)."""
+    objectives = json.dumps([
+        {"name": "storm-latency", "kind": "latency", "target": 0.95,
+         "threshold_s": 0.001,
+         "description": "every fake-cluster mount is slower than 1 ms"},
+        {"name": "mount-success", "kind": "ratio", "target": 0.999,
+         "good": "mount_success", "bad": "mount_error"},
+    ])
+    from gpumounter_tpu.config import set_config
+    cfg = Config().replace(slave_pod_timeout_s=10.0,
+                           slo_objectives=objectives,
+                           fleet_scrape_interval_s=3600.0)
+    set_config(cfg)
+    stack = FleetStack(str(tmp_path), cfg)
+    yield stack
+    stack.stop()
+    set_config(Config())
+
+
+def _mount(stack, pod, n=1):
+    status, body, headers = _http(
+        "GET", f"{stack.base}/addtpu/namespace/default/pod/{pod}"
+               f"/tpu/{n}/isEntireMount/false")
+    assert status == 200, body
+    return headers.get("X-Tpumounter-Trace", "")
+
+
+def test_fleet_storm_end_to_end(storm_stack):
+    """The ISSUE acceptance flow: a multi-node mount storm surfaces
+    per-node p95, warm-pool hit rate, and an SLO burn-rate breach
+    through a single master /fleet + /slo scrape; the breach produces a
+    k8s Event and an audit record; per-tenant device-access counters
+    appear on worker /metrics via map/table reads with zero program
+    swaps during collection; collector restarts never double-count."""
+    stack = storm_stack
+    stack.cluster.add_target_pod("storm-a", node=NODE_A)
+    stack.cluster.add_target_pod("storm-b", node=NODE_B)
+    trace_ids = [_mount(stack, "storm-a") for _ in range(2)]
+    trace_ids += [_mount(stack, "storm-b") for _ in range(2)]
+
+    swaps_before = ebpf.PROGRAM_SWAPS.total()
+    status, body, _ = _http("GET", stack.base + "/fleet")
+    assert status == 200
+    rollup = json.loads(body)
+    assert ebpf.PROGRAM_SWAPS.total() == swaps_before, \
+        "telemetry collection must never swap an eBPF program"
+
+    # per-node view: both nodes present, RPC mode, latency populated
+    assert set(rollup["nodes"]) == {NODE_A, NODE_B}
+    for name, entry in rollup["nodes"].items():
+        assert entry["mode"] == "rpc", (name, entry.get("error"))
+        assert entry["mount"]["count"] >= 4  # shared in-process registry
+        assert entry["mount"]["p95_ms"] > 0
+        assert entry["breaker"] == "closed"
+    # warm-pool hit rate: node A's pool served at least one adoption
+    fleet = rollup["fleet"]
+    assert fleet["warm_pool_hits"] >= 1
+    assert fleet["warm_pool_hit_rate"] > 0
+    assert fleet["nodes"] == 2
+    assert fleet["mount_count"] >= 4 and fleet["p95_ms"] > 0
+
+    # per-tenant device-access series via the telemetry table
+    tenants = {t for entry in rollup["nodes"].values()
+               for t in entry["device_access"]}
+    assert {"default/storm-a", "default/storm-b"} <= tenants
+
+    # exemplars link the histogram to the PR 4 trace ids
+    exemplar_ids = {ex["trace_id"] for entry in rollup["nodes"].values()
+                    for ex in entry["exemplars"]}
+    assert exemplar_ids & set(trace_ids)
+
+    # the SLO engine saw the storm: breach on /slo, Event, audit record
+    status, body, _ = _http("GET", stack.base + "/slo")
+    assert status == 200
+    slo = json.loads(body)
+    by = {o["name"]: o for o in slo["objectives"]}
+    assert by["storm-latency"]["breached"] is True
+    assert by["storm-latency"]["burn_fast"] >= 2.0
+    assert by["mount-success"]["breached"] is False
+    reasons = [m["reason"] for _, m in stack.cluster.kube.events_posted
+               if m.get("reason") == "TPUSLOBurnRate"]
+    assert reasons, "breach must post a k8s Event"
+    recs = audit_mod.AUDIT.query(operation="slo.breach")
+    assert recs and recs[0]["outcome"] == "breach: storm-latency"
+    assert recs[0]["trace_id"]
+
+    # worker /metrics serves the per-tenant series (zero swaps asserted
+    # above covers this read too — same table)
+    from gpumounter_tpu.worker.main import serve_ops
+    ops = serve_ops(0)
+    try:
+        port = ops.server_address[1]
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+        with urllib.request.urlopen(req) as resp:
+            text = resp.read().decode()
+        assert re.search(r'tpumounter_device_access_total\{kind="grant",'
+                         r'tenant="default/storm-a"\} [0-9.]+', text)
+    finally:
+        ops.shutdown()
+        ops.server_close()
+
+    # collector-restart invariant: a brand-new collector over the same
+    # registry rolls up the same node set and counts — nothing doubles.
+    fresh = FleetCollector(stack.app.registry, stack.app._client_factory,
+                           cfg=stack.cfg)
+    again = fresh.collect_once()
+    assert set(again["nodes"]) == set(rollup["nodes"])
+    assert again["fleet"]["mount_count"] == fleet["mount_count"]
+    assert again["fleet"]["warm_pool_hits"] == fleet["warm_pool_hits"]
+
+
+def test_fleet_keeps_stale_entry_when_node_unreachable(storm_stack):
+    """A node that answers neither RPC nor scrape keeps its previous
+    entry marked stale — a blip must not blank it from the fleet."""
+    stack = storm_stack
+    stack.cluster.add_target_pod("blip", node=NODE_A)
+    _mount(stack, "blip")
+    first = stack.app.fleet.collect_once()
+    assert first["nodes"][NODE_B]["mode"] == "rpc"
+
+    # kill node B's worker: RPC fails (and there is no scrape target)
+    for server, name in zip(stack._servers, [NODE_A, NODE_B]):
+        if name == NODE_B:
+            server.stop(grace=None)
+    second = stack.app.fleet.collect_once()
+    entry = second["nodes"][NODE_B]
+    assert entry.get("stale") is True and entry.get("error")
+    assert entry["mount"]["count"] == \
+        first["nodes"][NODE_B]["mount"]["count"]  # previous data retained
+    assert second["nodes"][NODE_A].get("stale") is None
+
+
+def test_legacy_worker_falls_back_to_http_scrape(tmp_path, monkeypatch):
+    """A worker without the TelemetryService (the reference shape)
+    answers UNIMPLEMENTED; the collector recovers the same rollup by
+    scraping the worker's /metrics exposition."""
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.worker.main import serve_ops
+
+    cfg = Config().replace(slave_pod_timeout_s=10.0,
+                           fleet_scrape_interval_s=3600.0)
+    set_config(cfg)
+    stack = FleetStack(str(tmp_path), cfg, warm_on_a=False,
+                       telemetry_on_b=False)
+    ops = serve_ops(0)
+    try:
+        stack.cluster.add_target_pod("legacy-pod", node=NODE_B)
+        _mount(stack, "legacy-pod")
+        port = ops.server_address[1]
+        monkeypatch.setattr(
+            stack.app.fleet, "_scrape_url",
+            lambda ip: f"http://127.0.0.1:{port}/metrics")
+        rollup = stack.app.fleet.collect_once()
+        entry = rollup["nodes"][NODE_B]
+        assert entry["mode"] == "scrape"
+        assert entry["mount"]["count"] >= 1
+        assert rollup["nodes"][NODE_A]["mode"] == "rpc"
+        assert "default/legacy-pod" in entry["device_access"]
+    finally:
+        ops.shutdown()
+        ops.server_close()
+        stack.stop()
+        set_config(Config())
+
+
+def test_malformed_telemetry_payload_falls_back_to_scrape(
+        tmp_path, monkeypatch):
+    """A buggy worker answering garbage in the telemetry field follows
+    the same degrade path as a legacy one."""
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.worker.main import serve_ops
+
+    cfg = Config().replace(slave_pod_timeout_s=10.0,
+                           fleet_scrape_interval_s=3600.0)
+    set_config(cfg)
+    stack = FleetStack(str(tmp_path), cfg, warm_on_a=False)
+    ops = serve_ops(0)
+    try:
+        port = ops.server_address[1]
+        monkeypatch.setattr(
+            stack.app.fleet, "_scrape_url",
+            lambda ip: f"http://127.0.0.1:{port}/metrics")
+
+        class _GarbageClient:
+            def __init__(self, address):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+            def collect_telemetry(self, timeout_s=None):
+                from gpumounter_tpu.rpc import api
+                return api.CollectTelemetryResponse(telemetry="{broken")
+
+        monkeypatch.setattr(stack.app.fleet, "client_factory",
+                            _GarbageClient)
+        rollup = stack.app.fleet.collect_once()
+        for entry in rollup["nodes"].values():
+            assert entry["mode"] == "scrape"
+    finally:
+        ops.shutdown()
+        ops.server_close()
+        stack.stop()
+        set_config(Config())
+
+
+def test_payload_single_flight_collects_once(test_config):
+    """Concurrent stale observers must share ONE fan-out: the loser of
+    the race waits on the collection lock, re-checks, and reads the
+    winner's fresh rollup."""
+    import time as time_mod
+
+    from gpumounter_tpu.rpc import api
+
+    calls = []
+
+    class StubWorkers:
+        def registry_snapshot(self):
+            return {"n1": "10.0.0.1"}
+
+    class SlowClient:
+        def __init__(self, address):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def collect_telemetry(self, timeout_s=None):
+            calls.append(1)
+            time_mod.sleep(0.15)
+            return api.CollectTelemetryResponse(
+                telemetry=json.dumps(worker_telemetry_snapshot()))
+
+    fc = FleetCollector(StubWorkers(), SlowClient, cfg=test_config)
+    results = []
+
+    def poll():
+        results.append(fc.payload(max_age_s=30.0))
+
+    threads = [threading.Thread(target=poll) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1, "stale pollers must not each fan out"
+    assert all(set(r["nodes"]) == {"n1"} for r in results)
+
+
+def test_slo_engine_concurrent_ingest_and_evaluate():
+    """The collector thread ingests while /slo request threads evaluate:
+    no 'deque mutated during iteration', and the breach transition fires
+    exactly once across concurrent evaluators."""
+    kube = _FakeKube()
+    eng = SloEngine(cfg=_slo_cfg(), kube=kube)
+    bad = _rollup(count=10, buckets=[(0.05, 0), (0.1, 10)], success=10)
+    errors = []
+
+    def ingester():
+        try:
+            for _ in range(300):
+                eng.ingest(bad)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def evaluator():
+        try:
+            for _ in range(100):
+                eng.evaluate()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = ([threading.Thread(target=ingester) for _ in range(2)]
+               + [threading.Thread(target=evaluator) for _ in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert len([1 for _, m in kube.events
+                if m["reason"] == "TPUSLOBurnRate"]) == 1
+
+
+# --- routes, auth, CLI ---
+
+
+def test_fleet_and_slo_routes_read_scope_auth(test_config):
+    """Satellite: /fleet and /slo ride the PR 4 read-only scope on the
+    master — read token or mutate token with a read token configured;
+    mutate-token-only when unset; never open."""
+    from conftest import TEST_AUTH_TOKEN
+
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+
+    cfg = test_config.replace(auth_read_token="scrape-only-secret",
+                              fleet_scrape_interval_s=3600.0)
+    app = MasterApp(FakeKubeClient(), cfg=cfg)
+    read = {"Authorization": "Bearer scrape-only-secret"}
+    mutate = {"Authorization": f"Bearer {TEST_AUTH_TOKEN}"}
+    for path in ("/fleet", "/slo"):
+        assert app.handle("GET", path, b"", read)[0] == 200, path
+        assert app.handle("GET", path, b"", mutate)[0] == 200, path
+        assert app.handle("GET", path, b"", {})[0] == 401, path
+        bad = {"Authorization": "Bearer wrong"}
+        assert app.handle("GET", path, b"", bad)[0] == 401, path
+
+    # read scope still cannot mutate
+    status, _, _, _ = app.handle(
+        "POST", "/removetpu/namespace/default/pod/p/force/false",
+        b"uuids=a", read)
+    assert status == 401
+
+    # without a read token: mutate token required (tenant names leak)
+    app2 = MasterApp(FakeKubeClient(),
+                     cfg=test_config.replace(fleet_scrape_interval_s=3600.0))
+    for path in ("/fleet", "/slo"):
+        assert app2.handle("GET", path, b"", {})[0] == 401, path
+        assert app2.handle("GET", path, b"", mutate)[0] == 200, path
+
+
+def test_worker_telemetry_route_read_scope_auth(test_config, monkeypatch):
+    """Satellite, worker half: the ops port's /telemetry obeys the same
+    read/mutate/unset matrix."""
+    from conftest import TEST_AUTH_TOKEN
+
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.worker.main import serve_ops
+
+    def get(port, path, token=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            headers={"Authorization": f"Bearer {token}"} if token else {})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as exc:
+            return exc.code, ""
+
+    # read token configured: read or mutate token pass, junk/unset fail
+    cfg = test_config.replace(auth_read_token="worker-read-secret")
+    set_config(cfg)
+    ops = serve_ops(0, cfg=cfg)
+    try:
+        port = ops.server_address[1]
+        assert get(port, "/telemetry", "worker-read-secret")[0] == 200
+        status, body = get(port, "/telemetry", TEST_AUTH_TOKEN)
+        assert status == 200
+        assert json.loads(body)["schema"] == fleet_mod.TELEMETRY_SCHEMA
+        assert get(port, "/telemetry")[0] == 401
+        assert get(port, "/telemetry", "wrong")[0] == 401
+    finally:
+        ops.shutdown()
+        ops.server_close()
+
+    # no read token: the mutate secret gates it, unset is rejected
+    set_config(test_config)
+    ops2 = serve_ops(0, cfg=test_config)
+    try:
+        port = ops2.server_address[1]
+        assert get(port, "/telemetry", TEST_AUTH_TOKEN)[0] == 200
+        assert get(port, "/telemetry")[0] == 401
+    finally:
+        ops2.shutdown()
+        ops2.server_close()
+
+
+def test_fleet_and_slo_cli_verbs(test_config, capsys):
+    """tpumounter fleet / tpumounter slo against a live master; slo
+    exits 3 on breach."""
+    from gpumounter_tpu.cli import main as cli_main
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp, build_http_server
+
+    cfg = test_config.replace(fleet_scrape_interval_s=3600.0)
+    app = MasterApp(FakeKubeClient(), cfg=cfg)
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        assert cli_main(["fleet", "--master", base]) == 0
+        out = capsys.readouterr().out
+        assert '"fleet"' in out and '"nodes"' in out
+        assert cli_main(["slo", "--master", base]) == 0
+        out = capsys.readouterr().out
+        assert "mount-latency-50ms" in out
+
+        # force a breach: exit code 3
+        clock = [0.0]
+        app.slo.clock = lambda: clock[0]
+        app.slo.ingest(_rollup(count=10,
+                               buckets=[(0.05, 0), (0.1, 10)]))
+        assert cli_main(["slo", "--master", base]) == 3
+        capsys.readouterr()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.registry.stop()
+
+
+def test_openmetrics_negotiation_serves_exemplars(test_config):
+    """Classic scrapes stay exemplar-free; Accept:
+    application/openmetrics-text gets bucket exemplars with trace ids."""
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+
+    tid = trace.new_trace_id()
+    MOUNT_LATENCY.observe(0.02, trace_id=tid)
+    app = MasterApp(FakeKubeClient(), cfg=test_config)
+    status, ctype, body, _ = app.handle("GET", "/metrics", b"", _auth())
+    assert status == 200 and "# {" not in body
+    status, ctype, body, _ = app.handle(
+        "GET", "/metrics", b"",
+        {**_auth(), "Accept": "application/openmetrics-text"})
+    assert status == 200
+    assert ctype.startswith("application/openmetrics-text")
+    assert f'# {{trace_id="{tid}"}} 0.02' in body

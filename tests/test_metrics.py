@@ -1,0 +1,125 @@
+"""utils/metrics.py thread-safety + new accessors.
+
+Satellite audit of the MOUNT_CONCURRENCY fan-out: mount_many's inject
+pool and concurrent gRPC handler threads observe/inc shared instruments
+while scrapes render. The audit outcome (documented in the module
+docstring there): every sample mutation and read holds the instrument's
+lock — including the exemplar path added this PR. These tests prove it
+under contention and cover the snapshot/quantile/exemplar additions.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from concurrent import futures
+
+from gpumounter_tpu.utils.metrics import (
+    Counter,
+    Histogram,
+    Registry,
+    estimate_quantile,
+)
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*.*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? [-+0-9.eE]+)$")
+
+
+def test_concurrent_observe_loses_nothing():
+    """N threads hammer one histogram (labels + exemplars) while a
+    renderer races them: every observation lands, the sum is exact, and
+    every rendered line stays parseable mid-flight."""
+    reg = Registry()
+    hist = reg.histogram("t_stress_seconds", "stress")
+    counter = reg.counter("t_stress_total", "stress")
+    threads, per_thread = 8, 2000
+    stop_render = threading.Event()
+    render_errors: list[str] = []
+
+    def renderer():
+        while not stop_render.is_set():
+            for line in reg.render().splitlines():
+                if line and not _PROM_LINE.match(line):
+                    render_errors.append(line)
+                    return
+
+    def worker(tid: int):
+        for i in range(per_thread):
+            hist.observe(0.001 * (i % 50), trace_id=f"{tid:02d}" * 16,
+                         phase=f"p{tid % 2}")
+            counter.inc(result="success")
+
+    render_thread = threading.Thread(target=renderer)
+    render_thread.start()
+    with futures.ThreadPoolExecutor(max_workers=threads) as pool:
+        list(pool.map(worker, range(threads)))
+    stop_render.set()
+    render_thread.join()
+    assert render_errors == []
+    snap = hist.snapshot()
+    total = sum(entry["counts"][-1] for entry in snap.values())
+    assert total == threads * per_thread
+    expected_sum = threads * sum(0.001 * (i % 50) for i in range(per_thread))
+    assert abs(sum(e["sum"] for e in snap.values()) - expected_sum) < 1e-6
+    assert counter.get(result="success") == threads * per_thread
+    # exemplars landed under the same lock: every stored exemplar is a
+    # (trace_id, value, ts) triple from some thread
+    for entry in snap.values():
+        for trace_id, value, ts in entry["exemplars"].values():
+            assert len(trace_id) == 32 and value >= 0 and ts > 0
+
+
+def test_histogram_exemplar_capture_and_buckets():
+    hist = Histogram("t_ex_seconds", "x")
+    hist.observe(0.004, trace_id="aa" * 16)   # bucket 0 (le=0.005)
+    hist.observe(0.3, trace_id="bb" * 16)     # le=0.5 -> index 6
+    hist.observe(99.0, trace_id="cc" * 16)    # +Inf
+    hist.observe(0.0049, trace_id="dd" * 16)  # overwrites bucket 0
+    (entry,) = hist.snapshot().values()
+    ex = entry["exemplars"]
+    assert ex[0][0] == "dd" * 16              # last-write-wins
+    assert ex[6][0] == "bb" * 16
+    assert ex[len(hist.buckets)][0] == "cc" * 16
+    # untraced observes never store an exemplar
+    hist2 = Histogram("t_ex2_seconds", "x")
+    hist2.observe(0.004)
+    (entry2,) = hist2.snapshot().values()
+    assert entry2["exemplars"] == {}
+
+
+def test_histogram_quantile_and_estimate():
+    hist = Histogram("t_q_seconds", "x")
+    for _ in range(90):
+        hist.observe(0.004)
+    for _ in range(10):
+        hist.observe(0.2)
+    assert hist.quantile(0.5) <= 0.005
+    p95 = hist.quantile(0.95)
+    assert 0.1 < p95 <= 0.25
+    assert hist.quantile(0.5, other="labels") == 0.0  # unknown labelset
+    # direct estimator edge cases
+    assert estimate_quantile((0.1, 1.0), [0, 0, 0], 0.5) == 0.0
+    assert estimate_quantile((0.1, 1.0), [10, 10, 10], 0.99) <= 0.1
+    # everything in +Inf clamps to the largest finite bound
+    assert estimate_quantile((0.1, 1.0), [0, 0, 10], 0.5) == 1.0
+
+
+def test_counter_total_and_snapshot():
+    c = Counter("t_total", "x")
+    c.inc(2.0, result="success")
+    c.inc(1.0, result="error")
+    assert c.total() == 3.0
+    assert c.snapshot() == {(("result", "success"),): 2.0,
+                            (("result", "error"),): 1.0}
+
+
+def test_registry_series_count_and_find():
+    reg = Registry()
+    c = reg.counter("t_a_total", "a")
+    reg.gauge("t_b", "b")
+    c.inc(result="x")
+    c.inc(result="y")
+    assert reg.find("t_a_total") is c
+    assert reg.find("absent") is None
+    assert reg.series_count() == 3  # two counter series + gauge's 0 line

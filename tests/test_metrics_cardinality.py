@@ -1,0 +1,136 @@
+"""CI metrics-cardinality guard (obs lane).
+
+Per-tenant labels (the device-access telemetry) are the first metrics in
+this codebase whose label values come from user-controlled names — the
+classic way a /metrics exposition silently explodes to millions of
+series and takes the scrape pipeline down with it. This lane fails when:
+
+  * a fake-cluster control-plane run pushes the per-daemon exposition
+    over the series budget, or
+  * the tenant-label cap stops bounding the device-access series.
+
+If you add metrics and trip the budget, first ask whether a label is
+unbounded (pod names, uuids, trace ids are NOT metric labels — they
+belong in the audit trail / spans); raise the budget only for bounded
+series.
+"""
+
+from __future__ import annotations
+
+from gpumounter_tpu.cgroup import ebpf
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+#: per-daemon series budget (sample lines, comments excluded). The full
+#: control-plane run below currently sits well under 300; headroom is
+#: deliberate slack for label growth, not an invitation.
+SERIES_BUDGET = 400
+
+
+def test_fake_cluster_run_stays_within_series_budget(tmp_path):
+    """Drive a real mount + unmount + fleet collection + SLO evaluation
+    over the fake cluster — the path that populates every subsystem's
+    instruments — then measure the exposition."""
+    import threading
+
+    from gpumounter_tpu.collector.collector import TpuCollector
+    from gpumounter_tpu.collector.podresources import PodResourcesClient
+    from gpumounter_tpu.config import Config, set_config
+    from gpumounter_tpu.master.app import (
+        MasterApp,
+        WorkerRegistry,
+        build_http_server,
+    )
+    from gpumounter_tpu.testing.cluster import FakeCluster
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+    from gpumounter_tpu.worker.server import TpuMountService, build_server
+    from conftest import AUTH_HEADER
+
+    import urllib.parse
+    import urllib.request
+
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    set_config(cluster.cfg)
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cluster.cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cluster.cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cluster.cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev), description=f"{pod.namespace}/{pod.name}")
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cluster.cfg)
+    grpc_server = build_server(service, address="localhost:0")
+    grpc_server.start()
+    cfg = cluster.cfg.replace(worker_port=grpc_server.bound_port,
+                              fleet_scrape_interval_s=3600.0)
+    cluster.kube.create_pod(cfg.worker_namespace, {
+        "metadata": {"name": "card-worker",
+                     "namespace": cfg.worker_namespace,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": cluster.node_name,
+                 "containers": [{"name": "w"}]},
+        "status": {"phase": "Running", "podIP": "127.0.0.1"},
+    })
+    app = MasterApp(cluster.kube, cfg=cfg,
+                    registry=WorkerRegistry(cluster.kube, cfg))
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def http(method, path, form=None):
+        data = (urllib.parse.urlencode(form, doseq=True).encode()
+                if form else None)
+        req = urllib.request.Request(base + path, data=data, method=method,
+                                     headers=dict(AUTH_HEADER))
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read().decode()
+
+    try:
+        cluster.add_target_pod("card-pod")
+        status, _ = http("GET", "/addtpu/namespace/default/pod/card-pod"
+                                "/tpu/2/isEntireMount/false")
+        assert status == 200
+        assert http("GET", "/fleet")[0] == 200
+        assert http("GET", "/slo")[0] == 200
+        from gpumounter_tpu.k8s.types import Pod
+        pod = Pod(cluster.kube.get_pod("default", "card-pod"))
+        slaves = {p.name for p in service.allocator.slave_pods_for(pod)}
+        pod_devices = service.collector.get_pod_devices(
+            "card-pod", "default", slave_pod_names=slaves)
+        uuids = ",".join(d.uuid for d in pod_devices)
+        status, _ = http("POST", "/removetpu/namespace/default/pod/card-pod"
+                                 "/force/true", form={"uuids": uuids})
+        assert status == 200
+
+        count = REGISTRY.series_count()
+        assert count <= SERIES_BUDGET, (
+            f"/metrics exposition grew to {count} series "
+            f"(budget {SERIES_BUDGET}) — an unbounded label slipped in? "
+            f"See this file's docstring before raising the budget.")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.registry.stop()
+        grpc_server.stop(grace=None)
+        cluster.stop()
+        from gpumounter_tpu.config import Config as _C, set_config as _s
+        _s(_C())
+
+
+def test_tenant_label_cardinality_is_capped():
+    """The device-access table folds tenants beyond max_tenants into
+    one _overflow bucket: a churny namespace cannot explode the
+    per-tenant series no matter how many pods cycle through."""
+    before = REGISTRY.series_count()
+    for i in range(ebpf.DEVICE_TELEMETRY.max_tenants * 3):
+        ebpf.DEVICE_TELEMETRY.record(f"churn/pod-{i}", "grant")
+    counts = ebpf.DEVICE_TELEMETRY.counts()
+    tenants = {t for t, _ in counts}
+    assert len(tenants) == ebpf.DEVICE_TELEMETRY.max_tenants + 1
+    assert counts[(ebpf.TELEMETRY_OVERFLOW_TENANT, "grant")] == \
+        2.0 * ebpf.DEVICE_TELEMETRY.max_tenants
+    grown = REGISTRY.series_count() - before
+    assert grown <= ebpf.DEVICE_TELEMETRY.max_tenants + 1

@@ -198,3 +198,86 @@ def test_trace_context_non_string_parses_to_none():
 
     for bad in (None, 7, b"aa" * 16, ["x"], {"trace": "y"}):
         assert trace.parse_wire_context(bad) is None
+
+
+# --- CollectTelemetry round-tripping (obs/fleet.py over rpc/api.py) ---
+#
+# Same coverage matrix as the trace-context tests above: the telemetry
+# payload is our extension (a JSON document in a proto3 string field); a
+# legacy decoder must skip it, and the tolerant parse must turn every
+# degenerate payload into None so the collector degrades to the HTTP
+# scrape instead of failing the pass.
+
+
+def test_telemetry_payload_present_roundtrips():
+    import json
+
+    from gpumounter_tpu.obs.fleet import (
+        parse_telemetry,
+        worker_telemetry_snapshot,
+    )
+    payload = json.dumps(worker_telemetry_snapshot())
+    msg = api.CollectTelemetryResponse(
+        collect_telemetry_result=api.CollectTelemetryResult.Success,
+        node_name="node-7", telemetry=payload)
+    decoded = api.CollectTelemetryResponse.decode(msg.encode())
+    assert decoded.node_name == "node-7"
+    assert decoded.telemetry == payload  # codec is faithful...
+    doc = parse_telemetry(decoded.telemetry)
+    assert doc is not None and "mount_latency" in doc  # ...parse accepts
+
+    # request side: the trace_context extension round-trips like the
+    # other four request messages
+    req = api.CollectTelemetryRequest(trace_context="aa" * 16 + "-" + "bb" * 8)
+    assert api.CollectTelemetryRequest.decode(
+        req.encode()).trace_context == req.trace_context
+
+
+def test_telemetry_payload_absent_parses_to_none():
+    from gpumounter_tpu.obs.fleet import parse_telemetry
+
+    msg = api.CollectTelemetryResponse(
+        collect_telemetry_result=api.CollectTelemetryResult.Success)
+    decoded = api.CollectTelemetryResponse.decode(msg.encode())
+    assert decoded.telemetry == ""  # proto3 default: omitted on the wire
+    assert parse_telemetry(decoded.telemetry) is None
+
+
+@pytest.mark.parametrize("malformed", [
+    "not json at all",
+    "{broken",
+    "[1, 2, 3]",                      # JSON but not an object
+    '"just a string"',
+    '{"schema": "other-schema/99"}',  # wrong schema marker
+    "{}",                             # object with no schema
+    "\x00\x01\x02",
+])
+def test_telemetry_payload_malformed_parses_to_none(malformed):
+    from gpumounter_tpu.obs.fleet import parse_telemetry
+
+    msg = api.CollectTelemetryResponse(telemetry=malformed)
+    decoded = api.CollectTelemetryResponse.decode(msg.encode())
+    assert decoded.telemetry == malformed  # codec is faithful...
+    assert parse_telemetry(decoded.telemetry) is None  # ...parse tolerant
+
+
+def test_telemetry_fields_unknown_to_legacy_decoder_are_skipped():
+    """A legacy decoder (no telemetry/node_name fields) must skip our
+    extension fields unharmed — both directions of the fallback story
+    (the scrape-path e2e lives in tests/test_fleet.py)."""
+
+    class LegacyResponse(Message):
+        FIELDS = [
+            Field(1, "collect_telemetry_result", "enum"),
+        ]
+
+    ours = api.CollectTelemetryResponse(
+        collect_telemetry_result=api.CollectTelemetryResult.Success,
+        node_name="n", telemetry='{"schema": "tpumounter-telemetry/1"}')
+    decoded = LegacyResponse.decode(ours.encode())
+    assert decoded.collect_telemetry_result == 0
+
+    # and the reverse: our decoder tolerates a legacy (empty) response
+    legacy = LegacyResponse()
+    back = api.CollectTelemetryResponse.decode(legacy.encode())
+    assert back.telemetry == "" and back.node_name == ""
