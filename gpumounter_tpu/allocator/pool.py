@@ -211,15 +211,21 @@ class WarmPodPool:
         self.ensure_node(node)
         adopted: list[str] = []
         while len(adopted) < count:
+            # One adopt pass per node: the whole remaining want is popped
+            # under a single lock hold, so a storm of concurrent mounts
+            # on one node serializes on the lock once per batch instead
+            # of once per holder (the bulk-mount path's common case).
             with self._lock:
                 bucket = self._ready.get(node, [])
-                name = bucket.pop(0) if bucket else None
-                if name is not None:
+                batch = bucket[:count - len(adopted)]
+                del bucket[:len(batch)]
+                if batch:
                     WARM_POOL_READY.set(float(len(bucket)), node=node)
-            if name is None:
+            if not batch:
                 break
-            if self._adopt(name, owner):
-                adopted.append(name)
+            for name in batch:
+                if self._adopt(name, owner):
+                    adopted.append(name)
         if adopted:
             WARM_POOL_HITS.inc(float(len(adopted)))
             logger.info("warm-pool: adopted %d holder(s) for %s/%s: %s",

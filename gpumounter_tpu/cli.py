@@ -10,15 +10,21 @@ Two families of verbs:
     unmount --target-dev DIR [--pid N] [--cgroup DIR] --uuid U.. [--force]
 
   Remote (against a running master, same HTTP API as the reference's
-  QuickStart curl examples):
-    add     --master URL --namespace NS --pod POD --num N [--entire]
+  QuickStart curl examples). `--master` accepts a single URL or a
+  comma-separated replica list: endpoints fail over on connection
+  errors and shard 307 redirects are followed transparently
+  (rpc/http_failover.py + master/shard.py):
+    add     --master URL[,URL...] --namespace NS --pod POD --num N
+    bulk-add --master URL --target [NS/]POD[:CHIPS] ...   one request,
+                                   many mounts (POST /batch/addtpu)
     remove  --master URL --namespace NS --pod POD --uuids U,U [--force]
     migrate start|status|abort     live chip migration between pods
     audit   [--pod POD] [--trace ID] [--op PREFIX]   the audit trail
     trace ID                       all buffered spans for one trace
     fleet                          federated per-node fleet rollup
     slo                            SLO burn-rate evaluation
-                                   (the four above accept --read-token:
+    shards                         shard -> owner replica table
+                                   (the five above accept --read-token:
                                    the read-only observability scope)
 
 The reference has no CLI at all (interaction is raw curl,
@@ -141,24 +147,27 @@ def cmd_unmount(args) -> int:
     return rc
 
 
-def _http(method: str, url: str, form: dict | None = None,
+def _endpoints(args, token: str | None):
+    """The failover client over `--master` (a URL or a comma-separated
+    replica list): tries replicas in order, follows shard 307 redirects
+    re-sending the body, and fails over on connection errors/503s
+    (rpc/http_failover.py)."""
+    from gpumounter_tpu.rpc.http_failover import MasterEndpoints
+    return MasterEndpoints(args.master, token=token)
+
+
+def _http(args, method: str, path: str, form: dict | None = None,
           token: str | None = None,
           json_body: dict | None = None) -> tuple[int, str]:
-    if json_body is not None:
-        data = json.dumps(json_body).encode()
-    else:
-        data = (urllib.parse.urlencode(form, doseq=True).encode()
-                if form else None)
-    req = urllib.request.Request(url, data=data, method=method)
-    if json_body is not None:
-        req.add_header("Content-Type", "application/json")
-    if token:
-        req.add_header("Authorization", f"Bearer {token}")
+    """One master request; exits 1 with a one-line error when every
+    replica is unreachable (a traceback is not a CLI answer)."""
+    from gpumounter_tpu.rpc.http_failover import EndpointError
     try:
-        with urllib.request.urlopen(req) as resp:
-            return resp.status, resp.read().decode()
-    except urllib.error.HTTPError as exc:
-        return exc.code, exc.read().decode()
+        return _endpoints(args, token).request(
+            method, path, form=form, json_body=json_body)
+    except EndpointError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        raise SystemExit(1)
 
 
 def _remote_token(args) -> str | None:
@@ -179,55 +188,54 @@ def _remote_token(args) -> str | None:
 
 
 def cmd_add(args) -> int:
-    url = (f"{args.master.rstrip('/')}/addtpu/namespace/{args.namespace}"
-           f"/pod/{args.pod}/tpu/{args.num}"
-           f"/isEntireMount/{str(args.entire).lower()}")
-    status, body = _http("GET", url, token=_remote_token(args))
+    path = (f"/addtpu/namespace/{args.namespace}"
+            f"/pod/{args.pod}/tpu/{args.num}"
+            f"/isEntireMount/{str(args.entire).lower()}")
+    status, body = _http(args, "GET", path, token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
 
 def cmd_remove(args) -> int:
-    url = (f"{args.master.rstrip('/')}/removetpu/namespace/{args.namespace}"
-           f"/pod/{args.pod}/force/{str(args.force).lower()}")
-    status, body = _http("POST", url, form={"uuids": args.uuids},
+    path = (f"/removetpu/namespace/{args.namespace}"
+            f"/pod/{args.pod}/force/{str(args.force).lower()}")
+    status, body = _http(args, "POST", path, form={"uuids": args.uuids},
                          token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
 
-def _intent_url(args, with_pod: bool = True) -> str:
-    base = f"{args.master.rstrip('/')}/intents"
+def _intent_path(args, with_pod: bool = True) -> str:
     if with_pod:
-        return f"{base}/{args.namespace}/{args.pod}"
-    return base
+        return f"/intents/{args.namespace}/{args.pod}"
+    return "/intents"
 
 
 def cmd_intent_set(args) -> int:
     payload = {"desiredChips": args.chips, "minChips": args.min_chips,
                "priority": args.priority}
-    status, body = _http("PUT", _intent_url(args), json_body=payload,
-                         token=_remote_token(args))
+    status, body = _http(args, "PUT", _intent_path(args),
+                         json_body=payload, token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
 
 def cmd_intent_get(args) -> int:
-    status, body = _http("GET", _intent_url(args),
+    status, body = _http(args, "GET", _intent_path(args),
                          token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
 
 def cmd_intent_delete(args) -> int:
-    status, body = _http("DELETE", _intent_url(args),
+    status, body = _http(args, "DELETE", _intent_path(args),
                          token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
 
 def cmd_intent_list(args) -> int:
-    status, body = _http("GET", _intent_url(args, with_pod=False),
+    status, body = _http(args, "GET", _intent_path(args, with_pod=False),
                          token=_remote_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
@@ -247,16 +255,15 @@ def cmd_audit(args) -> int:
         ("namespace", args.namespace), ("pod", args.pod), ("op", args.op),
         ("trace", args.trace), ("outcome", args.outcome),
         ("limit", str(args.limit))) if v}
-    url = (f"{args.master.rstrip('/')}/audit?"
-           f"{urllib.parse.urlencode(params)}")
-    status, body = _http("GET", url, token=_obs_token(args))
+    path = f"/audit?{urllib.parse.urlencode(params)}"
+    status, body = _http(args, "GET", path, token=_obs_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
 
 
 def cmd_trace(args) -> int:
-    url = f"{args.master.rstrip('/')}/trace/{args.id}"
-    status, body = _http("GET", url, token=_obs_token(args))
+    status, body = _http(args, "GET", f"/trace/{args.id}",
+                         token=_obs_token(args))
     print(body.rstrip())
     if status == 404:
         return 2  # unknown/expired trace id: rejected, not a failure
@@ -264,17 +271,57 @@ def cmd_trace(args) -> int:
 
 
 def cmd_fleet(args) -> int:
-    status, body = _http("GET", f"{args.master.rstrip('/')}/fleet",
-                         token=_obs_token(args))
+    status, body = _http(args, "GET", "/fleet", token=_obs_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
+
+
+def cmd_shards(args) -> int:
+    """The shard table: which master replica owns which shard."""
+    status, body = _http(args, "GET", "/shards", token=_obs_token(args))
+    print(body.rstrip())
+    return 0 if status == 200 else 1
+
+
+def _parse_bulk_target(raw: str, default_ns: str) -> dict:
+    """"[ns/]pod[:chips]" -> a /batch/addtpu target entry."""
+    body, _, chips = raw.partition(":")
+    ns, _, pod = body.rpartition("/")
+    entry = {"namespace": ns or default_ns, "pod": pod or body}
+    if chips:
+        try:
+            entry["chips"] = int(chips)
+        except ValueError:
+            raise SystemExit(f"error: bad --target {raw!r} "
+                             f"(chips must be an integer)")
+    return entry
+
+
+def cmd_bulk_add(args) -> int:
+    """One request, many mounts: exit 0 only when EVERY target mounted
+    (per-target results are printed either way)."""
+    targets = [_parse_bulk_target(t, args.namespace)
+               for t in args.target]
+    if args.entire:
+        for t in targets:
+            t["isEntireMount"] = True
+    status, body = _http(args, "POST", "/batch/addtpu",
+                         json_body={"targets": targets},
+                         token=_remote_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        summary = json.loads(body).get("summary", {})
+    except ValueError:
+        return 1
+    return 0 if summary.get("success") == summary.get("total") else 1
 
 
 def cmd_slo(args) -> int:
     """Print the SLO evaluation; exit 3 when any objective is in breach
     (scriptable: a deploy gate can `tpumounter slo && roll`)."""
-    status, body = _http("GET", f"{args.master.rstrip('/')}/slo",
-                         token=_obs_token(args))
+    status, body = _http(args, "GET", "/slo", token=_obs_token(args))
     print(body.rstrip())
     if status != 200:
         return 1
@@ -305,7 +352,7 @@ def cmd_migrate_start(args) -> int:
                         "pod": args.dest_pod},
     }
     token = _remote_token(args)
-    status, body = _http("POST", f"{args.master.rstrip('/')}/migrate",
+    status, body = _http(args, "POST", "/migrate",
                          json_body=payload, token=token)
     print(body.rstrip())
     if 400 <= status < 500:
@@ -315,16 +362,15 @@ def cmd_migrate_start(args) -> int:
     if not args.wait:
         return EXIT_OK
     mid = json.loads(body)["id"]
+    endpoints = _endpoints(args, token)
     deadline = time.monotonic() + args.wait_timeout
     while time.monotonic() < deadline:
-        # Transient poll failures (master restarting, blip) must not
-        # abort the wait: the journal survives in pod annotations and a
-        # restarted master re-adopts the migration, so keep polling
-        # until the deadline.
+        # Transient poll failures (every replica restarting, blip) must
+        # not abort the wait: the journal survives in pod annotations
+        # and a restarted/peer master re-adopts the migration, so keep
+        # polling until the deadline.
         try:
-            status, body = _http(
-                "GET", f"{args.master.rstrip('/')}/migrations/{mid}",
-                token=token)
+            status, body = endpoints.request("GET", f"/migrations/{mid}")
         except (urllib.error.URLError, OSError):
             status = None
         if status == 200:
@@ -339,9 +385,8 @@ def cmd_migrate_start(args) -> int:
 
 
 def cmd_migrate_status(args) -> int:
-    base = f"{args.master.rstrip('/')}/migrations"
-    url = f"{base}/{args.id}" if args.id else base
-    status, body = _http("GET", url, token=_remote_token(args))
+    path = f"/migrations/{args.id}" if args.id else "/migrations"
+    status, body = _http(args, "GET", path, token=_remote_token(args))
     print(body.rstrip())
     if 400 <= status < 500:
         return EXIT_REJECTED
@@ -349,9 +394,8 @@ def cmd_migrate_status(args) -> int:
 
 
 def cmd_migrate_abort(args) -> int:
-    status, body = _http(
-        "POST", f"{args.master.rstrip('/')}/migrations/{args.id}/abort",
-        token=_remote_token(args))
+    status, body = _http(args, "POST", f"/migrations/{args.id}/abort",
+                         token=_remote_token(args))
     print(body.rstrip())
     if 400 <= status < 500:
         return EXIT_REJECTED
@@ -391,7 +435,9 @@ def build_parser() -> argparse.ArgumentParser:
     um.set_defaults(fn=cmd_unmount)
 
     a = sub.add_parser("add", help="hot-add via a running master")
-    a.add_argument("--master", required=True)
+    a.add_argument("--master", required=True,
+                   help="master URL, or a comma-separated replica list "
+                        "(failover + shard-redirect following)")
     a.add_argument("--namespace", default="default")
     a.add_argument("--pod", required=True)
     a.add_argument("--num", type=int, default=1)
@@ -400,6 +446,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="master bearer token (default: "
                         "TPUMOUNTER_AUTH_TOKEN[_FILE])")
     a.set_defaults(fn=cmd_add)
+
+    # Bulk mount: one POST /batch/addtpu covering many pods; the master
+    # groups targets by owning shard and node (docs/FAQ.md on when bulk
+    # beats per-pod adds).
+    ba = sub.add_parser("bulk-add", help="mount chips into MANY pods in "
+                                         "one request")
+    ba.add_argument("--master", required=True,
+                    help="master URL, or a comma-separated replica list")
+    ba.add_argument("--namespace", default="default",
+                    help="default namespace for --target entries")
+    ba.add_argument("--target", action="append", required=True,
+                    metavar="[NS/]POD[:CHIPS]",
+                    help="repeatable; e.g. --target serve-a:2 "
+                         "--target jobs/serve-b")
+    ba.add_argument("--entire", action="store_true",
+                    help="entire-mount each target's chips")
+    ba.add_argument("--token", default=None,
+                    help="master bearer token (default: "
+                         "TPUMOUNTER_AUTH_TOKEN[_FILE])")
+    ba.set_defaults(fn=cmd_bulk_add)
 
     # Elastic intents: declare desired chip counts; the master's
     # reconciler converges and keeps converging (self-healing).
@@ -515,6 +581,11 @@ def build_parser() -> argparse.ArgumentParser:
                                     "when any objective is in breach)")
     _obs_common(sl)
     sl.set_defaults(fn=cmd_slo)
+
+    sh = sub.add_parser("shards", help="shard table: which master "
+                                       "replica owns which node shard")
+    _obs_common(sh)
+    sh.set_defaults(fn=cmd_shards)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

@@ -97,6 +97,61 @@ class Config:
     channel_keepalive_time_s: float = field(default_factory=lambda: float(
         _env("CHANNEL_KEEPALIVE_TIME_S", "30")))
 
+    # --- sharded masters (master/shard.py) ---
+    # Node ownership is split across this many shards by consistent
+    # hashing; each shard has one leader at a time, elected through a
+    # coordination.k8s.io/v1 Lease. 1 (the default) is the paper's
+    # single-master shape: no lease traffic, every node is local.
+    shard_count: int = field(default_factory=lambda: int(
+        _env("TPUMOUNTER_SHARD_COUNT", "1")))
+    # Lease TTL: a crashed leader's shards become claimable this long
+    # after its last renew. Lower = faster takeover, more API writes.
+    shard_lease_duration_s: float = field(default_factory=lambda: float(
+        _env("SHARD_LEASE_DURATION_S", "15")))
+    # Renew cadence; 0 = duration / 3 (the leader-election convention:
+    # two missed renews still leave slack before expiry).
+    shard_renew_interval_s: float = field(default_factory=lambda: float(
+        _env("SHARD_RENEW_INTERVAL_S", "0")))
+    # Namespace holding the tpumounter-shard-<i> Lease objects;
+    # "" = worker_namespace.
+    shard_lease_namespace: str = field(default_factory=lambda: _env(
+        "SHARD_LEASE_NAMESPACE", ""))
+    # This replica's identity in lease holder records; "" = hostname
+    # (the pod name in a StatefulSet — stable across restarts).
+    replica_id: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_REPLICA_ID", ""))
+    # URL peers/clients can reach THIS replica at; stamped into lease
+    # holder records so a non-owner replica can 307-redirect or proxy
+    # to the owner. "" = redirects degrade to 503 (clients retry).
+    advertise_url: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_ADVERTISE_URL", ""))
+    # Which never-held shards this replica volunteers for: "auto" (the
+    # default) derives {ordinal % shard_count} from a trailing "-<n>"
+    # in replica_id (StatefulSet pod names), "" volunteers for any, or
+    # an explicit comma list ("0,2"). Expired leases are ALWAYS
+    # claimable by anyone — preference shapes initial balance, never
+    # availability.
+    shard_preferred: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_SHARD_PREFERRED", "auto"))
+
+    # --- master admission control + bulk mounts ---
+    # Max client requests processed concurrently by one master replica;
+    # 0 = unbounded (legacy). Under a mount storm a bounded master
+    # queues instead of spawning unbounded handler threads — and the
+    # fleet bench measures exactly this capacity times the shard count.
+    master_http_concurrency: int = field(default_factory=lambda: int(
+        _env("MASTER_HTTP_CONCURRENCY", "0")))
+    # Per-request target cap for POST /batch/addtpu.
+    bulk_max_targets: int = field(default_factory=lambda: int(
+        _env("BULK_MAX_TARGETS", "256")))
+    # How many nodes a bulk request mounts on concurrently (one worker
+    # client per node, borrowed from the shared channel pool).
+    bulk_node_fanout: int = field(default_factory=lambda: int(
+        _env("BULK_NODE_FANOUT", "16")))
+    # Deadline for a sub-batch proxied to the owning replica.
+    bulk_proxy_timeout_s: float = field(default_factory=lambda: float(
+        _env("BULK_PROXY_TIMEOUT_S", "330")))
+
     # --- master-side request validation ---
     # Reference accepts any int32 gpuNum incl. 0/negative at L1
     # (cmd/GPUMounter-master/main.go:31-43 parses but never range-checks);

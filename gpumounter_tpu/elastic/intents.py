@@ -19,7 +19,6 @@ from dataclasses import dataclass
 
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.k8s.client import KubeClient
-from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("elastic.intents")
@@ -96,35 +95,37 @@ class Intent:
 
 class IntentStore:
     """CRUD over intent annotations. Raises k8s NotFoundError when the
-    target pod does not exist (the intent has nothing to live on)."""
+    target pod does not exist (the intent has nothing to live on).
 
-    def __init__(self, kube: KubeClient, cfg=None):
+    Persistence is delegated to a MasterStore backend (store/base.py) —
+    by default the annotation-persisted KubeMasterStore, so the intent
+    API is unchanged while the actual state lives behind the seam any
+    stateless master replica rebuilds from."""
+
+    def __init__(self, kube: KubeClient, cfg=None, backend=None):
         self.kube = kube
         self.cfg = cfg or get_config()
+        if backend is None:
+            from gpumounter_tpu.store import KubeMasterStore
+            backend = KubeMasterStore(kube, self.cfg)
+        self.backend = backend
 
     def put(self, namespace: str, pod_name: str, intent: Intent) -> Intent:
         intent.validate(self.cfg.max_tpu_per_request)
-        self.kube.patch_pod(namespace, pod_name, {
-            "metadata": {"annotations": intent.to_annotations()}})
+        self.backend.put_intent(namespace, pod_name, intent)
         logger.info("intent set: %s/%s desired=%d min=%d priority=%d",
                     namespace, pod_name, intent.desired_chips,
                     intent.min_chips, intent.priority)
         return intent
 
     def get(self, namespace: str, pod_name: str) -> Intent | None:
-        pod = Pod(self.kube.get_pod(namespace, pod_name))
-        return Intent.from_annotations(pod.annotations)
+        return self.backend.get_intent(namespace, pod_name)
 
     def delete(self, namespace: str, pod_name: str) -> bool:
         """Remove the intent (and the heal marker); the pod keeps its
         currently-mounted chips — deletion stops management, it does not
         unmount. Returns whether an intent was present."""
-        pod = Pod(self.kube.get_pod(namespace, pod_name))
-        had = ANNOT_DESIRED in pod.annotations
-        self.kube.patch_pod(namespace, pod_name, {
-            "metadata": {"annotations": {
-                ANNOT_DESIRED: None, ANNOT_MIN: None,
-                ANNOT_PRIORITY: None, ANNOT_REPLACED: None}}})
+        had = self.backend.delete_intent(namespace, pod_name)
         if had:
             logger.info("intent deleted: %s/%s", namespace, pod_name)
         return had
@@ -132,15 +133,4 @@ class IntentStore:
     def list(self) -> list[tuple[str, str, Intent]]:
         """Every (namespace, pod, intent) in the cluster — one LIST, used
         by the reconciler's periodic resync."""
-        out = []
-        for pod_json in self.kube.list_pods():
-            pod = Pod(pod_json)
-            try:
-                intent = Intent.from_annotations(pod.annotations)
-            except IntentError as exc:
-                logger.warning("skipping malformed intent on %s/%s: %s",
-                               pod.namespace, pod.name, exc)
-                continue
-            if intent is not None:
-                out.append((pod.namespace, pod.name, intent))
-        return out
+        return self.backend.list_intents()

@@ -81,15 +81,18 @@ def _post_pod_event(kube: KubeClient, pod: Pod, reason: str, message: str,
 class ElasticReconciler:
     def __init__(self, kube: KubeClient, registry, client_factory,
                  cfg=None, store: IntentStore | None = None,
-                 backoff: BackoffPolicy | None = None):
+                 backoff: BackoffPolicy | None = None, shards=None):
         """registry/client_factory: the MasterApp's WorkerRegistry and
         worker-client factory — the reconciler drives the same RPCs the
-        imperative routes do."""
+        imperative routes do. shards: optional ShardManager — when
+        active, intents on nodes this replica does not own are parked
+        (their shard's owner converges them)."""
         self.cfg = cfg or get_config()
         self.kube = kube
         self.registry = registry
         self.client_factory = client_factory
         self.store = store or IntentStore(kube, self.cfg)
+        self.shards = shards
         self.queue = RateLimitedQueue(
             backoff=backoff or BackoffPolicy(
                 base_s=self.cfg.elastic_backoff_base_s,
@@ -217,7 +220,7 @@ class ElasticReconciler:
                 pending.publish()
                 raise
             if outcome.get("phase") not in ("converged", "unmanaged",
-                                            "gone") \
+                                            "gone", "not-owned") \
                     or outcome.get("healed") or outcome.get("added") \
                     or outcome.get("removed_excess"):
                 pending.publish()
@@ -261,6 +264,15 @@ class ElasticReconciler:
             return {"phase": "migrating", "migration": mid}
         if not pod.node_name:
             raise ReconcileError(f"pod {pod_name} is not scheduled yet")
+        if self.shards is not None and self.shards.active() \
+                and not self.shards.owns_node(pod.node_name):
+            # Sharded masters: the node's shard owner reconciles this
+            # intent — two replicas converging one pod would race their
+            # probe/mount decisions. Parked, not retried: our resync
+            # re-enqueues it, and after a takeover this branch flips.
+            self.queue.forget(key)
+            return {"phase": "not-owned",
+                    "shard": self.shards.owner_shard(pod.node_name)}
         address = self.registry.worker_address(pod.node_name)
         if address is None:
             raise ReconcileError(

@@ -144,6 +144,33 @@ class KubeClient(abc.ABC):
         non-cluster deployments (CLI local mode) need nothing."""
         return {}
 
+    # --- coordination.k8s.io/v1 Leases (shard leader election) ---
+    #
+    # The sharded-master plane (master/shard.py) elects one owner per
+    # node shard through standard Lease objects, exactly like
+    # kube-controller-manager leader election: acquire = create (or
+    # replace an expired holder), renew = replace with a fresh
+    # renewTime, and every replace carries the read resourceVersion so
+    # two replicas racing for the same lease get a clean ConflictError
+    # instead of a silent last-writer-wins.
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support leases")
+
+    def create_lease(self, namespace: str, manifest: dict) -> dict:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support leases")
+
+    def update_lease(self, namespace: str, name: str,
+                     manifest: dict) -> dict:
+        """Full replace (PUT). The manifest's metadata.resourceVersion
+        must match the server's current one; raises ConflictError when
+        another writer got there first — the CAS the shard manager's
+        acquire/renew race safety rests on."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support leases")
+
     # --- composed helper used by the allocator ---
 
     def wait_for_pod(self, namespace: str, name: str, predicate,
@@ -362,6 +389,27 @@ class RestKubeClient(KubeClient):
 
     def create_event(self, namespace: str, manifest: dict) -> dict:
         return self._json("POST", f"/api/v1/namespaces/{namespace}/events",
+                          body=manifest)
+
+    # --- leases (coordination.k8s.io/v1) ---
+
+    _LEASE_BASE = "/apis/coordination.k8s.io/v1/namespaces"
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        return self._json("GET",
+                          f"{self._LEASE_BASE}/{namespace}/leases/{name}")
+
+    def create_lease(self, namespace: str, manifest: dict) -> dict:
+        inject_write_fault("create_lease", namespace,
+                           manifest.get("metadata", {}).get("name", ""))
+        return self._json("POST", f"{self._LEASE_BASE}/{namespace}/leases",
+                          body=manifest)
+
+    def update_lease(self, namespace: str, name: str,
+                     manifest: dict) -> dict:
+        inject_write_fault("update_lease", namespace, name)
+        return self._json("PUT",
+                          f"{self._LEASE_BASE}/{namespace}/leases/{name}",
                           body=manifest)
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",

@@ -71,6 +71,8 @@ class FakeKubeClient(KubeClient):
                  scheduler_delay_s: float = 0.0,
                  delete_hook: SchedulerHook | None = None):
         self._pods: dict[tuple[str, str], dict] = {}
+        self._leases: dict[tuple[str, str], dict] = {}
+        self._lease_rv = itertools.count(1)
         self._lock = threading.Condition()
         self._events: list[tuple[int, str, dict]] = []  # (seq, type, pod)
         self._seq = itertools.count(1)
@@ -92,9 +94,23 @@ class FakeKubeClient(KubeClient):
 
     # --- event plumbing ---
 
+    #: bounded event backlog. Sequence numbers are consecutive, so any
+    #: watcher can locate its resume point by arithmetic (O(1), not an
+    #: O(total-events) rescan per wake — the old shape made a 1k-node
+    #: churn test quadratic). A watcher that falls behind the trim
+    #: horizon has its stream end, exactly like a real apiserver's
+    #: 410 Gone on an expired resourceVersion: callers re-LIST and
+    #: re-open (WorkerRegistry's loop and wait_for_pod already do).
+    _MAX_EVENTS = 8192
+
     def _emit(self, etype: str, pod: dict) -> None:
         with self._lock:
+            # One deepcopy per event, at emit: the stored payload is
+            # immutable from then on, so watchers can filter (and copy
+            # matches) outside the lock.
             self._events.append((next(self._seq), etype, copy.deepcopy(pod)))
+            if len(self._events) > self._MAX_EVENTS:
+                del self._events[:len(self._events) - self._MAX_EVENTS]
             self._lock.notify_all()
 
     # --- KubeClient surface ---
@@ -197,18 +213,21 @@ class FakeKubeClient(KubeClient):
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:
+        # Filter FIRST, deepcopy only the matches: a selector LIST over
+        # a 1k-pod cluster used to deepcopy every pod (the fake's
+        # dominant cost at fleet scale — the registry, the reconciler
+        # resync and the warm-pool resync all LIST with selectors).
         with self._lock:
-            pods = [copy.deepcopy(p) for p in self._pods.values()]
-        out = []
-        for pod in pods:
-            p = Pod(pod)
-            if namespace and p.namespace != namespace:
-                continue
-            if not match_label_selector(p.labels, label_selector):
-                continue
-            if not _match_field_selector(pod, field_selector):
-                continue
-            out.append(pod)
+            out = []
+            for (ns, _name), pod in self._pods.items():
+                if namespace and ns != namespace:
+                    continue
+                p = Pod(pod)
+                if not match_label_selector(p.labels, label_selector):
+                    continue
+                if not _match_field_selector(pod, field_selector):
+                    continue
+                out.append(copy.deepcopy(pod))
         return out
 
     def watch_pods(self, namespace: str, *, label_selector: str = "",
@@ -223,17 +242,39 @@ class FakeKubeClient(KubeClient):
         return self._watch_iter(namespace, label_selector, field_selector,
                                 deadline, cursor)
 
+    def _pending_locked(self, cursor: int) -> list | None:
+        """Events after `cursor` (a slice copy — safe to read unlocked:
+        payloads are immutable after emit). None = the backlog was
+        trimmed past this watcher (the fake's 410 Gone: the stream must
+        end so the caller re-LISTs and re-opens). Caller holds _lock."""
+        if not self._events:
+            return []
+        first = self._events[0][0]
+        if cursor < first - 1:
+            return None
+        start = cursor - (first - 1)  # seqs are consecutive: O(1) resume
+        return self._events[start:]
+
     def _watch_iter(self, namespace, label_selector, field_selector,
                     deadline, cursor) -> Iterator[tuple[str, dict]]:
         while True:
             with self._lock:
-                pending = [(s, t, p) for (s, t, p) in self._events if s > cursor]
-                if not pending:
+                pending = self._pending_locked(cursor)
+                if pending is not None and not pending:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return
                     self._lock.wait(timeout=min(remaining, 0.25))
-                    pending = [(s, t, p) for (s, t, p) in self._events if s > cursor]
+                    pending = self._pending_locked(cursor)
+            if pending is None:
+                logger.warning("watch backlog trimmed past cursor %d; "
+                               "ending stream (caller must re-list)",
+                               cursor)
+                return
+            # Filter + deepcopy OUTSIDE the lock: event payloads are
+            # immutable after emit, and only matches pay the copy — a
+            # field-selector watch (one pod) over heavy churn was
+            # paying a deepcopy per event per watcher.
             for seq, etype, pod in pending:
                 cursor = max(cursor, seq)
                 p = Pod(pod)
@@ -262,6 +303,58 @@ class FakeKubeClient(KubeClient):
         with self._lock:
             self.events_posted.append((namespace, copy.deepcopy(manifest)))
         return manifest
+
+    # --- leases (coordination.k8s.io/v1 fake; shard leader election) ---
+    #
+    # Same CAS semantics as the API server: every stored lease carries a
+    # monotonically-increasing resourceVersion, and update_lease rejects
+    # a manifest whose resourceVersion is not the current one — the
+    # property the shard manager's single-owner invariant rests on.
+
+    def get_lease(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            if lease is None:
+                raise NotFoundError(f"lease {namespace}/{name} not found")
+            return copy.deepcopy(lease)
+
+    def create_lease(self, namespace: str, manifest: dict) -> dict:
+        inject_write_fault("create_lease", namespace,
+                           manifest.get("metadata", {}).get("name", ""))
+        lease = copy.deepcopy(manifest)
+        meta = lease.setdefault("metadata", {})
+        meta.setdefault("namespace", namespace)
+        name = meta.get("name")
+        if not name:
+            raise ValueError("lease manifest missing metadata.name")
+        with self._lock:
+            if (namespace, name) in self._leases:
+                raise ConflictError(
+                    f"lease {namespace}/{name} already exists")
+            meta["resourceVersion"] = str(next(self._lease_rv))
+            self._leases[(namespace, name)] = lease
+            return copy.deepcopy(lease)
+
+    def update_lease(self, namespace: str, name: str,
+                     manifest: dict) -> dict:
+        inject_write_fault("update_lease", namespace, name)
+        with self._lock:
+            current = self._leases.get((namespace, name))
+            if current is None:
+                raise NotFoundError(f"lease {namespace}/{name} not found")
+            sent_rv = manifest.get("metadata", {}).get("resourceVersion")
+            have_rv = current.get("metadata", {}).get("resourceVersion")
+            if sent_rv != have_rv:
+                raise ConflictError(
+                    f"lease {namespace}/{name}: resourceVersion conflict "
+                    f"(sent {sent_rv}, have {have_rv})")
+            lease = copy.deepcopy(manifest)
+            lease.setdefault("metadata", {})["resourceVersion"] = \
+                str(next(self._lease_rv))
+            lease["metadata"].setdefault("namespace", namespace)
+            lease["metadata"].setdefault("name", name)
+            self._leases[(namespace, name)] = lease
+            return copy.deepcopy(lease)
 
     # --- test helpers ---
 

@@ -21,6 +21,7 @@ Additions over the reference (SURVEY.md §5 gaps): /healthz, /metrics,
 
 from __future__ import annotations
 
+import contextlib
 import re
 import threading
 import time
@@ -39,6 +40,12 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("master")
 
+#: stamped on replica-to-replica proxied requests (bulk sub-batches):
+#: a forwarded request is answered locally — non-owned targets get a
+#: per-target error instead of another hop, so ownership flaps can
+#: never turn into a proxy loop.
+FORWARDED_HEADER = "x-tpumounter-forwarded"
+
 
 class WorkerRegistry:
     """node name → worker pod IP, kept current by a background watch.
@@ -55,9 +62,16 @@ class WorkerRegistry:
     #: floor between on-miss re-LISTs (ADVICE r1: back-to-back LIST storm)
     MISS_RELIST_INTERVAL_S = 1.0
 
-    def __init__(self, kube: KubeClient, cfg=None):
+    def __init__(self, kube: KubeClient, cfg=None, store=None):
         self.kube = kube
         self.cfg = cfg or get_config()
+        # Worker discovery goes through the MasterStore seam: the
+        # registry is pure derived state any replica rebuilds from the
+        # cluster (store/base.py — the stateless-master contract).
+        if store is None:
+            from gpumounter_tpu.store import KubeMasterStore
+            store = KubeMasterStore(kube, self.cfg)
+        self.store = store
         # Per-worker circuit breaker, keyed by worker address: shared by
         # every WorkerClient the master builds, so consecutive transport
         # failures anywhere in the control plane degrade the entry (the
@@ -156,9 +170,7 @@ class WorkerRegistry:
         with self._lock:
             self._journal = []
         try:
-            pods = self.kube.list_pods(
-                self.cfg.worker_namespace,
-                label_selector=self.cfg.worker_label_selector)
+            pods = self.store.list_worker_pods()
             cache: dict[str, tuple[str, str]] = {}
             for pod_json in pods:
                 p = Pod(pod_json)
@@ -182,10 +194,7 @@ class WorkerRegistry:
                 # (Re)prime, then stream deltas. Re-LIST on every watch
                 # re-open keeps the cache honest across missed windows.
                 self._refresh()
-                watch = self.kube.watch_pods(
-                    self.cfg.worker_namespace,
-                    label_selector=self.cfg.worker_label_selector,
-                    timeout_s=60.0)
+                watch = self.store.watch_worker_pods(timeout_s=60.0)
                 for etype, pod_json in watch:
                     if self._stop.is_set():
                         return
@@ -254,6 +263,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
         r"^/remove(?:gpu|tpu)/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
         r"/force/(?P<force>[^/]+)$"),
      "remove"),
+    # Bulk mount: one request -> many pod/chip mounts, grouped by owning
+    # shard (proxied to peers) and node (one pooled channel per node).
+    ("POST", re.compile(r"^/batch/addtpu$"), "batch_add"),
+    # Shard table: which replica owns which shard (master/shard.py).
+    ("GET", re.compile(r"^/shards$"), "shards"),
     ("GET", re.compile(r"^/$"), "index"),
     ("GET", re.compile(r"^/healthz$"), "healthz"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
@@ -315,18 +329,21 @@ class MasterApp:
     #: stays open (probe/scrape back-compat) while /audit, /trace,
     #: /fleet and /slo — which reveal pod/tenant names and chip
     #: movements — require the mutate token.
-    READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo"})
+    READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
+                             "shards"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
     #: trace id).
     AUDITED_ROUTES = frozenset({
-        "add", "remove", "addslice", "removeslice", "intent_put",
-        "intent_delete", "migrate_start", "migration_abort"})
+        "add", "remove", "batch_add", "addslice", "removeslice",
+        "intent_put", "intent_delete", "migrate_start",
+        "migration_abort"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
-                 registry: WorkerRegistry | None = None):
+                 registry: WorkerRegistry | None = None,
+                 store=None, shards=None):
         from gpumounter_tpu.utils.auth import (
             required_token,
             resolve_read_token,
@@ -339,7 +356,42 @@ class MasterApp:
         self._token = required_token(self.cfg, "master HTTP gateway")
         self._read_token = resolve_read_token(self.cfg)
         self.kube = kube
-        self.registry = registry or WorkerRegistry(kube, self.cfg)
+        # All durable master state flows through one MasterStore
+        # (store/base.py): registry, intents, and journals are derived
+        # views any replica — this one restarted, or a peer taking over
+        # a shard — rebuilds identically from the cluster.
+        if store is None:
+            from gpumounter_tpu.store import KubeMasterStore
+            store = KubeMasterStore(kube, self.cfg)
+        self.store = store
+        # Shard ownership (master/shard.py): inactive by default (one
+        # master owns everything, zero overhead); master/main.py starts
+        # the lease loop when TPUMOUNTER_SHARD_COUNT > 1. Requests for
+        # nodes another replica owns 307-redirect (single-target) or
+        # proxy (bulk) to the owner's advertised URL.
+        if shards is None:
+            from gpumounter_tpu.master.shard import ShardManager
+            shards = ShardManager(kube, cfg=self.cfg)
+        self.shards = shards
+        # Admission control: bound the client requests one replica
+        # processes concurrently (0 = unbounded, the legacy shape).
+        # Replica-to-replica forwarded work runs under its own separate
+        # bound — never the client gate — so two replicas proxying to
+        # each other cannot deadlock on their own admission slots. Its
+        # size is the legitimate maximum: every OTHER replica's entire
+        # admitted load could forward here at once (depth x (N-1)), so
+        # the gate only trips on runaway peers, never on traffic the
+        # entry gates already admitted — a smaller gate would throttle
+        # proxied sub-batches below the fleet's own admission capacity
+        # and invert the scale-out.
+        depth = int(self.cfg.master_http_concurrency)
+        self._client_gate = (threading.BoundedSemaphore(depth)
+                             if depth > 0 else None)
+        forward_depth = depth * max(1, int(self.cfg.shard_count) - 1)
+        self._forward_gate = (threading.BoundedSemaphore(forward_depth)
+                              if depth > 0 else None)
+        self.registry = registry or WorkerRegistry(kube, self.cfg,
+                                                   store=self.store)
         # The default worker client forwards the same per-deploy secret
         # the worker's gRPC interceptor checks, reports transport
         # outcomes to the registry's shared per-worker circuit breaker,
@@ -355,14 +407,18 @@ class MasterApp:
         # an explicit elastic.start() (master/main.py — tests drive
         # reconcile_once directly or start it themselves).
         from gpumounter_tpu.elastic import ElasticReconciler
+        from gpumounter_tpu.elastic.intents import IntentStore
         self.elastic = ElasticReconciler(
-            kube, self.registry, self._client_factory, cfg=self.cfg)
+            kube, self.registry, self._client_factory, cfg=self.cfg,
+            store=IntentStore(kube, self.cfg, backend=self.store),
+            shards=self.shards)
         # Live-migration orchestrator: shares the registry and worker
         # client factory; interrupted migrations are re-adopted by an
         # explicit migrations.resume_interrupted() (master/main.py).
         from gpumounter_tpu.migrate import MigrationCoordinator
         self.migrations = MigrationCoordinator(
-            kube, self.registry, self._client_factory, cfg=self.cfg)
+            kube, self.registry, self._client_factory, cfg=self.cfg,
+            store=self.store, shards=self.shards)
         # Fleet telemetry plane: the collector federates every worker's
         # telemetry over the same pooled channels and feeds the SLO
         # burn-rate engine; breaches land as k8s Events + audit records.
@@ -374,7 +430,8 @@ class MasterApp:
         from gpumounter_tpu.obs.slo import SloEngine
         self.slo = SloEngine(cfg=self.cfg, kube=kube)
         self.fleet = FleetCollector(self.registry, self._client_factory,
-                                    cfg=self.cfg, slo=self.slo)
+                                    cfg=self.cfg, slo=self.slo,
+                                    shards=self.shards)
 
     # --- plumbing ---
 
@@ -403,7 +460,35 @@ class MasterApp:
     #: query (RUNBOOK "Debugging a slow mount"). /fleet and /slo are
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
-                                 "slo"})
+                                 "slo", "shards"})
+
+    #: routes that bypass the admission gate: liveness/scrape surfaces
+    #: must answer even when the replica is saturated by a mount storm
+    #: (a gated /healthz would fail probes exactly when the master is
+    #: busiest, turning load into restarts).
+    UNGATED_ROUTES = frozenset({"index", "healthz", "metrics"})
+
+    @contextlib.contextmanager
+    def _admission(self, name: str, headers: dict[str, str]):
+        """Bounded concurrent request processing (master_http_concurrency;
+        0 = unbounded). Replica-forwarded work (bulk sub-batches) holds a
+        slot of its own gate, never the client gate: forwarded requests
+        do only local work, so the two-gate split bounds them without a
+        proxy cycle ever waiting on itself."""
+        if name in self.UNGATED_ROUTES:
+            yield
+            return
+        forwarded = any(k.lower() == FORWARDED_HEADER
+                        for k in headers)
+        gate = self._forward_gate if forwarded else self._client_gate
+        if gate is None:
+            yield
+            return
+        gate.acquire()
+        try:
+            yield
+        finally:
+            gate.release()
 
     def _dispatch(self, name: str, match, method: str, path: str,
                   body: bytes, headers: dict[str, str]
@@ -417,6 +502,13 @@ class MasterApp:
         not be able to churn the span ring or — via the inbound trace
         header — inject spans into a victim's trace id."""
         self._check_auth(name, headers)
+        with self._admission(name, headers):
+            return self._dispatch_admitted(name, match, method, path,
+                                           body, headers)
+
+    def _dispatch_admitted(self, name: str, match, method: str, path: str,
+                           body: bytes, headers: dict[str, str]
+                           ) -> tuple[int, str, str, dict[str, str]]:
         if name in self.UNTRACED_ROUTES:
             status, ctype, text = getattr(
                 self, f"_route_{name}")(match, body, headers)
@@ -495,8 +587,28 @@ class MasterApp:
             logger.warning("unauthenticated %s request rejected", route_name)
             raise _HttpError(401, "missing or invalid bearer token")
 
-    def _worker_for_pod(self, namespace: str, pod_name: str) -> tuple[str, str]:
-        """(worker_address, node_name); raises _HttpError on miss."""
+    def _shard_gate(self, node: str, path: str) -> None:
+        """Sharded masters: a request for a node another replica owns is
+        307-redirected to the owner's advertised URL (clients follow —
+        rpc/http_failover.py); an ownerless shard (lease expired, the
+        renew loops racing to claim it) answers 503 + Retry-After."""
+        kind, url = self.shards.route(node)
+        if kind == "local":
+            return
+        if kind == "remote" and url:
+            raise _HttpError(
+                307, f"node {node} is owned by master replica at {url}",
+                headers={"Location": url.rstrip("/") + path})
+        raise _HttpError(
+            503, f"shard for node {node} has no live owner yet; retry",
+            headers={"Retry-After": "1"})
+
+    def _worker_for_pod(self, namespace: str, pod_name: str,
+                        redirect_path: str | None = None
+                        ) -> tuple[str, str]:
+        """(worker_address, node_name); raises _HttpError on miss. With
+        redirect_path set, non-owned nodes 307 to their shard owner
+        before any worker lookup happens here."""
         try:
             pod = Pod(self.kube.get_pod(namespace, pod_name))
         except NotFoundError:
@@ -505,6 +617,8 @@ class MasterApp:
         node = pod.node_name
         if not node:
             raise _HttpError(400, f"Pod {pod_name} is not scheduled yet")
+        if redirect_path is not None:
+            self._shard_gate(node, redirect_path)
         address = self.registry.worker_address(node)
         if address is None:
             logger.error("no tpumounter worker on node %s", node)
@@ -667,6 +781,182 @@ class MasterApp:
                  sorted(self.registry.registry_snapshot().items())]
         return 200, "text/plain", "\n".join(lines) + "\n"
 
+    def _route_shards(self, match, body, headers):
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.shards.table(), indent=1) + "\n"
+
+    # --- bulk mount (POST /batch/addtpu) ---
+
+    def _parse_bulk_body(self, body: bytes):
+        import json as jsonlib
+
+        from gpumounter_tpu.master.slice_ops import BulkTarget
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        if not isinstance(payload, dict):
+            raise _HttpError(400, 'body must be a JSON object with a '
+                                  '"targets" list')
+        raw = payload.get("targets")
+        if not isinstance(raw, list) or not raw:
+            raise _HttpError(400, 'JSON body needs "targets": '
+                                  '[{"namespace", "pod", "chips", '
+                                  '"isEntireMount"}, ...]')
+        if len(raw) > self.cfg.bulk_max_targets:
+            raise _HttpError(
+                400, f"too many targets: {len(raw)} > "
+                     f"{self.cfg.bulk_max_targets} (BULK_MAX_TARGETS)")
+        targets = []
+        for entry in raw:
+            if not isinstance(entry, dict) or not entry.get("pod"):
+                raise _HttpError(400, f"targets entries must be objects "
+                                      f"with a 'pod': {entry!r}")
+            try:
+                chips = int(entry.get("chips", 1))
+            except (TypeError, ValueError):
+                raise _HttpError(400, f"invalid chips for "
+                                      f"{entry.get('pod')}: "
+                                      f"{entry.get('chips')!r}")
+            if not 0 < chips <= self.cfg.max_tpu_per_request:
+                raise _HttpError(
+                    400, f"invalid chips {chips} for {entry['pod']} "
+                         f"(must be 1..{self.cfg.max_tpu_per_request})")
+            targets.append(BulkTarget(
+                namespace=entry.get("namespace", "default"),
+                pod=entry["pod"], chips=chips,
+                entire=bool(entry.get("isEntireMount", False))))
+        return targets
+
+    def _route_batch_add(self, match, body, headers):
+        """One request -> many pod/chip mounts. Targets are grouped by
+        owning shard: local shards mount here (grouped by node over the
+        pooled channels — slice_ops.BulkMountCoordinator), peer-owned
+        shards have their sub-batch proxied to the owner, and every
+        target gets an individual result — a bad pod or a dead shard
+        never fails the rest of the batch."""
+        import json as jsonlib
+
+        from gpumounter_tpu.master.slice_ops import BulkMountCoordinator
+        targets = self._parse_bulk_body(body)
+        forwarded = any(k.lower() == FORWARDED_HEADER for k in headers)
+        coordinator = BulkMountCoordinator(
+            self.kube, self.registry, self._client_factory, self.cfg)
+        results: list[dict | None] = [None] * len(targets)
+        resolve_errors, by_node = coordinator._resolve_bulk(targets)
+        for i, err in resolve_errors.items():
+            results[i] = {"namespace": targets[i].namespace,
+                          "pod": targets[i].pod, **err}
+        local_by_node: dict[str, list[int]] = {}
+        remote: dict[str, list[int]] = {}
+        for node, indices in by_node.items():
+            kind, url = self.shards.route(node)
+            if kind == "local":
+                local_by_node[node] = indices
+            elif forwarded:
+                # Never a second hop: the proxying replica believed we
+                # owned this node; if ownership moved meanwhile the
+                # client retries against fresh routing.
+                for i in indices:
+                    results[i] = {
+                        "namespace": targets[i].namespace,
+                        "pod": targets[i].pod, "node": node,
+                        "result": "NotOwner",
+                        "error": f"replica does not own node {node}"}
+            elif kind == "remote" and url:
+                remote.setdefault(url, []).extend(indices)
+            else:
+                for i in indices:
+                    results[i] = {
+                        "namespace": targets[i].namespace,
+                        "pod": targets[i].pod, "node": node,
+                        "result": "Unowned", "retryAfterS": 1,
+                        "error": f"shard for node {node} has no live "
+                                 f"owner yet"}
+
+        threads = []
+        if remote:
+            def _forward(url: str, indices: list[int]) -> None:
+                entries = self._proxy_batch(url,
+                                            [targets[i] for i in indices])
+                for i, entry in zip(indices, entries):
+                    results[i] = entry
+
+            threads = [threading.Thread(target=_forward, args=(url, idx),
+                                        daemon=True)
+                       for url, idx in remote.items()]
+            for th in threads:
+                th.start()
+        if local_by_node:
+            # One resolve total: the grouping computed above IS the
+            # mount plan (re-resolving would double the pod reads and
+            # let a rescheduled pod dodge the shard routing decision).
+            local_results = coordinator.mount_bulk(
+                targets, resolution=({}, local_by_node))
+            for indices in local_by_node.values():
+                for i in indices:
+                    results[i] = local_results[i]
+        for th in threads:
+            th.join()
+
+        out = [r if r is not None else
+               {"namespace": targets[i].namespace, "pod": targets[i].pod,
+                "result": "Error", "error": "internal: unprocessed"}
+               for i, r in enumerate(results)]
+        by_result: dict[str, int] = {}
+        for entry in out:
+            by_result[entry.get("result", "Error")] = \
+                by_result.get(entry.get("result", "Error"), 0) + 1
+        payload = {
+            "results": out,
+            "summary": {"total": len(out),
+                        "success": by_result.get("Success", 0),
+                        "byResult": by_result},
+        }
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
+
+    def _proxy_batch(self, url: str, sub_targets) -> list[dict]:
+        """POST a sub-batch to the owning replica; per-target entries
+        come back in order. A transport failure becomes per-target
+        ProxyError entries — never an exception out of the route."""
+        import json as jsonlib
+        import urllib.error
+        import urllib.request
+        payload = {"targets": [
+            {"namespace": t.namespace, "pod": t.pod, "chips": t.chips,
+             "isEntireMount": t.entire} for t in sub_targets]}
+        request_headers = {
+            "Content-Type": "application/json",
+            FORWARDED_HEADER: "1",
+            # The peer's worker-side spans should join THIS request's
+            # trace, exactly like a locally-mounted target's do.
+            trace.TRACE_HEADER: trace.wire_context(),
+        }
+        if self._token:
+            request_headers["Authorization"] = f"Bearer {self._token}"
+        req = urllib.request.Request(
+            url.rstrip("/") + "/batch/addtpu",
+            data=jsonlib.dumps(payload).encode(), method="POST",
+            headers=request_headers)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.cfg.bulk_proxy_timeout_s) as resp:
+                answered = jsonlib.loads(resp.read().decode())
+            entries = answered.get("results", [])
+            if len(entries) != len(sub_targets):
+                raise ValueError(
+                    f"peer answered {len(entries)} results for "
+                    f"{len(sub_targets)} targets")
+            return entries
+        except Exception as exc:  # noqa: BLE001 — peer/transport boundary
+            logger.error("bulk proxy to %s failed: %s", url, exc)
+            return [{"namespace": t.namespace, "pod": t.pod,
+                     "result": "ProxyError",
+                     "error": f"owner replica {url} unreachable: {exc}"}
+                    for t in sub_targets]
+
     # --- elastic intents ---
 
     def _intent_status(self, ns: str, pod: str, intent) -> dict:
@@ -800,7 +1090,8 @@ class MasterApp:
         entire = _parse_bool(match.group("entire"), "isEntireMount")
         logger.info("AddTPU request: %s/%s num=%d entire=%s",
                     ns, pod_name, tpu_num, entire)
-        address, node = self._worker_for_pod(ns, pod_name)
+        address, node = self._worker_for_pod(ns, pod_name,
+                                             redirect_path=match.string)
         with self._client_factory(address) as client:
             try:
                 result = client.add_tpu(pod_name, ns, tpu_num, entire)
@@ -828,7 +1119,8 @@ class MasterApp:
             uuids.extend(u for u in entry.split(",") if u)
         logger.info("RemoveTPU request: %s/%s uuids=%s force=%s",
                     ns, pod_name, uuids, force)
-        address, node = self._worker_for_pod(ns, pod_name)
+        address, node = self._worker_for_pod(ns, pod_name,
+                                             redirect_path=match.string)
         with self._client_factory(address) as client:
             try:
                 result = client.remove_tpu(pod_name, ns, uuids, force)

@@ -24,6 +24,25 @@ def main() -> None:
     kube = default_client()
     app = MasterApp(kube, cfg=cfg)
     httpd = build_http_server(app)
+    # Sharded masters (TPUMOUNTER_SHARD_COUNT > 1): start the lease
+    # acquire/renew loop. A takeover — initial claims and adopting a
+    # crashed peer's shards alike — re-drives that shard's interrupted
+    # migrations from the journals; intents follow at the next elastic
+    # resync tick (the reconciler's not-owned gate flips).
+    if cfg.shard_count > 1:
+        def _on_takeover(shards: set) -> None:
+            adopted_now = app.migrations.resume_interrupted()
+            if adopted_now:
+                logger.warning(
+                    "shard takeover %s: re-driving %d interrupted "
+                    "migration(s): %s", sorted(shards), len(adopted_now),
+                    ", ".join(adopted_now))
+
+        app.shards.on_takeover = _on_takeover
+        app.shards.start()
+        logger.info("shard manager on: %d shards, replica %s, lease "
+                    "%.0fs", app.shards.shard_count,
+                    app.shards.replica_id, app.shards.duration_s)
     # The elastic loop re-reads intents from pod annotations on start, so
     # declared desires survive master restarts with no extra store.
     app.elastic.start()
@@ -50,6 +69,10 @@ def main() -> None:
     finally:
         app.fleet.stop()
         app.elastic.stop()
+        if cfg.shard_count > 1:
+            # Graceful handoff: release held leases so peers take the
+            # shards immediately instead of waiting out the TTL.
+            app.shards.stop(release=True)
         httpd.shutdown()
 
 

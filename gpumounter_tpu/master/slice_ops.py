@@ -158,6 +158,135 @@ def topology_plan(targets: list[SliceTarget], nodes: list[str],
     return plan
 
 
+@dataclass(frozen=True)
+class BulkTarget:
+    """One entry of a POST /batch/addtpu request."""
+    namespace: str
+    pod: str
+    chips: int = 1
+    entire: bool = False
+
+
+class BulkMountCoordinator:
+    """One request -> many pod/chip mounts (the mount-storm API).
+
+    Differences from the slice coordinator: targets are independent —
+    per-target success/failure, no all-or-nothing rollback, no topology
+    plan — and the fan-out is grouped by NODE so each node's mounts ride
+    one pooled worker channel (rpc/client.py ChannelPool) and its warm
+    pool (allocator/pool.py) serves consecutive adoptions instead of
+    interleaving with other nodes' traffic. Node groups mount
+    concurrently, bounded by cfg.bulk_node_fanout.
+    """
+
+    def __init__(self, kube, registry, client_factory, cfg):
+        self.kube = kube
+        self.registry = registry
+        self.client_factory = client_factory
+        self.cfg = cfg
+
+    def _resolve_bulk(self, targets: list[BulkTarget]
+                      ) -> tuple[dict[int, dict], dict[str, list[int]]]:
+        """(per-index error entries, node -> target indices). Resolution
+        failures are per-target results, never a whole-request error —
+        one deleted pod must not fail the other 99 mounts."""
+        errors: dict[int, dict] = {}
+        by_node: dict[str, list[int]] = {}
+        for i, t in enumerate(targets):
+            try:
+                pod = Pod(self.kube.get_pod(t.namespace, t.pod))
+            except NotFoundError:
+                errors[i] = {"result": "PodNotFound",
+                             "error": f"no pod {t.namespace}/{t.pod}"}
+                continue
+            except Exception as exc:  # noqa: BLE001 — API blip
+                errors[i] = {"result": "Error", "error": str(exc)}
+                continue
+            if not pod.node_name:
+                errors[i] = {"result": "NotScheduled",
+                             "error": f"pod {t.pod} is not scheduled yet"}
+                continue
+            by_node.setdefault(pod.node_name, []).append(i)
+        return errors, by_node
+
+    def mount_bulk(self, targets: list[BulkTarget],
+                   resolution: tuple[dict[int, dict],
+                                     dict[str, list[int]]] | None = None,
+                   ) -> list[dict]:
+        """Per-target results, in request order. Each entry carries
+        namespace/pod/node plus either result=Success with the mounted
+        uuids or a result/error pair.
+
+        resolution: a (errors, by_node) pair from _resolve_bulk, when
+        the caller already resolved (the batch route resolves once for
+        shard partitioning — re-resolving here would double the API
+        reads AND let a pod rescheduled in between dodge the shard
+        routing decision made on the first resolve)."""
+        results: list[dict | None] = [None] * len(targets)
+        errors, by_node = (resolution if resolution is not None
+                           else self._resolve_bulk(targets))
+        for i, err in errors.items():
+            results[i] = {"namespace": targets[i].namespace,
+                          "pod": targets[i].pod, **err}
+        trace_ctx = trace.current()
+
+        def _mount_node(node: str, indices: list[int]) -> None:
+            address = self.registry.worker_address(node)
+            if address is None:
+                for i in indices:
+                    results[i] = {
+                        "namespace": targets[i].namespace,
+                        "pod": targets[i].pod, "node": node,
+                        "result": "NoWorker",
+                        "error": f"no tpumounter worker on node {node}"}
+                return
+            retry_after = self.registry.breaker.retry_after(address)
+            if retry_after is not None:
+                for i in indices:
+                    results[i] = {
+                        "namespace": targets[i].namespace,
+                        "pod": targets[i].pod, "node": node,
+                        "result": "Degraded", "retryAfterS": retry_after,
+                        "error": f"worker on {node} degraded "
+                                 f"(circuit open)"}
+                return
+            with trace.attached(trace_ctx), \
+                    trace.span("bulk.mount_node", node=node,
+                               targets=len(indices)), \
+                    self.client_factory(address) as client:
+                for i in indices:
+                    t = targets[i]
+                    entry = {"namespace": t.namespace, "pod": t.pod,
+                             "node": node}
+                    try:
+                        result, uuids = client.add_tpu_detailed(
+                            t.pod, t.namespace, t.chips, t.entire)
+                        entry["result"] = result.name
+                        if result == api.AddTPUResult.Success:
+                            entry["uuids"] = uuids
+                    except Exception as exc:  # noqa: BLE001 — boundary
+                        entry["result"] = "Error"
+                        entry["error"] = str(exc)
+                    results[i] = entry
+
+        nodes = list(by_node.items())
+        width = max(1, int(self.cfg.bulk_node_fanout))
+        # Node groups are independent; a bounded wave pattern keeps a
+        # thousand-node request from spawning a thousand threads.
+        for start in range(0, len(nodes), width):
+            wave = nodes[start:start + width]
+            threads = [threading.Thread(target=_mount_node, args=(n, idx),
+                                        daemon=True) for n, idx in wave]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        return [r if r is not None else
+                {"namespace": targets[i].namespace, "pod": targets[i].pod,
+                 "result": "Error", "error": "internal: unprocessed"}
+                for i, r in enumerate(results)]
+
+
 class SliceCoordinator:
     def __init__(self, kube, registry, client_factory, cfg):
         self.kube = kube

@@ -43,11 +43,7 @@ import time
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.faults.failpoints import CrashError
-from gpumounter_tpu.k8s.client import (
-    KubeClient,
-    NotFoundError,
-    patch_pod_with_retry,
-)
+from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
 from gpumounter_tpu.k8s.events import post_pod_event
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.migrate.journal import (
@@ -55,10 +51,8 @@ from gpumounter_tpu.migrate.journal import (
     ANNOT_LOCK,
     ANNOT_PHASE,
     PHASE_DONE,
-    dump,
     migration_active,
     new_journal,
-    parse_journal,
 )
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
@@ -107,11 +101,22 @@ class MigrationCoordinator:
     ABORTABLE_PHASES = ("quiesce", "drain", "remount")
 
     def __init__(self, kube: KubeClient, registry, client_factory,
-                 cfg=None):
+                 cfg=None, store=None, shards=None):
         self.cfg = cfg or get_config()
         self.kube = kube
         self.registry = registry
         self.client_factory = client_factory
+        # Durable state (journals, phase/lock stamps) goes through the
+        # MasterStore seam: any replica rebuilds the same view, and a
+        # shard takeover re-drives interrupted journals from it.
+        if store is None:
+            from gpumounter_tpu.store import KubeMasterStore
+            store = KubeMasterStore(kube, self.cfg)
+        self.store = store
+        #: optional ShardManager (master/shard.py): when set and active,
+        #: resume_interrupted adopts only journals whose source pod lives
+        #: on a node this replica owns — the owner re-drives the rest.
+        self.shards = shards
         self._lock = threading.Lock()
         # Serializes begin(): the already-migrating check and the journal
         # persist must be atomic, or two concurrent /migrate requests for
@@ -242,6 +247,8 @@ class MigrationCoordinator:
         for journal in self._scan():
             if journal.get("outcome") is not None:
                 continue
+            if not self._owns_journal(journal):
+                continue
             with self._lock:
                 if journal["id"] in self._threads:
                     continue
@@ -250,6 +257,22 @@ class MigrationCoordinator:
             self._spawn(journal)
             adopted.append(journal["id"])
         return adopted
+
+    def _owns_journal(self, journal: dict) -> bool:
+        """Sharded masters adopt only journals whose source pod sits on
+        a node this replica owns — double-adoption would double-drive
+        the machine. Unsharded (or inactive shard manager): adopt all.
+        An unresolvable source pod is skipped this pass (the owner — or
+        the next resume scan — picks it up) rather than risking two
+        drivers."""
+        if self.shards is None or not self.shards.active():
+            return True
+        src = journal["source"]
+        try:
+            pod = Pod(self.kube.get_pod(src["namespace"], src["pod"]))
+        except Exception:  # noqa: BLE001 — can't prove ownership: skip
+            return False
+        return bool(pod.node_name) and self.shards.owns_node(pod.node_name)
 
     def stop(self) -> None:
         with self._lock:
@@ -654,17 +677,7 @@ class MigrationCoordinator:
     # --- plumbing ---
 
     def _scan(self) -> list[dict]:
-        out = []
-        try:
-            pods = self.kube.list_pods()
-        except Exception as exc:  # noqa: BLE001 — LIST is best-effort here
-            logger.warning("migration journal scan failed: %s", exc)
-            return out
-        for pod_json in pods:
-            journal = parse_journal(Pod(pod_json).annotations)
-            if journal is not None:
-                out.append(journal)
-        return out
+        return self.store.scan_journals()
 
     def _persist(self, journal: dict) -> None:
         src = journal["source"]
@@ -676,12 +689,7 @@ class MigrationCoordinator:
         try:
             with trace.span("migrate.journal_persist", id=journal["id"],
                             phase=journal["phase"]):
-                patch_pod_with_retry(
-                    self.kube, src["namespace"], src["pod"],
-                    {"metadata": {"annotations": {ANNOT_JOURNAL:
-                                                  dump(journal)}}},
-                    attempts=self.cfg.k8s_write_attempts,
-                    base_s=self.cfg.k8s_write_retry_base_s)
+                self.store.save_journal(journal)
         except NotFoundError:
             raise MigrationError(
                 f"source pod {src['namespace']}/{src['pod']} disappeared "
@@ -694,12 +702,8 @@ class MigrationCoordinator:
         payload = {**payload,
                    "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
         try:
-            patch_pod_with_retry(
-                self.kube, ref["namespace"], ref["pod"],
-                {"metadata": {"annotations": {
-                    annotation: jsonlib.dumps(payload)}}},
-                attempts=self.cfg.k8s_write_attempts,
-                base_s=self.cfg.k8s_write_retry_base_s)
+            self.store.stamp_annotation(ref["namespace"], ref["pod"],
+                                        annotation, jsonlib.dumps(payload))
         except NotFoundError:
             logger.warning("cannot stamp %s on %s/%s: pod gone",
                            annotation, ref["namespace"], ref["pod"])
@@ -707,15 +711,13 @@ class MigrationCoordinator:
     def _clear_lock(self, journal: dict) -> None:
         dst = journal["destination"]
         # Outer loop covers transport-level failures (connection errors
-        # raised before any HTTP status exists) that patch_pod_with_retry
-        # — which only retries ApiError 409/5xx — re-raises immediately.
+        # raised before any HTTP status exists) that the store's bounded
+        # retry — which only retries ApiError 409/5xx — re-raises
+        # immediately.
         for attempt in range(3):
             try:
-                patch_pod_with_retry(
-                    self.kube, dst["namespace"], dst["pod"],
-                    {"metadata": {"annotations": {ANNOT_LOCK: None}}},
-                    attempts=max(3, self.cfg.k8s_write_attempts),
-                    base_s=max(0.2, self.cfg.k8s_write_retry_base_s))
+                self.store.stamp_annotation(dst["namespace"], dst["pod"],
+                                            ANNOT_LOCK, None)
                 return
             except NotFoundError:
                 return  # destination pod gone: nothing left to unlock

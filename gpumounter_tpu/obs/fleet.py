@@ -287,7 +287,8 @@ class FleetCollector:
     blip must not blank a node out of the fleet view.
     """
 
-    def __init__(self, workers, client_factory, cfg=None, slo=None):
+    def __init__(self, workers, client_factory, cfg=None, slo=None,
+                 shards=None):
         if cfg is None:
             from gpumounter_tpu.config import get_config
             cfg = get_config()
@@ -295,6 +296,11 @@ class FleetCollector:
         self.workers = workers
         self.client_factory = client_factory
         self.slo = slo
+        #: optional ShardManager (master/shard.py): an active sharded
+        #: replica collects only the nodes it owns — N replicas split
+        #: the scrape fan-out instead of each polling the whole fleet —
+        #: and the payload says which slice this rollup covers.
+        self.shards = shards
         self.interval_s = cfg.fleet_scrape_interval_s
         #: per-node collection fan-out width: a few wedged workers each
         #: burn their full RPC deadline, so a serial pass would stall
@@ -405,6 +411,9 @@ class FleetCollector:
         with self._collect_mu:
             t0 = time.monotonic()
             items = sorted(self.workers.registry_snapshot().items())
+            if self.shards is not None and self.shards.active():
+                items = [(node, ip) for node, ip in items
+                         if self.shards.owns_node(node)]
             fresh: dict[str, dict] = {}
             if items:
                 width = max(1, min(self.collect_width, len(items)))
@@ -500,13 +509,20 @@ class FleetCollector:
         master = {key: (REGISTRY.find(name).total()
                         if isinstance(REGISTRY.find(name), Counter) else 0.0)
                   for name, key in _MASTER_COUNTER_NAMES}
-        return {
+        payload = {
             "at": round(at, 3),
             "interval_s": self.interval_s,
             "nodes": nodes,
             "fleet": fleet,
             "master": master,
         }
+        if self.shards is not None and self.shards.active():
+            payload["shard"] = {
+                "replica": self.shards.replica_id,
+                "shardCount": self.shards.shard_count,
+                "ownedShards": sorted(self.shards.owned_shards()),
+            }
+        return payload
 
     # --- the poll loop (master/main.py) ---
 

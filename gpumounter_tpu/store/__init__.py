@@ -1,0 +1,12 @@
+"""Master state store: the seam that makes master replicas stateless.
+
+`MasterStore` (store/base.py) is the full durable-state surface of a
+master — worker registry, elastic intents, migration journals — and
+`KubeMasterStore` (store/k8s.py) is the default annotation-persisted
+backend. See store/base.py for the design stance.
+"""
+
+from gpumounter_tpu.store.base import MasterStore
+from gpumounter_tpu.store.k8s import KubeMasterStore
+
+__all__ = ["MasterStore", "KubeMasterStore"]

@@ -1,0 +1,90 @@
+"""MasterStore: the persistence boundary that makes masters stateless.
+
+Everything a master replica knows — which worker serves which node,
+which pods declared elastic intents, which migrations are in flight —
+must be rebuildable from this interface alone, so that
+
+  * any replica (or a restarted one) converges to the same view by
+    reading the cluster, with no replica-local database to lose,
+  * shard takeover (master/shard.py) can re-drive another replica's
+    interrupted work straight from the journals,
+  * tests can prove restart-resume parity: state written through one
+    store instance is read back identically by a fresh instance
+    (tests/test_store.py).
+
+The default backend (store/k8s.py KubeMasterStore) is the
+annotation-persisted state the subsystems already used — the pod object
+IS the record (elastic/intents.py, migrate/journal.py) — now gathered
+behind one seam instead of each subsystem talking to the API server in
+its own dialect. Alternative backends (a CRD, etcd, a SQL cache) slot
+in here without touching the reconciler/orchestrator/registry.
+"""
+
+from __future__ import annotations
+
+import abc
+from collections.abc import Iterator
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # intent type only; no import cycle at runtime
+    from gpumounter_tpu.elastic.intents import Intent
+
+
+class MasterStore(abc.ABC):
+    """The master's full durable-state surface.
+
+    Error contract (matches the k8s client the default backend wraps):
+    methods that name a pod raise k8s.client.NotFoundError when it does
+    not exist; list/scan methods swallow transport failures and return
+    what they can (callers resync on the next pass).
+    """
+
+    # --- worker registry (node -> worker pod) ---
+
+    @abc.abstractmethod
+    def list_worker_pods(self) -> list[dict]:
+        """Every worker pod (raw API JSON) — the registry's priming LIST."""
+
+    @abc.abstractmethod
+    def watch_worker_pods(self, timeout_s: float = 60.0,
+                          ) -> Iterator[tuple[str, dict]]:
+        """ADDED/MODIFIED/DELETED deltas for worker pods."""
+
+    # --- elastic intents ---
+
+    @abc.abstractmethod
+    def put_intent(self, namespace: str, pod_name: str,
+                   intent: "Intent") -> None: ...
+
+    @abc.abstractmethod
+    def get_intent(self, namespace: str, pod_name: str) -> "Intent | None": ...
+
+    @abc.abstractmethod
+    def delete_intent(self, namespace: str, pod_name: str) -> bool:
+        """Remove the intent and the heal marker; returns whether an
+        intent was present."""
+
+    @abc.abstractmethod
+    def list_intents(self) -> list[tuple[str, str, "Intent"]]:
+        """Every (namespace, pod, intent) in the cluster."""
+
+    # --- migration journals ---
+
+    @abc.abstractmethod
+    def scan_journals(self) -> list[dict]:
+        """Every migration journal found in the cluster (terminal ones
+        included). Best-effort: a failed LIST returns []."""
+
+    @abc.abstractmethod
+    def save_journal(self, journal: dict) -> None:
+        """Persist the journal on its source pod. Raises NotFoundError
+        when the source pod is gone (the journal has nothing to live
+        on)."""
+
+    # --- raw annotation stamps (phase/ack/lock markers) ---
+
+    @abc.abstractmethod
+    def stamp_annotation(self, namespace: str, pod_name: str,
+                         annotation: str, payload: str | None) -> None:
+        """Write (payload) or clear (None) one annotation with bounded
+        retries. Raises NotFoundError when the pod is gone."""

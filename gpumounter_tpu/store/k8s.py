@@ -1,0 +1,117 @@
+"""KubeMasterStore: the annotation/CRD-persisted default backend.
+
+This is the state model the subsystems always had — intents as
+`tpumounter.io/desired-chips` annotations (elastic/intents.py), journals
+as one `tpumounter.io/migration` annotation on the source pod
+(migrate/journal.py), the worker registry as labeled pods — moved behind
+the MasterStore seam so every replica rebuilds its view from the cluster
+and masters hold no private state worth losing.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterator
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import KubeClient, patch_pod_with_retry
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.store.base import MasterStore
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("store.k8s")
+
+
+class KubeMasterStore(MasterStore):
+    def __init__(self, kube: KubeClient, cfg=None):
+        self.kube = kube
+        self.cfg = cfg or get_config()
+
+    # --- worker registry ---
+
+    def list_worker_pods(self) -> list[dict]:
+        return self.kube.list_pods(
+            self.cfg.worker_namespace,
+            label_selector=self.cfg.worker_label_selector)
+
+    def watch_worker_pods(self, timeout_s: float = 60.0,
+                          ) -> Iterator[tuple[str, dict]]:
+        return self.kube.watch_pods(
+            self.cfg.worker_namespace,
+            label_selector=self.cfg.worker_label_selector,
+            timeout_s=timeout_s)
+
+    # --- elastic intents ---
+
+    def put_intent(self, namespace: str, pod_name: str, intent) -> None:
+        self.kube.patch_pod(namespace, pod_name, {
+            "metadata": {"annotations": intent.to_annotations()}})
+
+    def get_intent(self, namespace: str, pod_name: str):
+        from gpumounter_tpu.elastic.intents import Intent
+        pod = Pod(self.kube.get_pod(namespace, pod_name))
+        return Intent.from_annotations(pod.annotations)
+
+    def delete_intent(self, namespace: str, pod_name: str) -> bool:
+        from gpumounter_tpu.elastic.intents import (
+            ANNOT_DESIRED,
+            ANNOT_MIN,
+            ANNOT_PRIORITY,
+            ANNOT_REPLACED,
+        )
+        pod = Pod(self.kube.get_pod(namespace, pod_name))
+        had = ANNOT_DESIRED in pod.annotations
+        self.kube.patch_pod(namespace, pod_name, {
+            "metadata": {"annotations": {
+                ANNOT_DESIRED: None, ANNOT_MIN: None,
+                ANNOT_PRIORITY: None, ANNOT_REPLACED: None}}})
+        return had
+
+    def list_intents(self) -> list[tuple[str, str, object]]:
+        from gpumounter_tpu.elastic.intents import Intent, IntentError
+        out = []
+        for pod_json in self.kube.list_pods():
+            pod = Pod(pod_json)
+            try:
+                intent = Intent.from_annotations(pod.annotations)
+            except IntentError as exc:
+                logger.warning("skipping malformed intent on %s/%s: %s",
+                               pod.namespace, pod.name, exc)
+                continue
+            if intent is not None:
+                out.append((pod.namespace, pod.name, intent))
+        return out
+
+    # --- migration journals ---
+
+    def scan_journals(self) -> list[dict]:
+        from gpumounter_tpu.migrate.journal import parse_journal
+        out = []
+        try:
+            pods = self.kube.list_pods()
+        except Exception as exc:  # noqa: BLE001 — LIST is best-effort here
+            logger.warning("migration journal scan failed: %s", exc)
+            return out
+        for pod_json in pods:
+            journal = parse_journal(Pod(pod_json).annotations)
+            if journal is not None:
+                out.append(journal)
+        return out
+
+    def save_journal(self, journal: dict) -> None:
+        from gpumounter_tpu.migrate.journal import ANNOT_JOURNAL, dump
+        src = journal["source"]
+        patch_pod_with_retry(
+            self.kube, src["namespace"], src["pod"],
+            {"metadata": {"annotations": {ANNOT_JOURNAL: dump(journal)}}},
+            attempts=self.cfg.k8s_write_attempts,
+            base_s=self.cfg.k8s_write_retry_base_s)
+
+    # --- raw annotation stamps ---
+
+    def stamp_annotation(self, namespace: str, pod_name: str,
+                         annotation: str, payload: str | None) -> None:
+        patch_pod_with_retry(
+            self.kube, namespace, pod_name,
+            {"metadata": {"annotations": {annotation: payload}}},
+            attempts=self.cfg.k8s_write_attempts,
+            base_s=self.cfg.k8s_write_retry_base_s)

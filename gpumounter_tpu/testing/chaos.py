@@ -27,7 +27,12 @@ invariants after convergence:
      two freshly-constructed FleetCollectors (a "restart") rolling up
      the converged cluster agree exactly — same node set (every worker
      once), same per-node mount counts, and the fleet total is the sum
-     of the per-node counts in both.
+     of the per-node counts in both,
+  9. single shard owner per node (run_shard_scenario): across seeded
+     master crashes, restarts, and lease takeovers, no shard — and
+     therefore no node, since the hash ring maps each node to exactly
+     one shard — is ever claimed by two replica views at once, and the
+     fleet converges back to every shard owned.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -573,3 +578,125 @@ class ChaosHarness:
                 f"chaos invariants violated (seed={self.seed}):\n- "
                 + "\n- ".join(violations)
                 + f"\nschedule tail:\n  {tail}")
+
+
+# --- invariant 9: single shard owner per node (master/shard.py) ---
+
+def run_shard_scenario(seed: int, shard_count: int = 5,
+                       replicas: int = 3, n_ops: int = 40,
+                       lease_duration_s: float = 0.35) -> list[str]:
+    """Seeded lease chaos over the fake API server: master replicas
+    acquire/renew shard leases while the schedule crashes them (the
+    ghost keeps *believing* it owns until self-expiry — the dangerous
+    window), restarts them (same identity, fresh process), and lets
+    leases expire for takeover. After EVERY step the invariant is
+    checked over all views, live and ghost:
+
+      * no shard is claimed by two replica views at once — and since
+        the HashRing maps each node to exactly one shard, no node ever
+        has two owners;
+      * every manager agrees on the node -> shard mapping (ring
+        determinism: routing never depends on which replica you ask).
+
+    Convergence: once crashes stop, driving the live managers' renew
+    passes must end with every shard owned by exactly one live replica.
+    Raises InvariantViolation with the executed schedule on any breach.
+    """
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.shard import ShardManager
+
+    rng = random.Random(seed)
+    schedule: list[str] = []
+    cfg = Config().replace(shard_count=shard_count,
+                           shard_lease_duration_s=lease_duration_s,
+                           shard_preferred="")
+    kube = FakeKubeClient()
+    next_instance = iter(range(10_000))
+
+    def new_manager(replica: str) -> ShardManager:
+        return ShardManager(
+            kube, cfg=cfg, replica_id=replica,
+            advertise_url=f"http://{replica}:8080",
+            preferred=None).start_without_loop()
+
+    live: dict[str, ShardManager] = {
+        f"rep-{i}": new_manager(f"rep-{i}") for i in range(replicas)}
+    #: crashed-but-partitioned views: the process is gone from the
+    #: schedule's perspective but its last owned_shards() judgment is
+    #: exactly what a paused/partitioned master would still act on.
+    ghosts: dict[str, ShardManager] = {}
+    nodes = [f"storm-node-{j}" for j in range(64)]
+
+    def record(event: str) -> None:
+        schedule.append(event)
+        logger.info("shard-chaos[seed=%d] %s", seed, event)
+
+    def check(context: str) -> None:
+        views = list(live.values()) + list(ghosts.values())
+        by_shard: dict[int, list[str]] = {}
+        for view in views:
+            for s in view.owned_shards():
+                by_shard.setdefault(s, []).append(view.replica_id)
+        violations = [
+            f"shard {s} owned by {sorted(owners)} simultaneously"
+            for s, owners in by_shard.items() if len(set(owners)) > 1]
+        rings = {tuple(v.ring.owner_of(n) for n in nodes) for v in views}
+        if len(rings) > 1:
+            violations.append("replicas disagree on node->shard mapping")
+        if violations:
+            tail = "\n  ".join(schedule[-25:])
+            raise InvariantViolation(
+                f"invariant 9 violated at {context} (seed={seed}):\n- "
+                + "\n- ".join(violations)
+                + f"\nschedule tail:\n  {tail}")
+
+    for op_index in range(n_ops):
+        roll = rng.random()
+        if roll < 0.15 and len(live) > 1:
+            victim = rng.choice(sorted(live))
+            ghosts[f"{victim}#{next(next_instance)}"] = live.pop(victim)
+            record(f"crash {victim} (ghost keeps its claim view)")
+        elif roll < 0.30 and ghosts:
+            # Restart: the OLD process is truly dead the moment its
+            # replacement exists (one pod name runs once), so the ghost
+            # view retires and a fresh manager with the same identity
+            # re-enters — it may re-claim its own still-held lease.
+            ghost_key = rng.choice(sorted(ghosts))
+            ghost = ghosts.pop(ghost_key)
+            replica = ghost.replica_id
+            if replica not in live:
+                live[replica] = new_manager(replica)
+                record(f"restart {replica} (fresh process, same id)")
+        elif roll < 0.45:
+            time.sleep(rng.uniform(0.05, lease_duration_s * 1.2))
+            record("sleep (leases age toward expiry)")
+        else:
+            replica = rng.choice(sorted(live))
+            newly = live[replica].acquire_once()
+            record(f"acquire pass on {replica} -> newly {sorted(newly)}")
+        check(f"op {op_index}")
+
+    # Convergence: crashes over; live managers must soak up every shard
+    # (expired ghost leases are claimable by anyone), each shard ending
+    # with exactly one live owner.
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        for manager in live.values():
+            manager.acquire_once()
+        check("convergence")
+        owned = set()
+        for manager in live.values():
+            owned |= manager.owned_shards()
+        if owned == set(range(shard_count)):
+            break
+        time.sleep(0.05)
+    else:
+        raise InvariantViolation(
+            f"shards never fully re-owned after chaos (seed={seed}): "
+            f"missing {set(range(shard_count)) - owned}\nschedule:\n  "
+            + "\n  ".join(schedule[-25:]))
+    check("final")
+    record(f"converged: all {shard_count} shards owned by "
+           f"{sorted(live)}")
+    return schedule

@@ -1,0 +1,297 @@
+"""Bulk mount API + shard routing, end-to-end over the fake cluster
+(ISSUE 7): two real loopback gRPC workers, two sharded master replicas,
+real HTTP in between.
+
+Covers: mixed per-target results, cross-shard proxying (one request
+fans out to the owning replica), single-target 307 redirects, the
+forwarded no-second-hop contract, unowned-shard 503s, and the
+admission gate queueing rather than failing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+from conftest import TEST_AUTH_TOKEN
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.master.app import (
+    MasterApp,
+    WorkerRegistry,
+    build_http_server,
+)
+from gpumounter_tpu.master.shard import ShardManager
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+AUTH = {"Authorization": f"Bearer {TEST_AUTH_TOKEN}"}
+
+
+def _post_json(url, payload, extra_headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={**AUTH, "Content-Type": "application/json",
+                 **(extra_headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class ShardedStack:
+    """Two-node fake cluster + one real worker per node + N sharded
+    master replicas serving real HTTP."""
+
+    def __init__(self, root: str, replicas: int = 2):
+        self.cluster = FakeCluster(root, nodes={"node-a": 4,
+                                                "node-b": 4}).start()
+        cfg0 = self.cluster.cfg
+        self._servers = []
+        self._httpds = []
+        port_by_ip = {}
+        for i, name in enumerate(self.cluster.node_names):
+            node_cfg = self.cluster.node_cfg(name, cfg0)
+            node = self.cluster.node(name)
+            collector = TpuCollector(
+                backend=node.backend,
+                podresources=PodResourcesClient(node.kubelet_socket,
+                                                timeout_s=5.0),
+                cfg=node_cfg)
+            mounter = TpuMounter(node.backend, cfg=node_cfg,
+                                 kube=self.cluster.kube)
+            dev = os.path.join(root, f"cd-{name}")
+            os.makedirs(dev, exist_ok=True)
+            mounter.resolve_target = (
+                lambda pod, _d=dev: MountTarget(dev_dir=_d,
+                                                description=pod.name))
+            service = TpuMountService(self.cluster.kube,
+                                      collector=collector,
+                                      mounter=mounter, cfg=node_cfg)
+            server = build_server(service, address="localhost:0")
+            server.start()
+            self._servers.append(server)
+            ip = f"10.9.0.{i + 1}"
+            port_by_ip[ip] = server.bound_port
+            self.cluster.kube.create_pod(cfg0.worker_namespace, {
+                "metadata": {"name": f"w-{name}",
+                             "namespace": cfg0.worker_namespace,
+                             "labels": {"app": "tpu-mounter-worker"}},
+                "spec": {"nodeName": name, "containers": [{"name": "w"}]},
+                "status": {"phase": "Running", "podIP": ip}})
+
+        self.cfg = cfg0.replace(shard_count=replicas,
+                                shard_lease_duration_s=30.0,
+                                master_http_concurrency=4)
+
+        def factory(addr):
+            ip = addr.rsplit(":", 1)[0]
+            return WorkerClient(f"localhost:{port_by_ip[ip]}",
+                                cfg=self.cfg)
+
+        self.apps, self.bases = [], []
+        for i in range(replicas):
+            shards = ShardManager(self.cluster.kube, cfg=self.cfg,
+                                  replica_id=f"m-{i}", preferred={i})
+            app = MasterApp(self.cluster.kube, cfg=self.cfg,
+                            worker_client_factory=factory,
+                            registry=WorkerRegistry(self.cluster.kube,
+                                                    self.cfg),
+                            shards=shards)
+            httpd = build_http_server(app, port=0, host="127.0.0.1")
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            self._httpds.append(httpd)
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            shards.advertise_url = base
+            shards.start_without_loop()
+            self.apps.append(app)
+            self.bases.append(base)
+        for _ in range(2):  # acquire own shard, then record peers
+            for app in self.apps:
+                app.shards.acquire_once()
+
+    def owner_base(self, node: str) -> str:
+        """Base URL of the replica owning `node`'s shard."""
+        for app, base in zip(self.apps, self.bases):
+            if app.shards.owns_node(node):
+                return base
+        raise AssertionError(f"no replica owns {node}")
+
+    def non_owner_base(self, node: str) -> str:
+        for app, base in zip(self.apps, self.bases):
+            if not app.shards.owns_node(node):
+                return base
+        raise AssertionError(f"every replica owns {node}?!")
+
+    def stop(self):
+        for httpd in self._httpds:
+            httpd.shutdown()
+        for app in self.apps:
+            app.registry.stop()
+        for server in self._servers:
+            server.stop(grace=None)
+        self.cluster.stop()
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    s = ShardedStack(str(tmp_path_factory.mktemp("bulk")))
+    yield s
+    s.stop()
+
+
+def test_bulk_mixed_results_and_cross_shard_proxy(stack):
+    stack.cluster.add_target_pod("bulk-a", node="node-a")
+    stack.cluster.add_target_pod("bulk-b", node="node-b")
+    status, out = _post_json(stack.bases[0] + "/batch/addtpu", {
+        "targets": [
+            {"namespace": "default", "pod": "bulk-a", "chips": 1},
+            {"namespace": "default", "pod": "bulk-b", "chips": 2},
+            {"namespace": "default", "pod": "ghost", "chips": 1},
+        ]})
+    assert status == 200
+    results = out["results"]
+    assert [r["pod"] for r in results] == ["bulk-a", "bulk-b", "ghost"]
+    assert results[0]["result"] == "Success"
+    assert len(results[0]["uuids"]) == 1
+    assert results[1]["result"] == "Success"
+    assert len(results[1]["uuids"]) == 2
+    assert results[2]["result"] == "PodNotFound"
+    assert out["summary"]["success"] == 2
+    assert out["summary"]["total"] == 3
+    # At least one of the two nodes is NOT owned by replica 0, so the
+    # request necessarily exercised the proxy path (both mounts landed).
+    owned_by_0 = [n for n in ("node-a", "node-b")
+                  if stack.apps[0].shards.owns_node(n)]
+    assert len(owned_by_0) < 2 or stack.cfg.shard_count == 1
+
+
+def test_single_target_redirects_to_owner(stack):
+    stack.cluster.add_target_pod("redir", node="node-a")
+    base = stack.non_owner_base("node-a")
+    path = "/addtpu/namespace/default/pod/redir/tpu/1/isEntireMount/false"
+
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = urllib.request.build_opener(NoRedirect)
+    req = urllib.request.Request(base + path, headers=AUTH)
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        opener.open(req)
+    assert excinfo.value.code == 307
+    location = excinfo.value.headers["Location"]
+    assert location == stack.owner_base("node-a") + path
+    # Following the redirect (what rpc/http_failover.py does) mounts.
+    req = urllib.request.Request(location, headers=AUTH)
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+        assert b"Success" in resp.read()
+
+
+def test_forwarded_request_never_rehops(stack):
+    stack.cluster.add_target_pod("fwd", node="node-b")
+    base = stack.non_owner_base("node-b")
+    status, out = _post_json(
+        base + "/batch/addtpu",
+        {"targets": [{"namespace": "default", "pod": "fwd"}]},
+        extra_headers={"X-Tpumounter-Forwarded": "1"})
+    assert status == 200
+    assert out["results"][0]["result"] == "NotOwner"
+
+
+def test_unowned_shard_answers_per_target_and_503(stack):
+    """Drop every lease: bulk answers per-target Unowned entries and a
+    single-target add answers 503 + Retry-After (clients fail over)."""
+    stack.cluster.add_target_pod("orphan", node="node-a")
+    try:
+        for app in stack.apps:
+            app.shards.release_all()
+            # Drop cached peer routes too: until the next renew pass a
+            # replica would still (correctly) forward to the peer it
+            # last saw holding the lease — which then answers NotOwner.
+            # This test wants the genuinely-ownerless answer.
+            with app.shards._lock:
+                app.shards._peers.clear()
+        status, out = _post_json(
+            stack.bases[0] + "/batch/addtpu",
+            {"targets": [{"namespace": "default", "pod": "orphan"}]})
+        assert status == 200
+        assert out["results"][0]["result"] == "Unowned"
+        req = urllib.request.Request(
+            stack.bases[0] + "/addtpu/namespace/default/pod/orphan"
+                             "/tpu/1/isEntireMount/false", headers=AUTH)
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(req)
+        assert excinfo.value.code == 503
+        assert excinfo.value.headers.get("Retry-After")
+    finally:
+        for _ in range(2):
+            for app in stack.apps:
+                app.shards.acquire_once()
+
+
+def test_bulk_validation(stack):
+    for payload, fragment in (
+            ({}, "targets"),
+            ({"targets": []}, "targets"),
+            ({"targets": [{"namespace": "default"}]}, "pod"),
+            ({"targets": [{"pod": "x", "chips": 0}]}, "chips"),
+            ({"targets": [{"pod": "x", "chips": "lots"}]}, "chips"),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            _post_json(stack.bases[0] + "/batch/addtpu", payload)
+        assert excinfo.value.code == 400
+        assert fragment in excinfo.value.read().decode()
+
+
+def test_bulk_target_cap(stack):
+    many = [{"pod": f"p{i}"} for i in range(stack.cfg.bulk_max_targets
+                                            + 1)]
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        _post_json(stack.bases[0] + "/batch/addtpu", {"targets": many})
+    assert excinfo.value.code == 400
+    assert "too many targets" in excinfo.value.read().decode()
+
+
+def test_admission_gate_queues_instead_of_failing(stack):
+    """master_http_concurrency=4; 12 concurrent bulk requests all
+    succeed — the gate trades latency for stability, never errors."""
+    for i in range(3):
+        stack.cluster.add_target_pod(f"storm-{i}", node="node-a")
+    statuses = []
+    lock = threading.Lock()
+
+    def one(i):
+        pod = f"storm-{i % 3}"
+        try:
+            status, _ = _post_json(
+                stack.bases[0] + "/batch/addtpu",
+                {"targets": [{"namespace": "default", "pod": pod,
+                              "chips": 1}]})
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+        with lock:
+            statuses.append(status)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert statuses == [200] * 12
+
+
+def test_shards_route_serves_table(stack):
+    req = urllib.request.Request(stack.bases[0] + "/shards", headers=AUTH)
+    with urllib.request.urlopen(req) as resp:
+        table = json.loads(resp.read())
+    assert table["shardCount"] == stack.cfg.shard_count
+    holders = {e["shard"]: e["holder"] for e in table["shards"]}
+    assert set(holders) == set(range(stack.cfg.shard_count))

@@ -1,0 +1,174 @@
+"""MasterEndpoints (rpc/http_failover.py): replica failover + shard
+redirect following — the client half of the sharded-master contract
+(ISSUE 7 satellite). Driven against real stdlib HTTP servers."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from gpumounter_tpu.rpc.http_failover import EndpointError, MasterEndpoints
+
+
+class _Replica:
+    """A scriptable fake master replica: each (method, path) maps to a
+    (status, body, headers) answer or a callable(body_bytes)."""
+
+    def __init__(self):
+        self.answers = {}
+        self.requests = []  # (method, path, body)
+        self.headers_seen = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _serve(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                outer.requests.append((self.command, self.path, body))
+                outer.headers_seen.append(dict(self.headers))
+                answer = outer.answers.get((self.command, self.path),
+                                           (404, "nope", {}))
+                if callable(answer):
+                    answer = answer(body)
+                status, text, headers = answer
+                payload = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(payload)))
+                for key, value in headers.items():
+                    self.send_header(key, value)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.base = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def replicas():
+    pair = (_Replica(), _Replica())
+    yield pair
+    for r in pair:
+        r.stop()
+
+
+def test_comma_list_parsing():
+    ep = MasterEndpoints("http://a:1/, http://b:2 ,")
+    assert ep.bases == ["http://a:1", "http://b:2"]
+    with pytest.raises(ValueError):
+        MasterEndpoints(",")
+
+
+def test_failover_skips_dead_replica(replicas):
+    alive, _ = replicas
+    alive.answers[("GET", "/healthz")] = (200, "ok", {})
+    # First endpoint: a port nothing listens on.
+    ep = MasterEndpoints(f"http://127.0.0.1:1,{alive.base}")
+    assert ep.request("GET", "/healthz") == (200, "ok")
+    # Sticky preference: the next request goes straight to the live one.
+    ep.request("GET", "/healthz")
+    assert len(alive.requests) == 2
+
+
+def test_follows_307_resending_post_body(replicas):
+    a, b = replicas
+    a.answers[("POST", "/batch/addtpu")] = (
+        307, "owner elsewhere", {"Location": b.base + "/batch/addtpu"})
+    b.answers[("POST", "/batch/addtpu")] = (
+        lambda body: (200, json.dumps({"echo": json.loads(body)}), {}))
+    ep = MasterEndpoints(a.base)
+    status, body = ep.request("POST", "/batch/addtpu",
+                              json_body={"targets": [{"pod": "x"}]})
+    assert status == 200
+    assert json.loads(body)["echo"] == {"targets": [{"pod": "x"}]}
+    # The redirected hop carried the SAME body (urllib alone drops it).
+    assert b.requests[0][2] == a.requests[0][2]
+
+
+def test_503_fails_over_once_then_surfaces(replicas):
+    a, b = replicas
+    a.answers[("GET", "/x")] = (503, "unowned", {"Retry-After": "1"})
+    b.answers[("GET", "/x")] = (200, "served", {})
+    ep = MasterEndpoints(f"{a.base},{b.base}")
+    assert ep.request("GET", "/x") == (200, "served")
+    # Both replicas 503: the honest answer is the 503 itself.
+    b.answers[("GET", "/x")] = (503, "unowned too", {})
+    ep2 = MasterEndpoints(f"{a.base},{b.base}")
+    status, body = ep2.request("GET", "/x")
+    assert status == 503
+
+
+def test_4xx_is_an_answer_not_a_failover(replicas):
+    a, b = replicas
+    a.answers[("GET", "/missing")] = (404, "no pod", {})
+    b.answers[("GET", "/missing")] = (200, "should never be asked", {})
+    ep = MasterEndpoints(f"{a.base},{b.base}")
+    assert ep.request("GET", "/missing") == (404, "no pod")
+    assert b.requests == []
+
+
+def test_post_fails_over_on_connection_refused(replicas):
+    """Connection refused proves the request never reached a server —
+    safe to re-send even a mutation."""
+    alive, _ = replicas
+    alive.answers[("POST", "/batch/addtpu")] = (200, "ok", {})
+    ep = MasterEndpoints(f"http://127.0.0.1:1,{alive.base}")
+    assert ep.request("POST", "/batch/addtpu",
+                      json_body={"targets": []}) == (200, "ok")
+
+
+def test_post_timeout_does_not_fail_over(replicas):
+    """A timed-out mutation is AMBIGUOUS (the replica may have mounted):
+    it must surface, never be re-POSTed to another replica."""
+    import time as _time
+    slow, other = replicas
+    slow.answers[("POST", "/batch/addtpu")] = (
+        lambda body: (_time.sleep(3.0), (200, "late", {}))[1])
+    other.answers[("POST", "/batch/addtpu")] = (200, "should not run", {})
+    ep = MasterEndpoints(f"{slow.base},{other.base}", timeout_s=0.5)
+    with pytest.raises(EndpointError, match="ambiguous"):
+        ep.request("POST", "/batch/addtpu", json_body={"targets": []})
+    assert other.requests == []
+    # The same timeout on a GET is retried — reads are idempotent.
+    slow.answers[("GET", "/fleet")] = (
+        lambda body: (_time.sleep(3.0), (200, "late", {}))[1])
+    other.answers[("GET", "/fleet")] = (200, "served", {})
+    assert ep.request("GET", "/fleet") == (200, "served")
+
+
+def test_all_dead_raises_endpoint_error():
+    ep = MasterEndpoints("http://127.0.0.1:1,http://127.0.0.1:2",
+                         timeout_s=2.0)
+    with pytest.raises(EndpointError):
+        ep.request("GET", "/healthz")
+
+
+def test_redirect_loop_is_bounded(replicas):
+    a, _ = replicas
+    a.answers[("GET", "/loop")] = (307, "again",
+                                   {"Location": a.base + "/loop"})
+    ep = MasterEndpoints(a.base, max_redirects=3)
+    with pytest.raises(EndpointError, match="redirect loop"):
+        ep.request("GET", "/loop")
+
+
+def test_auth_header_attached_and_survives_redirect(replicas):
+    a, b = replicas
+    a.answers[("GET", "/fleet")] = (307, "", {"Location": b.base + "/fleet"})
+    b.answers[("GET", "/fleet")] = (200, "ok", {})
+    ep = MasterEndpoints(a.base, token="sekrit")
+    assert ep.request("GET", "/fleet") == (200, "ok")
+    assert a.headers_seen[0].get("Authorization") == "Bearer sekrit"
+    assert b.headers_seen[0].get("Authorization") == "Bearer sekrit"

@@ -64,3 +64,35 @@ def test_deploy_sh_usage():
                           capture_output=True, text=True)
     assert proc.returncode == 2
     assert "deploy|redeploy|uninstall" in proc.stderr
+
+
+def test_sharded_master_statefulset():
+    """The N-replica example must keep identity/sharding coherent:
+    stable StatefulSet identity, shard count == replicas, a replica id
+    derived from the pod name (the 'auto' preference contract), and an
+    advertise URL for redirects."""
+    (sts,) = _load("master-statefulset-sharded.yaml")
+    assert sts["kind"] == "StatefulSet"  # stable ordinals for preference
+    spec = sts["spec"]
+    env = {e["name"]: e for e in
+           spec["template"]["spec"]["containers"][0]["env"]}
+    assert int(env["TPUMOUNTER_SHARD_COUNT"]["value"]) == spec["replicas"]
+    assert env["TPUMOUNTER_REPLICA_ID"]["valueFrom"]["fieldRef"][
+        "fieldPath"] == "metadata.name"
+    assert "TPUMOUNTER_ADVERTISE_URL" in env
+    assert int(env["MASTER_HTTP_CONCURRENCY"]["value"]) > 0
+    # $(VAR) substitution only sees vars declared EARLIER in the list.
+    names = [e["name"] for e in
+             spec["template"]["spec"]["containers"][0]["env"]]
+    assert names.index("POD_IP") < names.index("TPUMOUNTER_ADVERTISE_URL")
+
+
+def test_rbac_grants_shard_leases():
+    docs = _load("rbac.yaml")
+    lease_rules = [
+        rule
+        for doc in docs if doc["kind"] == "Role"
+        for rule in doc.get("rules", [])
+        if "coordination.k8s.io" in rule.get("apiGroups", [])]
+    assert lease_rules, "no Lease RBAC for shard leader election"
+    assert {"get", "create", "update"} <= set(lease_rules[0]["verbs"])

@@ -184,3 +184,67 @@ def test_scheduler_thread_restarts_after_retiring():
                          lambda pod: pod and Pod(pod).phase == "Running",
                          timeout_s=5.0)
     assert got
+
+
+# --- fleet-scale behavior (ISSUE 7 satellite: 1k+ node simulations) ---
+
+
+def test_scale_1k_pods_list_and_watch_under_churn():
+    """The fleet bench's substrate: 1k worker pods must create, LIST
+    (selector-filtered) and stream watch deltas in interactive time.
+    The old fake deepcopied the whole store per LIST and rescanned the
+    whole event log per watch wake — quadratic at this size."""
+    kube = FakeKubeClient()
+    t0 = time.monotonic()
+    for i in range(1000):
+        kube.create_pod("kube-system", {
+            "metadata": {"name": f"w-{i}",
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": f"node-{i}", "containers": [{"name": "w"}]},
+            "status": {"phase": "Running", "podIP": f"10.0.{i // 250}.{i % 250 + 1}"},
+        })
+    for _ in range(10):
+        pods = kube.list_pods("kube-system",
+                              label_selector="app=tpu-mounter-worker")
+    assert len(pods) == 1000
+    watch = kube.watch_pods("kube-system",
+                            label_selector="app=tpu-mounter-worker",
+                            timeout_s=10.0)
+    for i in range(200):
+        kube.patch_pod("kube-system", f"w-{i}",
+                       {"metadata": {"annotations": {"churn": str(i)}}})
+    seen = 0
+    for etype, _pod in watch:
+        if etype == "MODIFIED":
+            seen += 1
+            if seen == 200:
+                break
+    assert seen == 200
+    elapsed = time.monotonic() - t0
+    # Generous CI bound: the pre-fix shape took tens of seconds here.
+    assert elapsed < 20.0, f"1k-node churn took {elapsed:.1f}s"
+
+
+def test_watch_expires_when_backlog_trimmed():
+    """A watcher that falls behind the bounded event backlog has its
+    stream END (the fake's 410 Gone) instead of silently skipping
+    events — callers re-LIST and re-open, exactly like against a real
+    apiserver."""
+    kube = FakeKubeClient()
+    kube.create_pod("ns", make_pod("seed", "ns"))
+    lagging = kube.watch_pods("ns", timeout_s=5.0)
+    for i in range(FakeKubeClient._MAX_EVENTS + 10):
+        kube.patch_pod("ns", "seed",
+                       {"metadata": {"annotations": {"i": str(i)}}})
+    # The lagging watcher's cursor predates the trim horizon: it must
+    # terminate promptly (not hang out its timeout, not yield stale
+    # events as if nothing was lost).
+    t0 = time.monotonic()
+    events = list(lagging)
+    assert time.monotonic() - t0 < 2.0
+    assert events == []
+    # A fresh watch opened NOW still streams new deltas fine.
+    fresh = kube.watch_pods("ns", timeout_s=5.0)
+    kube.patch_pod("ns", "seed", {"metadata": {"annotations": {"z": "1"}}})
+    etype, pod = next(iter(fresh))
+    assert etype == "MODIFIED"

@@ -1,0 +1,263 @@
+"""Shard plane unit tests: hash ring, lease lifecycle, routing (ISSUE 7).
+
+The chaos side (crashes/takeovers, invariant 9) lives in
+tests/test_chaos.py::test_shard_lease_chaos; these are the deterministic
+mechanics.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.master.shard import LEASE_PREFIX, HashRing, ShardManager
+
+
+def _cfg(**kw):
+    base = {"shard_count": 3, "shard_lease_duration_s": 5.0,
+            "shard_preferred": ""}
+    base.update(kw)
+    return Config().replace(**base)
+
+
+def _manager(kube, cfg, rid, preferred=None, url=None):
+    return ShardManager(kube, cfg=cfg, replica_id=rid,
+                        advertise_url=url or f"http://{rid}",
+                        preferred=preferred)
+
+
+# --- hash ring ---
+
+def test_ring_total_and_deterministic():
+    ring_a, ring_b = HashRing(4), HashRing(4)
+    for i in range(500):
+        owner = ring_a.owner_of(f"node-{i}")
+        assert 0 <= owner < 4
+        assert owner == ring_b.owner_of(f"node-{i}")
+
+
+def test_ring_reasonably_balanced():
+    ring = HashRing(3)
+    counts = [0, 0, 0]
+    for i in range(1200):
+        counts[ring.owner_of(f"gke-tpu-node-{i}")] += 1
+    # Virtual nodes keep every shard within ~2x of the mean.
+    assert min(counts) > 1200 / 3 / 2, counts
+
+
+def test_ring_growth_remaps_a_minority():
+    before, after = HashRing(3), HashRing(4)
+    nodes = [f"node-{i}" for i in range(1000)]
+    moved = sum(1 for n in nodes
+                if before.owner_of(n) != after.owner_of(n))
+    # Consistent hashing: growing 3 -> 4 shards moves ~1/4 of nodes,
+    # never a majority (a modulo hash would move ~3/4).
+    assert moved < 500, moved
+
+
+def test_single_shard_ring_is_constant():
+    ring = HashRing(1)
+    assert {ring.owner_of(f"n{i}") for i in range(50)} == {0}
+
+
+# --- preference parsing ---
+
+def test_preferred_auto_uses_statefulset_ordinal():
+    kube = FakeKubeClient()
+    m = ShardManager(kube, cfg=_cfg(shard_preferred="auto"),
+                     replica_id="tpu-mounter-master-2")
+    assert m.preferred == {2}
+    m = ShardManager(kube, cfg=_cfg(shard_preferred="auto"),
+                     replica_id="no-ordinal-name")
+    assert m.preferred is None
+
+
+def test_preferred_explicit_list():
+    kube = FakeKubeClient()
+    m = ShardManager(kube, cfg=_cfg(shard_preferred="0, 2"),
+                     replica_id="x")
+    assert m.preferred == {0, 2}
+
+
+# --- inactive (unsharded) managers ---
+
+def test_inactive_manager_owns_everything():
+    m = _manager(FakeKubeClient(), _cfg(), "solo")
+    assert not m.active()
+    assert m.owns_node("any-node")
+    assert m.route("any-node") == ("local", None)
+
+
+# --- lease lifecycle ---
+
+def test_acquire_renew_and_peer_routing():
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=2)
+    a = _manager(kube, cfg, "m-0", preferred={0}).start_without_loop()
+    b = _manager(kube, cfg, "m-1", preferred={1}).start_without_loop()
+    assert a.acquire_once() == {0}
+    assert b.acquire_once() == {1}
+    # Second passes renew own + record the peer for redirects.
+    assert a.acquire_once() == set()
+    assert b.acquire_once() == set()
+    assert a.owned_shards() == {0} and b.owned_shards() == {1}
+    remote_nodes = [f"n-{i}" for i in range(64)
+                    if a.owner_shard(f"n-{i}") == 1]
+    assert remote_nodes, "no node hashed to shard 1?!"
+    kind, url = a.route(remote_nodes[0])
+    assert (kind, url) == ("remote", "http://m-1")
+    assert not a.owns_node(remote_nodes[0])
+    assert b.owns_node(remote_nodes[0])
+
+
+def test_fresh_lease_respects_preference_but_expiry_does_not():
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=2, shard_lease_duration_s=0.2)
+    picky = _manager(kube, cfg, "picky", preferred={0}).start_without_loop()
+    assert picky.acquire_once() == {0}  # volunteers only for shard 0
+    assert picky.owned_shards() == {0}
+    greedy = _manager(kube, cfg, "greedy",
+                      preferred=None).start_without_loop()
+    assert greedy.acquire_once() == {1}
+    # picky dies; after expiry greedy takes shard 0 despite having no
+    # preference claim on fresh leases (availability beats balance).
+    time.sleep(0.25)
+    assert 0 in greedy.acquire_once()
+    assert greedy.owned_shards() == {0, 1}
+    # ... and the dead replica's own view self-expired.
+    assert picky.owned_shards() == set()
+
+
+def test_release_all_hands_off_immediately():
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=1, shard_lease_duration_s=30.0)
+    a = _manager(kube, cfg, "a", preferred=None).start_without_loop()
+    b = _manager(kube, cfg, "b", preferred=None).start_without_loop()
+    assert a.acquire_once() == {0}
+    assert b.acquire_once() == set()  # held, not expired (30s TTL)
+    a.release_all()
+    assert a.owned_shards() == set()
+    assert b.acquire_once() == {0}  # no TTL wait after graceful release
+
+
+def test_renew_conflict_drops_local_claim():
+    """A renew that loses the resourceVersion CAS (another writer got
+    between our read and our write) means the record is no longer ours:
+    the local claim must drop, not limp on."""
+    from gpumounter_tpu.faults import failpoints
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=1, shard_lease_duration_s=30.0)
+    a = _manager(kube, cfg, "a", preferred=None).start_without_loop()
+    assert a.acquire_once() == {0}
+    failpoints.arm("k8s.update_lease.status", "1*return(409)")
+    try:
+        a.acquire_once()
+    finally:
+        failpoints.disarm_all()
+    assert a.owned_shards() == set()
+    # The next clean pass re-reads the lease (still recording us as the
+    # holder) and re-claims it.
+    assert a.acquire_once() == {0}
+
+
+def test_on_takeover_fires_async_with_newly_acquired_set():
+    """The callback runs OFF the renew thread (a slow re-drive must not
+    stall renews and expire our own leases)."""
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=2)
+    m = _manager(kube, cfg, "m", preferred=None).start_without_loop()
+    seen = []
+    m.on_takeover = seen.append
+    m.acquire_once()
+    deadline = time.monotonic() + 5.0
+    while not seen and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert seen == [{0, 1}]
+    m.acquire_once()  # pure renew: no callback
+    time.sleep(0.05)
+    assert seen == [{0, 1}]
+
+
+def test_table_shape():
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=2)
+    m = _manager(kube, cfg, "m", preferred={0},
+                 url="http://m:8080").start_without_loop()
+    m.acquire_once()
+    table = m.table()
+    assert table["replica"] == "m" and table["shardCount"] == 2
+    by_shard = {e["shard"]: e for e in table["shards"]}
+    assert by_shard[0]["local"] and by_shard[0]["url"] == "http://m:8080"
+    assert by_shard[1]["holder"] is None
+
+
+# --- fake lease CAS semantics ---
+
+def test_fake_lease_cas():
+    from gpumounter_tpu.k8s.client import ConflictError, NotFoundError
+    kube = FakeKubeClient()
+    with pytest.raises(NotFoundError):
+        kube.get_lease("ns", "missing")
+    created = kube.create_lease("ns", {
+        "metadata": {"name": "l1"}, "spec": {"holderIdentity": "x"}})
+    with pytest.raises(ConflictError):
+        kube.create_lease("ns", {"metadata": {"name": "l1"}, "spec": {}})
+    stale = dict(created, metadata={**created["metadata"],
+                                    "resourceVersion": "999"})
+    with pytest.raises(ConflictError):
+        kube.update_lease("ns", "l1", stale)
+    fresh = kube.get_lease("ns", "l1")
+    fresh["spec"]["holderIdentity"] = "y"
+    updated = kube.update_lease("ns", "l1", fresh)
+    assert updated["spec"]["holderIdentity"] == "y"
+    assert updated["metadata"]["resourceVersion"] != \
+        created["metadata"]["resourceVersion"]
+
+
+# --- subsystem gates ---
+
+def test_reconciler_parks_not_owned_intents():
+    """An active sharded replica must not converge intents for nodes it
+    does not own — the owner does."""
+    from gpumounter_tpu.elastic.intents import ANNOT_DESIRED
+    from gpumounter_tpu.elastic.reconciler import ElasticReconciler
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=2)
+    kube.create_pod("default", {
+        "metadata": {"name": "t", "namespace": "default",
+                     "annotations": {ANNOT_DESIRED: "2"}},
+        "spec": {"nodeName": "some-node", "containers": [{"name": "c"}]},
+        "status": {"phase": "Running"},
+    })
+    shards = _manager(kube, cfg, "m", preferred=set())  # owns nothing
+    shards.start_without_loop()
+    rec = ElasticReconciler(kube, registry=None, client_factory=None,
+                            cfg=cfg, shards=shards)
+    outcome = rec.reconcile_once("default", "t")
+    assert outcome["phase"] == "not-owned"
+    assert outcome["shard"] == shards.owner_shard("some-node")
+
+
+def test_resume_interrupted_skips_unowned_journals():
+    from gpumounter_tpu.migrate.orchestrator import MigrationCoordinator
+    from gpumounter_tpu.migrate.journal import new_journal
+    from gpumounter_tpu.store import KubeMasterStore
+    kube = FakeKubeClient()
+    cfg = _cfg(shard_count=2)
+    kube.create_pod("default", {
+        "metadata": {"name": "src", "namespace": "default"},
+        "spec": {"nodeName": "mig-node", "containers": [{"name": "c"}]},
+        "status": {"phase": "Running"},
+    })
+    store = KubeMasterStore(kube, cfg)
+    store.save_journal(new_journal("mig-x", "default", "src",
+                                   "default", "dst"))
+    shards = _manager(kube, cfg, "m", preferred=set())
+    shards.start_without_loop()
+    coordinator = MigrationCoordinator(kube, registry=None,
+                                       client_factory=None, cfg=cfg,
+                                       store=store, shards=shards)
+    assert coordinator.resume_interrupted() == []  # not ours to adopt

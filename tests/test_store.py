@@ -1,0 +1,158 @@
+"""MasterStore round-trips: restart-resume parity (ISSUE 7).
+
+The stateless-master contract (store/base.py): state written through
+one store instance — intents, migration journals, worker registry —
+must be rebuilt IDENTICALLY by a freshly-constructed instance reading
+the same cluster. That is the whole basis for shard takeover and for
+N-replica masters sharing one view with no replica-local database.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.elastic.intents import Intent, IntentStore
+from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.migrate.journal import new_journal
+from gpumounter_tpu.store import KubeMasterStore
+
+
+@pytest.fixture()
+def kube():
+    return FakeKubeClient()
+
+
+@pytest.fixture()
+def cfg():
+    return Config()
+
+
+def _pod(kube, name, namespace="default", node="node-0", labels=None):
+    kube.create_pod(namespace, {
+        "metadata": {"name": name, "namespace": namespace,
+                     **({"labels": labels} if labels else {})},
+        "spec": {"nodeName": node, "containers": [{"name": "c"}]},
+        "status": {"phase": "Running", "podIP": "10.0.0.9"},
+    })
+
+
+def test_intent_roundtrip_fresh_instance(kube, cfg):
+    _pod(kube, "tenant-a")
+    _pod(kube, "tenant-b", namespace="jobs")
+    writer = KubeMasterStore(kube, cfg)
+    writer.put_intent("default", "tenant-a",
+                      Intent(desired_chips=3, min_chips=1, priority=2))
+    writer.put_intent("jobs", "tenant-b", Intent(desired_chips=1))
+
+    reader = KubeMasterStore(kube, cfg)  # fresh instance = restarted master
+    assert sorted(reader.list_intents()) == sorted(writer.list_intents())
+    got = reader.get_intent("default", "tenant-a")
+    assert got == Intent(desired_chips=3, min_chips=1, priority=2)
+    # Delete through the fresh instance; the original sees it gone too.
+    assert reader.delete_intent("default", "tenant-a") is True
+    assert writer.get_intent("default", "tenant-a") is None
+
+
+def test_intent_store_api_delegates_to_backend(kube, cfg):
+    """IntentStore keeps its public CRUD surface; persistence rides the
+    MasterStore seam (one backend shared by routes + reconciler)."""
+    _pod(kube, "tenant-c")
+    backend = KubeMasterStore(kube, cfg)
+    store = IntentStore(kube, cfg, backend=backend)
+    store.put("default", "tenant-c", Intent(desired_chips=2))
+    assert backend.get_intent("default", "tenant-c") == \
+        Intent(desired_chips=2)
+    assert store.list() == backend.list_intents()
+    with pytest.raises(NotFoundError):
+        store.get("default", "never-created")
+
+
+def test_journal_roundtrip_fresh_instance(kube, cfg):
+    _pod(kube, "src")
+    _pod(kube, "dst", node="node-1")
+    writer = KubeMasterStore(kube, cfg)
+    journal = new_journal("mig-roundtrip", "default", "src",
+                          "default", "dst")
+    journal["phase"] = "drain"
+    journal["chips"] = ["tpu-a", "tpu-b"]
+    writer.save_journal(journal)
+
+    reader = KubeMasterStore(kube, cfg)
+    scanned = reader.scan_journals()
+    assert len(scanned) == 1
+    got = scanned[0]
+    assert got["id"] == "mig-roundtrip"
+    assert got["phase"] == "drain"
+    assert got["chips"] == ["tpu-a", "tpu-b"]
+    assert got["outcome"] is None
+    # Byte-level parity between two fresh readers.
+    assert reader.scan_journals() == \
+        KubeMasterStore(kube, cfg).scan_journals()
+
+
+def test_journal_save_raises_when_source_gone(kube, cfg):
+    store = KubeMasterStore(kube, cfg)
+    journal = new_journal("mig-gone", "default", "vanished",
+                          "default", "dst")
+    with pytest.raises(NotFoundError):
+        store.save_journal(journal)
+
+
+def test_interrupted_journal_adopted_by_fresh_coordinator(kube, cfg):
+    """A non-terminal journal persisted by one master shows up in a
+    freshly-built coordinator's listing — the restart-resume (and shard
+    takeover) entry point."""
+    from gpumounter_tpu.migrate.orchestrator import MigrationCoordinator
+    _pod(kube, "src")
+    _pod(kube, "dst", node="node-1")
+    first = KubeMasterStore(kube, cfg)
+    journal = new_journal("mig-interrupted", "default", "src",
+                          "default", "dst")
+    journal["phase"] = "remount"
+    first.save_journal(journal)
+
+    fresh = MigrationCoordinator(kube, registry=None, client_factory=None,
+                                 cfg=cfg, store=KubeMasterStore(kube, cfg))
+    listed = fresh.list_migrations()
+    assert [j["id"] for j in listed] == ["mig-interrupted"]
+    assert fresh.get("mig-interrupted")["phase"] == "remount"
+
+
+def test_worker_registry_rebuilt_identically(kube, cfg):
+    """Two registries over two fresh stores converge to the same
+    node -> worker map from the cluster alone."""
+    from gpumounter_tpu.master.app import WorkerRegistry
+    for i in range(5):
+        _pod(kube, f"worker-{i}", namespace=cfg.worker_namespace,
+             node=f"node-{i}", labels={"app": "tpu-mounter-worker"})
+    _pod(kube, "not-a-worker", namespace=cfg.worker_namespace,
+         node="node-9")
+
+    first = WorkerRegistry(kube, cfg, store=KubeMasterStore(kube, cfg))
+    second = WorkerRegistry(kube, cfg, store=KubeMasterStore(kube, cfg))
+    try:
+        snap_a = first.registry_snapshot()
+        snap_b = second.registry_snapshot()
+        assert snap_a == snap_b
+        assert set(snap_a) == {f"node-{i}" for i in range(5)}
+    finally:
+        first.stop()
+        second.stop()
+
+
+def test_stamp_annotation_write_and_clear(kube, cfg):
+    _pod(kube, "stamped")
+    store = KubeMasterStore(kube, cfg)
+    store.stamp_annotation("default", "stamped",
+                           "tpumounter.io/migration-lock", '{"id":"m1"}')
+    from gpumounter_tpu.k8s.types import Pod
+    pod = Pod(kube.get_pod("default", "stamped"))
+    assert pod.annotations["tpumounter.io/migration-lock"] == '{"id":"m1"}'
+    store.stamp_annotation("default", "stamped",
+                           "tpumounter.io/migration-lock", None)
+    pod = Pod(kube.get_pod("default", "stamped"))
+    assert "tpumounter.io/migration-lock" not in pod.annotations
+    with pytest.raises(NotFoundError):
+        store.stamp_annotation("default", "missing", "a", "b")
