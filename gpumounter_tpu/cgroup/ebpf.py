@@ -853,6 +853,17 @@ class V2DeviceController:
         with self._mu:
             return cgroup_dir in self._state
 
+    def enumerate_grants(self) -> dict[str, set[tuple[int, int]]]:
+        """Ground truth for the worker's ledger replay
+        (worker/resync.py): cgroup dir -> the (major, minor) chip set
+        currently granted there. After a worker restart this is the
+        bpffs-restored state (_restore_all), i.e. exactly what survives
+        a crash — the replay compares it against the ledger's open
+        transactions and converges the difference."""
+        with self._mu:
+            return {cg: set(st.granted)
+                    for cg, st in self._state.items() if st.granted}
+
     def _seed_telemetry(self, st: _CgroupState, devs: list[TpuDevice],
                         tenant: str) -> None:
         """Register the grant with the telemetry table: remember the

@@ -24,7 +24,9 @@ Two families of verbs:
     fleet                          federated per-node fleet rollup
     slo                            SLO burn-rate evaluation
     shards                         shard -> owner replica table
-                                   (the five above accept --read-token:
+    recovery [--evacuate NODE]     node-failure recovery plane: liveness
+                                   verdicts + evacuation history
+                                   (the six above accept --read-token:
                                    the read-only observability scope)
 
 The reference has no CLI at all (interaction is raw curl,
@@ -281,6 +283,30 @@ def cmd_shards(args) -> int:
     status, body = _http(args, "GET", "/shards", token=_obs_token(args))
     print(body.rstrip())
     return 0 if status == 200 else 1
+
+
+def cmd_recovery(args) -> int:
+    """The recovery plane: per-node liveness verdicts + evacuation
+    history (GET /recovery), or --evacuate NODE to trigger a manual
+    evacuation (POST; requires the mutate token). Exit 3 when any node
+    is suspect/evacuated — scriptable like `tpumounter slo`."""
+    if args.evacuate:
+        status, body = _http(args, "POST",
+                             f"/recovery/evacuate/{args.evacuate}",
+                             token=_remote_token(args))
+        print(body.rstrip())
+        return 0 if status == 200 else 1
+    status, body = _http(args, "GET", "/recovery", token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        nodes = json.loads(body).get("nodes", {})
+    except ValueError:
+        return 1
+    unhealthy = any(entry.get("status") in ("suspect", "evacuated")
+                    for entry in nodes.values())
+    return 3 if unhealthy else 0
 
 
 def _parse_bulk_target(raw: str, default_ns: str) -> dict:
@@ -586,6 +612,16 @@ def build_parser() -> argparse.ArgumentParser:
                                        "replica owns which node shard")
     _obs_common(sh)
     sh.set_defaults(fn=cmd_shards)
+
+    rc = sub.add_parser("recovery", help="node-failure recovery plane: "
+                                         "liveness verdicts + evacuation "
+                                         "history (exit 3 when any node "
+                                         "is suspect/evacuated)")
+    _obs_common(rc)
+    rc.add_argument("--evacuate", metavar="NODE", default=None,
+                    help="manually evacuate NODE (operator-confirmed "
+                         "death; needs the mutate token)")
+    rc.set_defaults(fn=cmd_recovery)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

@@ -152,6 +152,54 @@ class Config:
     bulk_proxy_timeout_s: float = field(default_factory=lambda: float(
         _env("BULK_PROXY_TIMEOUT_S", "330")))
 
+    # --- node-failure recovery plane (worker ledger / epoch fencing /
+    # evacuation) ---
+    # Durable worker mount ledger: an fsync'd append-only JSONL journal
+    # of every grant/mknod intent+completion, written to this hostPath
+    # directory so a crashed worker's replacement can replay it against
+    # ground truth and converge (worker/ledger.py + worker/resync.py).
+    # "" disables the ledger (the pre-recovery shape; tests opt in with
+    # a tmp dir, the DaemonSet mounts /var/lib/tpumounter).
+    ledger_dir: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_LEDGER_DIR", ""))
+    # Compaction threshold: when the journal file exceeds this many
+    # bytes, it is rewritten as a holdings snapshot + the still-open
+    # transactions + the persisted epoch (atomic tmp+rename) — see
+    # docs/FAQ.md on ledger location/rotation.
+    ledger_max_bytes: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_LEDGER_MAX_BYTES", str(4 * 1024 * 1024))))
+    # SIGTERM graceful drain: how long the worker waits for in-flight
+    # mount/unmount batches to finish before closing the ledger and
+    # exiting (new mutations are rejected UNAVAILABLE from the signal
+    # on, so masters retry elsewhere/later).
+    drain_timeout_s: float = field(default_factory=lambda: float(_env(
+        "WORKER_DRAIN_TIMEOUT_S", "20")))
+    # Bounded retry for slave-pod release after an unmount: a release
+    # that still fails trips tpumounter_slave_release_failures_total
+    # and a TPUSlaveReleaseFailed Event instead of leaking silently.
+    slave_release_attempts: int = field(default_factory=lambda: int(_env(
+        "SLAVE_RELEASE_ATTEMPTS", "3")))
+    # Master-side recovery controller (gpumounter_tpu/recovery/): watches
+    # worker liveness (registry + probe + breaker) and node readiness;
+    # on confirmed node death it evacuates — releases the node's
+    # slave-pod bookings, re-drives elastic intents and interrupted
+    # migration journals onto healthy nodes, and emits TPUNodeEvacuated.
+    recovery_enabled: bool = field(default_factory=lambda: _env(
+        "TPUMOUNTER_RECOVERY", "1") not in ("0", "false", ""))
+    recovery_interval_s: float = field(default_factory=lambda: float(_env(
+        "RECOVERY_INTERVAL_S", "10")))
+    # A node is confirmed dead only after this many consecutive failed
+    # liveness checks AND recovery_grace_s of continuous failure AND
+    # (its Node object NotReady, or its worker pod gone) — a worker
+    # crash on a Ready node is left to ledger replay, never evacuated.
+    recovery_confirm_failures: int = field(default_factory=lambda: int(
+        _env("RECOVERY_CONFIRM_FAILURES", "3")))
+    recovery_grace_s: float = field(default_factory=lambda: float(_env(
+        "RECOVERY_GRACE_S", "30")))
+    # Deadline for the controller's per-node liveness probe RPC.
+    recovery_probe_timeout_s: float = field(default_factory=lambda: float(
+        _env("RECOVERY_PROBE_TIMEOUT_S", "5")))
+
     # --- master-side request validation ---
     # Reference accepts any int32 gpuNum incl. 0/negative at L1
     # (cmd/GPUMounter-master/main.go:31-43 parses but never range-checks);

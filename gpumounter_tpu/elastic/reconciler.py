@@ -287,6 +287,14 @@ class ElasticReconciler:
         return self._converge(key, namespace, pod_name, pod, intent,
                               address, dead, healthy)
 
+    def _node_epoch(self, pod: Pod) -> dict:
+        """Fencing-epoch client kwargs for the pod's node: every
+        mutation the reconciler drives carries it, so a replica whose
+        shard lease was taken over cannot heal/shrink a pod its
+        successor now manages (shard.epoch_kwargs is the shared rule)."""
+        from gpumounter_tpu.master.shard import epoch_kwargs
+        return epoch_kwargs(self.shards, pod.node_name)
+
     def _heal_counted(self, key, namespace, pod_name, pod, intent,
                       address, dead, healthy) -> dict:
         """A pass with dead chips (or a journaled half-done heal) is a
@@ -372,11 +380,13 @@ class ElasticReconciler:
     def _remove_chips(self, address: str, pod: Pod, uuids: list[str],
                       force: bool) -> list[str]:
         removed: list[str] = []
+        epoch_kwargs = self._node_epoch(pod)
         for uuid in uuids:
             try:
                 with self.client_factory(address) as client:
                     result = client.remove_tpu(pod.name, pod.namespace,
-                                               [uuid], force=force)
+                                               [uuid], force=force,
+                                               **epoch_kwargs)
             except Exception as exc:  # noqa: BLE001 — gRPC boundary
                 raise ReconcileError(f"remove of {uuid} failed: {exc}")
             if result not in (api.RemoveTPUResult.Success,
@@ -399,7 +409,8 @@ class ElasticReconciler:
         )
 
         coordinator = SliceCoordinator(self.kube, self.registry,
-                                       self.client_factory, self.cfg)
+                                       self.client_factory, self.cfg,
+                                       shards=self.shards)
         target = SliceTarget(namespace=pod.namespace, pod=pod.name)
         try:
             coordinator.mount_slice([target], gap, entire=False)

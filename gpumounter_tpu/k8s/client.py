@@ -171,6 +171,22 @@ class KubeClient(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} does not support leases")
 
+    # --- core/v1 Nodes (recovery plane: node-readiness signal) ---
+    #
+    # The recovery controller (gpumounter_tpu/recovery/) confirms node
+    # death by combining worker liveness with the Node object's Ready
+    # condition — a crashed worker on a Ready node is left to ledger
+    # replay, never evacuated. Default raises NotImplementedError so
+    # non-cluster backends degrade to "no readiness signal" cleanly.
+
+    def get_node(self, name: str) -> dict:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support nodes")
+
+    def list_nodes(self) -> list[dict]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support nodes")
+
     # --- composed helper used by the allocator ---
 
     def wait_for_pod(self, namespace: str, name: str, predicate,
@@ -411,6 +427,14 @@ class RestKubeClient(KubeClient):
         return self._json("PUT",
                           f"{self._LEASE_BASE}/{namespace}/leases/{name}",
                           body=manifest)
+
+    # --- core/v1 Nodes ---
+
+    def get_node(self, name: str) -> dict:
+        return self._json("GET", f"/api/v1/nodes/{name}")
+
+    def list_nodes(self) -> list[dict]:
+        return self._json("GET", "/api/v1/nodes").get("items", [])
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:

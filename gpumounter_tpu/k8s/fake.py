@@ -71,6 +71,11 @@ class FakeKubeClient(KubeClient):
                  scheduler_delay_s: float = 0.0,
                  delete_hook: SchedulerHook | None = None):
         self._pods: dict[tuple[str, str], dict] = {}
+        self._nodes: dict[str, dict] = {}
+        #: API-partition simulation (recovery/chaos tests): while set,
+        #: every call raises ApiError(503) — what a partitioned master
+        #: sees from the API server.
+        self._partitioned = False
         self._leases: dict[tuple[str, str], dict] = {}
         self._lease_rv = itertools.count(1)
         self._lock = threading.Condition()
@@ -115,7 +120,21 @@ class FakeKubeClient(KubeClient):
 
     # --- KubeClient surface ---
 
+    def _check_partition(self) -> None:
+        if self._partitioned:
+            from gpumounter_tpu.k8s.client import ApiError
+            raise ApiError(503, "fake apiserver partitioned "
+                                "(set_partitioned)")
+
+    def set_partitioned(self, partitioned: bool) -> None:
+        """Simulate a network partition between this client's holder and
+        the API server: every call fails 503 until cleared. The recovery
+        chaos scenarios use it to model a stale master that can still
+        reach workers but not the cluster state."""
+        self._partitioned = bool(partitioned)
+
     def get_pod(self, namespace: str, name: str) -> dict:
+        self._check_partition()
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -123,6 +142,7 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(pod)
 
     def create_pod(self, namespace: str, manifest: dict) -> dict:
+        self._check_partition()
         # Same injection surface as the REST client, so chaos schedules
         # hit the fake API server exactly like a real one.
         inject_write_fault("create_pod", namespace,
@@ -199,6 +219,7 @@ class FakeKubeClient(KubeClient):
                                  namespace, name)
 
     def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
+        self._check_partition()
         try:
             inject_write_fault("delete_pod", namespace, name)
         except NotFoundError:
@@ -213,6 +234,7 @@ class FakeKubeClient(KubeClient):
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:
+        self._check_partition()
         # Filter FIRST, deepcopy only the matches: a selector LIST over
         # a 1k-pod cluster used to deepcopy every pod (the fake's
         # dominant cost at fleet scale — the registry, the reconciler
@@ -233,6 +255,7 @@ class FakeKubeClient(KubeClient):
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
                    resource_version: str = "") -> Iterator[tuple[str, dict]]:
+        self._check_partition()
         # Subscribe EAGERLY (cursor captured at call time, not at first
         # next()): callers rely on open-watch-then-recheck to close the
         # missed-event window (KubeClient.wait_for_pod).
@@ -289,6 +312,7 @@ class FakeKubeClient(KubeClient):
                 return
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
+        self._check_partition()
         inject_write_fault("patch_pod", namespace, name)
         with self._lock:
             pod = self._pods.get((namespace, name))
@@ -312,6 +336,7 @@ class FakeKubeClient(KubeClient):
     # property the shard manager's single-owner invariant rests on.
 
     def get_lease(self, namespace: str, name: str) -> dict:
+        self._check_partition()
         with self._lock:
             lease = self._leases.get((namespace, name))
             if lease is None:
@@ -319,6 +344,7 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(lease)
 
     def create_lease(self, namespace: str, manifest: dict) -> dict:
+        self._check_partition()
         inject_write_fault("create_lease", namespace,
                            manifest.get("metadata", {}).get("name", ""))
         lease = copy.deepcopy(manifest)
@@ -337,6 +363,7 @@ class FakeKubeClient(KubeClient):
 
     def update_lease(self, namespace: str, name: str,
                      manifest: dict) -> dict:
+        self._check_partition()
         inject_write_fault("update_lease", namespace, name)
         with self._lock:
             current = self._leases.get((namespace, name))
@@ -355,6 +382,54 @@ class FakeKubeClient(KubeClient):
             lease["metadata"].setdefault("name", name)
             self._leases[(namespace, name)] = lease
             return copy.deepcopy(lease)
+
+    # --- core/v1 Nodes (recovery plane) ---
+
+    def get_node(self, name: str) -> dict:
+        self._check_partition()
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFoundError(f"node {name} not found")
+            return copy.deepcopy(node)
+
+    def list_nodes(self) -> list[dict]:
+        self._check_partition()
+        with self._lock:
+            return [copy.deepcopy(n) for n in self._nodes.values()]
+
+    def create_node(self, name: str, ready: bool = True) -> dict:
+        """Test helper: register a Node object with a Ready condition."""
+        node = {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "uid": str(uuidlib.uuid4())},
+            "status": {"conditions": [{
+                "type": "Ready",
+                "status": "True" if ready else "False",
+            }]},
+        }
+        with self._lock:
+            self._nodes[name] = node
+            return copy.deepcopy(node)
+
+    def set_node_ready(self, name: str, ready: bool,
+                       reason: str = "") -> None:
+        """Kill/partition simulation: flip the node's Ready condition —
+        what the kubelet stopping its heartbeats looks like from the
+        API server."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFoundError(f"node {name} not found")
+            node["status"]["conditions"] = [{
+                "type": "Ready",
+                "status": "True" if ready else "False",
+                **({"reason": reason} if reason else {}),
+            }]
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
 
     # --- test helpers ---
 

@@ -300,6 +300,12 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     # telemetry, and the SLO burn-rate evaluation over it.
     ("GET", re.compile(r"^/fleet$"), "fleet"),
     ("GET", re.compile(r"^/slo$"), "slo"),
+    # Node-failure recovery plane (gpumounter_tpu/recovery/): per-node
+    # liveness verdicts + the evacuation history, and a manual
+    # evacuation trigger for operators who confirmed a death themselves.
+    ("GET", re.compile(r"^/recovery$"), "recovery"),
+    ("POST", re.compile(
+        r"^/recovery/evacuate/(?P<node>[^/]+)$"), "recovery_evacuate"),
 ]
 
 
@@ -330,7 +336,7 @@ class MasterApp:
     #: /fleet and /slo — which reveal pod/tenant names and chip
     #: movements — require the mutate token.
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
-                             "shards"})
+                             "shards", "recovery"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -338,7 +344,7 @@ class MasterApp:
     AUDITED_ROUTES = frozenset({
         "add", "remove", "batch_add", "addslice", "removeslice",
         "intent_put", "intent_delete", "migrate_start",
-        "migration_abort"})
+        "migration_abort", "recovery_evacuate"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
@@ -432,6 +438,16 @@ class MasterApp:
         self.fleet = FleetCollector(self.registry, self._client_factory,
                                     cfg=self.cfg, slo=self.slo,
                                     shards=self.shards)
+        # Node-failure recovery plane: liveness verdicts + automatic
+        # evacuation. Constructed here so the /recovery routes and the
+        # loop share one controller; the background loop only runs
+        # after an explicit recovery.start() (master/main.py) — tests
+        # drive check_once()/evacuate() directly.
+        from gpumounter_tpu.recovery import RecoveryController
+        self.recovery = RecoveryController(
+            kube, self.registry, self._client_factory, cfg=self.cfg,
+            store=self.store, shards=self.shards, elastic=self.elastic,
+            migrations=self.migrations)
 
     # --- plumbing ---
 
@@ -460,7 +476,7 @@ class MasterApp:
     #: query (RUNBOOK "Debugging a slow mount"). /fleet and /slo are
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
-                                 "slo", "shards"})
+                                 "slo", "shards", "recovery"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -676,6 +692,26 @@ class MasterApp:
         return 200, "application/json", \
             jsonlib.dumps(self.slo.payload(), indent=1) + "\n"
 
+    def _route_recovery(self, match, body, headers):
+        """The recovery plane's state: per-node liveness verdicts, the
+        evacuation history, and the controller's confirmation config —
+        the 'verify' step of the RUNBOOK's node-failure walkthrough."""
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.recovery.payload(), indent=1) + "\n"
+
+    def _route_recovery_evacuate(self, match, body, headers):
+        """Manual evacuation: an operator who confirmed a node death
+        out-of-band (console says the VM is gone) can skip the
+        confirmation window. Shard-gated like every per-node mutation —
+        the node's owner runs the evacuation."""
+        import json as jsonlib
+        node = match.group("node")
+        self._shard_gate(node, f"/recovery/evacuate/{node}")
+        record = self.recovery.evacuate(node, reason="manual")
+        return 200, "application/json", \
+            jsonlib.dumps(record, indent=1) + "\n"
+
     def _route_audit(self, match, body, headers):
         """Query the append-only audit trail. Filters (all optional):
         ?namespace= &pod= &op= (prefix) &trace= &outcome= (prefix)
@@ -735,7 +771,8 @@ class MasterApp:
     def _slice_coordinator(self):
         from gpumounter_tpu.master.slice_ops import SliceCoordinator
         return SliceCoordinator(self.kube, self.registry,
-                                self._client_factory, self.cfg)
+                                self._client_factory, self.cfg,
+                                shards=self.shards)
 
     def _route_addslice(self, match, body, headers):
         import json as jsonlib
@@ -842,7 +879,8 @@ class MasterApp:
         targets = self._parse_bulk_body(body)
         forwarded = any(k.lower() == FORWARDED_HEADER for k in headers)
         coordinator = BulkMountCoordinator(
-            self.kube, self.registry, self._client_factory, self.cfg)
+            self.kube, self.registry, self._client_factory, self.cfg,
+            shards=self.shards)
         results: list[dict | None] = [None] * len(targets)
         resolve_errors, by_node = coordinator._resolve_bulk(targets)
         for i, err in resolve_errors.items():
@@ -1092,9 +1130,11 @@ class MasterApp:
                     ns, pod_name, tpu_num, entire)
         address, node = self._worker_for_pod(ns, pod_name,
                                              redirect_path=match.string)
+        from gpumounter_tpu.master.shard import epoch_kwargs
         with self._client_factory(address) as client:
             try:
-                result = client.add_tpu(pod_name, ns, tpu_num, entire)
+                result = client.add_tpu(pod_name, ns, tpu_num, entire,
+                                        **epoch_kwargs(self.shards, node))
             except Exception as exc:  # noqa: BLE001 — gRPC boundary
                 logger.error("worker AddTPU failed: %s", exc)
                 raise _degraded_or_500(exc)
@@ -1121,9 +1161,12 @@ class MasterApp:
                     ns, pod_name, uuids, force)
         address, node = self._worker_for_pod(ns, pod_name,
                                              redirect_path=match.string)
+        from gpumounter_tpu.master.shard import epoch_kwargs
         with self._client_factory(address) as client:
             try:
-                result = client.remove_tpu(pod_name, ns, uuids, force)
+                result = client.remove_tpu(pod_name, ns, uuids, force,
+                                           **epoch_kwargs(self.shards,
+                                                          node))
             except Exception as exc:  # noqa: BLE001 — gRPC boundary
                 logger.error("worker RemoveTPU failed: %s", exc)
                 raise _degraded_or_500(exc)
@@ -1156,13 +1199,20 @@ def _slice_headers(exc) -> dict[str, str] | None:
 
 def _degraded_or_500(exc: Exception) -> _HttpError:
     """Map a worker-call failure to HTTP: a breaker that opened (or was
-    found open) mid-call is 503 + Retry-After, anything else 500."""
-    from gpumounter_tpu.rpc.resilience import BreakerOpenError
+    found open) mid-call is 503 + Retry-After, a fencing rejection is
+    503 + Retry-After 1 (this replica's shard view is stale — the
+    failover client retries against fresh routing and lands on the
+    current owner), anything else 500."""
+    from gpumounter_tpu.rpc.resilience import BreakerOpenError, FencedError
     if isinstance(exc, BreakerOpenError):
         return _HttpError(
             503, f"worker degraded (circuit breaker open): {exc}",
             headers={"Retry-After":
                      str(max(1, int(exc.retry_after_s + 0.5)))})
+    if isinstance(exc, FencedError):
+        return _HttpError(
+            503, f"stale shard ownership (fenced by worker): {exc}",
+            headers={"Retry-After": "1"})
     return _HttpError(500, f"Service Internal Error: {_grpc_detail(exc)}")
 
 

@@ -46,6 +46,15 @@ def main() -> None:
     # The elastic loop re-reads intents from pod annotations on start, so
     # declared desires survive master restarts with no extra store.
     app.elastic.start()
+    # Recovery controller: watch worker liveness + node readiness and
+    # evacuate confirmed-dead nodes (release bookings, re-drive intents
+    # and migration journals). Detection state is in-memory — a fresh
+    # replica re-confirms within one grace window.
+    if cfg.recovery_enabled:
+        app.recovery.start()
+        logger.info("recovery controller on (interval %.0fs, confirm "
+                    "%d failures + %.0fs grace)", cfg.recovery_interval_s,
+                    cfg.recovery_confirm_failures, cfg.recovery_grace_s)
     # Fleet telemetry poll loop: federate every worker's telemetry each
     # FLEET_SCRAPE_INTERVAL_S and evaluate the SLO burn rates (breaches
     # emit k8s Events + audit records). Restart-safe: workers report
@@ -67,6 +76,7 @@ def main() -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        app.recovery.stop()
         app.fleet.stop()
         app.elastic.stop()
         if cfg.shard_count > 1:

@@ -105,6 +105,18 @@ def _now_rfc3339() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
+def epoch_kwargs(shards: "ShardManager | None", node_name: str) -> dict:
+    """Client kwargs carrying `node_name`'s fencing epoch for a mutating
+    worker RPC — the one place the stamping rule lives. Empty when no
+    shard manager is wired, sharding is inactive, or the shard is
+    unowned (epoch 0 never fences, and omitting the kwarg entirely
+    keeps legacy client shapes and test doubles working)."""
+    if shards is None or not node_name:
+        return {}
+    epoch = shards.node_epoch(node_name)
+    return {"epoch": epoch} if epoch else {}
+
+
 class ShardManager:
     """One replica's view of shard ownership.
 
@@ -144,6 +156,11 @@ class ShardManager:
         #: shard -> monotonic stamp taken BEFORE the successful
         #: acquire/renew write: ownership self-expires duration_s later.
         self._held: dict[int, float] = {}
+        #: shard -> fencing epoch (leaseTransitions + 1 at acquire):
+        #: monotonic per shard because transitions only ever grows, and
+        #: bumped exactly on takeover — the property workers fence on
+        #: (worker/server.py rejects older non-zero epochs FENCED).
+        self._epochs: dict[int, int] = {}
         #: shard -> (holder replica id, advertised url, local expiry)
         self._peers: dict[int, tuple[str, str, float]] = {}
         #: shard -> (last seen renewTime string, monotonic observed-at):
@@ -193,6 +210,22 @@ class ShardManager:
             return True  # unsharded master: everything is local
         return self.ring.owner_of(node_name) in self.owned_shards()
 
+    def node_epoch(self, node_name: str) -> int:
+        """The fencing epoch to stamp on mutating RPCs for this node:
+        leaseTransitions+1 of its shard's lease as of OUR LAST
+        acquisition — deliberately NOT gated on still holding the
+        shard. A replica that lost the lease mid-operation must keep
+        stamping its (now stale) epoch so the worker FENCES the write;
+        degrading to 0 here would turn "stale owner" into "unfenced
+        legacy traffic" the worker accepts — reopening the split-brain
+        window fencing exists to close. 0 only when sharding is
+        inactive or we never held the shard (a replica the shard gate
+        never routed mutations to)."""
+        if not self._started:
+            return 0
+        with self._lock:
+            return self._epochs.get(self.ring.owner_of(node_name), 0)
+
     def route(self, node_name: str) -> tuple[str, str | None]:
         """("local", None) when this replica owns the node's shard,
         ("remote", url) when a live peer does, ("unowned", None) when
@@ -223,6 +256,8 @@ class ShardManager:
                 entry["holder"] = self.replica_id
                 entry["url"] = self.advertise_url
                 entry["local"] = True
+                with self._lock:
+                    entry["epoch"] = self._epochs.get(i, 0)
             elif i in peers and peers[i][2] > now:
                 entry["holder"], entry["url"], _ = peers[i]
                 entry["local"] = False
@@ -327,7 +362,7 @@ class ShardManager:
                 self.kube.create_lease(self.lease_namespace, manifest)
             except (ConflictError, ApiError):
                 return  # lost the race; next pass sees the winner
-            self._record_held(shard, stamp, newly)
+            self._record_held(shard, stamp, newly, transitions=0)
             return
         holder, url = self._holder_of(lease)
         transitions = int(lease.get("spec", {}).get("leaseTransitions")
@@ -345,7 +380,7 @@ class ShardManager:
                 with self._lock:
                     self._held.pop(shard, None)
                 return
-            self._record_held(shard, stamp, newly)
+            self._record_held(shard, stamp, newly, transitions=transitions)
             return
         if self._expired(shard, lease):
             lease["spec"] = self._lease_spec(transitions + 1)
@@ -353,7 +388,8 @@ class ShardManager:
                 self.kube.update_lease(self.lease_namespace, name, lease)
             except (ConflictError, ApiError):
                 return  # another challenger won; next pass records it
-            self._record_held(shard, stamp, newly)
+            self._record_held(shard, stamp, newly,
+                              transitions=transitions + 1)
             return
         # Held by a live peer: remember where to redirect until its
         # lease would expire on OUR clock (same local-observation basis
@@ -369,11 +405,16 @@ class ShardManager:
         return self.preferred is None or shard in self.preferred
 
     def _record_held(self, shard: int, stamp: float,
-                     newly: set[int]) -> None:
+                     newly: set[int], transitions: int = 0) -> None:
         with self._lock:
             if shard not in self._held:
                 newly.add(shard)
             self._held[shard] = stamp
+            # Fencing epoch = the transitions value WE wrote, + 1 (so a
+            # fresh create is epoch 1 > 0 = the unfenced sentinel).
+            # Monotonic: transitions only grows, and a renew keeps it.
+            self._epochs[shard] = max(self._epochs.get(shard, 0),
+                                      int(transitions) + 1)
             self._peers.pop(shard, None)
 
     # --- lifecycle ---

@@ -179,11 +179,18 @@ class BulkMountCoordinator:
     concurrently, bounded by cfg.bulk_node_fanout.
     """
 
-    def __init__(self, kube, registry, client_factory, cfg):
+    def __init__(self, kube, registry, client_factory, cfg, shards=None):
         self.kube = kube
         self.registry = registry
         self.client_factory = client_factory
         self.cfg = cfg
+        #: optional ShardManager: mutating RPCs carry the node's fencing
+        #: epoch so a stale replica's writes are rejected by workers.
+        self.shards = shards
+
+    def _epoch(self, node: str) -> dict:
+        from gpumounter_tpu.master.shard import epoch_kwargs
+        return epoch_kwargs(self.shards, node)
 
     def _resolve_bulk(self, targets: list[BulkTarget]
                       ) -> tuple[dict[int, dict], dict[str, list[int]]]:
@@ -260,7 +267,8 @@ class BulkMountCoordinator:
                              "node": node}
                     try:
                         result, uuids = client.add_tpu_detailed(
-                            t.pod, t.namespace, t.chips, t.entire)
+                            t.pod, t.namespace, t.chips, t.entire,
+                            **self._epoch(node))
                         entry["result"] = result.name
                         if result == api.AddTPUResult.Success:
                             entry["uuids"] = uuids
@@ -288,11 +296,18 @@ class BulkMountCoordinator:
 
 
 class SliceCoordinator:
-    def __init__(self, kube, registry, client_factory, cfg):
+    def __init__(self, kube, registry, client_factory, cfg, shards=None):
         self.kube = kube
         self.registry = registry
         self.client_factory = client_factory
         self.cfg = cfg
+        #: optional ShardManager: mutating RPCs carry the node's fencing
+        #: epoch (see BulkMountCoordinator).
+        self.shards = shards
+
+    def _epoch(self, node: str) -> dict:
+        from gpumounter_tpu.master.shard import epoch_kwargs
+        return epoch_kwargs(self.shards, node)
 
     def _resolve(self, targets: list[SliceTarget]) -> list[tuple[SliceTarget, str, str, str]]:
         """[(target, node, worker_address, pod_ip)]; validates every pod
@@ -344,7 +359,8 @@ class SliceCoordinator:
         # per-host mount span joins the caller's trace.
         trace_ctx = trace.current()
 
-        def _mount(i: int, address: str, t: SliceTarget) -> None:
+        def _mount(i: int, address: str, t: SliceTarget,
+                   node: str) -> None:
             try:
                 with trace.attached(trace_ctx), \
                         trace.span("slice.mount_host", pod=t.pod,
@@ -352,13 +368,14 @@ class SliceCoordinator:
                         self.client_factory(address) as client:
                     results[i] = client.add_tpu_detailed(
                         t.pod, t.namespace, chips_per_host, entire,
-                        prefer_ici=prefer_ici)
+                        prefer_ici=prefer_ici,
+                        **self._epoch(node))
             except Exception as exc:  # noqa: BLE001 — per-host gRPC boundary
                 results[i] = exc
 
-        threads = [threading.Thread(target=_mount, args=(i, addr, t),
+        threads = [threading.Thread(target=_mount, args=(i, addr, t, node),
                                     daemon=True)
-                   for i, (t, _, addr, _ip) in enumerate(resolved)]
+                   for i, (t, node, addr, _ip) in enumerate(resolved)]
         for th in threads:
             th.start()
         for th in threads:
@@ -379,7 +396,7 @@ class SliceCoordinator:
                              "%d host mount(s) leaked", len(succeeded))
                 succeeded = []
             for i in succeeded:
-                t, _, addr, _ip = resolved[i]
+                t, node, addr, _ip = resolved[i]
                 _, mounted_uuids = results[i]  # type: ignore[misc]
                 try:
                     with self.client_factory(addr) as client:
@@ -387,7 +404,8 @@ class SliceCoordinator:
                         # empty uuids would no-op on single-mounts and
                         # over-remove pre-existing entire-mounts.
                         client.remove_tpu(t.pod, t.namespace,
-                                          mounted_uuids, force=True)
+                                          mounted_uuids, force=True,
+                                          **self._epoch(node))
                 except Exception as exc:  # noqa: BLE001
                     logger.error("slice rollback on %s failed: %s",
                                  t.pod, exc)
@@ -400,7 +418,7 @@ class SliceCoordinator:
             for i, r in failures.items():
                 if not isinstance(r, Exception):
                     continue  # worker answered: nothing was mounted
-                t, _, addr, _ip = resolved[i]
+                t, node, addr, _ip = resolved[i]
                 if not entire:
                     logger.error(
                         "host %s failed at transport level during a "
@@ -411,7 +429,8 @@ class SliceCoordinator:
                 try:
                     with self.client_factory(addr) as client:
                         client.remove_tpu(t.pod, t.namespace, [],
-                                          force=True)
+                                          force=True,
+                                          **self._epoch(node))
                 except Exception as exc:  # noqa: BLE001
                     logger.warning("post-timeout rollback probe on %s: %s",
                                    t.pod, exc)
@@ -460,20 +479,22 @@ class SliceCoordinator:
         results = {}
         trace_ctx = trace.current()
 
-        def _remove(i: int, address: str, t: SliceTarget) -> None:
+        def _remove(i: int, address: str, t: SliceTarget,
+                    node: str) -> None:
             try:
                 with trace.attached(trace_ctx), \
                         trace.span("slice.remove_host", pod=t.pod), \
                         self.client_factory(address) as client:
                     results[i] = client.remove_tpu(t.pod, t.namespace, [],
                                                    force=force,
-                                                   remove_all=True)
+                                                   remove_all=True,
+                                                   **self._epoch(node))
             except Exception as exc:  # noqa: BLE001
                 results[i] = exc
 
-        threads = [threading.Thread(target=_remove, args=(i, addr, t),
+        threads = [threading.Thread(target=_remove, args=(i, addr, t, node),
                                     daemon=True)
-                   for i, (t, _, addr, _ip) in enumerate(resolved)]
+                   for i, (t, node, addr, _ip) in enumerate(resolved)]
         for th in threads:
             th.start()
         for th in threads:

@@ -125,6 +125,11 @@ class MigrationCoordinator:
         self._journals: dict[str, dict] = {}   # id -> last persisted copy
         self._threads: dict[str, threading.Thread] = {}
         self._aborts: set[str] = set()
+        #: (namespace, pod) -> last resolved node, so a transient pod
+        #: GET failure cannot silently drop the fencing epoch from a
+        #: machine's mutations (an unfenced write from a stale replica
+        #: is exactly what fencing must prevent).
+        self._node_cache: dict[tuple[str, str], str] = {}
 
     # --- public API (HTTP routes + CLI land here) ---
 
@@ -257,6 +262,28 @@ class MigrationCoordinator:
             self._spawn(journal)
             adopted.append(journal["id"])
         return adopted
+
+    def _node_epoch(self, namespace: str, pod_name: str) -> dict:
+        """Fencing-epoch client kwargs for a pod's node: the machine's
+        drains and rollback removes carry it, so a machine still
+        running on a replica that lost the shard keeps stamping its
+        (stale) epoch and the worker fences it — node_epoch is
+        deliberately not gated on current ownership. A transient pod
+        GET failure falls back to the last node this machine resolved
+        (cached) rather than silently dropping the stamp; {} only when
+        unsharded or the pod was never resolvable. shard.epoch_kwargs
+        is the shared rule."""
+        from gpumounter_tpu.master.shard import epoch_kwargs
+        if self.shards is None or not self.shards.active():
+            return {}  # skip the pod GET entirely
+        key = (namespace, pod_name)
+        try:
+            node = Pod(self.kube.get_pod(namespace, pod_name)).node_name
+        except Exception:  # noqa: BLE001 — use the cached resolution
+            node = self._node_cache.get(key, "")
+        if node:
+            self._node_cache[key] = node
+        return epoch_kwargs(self.shards, node or "")
 
     def _owns_journal(self, journal: dict) -> bool:
         """Sharded masters adopt only journals whose source pod sits on
@@ -427,8 +454,9 @@ class MigrationCoordinator:
         to_remove = [u for u in journal["chips"] if u in set(held)]
         if to_remove:
             with self.client_factory(address) as client:
-                result = client.remove_tpu(src["pod"], src["namespace"],
-                                           to_remove, force=True)
+                result = client.remove_tpu(
+                    src["pod"], src["namespace"], to_remove, force=True,
+                    **self._node_epoch(src["namespace"], src["pod"]))
             if result not in (api.RemoveTPUResult.Success,
                               api.RemoveTPUResult.TPUNotFound):
                 raise MigrationError(
@@ -458,7 +486,8 @@ class MigrationCoordinator:
                 SliceTarget,
             )
             coordinator = SliceCoordinator(self.kube, self.registry,
-                                           self.client_factory, self.cfg)
+                                           self.client_factory, self.cfg,
+                                           shards=self.shards)
             target = SliceTarget(namespace=dst["namespace"],
                                  pod=dst["pod"])
             try:
@@ -595,8 +624,10 @@ class MigrationCoordinator:
             if cleanup:
                 address = self._worker_addr(dst["namespace"], dst["pod"])
                 with self.client_factory(address) as client:
-                    client.remove_tpu(dst["pod"], dst["namespace"],
-                                      cleanup, force=True)
+                    client.remove_tpu(
+                        dst["pod"], dst["namespace"], cleanup, force=True,
+                        **self._node_epoch(dst["namespace"],
+                                           dst["pod"]))
         except Exception as exc:  # noqa: BLE001 — keep restoring
             failure = f"destination cleanup failed: {exc}"
 
@@ -613,7 +644,7 @@ class MigrationCoordinator:
                     )
                     SliceCoordinator(
                         self.kube, self.registry, self.client_factory,
-                        self.cfg).mount_slice(
+                        self.cfg, shards=self.shards).mount_slice(
                             [SliceTarget(namespace=src["namespace"],
                                          pod=src["pod"])],
                             missing, entire=False, prefer_ici=True)

@@ -1,0 +1,13 @@
+"""Node-failure recovery plane (master side).
+
+RecoveryController watches worker liveness (registry view + liveness
+probes + circuit-breaker state) and node readiness; on confirmed node
+death it evacuates the node — releases its slave-pod bookings, re-drives
+elastic intents and interrupted migration journals onto healthy nodes,
+and emits TPUNodeEvacuated Events + audit records. Served at
+GET /recovery and `tpumounter recovery`.
+"""
+
+from gpumounter_tpu.recovery.controller import RecoveryController
+
+__all__ = ["RecoveryController"]

@@ -1,0 +1,443 @@
+"""RecoveryController: detect dead nodes, fence them off, evacuate.
+
+The paper's design leaves node death to operators: slave pods keep their
+bookings, elastic intents keep failing their reconcile passes against a
+worker that will never answer, and in-flight migrations wedge. The
+recovery controller closes the loop:
+
+  detect    every pass, each tracked node gets a liveness verdict from
+            three signals — the worker registry (is a worker pod even
+            registered?), the shared circuit breaker (is its transport
+            degraded?), and a direct probe RPC (CollectTelemetry with a
+            short deadline — any ANSWER, even an application error,
+            proves the process is alive).
+  confirm   a node is confirmed dead only after `recovery_confirm_failures`
+            consecutive failed passes AND `recovery_grace_s` of
+            continuous failure AND corroboration from the cluster: its
+            Node object NotReady, or its worker pod gone from the
+            registry. A crashed worker on a Ready node is NOT evacuated
+            — its restart's ledger replay (worker/resync.py) is the
+            right recovery, and evacuating would yank chips a healthy
+            tenant still uses.
+  evacuate  release the node's pool bookings (slave + warm holder pods —
+            their chips are stranded on dead hardware; deleting them
+            frees the books), re-enqueue every elastic intent whose pod
+            sat on the node (when the workload controller reschedules
+            the pod, the reconciler converges it on its new node),
+            re-drive interrupted migration journals
+            (migrations.resume_interrupted — the owner-side journal
+            scan), and emit a TPUNodeEvacuated Event per affected pod +
+            an audit record.
+
+Sharded masters: each replica recovers only nodes it owns (the shard
+route), so two replicas never race an evacuation; epoch fencing
+(worker/server.py) protects the node from the loser of any such race
+anyway.
+
+State is in-memory per replica — deliberately. Detection state is
+cheap to rebuild (a fresh replica re-confirms death within one
+grace window for every node still registry-visible), and every
+evacuation ACTION is idempotent: deleting deleted pods no-ops,
+re-enqueueing intents is the reconciler's normal diet,
+resume_interrupted skips adopted journals. A node whose worker pod
+vanished BEFORE any replica ever tracked it is invisible here; its
+stranded bookings still converge through the slave reaper once the
+node's tenant pods are deleted/rescheduled by their workload
+controllers (worker/reaper.py's owner-gone sweep).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("recovery")
+
+NODES_TRACKED = REGISTRY.gauge(
+    "tpumounter_recovery_nodes_tracked",
+    "Nodes the recovery controller is watching")
+NODES_SUSPECT = REGISTRY.gauge(
+    "tpumounter_recovery_nodes_suspect",
+    "Nodes currently failing liveness but not yet confirmed dead")
+NODES_EVACUATED = REGISTRY.counter(
+    "tpumounter_nodes_evacuated_total",
+    "Nodes evacuated after confirmed death")
+EVACUATED_BOOKINGS = REGISTRY.counter(
+    "tpumounter_evacuated_bookings_total",
+    "Slave/warm pool pods released by evacuations")
+EVACUATED_INTENTS = REGISTRY.counter(
+    "tpumounter_evacuated_intents_total",
+    "Elastic intents re-driven off dead nodes by evacuations")
+
+
+class RecoveryController:
+    """One master replica's recovery loop. Constructed by MasterApp;
+    the background thread starts only from master/main.py (or tests
+    driving check_once directly)."""
+
+    def __init__(self, kube, registry, client_factory, cfg=None,
+                 store=None, shards=None, elastic=None, migrations=None):
+        self.cfg = cfg or get_config()
+        self.kube = kube
+        self.registry = registry
+        self.client_factory = client_factory
+        self.store = store
+        self.shards = shards
+        self.elastic = elastic
+        self.migrations = migrations
+        self._lock = threading.Lock()
+        #: node -> {"status": healthy|suspect|evacuated,
+        #:          "failures": int, "first_failure_at": monotonic,
+        #:          "reason": str, "last_seen": wall}
+        self._nodes: dict[str, dict] = {}
+        #: completed evacuations, newest last (bounded).
+        self._evacuations: list[dict] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --- lifecycle ---
+
+    def start(self) -> "RecoveryController":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="recovery-controller",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.recovery_interval_s):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("recovery pass crashed")
+
+    # --- detection ---
+
+    #: probe fan-out width: a correlated failure (rack outage) must not
+    #: serialize N dead nodes' probe timeouts — detection latency would
+    #: grow linearly with the blast radius, exactly when speed matters.
+    #: Same bounded-pool pattern as the fleet collector (obs/fleet.py).
+    PROBE_POOL_WIDTH = 16
+
+    def check_once(self) -> dict:
+        """One detection pass over every tracked node (liveness probes
+        fanned out over a bounded pool). Returns the pass summary
+        {checked, suspect, evacuated:[...]}."""
+        from concurrent import futures
+        snapshot = self.registry.registry_snapshot()
+        with self._lock:
+            tracked = set(self._nodes) | set(snapshot)
+        owned = []
+        for node in sorted(tracked):
+            if self.shards is not None and self.shards.active() \
+                    and not self.shards.owns_node(node):
+                # The node's shard owner runs recovery for it; keeping
+                # state here would race the owner's confirmation clock.
+                with self._lock:
+                    self._nodes.pop(node, None)
+                continue
+            owned.append(node)
+        verdicts: dict[str, tuple[bool, str]] = {}
+        if owned:
+            width = min(self.PROBE_POOL_WIDTH, len(owned))
+            with futures.ThreadPoolExecutor(
+                    max_workers=width,
+                    thread_name_prefix="recovery-probe") as pool:
+                for node, verdict in zip(owned, pool.map(
+                        lambda n: self._worker_alive(
+                            n, self._address(n, snapshot)), owned)):
+                    verdicts[node] = verdict
+        evacuated: list[str] = []
+        suspect = 0
+        for node in owned:
+            state = self._check_node(node, snapshot, verdicts[node])
+            if state == "suspect":
+                suspect += 1
+            elif state == "evacuate":
+                self.evacuate(node, reason=self._reason(node))
+                evacuated.append(node)
+        self._prune_departed(snapshot)
+        with self._lock:
+            NODES_TRACKED.set(float(len(self._nodes)))
+        NODES_SUSPECT.set(float(suspect))
+        return {"checked": len(owned), "suspect": suspect,
+                "evacuated": evacuated}
+
+    def _address(self, node: str, snapshot: dict[str, str]) -> str | None:
+        return (f"{snapshot[node]}:{self.cfg.worker_port}"
+                if node in snapshot else None)
+
+    #: how long an evacuated-and-unregistered node stays visible in the
+    #: /recovery nodes table before tracking drops it (the bounded
+    #: evacuation history remains the durable record).
+    EVACUATED_RETENTION_S = 600.0
+
+    def _prune_departed(self, snapshot: dict[str, str]) -> None:
+        """Stop tracking evacuated nodes whose worker never re-registered
+        (after a visibility retention window): the evacuation history
+        (bounded) is the durable record, and a node that does come back
+        re-enters tracking through the registry snapshot as a fresh
+        healthy entry. Without this, autoscaler churn grows self._nodes
+        (and the /recovery payload) forever."""
+        now = time.monotonic()
+        with self._lock:
+            departed = [
+                node for node, entry in self._nodes.items()
+                if entry.get("status") == "evacuated"
+                and node not in snapshot
+                and now - entry.get("evacuated_at", now)
+                > self.EVACUATED_RETENTION_S]
+            for node in departed:
+                del self._nodes[node]
+        for node in departed:
+            logger.info("evacuated node %s left the registry; tracking "
+                        "dropped (history retains the evacuation)", node)
+
+    def _reason(self, node: str) -> str:
+        with self._lock:
+            return self._nodes.get(node, {}).get("reason", "")
+
+    def _check_node(self, node: str, snapshot: dict[str, str],
+                    verdict: tuple[bool, str]) -> str:
+        address = self._address(node, snapshot)
+        alive, why = verdict
+        now = time.monotonic()
+        with self._lock:
+            entry = self._nodes.setdefault(
+                node, {"status": "healthy", "failures": 0,
+                       "first_failure_at": None, "reason": ""})
+            if entry["status"] == "evacuated":
+                if alive:
+                    # The node came back (replacement hardware, flapping
+                    # network): resume watching it like any healthy node.
+                    logger.warning("evacuated node %s is alive again; "
+                                   "tracking as healthy", node)
+                    entry.update(status="healthy", failures=0,
+                                 first_failure_at=None, reason="")
+                entry["last_seen"] = time.time()
+                return entry["status"]
+            if alive:
+                entry.update(status="healthy", failures=0,
+                             first_failure_at=None, reason="",
+                             last_seen=time.time())
+                return "healthy"
+            entry["failures"] += 1
+            if entry["first_failure_at"] is None:
+                entry["first_failure_at"] = now
+            entry["status"] = "suspect"
+            entry["reason"] = why
+            confirmed = (
+                entry["failures"] >= self.cfg.recovery_confirm_failures
+                and now - entry["first_failure_at"]
+                >= self.cfg.recovery_grace_s)
+        if not confirmed:
+            return "suspect"
+        # Corroborate with the cluster before the point of no return.
+        # Evacuation needs POSITIVE evidence beyond unresponsiveness:
+        # the Node object NotReady, or the worker pod gone from the
+        # registry. A Ready node (crashed worker — ledger replay fixes
+        # it; or a DaemonSet rollout) stays suspect; so does a node
+        # with NO readable Node object but a still-registered worker —
+        # an unreadable Node (API blip: store.get_node degrades to
+        # None) must never tip a merely-slow worker into evacuation.
+        ready = self._node_ready(node)
+        worker_gone = address is None
+        if ready is True:
+            logger.info("node %s: worker unresponsive but Node is Ready; "
+                        "leaving to worker restart + ledger replay", node)
+            return "suspect"
+        if ready is None and not worker_gone:
+            logger.info("node %s: worker unresponsive but no Node "
+                        "readiness signal and the worker is still "
+                        "registered; insufficient evidence to evacuate",
+                        node)
+            return "suspect"
+        with self._lock:
+            self._nodes[node]["reason"] = (
+                f"{why}; node_ready={ready}, worker_registered="
+                f"{not worker_gone}")
+        return "evacuate"
+
+    def _worker_alive(self, node: str, address: str | None
+                      ) -> tuple[bool, str]:
+        if address is None:
+            return False, "no worker registered for node"
+        breaker = getattr(self.registry, "breaker", None)
+        if breaker is not None and breaker.state(address) == "open":
+            return False, "worker circuit breaker open"
+        from gpumounter_tpu.rpc.resilience import (
+            BreakerOpenError,
+            DeadlineExceededError,
+            WorkerUnavailableError,
+        )
+        try:
+            with self.client_factory(address) as client:
+                client.collect_telemetry(
+                    timeout_s=self.cfg.recovery_probe_timeout_s)
+            return True, ""
+        except (DeadlineExceededError, WorkerUnavailableError,
+                BreakerOpenError) as exc:
+            return False, f"liveness probe failed: {exc}"
+        except Exception:  # noqa: BLE001 — ANY answer proves liveness
+            # UNIMPLEMENTED (legacy worker), auth errors, app errors:
+            # the process answered, so it is alive.
+            return True, ""
+
+    def _node_ready(self, node: str) -> bool | None:
+        """True/False from the Node object's Ready condition; None when
+        no node view exists (non-cluster backends — confirmation then
+        rests on the worker being gone)."""
+        node_obj = (self.store.get_node(node)
+                    if self.store is not None else None)
+        if node_obj is None:
+            return None
+        for cond in node_obj.get("status", {}).get("conditions", []):
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return None
+
+    # --- evacuation ---
+
+    def evacuate(self, node: str, reason: str = "manual") -> dict:
+        """Evacuate one node (idempotent; also the POST
+        /recovery/evacuate/<node> manual path). Returns the evacuation
+        record."""
+        started = time.monotonic()
+        with trace.span("recovery.evacuate", node=node):
+            released = self._release_bookings(node)
+            intents = self._redrive_intents(node)
+            journals = self._redrive_migrations()
+            # Audit inside the span: the record must carry the
+            # evacuation's trace id (chaos invariant 6 — no trace-less
+            # audit records).
+            AUDIT.record(
+                "recovery.evacuate", actor="recovery-controller",
+                namespace="", pod="", outcome="evacuated", node=node,
+                reason=reason, released=len(released),
+                intents=[f"{ns}/{p}" for ns, p in intents],
+                migrations=journals)
+        record = {
+            "node": node,
+            "reason": reason or "manual",
+            "at": time.time(),
+            "released_bookings": released,
+            "redriven_intents": intents,
+            "redriven_migrations": journals,
+            "duration_s": round(time.monotonic() - started, 3),
+        }
+        with self._lock:
+            entry = self._nodes.setdefault(node, {"failures": 0,
+                                                  "first_failure_at": None})
+            entry["status"] = "evacuated"
+            entry["reason"] = reason
+            entry["evacuated_at"] = time.monotonic()
+            self._evacuations.append(record)
+            del self._evacuations[:-200]
+        NODES_EVACUATED.inc()
+        EVACUATED_BOOKINGS.inc(float(len(released)))
+        EVACUATED_INTENTS.inc(float(len(intents)))
+        logger.warning(
+            "node %s EVACUATED (%s): released %d booking(s), re-drove "
+            "%d intent(s) + %d migration journal(s)", node, reason,
+            len(released), len(intents), len(journals))
+        return record
+
+    def _release_bookings(self, node: str) -> list[str]:
+        """Delete every pool-namespace pod on the dead node: slave pods
+        (their chips are stranded on dead hardware; the booking blocks
+        nothing but bookkeeping) and warm holders (the refiller on the
+        replacement worker restocks). Deleting an already-deleted pod
+        no-ops, so replaying an evacuation cannot double-free."""
+        pods = (self.store.list_pool_pods(node)
+                if self.store is not None else [])
+        released = []
+        for pod_json in pods:
+            name = Pod(pod_json).name
+            try:
+                self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                     grace_period_seconds=0)
+                released.append(name)
+            except NotFoundError:
+                pass
+            except Exception as exc:  # noqa: BLE001 — keep releasing
+                logger.warning("evacuation delete of %s failed: %s",
+                               name, exc)
+        return released
+
+    def _redrive_intents(self, node: str) -> list[tuple[str, str]]:
+        """Every elastic intent whose pod sat on the dead node gets
+        re-enqueued (and an Event): when its workload controller
+        reschedules the pod, the reconciler converges it on the new
+        node via the normal allocator/warm-pool path."""
+        if self.elastic is None:
+            return []
+        try:
+            intents = self.elastic.store.list()
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("evacuation intent list failed: %s", exc)
+            return []
+        affected: list[tuple[str, str]] = []
+        for namespace, pod_name, intent in intents:
+            try:
+                pod = Pod(self.kube.get_pod(namespace, pod_name))
+            except Exception:  # noqa: BLE001 — gone or unreadable: skip
+                continue
+            if pod.node_name != node:
+                continue
+            affected.append((namespace, pod_name))
+            self.elastic.enqueue(namespace, pod_name,
+                                 priority=intent.priority)
+            from gpumounter_tpu.k8s.events import post_pod_event
+            post_pod_event(
+                self.kube, pod, "TPUNodeEvacuated",
+                f"node {node} confirmed dead and evacuated; this pod's "
+                f"chip intent (desired={intent.desired_chips}) will "
+                f"re-converge once the pod is rescheduled on a healthy "
+                f"node", event_type="Warning",
+                component="tpumounter-recovery")
+        return affected
+
+    def _redrive_migrations(self) -> list[str]:
+        if self.migrations is None:
+            return []
+        try:
+            return self.migrations.resume_interrupted()
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("evacuation migration re-drive failed: %s", exc)
+            return []
+
+    # --- the /recovery payload ---
+
+    def payload(self) -> dict:
+        with self._lock:
+            nodes = {
+                node: {
+                    "status": entry.get("status", "healthy"),
+                    "consecutiveFailures": entry.get("failures", 0),
+                    "reason": entry.get("reason", ""),
+                }
+                for node, entry in sorted(self._nodes.items())}
+            evacuations = list(self._evacuations)
+        return {
+            "nodes": nodes,
+            "evacuations": evacuations,
+            "config": {
+                "intervalS": self.cfg.recovery_interval_s,
+                "confirmFailures": self.cfg.recovery_confirm_failures,
+                "graceS": self.cfg.recovery_grace_s,
+            },
+        }

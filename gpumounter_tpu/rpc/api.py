@@ -43,6 +43,13 @@ class AddTPURequest(Message):
     # "<trace_id>-<span_id>") so worker-side spans join the trace minted
     # at the master HTTP edge; the worker tolerates absent/malformed
     # values (legacy or buggy peers) by starting a fresh trace.
+    # Field 8 is the fencing epoch (recovery plane): masters stamp the
+    # node's monotonic epoch (bumped on shard takeover) on every
+    # mutating RPC; the worker persists the highest seen and rejects
+    # older non-zero epochs FENCED — closing the split-brain window
+    # where a partitioned old shard owner mutates a node the new owner
+    # already manages. 0 (the proto3 default, i.e. legacy/unsharded
+    # masters) never fences.
     # Wire-compatible: legacy peers skip the unknown fields and see
     # reference semantics.
     FIELDS = [
@@ -53,6 +60,7 @@ class AddTPURequest(Message):
         Field(5, "prefer_ici", "bool"),
         Field(6, "idempotency_key", "string"),
         Field(7, "trace_context", "string"),
+        Field(8, "epoch", "int64"),
     ]
 
 
@@ -74,8 +82,9 @@ class RemoveTPURequest(Message):
     # mount type (the slice coordinator's remove path). Field 6 mirrors
     # AddTPURequest: a retried remove whose first attempt landed answers
     # Success from the worker's idempotency record. Field 7 mirrors
-    # AddTPURequest's trace context. Wire-compatible — legacy peers skip
-    # the unknown fields and see reference semantics.
+    # AddTPURequest's trace context; field 8 its fencing epoch.
+    # Wire-compatible — legacy peers skip the unknown fields and see
+    # reference semantics.
     FIELDS = [
         Field(1, "pod_name", "string"),
         Field(2, "namespace", "string"),
@@ -84,6 +93,7 @@ class RemoveTPURequest(Message):
         Field(5, "remove_all", "bool"),
         Field(6, "idempotency_key", "string"),
         Field(7, "trace_context", "string"),
+        Field(8, "epoch", "int64"),
     ]
 
 

@@ -34,6 +34,7 @@ from gpumounter_tpu.rpc.resilience import (
     BreakerOpenError,
     CircuitBreaker,
     DeadlineExceededError,
+    FencedError,
     RetryPolicy,
     WorkerUnavailableError,
 )
@@ -206,6 +207,16 @@ class ChannelPool:
         with self._lock:
             return {"live": len(self._channels), "dialed": self._dialed,
                     "closed": self._closed}
+
+def _grpc_details(exc: Exception) -> str:
+    details = getattr(exc, "details", None)
+    if callable(details):
+        try:
+            return str(details() or "")
+        except Exception:  # noqa: BLE001 — non-grpc .details() callables
+            return ""
+    return ""
+
 
 _TOKEN_FROM_CONFIG = object()  # sentinel: resolve from global config
 
@@ -407,16 +418,29 @@ class WorkerClient:
             return WorkerUnavailableError(
                 f"{method} to {self.address}: worker unavailable ({exc})",
                 self.address, method)
+        if code == "FAILED_PRECONDITION":
+            # Epoch fencing rejections travel as FAILED_PRECONDITION with
+            # a "FENCED:" detail prefix (worker/server.py). Typed so
+            # callers (and never the retry loop — application errors are
+            # not retriable here) can distinguish "my shard view is
+            # stale" from a policy rejection like CanMount.
+            detail = _grpc_details(exc)
+            if detail.startswith("FENCED"):
+                return FencedError(
+                    f"{method} to {self.address}: {detail}",
+                    self.address, method)
         return exc  # non-transport errors keep their original type
 
     # --- methods ---
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
                 is_entire_mount: bool = False,
-                timeout_s: float | None = None) -> api.AddTPUResult:
+                timeout_s: float | None = None,
+                epoch: int = 0) -> api.AddTPUResult:
         result, _ = self.add_tpu_detailed(pod_name, namespace, tpu_num,
                                           is_entire_mount,
-                                          timeout_s=timeout_s)
+                                          timeout_s=timeout_s,
+                                          epoch=epoch)
         return result
 
     def add_tpu_detailed(self, pod_name: str, namespace: str, tpu_num: int,
@@ -424,16 +448,21 @@ class WorkerClient:
                          prefer_ici: bool = False,
                          timeout_s: float | None = None,
                          idempotency_key: str | None = None,
+                         epoch: int = 0,
                          ) -> tuple[api.AddTPUResult, list[str]]:
         """(result, mounted device uuids) — uuids empty unless Success.
 
         One idempotency key covers the whole bounded-retry loop: a retry
         whose first attempt actually landed on the worker gets the
-        recorded response back instead of a second mount."""
+        recorded response back instead of a second mount.
+
+        epoch: the caller's fencing epoch for the target node (0 =
+        unfenced). A stale epoch raises FencedError — never retried."""
         request = api.AddTPURequest(
             pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
             is_entire_mount=is_entire_mount, prefer_ici=prefer_ici,
-            idempotency_key=idempotency_key or f"add-{secrets.token_hex(8)}")
+            idempotency_key=idempotency_key or f"add-{secrets.token_hex(8)}",
+            epoch=int(epoch))
         resp = self._call("AddTPU", self._add, request, timeout_s)
         return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
 
@@ -471,10 +500,12 @@ class WorkerClient:
                    force: bool = False,
                    remove_all: bool = False,
                    timeout_s: float | None = None,
-                   idempotency_key: str | None = None) -> api.RemoveTPUResult:
+                   idempotency_key: str | None = None,
+                   epoch: int = 0) -> api.RemoveTPUResult:
         request = api.RemoveTPURequest(
             pod_name=pod_name, namespace=namespace, uuids=list(uuids),
             force=force, remove_all=remove_all,
-            idempotency_key=idempotency_key or f"rm-{secrets.token_hex(8)}")
+            idempotency_key=idempotency_key or f"rm-{secrets.token_hex(8)}",
+            epoch=int(epoch))
         resp = self._call("RemoveTPU", self._remove, request, timeout_s)
         return api.RemoveTPUResult(resp.remove_tpu_result)

@@ -78,6 +78,19 @@ class BreakerOpenError(RpcCallError):
         self.retry_after_s = retry_after_s
 
 
+class FencedError(RpcCallError):
+    """The worker rejected a stale-epoch write (epoch fencing).
+
+    This caller's view of node ownership is behind: another master
+    replica has taken over the node's shard since this epoch was read.
+    NEVER retried by the transport layer — the correct response is to
+    refresh shard routing (the lease table) and let the current owner
+    drive the mutation, not to re-send the stale write."""
+
+    def __init__(self, message: str, address: str = "", method: str = ""):
+        super().__init__(message, "FENCED", address, method)
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """Capped exponential backoff between bounded attempts.

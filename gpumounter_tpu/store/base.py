@@ -81,6 +81,19 @@ class MasterStore(abc.ABC):
         when the source pod is gone (the journal has nothing to live
         on)."""
 
+    # --- recovery plane (node readiness + per-node pool bookings) ---
+
+    def get_node(self, node_name: str) -> dict | None:
+        """The Node object, or None when the backend has no node view
+        (non-cluster backends). Default: no view — the recovery
+        controller then confirms death from worker liveness alone."""
+        return None
+
+    def list_pool_pods(self, node_name: str) -> list[dict]:
+        """Every pool-namespace pod (slave + warm holders) placed on the
+        node — the bookings an evacuation must release. Default: none."""
+        return []
+
     # --- raw annotation stamps (phase/ack/lock markers) ---
 
     @abc.abstractmethod

@@ -106,6 +106,30 @@ class KubeMasterStore(MasterStore):
             attempts=self.cfg.k8s_write_attempts,
             base_s=self.cfg.k8s_write_retry_base_s)
 
+    # --- recovery plane ---
+
+    def get_node(self, node_name: str) -> dict | None:
+        from gpumounter_tpu.k8s.client import NotFoundError
+        try:
+            return self.kube.get_node(node_name)
+        except NotFoundError:
+            return None
+        except NotImplementedError:
+            return None
+        except Exception as exc:  # noqa: BLE001 — readiness is advisory
+            logger.warning("node read %s failed: %s", node_name, exc)
+            return None
+
+    def list_pool_pods(self, node_name: str) -> list[dict]:
+        try:
+            return self.kube.list_pods(
+                self.cfg.pool_namespace,
+                field_selector=f"spec.nodeName={node_name}")
+        except Exception as exc:  # noqa: BLE001 — evacuation retries
+            logger.warning("pool pod list for node %s failed: %s",
+                           node_name, exc)
+            return []
+
     # --- raw annotation stamps ---
 
     def stamp_annotation(self, namespace: str, pod_name: str,

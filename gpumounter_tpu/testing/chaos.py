@@ -32,7 +32,22 @@ invariants after convergence:
      master crashes, restarts, and lease takeovers, no shard — and
      therefore no node, since the hash ring maps each node to exactly
      one shard — is ever claimed by two replica views at once, and the
-     fleet converges back to every shard owned.
+     fleet converges back to every shard owned,
+ 10. ledger agreement (run_worker_crash_scenario): after a worker
+     crash at ANY seeded failpoint followed by restart + ledger replay
+     (worker/resync.py), books == mounts == ledger — no open
+     transactions survive, and the ledger's net holdings equal both
+     the injected nodes and the scheduler's bookings for every pod on
+     the node,
+ 11. evacuation re-convergence (run_node_kill_scenario): a killed node
+     (server dead, worker pod gone, Node NotReady) is confirmed and
+     evacuated by the recovery controller — its pool bookings
+     released — and every elastic intent stranded on it re-converges
+     on a healthy node once its pod is rescheduled,
+ 12. fencing (run_fencing_scenario): no stale-epoch write is ever
+     applied — a partitioned old shard owner's mutations are rejected
+     FENCED and provably change nothing, while the new owner's traffic
+     flows.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -124,12 +139,25 @@ class ChaosHarness:
             rpc_retry_base_s=0.02,
             rpc_retry_cap_s=0.1,
             k8s_write_retry_base_s=0.02,
+            # Recovery plane: fast confirmation so the node-kill
+            # scenario's detect->evacuate loop runs in test time.
+            recovery_confirm_failures=2,
+            recovery_grace_s=0.0,
+            recovery_probe_timeout_s=2.0,
             # High threshold: chaos injects isolated transport faults by
             # design; the breaker's own behavior has dedicated tests.
             breaker_failure_threshold=50)
         self.services: dict[str, TpuMountService] = {}
-        self._servers = []
+        self._servers: dict[str, object] = {}   # node -> live gRPC server
+        self._ip_by_node: dict[str, str] = {}
         self._port_by_ip: dict[str, int] = {}
+        #: nodes killed via kill_node (skipped by converge/invariants)
+        self.dead_nodes: set[str] = set()
+        #: run_worker_crash_scenario arms this so check_invariants also
+        #: asserts invariant 10 (ledger agreement) — the base scenarios
+        #: crash workers WITHOUT restarting them, so their ledgers
+        #: legitimately hold open txns at check time.
+        self.check_ledgers = False
         # Pooled channels, like the production master: the harness's
         # invariant 7 asserts the pool's books stay exact under chaos
         # (every dialed channel either live in the cache or closed).
@@ -140,6 +168,51 @@ class ChaosHarness:
 
     # --- lifecycle ---
 
+    def _build_node_service(self, name: str) -> TpuMountService:
+        """One node's worker stack: collector + mounter + durable
+        ledger (per-node dir under the harness root — building a second
+        service over the same dir IS the worker restart)."""
+        node_cfg = self.cluster.node_cfg(name, self.cfg).replace(
+            ledger_dir=os.path.join(self.root, f"ledger-{name}"))
+        node = self.cluster.node(name)
+        collector = TpuCollector(
+            backend=node.backend,
+            podresources=PodResourcesClient(node.kubelet_socket,
+                                            timeout_s=5.0),
+            cfg=node_cfg)
+        mounter = TpuMounter(node.backend, cfg=node_cfg,
+                             kube=self.cluster.kube)
+        dev_base = os.path.join(self.root, f"container-dev-{name}")
+        os.makedirs(dev_base, exist_ok=True)
+
+        def _resolver(pod, _base=dev_base):
+            d = os.path.join(_base, f"{pod.namespace}-{pod.name}")
+            os.makedirs(d, exist_ok=True)
+            return MountTarget(
+                dev_dir=d, description=f"{pod.namespace}/{pod.name}",
+                pod=pod)
+
+        mounter.resolve_target = _resolver
+        return TpuMountService(self.cluster.kube, collector=collector,
+                               mounter=mounter, cfg=node_cfg)
+
+    def _serve_node(self, name: str, service: TpuMountService) -> None:
+        server = build_server(service, address="localhost:0")
+        server.start()
+        old = self._servers.get(name)
+        self._servers[name] = server
+        self.services[name] = service
+        ip = self._ip_by_node[name]
+        old_port = self._port_by_ip.get(ip)
+        self._port_by_ip[ip] = server.bound_port
+        if old is not None:
+            # Production parity: a replaced worker's cached channel must
+            # not serve one more RPC (WorkerRegistry does this on
+            # address change; the harness maps ip->port itself).
+            old.stop(grace=None)
+            self.channel_pool.invalidate(f"localhost:{old_port}",
+                                         "worker-restart")
+
     def start(self) -> "ChaosHarness":
         # Per-scenario observability baseline: the closure invariants
         # (open spans, audit records) must judge THIS run only.
@@ -147,41 +220,16 @@ class ChaosHarness:
         AUDIT.reset()
         self.cluster.start()
         for i, name in enumerate(self.cluster.node_names):
-            node_cfg = self.cluster.node_cfg(name, self.cfg)
-            node = self.cluster.node(name)
-            collector = TpuCollector(
-                backend=node.backend,
-                podresources=PodResourcesClient(node.kubelet_socket,
-                                                timeout_s=5.0),
-                cfg=node_cfg)
-            mounter = TpuMounter(node.backend, cfg=node_cfg,
-                                 kube=self.cluster.kube)
-            dev_base = os.path.join(self.root, f"container-dev-{name}")
-            os.makedirs(dev_base, exist_ok=True)
-
-            def _resolver(pod, _base=dev_base):
-                d = os.path.join(_base, f"{pod.namespace}-{pod.name}")
-                os.makedirs(d, exist_ok=True)
-                return MountTarget(
-                    dev_dir=d, description=f"{pod.namespace}/{pod.name}",
-                    pod=pod)
-
-            mounter.resolve_target = _resolver
-            service = TpuMountService(self.cluster.kube,
-                                      collector=collector,
-                                      mounter=mounter, cfg=node_cfg)
-            server = build_server(service, address="localhost:0")
-            server.start()
-            self._servers.append(server)
-            ip = f"10.9.0.{i + 1}"
-            self._port_by_ip[ip] = server.bound_port
-            self.services[name] = service
+            self._ip_by_node[name] = f"10.9.0.{i + 1}"
+            self.cluster.kube.create_node(name, ready=True)
+            self._serve_node(name, self._build_node_service(name))
             self.cluster.kube.create_pod(self.cfg.worker_namespace, {
                 "metadata": {"name": f"chaos-worker-{name}",
                              "namespace": self.cfg.worker_namespace,
                              "labels": {"app": "tpu-mounter-worker"}},
                 "spec": {"nodeName": name, "containers": [{"name": "w"}]},
-                "status": {"phase": "Running", "podIP": ip},
+                "status": {"phase": "Running",
+                           "podIP": self._ip_by_node[name]},
             })
 
         def client_factory(address: str):
@@ -196,14 +244,48 @@ class ChaosHarness:
                                                      self.cfg))
         return self
 
+    def restart_worker(self, name: str) -> dict:
+        """Simulate a worker crash + restart on one node: abandon the
+        old process's ledger fd (no clean-shutdown marker), rebuild the
+        whole service over the same ledger dir, run the startup replay,
+        and serve on a fresh port. Returns the replay summary."""
+        from gpumounter_tpu.worker.resync import LedgerResync
+        old = self.services[name]
+        if old.ledger is not None:
+            old.ledger.abandon()
+        service = self._build_node_service(name)
+        summary = LedgerResync(service).replay_once()
+        self._serve_node(name, service)
+        self.record(f"restart worker {name}: replay {summary}")
+        return summary
+
+    def kill_node(self, name: str) -> None:
+        """Node death as the control plane sees it: the worker's gRPC
+        endpoint refuses, its pod is gone from the registry, and the
+        Node object goes NotReady. (The backing state — device dirs,
+        ledger — stays on disk, exactly like dead hardware.)"""
+        server = self._servers.pop(name, None)
+        if server is not None:
+            server.stop(grace=None)
+        self.channel_pool.invalidate(
+            f"localhost:{self._port_by_ip.get(self._ip_by_node[name])}",
+            "node-kill")
+        self.cluster.kube.delete_pod(self.cfg.worker_namespace,
+                                     f"chaos-worker-{name}")
+        self.cluster.kube.set_node_ready(name, False,
+                                         reason="KubeletStopped")
+        self.dead_nodes.add(name)
+        self.record(f"kill node {name}")
+
     def stop(self) -> None:
         failpoints.disarm_all()
         if self.app is not None:
+            self.app.recovery.stop()
             self.app.elastic.stop()
             self.app.migrations.stop()
             self.app.registry.stop()
         self.channel_pool.close_all()
-        for server in self._servers:
+        for server in self._servers.values():
             server.stop(grace=None)
         self.cluster.stop()
 
@@ -371,6 +453,161 @@ class ChaosHarness:
                 source, dest = dest, source  # ping-pong back
         self.converge()
 
+    # --- invariant 10: worker crash mid-batch + ledger replay ---
+
+    #: crash sites inside the worker's mount batch, i.e. the windows a
+    #: real worker process death can land in (ledger txn already open).
+    CRASH_SITES = [
+        ("worker.mount.before_grant", "1*crash(chaos worker death)"),
+        ("worker.mount.after_grant", "1*crash(chaos worker death)"),
+        ("worker.mount.mknod", "1*crash(chaos worker death)"),
+        ("worker.mount.mknod", "1*pass->1*crash(chaos worker death 2nd)"),
+    ]
+
+    def run_worker_crash_scenario(self, n_ops: int = 8) -> None:
+        """Seeded worker crashes inside mount batches, each followed by
+        a worker restart + ledger replay; interleaved with healthy
+        traffic. check_invariants() then also asserts invariant 10:
+        books == mounts == ledger on every node."""
+        from gpumounter_tpu.elastic.intents import Intent
+        from gpumounter_tpu.master.slice_ops import SliceTarget
+        self.check_ledgers = True
+        pods = [("default", "wc-a", NODE_A), ("default", "wc-b", NODE_B),
+                ("default", "wc-c", NODE_A)]
+        for ns, name, node in pods:
+            self.add_pod(name, node, namespace=ns)
+            desired = self.rng.randint(1, 2)
+            self.app.elastic.store.put(ns, name, Intent(
+                desired_chips=desired, min_chips=1))
+            self.record(f"intent {ns}/{name} desired={desired}")
+        for _ in range(n_ops):
+            ns, name, node = self.rng.choice(pods)
+            roll = self.rng.random()
+            if roll < 0.5:
+                # Crash the worker mid-batch, then restart + replay.
+                site, action = self.rng.choice(self.CRASH_SITES)
+                self.record(f"arm {site}={action}")
+                failpoints.arm(site, action)
+                n = self.rng.randint(1, 2)
+                try:
+                    self._coordinator().mount_slice(
+                        [SliceTarget(namespace=ns, pod=name)], n,
+                        entire=False)
+                except Exception as exc:  # noqa: BLE001 — the crash
+                    self.record(f"crash-mount {n} to {name} -> "
+                                f"{type(exc).__name__}")
+                else:
+                    self.record(f"crash-mount {n} to {name} -> ok "
+                                f"(fault unfired)")
+                finally:
+                    failpoints.disarm_all()
+                self.restart_worker(node)
+            elif roll < 0.75:
+                n = self.rng.randint(1, 2)
+                self._op([], f"add {n} to {name}",
+                         lambda t=SliceTarget(namespace=ns, pod=name),
+                         n=n: self._coordinator().mount_slice(
+                             [t], n, entire=False), fault_p=0.0)
+            else:
+                self._op([], f"reconcile {name}",
+                         lambda ns=ns, name=name:
+                         self.app.elastic.reconcile_once(ns, name),
+                         fault_p=0.0)
+        self.converge()
+
+    # --- invariant 11: node kill -> evacuation -> re-convergence ---
+
+    def run_node_kill_scenario(self, n_pods: int = 2) -> dict:
+        """Kill NODE_A under live intents: the recovery controller must
+        confirm and evacuate it (bookings released), and every stranded
+        intent must re-converge on NODE_B once its pod is rescheduled
+        there. Returns {"detect_passes", "evacuation", "reconverged"}."""
+        from gpumounter_tpu.elastic.intents import Intent
+        victims = []
+        for i in range(n_pods):
+            name = f"nk-{i}"
+            self.add_pod(name, NODE_A)
+            desired = self.rng.randint(1, 2)
+            self.app.elastic.store.put("default", name, Intent(
+                desired_chips=desired, min_chips=1))
+            victims.append((name, desired))
+            outcome = self.app.elastic.reconcile_once("default", name)
+            self.record(f"pre-kill {name}: {outcome.get('phase')} "
+                        f"desired={desired}")
+            if outcome.get("phase") != "converged":
+                raise InvariantViolation(
+                    f"pre-kill convergence failed for {name}: {outcome}")
+        self.add_pod("survivor", NODE_B)
+        self.app.elastic.store.put("default", "survivor",
+                                   Intent(desired_chips=1, min_chips=1))
+        self.app.elastic.reconcile_once("default", "survivor")
+
+        # Prime detection while the node is alive — the production
+        # controller loop runs continuously, so every node is tracked
+        # BEFORE it can die; a scenario that kills first would race the
+        # registry watch evicting the worker and never track the node.
+        primed = self.app.recovery.check_once()
+        if NODE_A not in self.app.recovery.payload()["nodes"]:
+            raise InvariantViolation(
+                f"recovery never tracked {NODE_A} while alive: {primed}")
+        self.kill_node(NODE_A)
+        # Detection loop: drive check_once until the controller commits.
+        passes = 0
+        evacuated = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not evacuated:
+            passes += 1
+            out = self.app.recovery.check_once()
+            evacuated = NODE_A in out["evacuated"]
+            if not evacuated:
+                time.sleep(0.05)
+        if not evacuated:
+            raise InvariantViolation(
+                f"node {NODE_A} never evacuated (seed={self.seed}); "
+                f"recovery state: {self.app.recovery.payload()}")
+        self.record(f"evacuated {NODE_A} after {passes} pass(es)")
+        # Bookings on the dead node are gone.
+        leftover = [Pod(p).name for p in self.cluster.kube.list_pods(
+            self.cfg.pool_namespace)
+            if Pod(p).node_name == NODE_A]
+        if leftover:
+            raise InvariantViolation(
+                f"evacuation left bookings on {NODE_A}: {leftover}")
+
+        # The workload controller reschedules each victim onto NODE_B
+        # (same name, fresh pod object); intents re-declared by the
+        # harness exactly like an annotation-carrying pod template.
+        reconverged = {}
+        for name, desired in victims:
+            self.cluster.kube.delete_pod("default", name)
+            self.add_pod(name, NODE_B)
+            self.app.elastic.store.put("default", name, Intent(
+                desired_chips=desired, min_chips=1))
+            deadline = time.monotonic() + 30.0
+            outcome: dict = {}
+            while time.monotonic() < deadline:
+                try:
+                    outcome = self.app.elastic.reconcile_once("default",
+                                                              name)
+                except Exception as exc:  # noqa: BLE001 — keep driving
+                    self.record(f"re-drive {name}: retrying ({exc})")
+                    time.sleep(0.05)
+                    continue
+                if outcome.get("phase") == "converged":
+                    break
+                time.sleep(0.05)
+            if outcome.get("phase") != "converged" \
+                    or outcome.get("actual") != desired:
+                raise InvariantViolation(
+                    f"evacuated intent default/{name} never re-converged "
+                    f"(seed={self.seed}): {outcome}")
+            reconverged[name] = outcome
+            self.record(f"re-converged {name} on {NODE_B}: "
+                        f"actual={outcome.get('actual')}")
+        return {"detect_passes": passes,
+                "evacuation": self.app.recovery.payload()["evacuations"],
+                "reconverged": reconverged}
+
     def _drive_to_terminal(self, mid: str, timeout_s: float = 30.0) -> None:
         """Wait out the machine; re-adopt after simulated master crashes
         (failpoints cleared first — the 'restarted master' is clean)."""
@@ -406,6 +643,9 @@ class ChaosHarness:
         except Exception:  # noqa: BLE001
             intents = []
         for namespace, pod_name, _intent in intents:
+            if self.pods.get((namespace, pod_name)) in self.dead_nodes:
+                continue  # stranded on a killed node: the node-kill
+                # scenario reschedules + re-converges these explicitly
             while time.monotonic() < deadline:
                 try:
                     outcome = self.app.elastic.reconcile_once(namespace,
@@ -538,9 +778,9 @@ class ChaosHarness:
                                        cfg=self.cfg)
             rollups.append(collector.collect_once())
         first, second = rollups
-        expected_nodes = set(self.services)
+        expected_nodes = set(self.services) - self.dead_nodes
         for which, rollup in (("first", first), ("second", second)):
-            if set(rollup["nodes"]) != expected_nodes:
+            if set(rollup["nodes"]) - self.dead_nodes != expected_nodes:
                 violations.append(
                     f"fleet rollup ({which}) nodes "
                     f"{sorted(rollup['nodes'])} != workers "
@@ -561,6 +801,37 @@ class ChaosHarness:
                     f"collector restart changed node {node} mount count "
                     f"{a} -> {b} (rollup not restart-stable)")
 
+        # 10. ledger agreement (armed by run_worker_crash_scenario):
+        # after crash+restart+replay at any failpoint, every node's
+        # ledger has no open transactions and its net holdings equal
+        # both the injected nodes and the scheduler's bookings.
+        if self.check_ledgers:
+            for node, service in self.services.items():
+                if node in self.dead_nodes or service.ledger is None:
+                    continue
+                open_txns = service.ledger.open_transactions()
+                if open_txns:
+                    violations.append(
+                        f"ledger on {node} left open txn(s) after "
+                        f"convergence: {[t['txn'] for t in open_txns]}")
+                holdings = service.ledger.net_holdings()
+                for key, node_of in self.pods.items():
+                    if node_of != node:
+                        continue
+                    ledger_view = holdings.get(key, set())
+                    if ledger_view != held[key]:
+                        violations.append(
+                            f"ledger/mounts disagree for "
+                            f"{key[0]}/{key[1]} on {node}: ledger "
+                            f"{sorted(ledger_view)} != mounted "
+                            f"{sorted(held[key])}")
+                    if ledger_view != booked[key]:
+                        violations.append(
+                            f"ledger/books disagree for "
+                            f"{key[0]}/{key[1]} on {node}: ledger "
+                            f"{sorted(ledger_view)} != booked "
+                            f"{sorted(booked[key])}")
+
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()
         if stats["dialed"] != stats["live"] + stats["closed"]:
@@ -578,6 +849,140 @@ class ChaosHarness:
                 f"chaos invariants violated (seed={self.seed}):\n- "
                 + "\n- ".join(violations)
                 + f"\nschedule tail:\n  {tail}")
+
+
+# --- invariant 12: stale-shard partition -> fencing (run standalone) ---
+
+def run_fencing_scenario(seed: int, n_stale_ops: int = 6) -> list[str]:
+    """Seeded stale-shard-partition chaos: a real worker (ledger on),
+    an old shard owner that keeps acting after losing its lease, and
+    the new owner's live traffic. Invariant: NO stale-epoch write is
+    ever applied — every ghost mutation raises FencedError and provably
+    changes nothing (bookings and mounted-node sets are compared around
+    each attempt), while the new owner's same-shaped traffic lands.
+    Raises InvariantViolation with the executed schedule on any breach.
+    """
+    import random as random_mod
+    import tempfile
+
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.master.shard import ShardManager
+    from gpumounter_tpu.rpc.resilience import FencedError
+
+    rng = random_mod.Random(seed)
+    schedule: list[str] = []
+
+    def record(event: str) -> None:
+        schedule.append(event)
+        logger.info("fencing-chaos[seed=%d] %s", seed, event)
+
+    def fail(message: str) -> None:
+        raise InvariantViolation(
+            f"invariant 12 violated (seed={seed}): {message}\n"
+            f"schedule:\n  " + "\n  ".join(schedule[-25:]))
+
+    with tempfile.TemporaryDirectory() as root:
+        cluster = FakeCluster(os.path.join(root, "cluster"),
+                              n_chips=6).start()
+        try:
+            node_cfg = cluster.node_cfg(cluster.node_name).replace(
+                ledger_dir=os.path.join(root, "ledger"))
+            collector = TpuCollector(
+                backend=cluster.backend,
+                podresources=PodResourcesClient(
+                    cluster.cfg.kubelet_socket, timeout_s=5.0),
+                cfg=node_cfg)
+            mounter = TpuMounter(cluster.backend, cfg=node_cfg)
+            dev_dir = os.path.join(root, "container-dev")
+            os.makedirs(dev_dir, exist_ok=True)
+            mounter.resolve_target = lambda pod: MountTarget(
+                dev_dir=dev_dir,
+                description=f"{pod.namespace}/{pod.name}", pod=pod)
+            service = TpuMountService(cluster.kube, collector=collector,
+                                      mounter=mounter, cfg=node_cfg)
+            server = build_server(service, address="localhost:0")
+            server.start()
+            address = f"localhost:{server.bound_port}"
+            cluster.add_target_pod("tenant")
+
+            lease_cfg = Config().replace(shard_count=1,
+                                         shard_lease_duration_s=0.3,
+                                         shard_preferred="")
+            node = cluster.node_name
+            old_owner = ShardManager(cluster.kube, cfg=lease_cfg,
+                                     replica_id="ghost",
+                                     advertise_url="http://ghost",
+                                     preferred=None).start_without_loop()
+            old_owner.acquire_once()
+            stale_epoch = old_owner.node_epoch(node)
+            if stale_epoch <= 0:
+                fail("old owner acquired no epoch")
+            with WorkerClient(address, cfg=node_cfg) as client:
+                result = client.add_tpu("tenant", "default", 1,
+                                        epoch=stale_epoch)
+                record(f"old owner mounted 1 chip at epoch "
+                       f"{stale_epoch} -> {result.name}")
+
+                # Partition: the ghost stops renewing but keeps acting.
+                # A new replica takes the lease over after expiry.
+                new_owner = ShardManager(
+                    cluster.kube, cfg=lease_cfg, replica_id="successor",
+                    advertise_url="http://successor",
+                    preferred=None).start_without_loop()
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline \
+                        and not new_owner.owned_shards():
+                    new_owner.acquire_once()
+                    time.sleep(0.05)
+                fresh_epoch = new_owner.node_epoch(node)
+                if fresh_epoch <= stale_epoch:
+                    fail(f"takeover epoch {fresh_epoch} not newer than "
+                         f"{stale_epoch}")
+                record(f"takeover: successor owns at epoch {fresh_epoch}")
+                # The new owner touches the node once: the worker now
+                # remembers the fresh epoch durably.
+                result = client.add_tpu("tenant", "default", 1,
+                                        epoch=fresh_epoch)
+                record(f"new owner mounted at epoch {fresh_epoch} "
+                       f"-> {result.name}")
+
+                def state() -> tuple[int, tuple[str, ...]]:
+                    return (cluster.free_chip_count(),
+                            tuple(sorted(os.listdir(dev_dir))))
+
+                for op_index in range(n_stale_ops):
+                    before = state()
+                    kind = rng.choice(["add", "remove"])
+                    try:
+                        if kind == "add":
+                            client.add_tpu("tenant", "default",
+                                           rng.randint(1, 2),
+                                           epoch=stale_epoch)
+                        else:
+                            client.remove_tpu("tenant", "default", [],
+                                              remove_all=True, force=True,
+                                              epoch=stale_epoch)
+                        fail(f"stale {kind} (epoch {stale_epoch}) was "
+                             f"APPLIED at op {op_index}")
+                    except FencedError:
+                        record(f"stale {kind} -> FENCED (op {op_index})")
+                    if state() != before:
+                        fail(f"stale {kind} changed node state at op "
+                             f"{op_index}: {before} -> {state()}")
+                    if rng.random() < 0.5:
+                        # Interleave live-owner traffic: fencing must be
+                        # selective, not a node lockdown.
+                        result = client.add_tpu("tenant", "default", 1,
+                                                epoch=fresh_epoch)
+                        record(f"new owner add -> {result.name}")
+                if service.ledger.epoch() != fresh_epoch:
+                    fail(f"worker persisted epoch "
+                         f"{service.ledger.epoch()} != {fresh_epoch}")
+            record("fencing held: no stale-epoch write applied")
+            server.stop(grace=None)
+        finally:
+            cluster.stop()
+    return schedule
 
 
 # --- invariant 9: single shard owner per node (master/shard.py) ---

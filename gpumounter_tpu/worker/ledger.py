@@ -1,0 +1,360 @@
+"""Durable worker mount ledger: an fsync'd append-only JSONL journal.
+
+The paper's core trick — granting devices behind the kubelet's back —
+means nobody but this worker can clean up after its own crash: kubelet
+restart-recovery never sees our grants, so a worker that dies mid-mount
+strands eBPF state, injected /dev/accel* nodes, and slave-pod bookings.
+The ledger closes that hole the way databases do (and the way CRIUgpu
+externalizes device state, PAPERS.md): every mutating batch writes an
+INTENT record before the first side effect and a DONE record after the
+last one, each appended and fsync'd to a hostPath JSONL file. A crash
+at any point leaves either nothing, or an open transaction naming
+exactly the chips, paths, cgroups and bookings in flight — which the
+restart replay (worker/resync.py) converges against ground truth.
+
+Record kinds (one JSON object per line):
+
+  txn       {"kind":"txn","txn":id,"op":"mount"|"unmount", target
+             identity (namespace/pod/uid), dev_dir/ns_pid/cgroup_dirs,
+             "chips":[{uuid,rel_path,major,minor,slave}], "at":ts}
+  done      {"kind":"done","txn":id,"outcome":...,"at":ts} — closes a
+             txn; outcomes: success / rolled-back / error / busy /
+             replayed-completed / replayed-rolled-back /
+             replayed-unmounted
+  epoch     {"kind":"epoch","epoch":N} — the highest fencing epoch this
+             worker has accepted (rpc epoch fencing; worker/server.py)
+  shutdown  {"kind":"shutdown"} — clean close marker (SIGTERM drain);
+             its absence on a non-empty ledger means the last process
+             crashed
+
+Rotation: the file is compacted (atomic tmp+rename) whenever it exceeds
+`ledger_max_bytes` — the rewrite keeps a `snapshot` record of net
+holdings (so books==mounts==ledger stays checkable across rotations),
+every still-open txn, and the epoch. See docs/FAQ.md.
+
+Thread safety: one lock around append+fsync; callers (the mounter's
+batch pipeline, the server's epoch checks, the drain path) may hit it
+from any gRPC thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("worker.ledger")
+
+LEDGER_FILE = "ledger.jsonl"
+
+LEDGER_APPENDS = REGISTRY.counter(
+    "tpumounter_ledger_appends_total",
+    "Ledger records appended (fsync'd), by record kind")
+LEDGER_OPEN_TXNS = REGISTRY.gauge(
+    "tpumounter_ledger_open_transactions",
+    "Mutating batches intent-logged but not yet closed")
+LEDGER_COMPACTIONS = REGISTRY.counter(
+    "tpumounter_ledger_compactions_total",
+    "Ledger rotations (rewrite to snapshot + open txns + epoch)")
+
+
+class LedgerError(RuntimeError):
+    pass
+
+
+def _chip_record(dev) -> dict:
+    return {"uuid": dev.uuid, "rel_path": dev.rel_path,
+            "major": dev.major, "minor": dev.minor,
+            "slave": dev.pod_name or ""}
+
+
+class MountLedger:
+    """One worker's durable mount journal (see module docstring)."""
+
+    def __init__(self, directory: str, max_bytes: int = 4 * 1024 * 1024,
+                 fsync: bool = True):
+        self.directory = directory
+        self.path = os.path.join(directory, LEDGER_FILE)
+        self.max_bytes = max(4096, int(max_bytes))
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._open_txns: dict[str, dict] = {}
+        #: net holdings after every CLOSED txn: (namespace, pod) ->
+        #: {uuid: chip record}. The books==mounts==ledger invariant
+        #: compares this against injected nodes and scheduler bookings.
+        self._holdings: dict[tuple[str, str], dict[str, dict]] = {}
+        self._epoch = 0
+        self._clean_shutdown = False
+        self._fd: int | None = None
+        os.makedirs(directory, exist_ok=True)
+        self._load()
+        self._fd = os.open(self.path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
+        LEDGER_OPEN_TXNS.set(float(len(self._open_txns)))
+
+    # --- load / replay-state ---
+
+    def _load(self) -> None:
+        """Rebuild open-txn / holdings / epoch state from the file. A
+        torn final line (crash mid-append) is dropped — the append
+        protocol writes intent records before side effects, so a torn
+        intent means the batch never started."""
+        if not os.path.exists(self.path):
+            return
+        dropped = 0
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    dropped += 1
+                    continue
+                self._apply(record)
+        if dropped:
+            logger.warning("ledger %s: dropped %d torn/corrupt line(s)",
+                           self.path, dropped)
+
+    def _apply(self, record: dict) -> None:
+        kind = record.get("kind")
+        if kind == "txn":
+            self._open_txns[record["txn"]] = record
+            self._clean_shutdown = False
+        elif kind == "done":
+            txn = self._open_txns.pop(record.get("txn", ""), None)
+            if txn is not None:
+                self._fold(txn, record.get("outcome", ""))
+            self._clean_shutdown = False
+        elif kind == "epoch":
+            self._epoch = max(self._epoch, int(record.get("epoch", 0)))
+        elif kind == "snapshot":
+            holdings: dict[tuple[str, str], dict[str, dict]] = {}
+            for entry in record.get("holdings", []):
+                key = (entry.get("namespace", ""), entry.get("pod", ""))
+                holdings[key] = {c["uuid"]: c
+                                 for c in entry.get("chips", [])}
+            self._holdings = holdings
+        elif kind == "shutdown":
+            self._clean_shutdown = True
+
+    def _fold(self, txn: dict, outcome: str) -> None:
+        """Apply one closed txn to the net-holdings view."""
+        key = (txn.get("namespace", ""), txn.get("pod", ""))
+        chips = {c["uuid"]: c for c in txn.get("chips", [])}
+        if txn.get("op") == "mount":
+            if outcome in ("success", "replayed-completed"):
+                self._holdings.setdefault(key, {}).update(chips)
+            # rolled-back / error / replayed-rolled-back: no net change
+        else:  # unmount
+            if outcome in ("success", "replayed-unmounted"):
+                held = self._holdings.get(key)
+                if held:
+                    for uuid in chips:
+                        held.pop(uuid, None)
+                    if not held:
+                        self._holdings.pop(key, None)
+
+    # --- append protocol ---
+
+    def _append(self, record: dict) -> None:
+        if self._fd is None:
+            raise LedgerError("ledger is closed")
+        data = (json.dumps(record, separators=(",", ":")) + "\n").encode()
+        os.write(self._fd, data)
+        if self.fsync:
+            os.fsync(self._fd)
+        if record.get("kind") != "shutdown":
+            self._clean_shutdown = False
+        LEDGER_APPENDS.inc(kind=record.get("kind", "?"))
+
+    def begin(self, op: str, *, target, devices, pod=None) -> str:
+        """Intent-log one mutating batch BEFORE its first side effect.
+        Returns the txn id the caller closes with commit()."""
+        txn_id = f"{op[0]}-{secrets.token_hex(5)}"
+        pod_obj = pod or getattr(target, "pod", None)
+        record = {
+            "kind": "txn", "txn": txn_id, "op": op,
+            "namespace": getattr(pod_obj, "namespace", "") if pod_obj
+            else "",
+            "pod": getattr(pod_obj, "name", "") if pod_obj else "",
+            "pod_uid": getattr(pod_obj, "uid", "") if pod_obj else "",
+            "target": getattr(target, "description", str(target)),
+            "dev_dir": getattr(target, "dev_dir", ""),
+            "ns_pid": getattr(target, "ns_pid", None),
+            "cgroup_dirs": list(getattr(target, "cgroup_dirs", []) or []),
+            "chips": [_chip_record(d) for d in devices],
+            "at": time.time(),
+        }
+        with self._lock:
+            self._append(record)
+            self._open_txns[txn_id] = record
+            LEDGER_OPEN_TXNS.set(float(len(self._open_txns)))
+        return txn_id
+
+    def commit(self, txn_id: str, outcome: str) -> None:
+        """Close a txn with its outcome. Idempotent on unknown ids (a
+        replay may close a txn the caller also tries to close)."""
+        with self._lock:
+            txn = self._open_txns.pop(txn_id, None)
+            if txn is None:
+                return
+            self._append({"kind": "done", "txn": txn_id,
+                          "outcome": outcome, "at": time.time()})
+            self._fold(txn, outcome)
+            LEDGER_OPEN_TXNS.set(float(len(self._open_txns)))
+            self._maybe_compact_locked()
+
+    def record_epoch(self, epoch: int) -> None:
+        """Persist the highest fencing epoch seen (monotonic; writes
+        only on increase, so steady traffic costs nothing)."""
+        epoch = int(epoch)
+        with self._lock:
+            if epoch <= self._epoch:
+                return
+            self._epoch = epoch
+            self._append({"kind": "epoch", "epoch": epoch})
+
+    def close(self) -> None:
+        """Clean shutdown: append the marker (drain finished all
+        in-flight batches first — worker/main.py) and close the fd.
+        Idempotent."""
+        with self._lock:
+            if self._fd is None:
+                return
+            try:
+                self._append({"kind": "shutdown", "at": time.time()})
+            finally:
+                os.close(self._fd)
+                self._fd = None
+                self._clean_shutdown = True
+
+    def abandon(self) -> None:
+        """Close the fd WITHOUT the clean-shutdown marker — the test
+        harness's 'process crashed' (a real crash just loses the fd).
+        Idempotent."""
+        with self._lock:
+            if self._fd is None:
+                return
+            os.close(self._fd)
+            self._fd = None
+
+    # --- reads (replay + invariants) ---
+
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def open_transactions(self) -> list[dict]:
+        """Txns intent-logged but never closed — the crash windows the
+        restart replay must converge."""
+        with self._lock:
+            return [dict(t) for t in self._open_txns.values()]
+
+    def was_clean_shutdown(self) -> bool:
+        with self._lock:
+            return self._clean_shutdown
+
+    def net_holdings(self) -> dict[tuple[str, str], set[str]]:
+        """(namespace, pod) -> chip uuids the ledger says are mounted
+        (closed successful mounts minus closed unmounts). The chaos
+        harness compares this with injected nodes and bookings."""
+        with self._lock:
+            return {key: set(chips)
+                    for key, chips in self._holdings.items() if chips}
+
+    def forget_holding(self, namespace: str, pod: str,
+                       uuids=None) -> None:
+        """Reconcile the holdings view against ground truth the ledger
+        never saw (e.g. the pod was deleted while the worker was down —
+        its nodes are gone without an unmount txn). Appends a synthetic
+        closed unmount so the correction is itself durable."""
+        with self._lock:
+            held = self._holdings.get((namespace, pod))
+            if not held:
+                return
+            drop = set(held) if uuids is None else set(uuids) & set(held)
+            if not drop:
+                return
+            txn_id = f"u-{secrets.token_hex(5)}"
+            record = {
+                "kind": "txn", "txn": txn_id, "op": "unmount",
+                "namespace": namespace, "pod": pod, "pod_uid": "",
+                "target": f"{namespace}/{pod}", "dev_dir": "",
+                "ns_pid": None, "cgroup_dirs": [],
+                "chips": [held[u] for u in sorted(drop)],
+                "at": time.time(),
+            }
+            self._append(record)
+            self._append({"kind": "done", "txn": txn_id,
+                          "outcome": "replayed-unmounted",
+                          "at": time.time()})
+            self._fold(record, "replayed-unmounted")
+
+    # --- compaction (rotation) ---
+
+    def _maybe_compact_locked(self) -> None:
+        try:
+            size = os.fstat(self._fd).st_size
+        except OSError:
+            return
+        if size <= self.max_bytes:
+            return
+        self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as snapshot + open txns + epoch, via
+        tmp+rename so a crash mid-compaction leaves either the old or
+        the new file, never a torn one."""
+        tmp = self.path + ".compact"
+        snapshot = {
+            "kind": "snapshot",
+            "holdings": [
+                {"namespace": ns, "pod": pod, "chips": list(chips.values())}
+                for (ns, pod), chips in self._holdings.items() if chips],
+            "at": time.time(),
+        }
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            lines = [snapshot]
+            if self._epoch:
+                lines.append({"kind": "epoch", "epoch": self._epoch})
+            lines.extend(self._open_txns.values())
+            payload = "".join(
+                json.dumps(r, separators=(",", ":")) + "\n"
+                for r in lines).encode()
+            os.write(fd, payload)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, self.path)
+        old_fd = self._fd
+        self._fd = os.open(self.path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
+        if old_fd is not None:
+            os.close(old_fd)
+        LEDGER_COMPACTIONS.inc()
+        logger.info("ledger %s compacted (%d open txn(s), %d pod "
+                    "holding(s))", self.path, len(self._open_txns),
+                    len(self._holdings))
+
+
+def open_ledger(cfg) -> MountLedger | None:
+    """The daemons' constructor: a ledger when cfg.ledger_dir is set and
+    writable, else None (in-memory-only epochs, no replay — the
+    pre-recovery shape). Never raises: an unwritable hostPath must not
+    stop the worker from serving."""
+    if not cfg.ledger_dir:
+        return None
+    try:
+        return MountLedger(cfg.ledger_dir, max_bytes=cfg.ledger_max_bytes)
+    except OSError as exc:
+        logger.warning("ledger unavailable at %s (%s); running without "
+                       "crash-replay", cfg.ledger_dir, exc)
+        return None

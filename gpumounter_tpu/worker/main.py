@@ -152,6 +152,17 @@ def main() -> None:
 
     kube = default_client()
     service = TpuMountService(kube, cfg=cfg)
+    # Ledger replay BEFORE serving and BEFORE the reaper's first sweep:
+    # a crash mid-mount left open transactions only this replay can
+    # converge (re-grant / delete half-mounted nodes / free bookings),
+    # and the reaper must see the post-replay books, not the torn ones.
+    if service.ledger is not None:
+        from gpumounter_tpu.worker.resync import LedgerResync
+        replay = LedgerResync(service).replay_once()
+        if not service.ledger.was_clean_shutdown() and replay["open"]:
+            logger.warning("previous worker process crashed; replay "
+                           "converged %d open transaction(s)",
+                           replay["open"])
     server = build_server(service)
     ops = serve_ops(cfg.metrics_port)
     reaper = SlaveReaper(
@@ -175,6 +186,12 @@ def main() -> None:
         # Warm holders stay Running — the restarted worker re-adopts
         # them (pool.ensure_node resync); only the refiller stops.
         service.pool.stop()
+    # Graceful drain: reject new mutations, let in-flight mount_many
+    # batches finish, then close the ledger with a clean-shutdown marker
+    # — so SIGTERM mid-batch is never mistaken for a crash on restart.
+    drained = service.drain(cfg.drain_timeout_s)
+    logger.info("drain %s; stopping gRPC",
+                "clean" if drained else "timed out (crash-equivalent)")
     server.stop(grace=5).wait()
     ops.shutdown()
 

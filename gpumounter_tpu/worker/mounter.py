@@ -112,12 +112,19 @@ class MountTarget:
 
 
 class TpuMounter:
-    def __init__(self, backend: DeviceBackend, cfg=None, kube=None):
+    def __init__(self, backend: DeviceBackend, cfg=None, kube=None,
+                 ledger=None):
         """kube: optional KubeClient — when given, a failed grant
         rollback is surfaced as a Warning Event on the target pod
-        (leaked grants must be operator-visible, not log-only)."""
+        (leaked grants must be operator-visible, not log-only).
+
+        ledger: optional worker.ledger.MountLedger — every mutating
+        batch is intent-logged before its first side effect and closed
+        after the last one, so a crash at any point leaves an open
+        transaction the restart replay (worker/resync.py) converges."""
         self.cfg = cfg or get_config()
         self.kube = kube
+        self.ledger = ledger
         self.backend = backend
         version = self.cfg.cgroup_version
         self.cgroup_version = (detect_cgroup_version(self.cfg.cgroup_root)
@@ -250,6 +257,12 @@ class TpuMounter:
         granted: list[tuple[str, TpuDevice]] = []
         injected: list[TpuDevice] = []
         uuids = ",".join(d.uuid for d in devices)
+        # Intent record BEFORE the first side effect: a crash anywhere in
+        # the batch leaves an open ledger txn naming exactly these chips,
+        # paths and cgroups — what the restart replay converges. A real
+        # crash (CrashError, or the process dying) never closes it.
+        txn = (self.ledger.begin("mount", target=target, devices=devices)
+               if self.ledger is not None else None)
         try:
             # Crash sites bracketing the grant: a worker dying here leaves
             # either nothing (before) or grants with no injected nodes
@@ -279,6 +292,11 @@ class TpuMounter:
             # caller's rollback is about to hand back to the scheduler.
             self._rollback_batch(target, granted, injected)
             MOUNT_TOTAL.inc(float(len(devices)), result="error")
+            if txn is not None:
+                # The rollback completed (or was deliberately skipped by
+                # the chaos failpoint — either way this process finished
+                # its undo pass): close the books.
+                self.ledger.commit(txn, "rolled-back")
             if isinstance(exc, MountError):
                 raise
             # Normalize lower-layer failures (CgroupError, BpfError,
@@ -288,6 +306,8 @@ class TpuMounter:
                 f"mount of {uuids} into {target.description}: "
                 f"{exc}") from exc
         MOUNT_TOTAL.inc(float(len(devices)), result="success")
+        if txn is not None:
+            self.ledger.commit(txn, "success")
         # Exemplar: the ambient trace id rides the latency bucket this
         # batch landed in, linking a histogram outlier straight to its
         # span tree (`tpumounter trace <id>`; served on OpenMetrics
@@ -482,6 +502,11 @@ class TpuMounter:
                 f"{dev.device_path} held by PIDs {holders} in "
                 f"{target.description}; use force (libtpu holds chips for "
                 "the life of the process)")
+        # Intent record after the read-only busy gate, before the first
+        # mutation — a crash mid-unmount leaves an open txn the restart
+        # replay completes (remove node, revoke grant, free booking).
+        txn = (self.ledger.begin("unmount", target=target, devices=[dev])
+               if self.ledger is not None else None)
         try:
             failpoints.fire("worker.unmount.before_revoke", device=dev.uuid,
                             target=target.description)
@@ -500,18 +525,26 @@ class TpuMounter:
                     # Reference kills via nsenter when forced (util.go:137-142)
                     nsutil.kill_pids_in_ns(holders, pid=target.ns_pid)
         except TpuBusyError:
+            if txn is not None:
+                self.ledger.commit(txn, "busy")
             raise
         except CrashError:
             UNMOUNT_TOTAL.inc(result="error")
             raise  # simulated process death: no wrapping, no cleanup
         except MountError:
             UNMOUNT_TOTAL.inc(result="error")
+            if txn is not None:
+                self.ledger.commit(txn, "error")
             raise
         except Exception as exc:
             UNMOUNT_TOTAL.inc(result="error")
+            if txn is not None:
+                self.ledger.commit(txn, "error")
             raise MountError(
                 f"unmount of {dev.uuid} from {target.description}: {exc}") from exc
         UNMOUNT_TOTAL.inc(result="success")
+        if txn is not None:
+            self.ledger.commit(txn, "success")
         for phase, seconds in timer.phases.items():
             PHASE_LATENCY.observe(seconds, phase=phase)
         summary = timer.summary_ms()

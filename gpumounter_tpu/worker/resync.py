@@ -1,0 +1,270 @@
+"""Ledger replay: converge a restarted worker against ground truth.
+
+A worker that crashed mid-`mount_many` leaves the node in one of the
+states its ledger (worker/ledger.py) brackets: nothing yet, grants with
+no injected nodes, some nodes injected, or everything done but the DONE
+record unwritten. Nobody else can clean this up — the grants live
+behind the kubelet's back. On startup the replacement worker replays
+every OPEN ledger transaction against three sources of ground truth:
+
+  * live cgroup/eBPF grant state — V2DeviceController.enumerate_grants
+    (the bpffs-pinned state that survives the crash) / whatever the
+    controller restored;
+  * injected device nodes — stat of the recorded target paths (through
+    the recorded namespace PID when its process still exists);
+  * the scheduler's books — the kubelet pod-resources view of which
+    slave pods still hold which chips.
+
+Convergence policy per open txn:
+
+  mount, bookings intact    the master was never answered, but the
+                            capacity is still booked to this pod —
+                            finish the mount forward (grant + mknod are
+                            idempotent) and close the txn
+                            `replayed-completed`; the pod gets the chips
+                            its books already pay for.
+  mount, bookings gone/torn undo: remove injected nodes, revoke grants,
+                            delete the txn's remaining slave bookings;
+                            close `replayed-rolled-back`. Books ==
+                            mounts == ledger again.
+  unmount (any)             finish forward: remove nodes, revoke
+                            grants, release the chips' slave bookings;
+                            close `replayed-unmounted` (an unmount that
+                            started was meant to happen).
+
+After the open txns, the ledger's NET holdings are reconciled: chips
+the ledger says a pod holds but the books no longer back (the pod was
+deleted during the outage) are forgotten with a durable correction
+record, so `ledger == books` holds even across events the dead worker
+never saw. The chaos harness proves the end state on every seeded crash
+site (books == mounts == ledger, tests/test_recovery_chaos.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.worker.mounter import MountTarget
+
+logger = get_logger("worker.resync")
+
+LEDGER_REPLAYS = REGISTRY.counter(
+    "tpumounter_ledger_replays_total",
+    "Open ledger transactions converged at worker startup, by outcome")
+
+
+def _live_pid(pid) -> int | None:
+    """The recorded namespace PID, only if that process still exists —
+    a recycled PID after reboot must not have nodes injected into it."""
+    if pid is None:
+        return None
+    try:
+        return int(pid) if os.path.exists(f"/proc/{int(pid)}") else None
+    except (TypeError, ValueError):
+        return None
+
+
+class LedgerResync:
+    """One-shot startup replay for a TpuMountService's ledger."""
+
+    def __init__(self, service):
+        self.service = service
+        self.ledger = service.ledger
+        self.mounter = service.mounter
+        self.collector = service.collector
+        self.allocator = service.allocator
+        self.kube = service.kube
+
+    # --- entry point ---
+
+    def replay_once(self) -> dict:
+        """Converge every open txn + reconcile net holdings. Returns a
+        summary dict (logged by worker/main.py). Never raises: a replay
+        failure leaves the txn open for the next restart rather than
+        stopping the worker from serving."""
+        summary = {"open": 0, "completed": [], "rolled_back": [],
+                   "unmounted": [], "holdings_corrected": 0}
+        if self.ledger is None:
+            return summary
+        try:
+            self.collector.update_status()
+        except Exception as exc:  # noqa: BLE001 — NOT best-effort here:
+            # without a trustworthy books view, "no bookings" and
+            # "kubelet unreachable" are indistinguishable, and replay
+            # would destructively roll back healthy mounts. Leave every
+            # txn open for the next restart instead.
+            logger.error("resync collector refresh failed (%s); replay "
+                         "deferred — open transactions left for the "
+                         "next restart", exc)
+            summary["open"] = len(self.ledger.open_transactions())
+            summary["deferred"] = True
+            return summary
+        open_txns = self.ledger.open_transactions()
+        summary["open"] = len(open_txns)
+        for txn in open_txns:
+            try:
+                outcome = (self._replay_mount(txn)
+                           if txn.get("op") == "mount"
+                           else self._replay_unmount(txn))
+            except Exception as exc:  # noqa: BLE001 — keep replaying
+                logger.error("replay of txn %s failed (%s); left open "
+                             "for the next restart", txn.get("txn"), exc)
+                continue
+            LEDGER_REPLAYS.inc(outcome=outcome)
+            key = {"replayed-completed": "completed",
+                   "replayed-rolled-back": "rolled_back",
+                   "replayed-unmounted": "unmounted"}[outcome]
+            summary[key].append(txn.get("txn"))
+        summary["holdings_corrected"] = self._reconcile_holdings()
+        if summary["open"] or summary["holdings_corrected"]:
+            logger.warning("ledger replay: %s", summary)
+        return summary
+
+    # --- ground truth ---
+
+    def _booked_uuids(self, namespace: str, pod_name: str) -> set[str]:
+        """Chips the scheduler's books say this pod owns (slave pods
+        included) — empty ONLY when the pod is provably gone. A
+        transient API/collector failure RAISES: "couldn't read the
+        books" must never be treated as "no bookings", because the
+        rollback path that decision feeds deletes a healthy tenant's
+        injected nodes and bookings (callers leave the txn open for the
+        next restart instead)."""
+        try:
+            pod = Pod(self.kube.get_pod(namespace, pod_name))
+        except NotFoundError:
+            return set()
+        slaves = {s.name for s in self.allocator.slave_pods_for(pod)}
+        devices = self.collector.get_pod_devices(
+            pod_name, namespace, slave_pod_names=slaves, refresh=False)
+        return {d.uuid for d in devices}
+
+    def _txn_devices(self, txn: dict) -> list:
+        devices = []
+        for chip in txn.get("chips", []):
+            dev = self.mounter.backend.device_by_uuid(chip["uuid"])
+            if dev is not None:
+                devices.append(dev)
+        return devices
+
+    def _txn_target(self, txn: dict) -> MountTarget:
+        return MountTarget(
+            dev_dir=txn.get("dev_dir") or "/dev",
+            cgroup_dirs=list(txn.get("cgroup_dirs") or []),
+            ns_pid=_live_pid(txn.get("ns_pid")),
+            description=txn.get("target") or
+            f"{txn.get('namespace')}/{txn.get('pod')}")
+
+    # --- convergence ---
+
+    def _replay_mount(self, txn: dict) -> str:
+        namespace, pod_name = txn.get("namespace", ""), txn.get("pod", "")
+        booked = self._booked_uuids(namespace, pod_name)
+        chips = txn.get("chips", [])
+        devices = self._txn_devices(txn)
+        if chips and booked >= {c["uuid"] for c in chips} \
+                and len(devices) == len(chips):
+            # Every chip is still booked to the pod: the crash ate the
+            # answer, not the allocation. Re-drive the mount — grant and
+            # mknod are idempotent, so whatever half landed is absorbed.
+            try:
+                pod = Pod(self.kube.get_pod(namespace, pod_name))
+                target = self.mounter.resolve_target(pod)
+                self.mounter.mount_many(target, devices)
+                self.ledger.commit(txn["txn"], "replayed-completed")
+                logger.warning(
+                    "replayed mount txn %s forward: %d chip(s) onto %s "
+                    "(bookings were intact)", txn["txn"], len(devices),
+                    target.description)
+                return "replayed-completed"
+            except Exception as exc:  # noqa: BLE001 — fall back to undo
+                logger.warning("forward replay of %s failed (%s); "
+                               "rolling back instead", txn["txn"], exc)
+        self._undo_mount(txn, devices)
+        self.ledger.commit(txn["txn"], "replayed-rolled-back")
+        return "replayed-rolled-back"
+
+    def _undo_mount(self, txn: dict, devices: list) -> None:
+        """Remove whatever landed, revoke whatever was granted, free the
+        txn's bookings — the books agree with the (empty) mounts after."""
+        from gpumounter_tpu.nsutil import ns as nsutil
+        target = self._txn_target(txn)
+        for dev in devices:
+            try:
+                nsutil.remove_device_file(target.dev_dir, dev,
+                                          pid=target.ns_pid)
+            except Exception as exc:  # noqa: BLE001
+                logger.error("replay node removal of %s failed: %s",
+                             dev.uuid, exc)
+        self._revoke_txn_grants(txn, devices)
+        self._release_txn_slaves(txn)
+
+    def _replay_unmount(self, txn: dict) -> str:
+        """An unmount that intent-logged was meant to happen: finish it."""
+        devices = self._txn_devices(txn)
+        self._undo_mount(txn, devices)
+        self.ledger.commit(txn["txn"], "replayed-unmounted")
+        return "replayed-unmounted"
+
+    def _revoke_txn_grants(self, txn: dict, devices: list) -> None:
+        """Revoke the txn's chips on its recorded cgroups — but only
+        where the controller's restored state actually shows a grant
+        (enumerate_grants), so a replay never double-revokes a cgroup
+        another pod's grant legitimately shares."""
+        controller = self.mounter.controller
+        enumerate_grants = getattr(controller, "enumerate_grants", None)
+        live = enumerate_grants() if enumerate_grants is not None else {}
+        for cg in txn.get("cgroup_dirs", []):
+            granted_here = live.get(cg)
+            for dev in devices:
+                if granted_here is not None \
+                        and (dev.major, dev.minor) not in granted_here:
+                    continue
+                try:
+                    controller.revoke(cg, dev)
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("replay grant revoke of %s on %s "
+                                 "failed: %s", dev.uuid, cg, exc)
+
+    def _release_txn_slaves(self, txn: dict) -> None:
+        slaves = sorted({c.get("slave") for c in txn.get("chips", [])
+                         if c.get("slave")})
+        if not slaves:
+            return
+        try:
+            self.allocator.delete_slave_pods(slaves, wait=False)
+            logger.info("replay released %d slave booking(s): %s",
+                        len(slaves), slaves)
+        except Exception as exc:  # noqa: BLE001 — reaper sweeps leftovers
+            logger.error("replay slave release failed (reaper will "
+                         "sweep): %s", exc)
+
+    # --- net-holdings reconciliation (ledger == books) ---
+
+    def _reconcile_holdings(self) -> int:
+        """Forget ledger holdings the books no longer back (pods deleted
+        while the worker was down take their injected nodes with them —
+        there was never an unmount txn to close them)."""
+        corrected = 0
+        for (namespace, pod_name), held in \
+                self.ledger.net_holdings().items():
+            try:
+                booked = self._booked_uuids(namespace, pod_name)
+            except Exception as exc:  # noqa: BLE001 — skip, don't forget
+                logger.warning("holdings check for %s/%s deferred "
+                               "(books unreadable: %s)", namespace,
+                               pod_name, exc)
+                continue
+            stale = held - booked
+            if stale:
+                self.ledger.forget_holding(namespace, pod_name, stale)
+                corrected += len(stale)
+                logger.warning(
+                    "ledger holdings corrected for %s/%s: %d chip(s) no "
+                    "longer booked (%s)", namespace, pod_name,
+                    len(stale), sorted(stale))
+        return corrected

@@ -41,9 +41,19 @@ from gpumounter_tpu.cgroup.ebpf import device_rule
 from gpumounter_tpu.nsutil import ns as nsutil
 from gpumounter_tpu.utils.lazy_grpc import grpc
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 from gpumounter_tpu.utils.timing import PhaseTimer
 
 logger = get_logger("worker.server")
+
+FENCED_WRITES = REGISTRY.counter(
+    "tpumounter_fenced_writes_total",
+    "Mutating RPCs rejected because they carried a stale fencing epoch "
+    "(a partitioned old shard owner trying to mutate this node)")
+SLAVE_RELEASE_FAILURES = REGISTRY.counter(
+    "tpumounter_slave_release_failures_total",
+    "Slave-pod releases that exhausted their bounded retry — leaked "
+    "capacity until the reaper or the recovery plane sweeps it")
 
 #: stamped by the tenant's jaxside.watch_migration hook after it packs
 #: (or restores) state; mirror of migrate.journal.ANNOT_ACK — the worker
@@ -134,10 +144,20 @@ class TpuMountService:
     def __init__(self, kube: KubeClient, collector: TpuCollector | None = None,
                  allocator: TpuAllocator | None = None,
                  mounter: TpuMounter | None = None, cfg=None,
-                 pool=None):
+                 pool=None, ledger=None):
         self.cfg = cfg or get_config()
         self.kube = kube
         self.collector = collector or TpuCollector(cfg=self.cfg)
+        # Durable mount ledger (worker/ledger.py): opened from
+        # cfg.ledger_dir unless the caller passes one (or a mounter that
+        # already carries one). None = no crash-replay, the pre-recovery
+        # shape.
+        if ledger is None and getattr(mounter, "ledger", None) is not None:
+            ledger = mounter.ledger
+        if ledger is None and self.cfg.ledger_dir:
+            from gpumounter_tpu.worker.ledger import open_ledger
+            ledger = open_ledger(self.cfg)
+        self.ledger = ledger
         # Warm slave-pod pool (allocator/pool.py): stocked only when
         # warm_pool_size > 0; pre-warms cfg.node_name at construction
         # when the DaemonSet passes it down. An explicit allocator=
@@ -152,13 +172,112 @@ class TpuMountService:
         self.allocator = allocator or TpuAllocator(kube, self.collector,
                                                    cfg=self.cfg, pool=pool)
         self.mounter = mounter or TpuMounter(self.collector.backend,
-                                             cfg=self.cfg, kube=kube)
+                                             cfg=self.cfg, kube=kube,
+                                             ledger=ledger)
+        if self.mounter.ledger is None and ledger is not None:
+            self.mounter.ledger = ledger  # explicit mounter, shared books
         # Per-pod (UID-keyed) serialization of the CanMount-gate →
         # allocate → mount / remove critical sections. Without it two
         # concurrent AddTPU(entire) calls can both observe MountType.NONE
         # and both mount (TOCTOU the reference shares, server.go:57).
         self._pod_locks = _KeyedLocks()
         self._idem = _IdempotencyCache()
+        # Epoch fencing (recovery plane): the highest epoch any master
+        # has stamped on a mutating RPC, persisted in the ledger so a
+        # worker restart cannot forget it. Writes carrying an older
+        # (non-zero) epoch are rejected FENCED — a partitioned old shard
+        # owner can no longer mutate a node its successor manages.
+        # Epoch 0 = unfenced legacy traffic (proto3 default), accepted.
+        self._epoch_lock = threading.Lock()
+        self._node_epoch = ledger.epoch() if ledger is not None else 0
+        # SIGTERM graceful drain: once draining, new mutating RPCs are
+        # rejected UNAVAILABLE (masters retry elsewhere/later) while
+        # in-flight batches run to completion — termination mid-batch
+        # must be distinguishable from a crash (the ledger closes clean).
+        self._draining = threading.Event()
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+
+    # --- epoch fencing + drain gates (shared by both mutating RPCs) ---
+
+    def _check_epoch(self, epoch: int, context: grpc.ServicerContext,
+                     method: str) -> None:
+        """Reject stale-epoch writes; accept-and-persist newer ones.
+        Epoch 0 (absent field / legacy or unsharded master) never
+        fences — back-compat with the paper's single-master shape."""
+        epoch = int(epoch or 0)
+        if epoch <= 0:
+            return
+        if self._draining.is_set():
+            # A mutation arriving after drain closed the ledger must get
+            # the drain answer, not a LedgerError-turned-UNKNOWN from
+            # the epoch persist below (server.stop's grace window still
+            # delivers RPCs for a few seconds after drain()).
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "worker draining (SIGTERM); retry elsewhere")
+        with self._epoch_lock:
+            if epoch < self._node_epoch:
+                stored = self._node_epoch
+            else:
+                stored = None
+                if epoch > self._node_epoch:
+                    self._node_epoch = epoch
+                    if self.ledger is not None:
+                        try:
+                            self.ledger.record_epoch(epoch)
+                        except Exception as exc:  # noqa: BLE001
+                            # Closed-by-drain race / disk error: the
+                            # in-memory bump still fences this process;
+                            # persistence catches up on the next write.
+                            logger.warning("epoch %d not persisted: %s",
+                                           epoch, exc)
+        if stored is not None:
+            FENCED_WRITES.inc()
+            logger.warning("%s FENCED: stale epoch %d < %d (partitioned "
+                           "old shard owner?)", method, epoch, stored)
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"FENCED: stale epoch {epoch} < {stored}; this node is "
+                f"owned by a newer master — refresh shard routing")
+
+    @contextlib.contextmanager
+    def _mutation(self, context: grpc.ServicerContext):
+        """Drain gate + in-flight accounting around every mutating op."""
+        with self._inflight_cv:
+            if self._draining.is_set():
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              "worker draining (SIGTERM); retry elsewhere")
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def drain(self, timeout_s: float = 20.0) -> bool:
+        """Begin draining: reject new mutations, wait for in-flight
+        batches, then close the ledger cleanly. Returns True when every
+        in-flight batch finished inside the timeout (the ledger then
+        carries a clean-shutdown marker and no open transactions of
+        ours)."""
+        self._draining.set()
+        deadline = time.monotonic() + timeout_s
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cv.wait(timeout=remaining)
+            clean = self._inflight == 0
+        if not clean:
+            logger.error("drain timed out with %d mutation(s) in flight; "
+                         "the ledger will show them open (crash-"
+                         "equivalent: replay converges them on restart)",
+                         self._inflight)
+        if self.ledger is not None and clean:
+            self.ledger.close()
+        return clean
 
     # --- AddTPU (reference: server.go:34-99) ---
 
@@ -183,6 +302,7 @@ class TpuMountService:
         timer = PhaseTimer()
         failpoints.fire("worker.rpc", method="AddTPU",
                         pod=request.pod_name)
+        self._check_epoch(request.epoch, context, "AddTPU")
         logger.info("AddTPU %s/%s num=%d entire=%s", request.namespace,
                     request.pod_name, request.tpu_num, request.is_entire_mount)
         if request.tpu_num <= 0:
@@ -203,7 +323,7 @@ class TpuMountService:
                 add_tpu_result=api.AddTPUResult.PodNotFound)
         key = (f"add:{request.idempotency_key}"
                if request.idempotency_key else "")
-        with self._pod_locks.held(pod.uid):
+        with self._mutation(context), self._pod_locks.held(pod.uid):
             # Re-check under the pod lock so a retry racing its original
             # waits for the first execution, then reads its answer.
             cached = self._idem.get(key)
@@ -432,6 +552,7 @@ class TpuMountService:
                        ) -> api.RemoveTPUResponse:
         failpoints.fire("worker.rpc", method="RemoveTPU",
                         pod=request.pod_name)
+        self._check_epoch(request.epoch, context, "RemoveTPU")
         logger.info("RemoveTPU %s/%s uuids=%s force=%s", request.namespace,
                     request.pod_name, request.uuids, request.force)
         # "rm:"-namespaced: a key reused across AddTPU/RemoveTPU must
@@ -446,7 +567,7 @@ class TpuMountService:
         except NotFoundError:
             return api.RemoveTPUResponse(
                 remove_tpu_result=api.RemoveTPUResult.PodNotFound)
-        with self._pod_locks.held(pod.uid):
+        with self._mutation(context), self._pod_locks.held(pod.uid):
             cached = self._idem.get(key)
             if cached is not None:
                 logger.info("RemoveTPU %s/%s replay (idempotency key %s): "
@@ -497,13 +618,13 @@ class TpuMountService:
             # Free what was already unmounted before the busy hit —
             # otherwise those chips stay revoked from the pod yet booked
             # to slave pods the reaper will never touch.
-            self._release_slaves_for(devices, unmounted)
+            self._release_slaves_for(devices, unmounted, pod)
             return api.RemoveTPUResponse(
                 remove_tpu_result=api.RemoveTPUResult.TPUBusy)
         except MountError as exc:
-            self._release_slaves_for(devices, unmounted)
+            self._release_slaves_for(devices, unmounted, pod)
             context.abort(grpc.StatusCode.INTERNAL, str(exc))
-        self._release_slaves_for(devices, unmounted)
+        self._release_slaves_for(devices, unmounted, pod)
         self._post_event(
             pod, "TPUUnmounted",
             f"hot-removed {len(unmounted)} TPU chip(s): "
@@ -521,12 +642,20 @@ class TpuMountService:
         post_pod_event(self.kube, pod, reason, message, event_type,
                        component="tpumounter-worker")
 
-    def _release_slaves_for(self, requested: list, unmounted: list) -> None:
+    def _release_slaves_for(self, requested: list, unmounted: list,
+                            pod: Pod | None = None) -> None:
         """Delete slave pods whose every requested chip was unmounted.
 
         A slave still holding a mounted chip (entire-mount partial failure)
         must keep its booking — deleting it would free chips the container
         still has kernel access to.
+
+        Release failures used to log and move on — a silent booking leak
+        (the chips stay booked to slave pods the reaper never touches,
+        because their owner still exists). Now: bounded retry, a
+        tpumounter_slave_release_failures_total counter that trips
+        alerting, and a TPUSlaveReleaseFailed Warning Event so the
+        leaked capacity is operator-visible and reapable by hand.
         """
         if not unmounted:
             return
@@ -534,15 +663,47 @@ class TpuMountService:
         by_slave: dict[str, list] = {}
         for dev in requested:
             by_slave.setdefault(dev.pod_name, []).append(dev)
-        releasable = [slave for slave, devs in by_slave.items()
-                      if all(d.uuid in unmounted_keys for d in devs)]
+        releasable = sorted(slave for slave, devs in by_slave.items()
+                            if all(d.uuid in unmounted_keys for d in devs))
         if not releasable:
             return
-        try:
-            self.allocator.delete_slave_pods(sorted(releasable))
-        except SlavePodError as exc:
-            logger.error("slave pod release failed (capacity stays booked "
-                         "until retry/reap): %s", exc)
+        attempts = max(1, int(self.cfg.slave_release_attempts))
+        last_exc: Exception | None = None
+        for attempt in range(1, attempts + 1):
+            try:
+                self.allocator.delete_slave_pods(releasable)
+                return
+            except SlavePodError as exc:
+                last_exc = exc
+                logger.warning("slave pod release attempt %d/%d failed: "
+                               "%s", attempt, attempts, exc)
+                if attempt < attempts:
+                    time.sleep(min(0.1 * 2 ** (attempt - 1), 2.0))
+        # Count and name only what ACTUALLY leaked: a partial failure
+        # (two of three deleted, one stuck) must not alert operators
+        # with 3x the real leaked capacity.
+        leaked = []
+        for name in releasable:
+            try:
+                self.kube.get_pod(self.cfg.pool_namespace, name)
+                leaked.append(name)
+            except NotFoundError:
+                pass  # released after all (delete landed, wait timed out)
+            except Exception:  # noqa: BLE001 — unknown: assume leaked
+                leaked.append(name)
+        if not leaked:
+            return
+        SLAVE_RELEASE_FAILURES.inc(float(len(leaked)))
+        logger.error("slave pod release failed after %d attempt(s); "
+                     "%d booking(s) stay leaked until reaped: %s",
+                     attempts, len(leaked), last_exc)
+        if pod is not None:
+            self._post_event(
+                pod, "TPUSlaveReleaseFailed",
+                f"could not release {len(leaked)} slave pod(s) "
+                f"({', '.join(leaked)}) after unmount: {last_exc}; "
+                f"their chip bookings are leaked until deleted manually "
+                f"or swept by the recovery plane", "Warning")
 
 
 def _bearer_interceptor(token: str):

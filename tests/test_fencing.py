@@ -1,0 +1,202 @@
+"""Epoch fencing (ISSUE 8 tentpole 2): wire field, worker-side
+rejection, ledger persistence across worker restart, and the shard
+manager's monotonic epoch source.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.master.shard import ShardManager
+from gpumounter_tpu.rpc import api
+from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.rpc.resilience import FencedError
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.server import TpuMountService, build_server
+
+
+# --- wire ---
+
+
+def test_epoch_rides_the_wire():
+    request = api.AddTPURequest(pod_name="p", namespace="ns", tpu_num=1,
+                                epoch=7)
+    decoded = api.AddTPURequest.decode(request.encode())
+    assert decoded.epoch == 7
+    removed = api.RemoveTPURequest.decode(
+        api.RemoveTPURequest(pod_name="p", namespace="ns",
+                             uuids=["u"], epoch=9).encode())
+    assert removed.epoch == 9
+
+
+def test_epoch_absent_decodes_to_zero():
+    decoded = api.AddTPURequest.decode(
+        api.AddTPURequest(pod_name="p", namespace="ns", tpu_num=1).encode())
+    assert decoded.epoch == 0
+
+
+# --- worker-side fencing ---
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = FakeCluster(str(tmp_path / "cluster"), n_chips=4).start()
+    yield c
+    c.stop()
+
+
+def _service(cluster, tmp_path):
+    cfg = cluster.cfg.replace(ledger_dir=str(tmp_path / "ledger"))
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir(exist_ok=True)
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cfg.kubelet_socket, timeout_s=5.0),
+        cfg=cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev),
+        description=f"{pod.namespace}/{pod.name}", pod=pod)
+    return TpuMountService(cluster.kube, collector=collector,
+                           mounter=mounter, cfg=cfg)
+
+
+@pytest.fixture()
+def worker(cluster, tmp_path):
+    service = _service(cluster, tmp_path)
+    server = build_server(service, address="localhost:0")
+    server.start()
+    yield f"localhost:{server.bound_port}", service
+    server.stop(grace=None)
+
+
+def test_stale_epoch_is_fenced(cluster, worker):
+    addr, service = worker
+    cluster.add_target_pod("trainer")
+    with WorkerClient(addr) as client:
+        assert client.add_tpu("trainer", "default", 1, epoch=3) == \
+            api.AddTPUResult.Success
+        booked_before = cluster.free_chip_count()
+        # A partitioned old shard owner with epoch 2: rejected, typed,
+        # and NOTHING mutated.
+        with pytest.raises(FencedError):
+            client.add_tpu("trainer", "default", 1, epoch=2)
+        assert cluster.free_chip_count() == booked_before
+        with pytest.raises(FencedError):
+            client.remove_tpu("trainer", "default", [], remove_all=True,
+                              force=True, epoch=2)
+        # The current owner (same epoch) and newer owners keep working.
+        assert client.add_tpu("trainer", "default", 1, epoch=3) == \
+            api.AddTPUResult.Success
+        assert client.add_tpu("trainer", "default", 1, epoch=4) == \
+            api.AddTPUResult.Success
+
+
+def test_epoch_zero_never_fences(cluster, worker):
+    """Legacy/unsharded masters send no epoch (decodes 0): accepted even
+    after a fenced epoch was recorded — the paper's single-master shape
+    keeps working unchanged."""
+    addr, _ = worker
+    cluster.add_target_pod("trainer")
+    with WorkerClient(addr) as client:
+        assert client.add_tpu("trainer", "default", 1, epoch=5) == \
+            api.AddTPUResult.Success
+        assert client.add_tpu("trainer", "default", 1) == \
+            api.AddTPUResult.Success
+
+
+def test_epoch_survives_worker_restart(cluster, tmp_path):
+    """The highest seen epoch is persisted in the ledger: a restarted
+    worker still fences the stale master."""
+    service = _service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+
+    class _Ctx:
+        aborted = None
+
+        def abort(self, code, details):
+            self.aborted = (code, details)
+            raise RuntimeError(details)
+
+    service.add_tpu(api.AddTPURequest(pod_name="trainer",
+                                      namespace="default", tpu_num=1,
+                                      epoch=6), _Ctx())
+    assert service.ledger.epoch() == 6
+    service.ledger.close()
+
+    restarted = _service(cluster, tmp_path)
+    assert restarted.ledger.epoch() == 6
+    ctx = _Ctx()
+    with pytest.raises(RuntimeError, match="FENCED"):
+        restarted.add_tpu(api.AddTPURequest(
+            pod_name="trainer", namespace="default", tpu_num=1,
+            epoch=5), ctx)
+
+
+# --- the master-side epoch source ---
+
+
+def test_shard_epoch_bumps_on_takeover():
+    cfg = Config().replace(shard_count=1, shard_lease_duration_s=0.3,
+                           shard_preferred="")
+    kube = FakeKubeClient()
+    first = ShardManager(kube, cfg=cfg, replica_id="rep-0",
+                         advertise_url="http://a", preferred=None)
+    first.start_without_loop()
+    first.acquire_once()
+    assert first.owns_node("node-x")
+    epoch_one = first.node_epoch("node-x")
+    assert epoch_one == 1
+
+    # rep-0 crashes (stops renewing); rep-1 takes over after expiry.
+    second = ShardManager(kube, cfg=cfg, replica_id="rep-1",
+                          advertise_url="http://b", preferred=None)
+    second.start_without_loop()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not second.owned_shards():
+        second.acquire_once()
+        time.sleep(0.05)
+    assert second.owned_shards() == {0}
+    assert second.node_epoch("node-x") > epoch_one
+
+
+def test_unsharded_epoch_is_zero():
+    manager = ShardManager(FakeKubeClient(), cfg=Config())
+    assert manager.node_epoch("any-node") == 0  # inactive: unfenced
+
+
+def test_partitioned_owner_loses_claim_and_lease(
+        ):
+    """The split-brain setup fencing exists for, end to end on the fake:
+    an API-partitioned owner (fake.set_partitioned) can no longer renew
+    — its local claim self-expires — while its already-issued epoch is
+    the one workers fence out once the successor writes a newer one."""
+    cfg = Config().replace(shard_count=1, shard_lease_duration_s=0.3,
+                           shard_preferred="")
+    kube = FakeKubeClient()
+    owner = ShardManager(kube, cfg=cfg, replica_id="rep-0",
+                         advertise_url="http://a",
+                         preferred=None).start_without_loop()
+    owner.acquire_once()
+    assert owner.owned_shards() == {0}
+    kube.set_partitioned(True)
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and owner.owned_shards():
+            owner.acquire_once()  # renew fails 503; claim self-expires
+            time.sleep(0.05)
+        assert owner.owned_shards() == set()
+        # Crucially the lost owner KEEPS stamping its last-held (stale)
+        # epoch: degrading to 0 would make its in-flight mutations read
+        # as unfenced legacy traffic the worker accepts — the exact
+        # split-brain write fencing exists to reject.
+        assert owner.node_epoch("node-x") == 1
+    finally:
+        kube.set_partitioned(False)

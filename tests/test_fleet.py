@@ -8,6 +8,7 @@ trace exemplars, and the e2e acceptance storm.
 
 from __future__ import annotations
 
+import itertools
 import json
 import re
 import threading
@@ -770,16 +771,27 @@ def test_payload_single_flight_collects_once(test_config):
 def test_slo_engine_concurrent_ingest_and_evaluate():
     """The collector thread ingests while /slo request threads evaluate:
     no 'deque mutated during iteration', and the breach transition fires
-    exactly once across concurrent evaluators."""
+    exactly once across concurrent evaluators.
+
+    The ingested counters GROW each pass (constant 100% bad ratio): with
+    static cumulative values, a run outlasting the 1 s fast window makes
+    the burn legitimately flap (window delta 0 -> recovered -> breach
+    again), and each re-breach correctly emits — which is not the
+    double-emission race this test is about."""
     kube = _FakeKube()
     eng = SloEngine(cfg=_slo_cfg(), kube=kube)
-    bad = _rollup(count=10, buckets=[(0.05, 0), (0.1, 10)], success=10)
     errors = []
+    tick = itertools.count(10)
+    tick_lock = threading.Lock()
 
     def ingester():
         try:
             for _ in range(300):
-                eng.ingest(bad)
+                with tick_lock:
+                    n = next(tick)
+                eng.ingest(_rollup(count=n,
+                                   buckets=[(0.05, 0), (0.1, n)],
+                                   success=n))
         except Exception as exc:  # noqa: BLE001
             errors.append(exc)
 

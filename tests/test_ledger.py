@@ -1,0 +1,320 @@
+"""Durable worker mount ledger + startup replay (ISSUE 8 tentpole 1).
+
+Unit half: the append/commit protocol, crash-state reload, epoch
+persistence, compaction (rotation) keeping net holdings + open txns,
+torn-line tolerance. Integration half: a real TpuMountService over the
+FakeCluster whose mount crashes at seeded failpoints, then a "restarted"
+service with the same ledger dir replays and converges — books ==
+mounts == ledger after every crash site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TpuCollector
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.faults.failpoints import CrashError
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.worker.ledger import MountLedger, open_ledger
+from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+from gpumounter_tpu.worker.resync import LedgerResync
+from gpumounter_tpu.worker.server import TpuMountService
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+class _Dev:
+    def __init__(self, uuid, major=240, minor=0, slave=""):
+        self.uuid = uuid
+        self.rel_path = uuid
+        self.major = major
+        self.minor = minor
+        self.pod_name = slave
+
+
+class _Target:
+    description = "default/pod-a"
+    dev_dir = "/tmp/dev"
+    ns_pid = None
+    cgroup_dirs = []
+
+    class pod:  # noqa: N801 — duck-typed Pod identity
+        namespace = "default"
+        name = "pod-a"
+        uid = "uid-1"
+
+
+# --- unit: the journal itself ---
+
+
+def test_begin_commit_roundtrip(tmp_path):
+    ledger = MountLedger(str(tmp_path))
+    txn = ledger.begin("mount", target=_Target(),
+                       devices=[_Dev("accel0", slave="s0")])
+    assert [t["txn"] for t in ledger.open_transactions()] == [txn]
+    ledger.commit(txn, "success")
+    assert ledger.open_transactions() == []
+    assert ledger.net_holdings() == {("default", "pod-a"): {"accel0"}}
+
+
+def test_crash_state_survives_reload(tmp_path):
+    ledger = MountLedger(str(tmp_path))
+    done = ledger.begin("mount", target=_Target(), devices=[_Dev("accel0")])
+    ledger.commit(done, "success")
+    open_txn = ledger.begin("mount", target=_Target(),
+                            devices=[_Dev("accel1")])
+    # No close() — the crash. A fresh instance sees the same books.
+    reloaded = MountLedger(str(tmp_path))
+    assert [t["txn"] for t in reloaded.open_transactions()] == [open_txn]
+    assert reloaded.net_holdings() == {("default", "pod-a"): {"accel0"}}
+    assert not reloaded.was_clean_shutdown()
+
+
+def test_clean_shutdown_marker(tmp_path):
+    ledger = MountLedger(str(tmp_path))
+    txn = ledger.begin("mount", target=_Target(), devices=[_Dev("accel0")])
+    ledger.commit(txn, "success")
+    ledger.close()
+    reloaded = MountLedger(str(tmp_path))
+    assert reloaded.was_clean_shutdown()
+    assert reloaded.open_transactions() == []
+
+
+def test_unmount_folds_out_of_holdings(tmp_path):
+    ledger = MountLedger(str(tmp_path))
+    ledger.commit(ledger.begin("mount", target=_Target(),
+                               devices=[_Dev("accel0"), _Dev("accel1")]),
+                  "success")
+    ledger.commit(ledger.begin("unmount", target=_Target(),
+                               devices=[_Dev("accel0")]), "success")
+    assert ledger.net_holdings() == {("default", "pod-a"): {"accel1"}}
+    # rolled-back mounts never enter holdings
+    ledger.commit(ledger.begin("mount", target=_Target(),
+                               devices=[_Dev("accel2")]), "rolled-back")
+    assert ledger.net_holdings() == {("default", "pod-a"): {"accel1"}}
+
+
+def test_epoch_is_persistent_and_monotonic(tmp_path):
+    ledger = MountLedger(str(tmp_path))
+    ledger.record_epoch(3)
+    ledger.record_epoch(2)  # never regresses
+    assert ledger.epoch() == 3
+    assert MountLedger(str(tmp_path)).epoch() == 3
+
+
+def test_torn_final_line_is_dropped(tmp_path):
+    ledger = MountLedger(str(tmp_path))
+    txn = ledger.begin("mount", target=_Target(), devices=[_Dev("accel0")])
+    ledger.commit(txn, "success")
+    path = ledger.path
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind":"txn","txn":"torn-')  # crash mid-append
+    reloaded = MountLedger(str(tmp_path))
+    assert reloaded.open_transactions() == []
+    assert reloaded.net_holdings() == {("default", "pod-a"): {"accel0"}}
+
+
+def test_compaction_preserves_state(tmp_path):
+    ledger = MountLedger(str(tmp_path), max_bytes=4096)
+    for i in range(40):  # enough traffic to cross the threshold
+        txn = ledger.begin("mount", target=_Target(),
+                           devices=[_Dev(f"accel{i % 4}")])
+        ledger.commit(txn, "success")
+    open_txn = ledger.begin("mount", target=_Target(),
+                            devices=[_Dev("accel9")])
+    ledger.record_epoch(7)
+    # Force one more commit to trigger compaction past the threshold.
+    ledger.commit(ledger.begin("mount", target=_Target(),
+                               devices=[_Dev("accel3")]), "success")
+    assert os.path.getsize(ledger.path) < 4096 * 4
+    reloaded = MountLedger(str(tmp_path))
+    assert reloaded.epoch() == 7
+    assert [t["txn"] for t in reloaded.open_transactions()] == [open_txn]
+    held = reloaded.net_holdings()[("default", "pod-a")]
+    assert {"accel0", "accel1", "accel2", "accel3"} <= held
+    # The rewrite is valid JSONL throughout (no torn lines).
+    with open(ledger.path, encoding="utf-8") as f:
+        for line in f:
+            json.loads(line)
+
+
+def test_open_ledger_disabled_and_unwritable(tmp_path):
+    from gpumounter_tpu.config import Config
+    assert open_ledger(Config().replace(ledger_dir="")) is None
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file, not a dir")
+    assert open_ledger(Config().replace(
+        ledger_dir=str(blocked))) is None  # degrades, never raises
+
+
+# --- integration: crash mid-mount, restart, replay converges ---
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = FakeCluster(str(tmp_path / "cluster"), n_chips=4).start()
+    yield c
+    c.stop()
+
+
+def _build_service(cluster, tmp_path):
+    """A worker service whose ledger + container dir live under
+    tmp_path — building it twice models a worker restart."""
+    cfg = cluster.cfg.replace(ledger_dir=str(tmp_path / "ledger"))
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir(exist_ok=True)
+    collector = TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cfg.kubelet_socket, timeout_s=5.0),
+        cfg=cfg)
+    mounter = TpuMounter(cluster.backend, cfg=cfg)
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev),
+        description=f"{pod.namespace}/{pod.name}", pod=pod)
+    service = TpuMountService(cluster.kube, collector=collector,
+                              mounter=mounter, cfg=cfg)
+    assert service.ledger is not None
+    assert service.mounter.ledger is service.ledger
+    return service, str(container_dev)
+
+
+def _books(service, cluster, name="trainer", namespace="default"):
+    pod = Pod(cluster.kube.get_pod(namespace, name))
+    service.collector.update_status()
+    slaves = {s.name for s in service.allocator.slave_pods_for(pod)}
+    return {d.uuid for d in service.collector.get_pod_devices(
+        name, namespace, slave_pod_names=slaves, refresh=False)}
+
+
+def _mounted(container_dev):
+    return {n for n in os.listdir(container_dev) if n.startswith("accel")}
+
+
+def _grpc_mount(service, cluster, n=2):
+    """Drive AddTPU through the business logic directly (no transport)."""
+    from gpumounter_tpu.rpc import api
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise RuntimeError(f"abort {code}: {details}")
+
+    return service.add_tpu(
+        api.AddTPURequest(pod_name="trainer", namespace="default",
+                          tpu_num=n), _Ctx())
+
+
+@pytest.mark.parametrize("crash_site", [
+    "worker.mount.before_grant",
+    "worker.mount.after_grant",
+])
+def test_crash_mid_mount_replay_converges(cluster, tmp_path, crash_site):
+    """Crash the mount at a seeded failpoint, 'restart' the worker
+    (fresh service, same ledger dir), replay — and the three books
+    agree: ledger has no open txns, and chips visible in the container
+    match chips the scheduler still books for the pod."""
+    service, container_dev = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    failpoints.arm(crash_site, "1*crash(ledger-test)")
+    with pytest.raises(CrashError):
+        _grpc_mount(service, cluster, n=2)
+    assert len(service.ledger.open_transactions()) == 1
+    service.ledger.close()  # release the fd only; NOT a clean drain state
+
+    restarted, container_dev = _build_service(cluster, tmp_path)
+    summary = LedgerResync(restarted).replay_once()
+    assert summary["open"] == 1
+    assert restarted.ledger.open_transactions() == []
+    books = _books(restarted, cluster)
+    mounted = _mounted(container_dev)
+    ledger_view = restarted.ledger.net_holdings().get(
+        ("default", "trainer"), set())
+    # books == mounts == ledger: whatever the replay decided (forward
+    # completion when bookings survived, rollback otherwise), all three
+    # agree afterwards.
+    assert books == ledger_view
+    assert {d.rel_path for d in cluster.backend.list_devices()
+            if d.uuid in books} == mounted
+
+
+def test_replay_completes_forward_when_booked(cluster, tmp_path):
+    """after_grant crash: the slave bookings survived the crash, so the
+    replay finishes the mount forward — the pod gets the chips its
+    books already pay for."""
+    service, container_dev = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    failpoints.arm("worker.mount.after_grant", "1*crash(ledger-test)")
+    with pytest.raises(CrashError):
+        _grpc_mount(service, cluster, n=2)
+    # Bookings landed before the crash (slave pods were created).
+    assert cluster.free_chip_count() == 2
+    service.ledger.close()
+
+    restarted, container_dev = _build_service(cluster, tmp_path)
+    summary = LedgerResync(restarted).replay_once()
+    assert summary["completed"], summary
+    assert len(_mounted(container_dev)) == 2
+    assert len(_books(restarted, cluster)) == 2
+
+
+def test_replay_rolls_back_when_bookings_gone(cluster, tmp_path):
+    """If the pod (and its bookings) vanished during the outage, replay
+    rolls the half-mount back and the ledger forgets the holdings."""
+    service, container_dev = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    _grpc_mount(service, cluster, n=2)  # a completed mount first
+    failpoints.arm("worker.mount.after_grant", "1*crash(ledger-test)")
+    cluster.add_target_pod("other")
+
+    from gpumounter_tpu.rpc import api
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise RuntimeError(f"abort {code}: {details}")
+
+    with pytest.raises(CrashError):
+        service.add_tpu(api.AddTPURequest(
+            pod_name="other", namespace="default", tpu_num=1), _Ctx())
+    service.ledger.close()
+    # The outage: both pods get deleted (workload torn down).
+    cluster.kube.delete_pod("default", "other")
+    cluster.kube.delete_pod("default", "trainer")
+
+    restarted, container_dev = _build_service(cluster, tmp_path)
+    summary = LedgerResync(restarted).replay_once()
+    assert summary["rolled_back"], summary
+    assert summary["holdings_corrected"] >= 2  # trainer's closed mounts
+    assert restarted.ledger.net_holdings() == {}
+    assert restarted.ledger.open_transactions() == []
+
+
+def test_sigterm_drain_closes_clean(cluster, tmp_path):
+    """The SIGTERM path: drain() finishes in-flight work, closes the
+    ledger with the clean marker, and rejects new mutations."""
+    service, _ = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    _grpc_mount(service, cluster, n=1)
+    assert service.drain(timeout_s=5.0)
+    reloaded = open_ledger(service.cfg)
+    assert reloaded.was_clean_shutdown()
+    assert reloaded.open_transactions() == []
+
+    from gpumounter_tpu.rpc import api
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise RuntimeError(f"abort:{code}:{details}")
+
+    with pytest.raises(RuntimeError, match="draining"):
+        service.add_tpu(api.AddTPURequest(
+            pod_name="trainer", namespace="default", tpu_num=1), _Ctx())

@@ -1,0 +1,446 @@
+"""Recovery controller (ISSUE 8 tentpole 3) + the reaper-vs-recovery
+interaction satellite.
+
+Detection discipline: a node is evacuated only on confirmed death
+(consecutive probe failures + grace + NotReady-or-worker-gone
+corroboration); a crashed worker on a Ready node is never evacuated.
+Evacuation releases pool bookings, re-drives intents and migration
+journals, and is idempotent against the worker-side recovery actors
+(SlaveReaper, warm-pool resync, ledger replay) racing it.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.elastic.intents import Intent
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.master.app import WorkerRegistry
+from gpumounter_tpu.recovery import RecoveryController
+from gpumounter_tpu.rpc.resilience import WorkerUnavailableError
+from gpumounter_tpu.store import KubeMasterStore
+
+NODE = "recovery-node-a"
+OTHER = "recovery-node-b"
+
+
+class _StubClientFactory:
+    """Liveness-probe stand-in: addresses in `dead` refuse, the rest
+    answer (any answer = alive)."""
+
+    def __init__(self):
+        self.dead: set[str] = set()
+        self.probes: list[str] = []
+
+    def __call__(self, address):
+        factory = self
+
+        class _Client:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+            def close(self):
+                pass
+
+            def collect_telemetry(self, timeout_s=None):
+                factory.probes.append(address)
+                if address in factory.dead:
+                    raise WorkerUnavailableError("refused", address,
+                                                 "CollectTelemetry")
+                return type("R", (), {"telemetry": "{}"})()
+
+        return _Client()
+
+
+class _ElasticStub:
+    def __init__(self, intents):
+        self._intents = intents
+        self.enqueued: list[tuple[str, str]] = []
+        self.store = self
+
+    def list(self):
+        return self._intents
+
+    def enqueue(self, namespace, pod, priority=0):
+        self.enqueued.append((namespace, pod))
+
+
+class _MigrationsStub:
+    def __init__(self):
+        self.resumes = 0
+
+    def resume_interrupted(self):
+        self.resumes += 1
+        return []
+
+
+@pytest.fixture()
+def stack():
+    cfg = Config().replace(recovery_confirm_failures=2,
+                           recovery_grace_s=0.0,
+                           recovery_probe_timeout_s=1.0)
+    kube = FakeKubeClient()
+    for node, ip in ((NODE, "10.0.0.1"), (OTHER, "10.0.0.2")):
+        kube.create_node(node, ready=True)
+        kube.create_pod(cfg.worker_namespace, {
+            "metadata": {"name": f"w-{node}",
+                         "namespace": cfg.worker_namespace,
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": node, "containers": [{"name": "w"}]},
+            "status": {"phase": "Running", "podIP": ip}})
+    registry = WorkerRegistry(kube, cfg)
+    factory = _StubClientFactory()
+    elastic = _ElasticStub([])
+    migrations = _MigrationsStub()
+    controller = RecoveryController(
+        kube, registry, factory, cfg=cfg,
+        store=KubeMasterStore(kube, cfg), elastic=elastic,
+        migrations=migrations)
+    yield kube, cfg, registry, factory, controller, elastic, migrations
+    registry.stop()
+
+
+def _addr(kube, cfg, node):
+    pod = kube.get_pod(cfg.worker_namespace, f"w-{node}")
+    return f"{pod['status']['podIP']}:{cfg.worker_port}"
+
+
+def test_healthy_nodes_stay_healthy(stack):
+    kube, cfg, registry, factory, controller, _, _ = stack
+    out = controller.check_once()
+    assert out["evacuated"] == []
+    payload = controller.payload()
+    assert payload["nodes"][NODE]["status"] == "healthy"
+    assert payload["evacuations"] == []
+
+
+def test_ready_node_with_dead_worker_is_never_evacuated(stack):
+    """A crashed worker on a Ready node is a worker problem — ledger
+    replay on its restart is the recovery, not evacuation."""
+    kube, cfg, registry, factory, controller, _, _ = stack
+    factory.dead.add(_addr(kube, cfg, NODE))
+    for _ in range(6):
+        out = controller.check_once()
+        assert out["evacuated"] == []
+    assert controller.payload()["nodes"][NODE]["status"] == "suspect"
+
+
+def test_confirmed_node_death_evacuates(stack):
+    kube, cfg, registry, factory, controller, elastic, migrations = stack
+    # Affected state on the dying node: two slave pods + one warm
+    # holder booked there, and a tenant pod with an elastic intent.
+    for name in ("t1-slave-pod-aa", "t1-slave-pod-bb", "warm-slave-cc"):
+        kube.create_pod(cfg.pool_namespace, {
+            "metadata": {"name": name, "namespace": cfg.pool_namespace,
+                         "labels": {"app": "tpu-pool"}},
+            "spec": {"nodeName": NODE, "containers": [{"name": "p"}]},
+            "status": {"phase": "Running"}})
+    kube.create_pod("default", {
+        "metadata": {"name": "tenant", "namespace": "default"},
+        "spec": {"nodeName": NODE, "containers": [{"name": "m"}]},
+        "status": {"phase": "Running"}})
+    elastic._intents = [("default", "tenant",
+                         Intent(desired_chips=2, min_chips=1))]
+
+    factory.dead.add(_addr(kube, cfg, NODE))
+    kube.set_node_ready(NODE, False, reason="KubeletStopped")
+    outcomes = [controller.check_once() for _ in range(3)]
+    evacuated = [n for out in outcomes for n in out["evacuated"]]
+    assert evacuated == [NODE]
+
+    # Bookings released, intent re-driven, journals re-scanned.
+    assert kube.list_pods(cfg.pool_namespace) == []
+    assert elastic.enqueued == [("default", "tenant")]
+    assert migrations.resumes >= 1
+    payload = controller.payload()
+    assert payload["nodes"][NODE]["status"] == "evacuated"
+    assert payload["evacuations"][0]["released_bookings"]
+    # TPUNodeEvacuated Event landed on the affected tenant pod.
+    reasons = [m.get("reason") for _, m in kube.events_posted]
+    assert "TPUNodeEvacuated" in reasons
+    # Healthy node untouched.
+    assert payload["nodes"][OTHER]["status"] == "healthy"
+    # Idempotent: another pass does not evacuate again.
+    assert controller.check_once()["evacuated"] == []
+
+
+def test_worker_gone_without_node_object_evacuates(stack):
+    """No Node view (non-cluster backend): confirmation rests on the
+    worker being gone from the registry."""
+    kube, cfg, registry, factory, controller, _, _ = stack
+    kube.delete_node(NODE)
+    registry.registry_snapshot()  # prime
+    controller.check_once()  # node tracked while worker alive
+    kube.delete_pod(cfg.worker_namespace, f"w-{NODE}")
+    import time
+    deadline = time.monotonic() + 5.0
+    evacuated = []
+    while time.monotonic() < deadline and not evacuated:
+        evacuated = controller.check_once()["evacuated"]
+        time.sleep(0.05)
+    assert evacuated == [NODE]
+
+
+def test_evacuated_node_coming_back_is_tracked_again(stack):
+    kube, cfg, registry, factory, controller, _, _ = stack
+    address = _addr(kube, cfg, NODE)
+    factory.dead.add(address)
+    kube.set_node_ready(NODE, False)
+    for _ in range(3):
+        controller.check_once()
+    assert controller.payload()["nodes"][NODE]["status"] == "evacuated"
+    factory.dead.discard(address)
+    kube.set_node_ready(NODE, True)
+    controller.check_once()
+    assert controller.payload()["nodes"][NODE]["status"] == "healthy"
+
+
+def test_sharded_controller_skips_unowned_nodes(stack):
+    kube, cfg, registry, factory, controller, _, _ = stack
+
+    class _Shards:
+        def active(self):
+            return True
+
+        def owns_node(self, node):
+            return node == OTHER
+
+    controller.shards = _Shards()
+    factory.dead.add(_addr(kube, cfg, NODE))
+    kube.set_node_ready(NODE, False)
+    for _ in range(4):
+        out = controller.check_once()
+        assert out["evacuated"] == []
+    assert NODE not in controller.payload()["nodes"]
+
+
+def test_api_partition_does_not_evacuate(stack):
+    """An API-partitioned master (fake.set_partitioned) loses its Node
+    readiness view (store.get_node degrades to None) while the worker
+    stays registered in its cache: insufficient evidence — the node
+    must stay suspect, never be evacuated on a partitioned view."""
+    kube, cfg, registry, factory, controller, _, _ = stack
+    registry.registry_snapshot()  # prime the cache pre-partition
+    factory.dead.add(_addr(kube, cfg, NODE))
+    kube.set_partitioned(True)
+    try:
+        for _ in range(5):
+            assert controller.check_once()["evacuated"] == []
+        assert controller.payload()["nodes"][NODE]["status"] == "suspect"
+    finally:
+        kube.set_partitioned(False)
+
+
+def test_correlated_failure_detection_is_parallel(stack):
+    """Many dead nodes must not serialize their probe timeouts: one
+    pass over N slow/dead workers is bounded by the pool width, not N
+    (a rack outage is exactly when detection speed matters)."""
+    import time as time_mod
+    kube, cfg, registry, factory, controller, _, _ = stack
+
+    slow_addresses = set()
+
+    class _SlowFactory:
+        def __call__(self, address):
+            class _Client:
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *exc):
+                    return False
+
+                def collect_telemetry(self, timeout_s=None):
+                    if address in slow_addresses:
+                        time_mod.sleep(0.3)  # a wedged worker's timeout
+                        from gpumounter_tpu.rpc.resilience import (
+                            WorkerUnavailableError,
+                        )
+                        raise WorkerUnavailableError("wedged", address,
+                                                     "CollectTelemetry")
+                    return object()
+
+            return _Client()
+
+    for i in range(12):
+        kube.create_node(f"rack-node-{i}", ready=True)
+        ip = f"10.1.0.{i + 1}"
+        slow_addresses.add(f"{ip}:{cfg.worker_port}")
+        kube.create_pod(cfg.worker_namespace, {
+            "metadata": {"name": f"w-rack-{i}",
+                         "namespace": cfg.worker_namespace,
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": f"rack-node-{i}",
+                     "containers": [{"name": "w"}]},
+            "status": {"phase": "Running", "podIP": ip}})
+    controller.client_factory = _SlowFactory()
+    started = time_mod.monotonic()
+    controller.check_once()
+    elapsed = time_mod.monotonic() - started
+    # Serial would be >= 12 * 0.3s = 3.6s; the 16-wide pool keeps one
+    # pass near a single probe's cost.
+    assert elapsed < 1.5, f"detection pass took {elapsed:.1f}s (serial?)"
+
+
+def test_evacuated_unregistered_node_is_pruned(stack):
+    """Autoscaler churn must not grow tracking forever: an evacuated
+    node whose worker never re-registers is dropped from the nodes
+    table after the retention window (the evacuation history stays)."""
+    kube, cfg, registry, factory, controller, _, _ = stack
+    kube.delete_pod(cfg.worker_namespace, f"w-{NODE}")
+    kube.delete_node(NODE)
+    registry.registry_snapshot()
+    controller.evacuate(NODE, reason="test")
+    controller.check_once()
+    assert controller.payload()["nodes"][NODE]["status"] == "evacuated"
+    # Age the entry past retention and run another pass.
+    with controller._lock:
+        controller._nodes[NODE]["evacuated_at"] -= \
+            controller.EVACUATED_RETENTION_S + 1
+    controller.check_once()
+    payload = controller.payload()
+    assert NODE not in payload["nodes"]
+    assert any(e["node"] == NODE for e in payload["evacuations"])
+
+
+# --- the /recovery HTTP surface ---
+
+
+def test_recovery_routes(stack):
+    import json
+
+    from tests.conftest import AUTH_HEADER
+
+    kube, cfg, registry, factory, controller, _, _ = stack
+    from gpumounter_tpu.master.app import MasterApp
+    app = MasterApp(kube, cfg=cfg, worker_client_factory=factory,
+                    registry=registry)
+    app.recovery = controller  # share the pre-wired stubs
+    status, _, body, _ = app.handle("GET", "/recovery", b"", AUTH_HEADER)
+    assert status == 200
+    payload = json.loads(body)
+    assert "nodes" in payload and "evacuations" in payload
+    # Unauthenticated read rejected (read scope).
+    status, _, _, _ = app.handle("GET", "/recovery", b"", {})
+    assert status == 401
+    # Manual evacuation: audited mutating route.
+    status, _, body, _ = app.handle(
+        "POST", f"/recovery/evacuate/{NODE}", b"", AUTH_HEADER)
+    assert status == 200
+    assert json.loads(body)["node"] == NODE
+    assert controller.payload()["nodes"][NODE]["status"] == "evacuated"
+    from gpumounter_tpu.obs.audit import AUDIT
+    ops = [r["operation"] for r in AUDIT.snapshot()]
+    assert "recovery.evacuate" in ops
+    assert "http.recovery_evacuate" in ops
+
+
+# --- satellite: reaper / warm-pool / replay vs evacuation ---
+
+
+def _pool_pod(kube, cfg, name, node, warm=False, owner=None):
+    labels = {"app": "tpu-pool"}
+    annotations = {}
+    if warm:
+        labels["tpumounter.io/warm"] = "true"
+    if owner is not None:
+        labels.update({"tpumounter.io/owner-uid": owner.get("uid", "u"),
+                       "tpumounter.io/owner": owner["name"],
+                       "tpumounter.io/owner-namespace": owner["ns"]})
+        annotations = {"tpumounter.io/owner": owner["name"],
+                       "tpumounter.io/owner-namespace": owner["ns"]}
+    kube.create_pod(cfg.pool_namespace, {
+        "metadata": {"name": name, "namespace": cfg.pool_namespace,
+                     "labels": labels, "annotations": annotations},
+        "spec": {"nodeName": node,
+                 "nodeSelector": {"kubernetes.io/hostname": node},
+                 "containers": [{"name": "p"}]},
+        "status": {"phase": "Running"}})
+
+
+def test_reaper_after_evacuation_no_double_free(stack):
+    """The evacuation released the node's pool pods; the (restarted)
+    worker's reaper pass over the same ground must be a no-op — not an
+    error, not a double delete of recreated capacity."""
+    from gpumounter_tpu.worker.reaper import SlaveReaper
+    kube, cfg, registry, factory, controller, _, _ = stack
+    _pool_pod(kube, cfg, "dead-slave", NODE,
+              owner={"name": "gone-owner", "ns": "default", "uid": "u1"})
+    controller.evacuate(NODE, reason="test")
+    assert kube.list_pods(cfg.pool_namespace) == []
+    deletes_after_evac = kube.delete_calls
+    reaper = SlaveReaper(kube, cfg=cfg)
+    assert reaper.reap_once() == []  # nothing left to reap, no error
+    assert kube.delete_calls == deletes_after_evac
+
+
+def test_warm_pool_does_not_readopt_evacuated_holders(stack):
+    """ensure_node's restart resync must not re-adopt warm holders the
+    evacuation controller already released."""
+    from gpumounter_tpu.allocator.pool import WarmPodPool
+    kube, cfg, registry, factory, controller, _, _ = stack
+    _pool_pod(kube, cfg, "warm-1", NODE, warm=True)
+    _pool_pod(kube, cfg, "warm-2", NODE, warm=True)
+    controller.evacuate(NODE, reason="test")
+    pool = WarmPodPool(kube, cfg=cfg.replace(warm_pool_size=2),
+                       refill_async=False)
+    pool.ensure_node(NODE)
+    assert pool.ready_count(NODE) == 0  # nothing stale re-adopted
+
+
+def test_replay_release_after_evacuation_is_idempotent(tmp_path, stack):
+    """Ledger replay deciding to roll back (and free bookings the
+    evacuation already deleted) must not crash or double-free."""
+    from gpumounter_tpu.worker.ledger import MountLedger
+    kube, cfg, registry, factory, controller, _, _ = stack
+    _pool_pod(kube, cfg, "txn-slave", NODE,
+              owner={"name": "tenant", "ns": "default", "uid": "u2"})
+    ledger = MountLedger(str(tmp_path))
+
+    class _Dev:
+        uuid = "accel0"
+        rel_path = "accel0"
+        major, minor = 240, 0
+        pod_name = "txn-slave"
+
+    class _Target:
+        description = "default/tenant"
+        dev_dir = str(tmp_path / "dev")
+        ns_pid = None
+        cgroup_dirs = []
+        pod = type("P", (), {"namespace": "default", "name": "tenant",
+                             "uid": "u2"})
+
+    ledger.begin("mount", target=_Target(), devices=[_Dev()])
+    controller.evacuate(NODE, reason="test")  # deletes txn-slave first
+
+    class _Alloc:
+        def delete_slave_pods(self, names, wait=True):
+            for name in names:
+                kube.delete_pod(cfg.pool_namespace, name)
+
+        def slave_pods_for(self, pod):
+            return []
+
+    class _Service:
+        pass
+
+    from gpumounter_tpu.device.backend import FakeDeviceBackend
+    from gpumounter_tpu.worker.mounter import TpuMounter
+    backend = FakeDeviceBackend.create(str(tmp_path / "fakedev"), 1)
+    service = _Service()
+    service.ledger = ledger
+    service.mounter = TpuMounter(backend, cfg=cfg)
+    service.collector = type(
+        "C", (), {"update_status": lambda self: None,
+                  "get_pod_devices": lambda self, *a, **k: []})()
+    service.allocator = _Alloc()
+    service.kube = kube
+
+    from gpumounter_tpu.worker.resync import LedgerResync
+    summary = LedgerResync(service).replay_once()
+    assert summary["rolled_back"]
+    assert ledger.open_transactions() == []

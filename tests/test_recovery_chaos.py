@@ -1,0 +1,83 @@
+"""Recovery-plane chaos suite (ISSUE 8 acceptance).
+
+Three scenario families over the seeded harness, three fixed seeds
+each:
+
+  worker-crash-mid-batch  crash the worker inside mount batches at
+                          seeded failpoints, restart + ledger replay —
+                          invariant 10: books == mounts == ledger.
+  node-kill               kill a node under live intents — invariant
+                          11: confirmed evacuation (bookings released)
+                          and every stranded intent re-converges on a
+                          healthy node.
+  stale-shard-partition   a ghost shard owner keeps mutating after its
+                          lease moved — invariant 12: no stale-epoch
+                          write is ever applied (FENCED, state
+                          unchanged), while the new owner's traffic
+                          flows.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.testing.chaos import (
+    NODE_A,
+    ChaosHarness,
+    InvariantViolation,
+    run_fencing_scenario,
+)
+
+SEEDS = [7, 1337, 20260803]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_worker_crash_chaos(tmp_path, seed):
+    with ChaosHarness(str(tmp_path), seed) as h:
+        h.run_worker_crash_scenario(n_ops=6)
+        h.check_invariants()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_node_kill_chaos(tmp_path, seed):
+    with ChaosHarness(str(tmp_path), seed) as h:
+        out = h.run_node_kill_scenario(n_pods=2)
+        assert out["evacuation"], "no evacuation recorded"
+        assert len(out["reconverged"]) == 2
+        h.check_invariants()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fencing_chaos(seed):
+    schedule = run_fencing_scenario(seed)
+    assert any("fencing held" in step for step in schedule)
+
+
+def test_worker_crash_scenario_detects_broken_replay(tmp_path):
+    """Negative control: a chaos suite that cannot fail proves nothing.
+    Crash a mount mid-batch and 'restart' WITHOUT the replay (the
+    ledger is carried over but never converged): invariant 10 must
+    flag the disagreement."""
+    from gpumounter_tpu.faults.failpoints import CrashError
+    from gpumounter_tpu.master.slice_ops import SliceError, SliceTarget
+    with ChaosHarness(str(tmp_path), seed=1) as h:
+        h.check_ledgers = True
+        h.add_pod("victim", NODE_A)
+        failpoints.arm("worker.mount.after_grant", "1*crash(negative)")
+        with pytest.raises((SliceError, CrashError)):
+            h._coordinator().mount_slice(
+                [SliceTarget(namespace="default", pod="victim")], 2,
+                entire=False)
+        failpoints.disarm_all()
+        with pytest.raises(InvariantViolation) as err:
+            h.check_invariants()
+        assert "ledger" in str(err.value)
+        assert "seed=1" in str(err.value)
