@@ -22,7 +22,11 @@ Two families of verbs:
     audit   [--pod POD] [--trace ID] [--op PREFIX]   the audit trail
     trace ID                       all buffered spans for one trace
     fleet                          federated per-node fleet rollup
-    slo                            SLO burn-rate evaluation
+                                   (stale nodes flagged on stderr)
+    slo                            SLO burn-rate evaluation with
+                                   per-objective fast/slow burn windows
+    tenants [--tenant T]           per-tenant disruption ledger: every
+                                   window attributed to a cause + trace
     shards                         shard -> owner replica table
     recovery [--evacuate NODE]     node-failure recovery plane: liveness
                                    verdicts + evacuation history
@@ -275,7 +279,65 @@ def cmd_trace(args) -> int:
 def cmd_fleet(args) -> int:
     status, body = _http(args, "GET", "/fleet", token=_obs_token(args))
     print(body.rstrip())
-    return 0 if status == 200 else 1
+    if status != 200:
+        return 1
+    try:
+        nodes = json.loads(body).get("nodes", {})
+    except ValueError:
+        return 1
+    # Flag stale entries loudly (stderr keeps stdout parseable JSON):
+    # a stale node's numbers describe the LAST successful collect, and
+    # before stale_age_s they were indistinguishable from fresh ones.
+    for name in sorted(nodes):
+        entry = nodes[name]
+        if entry.get("stale"):
+            age = entry.get("stale_age_s")
+            when = (f"last collected {age}s ago" if age is not None
+                    else "NEVER collected successfully")
+            print(f"STALE: node {name} {when} "
+                  f"({entry.get('error', 'unreachable')})",
+                  file=sys.stderr)
+    return 0
+
+
+def cmd_tenants(args) -> int:
+    """The per-tenant disruption ledger (GET /tenants): every window a
+    tenant's training loop felt, attributed to its cause and joined to
+    its control-plane trace. Exit 3 when any disruption window is still
+    open — scriptable like `tpumounter slo`."""
+    status, body = _http(args, "GET", "/tenants", token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return 1
+    tenants = payload.get("tenants", {})
+    if args.tenant:
+        tenants = {k: v for k, v in tenants.items() if k == args.tenant}
+        if not tenants:
+            print(f"error: no tenant {args.tenant!r} in the ledger",
+                  file=sys.stderr)
+            return 2
+    open_windows = 0
+    for name in sorted(tenants):
+        entry = tenants[name]
+        dis = entry.get("disruption", {})
+        open_windows += len(dis.get("open", []))
+        causes = ", ".join(
+            f"{cause}: {agg.get('windows', 0)}x p95 "
+            f"{agg.get('p95_ms', 0)}ms"
+            for cause, agg in sorted(dis.get("by_cause", {}).items()))
+        print(f"tenant {name}: steps={entry.get('steps', 0)} "
+              f"tokens/s={entry.get('tokens_per_s', 0)} "
+              f"disrupted {dis.get('total_seconds', 0)}s over "
+              f"{dis.get('total_windows', 0)} window(s)"
+              + (f" [{causes}]" if causes else ""), file=sys.stderr)
+        for w in dis.get("open", []):
+            print(f"  OPEN: {w.get('cause')} for {w.get('age_s')}s "
+                  f"(trace {w.get('trace_id') or '-'})", file=sys.stderr)
+    return 3 if open_windows else 0
 
 
 def cmd_shards(args) -> int:
@@ -346,16 +408,38 @@ def cmd_bulk_add(args) -> int:
 
 def cmd_slo(args) -> int:
     """Print the SLO evaluation; exit 3 when any objective is in breach
-    (scriptable: a deploy gate can `tpumounter slo && roll`)."""
+    (scriptable: a deploy gate can `tpumounter slo && roll`). Besides
+    the raw JSON, each objective gets a one-line verdict naming its
+    fast/slow burn against their windows and the breach threshold —
+    so WHICH window tripped is visible without reading the payload."""
     status, body = _http(args, "GET", "/slo", token=_obs_token(args))
     print(body.rstrip())
     if status != 200:
         return 1
     try:
-        breached = any(o.get("breached")
-                       for o in json.loads(body).get("objectives", []))
+        payload = json.loads(body)
     except ValueError:
         return 1
+    windows = payload.get("windows_s", {})
+    fast_s, slow_s = windows.get("fast", 0), windows.get("slow", 0)
+    threshold = payload.get("burn_threshold", 0)
+    breached = False
+    for obj in payload.get("objectives", []):
+        burn_fast = obj.get("burn_fast", 0.0)
+        burn_slow = obj.get("burn_slow", 0.0)
+        if obj.get("breached"):
+            breached = True
+            verdict = "BREACH (both windows over threshold)"
+        elif burn_fast >= threshold > burn_slow:
+            verdict = "ok (fast window hot, slow window holding)"
+        elif burn_slow >= threshold > burn_fast:
+            verdict = "ok (slow window elevated, fast window calm)"
+        else:
+            verdict = "ok"
+        print(f"{obj.get('name')}: burn {burn_fast:.2f}x/{fast_s:.0f}s "
+              f"(fast) {burn_slow:.2f}x/{slow_s:.0f}s (slow), "
+              f"threshold {threshold:.1f}x -> {verdict}",
+              file=sys.stderr)
     return 3 if breached else 0
 
 
@@ -607,6 +691,16 @@ def build_parser() -> argparse.ArgumentParser:
                                     "when any objective is in breach)")
     _obs_common(sl)
     sl.set_defaults(fn=cmd_slo)
+
+    tn = sub.add_parser("tenants", help="per-tenant disruption ledger: "
+                                        "step rates, downtime windows "
+                                        "attributed to their cause + "
+                                        "trace (exit 3 when any window "
+                                        "is still open)")
+    _obs_common(tn)
+    tn.add_argument("--tenant", default=None,
+                    help="show only this tenant (exit 2 when absent)")
+    tn.set_defaults(fn=cmd_tenants)
 
     sh = sub.add_parser("shards", help="shard table: which master "
                                        "replica owns which node shard")

@@ -349,6 +349,26 @@ class Config:
     slo_burn_threshold: float = field(default_factory=lambda: float(
         _env("SLO_BURN_THRESHOLD", "2.0")))
 
+    # --- tenant-side telemetry (gpumounter_tpu/jaxside/telemetry.py +
+    # obs/tenants.py) ---
+    # How often the TenantTelemetry SDK's background publisher POSTs a
+    # snapshot to the local worker's ops port /tenant-telemetry.
+    tenant_publish_interval_s: float = field(default_factory=lambda: float(
+        _env("TENANT_PUBLISH_INTERVAL_S", "15")))
+    # Step-gap stall detection: an idle gap between steps counts as a
+    # disruption window once it exceeds
+    # max(stall_min_s, stall_factor * smoothed step time) — see
+    # docs/FAQ.md "what counts as a disruption".
+    tenant_stall_factor: float = field(default_factory=lambda: float(
+        _env("TENANT_STALL_FACTOR", "10")))
+    tenant_stall_min_s: float = field(default_factory=lambda: float(
+        _env("TENANT_STALL_MIN_S", "1.0")))
+    # Worker-side tenant cap (the 256 + _overflow convention the
+    # device-access telemetry established): snapshots from more distinct
+    # tenants than this fold into one _overflow entry.
+    tenant_max: int = field(default_factory=lambda: int(
+        _env("TPUMOUNTER_TENANT_MAX", "256")))
+
     # --- logging ---
     log_dir: str = field(default_factory=lambda: _env("TPUMOUNTER_LOG_DIR", "/var/log/tpumounter"))
 

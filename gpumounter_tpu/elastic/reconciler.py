@@ -463,6 +463,10 @@ class ElasticReconciler:
             "generation": int(previous.get("generation", 0)) + 1,
             "removed": removed,
             "added": added,
+            # The reconcile pass's trace id: the jaxside telemetry SDK
+            # stamps it onto the heal disruption window, attributing the
+            # tenant's repack/restore gap to THIS heal's trace.
+            "trace_id": trace.current_trace_id(),
             "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
         try:

@@ -23,15 +23,23 @@ from gpumounter_tpu.jaxside.migrate import (
     migration_signal,
     watch_migration,
 )
+from gpumounter_tpu.jaxside.telemetry import (
+    TenantTelemetry,
+    disruption_marker,
+    watch_disruptions,
+)
 
 __all__ = [
     "chips_visible_in_dev",
     "chip_replacement",
+    "disruption_marker",
     "migration_signal",
     "refresh_devices",
     "set_topology_env",
     "wait_for_chips",
     "watch_chip_replacements",
+    "watch_disruptions",
     "watch_migration",
     "HotResumable",
+    "TenantTelemetry",
 ]

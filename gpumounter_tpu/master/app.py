@@ -300,6 +300,10 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     # telemetry, and the SLO burn-rate evaluation over it.
     ("GET", re.compile(r"^/fleet$"), "fleet"),
     ("GET", re.compile(r"^/slo$"), "slo"),
+    # Tenant-perceived disruption ledger (jaxside telemetry SDK ->
+    # worker tenant store -> fleet merge): per-tenant step rates and
+    # disruption windows, each joined to its control-plane trace.
+    ("GET", re.compile(r"^/tenants$"), "tenants"),
     # Node-failure recovery plane (gpumounter_tpu/recovery/): per-node
     # liveness verdicts + the evacuation history, and a manual
     # evacuation trigger for operators who confirmed a death themselves.
@@ -336,7 +340,7 @@ class MasterApp:
     #: /fleet and /slo — which reveal pod/tenant names and chip
     #: movements — require the mutate token.
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
-                             "shards", "recovery"})
+                             "shards", "recovery", "tenants"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -476,7 +480,7 @@ class MasterApp:
     #: query (RUNBOOK "Debugging a slow mount"). /fleet and /slo are
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
-                                 "slo", "shards", "recovery"})
+                                 "slo", "shards", "recovery", "tenants"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -691,6 +695,17 @@ class MasterApp:
         self.fleet.refresh_if_stale(self.cfg.fleet_scrape_interval_s)
         return 200, "application/json", \
             jsonlib.dumps(self.slo.payload(), indent=1) + "\n"
+
+    def _route_tenants(self, match, body, headers):
+        """The per-tenant disruption ledger: what each tenant's training
+        loop experienced (step rate, tokens/sec, queue depth) and every
+        disruption window attributed to its cause, joined against the
+        trace plane (each window's trace id links to /trace/<id>)."""
+        import json as jsonlib
+        payload = self.fleet.tenants_payload(
+            max_age_s=self.cfg.fleet_scrape_interval_s)
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
 
     def _route_recovery(self, match, body, headers):
         """The recovery plane's state: per-node liveness verdicts, the

@@ -419,8 +419,13 @@ class MigrationCoordinator:
 
     def _phase_quiesce(self, journal: dict) -> str:
         src = journal["source"]
+        # The tenant-facing signal carries the migration's trace id: the
+        # jaxside telemetry SDK stamps it onto the disruption window it
+        # opens, so tenant-perceived downtime joins /trace/<id> and the
+        # audit trail (the downtime-attribution contract).
         self._stamp(src, ANNOT_PHASE, {
             "id": journal["id"], "phase": "quiesce",
+            "trace_id": journal.get("trace_id", ""),
             "destination": journal["destination"]})
         journal["quiesced"] = self._await_ack(
             src, journal["id"], "quiesced",
@@ -512,6 +517,7 @@ class MigrationCoordinator:
         dst = journal["destination"]
         self._stamp(dst, ANNOT_PHASE, {
             "id": journal["id"], "phase": "resume",
+            "trace_id": journal.get("trace_id", ""),
             "chips": journal["dest_chips"], "source": journal["source"]})
         signaled_at = time.time()
         journal["resumed"] = self._await_ack(
@@ -668,6 +674,7 @@ class MigrationCoordinator:
                 pass
             self._stamp(src, ANNOT_PHASE,
                         {"id": journal["id"], "phase": "resume",
+                         "trace_id": journal.get("trace_id", ""),
                          "chips": chips_now})
         except Exception as exc:  # noqa: BLE001 — record, don't die
             failure = failure or f"source resume signal failed: {exc}"

@@ -123,12 +123,17 @@ def worker_telemetry_snapshot(cfg=None, registry=None) -> dict:
     device_access: dict[str, dict[str, float]] = {}
     for (tenant, kind), value in DEVICE_TELEMETRY.counts().items():
         device_access.setdefault(tenant, {})[kind] = value
+    from gpumounter_tpu.obs.tenants import TENANTS
     snap = {
         "schema": TELEMETRY_SCHEMA,
         "at": round(time.time(), 3),
         "mount_latency": mount_hist,
         "counters": counters,
         "device_access": device_access,
+        # Tenant-side snapshots the jaxside SDK published to this
+        # worker's ops port (obs/tenants.py): cumulative, capped at
+        # 256 + _overflow. Legacy consumers ignore the extra key.
+        "tenants": TENANTS.export(),
     }
     if cfg is not None and getattr(cfg, "node_name", ""):
         snap["node"] = cfg.node_name
@@ -224,6 +229,7 @@ def snapshot_from_prometheus(text: str) -> dict:
         },
         "counters": counters,
         "device_access": device_access,
+        "tenants": {},  # the classic exposition cannot carry them
     }
 
 
@@ -270,8 +276,76 @@ def _node_rollup(snapshot: dict) -> dict:
         "rollback_failures": _counter(snapshot, "rollback_failures"),
         "ebpf_program_swaps": _counter(snapshot, "ebpf_program_swaps"),
         "device_access": snapshot.get("device_access") or {},
+        "tenants": snapshot.get("tenants") or {},
         "exemplars": (snapshot.get("mount_latency") or {}).get(
             "exemplars", []),
+    }
+
+
+# --- tenant merge (the jaxside SDK series, fleet-wide) ---
+
+def merge_tenants(nodes: dict[str, dict]) -> dict[str, dict]:
+    """tenant -> latest snapshot across every node entry, stamped with
+    the node it came from. Keyed by tenant name so a tenant seen on two
+    nodes (mid-migration republish, shared in-process test stacks) is
+    counted ONCE — the freshest `at` wins; snapshots are cumulative, so
+    taking the latest never loses events."""
+    merged: dict[str, dict] = {}
+    for node, entry in sorted(nodes.items()):
+        for tenant, snap in (entry.get("tenants") or {}).items():
+            if not isinstance(snap, dict):
+                continue
+            best = merged.get(tenant)
+            if best is None or snap.get("at", 0) >= best.get("at", 0):
+                merged[tenant] = {**snap, "node": node}
+    return merged
+
+
+def tenants_fleet_rollup(merged: dict[str, dict]) -> dict:
+    """Fleet-wide tenant aggregates — the SLO engine's tenant inputs
+    (obs/slo.py): cumulative disruption-free/disrupted minutes, and a
+    per-cause merged downtime histogram for the p95 tenant-visible
+    downtime objectives."""
+    clean = disrupted = 0.0
+    windows_total = 0.0
+    seconds_total = 0.0
+    open_windows = 0
+    steps = 0.0
+    downtime: dict[str, dict] = {}
+    for snap in merged.values():
+        minutes = snap.get("minutes") or {}
+        total = float(minutes.get("total", 0))
+        bad = float(minutes.get("disrupted", 0))
+        clean += max(0.0, total - bad)
+        disrupted += bad
+        steps += float((snap.get("steps") or {}).get("count", 0))
+        dis = snap.get("disruption") or {}
+        windows_total += float(dis.get("total_windows", 0))
+        seconds_total += float(dis.get("total_seconds", 0.0))
+        open_windows += len(dis.get("open") or [])
+        for cause, entry in (dis.get("by_cause") or {}).items():
+            agg = downtime.setdefault(cause, {"buckets": {}, "count": 0.0,
+                                              "seconds": 0.0})
+            agg["count"] += float(entry.get("windows", 0))
+            agg["seconds"] += float(entry.get("seconds", 0.0))
+            for bound, cum in entry.get("buckets") or []:
+                agg["buckets"][float(bound)] = \
+                    agg["buckets"].get(float(bound), 0.0) + float(cum)
+    return {
+        "tenants": len(merged),
+        "steps": steps,
+        "tenant_clean_minutes": clean,
+        "tenant_disrupted_minutes": disrupted,
+        "disruption_windows": windows_total,
+        "disruption_seconds": round(seconds_total, 4),
+        "open_windows": open_windows,
+        "downtime": {
+            cause: {
+                "buckets": [[b, agg["buckets"][b]]
+                            for b in sorted(agg["buckets"])],
+                "count": agg["count"],
+                "seconds": round(agg["seconds"], 4),
+            } for cause, agg in sorted(downtime.items())},
     }
 
 
@@ -464,6 +538,19 @@ class FleetCollector:
         with self._lock:
             nodes = {n: dict(e) for n, e in self._nodes.items()}
             at = self._collected_at
+        now = time.time()
+        for entry in nodes.values():
+            if entry.get("stale"):
+                # Age since the last SUCCESSFUL collect (collected_at is
+                # only stamped on success — a stale entry keeps the old
+                # one), so `tpumounter fleet` can tell a 20-second blip
+                # from a node dark for an hour. A node that NEVER
+                # answered has no collected_at: age is null, not ~0 —
+                # "collected moments ago" would invert exactly the
+                # distinction this field exists to make.
+                entry["stale_age_s"] = (
+                    round(max(0.0, now - entry["collected_at"]), 1)
+                    if "collected_at" in entry else None)
         fleet = {
             "nodes": len(nodes),
             "mount_count": 0,
@@ -515,6 +602,9 @@ class FleetCollector:
             "nodes": nodes,
             "fleet": fleet,
             "master": master,
+            # Tenant-perceived series, merged fleet-wide (deduped by
+            # tenant) — the SLO engine's tenant objectives read this.
+            "tenants_fleet": tenants_fleet_rollup(merge_tenants(nodes)),
         }
         if self.shards is not None and self.shards.active():
             payload["shard"] = {
@@ -523,6 +613,64 @@ class FleetCollector:
                 "ownedShards": sorted(self.shards.owned_shards()),
             }
         return payload
+
+    def tenants_payload(self, max_age_s: float | None = None) -> dict:
+        """The /tenants response: the per-tenant disruption ledger,
+        joined against the trace plane — every window with a trace id
+        links to /trace/<id> and says whether that trace still resolves
+        in THIS master's ring (migration/heal/evacuation spans are
+        master-minted, so the join usually lands)."""
+        self.refresh_if_stale(max_age_s)
+        with self._lock:
+            nodes = {n: dict(e) for n, e in self._nodes.items()}
+            at = self._collected_at
+        merged = merge_tenants(nodes)
+        tenants: dict[str, dict] = {}
+        for tenant, snap in sorted(merged.items()):
+            dis = snap.get("disruption") or {}
+            windows = []
+            for w in dis.get("windows") or []:
+                entry = dict(w)
+                tid = entry.get("trace_id") or ""
+                if tid:
+                    entry["trace"] = f"/trace/{tid}"
+                    entry["trace_resolves"] = \
+                        trace.trace_payload(tid) is not None
+                windows.append(entry)
+            by_cause = {}
+            for cause, agg in (dis.get("by_cause") or {}).items():
+                buckets = [[float(b), float(c)]
+                           for b, c in agg.get("buckets") or []]
+                hist = {"buckets": buckets,
+                        "count": float(agg.get("windows", 0))}
+                by_cause[cause] = {
+                    "windows": agg.get("windows", 0),
+                    "seconds": agg.get("seconds", 0.0),
+                    "p50_ms": _hist_quantile_ms(hist, 0.50),
+                    "p95_ms": _hist_quantile_ms(hist, 0.95),
+                }
+            tenants[tenant] = {
+                "node": snap.get("node", ""),
+                "namespace": snap.get("namespace", ""),
+                "pod": snap.get("pod", ""),
+                "at": snap.get("at"),
+                "steps": (snap.get("steps") or {}).get("count", 0),
+                "tokens_per_s": snap.get("tokens_per_s", 0.0),
+                "queue_depth": snap.get("queue_depth"),
+                "minutes": snap.get("minutes") or {},
+                "disruption": {
+                    "open": dis.get("open") or [],
+                    "windows": windows,
+                    "by_cause": by_cause,
+                    "total_windows": dis.get("total_windows", 0),
+                    "total_seconds": dis.get("total_seconds", 0.0),
+                },
+            }
+        return {
+            "at": round(at, 3),
+            "tenants": tenants,
+            "fleet": tenants_fleet_rollup(merged),
+        }
 
     # --- the poll loop (master/main.py) ---
 

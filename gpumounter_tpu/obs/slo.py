@@ -57,21 +57,26 @@ class ObjectiveError(ValueError):
 @dataclass(frozen=True)
 class Objective:
     name: str
-    kind: str                 # "latency" | "ratio"
+    kind: str                 # "latency" | "ratio" | "tenant-downtime"
     target: float             # good fraction target in (0, 1)
-    threshold_s: float = 0.0  # latency: the histogram bound that is "good"
+    threshold_s: float = 0.0  # latency kinds: the bound that is "good"
     good: str = ""            # ratio: rollup counter key for good events
     bad: str = ""             # ratio: rollup counter key for bad events
+    #: tenant-downtime: which disruption cause's merged downtime
+    #: histogram to judge (migration / heal / evacuation / ...).
+    cause: str = "migration"
     description: str = ""
 
     def __post_init__(self):
-        if self.kind not in ("latency", "ratio"):
+        if self.kind not in ("latency", "ratio", "tenant-downtime"):
             raise ObjectiveError(f"{self.name}: unknown kind {self.kind!r}")
         if not 0.0 < self.target < 1.0:
             raise ObjectiveError(
                 f"{self.name}: target must be in (0, 1), got {self.target}")
-        if self.kind == "latency" and self.threshold_s <= 0:
-            raise ObjectiveError(f"{self.name}: latency needs threshold_s")
+        if self.kind in ("latency", "tenant-downtime") \
+                and self.threshold_s <= 0:
+            raise ObjectiveError(f"{self.name}: {self.kind} needs "
+                                 f"threshold_s")
         if self.kind == "ratio" and not (self.good and self.bad):
             raise ObjectiveError(f"{self.name}: ratio needs good and bad keys")
 
@@ -90,6 +95,19 @@ DEFAULT_OBJECTIVES: tuple[Objective, ...] = (
     Objective(name="heal-success", kind="ratio", target=0.99,
               good="heals", bad="heal_failures",
               description="99% of chip heals succeed"),
+    # Tenant-perceived objectives (the jaxside telemetry plane,
+    # obs/fleet.py tenants_fleet rollup). Zero tenant traffic = zero
+    # burn, so fleets without the SDK never see these breach.
+    Objective(name="tenant-migration-downtime", kind="tenant-downtime",
+              cause="migration", threshold_s=2.5, target=0.95,
+              description="95% of tenant-visible migration disruption "
+                          "windows close within 2.5 s (p95 "
+                          "tenant-visible migration downtime)"),
+    Objective(name="tenant-disruption-free-minutes", kind="ratio",
+              target=0.999, good="tenant_clean_minutes",
+              bad="tenant_disrupted_minutes",
+              description="99.9% of tenant wall-clock minutes are "
+                          "disruption-free"),
 )
 
 
@@ -109,24 +127,41 @@ def objectives_from_config(cfg) -> tuple[Objective, ...]:
     return tuple(Objective(**doc) for doc in docs)
 
 
+def _good_within(buckets, threshold_s: float) -> float:
+    """Cumulative count at the largest bucket bound <= threshold — the
+    'fast enough' events of a cumulative histogram."""
+    good = 0.0
+    best_bound = None
+    for bound, cum in buckets or []:
+        if float(bound) <= threshold_s + 1e-12 and \
+                (best_bound is None or float(bound) > best_bound):
+            best_bound = float(bound)
+            good = float(cum)
+    return good
+
+
 def _good_total(objective: Objective, rollup: dict) -> tuple[float, float]:
     """Cumulative (good, total) for one objective from a fleet rollup."""
     fleet = rollup.get("fleet") or {}
     if objective.kind == "latency":
         total = float(fleet.get("mount_count", 0))
-        good = 0.0
-        best_bound = None
-        for bound, cum in fleet.get("mount_buckets") or []:
-            # the largest bucket bound <= threshold carries the
-            # cumulative count of "good" (fast-enough) mounts
-            if float(bound) <= objective.threshold_s + 1e-12 and \
-                    (best_bound is None or float(bound) > best_bound):
-                best_bound = float(bound)
-                good = float(cum)
-        return good, total
+        return _good_within(fleet.get("mount_buckets"),
+                            objective.threshold_s), total
+    if objective.kind == "tenant-downtime":
+        # good = tenant disruption windows (of this cause) that closed
+        # within the threshold, from the fleet-merged per-cause
+        # downtime histogram (obs/fleet.py tenants_fleet_rollup).
+        downtime = ((rollup.get("tenants_fleet") or {})
+                    .get("downtime") or {}).get(objective.cause) or {}
+        total = float(downtime.get("count", 0))
+        return _good_within(downtime.get("buckets"),
+                            objective.threshold_s), total
     counters = {**(rollup.get("master") or {}),
                 "mount_success": fleet.get("mount_success", 0.0),
                 "mount_error": fleet.get("mount_error", 0.0)}
+    for key in ("tenant_clean_minutes", "tenant_disrupted_minutes"):
+        counters[key] = float(
+            (rollup.get("tenants_fleet") or {}).get(key, 0.0))
     good = float(counters.get(objective.good, 0.0))
     bad = float(counters.get(objective.bad, 0.0))
     return good, good + bad

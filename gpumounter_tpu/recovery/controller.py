@@ -77,6 +77,12 @@ EVACUATED_INTENTS = REGISTRY.counter(
     "tpumounter_evacuated_intents_total",
     "Elastic intents re-driven off dead nodes by evacuations")
 
+#: mirror of jaxside.telemetry.ANNOT_DISRUPTION (the tenant side
+#: deliberately does not import master-side packages, and vice versa):
+#: evacuations stamp this on every affected tenant pod so the jaxside
+#: SDK can attribute the downtime window to THIS evacuation's trace.
+ANNOT_DISRUPTION = "tpumounter.io/disruption"
+
 
 class RecoveryController:
     """One master replica's recovery loop. Constructed by MasterApp;
@@ -401,6 +407,7 @@ class RecoveryController:
             affected.append((namespace, pod_name))
             self.elastic.enqueue(namespace, pod_name,
                                  priority=intent.priority)
+            self._stamp_disruption(pod, node)
             from gpumounter_tpu.k8s.events import post_pod_event
             post_pod_event(
                 self.kube, pod, "TPUNodeEvacuated",
@@ -410,6 +417,36 @@ class RecoveryController:
                 f"node", event_type="Warning",
                 component="tpumounter-recovery")
         return affected
+
+    def _stamp_disruption(self, pod: Pod, node: str) -> None:
+        """Tell the tenant WHY its chips vanished: a seq-advancing
+        tpumounter.io/disruption marker carrying the evacuation's trace
+        id (we run inside the recovery.evacuate span), which the
+        jaxside telemetry SDK turns into an attributed downtime window.
+        Best-effort — a failed stamp degrades the window to an
+        unattributed stall, never the evacuation."""
+        import json
+        previous = {}
+        try:
+            previous = json.loads(
+                pod.annotations.get(ANNOT_DISRUPTION, "{}"))
+        except ValueError:
+            pass
+        marker = {
+            "seq": int(previous.get("seq", 0)) + 1
+            if isinstance(previous, dict) else 1,
+            "cause": "evacuation",
+            "trace_id": trace.current_trace_id(),
+            "node": node,
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        try:
+            self.kube.patch_pod(pod.namespace, pod.name, {
+                "metadata": {"annotations": {
+                    ANNOT_DISRUPTION: json.dumps(marker)}}})
+        except Exception as exc:  # noqa: BLE001 — marker is advisory
+            logger.warning("disruption marker stamp on %s/%s failed: %s",
+                           pod.namespace, pod.name, exc)
 
     def _redrive_migrations(self) -> list[str]:
         if self.migrations is None:

@@ -47,7 +47,13 @@ invariants after convergence:
  12. fencing (run_fencing_scenario): no stale-epoch write is ever
      applied — a partitioned old shard owner's mutations are rejected
      FENCED and provably change nothing, while the new owner's traffic
-     flows.
+     flows,
+ 13. tenant disruption closure (armed by attach_tenant): after a
+     terminal migration/heal/evacuation, no fake tenant's disruption
+     window is left open, every signalled-cause window carries the
+     control-plane trace id the signal delivered, and that trace id
+     resolves in the trace ring — tenant-perceived downtime is never
+     unattributable.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -85,6 +91,101 @@ NODE_A, NODE_B = "chaos-a", "chaos-b"
 
 class InvariantViolation(AssertionError):
     """A global safety invariant failed to hold after convergence."""
+
+
+class TenantSim:
+    """A fake tenant process over the fake cluster: a paced step loop
+    plus the REAL jaxside watchers (watch_migration /
+    watch_chip_replacements / watch_disruptions) driving the REAL
+    TenantTelemetry SDK — so the harness and bench measure the exact
+    code a tenant would run.
+
+    The step loop pauses on the quiesce signal (state packed) and
+    resumes on the resume signal (state restored), so tenant-visible
+    migration downtime is a genuinely measured gap, not a simulation
+    constant. `extra_pods` lets the sim watch a migration destination
+    pod too — the tenant process logically spans both ends of a move.
+    """
+
+    def __init__(self, kube, namespace: str, pod: str,
+                 extra_pods: tuple = (), step_s: float = 0.004,
+                 publish_url: str | None = None,
+                 token: str | None = None):
+        import threading
+
+        from gpumounter_tpu.jaxside.telemetry import TenantTelemetry
+        self.kube = kube
+        self.namespace = namespace
+        self.pod = pod
+        self.telemetry = TenantTelemetry(
+            tenant=f"{namespace}/{pod}", namespace=namespace, pod=pod,
+            publish_url=publish_url, token=token,
+            # test-speed knobs: stalls detected at half a second, minute
+            # accounting rolls every 2 s so short runs still count them
+            stall_min_s=0.5, minute_s=2.0)
+        self._step_s = step_s
+        self._stop = threading.Event()
+        self._pause = threading.Event()
+        self._threads: list[threading.Thread] = []
+        watched = [(namespace, pod)] + [tuple(p) for p in extra_pods]
+
+        def _stepper() -> None:
+            while not self._stop.is_set():
+                if self._pause.is_set():
+                    self._stop.wait(0.002)
+                    continue
+                with self.telemetry.step(tokens=256.0, queue_depth=1.0):
+                    self._stop.wait(self._step_s)
+
+        def _on_quiesce(signal: dict) -> None:
+            self._pause.set()      # HotResumable.pack stand-in
+            time.sleep(0.005)
+
+        def _on_resume(signal: dict) -> None:
+            time.sleep(0.005)      # restore stand-in
+            self._pause.clear()
+
+        def _on_heal(marker: dict) -> None:
+            self._pause.set()      # repack + restore blocks the loop
+            time.sleep(0.005)
+            self._pause.clear()
+
+        def _spawn(target, *args, **kwargs) -> None:
+            thread = threading.Thread(target=target, args=args,
+                                      kwargs=kwargs, daemon=True)
+            self._threads.append(thread)
+            thread.start()
+
+        _spawn(_stepper)
+        from gpumounter_tpu.jaxside.heal import watch_chip_replacements
+        from gpumounter_tpu.jaxside.migrate import watch_migration
+        from gpumounter_tpu.jaxside.telemetry import watch_disruptions
+        for ns, name in watched:
+            _spawn(watch_migration, kube, ns, name,
+                   self.telemetry.migration_quiesce(_on_quiesce),
+                   on_resume=self.telemetry.migration_resume(_on_resume),
+                   stop=self._stop, watch_timeout_s=1.0)
+            _spawn(watch_chip_replacements, kube, ns, name,
+                   self.telemetry.heal(_on_heal), stop=self._stop,
+                   watch_timeout_s=1.0)
+            _spawn(watch_disruptions, kube, ns, name,
+                   self.telemetry.external_disruption, stop=self._stop,
+                   watch_timeout_s=1.0)
+
+    def settle(self, timeout_s: float = 5.0) -> None:
+        """Wait until no disruption window is open (the step loop
+        auto-closes them) or the deadline passes."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not self.telemetry.snapshot()["disruption"]["open"]:
+                return
+            time.sleep(0.02)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pause.clear()
+        for thread in self._threads:
+            thread.join(timeout=3.0)
 
 
 #: (failpoint name, action) pools the scenarios draw from. Everything is
@@ -164,6 +265,9 @@ class ChaosHarness:
         self.channel_pool = ChannelPool(cfg=self.cfg)
         #: (namespace, pod) -> node, for every target pod we created
         self.pods: dict[tuple[str, str], str] = {}
+        #: (namespace, pod) -> TenantSim: fake tenants running the real
+        #: jaxside telemetry SDK; non-empty arms invariant 13.
+        self.tenant_sims: dict[tuple[str, str], TenantSim] = {}
         self.app: MasterApp | None = None
 
     # --- lifecycle ---
@@ -277,8 +381,25 @@ class ChaosHarness:
         self.dead_nodes.add(name)
         self.record(f"kill node {name}")
 
+    def attach_tenant(self, namespace: str, pod: str,
+                      extra_pods: tuple = (),
+                      publish_url: str | None = None,
+                      token: str | None = None) -> TenantSim:
+        """Run a fake tenant (step loop + real jaxside watchers) for an
+        existing target pod; arms invariant 13."""
+        sim = TenantSim(self.cluster.kube, namespace, pod,
+                        extra_pods=extra_pods, publish_url=publish_url,
+                        token=token)
+        self.tenant_sims[(namespace, pod)] = sim
+        return sim
+
+    def stop_tenants(self) -> None:
+        for sim in self.tenant_sims.values():
+            sim.stop()
+
     def stop(self) -> None:
         failpoints.disarm_all()
+        self.stop_tenants()
         if self.app is not None:
             self.app.recovery.stop()
             self.app.elastic.stop()
@@ -831,6 +952,34 @@ class ChaosHarness:
                             f"{key[0]}/{key[1]} on {node}: ledger "
                             f"{sorted(ledger_view)} != booked "
                             f"{sorted(booked[key])}")
+
+        # 13. tenant disruption closure (armed by attach_tenant): after
+        # terminal migrations/heals/evacuations no window is open, and
+        # every signalled-cause window carries a trace id that resolves
+        # in the trace ring — attributable downtime, never a mystery.
+        if self.tenant_sims:
+            from gpumounter_tpu.jaxside.telemetry import SIGNALLED_CAUSES
+            for sim in self.tenant_sims.values():
+                sim.settle()
+                snap = sim.telemetry.snapshot()
+                tenant = sim.telemetry.tenant
+                for window in snap["disruption"]["open"]:
+                    violations.append(
+                        f"tenant {tenant}: disruption window left open "
+                        f"after convergence: {window}")
+                for window in snap["disruption"]["windows"]:
+                    if window["cause"] not in SIGNALLED_CAUSES:
+                        continue
+                    if not window["trace_id"]:
+                        violations.append(
+                            f"tenant {tenant}: {window['cause']} window "
+                            f"without a control-plane trace id "
+                            f"(unattributed downtime): {window}")
+                    elif trace.trace_payload(window["trace_id"]) is None:
+                        violations.append(
+                            f"tenant {tenant}: {window['cause']} window "
+                            f"trace {window['trace_id']} does not "
+                            f"resolve in the trace ring")
 
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()

@@ -38,7 +38,9 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
     stays open (scrape back-compat) while /audit, /trace and /telemetry
     require the mutate secret (they reveal pod names, tenants, and chip
     movements; the master gates its /fleet + /slo the same way).
-    /healthz is always open for probes."""
+    /healthz is always open for probes. POST /tenant-telemetry (the
+    jaxside TenantTelemetry SDK's publish target) is mutate-scoped:
+    it writes the worker's tenant store."""
 
     def _read_allowed(auth_header: str | None) -> bool:
         from gpumounter_tpu.utils.auth import check_bearer
@@ -46,6 +48,15 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
             return check_bearer(auth_header, read_token) or (
                 mutate_token is not None
                 and check_bearer(auth_header, mutate_token))
+        if mutate_token is None:
+            return True  # explicit TPUMOUNTER_AUTH=insecure opt-in
+        return check_bearer(auth_header, mutate_token)
+
+    def _mutate_allowed(auth_header: str | None) -> bool:
+        """Mutate scope: the worker's shared secret ONLY — the read
+        token must never authorize a write (POST /tenant-telemetry
+        mutates the worker's tenant store)."""
+        from gpumounter_tpu.utils.auth import check_bearer
         if mutate_token is None:
             return True  # explicit TPUMOUNTER_AUTH=insecure opt-in
         return check_bearer(auth_header, mutate_token)
@@ -120,6 +131,41 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
             self.end_headers()
             self.wfile.write(body)
 
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            import json
+            import urllib.parse
+
+            from gpumounter_tpu.obs.tenants import (
+                TENANT_SNAPSHOTS_REJECTED,
+                TENANTS,
+                parse_tenant_snapshot,
+            )
+
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path != "/tenant-telemetry":
+                self.send_error(404)
+                return
+            # Mutate-scoped: the POST writes the worker's tenant store
+            # (and from there the fleet payload) — a read credential
+            # must not be able to forge another tenant's series.
+            if not _mutate_allowed(self.headers.get("Authorization")):
+                self.send_error(401)
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            snapshot = parse_tenant_snapshot(raw)
+            if snapshot is None:
+                TENANT_SNAPSHOTS_REJECTED.inc()
+                self.send_error(400)
+                return
+            key = TENANTS.ingest(snapshot)
+            body = (json.dumps({"stored": key}) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def log_message(self, fmt, *args):  # quiet
             pass
 
@@ -129,6 +175,8 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
 def serve_ops(port: int, cfg=None) -> ThreadingHTTPServer:
     from gpumounter_tpu.utils.auth import required_token, resolve_read_token
     cfg = cfg or get_config()
+    from gpumounter_tpu.obs.tenants import TENANTS
+    TENANTS.max_tenants = int(cfg.tenant_max)  # 256 + _overflow default
     # required_token: None only under the explicit insecure opt-in —
     # the same fail-closed resolution the gRPC server already did.
     handler = _make_ops_handler(resolve_read_token(cfg),

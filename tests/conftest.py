@@ -40,10 +40,12 @@ def _reset_observability():
     open-span records, and clears the audit trail."""
     yield
     from gpumounter_tpu.obs import audit, trace
+    from gpumounter_tpu.obs.tenants import TENANTS
     from gpumounter_tpu.utils.metrics import REGISTRY
     REGISTRY.reset_all()
     trace.TRACER.reset()
     audit.AUDIT.reset()
+    TENANTS.reset()
 
 
 @pytest.fixture()

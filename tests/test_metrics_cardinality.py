@@ -22,7 +22,10 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 
 #: per-daemon series budget (sample lines, comments excluded). The full
 #: control-plane run below currently sits well under 300; headroom is
-#: deliberate slack for label growth, not an invitation.
+#: deliberate slack for label growth, not an invitation. Reviewed for
+#: ISSUE 9 (tenant telemetry): the tenant plane adds only 3 unlabeled
+#: series (snapshots accepted/rejected + tenants-tracked gauge) — the
+#: per-tenant data rides the JSON plane, so no bump was needed.
 SERIES_BUDGET = 400
 
 
@@ -95,6 +98,7 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         assert status == 200
         assert http("GET", "/fleet")[0] == 200
         assert http("GET", "/slo")[0] == 200
+        assert http("GET", "/tenants")[0] == 200
         from gpumounter_tpu.k8s.types import Pod
         pod = Pod(cluster.kube.get_pod("default", "card-pod"))
         slaves = {p.name for p in service.allocator.slave_pods_for(pod)}
@@ -118,6 +122,31 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         cluster.stop()
         from gpumounter_tpu.config import Config as _C, set_config as _s
         _s(_C())
+
+
+def test_tenant_snapshot_store_cardinality_is_capped():
+    """The jaxside tenant-telemetry store (obs/tenants.py) follows the
+    same 256 + _overflow convention: a churny namespace POSTing from
+    thousands of pods folds into one overflow entry — the fleet payload
+    and the worker's memory stay bounded. The Prometheus side is
+    bounded by construction: the tenant metrics carry NO tenant label
+    (per-tenant series live in the JSON plane only)."""
+    from gpumounter_tpu.obs.tenants import (
+        OVERFLOW_TENANT,
+        TENANT_SCHEMA,
+        TenantStore,
+    )
+
+    before = REGISTRY.series_count()
+    store = TenantStore(max_tenants=16)
+    for i in range(16 * 3):
+        store.ingest({"schema": TENANT_SCHEMA, "tenant": f"churn/p-{i}",
+                      "at": float(i)})
+    exported = store.export()
+    assert len(exported) == store.max_tenants + 1
+    assert exported[OVERFLOW_TENANT]["folded_tenants"] == 2 * 16
+    # zero per-tenant Prometheus series grew out of 48 tenants
+    assert REGISTRY.series_count() - before <= 3  # the unlabeled trio
 
 
 def test_tenant_label_cardinality_is_capped():

@@ -1,0 +1,695 @@
+"""Tenant telemetry plane (ISSUE 9): the jaxside TenantTelemetry SDK,
+disruption-window attribution, the worker's POST /tenant-telemetry
+ingest, the fleet-wide tenant merge, the /tenants ledger route, the
+tenant SLO objectives, and the CLI verbs.
+
+Also the OpenMetrics-negotiation coverage for the routes added since
+PR 6 (/recovery, /shards, /tenants): they serve identical JSON under
+either Accept header, and the classic /metrics exposition stays
+byte-clean of exemplars no matter what those routes did first.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from conftest import AUTH_HEADER, TEST_AUTH_TOKEN
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.jaxside.telemetry import (
+    ANNOT_DISRUPTION,
+    CAUSE_HEAL,
+    CAUSE_MIGRATION,
+    CAUSE_STALL,
+    TENANT_SCHEMA,
+    TenantTelemetry,
+    watch_disruptions,
+)
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.fleet import merge_tenants, tenants_fleet_rollup
+from gpumounter_tpu.obs.tenants import (
+    OVERFLOW_TENANT,
+    TENANTS,
+    TenantStore,
+    parse_tenant_snapshot,
+)
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> float:
+        self.now += dt
+        return self.now
+
+
+def _tel(**kwargs) -> tuple[TenantTelemetry, FakeClock]:
+    clock = FakeClock()
+    kwargs.setdefault("stall_min_s", 1.0)
+    kwargs.setdefault("stall_factor", 10.0)
+    tel = TenantTelemetry(tenant="team-a/trainer", namespace="default",
+                          pod="trainer", clock=clock, **kwargs)
+    return tel, clock
+
+
+# --- the SDK: steps, stalls, windows ---
+
+def test_step_recording_rates_and_queue_depth():
+    tel, clock = _tel()
+    for _ in range(10):
+        clock.advance(0.010)
+        tel.record_step(0.010, tokens=512, queue_depth=4)
+    snap = tel.snapshot()
+    assert snap["schema"] == TENANT_SCHEMA
+    assert snap["steps"]["count"] == 10
+    assert snap["steps"]["sum_s"] == pytest.approx(0.1)
+    assert snap["tokens_total"] == 5120
+    # 512 tokens per 10 ms step = ~51200 tokens/s over the mark window
+    assert snap["tokens_per_s"] == pytest.approx(51200, rel=0.05)
+    assert snap["queue_depth"] == 4
+    # cumulative step histogram: every 10ms step lands in le=0.01
+    buckets = dict((b, c) for b, c in snap["steps"]["buckets"])
+    assert buckets[0.01] == 10
+    assert snap["disruption"]["total_windows"] == 0
+
+
+def test_stall_detection_opens_retroactive_window():
+    tel, clock = _tel()
+    for _ in range(5):
+        clock.advance(0.010)
+        tel.record_step(0.010)
+    # a 3 s idle gap (threshold = max(1.0, 10 * ewma~0.01) = 1 s)
+    clock.advance(3.0)
+    clock.advance(0.010)
+    tel.record_step(0.010)
+    snap = tel.snapshot()
+    (window,) = snap["disruption"]["windows"]
+    assert window["cause"] == CAUSE_STALL
+    assert window["trace_id"] == ""
+    assert window["duration_s"] == pytest.approx(3.0, abs=0.05)
+    # sub-threshold gaps stay invisible
+    clock.advance(0.5)
+    clock.advance(0.010)
+    tel.record_step(0.010)
+    assert tel.snapshot()["disruption"]["total_windows"] == 1
+
+
+def test_signal_window_suppresses_stall_double_count():
+    tel, clock = _tel()
+    clock.advance(0.010)
+    tel.record_step(0.010)
+    tel.begin_disruption(CAUSE_MIGRATION, trace_id="t-1", detail="mig-1")
+    clock.advance(5.0)  # the tenant was paused, signal-attributed
+    tel.end_disruption(CAUSE_MIGRATION)
+    clock.advance(0.010)
+    tel.record_step(0.010)
+    windows = tel.snapshot()["disruption"]["windows"]
+    assert [w["cause"] for w in windows] == [CAUSE_MIGRATION]
+    assert windows[0]["duration_s"] == pytest.approx(5.0, abs=0.05)
+
+
+def test_in_flight_step_cannot_close_a_fresh_window():
+    """A step that STARTED before the signal landed proves nothing: it
+    must not truncate the new window to ~0. Only a step that ran
+    entirely after the open closes it."""
+    tel, clock = _tel()
+    clock.advance(0.010)
+    tel.record_step(0.010)
+    tel.begin_disruption("evacuation", trace_id="t-ev")
+    # this step spans the open (step_start < opened): window survives
+    clock.advance(0.010)
+    tel.record_step(0.020)
+    assert len(tel.snapshot()["disruption"]["open"]) == 1
+    # a full post-open step closes it at that step's start
+    clock.advance(2.0)
+    clock.advance(0.010)
+    tel.record_step(0.010)
+    snap = tel.snapshot()
+    assert snap["disruption"]["open"] == []
+    (window,) = snap["disruption"]["windows"]
+    assert window["cause"] == "evacuation"
+    assert window["duration_s"] == pytest.approx(2.0, abs=0.05)
+
+
+def test_migration_wrappers_open_close_and_attribute():
+    tel, clock = _tel()
+    calls = []
+    on_quiesce = tel.migration_quiesce(lambda s: calls.append(("q", s)))
+    on_resume = tel.migration_resume(lambda s: calls.append(("r", s)))
+    on_quiesce({"id": "mig-9", "phase": "quiesce", "trace_id": "tr-99"})
+    assert len(tel.snapshot()["disruption"]["open"]) == 1
+    clock.advance(0.4)
+    on_resume({"id": "mig-9", "phase": "resume", "trace_id": "tr-99"})
+    snap = tel.snapshot()
+    assert snap["disruption"]["open"] == []
+    (window,) = snap["disruption"]["windows"]
+    assert window["cause"] == CAUSE_MIGRATION
+    assert window["trace_id"] == "tr-99"
+    assert window["duration_s"] == pytest.approx(0.4, abs=0.01)
+    assert [kind for kind, _ in calls] == ["q", "r"]
+    # re-delivered quiesce for the same id is idempotent (no new window)
+    on_quiesce({"id": "mig-9", "phase": "quiesce", "trace_id": "tr-99"})
+    on_resume({"id": "mig-9", "phase": "resume", "trace_id": "tr-99"})
+    assert tel.snapshot()["disruption"]["by_cause"][CAUSE_MIGRATION][
+        "windows"] == 2  # a NEW open+close pair, never a reopen of old
+
+
+def test_heal_wrapper_spans_the_restore_callback():
+    tel, clock = _tel()
+
+    def restore(marker):
+        clock.advance(0.25)
+
+    tel.heal(restore)({"generation": 3, "trace_id": "tr-heal"})
+    (window,) = tel.snapshot()["disruption"]["windows"]
+    assert window["cause"] == CAUSE_HEAL
+    assert window["trace_id"] == "tr-heal"
+    assert window["duration_s"] == pytest.approx(0.25, abs=0.01)
+    # the wrapper closes even when the restore raises
+    def broken(marker):
+        raise RuntimeError("restore died")
+
+    with pytest.raises(RuntimeError):
+        tel.heal(broken)({"generation": 4, "trace_id": "tr-h2"})
+    assert tel.snapshot()["disruption"]["open"] == []
+
+
+def test_disruption_free_minutes_accounting():
+    # stall floor above the 2 s step cadence: this test is about minute
+    # accounting, not stall detection
+    tel, clock = _tel(minute_s=10.0, stall_min_s=5.0)
+    # minute 1: clean stepping
+    for _ in range(5):
+        clock.advance(2.0)
+        tel.record_step(0.01)
+    # minute 2: a disruption window
+    tel.begin_disruption(CAUSE_MIGRATION, trace_id="t")
+    clock.advance(9.0)
+    tel.end_disruption(CAUSE_MIGRATION)
+    # minute 3: clean again
+    clock.advance(11.0)
+    tel.record_step(0.01)
+    snap = tel.snapshot()
+    assert snap["minutes"]["total"] == 3
+    assert snap["minutes"]["disrupted"] == 1
+
+
+def test_retroactive_stall_corrects_minutes_rolled_clean():
+    """A stall is only discovered at the NEXT completed step — by then
+    the publisher's snapshot() calls have already rolled the stalled
+    minutes as clean. The retro mark must correct the counter."""
+    tel, clock = _tel(minute_s=10.0)
+    clock.advance(0.01)
+    tel.record_step(0.01)
+    # 35 s of wedged input pipeline; a publisher snapshot mid-stall
+    # rolls 3 minutes with no window open
+    clock.advance(35.0)
+    assert tel.snapshot()["minutes"] == {"total": 3, "disrupted": 0}
+    clock.advance(0.01)
+    tel.record_step(0.01)  # stall window detected retroactively
+    snap = tel.snapshot()
+    (window,) = snap["disruption"]["windows"]
+    assert window["cause"] == CAUSE_STALL
+    # every minute the 35 s gap touched is now counted disrupted
+    assert snap["minutes"]["total"] == 3
+    assert snap["minutes"]["disrupted"] == 3
+
+
+# --- worker-side store + ops port ---
+
+def _snapshot(tenant: str, **over) -> dict:
+    tel = TenantTelemetry(tenant=tenant, namespace="default",
+                          pod=tenant.rsplit("/", 1)[-1])
+    snap = tel.snapshot()
+    snap.update(over)
+    return snap
+
+
+def test_tenant_store_caps_cardinality_with_overflow():
+    store = TenantStore(max_tenants=4)
+    for i in range(12):
+        store.ingest(_snapshot(f"churn/pod-{i}"))
+    exported = store.export()
+    assert len(exported) == 5  # 4 named + _overflow
+    assert OVERFLOW_TENANT in exported
+    assert exported[OVERFLOW_TENANT]["folded_tenants"] == 8
+    # an existing tenant keeps updating in place past the cap
+    store.ingest(_snapshot("churn/pod-1", tokens_total=77.0))
+    assert store.export()["churn/pod-1"]["tokens_total"] == 77.0
+
+
+def test_parse_tenant_snapshot_is_tolerant():
+    good = json.dumps(_snapshot("a/b")).encode()
+    assert parse_tenant_snapshot(good)["tenant"] == "a/b"
+    for bad in (b"", b"not json", b"[1,2]", b'{"schema": "wrong"}',
+                json.dumps({"schema": TENANT_SCHEMA}).encode(),
+                json.dumps({"schema": TENANT_SCHEMA,
+                            "tenant": ""}).encode()):
+        assert parse_tenant_snapshot(bad) is None
+
+
+def _post(port: int, body: bytes, token: str | None,
+          path: str = "/tenant-telemetry") -> int:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            return resp.status
+    except urllib.error.HTTPError as exc:
+        return exc.code
+
+
+def test_ops_port_ingests_tenant_telemetry(test_config):
+    """POST /tenant-telemetry: mutate-scoped ingest into the worker's
+    tenant store; the snapshot then rides /telemetry (and from there
+    CollectTelemetry -> the fleet)."""
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.worker.main import serve_ops
+    read_cfg = test_config.replace(auth_read_token="read-scope-secret")
+    set_config(read_cfg)
+    ops = serve_ops(0, cfg=read_cfg)
+    try:
+        port = ops.server_address[1]
+        body = json.dumps(_snapshot("team-a/trainer")).encode()
+        # read scope must NOT authorize the write
+        assert _post(port, body, "read-scope-secret") == 401
+        assert _post(port, body, None) == 401
+        assert _post(port, body, TEST_AUTH_TOKEN) == 200
+        assert _post(port, b"not json", TEST_AUTH_TOKEN) == 400
+        assert _post(port, body, TEST_AUTH_TOKEN, path="/nope") == 404
+        assert TENANTS.export()["team-a/trainer"]["received_at"] > 0
+        # the worker's /telemetry snapshot now carries the tenant block
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/telemetry",
+            headers={"Authorization": "Bearer read-scope-secret"})
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            snap = json.loads(resp.read().decode())
+        assert "team-a/trainer" in snap["tenants"]
+    finally:
+        ops.shutdown()
+        ops.server_close()
+        from gpumounter_tpu.config import set_config as _s
+        _s(Config())
+
+
+def test_publish_roundtrip_via_sdk(test_config):
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.worker.main import serve_ops
+    set_config(test_config)
+    ops = serve_ops(0, cfg=test_config)
+    try:
+        port = ops.server_address[1]
+        tel = TenantTelemetry(tenant="team-b/serve", pod="serve",
+                              publish_url=f"http://127.0.0.1:{port}",
+                              token=TEST_AUTH_TOKEN)
+        with tel.step(tokens=64):
+            pass
+        assert tel.publish() is True
+        assert TENANTS.export()["team-b/serve"]["steps"]["count"] == 1
+        # a dead target is advisory, never an exception
+        tel.publish_url = "http://127.0.0.1:1"
+        assert tel.publish() is False
+    finally:
+        ops.shutdown()
+        ops.server_close()
+        from gpumounter_tpu.config import set_config as _s
+        _s(Config())
+
+
+# --- fleet merge + SLO objectives ---
+
+def _node_entry(tenants: dict) -> dict:
+    return {"address": "10.0.0.1:1200", "tenants": tenants}
+
+
+def test_merge_tenants_dedupes_across_nodes():
+    older = _snapshot("a/t", at=100.0)
+    newer = _snapshot("a/t", at=200.0, tokens_total=999.0)
+    merged = merge_tenants({"n1": _node_entry({"a/t": older}),
+                            "n2": _node_entry({"a/t": newer,
+                                               "b/u": _snapshot("b/u")})})
+    assert set(merged) == {"a/t", "b/u"}
+    assert merged["a/t"]["tokens_total"] == 999.0  # freshest wins
+    assert merged["a/t"]["node"] == "n2"
+
+
+def test_tenants_fleet_rollup_aggregates_minutes_and_downtime():
+    tel, clock = _tel(minute_s=10.0)
+    tel.begin_disruption(CAUSE_MIGRATION, trace_id="t")
+    clock.advance(1.0)
+    tel.end_disruption(CAUSE_MIGRATION)
+    clock.advance(9.0)  # close the first minute (disrupted)
+    clock.advance(10.0)  # a clean minute
+    tel.record_step(0.01)
+    fleet = tenants_fleet_rollup(
+        merge_tenants({"n": _node_entry({"a/t": tel.snapshot()})}))
+    assert fleet["tenants"] == 1
+    assert fleet["tenant_disrupted_minutes"] == 1.0
+    assert fleet["tenant_clean_minutes"] == 1.0
+    downtime = fleet["downtime"][CAUSE_MIGRATION]
+    assert downtime["count"] == 1.0
+    assert downtime["seconds"] == pytest.approx(1.0, abs=0.01)
+    # the 1 s window lands in the le=1.0 downtime bucket
+    assert dict((b, c) for b, c in downtime["buckets"])[1.0] == 1.0
+
+
+def test_slo_tenant_objectives_judge_the_rollup():
+    from gpumounter_tpu.obs.slo import Objective, SloEngine
+    objectives = (
+        Objective(name="mig-downtime", kind="tenant-downtime",
+                  cause="migration", threshold_s=2.5, target=0.95),
+        Objective(name="clean-minutes", kind="ratio", target=0.999,
+                  good="tenant_clean_minutes",
+                  bad="tenant_disrupted_minutes"),
+    )
+    clock = FakeClock()
+    engine = SloEngine(cfg=Config(), objectives=objectives,
+                       clock=clock)
+
+    def rollup(within: float, total: float, clean: float, bad: float):
+        return {"fleet": {}, "master": {}, "tenants_fleet": {
+            "tenant_clean_minutes": clean,
+            "tenant_disrupted_minutes": bad,
+            "downtime": {"migration": {
+                "count": total,
+                "buckets": [[2.5, within], [30.0, total]],
+            }},
+        }}
+
+    engine.ingest(rollup(0, 0, 0, 0))
+    clock.advance(60.0)
+    # 10 windows, 9 within 2.5s; 100 minutes, 40 disrupted
+    engine.ingest(rollup(9, 10, 60, 40))
+    out = engine.evaluate()
+    by = {o["name"]: o for o in out["objectives"]}
+    assert by["mig-downtime"]["good_events"] == 9.0
+    assert by["mig-downtime"]["total_events"] == 10.0
+    # 10% slow vs 5% budget = 2x burn over the fast window
+    assert by["mig-downtime"]["burn_fast"] == pytest.approx(2.0)
+    # 40% disrupted vs 0.1% budget: deep breach on the fast window
+    assert by["clean-minutes"]["burn_fast"] > 100
+    assert by["clean-minutes"]["sli"] == pytest.approx(0.6)
+
+
+def test_tenant_objective_validation():
+    from gpumounter_tpu.obs.slo import Objective, ObjectiveError
+    with pytest.raises(ObjectiveError):
+        Objective(name="x", kind="tenant-downtime", target=0.9)  # no thr
+    obj = Objective(name="x", kind="tenant-downtime", target=0.9,
+                    threshold_s=1.0, cause="heal")
+    assert obj.cause == "heal"
+
+
+# --- watch_disruptions + the evacuation stamp ---
+
+def _pod(kube: FakeKubeClient, name: str = "trainer") -> None:
+    kube.create_pod("default", {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "main"}]},
+    })
+
+
+def test_watch_disruptions_delivers_new_markers_only():
+    kube = FakeKubeClient()
+    _pod(kube)
+    # baseline marker: a restarted tenant must NOT re-see it
+    kube.patch_pod("default", "trainer", {"metadata": {"annotations": {
+        ANNOT_DISRUPTION: json.dumps({"seq": 1, "cause": "evacuation",
+                                      "trace_id": "old"})}}})
+    seen: list[dict] = []
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=watch_disruptions,
+        args=(kube, "default", "trainer", seen.append),
+        kwargs={"stop": stop, "watch_timeout_s": 1.0}, daemon=True)
+    thread.start()
+    time.sleep(0.2)
+    kube.patch_pod("default", "trainer", {"metadata": {"annotations": {
+        ANNOT_DISRUPTION: json.dumps({"seq": 2, "cause": "evacuation",
+                                      "trace_id": "tr-ev",
+                                      "node": "node-1"})}}})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not seen:
+        time.sleep(0.02)
+    stop.set()
+    thread.join(timeout=3.0)
+    assert [m["seq"] for m in seen] == [2]
+    assert seen[0]["trace_id"] == "tr-ev"
+
+
+def test_evacuation_stamps_attributable_disruption_marker():
+    from gpumounter_tpu.k8s.types import Pod
+    from gpumounter_tpu.recovery.controller import RecoveryController
+    kube = FakeKubeClient()
+    _pod(kube)
+    controller = RecoveryController(kube, None, None, cfg=Config())
+    with trace.span("recovery.evacuate", node="n1") as ctx:
+        controller._stamp_disruption(
+            Pod(kube.get_pod("default", "trainer")), "n1")
+        trace_id = ctx.trace_id
+    marker = json.loads(Pod(kube.get_pod("default", "trainer"))
+                        .annotations[ANNOT_DISRUPTION])
+    assert marker["cause"] == "evacuation"
+    assert marker["seq"] == 1
+    assert marker["trace_id"] == trace_id
+    # seq advances on a second evacuation (the watcher's dedup key)
+    with trace.span("recovery.evacuate", node="n1"):
+        controller._stamp_disruption(
+            Pod(kube.get_pod("default", "trainer")), "n1")
+    marker = json.loads(Pod(kube.get_pod("default", "trainer"))
+                        .annotations[ANNOT_DISRUPTION])
+    assert marker["seq"] == 2
+
+
+def test_heal_marker_carries_the_pass_trace_id(test_config):
+    """The chip-replaced annotation (elastic/reconciler.py) now carries
+    the reconcile pass's trace id — the jaxside SDK's heal-attribution
+    input."""
+    from gpumounter_tpu.elastic.intents import ANNOT_REPLACED
+    from gpumounter_tpu.elastic.reconciler import ElasticReconciler
+    from gpumounter_tpu.k8s.types import Pod
+    kube = FakeKubeClient()
+    _pod(kube)
+    reconciler = ElasticReconciler(kube, None, None, cfg=test_config)
+    with trace.span("elastic.reconcile", pod="trainer") as ctx:
+        reconciler._record_heal(Pod(kube.get_pod("default", "trainer")),
+                                removed=["uuid-dead"], added=["uuid-new"])
+        trace_id = ctx.trace_id
+    marker = json.loads(Pod(kube.get_pod("default", "trainer"))
+                        .annotations[ANNOT_REPLACED])
+    assert marker["trace_id"] == trace_id
+    assert marker["generation"] == 1
+
+
+# --- /tenants route, stale flags, CLI, OpenMetrics negotiation ---
+
+def _auth() -> dict:
+    return dict(AUTH_HEADER)
+
+
+def _app(cfg=None):
+    from gpumounter_tpu.master.app import MasterApp
+    return MasterApp(FakeKubeClient(), cfg=cfg or Config())
+
+
+def _inject_tenants(app, tenants: dict, stale_node: bool = False) -> None:
+    """Plant a collected rollup so routes serve without live workers."""
+    entry = {"address": "10.0.0.1:1200", "collected_at": time.time(),
+             "mode": "rpc", "tenants": tenants, "mount": {"count": 0},
+             "breaker": "closed"}
+    nodes = {"node-1": entry}
+    if stale_node:
+        nodes["node-dark"] = {"address": "10.0.0.2:1200", "stale": True,
+                              "error": "RpcError: unreachable",
+                              "collected_at": time.time() - 120.0,
+                              "tenants": {}}
+        # dark since master start: no successful collect ever happened
+        nodes["node-never"] = {"address": "10.0.0.3:1200", "stale": True,
+                               "error": "RpcError: unreachable",
+                               "tenants": {}}
+    with app.fleet._lock:
+        app.fleet._nodes = nodes
+        app.fleet._collected_at = time.time()
+
+
+def test_tenants_route_serves_the_ledger_with_trace_join(test_config):
+    app = _app(test_config)
+    with trace.span("migrate.quiesce", id="mig-1") as ctx:
+        resolvable = ctx.trace_id
+    tel, clock = _tel()
+    tel.begin_disruption(CAUSE_MIGRATION, trace_id=resolvable,
+                         detail="mig-1")
+    clock.advance(0.3)
+    tel.end_disruption(CAUSE_MIGRATION)
+    tel.begin_disruption(CAUSE_HEAL, trace_id="expired-trace")
+    clock.advance(0.1)
+    tel.end_disruption(CAUSE_HEAL)
+    _inject_tenants(app, {"team-a/trainer": tel.snapshot()})
+    status, ctype, body, _ = app.handle("GET", "/tenants", b"", _auth())
+    assert status == 200 and ctype == "application/json"
+    payload = json.loads(body)
+    entry = payload["tenants"]["team-a/trainer"]
+    windows = {w["cause"]: w for w in entry["disruption"]["windows"]}
+    assert windows["migration"]["trace"] == f"/trace/{resolvable}"
+    assert windows["migration"]["trace_resolves"] is True
+    assert windows["heal"]["trace_resolves"] is False  # ring miss
+    assert entry["disruption"]["by_cause"]["migration"]["p95_ms"] > 0
+    assert payload["fleet"]["tenants"] == 1
+    # read scope: the tenant ledger names pods — 401 without a token
+    status, _, _, _ = app.handle("GET", "/tenants", b"", {})
+    assert status == 401
+
+
+def test_fleet_payload_carries_stale_age(test_config):
+    app = _app(test_config)
+    _inject_tenants(app, {}, stale_node=True)
+    status, _, body, _ = app.handle("GET", "/fleet", b"", _auth())
+    assert status == 200
+    nodes = json.loads(body)["nodes"]
+    assert nodes["node-dark"]["stale"] is True
+    assert nodes["node-dark"]["stale_age_s"] == pytest.approx(120.0,
+                                                              abs=5.0)
+    # never collected successfully: age is null, never "~0s ago"
+    assert nodes["node-never"]["stale_age_s"] is None
+    assert "stale_age_s" not in nodes["node-1"]
+
+
+def test_cli_tenants_fleet_and_slo_verbs(test_config, capsys):
+    from gpumounter_tpu.cli import main as cli_main
+    from gpumounter_tpu.master.app import build_http_server
+    cfg = test_config.replace(fleet_scrape_interval_s=3600.0)
+    app = _app(cfg)
+    tel, clock = _tel()
+    tel.begin_disruption(CAUSE_MIGRATION, trace_id="tr-1", detail="m1")
+    clock.advance(0.2)
+    tel.end_disruption(CAUSE_MIGRATION)
+    _inject_tenants(app, {"team-a/trainer": tel.snapshot()},
+                    stale_node=True)
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        assert cli_main(["tenants", "--master", base]) == 0
+        out = capsys.readouterr()
+        assert "team-a/trainer" in out.out
+        assert "migration: 1x" in out.err
+        # --tenant filter: unknown name is a rejection
+        assert cli_main(["tenants", "--master", base,
+                         "--tenant", "nope"]) == 2
+        capsys.readouterr()
+        # an open window turns the exit code to 3 and is flagged
+        tel.begin_disruption("evacuation", trace_id="tr-2")
+        _inject_tenants(app, {"team-a/trainer": tel.snapshot()})
+        assert cli_main(["tenants", "--master", base]) == 3
+        assert "OPEN: evacuation" in capsys.readouterr().err
+        # fleet flags the stale node on stderr, JSON stays on stdout
+        # (skip past any logging lines a shared root logger interleaved)
+        _inject_tenants(app, {}, stale_node=True)
+        assert cli_main(["fleet", "--master", base]) == 0
+        out = capsys.readouterr()
+        payload = json.loads(out.out[out.out.index("{"):])
+        assert payload["nodes"]["node-dark"]["stale"]
+        assert "STALE: node node-dark" in out.err
+        # slo prints per-objective burn windows + the threshold
+        assert cli_main(["slo", "--master", base]) == 0
+        err = capsys.readouterr().err
+        assert "mount-latency-50ms: burn" in err
+        assert "(fast)" in err and "(slow)" in err
+        assert "threshold 2.0x" in err
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.registry.stop()
+
+
+def test_new_routes_ignore_openmetrics_negotiation(test_config):
+    """/recovery, /shards and /tenants are JSON planes: the OpenMetrics
+    Accept header must not change a byte of them (exemplar negotiation
+    is /metrics-only)."""
+    app = _app(test_config)
+    _inject_tenants(app, {})
+    om = {**_auth(), "Accept": "application/openmetrics-text"}
+    for path in ("/recovery", "/shards", "/tenants"):
+        s1, c1, b1, _ = app.handle("GET", path, b"", _auth())
+        s2, c2, b2, _ = app.handle("GET", path, b"", om)
+        assert (s1, c1) == (200, "application/json"), path
+        assert (s2, c2, b2) == (s1, c1, b1), path
+        json.loads(b1)  # and it parses
+
+
+def test_chaos_invariant_13_attributes_tenant_downtime(tmp_path):
+    """End to end over the fake cluster: a live migration under an
+    attached fake tenant (real SDK + real watchers) yields an
+    attributed, trace-resolvable migration window, and invariant 13
+    passes; the same invariant REJECTS a fabricated unattributed
+    window (negative control — the detector detects)."""
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.master.slice_ops import SliceTarget
+    from gpumounter_tpu.testing.chaos import (
+        NODE_A,
+        NODE_B,
+        ChaosHarness,
+        InvariantViolation,
+    )
+    set_config(Config())
+    with ChaosHarness(str(tmp_path), seed=5) as h:
+        h.add_pod("src", NODE_A)
+        h.add_pod("dst", NODE_B)
+        h._coordinator().mount_slice(
+            [SliceTarget(namespace="default", pod="src")], 2,
+            entire=False)
+        sim = h.attach_tenant("default", "src",
+                              extra_pods=(("default", "dst"),))
+        time.sleep(0.1)
+        journal = h.app.migrations.begin("default", "src",
+                                         "default", "dst")
+        final = h.app.migrations.wait(journal["id"], timeout_s=60.0)
+        assert final and final["outcome"] == "succeeded", final
+        h.converge()
+        h.check_invariants()  # invariant 13 among them
+        snap = sim.telemetry.snapshot()
+        migration_windows = [w for w in snap["disruption"]["windows"]
+                             if w["cause"] == "migration"]
+        assert migration_windows, snap["disruption"]
+        assert all(w["trace_id"] == journal["trace_id"]
+                   for w in migration_windows)
+        assert trace.trace_payload(journal["trace_id"]) is not None
+        # negative control: an unattributed signalled-cause window must
+        # trip the invariant
+        sim.telemetry.begin_disruption("heal", trace_id="")
+        sim.telemetry.end_disruption("heal")
+        with pytest.raises(InvariantViolation, match="without a "
+                                                     "control-plane"):
+            h.check_invariants()
+
+
+def test_classic_exposition_stays_byte_clean_after_new_routes(test_config):
+    """Hitting the new routes (which resolve traces internally) must
+    leave the classic /metrics exposition exemplar-free; openmetrics
+    negotiation still serves them."""
+    from gpumounter_tpu.utils.metrics import MOUNT_LATENCY
+    tid = trace.new_trace_id()
+    MOUNT_LATENCY.observe(0.02, trace_id=tid)
+    app = _app(test_config)
+    _inject_tenants(app, {})
+    for path in ("/recovery", "/shards", "/tenants"):
+        assert app.handle("GET", path, b"", _auth())[0] == 200
+    status, ctype, body, _ = app.handle("GET", "/metrics", b"", _auth())
+    assert status == 200 and ctype.startswith("text/plain")
+    assert "# {" not in body  # byte-clean classic exposition
+    status, ctype, body, _ = app.handle(
+        "GET", "/metrics", b"",
+        {**_auth(), "Accept": "application/openmetrics-text"})
+    assert status == 200 and f'trace_id="{tid}"' in body
