@@ -48,6 +48,17 @@ Usage:
       healthy node after its pod is rescheduled. Writes
       BENCH_recovery_r01.json; with --check FILE it gates CI (all
       intents must re-converge, MTTR bounded).
+  python bench_fleet.py --scenario api-outage
+      -> the degraded-mode bench (ISSUE 10): a 256-node fleet with
+      converged intents rides out a TPM_OUTAGE_S (default 30 s) full
+      API partition — annotation writes defer into the write-behind
+      queue, reconciles park, recovery never evacuates — then the
+      partition heals and the clock runs from the heal to (a) the
+      ApiHealth verdict recovering, (b) the deferred writes landing
+      exactly once, and (c) every intent re-verified converged. Writes
+      BENCH_outage_r01.json; with --check FILE it gates CI (zero
+      evacuations/destructive mutations during the outage, queue fully
+      drained, reconvergence bounded).
 
 Env knobs (CI smoke uses small values):
   TPM_FLEET_NODES        total cluster nodes            (default 1024)
@@ -628,6 +639,257 @@ def run_recovery_scenario(check: str | None) -> None:
     print(json.dumps(summary))
 
 
+# --- degraded-mode bench (--scenario api-outage) ---
+
+OUTAGE_ARTIFACT = os.path.join(REPO, "BENCH_outage_r01.json")
+OUTAGE_NODES = int(os.environ.get("TPM_OUTAGE_NODES", "256"))
+OUTAGE_AFFECTED = int(os.environ.get("TPM_OUTAGE_AFFECTED", "16"))
+OUTAGE_S = float(os.environ.get("TPM_OUTAGE_S", "30"))
+OUTAGE_WRITES = int(os.environ.get("TPM_OUTAGE_WRITES", "64"))
+OUTAGE_RECONVERGE_CEILING_S = float(os.environ.get(
+    "TPM_OUTAGE_RECONVERGE_CEILING_S", "20"))
+
+
+def run_api_outage_bench() -> dict:
+    """A full API partition of OUTAGE_S seconds under converged
+    intents: measure what degrades (and prove what must NOT happen),
+    then time the recovery — ApiHealth verdict back to healthy, the
+    write-behind queue drained exactly-once, every intent re-verified
+    converged."""
+    import tempfile
+
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.elastic.intents import Intent
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.k8s.types import Pod
+    from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+    from gpumounter_tpu.rpc.client import ChannelPool, WorkerClient
+
+    kube = FakeKubeClient()
+    workdir = tempfile.mkdtemp(prefix="tpm-outage-")
+    cfg = Config().replace(
+        api_health_degraded_failures=3,
+        api_health_down_after_s=1.0,
+        api_health_recovery_successes=2,
+        writebehind_dir=os.path.join(workdir, "writebehind"),
+        recovery_confirm_failures=2,
+        recovery_grace_s=0.0,
+        recovery_probe_timeout_s=1.0,
+        rpc_probe_timeout_s=5.0,
+        rpc_retry_base_s=0.02, rpc_retry_cap_s=0.1,
+        k8s_write_retry_base_s=0.02)
+    stubs = [build_stateful_stub() for _ in range(STUB_SERVERS)]
+    for stub in stubs:
+        stub.start()
+    port_by_ip: dict[str, int] = {}
+    for i in range(OUTAGE_NODES):
+        ip = f"10.{100 + i // 62500}.{(i // 250) % 250}.{i % 250 + 1}"
+        port_by_ip[ip] = stubs[i % STUB_SERVERS].bound_port
+        kube.create_node(f"fleet-node-{i}", ready=True)
+        kube.create_pod(cfg.worker_namespace, {
+            "metadata": {"name": f"w-{i}",
+                         "namespace": cfg.worker_namespace,
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": f"fleet-node-{i}",
+                     "containers": [{"name": "w"}]},
+            "status": {"phase": "Running", "podIP": ip}})
+
+    pool = ChannelPool(cfg=cfg)
+
+    def factory(addr):
+        ip = addr.rsplit(":", 1)[0]
+        return WorkerClient(f"localhost:{port_by_ip[ip]}", cfg=cfg,
+                            channel_pool=pool)
+
+    # A fresh per-process health baseline (bench modes share a process).
+    from gpumounter_tpu.k8s import health as k8s_health
+    k8s_health.reset_all()
+    app = MasterApp(kube, cfg=cfg, worker_client_factory=factory,
+                    registry=WorkerRegistry(kube, cfg))
+    try:
+        tenants = []
+        for t in range(OUTAGE_AFFECTED):
+            name = f"tenant-{t}"
+            node = f"fleet-node-{t % OUTAGE_NODES}"
+            kube.create_pod("default", {
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"nodeName": node,
+                         "containers": [{"name": "m"}]},
+                "status": {"phase": "Running",
+                           "podIP": f"10.200.0.{t + 2}"}})
+            app.elastic.store.put("default", name,
+                                  Intent(desired_chips=1, min_chips=1))
+            outcome = app.elastic.reconcile_once("default", name)
+            assert outcome.get("phase") == "converged", outcome
+            tenants.append(name)
+        app.recovery.check_once()  # track every node while healthy
+
+        # THE OUTAGE: full partition for OUTAGE_S seconds of sustained
+        # degraded-mode traffic.
+        t_partition = time.perf_counter()
+        kube.set_partitioned(True)
+        deferred = 0
+        reconcile_outcomes: dict[str, int] = {}
+        recovery_evacuations = 0
+        write_i = 0
+        while time.perf_counter() - t_partition < OUTAGE_S:
+            # Annotation writes -> the write-behind queue.
+            for _ in range(max(1, OUTAGE_WRITES // max(1, int(OUTAGE_S)))):
+                app.store.stamp_annotation(
+                    "default", tenants[write_i % len(tenants)],
+                    f"tpumounter.io/outage-bench-{write_i}",
+                    json.dumps({"i": write_i, "at": write_i}))
+                write_i += 1
+                deferred = app.store.queue.pending_count()
+            # Reconcile attempts: must park/fail, never mutate.
+            for name in tenants[:4]:
+                try:
+                    out = app.elastic.reconcile_once("default", name)
+                    key = out.get("phase", "?")
+                except Exception as exc:  # noqa: BLE001 — expected
+                    key = type(exc).__name__
+                reconcile_outcomes[key] = \
+                    reconcile_outcomes.get(key, 0) + 1
+            # Recovery passes: zero evacuations allowed.
+            out = app.recovery.check_once()
+            recovery_evacuations += len(out["evacuated"])
+            time.sleep(0.25)
+        outage_state = app.apihealth.state()
+
+        # THE HEAL: clock everything from here.
+        t_heal = time.perf_counter()
+        kube.set_partitioned(False)
+        t_health = None
+        deadline = time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            try:
+                app.kube.get_pod("default", tenants[0])
+                app.kube.patch_pod("default", tenants[0],
+                                   {"metadata": {}})
+            except Exception:  # noqa: BLE001
+                pass
+            if app.apihealth.ok():
+                t_health = time.perf_counter()
+                break
+            time.sleep(0.02)
+        if t_health is None:
+            raise RuntimeError("api health never recovered: "
+                               f"{app.apihealth.payload()}")
+        flush = app.store.flush_writes()
+        t_drained = time.perf_counter()
+        pending = set(tenants)
+        deadline = time.perf_counter() + 60.0
+        while pending and time.perf_counter() < deadline:
+            for name in sorted(pending):
+                try:
+                    out = app.elastic.reconcile_once("default", name)
+                except Exception:  # noqa: BLE001 — keep driving
+                    continue
+                if out.get("phase") == "converged" and \
+                        out.get("actual") == 1:
+                    pending.discard(name)
+            if pending:
+                time.sleep(0.05)
+        t_done = time.perf_counter()
+        # Exactly-once proof: every deferred write is on its pod with
+        # the LAST value for its key (distinct keys here -> all land).
+        landed = 0
+        for i in range(write_i):
+            pod = Pod(kube.get_pod("default",
+                                   tenants[i % len(tenants)]))
+            raw = pod.annotations.get(f"tpumounter.io/outage-bench-{i}")
+            if raw and json.loads(raw).get("i") == i:
+                landed += 1
+        return {
+            "schema": "tpumounter-outage/r01",
+            "scenario": "api-outage",
+            "total_nodes": OUTAGE_NODES,
+            "affected_intents": OUTAGE_AFFECTED,
+            "outage_s": round(t_heal - t_partition, 3),
+            "outage_verdict": outage_state,
+            "deferred_writes": write_i,
+            "deferred_writes_landed": landed,
+            "write_queue_pending_after": \
+            app.store.queue.pending_count(),
+            "flush": flush,
+            "reconcile_outcomes_during_outage": reconcile_outcomes,
+            "evacuations_during_outage": recovery_evacuations,
+            "health_recover_s": round(t_health - t_heal, 3),
+            "queue_drain_s": round(t_drained - t_heal, 3),
+            "reconverge_s": round(t_done - t_heal, 3),
+            "reconverged": len(tenants) - len(pending),
+            "unconverged": sorted(pending),
+        }
+    finally:
+        app.recovery.stop()
+        app.registry.stop()
+        pool.close_all()
+        for stub in stubs:
+            stub.stop(grace=None)
+
+
+def run_outage_scenario(check: str | None) -> None:
+    results = run_api_outage_bench()
+    summary = {
+        "metric": "api_outage_reconverge",
+        "nodes": results["total_nodes"],
+        "outage_s": results["outage_s"],
+        "outage_verdict": results["outage_verdict"],
+        "deferred_writes": results["deferred_writes"],
+        "health_recover_s": results["health_recover_s"],
+        "reconverge_s": results["reconverge_s"],
+    }
+    if check:
+        with open(check, encoding="utf-8") as f:
+            committed = json.load(f)
+        failures = []
+        if results["evacuations_during_outage"]:
+            failures.append(
+                f"{results['evacuations_during_outage']} evacuation(s) "
+                f"fired during the outage (stale-data destruction)")
+        if results["outage_verdict"] not in ("degraded", "down"):
+            failures.append(
+                f"api health never classified the outage "
+                f"(verdict {results['outage_verdict']})")
+        if results["write_queue_pending_after"]:
+            failures.append(
+                f"{results['write_queue_pending_after']} deferred "
+                f"write(s) never replayed")
+        if results["deferred_writes_landed"] != \
+                results["deferred_writes"]:
+            failures.append(
+                f"only {results['deferred_writes_landed']}/"
+                f"{results['deferred_writes']} deferred writes landed "
+                f"exactly once")
+        if results["reconverged"] != results["affected_intents"]:
+            failures.append(
+                f"only {results['reconverged']}/"
+                f"{results['affected_intents']} intents re-verified "
+                f"converged: {results['unconverged']}")
+        ceiling = max(OUTAGE_RECONVERGE_CEILING_S,
+                      committed.get("reconverge_s", 5.0) * 4)
+        if results["reconverge_s"] > ceiling:
+            failures.append(
+                f"reconverge {results['reconverge_s']}s above ceiling "
+                f"{ceiling}s (committed "
+                f"{committed.get('reconverge_s')}s)")
+        out = os.environ.get("TPM_OUTAGE_ARTIFACT")
+        if out:
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(results, f, indent=1)
+        summary["check"] = "fail" if failures else "ok"
+        print(json.dumps(summary))
+        if failures:
+            for failure in failures:
+                print(f"REGRESSION: {failure}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+    artifact = os.environ.get("TPM_OUTAGE_ARTIFACT", OUTAGE_ARTIFACT)
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(summary))
+
+
 def run_bench() -> dict:
     single = run_mode(sharded=False)
     sharded = run_mode(sharded=True)
@@ -661,15 +923,21 @@ def main() -> None:
                         help="CI smoke: run (env-shrunk) fresh, require "
                              "a healthy sharded-vs-single win and no "
                              "regression vs the committed artifact")
-    parser.add_argument("--scenario", choices=["storm", "node-kill"],
+    parser.add_argument("--scenario",
+                        choices=["storm", "node-kill", "api-outage"],
                         default="storm",
                         help="storm = the shard-scale mount storm; "
                              "node-kill = the recovery-plane MTTR bench "
-                             "(BENCH_recovery artifact)")
+                             "(BENCH_recovery artifact); api-outage = "
+                             "the degraded-mode ride-through bench "
+                             "(BENCH_outage artifact)")
     args = parser.parse_args()
 
     if args.scenario == "node-kill":
         run_recovery_scenario(args.check)
+        return
+    if args.scenario == "api-outage":
+        run_outage_scenario(args.check)
         return
 
     results = run_bench()

@@ -73,14 +73,25 @@ WARM_POOL_REFILL_FAILURES = REGISTRY.counter(
 
 class WarmPodPool:
     def __init__(self, kube: KubeClient, cfg=None,
-                 refill_async: bool = True):
+                 refill_async: bool = True, apihealth=None):
         """refill_async=False disables the background refiller entirely:
         nothing refills unless the caller invokes refill_once() —
         deterministic mode for tests that must not race a thread. The
         daemons use the default background refiller, which keeps refills
-        off the mount critical path."""
+        off the mount critical path.
+
+        apihealth: the ApiHealth verdict (k8s/health.py; defaults to
+        the process-global endpoint machine). While the API is
+        degraded/down, refill passes back off WITHOUT creating or
+        deleting pods: a refill create is doomed, and deleting a
+        holder we merely could not watch to Running would throw away
+        capacity the resync would have re-adopted after the outage."""
         self.kube = kube
         self.cfg = cfg or get_config()
+        if apihealth is None:
+            from gpumounter_tpu.k8s.health import api_health
+            apihealth = api_health(cfg=self.cfg)
+        self.apihealth = apihealth
         self.size = max(0, int(self.cfg.warm_pool_size))
         self.refill_async = refill_async
         self._lock = threading.Lock()
@@ -304,6 +315,14 @@ class WarmPodPool:
     def refill_once(self) -> int:
         """One refill pass over every registered node; returns holders
         added. Public so tests and the sync mode can drive it."""
+        if not self.apihealth.ok():
+            # Degraded-mode policy: back off the whole pass. No
+            # creates (doomed), and critically no failed-wait DELETES —
+            # the pool must not shrink standing capacity because the
+            # API went away (ISSUE: "backs off without deleting pods").
+            logger.info("warm-pool refill pass skipped: api %s",
+                        self.apihealth.state())
+            return 0
         added = 0
         with self._lock:
             nodes = list(self._ready)
@@ -373,6 +392,17 @@ class WarmPodPool:
                     WARM_POOL_READY.set(float(len(bucket)), node=node)
                 WARM_POOL_REFILLS.inc()
                 added += 1
+            elif not self.apihealth.ok():
+                # The wait failed because the API died mid-refill, not
+                # because the pod is doomed: leave it alone (the
+                # post-outage resync re-adopts it if it reached
+                # Running, and deletes it as a stray if it never did)
+                # and back the node off.
+                logger.info("warm-pool: leaving %s in place (api %s); "
+                            "resync will adopt or reap it after the "
+                            "outage", name, self.apihealth.state())
+                WARM_POOL_REFILL_FAILURES.inc()
+                self._backoff(node)
             else:
                 WARM_POOL_REFILL_FAILURES.inc()
                 try:

@@ -30,8 +30,11 @@ Two families of verbs:
     shards                         shard -> owner replica table
     recovery [--evacuate NODE]     node-failure recovery plane: liveness
                                    verdicts + evacuation history
-                                   (the six above accept --read-token:
-                                   the read-only observability scope)
+    apihealth                      API-outage degraded mode: ApiHealth
+                                   verdict, cache staleness, write-behind
+                                   queue (exit 3 when not healthy)
+                                   (the observability verbs accept
+                                   --read-token: the read-only scope)
 
 The reference has no CLI at all (interaction is raw curl,
 docs/guide/QuickStart.md).
@@ -338,6 +341,36 @@ def cmd_tenants(args) -> int:
             print(f"  OPEN: {w.get('cause')} for {w.get('age_s')}s "
                   f"(trace {w.get('trace_id') or '-'})", file=sys.stderr)
     return 3 if open_windows else 0
+
+
+def cmd_apihealth(args) -> int:
+    """The master's API-outage degraded-mode pane (GET /apihealth):
+    the ApiHealth verdict (healthy/degraded/down), the store cache's
+    staleness stamps, and the write-behind queue books. Exit 3 when
+    the API is degraded/down or deferred writes are still pending —
+    scriptable like `tpumounter slo`."""
+    status, body = _http(args, "GET", "/apihealth",
+                         token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return 1
+    api = payload.get("api", {})
+    state = api.get("state", "unknown")
+    pending = payload.get("store", {}).get("writeBehind", {}) \
+        .get("pending", 0)
+    print(f"api: {state} (for {api.get('sinceS', 0)}s, "
+          f"{api.get('consecutiveFailures', 0)} consecutive failure(s))"
+          + (f"; last error: {api.get('lastError')}"
+             if api.get("lastError") and state != "healthy" else ""),
+          file=sys.stderr)
+    if pending:
+        print(f"write-behind: {pending} deferred write(s) pending "
+              f"replay", file=sys.stderr)
+    return 3 if state != "healthy" or pending else 0
 
 
 def cmd_shards(args) -> int:
@@ -706,6 +739,14 @@ def build_parser() -> argparse.ArgumentParser:
                                        "replica owns which node shard")
     _obs_common(sh)
     sh.set_defaults(fn=cmd_shards)
+
+    ah = sub.add_parser("apihealth",
+                        help="API-outage degraded mode: ApiHealth "
+                             "verdict + cache staleness + write-behind "
+                             "queue (exit 3 when not healthy or writes "
+                             "are pending)")
+    _obs_common(ah)
+    ah.set_defaults(fn=cmd_apihealth)
 
     rc = sub.add_parser("recovery", help="node-failure recovery plane: "
                                          "liveness verdicts + evacuation "

@@ -200,6 +200,49 @@ class Config:
     recovery_probe_timeout_s: float = field(default_factory=lambda: float(
         _env("RECOVERY_PROBE_TIMEOUT_S", "5")))
 
+    # --- API-outage degraded mode (k8s/health.py + store/cache.py +
+    # store/writebehind.py) ---
+    # ApiHealth state machine: consecutive outage-shaped failures
+    # (5xx / transport / timeout — k8s/errors.py is_outage) before the
+    # endpoint is judged degraded, ...
+    api_health_degraded_failures: int = field(default_factory=lambda: int(
+        _env("API_HEALTH_DEGRADED_FAILURES", "3")))
+    # ... continuous failure time before degraded hardens to down
+    # (writes then short-circuit into the write-behind queue without
+    # paying a doomed round trip), ...
+    api_health_down_after_s: float = field(default_factory=lambda: float(
+        _env("API_HEALTH_DOWN_AFTER_S", "10")))
+    # ... and consecutive successes required to recover (hysteresis: a
+    # lucky call mid-outage must not flap the fleet back into
+    # destructive mode).
+    api_health_recovery_successes: int = field(default_factory=lambda: int(
+        _env("API_HEALTH_RECOVERY_SUCCESSES", "2")))
+    # While the WRITE plane is unhealthy the store probes it at this
+    # interval (a flush attempt when writes are queued, else a cheap
+    # lease touch). Without an active probe an idle master deadlocks
+    # after heal: every subsystem is parked waiting for a healthy
+    # verdict, so nothing issues the write whose success would flip
+    # the verdict back. 0 disables (tests drive probes explicitly).
+    api_health_probe_interval_s: float = field(default_factory=lambda: float(
+        _env("API_HEALTH_PROBE_INTERVAL_S", "5")))
+    # Bounded staleness for the store's read cache: during an outage a
+    # failed list/scan is answered from cache while the cached copy is
+    # younger than this; beyond it the failure propagates (acting on
+    # arbitrarily old state is how outages corrupt things). See
+    # docs/FAQ.md on staleness bounds.
+    api_cache_max_staleness_s: float = field(default_factory=lambda: float(
+        _env("API_CACHE_MAX_STALENESS_S", "300")))
+    # Durable write-behind queue for annotation writes made while the
+    # API is unreachable: an fsync'd append-only JSONL (mirroring the
+    # worker mount ledger), replayed idempotently on reconnect.
+    # "" keeps the queue in memory only (deferral still works within
+    # the process; lost on restart) — the deployment mounts a hostPath/
+    # emptyDir and sets TPUMOUNTER_WRITEBEHIND_DIR.
+    writebehind_dir: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_WRITEBEHIND_DIR", ""))
+    writebehind_max_bytes: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_WRITEBEHIND_MAX_BYTES", str(4 * 1024 * 1024))))
+
     # --- master-side request validation ---
     # Reference accepts any int32 gpuNum incl. 0/negative at L1
     # (cmd/GPUMounter-master/main.go:31-43 parses but never range-checks);

@@ -81,18 +81,24 @@ def _post_pod_event(kube: KubeClient, pod: Pod, reason: str, message: str,
 class ElasticReconciler:
     def __init__(self, kube: KubeClient, registry, client_factory,
                  cfg=None, store: IntentStore | None = None,
-                 backoff: BackoffPolicy | None = None, shards=None):
+                 backoff: BackoffPolicy | None = None, shards=None,
+                 apihealth=None):
         """registry/client_factory: the MasterApp's WorkerRegistry and
         worker-client factory — the reconciler drives the same RPCs the
         imperative routes do. shards: optional ShardManager — when
         active, intents on nodes this replica does not own are parked
-        (their shard's owner converges them)."""
+        (their shard's owner converges them). apihealth: the ApiHealth
+        verdict (k8s/health.py) — while the API is degraded/down every
+        pass is read-only (probe + report), because the intent and pod
+        views may be stale and a destructive shrink driven from stale
+        reads is exactly the corruption an outage must not cause."""
         self.cfg = cfg or get_config()
         self.kube = kube
         self.registry = registry
         self.client_factory = client_factory
         self.store = store or IntentStore(kube, self.cfg)
         self.shards = shards
+        self.apihealth = apihealth
         self.queue = RateLimitedQueue(
             backoff=backoff or BackoffPolicy(
                 base_s=self.cfg.elastic_backoff_base_s,
@@ -180,11 +186,14 @@ class ElasticReconciler:
             logger.warning("reconcile %s failed (%s); retry in %.2fs",
                            key, exc, delay)
         else:
-            if outcome.get("phase") in ("degraded", "migrating"):
+            if outcome.get("phase") in ("degraded", "migrating",
+                                        "degraded-api"):
                 # degraded: converged to >= min_chips but < desired —
                 # keep trying for desired on the backoff schedule.
                 # migrating: paused for an in-flight migration — check
                 # back the same way until it finishes.
+                # degraded-api: the API outage parked this pass
+                # read-only — keep checking back until the API heals.
                 self.queue.retry(key)
             else:
                 self.queue.forget(key)
@@ -220,7 +229,8 @@ class ElasticReconciler:
                 pending.publish()
                 raise
             if outcome.get("phase") not in ("converged", "unmanaged",
-                                            "gone", "not-owned") \
+                                            "gone", "not-owned",
+                                            "degraded-api") \
                     or outcome.get("healed") or outcome.get("added") \
                     or outcome.get("removed_excess"):
                 pending.publish()
@@ -277,6 +287,26 @@ class ElasticReconciler:
         if address is None:
             raise ReconcileError(
                 f"no tpumounter worker on node {pod.node_name}")
+
+        if self.apihealth is not None and not self.apihealth.ok():
+            # Degraded-mode policy: the pass stays READ-ONLY. The probe
+            # is a worker RPC (no API dependency) so the status surface
+            # keeps reporting live chip counts, but mounts and — above
+            # all — destructive shrinks are parked: the intent we just
+            # read may be a stale cache entry, and removing chips a
+            # user actually raised their intent for is unrecoverable.
+            # _process re-queues on the backoff schedule; the pass
+            # converges normally once the API heals.
+            chips = self._probe(address, pod)
+            healthy_now = [c for c in chips if c.healthy]
+            logger.info("reconcile of %s parked read-only: api %s "
+                        "(actual=%d desired=%d)", key,
+                        self.apihealth.state(), len(healthy_now),
+                        intent.desired_chips)
+            return {"phase": "degraded-api",
+                    "api": self.apihealth.state(),
+                    "desired": intent.desired_chips,
+                    "actual": len(healthy_now)}
 
         chips = self._probe(address, pod)
         dead = [c for c in chips if not c.healthy]

@@ -1,9 +1,12 @@
 from gpumounter_tpu.k8s.client import (
     ApiError,
+    ApiTimeoutError,
     ConflictError,
     KubeClient,
     NotFoundError,
+    PartitionError,
     RestKubeClient,
+    ServerError,
     default_client,
     in_cluster_client,
     kubeconfig_client,
@@ -12,11 +15,14 @@ from gpumounter_tpu.k8s.types import Pod
 
 __all__ = [
     "ApiError",
+    "ApiTimeoutError",
     "ConflictError",
     "KubeClient",
     "NotFoundError",
+    "PartitionError",
     "Pod",
     "RestKubeClient",
+    "ServerError",
     "default_client",
     "in_cluster_client",
     "kubeconfig_client",

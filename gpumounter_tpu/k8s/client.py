@@ -28,36 +28,27 @@ from collections.abc import Iterator
 from typing import Any
 
 from gpumounter_tpu.faults import failpoints
+
+# The typed error hierarchy lives in k8s/errors.py (shared with the
+# ApiHealth classifier and the write-behind queue); re-exported here
+# because every subsystem historically imported it from this module.
+from gpumounter_tpu.k8s.errors import (  # noqa: F401 — re-exports
+    ApiError,
+    ApiTimeoutError,
+    ConflictError,
+    NotFoundError,
+    PartitionError,
+    ServerError,
+    is_retriable,
+    raise_for,
+)
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("k8s")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
-
-class ApiError(Exception):
-    def __init__(self, status: int, message: str = ""):
-        super().__init__(f"kubernetes api error {status}: {message}")
-        self.status = status
-        self.message = message
-
-
-class NotFoundError(ApiError):
-    def __init__(self, message: str = ""):
-        super().__init__(404, message)
-
-
-class ConflictError(ApiError):
-    def __init__(self, message: str = ""):
-        super().__init__(409, message)
-
-
-def _raise_for(status: int, body: str) -> None:
-    if status == 404:
-        raise NotFoundError(body)
-    if status == 409:
-        raise ConflictError(body)
-    raise ApiError(status, body)
+_raise_for = raise_for  # back-compat alias (failpoint injection helper)
 
 
 def inject_write_fault(op: str, namespace: str, name: str) -> None:
@@ -97,8 +88,9 @@ def patch_pod_with_retry(kube: "KubeClient", namespace: str, name: str,
         except NotFoundError:
             raise
         except ApiError as exc:
-            retriable = exc.status == 409 or exc.status >= 500
-            if not retriable or attempt >= policy.max_attempts:
+            # Typed retriability (k8s/errors.py): Conflict (merge-patch
+            # re-applies safely) and ServerError/transport only.
+            if not is_retriable(exc) or attempt >= policy.max_attempts:
                 raise
             delay = policy.delay_for(attempt)
             logger.warning(

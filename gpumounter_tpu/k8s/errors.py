@@ -1,0 +1,152 @@
+"""Typed Kubernetes API error hierarchy.
+
+Before this module, every caller that cared WHY an API call failed
+string-matched on raw status codes (`exc.status == 409 or
+exc.status >= 500` in patch_pod_with_retry, ad-hoc `status != 503`
+checks in subsystems). The degraded-mode control plane needs one shared
+vocabulary — the ApiHealth state machine (k8s/health.py) classifies
+failures by TYPE, the write-behind queue defers only on outage-shaped
+errors, and retry layers decide from isinstance checks instead of
+integer comparisons:
+
+    ApiError                 any API-layer failure (carries .status)
+      NotFoundError   404    the API ANSWERED: the object is gone
+      ConflictError   409    the API ANSWERED: CAS/version conflict
+      ServerError     5xx    the API is struggling (retriable)
+        ApiTimeoutError 504  gateway/deadline timeout
+        PartitionError  503  we cannot reach the API at all — raised
+                             for transport-level failures (connection
+                             refused/reset, TLS teardown, socket
+                             timeouts) and by the fake's partition
+                             simulator. Subclasses ServerError with
+                             status 503 so every pre-existing handler
+                             that caught ApiError-with-5xx still fires.
+
+The split that matters for health classification: NotFound/Conflict
+(and any 4xx) prove the API server is ALIVE — they are answers, not
+outages. ServerError and below are evidence toward degraded/down.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"kubernetes api error {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFoundError(ApiError):
+    def __init__(self, message: str = ""):
+        super().__init__(404, message)
+
+
+class ConflictError(ApiError):
+    def __init__(self, message: str = ""):
+        super().__init__(409, message)
+
+
+class ServerError(ApiError):
+    """5xx: the API server answered with a failure of its own. Safe to
+    retry (the request may never have been applied) and evidence toward
+    a degraded/down ApiHealth verdict."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(status, message)
+
+
+class ApiTimeoutError(ServerError):
+    """504 gateway timeout, or a client-side deadline that expired while
+    a request was in flight."""
+
+    def __init__(self, message: str = "", status: int = 504):
+        super().__init__(status, message)
+
+
+class PartitionError(ServerError):
+    """The API server is unreachable: connection refused/reset, the
+    stream died mid-body, or the fake's set_partitioned simulator.
+    Status 503 keeps every existing ApiError(5xx) handler working."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(503, message)
+
+
+def raise_for(status: int, body: str) -> None:
+    """Map an HTTP status to the typed hierarchy (the REST client's and
+    the fake's shared raise point)."""
+    if status == 404:
+        raise NotFoundError(body)
+    if status == 409:
+        raise ConflictError(body)
+    if status == 504:
+        raise ApiTimeoutError(body)
+    if status >= 500:
+        raise ServerError(status, body)
+    raise ApiError(status, body)
+
+
+#: transport-level exception types that mean "could not reach / lost the
+#: API server" — classified as PartitionError by classify_exception.
+_TRANSPORT_EXCS = (ConnectionError, BrokenPipeError, socket.timeout,
+                   TimeoutError, socket.gaierror, OSError)
+
+#: OSError subclasses that are purely LOCAL failures (an unreadable
+#: serviceaccount token file, a bad path) — never evidence the API
+#: server is unreachable. Without this carve-out a kubelet rotating the
+#: token underneath us would park the whole control plane in degraded
+#: mode against a perfectly healthy API server.
+_LOCAL_OS_EXCS = (FileNotFoundError, PermissionError, NotADirectoryError,
+                  IsADirectoryError, FileExistsError, ProcessLookupError)
+
+
+def _is_transport(exc: Exception) -> bool:
+    return isinstance(exc, _TRANSPORT_EXCS) \
+        and not isinstance(exc, _LOCAL_OS_EXCS)
+
+
+def classify_exception(exc: Exception) -> ApiError:
+    """Wrap an arbitrary client-layer exception into the typed
+    hierarchy (already-typed errors pass through). Used by the
+    health-tracking client so subscribers always see ApiError types."""
+    if isinstance(exc, ApiError):
+        return exc
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return ApiTimeoutError(str(exc) or type(exc).__name__)
+    if _is_transport(exc):
+        return PartitionError(f"{type(exc).__name__}: {exc}")
+    # http.client's connection-state errors don't share a base with
+    # ConnectionError; anything else transport-shaped lands here too.
+    name = type(exc).__module__
+    if name.startswith(("http.", "ssl")):
+        return PartitionError(f"{type(exc).__name__}: {exc}")
+    return ApiError(0, f"{type(exc).__name__}: {exc}")
+
+
+def is_retriable(exc: Exception) -> bool:
+    """May re-sending the same request succeed? Conflicts (merge-patch
+    callers re-apply safely) and any 5xx/transport failure — never
+    NotFound (the object is gone; retrying cannot help) and never other
+    4xx (the request itself is wrong)."""
+    if isinstance(exc, ConflictError):
+        return True
+    if isinstance(exc, ServerError):
+        return True
+    if isinstance(exc, ApiError):
+        return exc.status >= 500
+    return _is_transport(exc)
+
+
+def is_outage(exc: Exception) -> bool:
+    """Does this failure count as evidence the API server is degraded
+    or unreachable (vs a perfectly healthy server answering 4xx)? The
+    ApiHealth state machine's classification rule."""
+    if isinstance(exc, ServerError):
+        return True
+    if isinstance(exc, ApiError):
+        return exc.status >= 500 or exc.status == 0
+    return _is_transport(exc) or \
+        type(exc).__module__.startswith(("http.", "ssl"))

@@ -73,9 +73,14 @@ class FakeKubeClient(KubeClient):
         self._pods: dict[tuple[str, str], dict] = {}
         self._nodes: dict[str, dict] = {}
         #: API-partition simulation (recovery/chaos tests): while set,
-        #: every call raises ApiError(503) — what a partitioned master
-        #: sees from the API server.
+        #: affected calls raise PartitionError (typed 503) — what a
+        #: partitioned master sees from the API server. The mode makes
+        #: the partition asymmetric: "full" fails everything, "reads"
+        #: fails only get/list/watch, "writes" only create/delete/
+        #: patch/update — the half-broken LB / one-way firewall shapes
+        #: a real outage takes.
         self._partitioned = False
+        self._partition_mode = "full"
         self._leases: dict[tuple[str, str], dict] = {}
         self._lease_rv = itertools.count(1)
         self._lock = threading.Condition()
@@ -120,21 +125,32 @@ class FakeKubeClient(KubeClient):
 
     # --- KubeClient surface ---
 
-    def _check_partition(self) -> None:
-        if self._partitioned:
-            from gpumounter_tpu.k8s.client import ApiError
-            raise ApiError(503, "fake apiserver partitioned "
-                                "(set_partitioned)")
+    def _check_partition(self, kind: str = "read") -> None:
+        if not self._partitioned:
+            return
+        mode = self._partition_mode
+        if mode == "full" or (mode == "reads" and kind == "read") \
+                or (mode == "writes" and kind == "write"):
+            from gpumounter_tpu.k8s.client import PartitionError
+            raise PartitionError(
+                f"fake apiserver partitioned (set_partitioned, "
+                f"mode={mode}, op={kind})")
 
-    def set_partitioned(self, partitioned: bool) -> None:
+    def set_partitioned(self, partitioned: bool,
+                        mode: str = "full") -> None:
         """Simulate a network partition between this client's holder and
-        the API server: every call fails 503 until cleared. The recovery
-        chaos scenarios use it to model a stale master that can still
-        reach workers but not the cluster state."""
+        the API server: affected calls fail with a typed PartitionError
+        until cleared. The recovery chaos scenarios use it to model a
+        stale master that can still reach workers but not the cluster
+        state; mode="reads"/"writes" makes the break asymmetric
+        (reads fail while writes succeed, or vice versa)."""
+        if mode not in ("full", "reads", "writes"):
+            raise ValueError(f"unknown partition mode {mode!r}")
         self._partitioned = bool(partitioned)
+        self._partition_mode = mode
 
     def get_pod(self, namespace: str, name: str) -> dict:
-        self._check_partition()
+        self._check_partition("read")
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -142,7 +158,7 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(pod)
 
     def create_pod(self, namespace: str, manifest: dict) -> dict:
-        self._check_partition()
+        self._check_partition("write")
         # Same injection surface as the REST client, so chaos schedules
         # hit the fake API server exactly like a real one.
         inject_write_fault("create_pod", namespace,
@@ -219,7 +235,7 @@ class FakeKubeClient(KubeClient):
                                  namespace, name)
 
     def delete_pod(self, namespace: str, name: str, grace_period_seconds: int = 0) -> None:
-        self._check_partition()
+        self._check_partition("write")
         try:
             inject_write_fault("delete_pod", namespace, name)
         except NotFoundError:
@@ -234,7 +250,7 @@ class FakeKubeClient(KubeClient):
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:
-        self._check_partition()
+        self._check_partition("read")
         # Filter FIRST, deepcopy only the matches: a selector LIST over
         # a 1k-pod cluster used to deepcopy every pod (the fake's
         # dominant cost at fleet scale — the registry, the reconciler
@@ -255,7 +271,7 @@ class FakeKubeClient(KubeClient):
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
                    resource_version: str = "") -> Iterator[tuple[str, dict]]:
-        self._check_partition()
+        self._check_partition("read")
         # Subscribe EAGERLY (cursor captured at call time, not at first
         # next()): callers rely on open-watch-then-recheck to close the
         # missed-event window (KubeClient.wait_for_pod).
@@ -312,7 +328,7 @@ class FakeKubeClient(KubeClient):
                 return
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
-        self._check_partition()
+        self._check_partition("write")
         inject_write_fault("patch_pod", namespace, name)
         with self._lock:
             pod = self._pods.get((namespace, name))
@@ -336,7 +352,7 @@ class FakeKubeClient(KubeClient):
     # property the shard manager's single-owner invariant rests on.
 
     def get_lease(self, namespace: str, name: str) -> dict:
-        self._check_partition()
+        self._check_partition("read")
         with self._lock:
             lease = self._leases.get((namespace, name))
             if lease is None:
@@ -344,7 +360,7 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(lease)
 
     def create_lease(self, namespace: str, manifest: dict) -> dict:
-        self._check_partition()
+        self._check_partition("write")
         inject_write_fault("create_lease", namespace,
                            manifest.get("metadata", {}).get("name", ""))
         lease = copy.deepcopy(manifest)
@@ -363,7 +379,7 @@ class FakeKubeClient(KubeClient):
 
     def update_lease(self, namespace: str, name: str,
                      manifest: dict) -> dict:
-        self._check_partition()
+        self._check_partition("write")
         inject_write_fault("update_lease", namespace, name)
         with self._lock:
             current = self._leases.get((namespace, name))
@@ -386,7 +402,7 @@ class FakeKubeClient(KubeClient):
     # --- core/v1 Nodes (recovery plane) ---
 
     def get_node(self, name: str) -> dict:
-        self._check_partition()
+        self._check_partition("read")
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
@@ -394,7 +410,7 @@ class FakeKubeClient(KubeClient):
             return copy.deepcopy(node)
 
     def list_nodes(self) -> list[dict]:
-        self._check_partition()
+        self._check_partition("read")
         with self._lock:
             return [copy.deepcopy(n) for n in self._nodes.values()]
 

@@ -1,0 +1,372 @@
+"""ApiHealth: a per-endpoint health state machine for the Kubernetes API.
+
+Every piece of the control plane round-trips through the API server —
+intents, migration journals, shard leases, slave bookings — so an
+API-server outage stalls or corrupts exactly the operations the system
+exists to keep alive. The first step of riding one out is KNOWING:
+instead of each subsystem discovering the outage through its own
+timeout, one state machine per API endpoint classifies every call
+outcome and publishes a verdict the whole process shares:
+
+    healthy    calls succeed (or fail with 4xx answers — an answer
+               proves the server is alive)
+    degraded   `api_health_degraded_failures` consecutive outage-shaped
+               failures (5xx / transport / timeout — k8s/errors.py
+               is_outage). Subsystems park destructive work; reads may
+               serve from cache.
+    down       the failure streak has lasted `api_health_down_after_s`
+               of continuous wall time. Mutating writes short-circuit
+               into the write-behind queue without paying a doomed
+               round trip.
+
+Hysteresis: recovery requires `api_health_recovery_successes`
+CONSECUTIVE successes — one lucky call mid-outage must not flip the
+fleet back into destructive mode, fail again, flip back (flapping is
+how a partial partition turns into a shrink/grow fight).
+
+The instance is process-global per endpooint (one process talks to one
+API server): `api_health()` returns the default endpoint's machine, and
+`HealthTrackingKubeClient` feeds it from every call on the wrapped
+client. Subscribers (the write-behind flusher, logs) get transition
+callbacks OUTSIDE the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from gpumounter_tpu.k8s.errors import classify_exception, is_outage
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("k8s.health")
+
+HEALTHY, DEGRADED, DOWN = "healthy", "degraded", "down"
+_LEVEL = {HEALTHY: 0, DEGRADED: 1, DOWN: 2}
+
+API_HEALTH_STATE = REGISTRY.gauge(
+    "tpumounter_api_health_state",
+    "Kubernetes API health verdict per endpoint "
+    "(0=healthy, 1=degraded, 2=down)")
+API_HEALTH_TRANSITIONS = REGISTRY.counter(
+    "tpumounter_api_health_transitions_total",
+    "ApiHealth state transitions by endpoint and new state")
+API_CALL_FAILURES = REGISTRY.counter(
+    "tpumounter_api_call_failures_total",
+    "Outage-shaped Kubernetes API call failures by error class")
+
+
+class _PlaneState:
+    """One op plane's (read or write) streak accounting. An API
+    partition is often ASYMMETRIC — writes fail while reads succeed
+    through a stale LB, or vice versa — and a single shared streak
+    would let the healthy plane's successes mask the broken one
+    forever. Each plane judges itself; the endpoint verdict is the
+    worst plane."""
+
+    __slots__ = ("state", "failures", "successes", "first_failure_at",
+                 "last_error")
+
+    def __init__(self):
+        self.state = HEALTHY
+        self.failures = 0
+        self.successes = 0
+        self.first_failure_at: float | None = None
+        self.last_error = ""
+
+
+class ApiHealth:
+    """One endpoint's state machine. Thread-safe; clock injectable."""
+
+    PLANES = ("read", "write")
+
+    def __init__(self, cfg=None, endpoint: str = "kube", now=None):
+        from gpumounter_tpu.config import get_config
+        cfg = cfg or get_config()
+        self.endpoint = endpoint
+        self.degraded_failures = max(
+            1, int(cfg.api_health_degraded_failures))
+        self.down_after_s = float(cfg.api_health_down_after_s)
+        self.recovery_successes = max(
+            1, int(cfg.api_health_recovery_successes))
+        self._now = now or time.monotonic
+        self._lock = threading.Lock()
+        self._planes = {plane: _PlaneState() for plane in self.PLANES}
+        self._state = HEALTHY            # worst plane (the verdict)
+        self._since = self._now()        # when the verdict was entered
+        self._transitions = 0
+        #: callbacks fired (old_state, new_state) OUTSIDE the lock.
+        self._subscribers: list = []
+        API_HEALTH_STATE.set(0.0, endpoint=endpoint)
+
+    # --- observation (fed by HealthTrackingKubeClient) ---
+
+    def record_success(self, kind: str = "read") -> None:
+        self._record(True, None, kind)
+
+    def record_failure(self, exc: Exception, kind: str = "read") -> None:
+        """An outage-shaped failure (callers pre-filter with is_outage;
+        a 4xx answer should be recorded as SUCCESS — the server is
+        alive)."""
+        self._record(False, exc, kind)
+
+    def observe(self, exc: Exception | None, kind: str = "read") -> None:
+        """One call outcome on one plane ("read" or "write"): None =
+        success; an exception is classified — outage-shaped failures
+        count against the plane, 4xx answers count FOR it (the server
+        answered)."""
+        if exc is None or not is_outage(exc):
+            self._record(True, None, kind)
+        else:
+            self._record(False, exc, kind)
+
+    def _record(self, ok: bool, exc: Exception | None, kind: str) -> None:
+        now = self._now()
+        transition: tuple[str, str] | None = None
+        with self._lock:
+            plane = self._planes.get(kind) or self._planes["read"]
+            if ok:
+                plane.successes += 1
+                plane.failures = 0
+                plane.first_failure_at = None
+                if plane.state != HEALTHY and \
+                        plane.successes >= self.recovery_successes:
+                    plane.state = HEALTHY
+            else:
+                typed = classify_exception(exc)
+                plane.last_error = \
+                    f"{type(typed).__name__}: {typed.message or typed}"
+                API_CALL_FAILURES.inc(kind=type(typed).__name__)
+                plane.successes = 0
+                plane.failures += 1
+                if plane.first_failure_at is None:
+                    plane.first_failure_at = now
+                if plane.failures >= self.degraded_failures:
+                    if now - plane.first_failure_at >= self.down_after_s:
+                        plane.state = DOWN
+                    elif plane.state == HEALTHY:
+                        plane.state = DEGRADED
+            old = self._state
+            worst = max((p.state for p in self._planes.values()),
+                        key=_LEVEL.get)
+            if worst != old:
+                self._state = worst
+                self._since = now
+                self._transitions += 1
+                transition = (old, worst)
+                API_HEALTH_STATE.set(float(_LEVEL[worst]),
+                                     endpoint=self.endpoint)
+                API_HEALTH_TRANSITIONS.inc(endpoint=self.endpoint,
+                                           state=worst)
+            subscribers = list(self._subscribers) if transition else []
+            last_error = self._last_error_locked()
+        if transition:
+            old_state, new_state = transition
+            log = logger.warning if new_state != HEALTHY else logger.info
+            log("api endpoint %r %s -> %s (%s)", self.endpoint,
+                old_state, new_state,
+                last_error if new_state != HEALTHY else "recovered")
+            for fn in subscribers:
+                try:
+                    fn(old_state, new_state)
+                except Exception:  # noqa: BLE001 — advisory hooks
+                    logger.exception("api-health subscriber failed")
+
+    def _last_error_locked(self) -> str:
+        for plane in self._planes.values():
+            if plane.state != HEALTHY and plane.last_error:
+                return plane.last_error
+        for plane in self._planes.values():
+            if plane.last_error:
+                return plane.last_error
+        return ""
+
+    # --- verdicts ---
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def plane_state(self, kind: str) -> str:
+        with self._lock:
+            plane = self._planes.get(kind)
+            return plane.state if plane is not None else HEALTHY
+
+    def ok(self) -> bool:
+        """True only when every plane is healthy — the gate destructive
+        subsystem actions check before acting on API-derived state (a
+        working read plane is no license to mutate when writes are
+        black-holed, and stale writes are no license to trust reads)."""
+        with self._lock:
+            return self._state == HEALTHY
+
+    def is_down(self) -> bool:
+        with self._lock:
+            return self._state == DOWN
+
+    def write_plane_ok(self) -> bool:
+        """True while writes still land — the write-behind queue defers
+        only when THIS plane is broken (a read-side partition must not
+        reroute perfectly deliverable writes through the queue)."""
+        with self._lock:
+            return self._planes["write"].state == HEALTHY
+
+    def subscribe(self, fn) -> None:
+        """fn(old_state, new_state) on every overall transition,
+        outside the lock (a slow subscriber cannot block
+        observation)."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def payload(self) -> dict:
+        with self._lock:
+            return {
+                "endpoint": self.endpoint,
+                "state": self._state,
+                "sinceS": round(self._now() - self._since, 3),
+                "consecutiveFailures": max(
+                    p.failures for p in self._planes.values()),
+                "transitions": self._transitions,
+                "lastError": self._last_error_locked(),
+                "planes": {
+                    kind: {
+                        "state": plane.state,
+                        "consecutiveFailures": plane.failures,
+                        "consecutiveSuccesses": plane.successes,
+                        "lastError": plane.last_error,
+                    } for kind, plane in self._planes.items()},
+                "config": {
+                    "degradedFailures": self.degraded_failures,
+                    "downAfterS": self.down_after_s,
+                    "recoverySuccesses": self.recovery_successes,
+                },
+            }
+
+    def reset(self) -> None:
+        """Test hook (conftest): back to a pristine healthy machine."""
+        with self._lock:
+            self._planes = {plane: _PlaneState()
+                            for plane in self.PLANES}
+            self._state = HEALTHY
+            self._since = self._now()
+            self._transitions = 0
+            self._subscribers = []
+            API_HEALTH_STATE.set(0.0, endpoint=self.endpoint)
+
+
+# --- the process-global per-endpoint registry ---
+
+_registry_lock = threading.Lock()
+_instances: dict[str, ApiHealth] = {}
+
+
+def api_health(endpoint: str = "kube", cfg=None) -> ApiHealth:
+    """The process-wide ApiHealth machine for one endpoint (a process
+    talks to one API server, so master routes, worker ops, the store
+    and every subsystem share a single verdict)."""
+    with _registry_lock:
+        instance = _instances.get(endpoint)
+        if instance is None:
+            instance = ApiHealth(cfg=cfg, endpoint=endpoint)
+            _instances[endpoint] = instance
+        return instance
+
+
+def reset_all() -> None:
+    """Test hook: drop every endpoint machine (conftest runs this
+    between tests so one test's simulated outage cannot leak a
+    degraded verdict into the next)."""
+    with _registry_lock:
+        for instance in _instances.values():
+            instance.reset()
+        _instances.clear()
+
+
+class HealthTrackingKubeClient(KubeClient):
+    """Delegating KubeClient that feeds every call outcome into an
+    ApiHealth machine. Unknown attributes (fake-only test helpers like
+    set_partitioned / create_node) pass through to the inner client, so
+    wrapping is transparent to tests holding the wrapper."""
+
+    def __init__(self, inner: KubeClient, health: ApiHealth | None = None):
+        self.inner = inner
+        self.health = health or api_health()
+
+    def __getattr__(self, name):
+        # Only called for attributes not defined here: fake-client test
+        # helpers, ad-hoc extensions. Not health-tracked (they are not
+        # API calls in production).
+        return getattr(self.inner, name)
+
+    def _call(self, kind: str, name: str, *args, **kwargs):
+        try:
+            out = getattr(self.inner, name)(*args, **kwargs)
+        except NotImplementedError:
+            raise  # capability gap, not an API outcome
+        except Exception as exc:  # noqa: BLE001 — classification boundary
+            self.health.observe(exc, kind)
+            raise
+        self.health.observe(None, kind)
+        return out
+
+    # --- the KubeClient surface, call-tracked per plane ---
+
+    def get_pod(self, namespace, name):
+        return self._call("read", "get_pod", namespace, name)
+
+    def create_pod(self, namespace, manifest):
+        return self._call("write", "create_pod", namespace, manifest)
+
+    def delete_pod(self, namespace, name, grace_period_seconds=0):
+        return self._call("write", "delete_pod", namespace, name,
+                          grace_period_seconds=grace_period_seconds)
+
+    def list_pods(self, namespace=None, label_selector="",
+                  field_selector=""):
+        return self._call("read", "list_pods", namespace,
+                          label_selector=label_selector,
+                          field_selector=field_selector)
+
+    def patch_pod(self, namespace, name, patch):
+        return self._call("write", "patch_pod", namespace, name, patch)
+
+    def watch_pods(self, namespace, *, label_selector="",
+                   field_selector="", timeout_s=60.0,
+                   resource_version=""):
+        # The OPEN is tracked (it is the call that fails during an
+        # outage); the stream itself is consumed by the caller.
+        return self._call("read", "watch_pods", namespace,
+                          label_selector=label_selector,
+                          field_selector=field_selector,
+                          timeout_s=timeout_s,
+                          resource_version=resource_version)
+
+    def create_event(self, namespace, manifest):
+        return self._call("write", "create_event", namespace, manifest)
+
+    def get_lease(self, namespace, name):
+        return self._call("read", "get_lease", namespace, name)
+
+    def create_lease(self, namespace, manifest):
+        return self._call("write", "create_lease", namespace, manifest)
+
+    def update_lease(self, namespace, name, manifest):
+        return self._call("write", "update_lease", namespace, name,
+                          manifest)
+
+    def get_node(self, name):
+        return self._call("read", "get_node", name)
+
+    def list_nodes(self):
+        return self._call("read", "list_nodes")
+
+
+def wrap_health(kube: KubeClient,
+                health: ApiHealth | None = None) -> KubeClient:
+    """Idempotent wrap: an already-tracking client is returned as-is
+    (MasterApp and the worker service both wrap defensively)."""
+    if isinstance(kube, HealthTrackingKubeClient):
+        return kube
+    return HealthTrackingKubeClient(kube, health)

@@ -188,8 +188,28 @@ class WorkerRegistry:
         self._prune_breaker()
         self._primed.set()
 
+    #: watch-reconnect backoff. A stream that lived for less than
+    #: MIN_HEALTHY_WATCH_S did no useful work — the classic case is the
+    #: fake/apiserver ending it immediately (backlog overrun -> the
+    #: 410-Gone-like end, or a flapping LB), and the old loop would
+    #: re-LIST + re-open in a zero-sleep spin exactly when the API was
+    #: most overloaded. Consecutive short-lived streams now back off
+    #: exponentially with full jitter (so N replicas' registries don't
+    #: reconnect in lockstep); one healthy stream resets the clock.
+    MIN_HEALTHY_WATCH_S = 5.0
+    WATCH_BACKOFF_BASE_S = 0.5
+    WATCH_BACKOFF_CAP_S = 15.0
+
+    def _watch_backoff(self, failures: int) -> float:
+        import random
+        cap = min(self.WATCH_BACKOFF_CAP_S,
+                  self.WATCH_BACKOFF_BASE_S * 2 ** max(0, failures - 1))
+        return random.uniform(cap / 2, cap)
+
     def _watch_loop(self) -> None:
+        short_streams = 0
         while not self._stop.is_set():
+            opened = time.monotonic()
             try:
                 # (Re)prime, then stream deltas. Re-LIST on every watch
                 # re-open keeps the cache honest across missed windows.
@@ -200,8 +220,32 @@ class WorkerRegistry:
                         return
                     self._apply(etype, Pod(pod_json))
             except Exception as exc:  # noqa: BLE001 — keep the informer up
-                logger.warning("worker watch failed (%s); retrying", exc)
-                self._stop.wait(2.0)
+                # A stream that lived past the healthy threshold before
+                # erroring did useful work: reset the escalation (count
+                # this failure as the first), else hours-apart transport
+                # errors would ratchet the backoff to its cap forever.
+                if time.monotonic() - opened >= self.MIN_HEALTHY_WATCH_S:
+                    short_streams = 1
+                else:
+                    short_streams += 1
+                delay = self._watch_backoff(short_streams)
+                logger.warning("worker watch failed (%s); retrying in "
+                               "%.1fs", exc, delay)
+                self._stop.wait(delay)
+                continue
+            if time.monotonic() - opened >= self.MIN_HEALTHY_WATCH_S:
+                short_streams = 0
+                continue
+            # The stream ended almost immediately without an error (the
+            # fake's trimmed-backlog end / a real 410 Gone): this is the
+            # tight-loop shape — back off with jitter before the
+            # re-LIST + re-open.
+            short_streams += 1
+            delay = self._watch_backoff(short_streams)
+            logger.info("worker watch stream ended after %.2fs "
+                        "(%d short stream(s)); re-opening in %.1fs",
+                        time.monotonic() - opened, short_streams, delay)
+            self._stop.wait(delay)
 
     def _prune_breaker(self) -> None:
         """Evicted workers take their breaker state (and any standing
@@ -270,6 +314,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/shards$"), "shards"),
     ("GET", re.compile(r"^/$"), "index"),
     ("GET", re.compile(r"^/healthz$"), "healthz"),
+    # API-outage degraded mode (k8s/health.py + store/cache.py): the
+    # ApiHealth verdict, the store cache's staleness stamps, and the
+    # write-behind queue's books — the RUNBOOK's "Surviving an
+    # API-server outage" pane.
+    ("GET", re.compile(r"^/apihealth$"), "apihealth"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/workers$"), "workers"),
     ("POST", re.compile(r"^/addslice$"), "addslice"),
@@ -340,7 +389,8 @@ class MasterApp:
     #: /fleet and /slo — which reveal pod/tenant names and chip
     #: movements — require the mutate token.
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
-                             "shards", "recovery", "tenants"})
+                             "shards", "recovery", "tenants",
+                             "apihealth"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -365,14 +415,31 @@ class MasterApp:
         # secret requires the explicit TPUMOUNTER_AUTH=insecure opt-in.
         self._token = required_token(self.cfg, "master HTTP gateway")
         self._read_token = resolve_read_token(self.cfg)
-        self.kube = kube
+        # API-outage degraded mode (k8s/health.py): every API call this
+        # replica makes feeds one per-endpoint ApiHealth state machine
+        # (healthy/degraded/down with hysteresis), surfaced on /healthz
+        # + /apihealth and consulted by every subsystem before it acts
+        # destructively on API-derived state.
+        from gpumounter_tpu.k8s.health import api_health, wrap_health
+        self.apihealth = api_health(cfg=self.cfg)
+        self.kube = wrap_health(kube, self.apihealth)
+        kube = self.kube
         # All durable master state flows through one MasterStore
         # (store/base.py): registry, intents, and journals are derived
         # views any replica — this one restarted, or a peer taking over
-        # a shard — rebuilds identically from the cluster.
+        # a shard — rebuilds identically from the cluster. The default
+        # store wears the degraded-mode wrapper (store/cache.py): reads
+        # fall back to a bounded-staleness cache during an outage, and
+        # annotation writes defer into the durable write-behind queue,
+        # replayed exactly-once on reconnect.
         if store is None:
-            from gpumounter_tpu.store import KubeMasterStore
-            store = KubeMasterStore(kube, self.cfg)
+            from gpumounter_tpu.store import (
+                CachedMasterStore,
+                KubeMasterStore,
+            )
+            store = CachedMasterStore(
+                KubeMasterStore(kube, self.cfg), cfg=self.cfg,
+                apihealth=self.apihealth)
         self.store = store
         # Shard ownership (master/shard.py): inactive by default (one
         # master owns everything, zero overhead); master/main.py starts
@@ -421,14 +488,15 @@ class MasterApp:
         self.elastic = ElasticReconciler(
             kube, self.registry, self._client_factory, cfg=self.cfg,
             store=IntentStore(kube, self.cfg, backend=self.store),
-            shards=self.shards)
+            shards=self.shards, apihealth=self.apihealth)
         # Live-migration orchestrator: shares the registry and worker
         # client factory; interrupted migrations are re-adopted by an
         # explicit migrations.resume_interrupted() (master/main.py).
         from gpumounter_tpu.migrate import MigrationCoordinator
         self.migrations = MigrationCoordinator(
             kube, self.registry, self._client_factory, cfg=self.cfg,
-            store=self.store, shards=self.shards)
+            store=self.store, shards=self.shards,
+            apihealth=self.apihealth)
         # Fleet telemetry plane: the collector federates every worker's
         # telemetry over the same pooled channels and feeds the SLO
         # burn-rate engine; breaches land as k8s Events + audit records.
@@ -451,7 +519,7 @@ class MasterApp:
         self.recovery = RecoveryController(
             kube, self.registry, self._client_factory, cfg=self.cfg,
             store=self.store, shards=self.shards, elastic=self.elastic,
-            migrations=self.migrations)
+            migrations=self.migrations, apihealth=self.apihealth)
 
     # --- plumbing ---
 
@@ -480,7 +548,8 @@ class MasterApp:
     #: query (RUNBOOK "Debugging a slow mount"). /fleet and /slo are
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
-                                 "slo", "shards", "recovery", "tenants"})
+                                 "slo", "shards", "recovery", "tenants",
+                                 "apihealth"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -662,7 +731,26 @@ class MasterApp:
         return 200, "text/plain", "tpumounter master\n"
 
     def _route_healthz(self, match, body, headers):
-        return 200, "text/plain", "ok\n"
+        # Liveness stays 200 through an API outage — restarting the
+        # master is exactly the wrong reflex then (it would dump the
+        # read cache and the in-memory half of the degraded state); the
+        # verdict rides in the body for operators and the CLI.
+        state = self.apihealth.state()
+        if state == "healthy":
+            return 200, "text/plain", "ok\n"
+        return 200, "text/plain", f"ok\napi: {state}\n"
+
+    def _route_apihealth(self, match, body, headers):
+        """The degraded-mode pane: ApiHealth state machine verdict +
+        the store's cache staleness stamps + write-behind queue books
+        (see `tpumounter apihealth` and the RUNBOOK walkthrough)."""
+        import json as jsonlib
+        payload = {"api": self.apihealth.payload()}
+        store_payload = getattr(self.store, "payload", None)
+        if callable(store_payload):
+            payload["store"] = store_payload()
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
 
     def _route_metrics(self, match, body, headers):
         accept = next((v for k, v in headers.items()

@@ -101,11 +101,20 @@ class MigrationCoordinator:
     ABORTABLE_PHASES = ("quiesce", "drain", "remount")
 
     def __init__(self, kube: KubeClient, registry, client_factory,
-                 cfg=None, store=None, shards=None):
+                 cfg=None, store=None, shards=None, apihealth=None):
         self.cfg = cfg or get_config()
         self.kube = kube
         self.registry = registry
         self.client_factory = client_factory
+        #: ApiHealth verdict (k8s/health.py): while the API is
+        #: degraded/down the machine PAUSES at its next phase boundary
+        #: — every transition is journaled through the store, and
+        #: driving quiesce/drain/remount against a cluster whose state
+        #: we cannot read or persist risks a half-moved tenant whose
+        #: journal never recorded the move. The journal write itself
+        #: rides the store's write-behind queue, so the pause is
+        #: durable locally even though the API cannot take it yet.
+        self.apihealth = apihealth
         # Durable state (journals, phase/lock stamps) goes through the
         # MasterStore seam: any replica rebuilds the same view, and a
         # shard takeover re-drives interrupted journals from it.
@@ -336,6 +345,7 @@ class MigrationCoordinator:
             while journal["phase"] != PHASE_DONE:
                 phase = journal["phase"]
                 final_phase = phase
+                self._await_api_healthy(journal)
                 if mid in self._aborts and phase in self.ABORTABLE_PHASES:
                     raise _Aborted(f"abort requested during {phase}")
                 # Crash site at every journal-phase boundary: the chaos
@@ -344,9 +354,32 @@ class MigrationCoordinator:
                 # proves resume_interrupted() re-drives to a terminal
                 # state from whatever the journal recorded.
                 started = time.monotonic()
-                with trace.span(f"migrate.{phase}", id=mid):
-                    failpoints.fire(f"migrate.phase.{phase}", id=mid)
-                    next_phase = getattr(self, f"_phase_{phase}")(journal)
+                try:
+                    with trace.span(f"migrate.{phase}", id=mid):
+                        failpoints.fire(f"migrate.phase.{phase}", id=mid)
+                        next_phase = getattr(self,
+                                             f"_phase_{phase}")(journal)
+                except (CrashError, _Aborted):
+                    raise
+                except Exception as exc:  # noqa: BLE001 — outage check
+                    if self.apihealth is not None \
+                            and not self.apihealth.ok():
+                        # The phase died BECAUSE the API went away
+                        # mid-phase (or its failure is at least
+                        # unjudgeable while it is away). Rolling back
+                        # now would drive MORE mutations against a
+                        # cluster we cannot read or journal to — hold
+                        # at this boundary instead; every phase is
+                        # re-entrant, so the re-run after the API
+                        # heals absorbs whatever half-landed. A real
+                        # (non-outage) failure re-raises on the
+                        # post-heal re-run and rolls back normally.
+                        logger.warning(
+                            "migration %s: phase %s failed during api "
+                            "outage (%s); holding at boundary for "
+                            "post-heal retry", mid, phase, exc)
+                        continue  # loop top: _await_api_healthy pauses
+                    raise
                 elapsed = time.monotonic() - started
                 MIGRATION_PHASE_DURATION.observe(elapsed, phase=phase)
                 journal["phase_durations_s"][phase] = round(elapsed, 3)
@@ -414,6 +447,38 @@ class MigrationCoordinator:
             with self._lock:
                 self._aborts.discard(mid)
                 self._threads.pop(mid, None)
+
+    def _await_api_healthy(self, journal: dict) -> None:
+        """Degraded-mode pause: hold the machine at this phase boundary
+        (the last journaled transition — the nearest SAFE point: every
+        phase is re-entrant from it) until the ApiHealth verdict is
+        healthy again. The pause is journaled locally — the persist
+        rides the store's write-behind queue while the API is down — so
+        a master crash during the outage resumes from exactly here, and
+        operators see pausedForApi in /migrations. An abort request in
+        an abortable phase breaks the wait (the abort lands at the
+        boundary we are already holding)."""
+        if self.apihealth is None or self.apihealth.ok():
+            return
+        mid = journal["id"]
+        logger.warning(
+            "migration %s pausing at phase boundary %r: api %s",
+            mid, journal["phase"], self.apihealth.state())
+        journal["paused_for_api"] = True
+        try:
+            self._persist(journal)
+        except Exception as exc:  # noqa: BLE001 — the pause itself must
+            # not kill the machine; the in-memory copy still records it
+            logger.warning("pause journal persist failed: %s", exc)
+        while not self.apihealth.ok():
+            if mid in self._aborts \
+                    and journal["phase"] in self.ABORTABLE_PHASES:
+                return  # the abort check right after the wait fires
+            time.sleep(self.cfg.migrate_poll_interval_s)
+        journal.pop("paused_for_api", None)
+        logger.info("migration %s resuming from phase %r: api healthy",
+                    mid, journal["phase"])
+        self._persist(journal)
 
     # --- phases (each idempotent under re-entry after a master crash) ---
 
@@ -715,7 +780,22 @@ class MigrationCoordinator:
     # --- plumbing ---
 
     def _scan(self) -> list[dict]:
-        return self.store.scan_journals()
+        # Last-resort degradation ABOVE the store's staleness cache:
+        # when even the cached answer is unavailable (no cache yet, or
+        # past the staleness bound), an outage degrades the scan to the
+        # in-memory view instead of failing /migrations — and
+        # resume_interrupted simply adopts nothing until the API heals.
+        from gpumounter_tpu.k8s.errors import is_outage
+        try:
+            return self.store.scan_journals()
+        except Exception as exc:  # noqa: BLE001 — outage boundary
+            if not is_outage(exc):
+                raise
+            logger.warning("migration journal scan degraded to the "
+                           "in-memory view: %s", exc)
+            with self._lock:
+                return [copy.deepcopy(j) for j in
+                        self._journals.values()]
 
     def _persist(self, journal: dict) -> None:
         src = journal["source"]

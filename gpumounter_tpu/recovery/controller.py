@@ -90,9 +90,19 @@ class RecoveryController:
     driving check_once directly)."""
 
     def __init__(self, kube, registry, client_factory, cfg=None,
-                 store=None, shards=None, elastic=None, migrations=None):
+                 store=None, shards=None, elastic=None, migrations=None,
+                 apihealth=None):
         self.cfg = cfg or get_config()
         self.kube = kube
+        #: ApiHealth verdict (k8s/health.py): while the API is
+        #: degraded/down, AUTOMATIC evacuations are suspended — an
+        #: evacuation is the most destructive thing this plane does,
+        #: and during an outage every corroborating signal (Node
+        #: readiness, registry freshness) is stale or absent. Nodes
+        #: stay suspect until the API heals and the evidence is fresh.
+        #: The manual POST /recovery/evacuate path is NOT gated: an
+        #: operator who confirmed the death out-of-band outranks us.
+        self.apihealth = apihealth
         self.registry = registry
         self.client_factory = client_factory
         self.store = store
@@ -252,6 +262,20 @@ class RecoveryController:
                 >= self.cfg.recovery_grace_s)
         if not confirmed:
             return "suspect"
+        if self.apihealth is not None and not self.apihealth.ok():
+            # Degraded-mode policy: never evacuate on stale data. The
+            # node may look dead only because WE are partitioned from
+            # the API (and possibly from it); releasing its bookings
+            # and re-driving its intents would dismantle a healthy
+            # tenant. Stay suspect; the confirmation clock holds.
+            with self._lock:
+                self._nodes[node]["reason"] = (
+                    f"{why}; api {self.apihealth.state()} — evacuation "
+                    f"suspended until the API heals")
+            logger.warning("node %s confirmed unresponsive but api is "
+                           "%s; evacuation suspended (stale evidence)",
+                           node, self.apihealth.state())
+            return "suspect"
         # Corroborate with the cluster before the point of no return.
         # Evacuation needs POSITIVE evidence beyond unresponsiveness:
         # the Node object NotReady, or the worker pod gone from the
@@ -368,8 +392,18 @@ class RecoveryController:
         nothing but bookkeeping) and warm holders (the refiller on the
         replacement worker restocks). Deleting an already-deleted pod
         no-ops, so replaying an evacuation cannot double-free."""
-        pods = (self.store.list_pool_pods(node)
-                if self.store is not None else [])
+        try:
+            pods = (self.store.list_pool_pods(node)
+                    if self.store is not None else [])
+        except Exception as exc:  # noqa: BLE001 — outage boundary:
+            # even the store's staleness cache could not answer. The
+            # bookings stay held (deletes are idempotent; the next
+            # evacuation replay or the reaper releases them) — never
+            # fail the evacuation record over bookkeeping.
+            logger.warning("pool pod list for %s failed during "
+                           "evacuation; bookings deferred: %s",
+                           node, exc)
+            pods = []
         released = []
         for pod_json in pods:
             name = Pod(pod_json).name

@@ -3,10 +3,16 @@
 `MasterStore` (store/base.py) is the full durable-state surface of a
 master — worker registry, elastic intents, migration journals — and
 `KubeMasterStore` (store/k8s.py) is the default annotation-persisted
-backend. See store/base.py for the design stance.
+backend. `CachedMasterStore` (store/cache.py) wraps any backend with
+the API-outage degraded mode: a bounded-staleness read cache plus a
+durable write-behind queue (store/writebehind.py) replayed
+exactly-once on reconnect. See store/base.py for the design stance.
 """
 
 from gpumounter_tpu.store.base import MasterStore
+from gpumounter_tpu.store.cache import CachedMasterStore
 from gpumounter_tpu.store.k8s import KubeMasterStore
+from gpumounter_tpu.store.writebehind import WriteBehindQueue
 
-__all__ = ["MasterStore", "KubeMasterStore"]
+__all__ = ["MasterStore", "KubeMasterStore", "CachedMasterStore",
+           "WriteBehindQueue"]

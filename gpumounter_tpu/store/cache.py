@@ -1,0 +1,316 @@
+"""CachedMasterStore: the MasterStore seam's degraded-mode wrapper.
+
+Wraps any MasterStore (in practice KubeMasterStore) with the two halves
+of riding out an API-server outage:
+
+  reads    every successful list/scan/get refreshes a bounded-staleness
+           cache; when the API is unreachable the cached value is
+           served instead (stamped with its age, bounded by
+           `api_cache_max_staleness_s` — beyond the bound the failure
+           propagates, because acting on arbitrarily old state is how
+           outages corrupt things). Node readiness (`get_node`) is
+           DELIBERATELY never cached: evacuation decisions must never
+           run on stale data (the recovery controller also suspends
+           itself while the API is unhealthy — this is defense in
+           depth).
+
+  writes   annotation writes (`stamp_annotation`, `save_journal`) that
+           fail outage-shaped — or that would be attempted while the
+           ApiHealth verdict is already `down` — are intent-logged into
+           the durable write-behind queue (store/writebehind.py) and
+           reported as accepted. They replay idempotently, in order,
+           exactly-once, when the API heals (the store subscribes to
+           the ApiHealth transition and flushes on recovery; callers
+           can also flush_writes() directly). Intent CRUD is NOT
+           deferred — a user mutation the master cannot persist must
+           fail loudly to its caller, not silently apply minutes later.
+
+The wrapper is what MasterApp builds by default, so every subsystem
+(reconciler, migration machine, recovery controller, registry) gets
+outage behavior through the seam it already uses.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+from gpumounter_tpu.k8s.errors import NotFoundError, is_outage
+from gpumounter_tpu.store.base import MasterStore
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("store.cache")
+
+STALE_READS = REGISTRY.counter(
+    "tpumounter_store_stale_reads_total",
+    "Store reads served from the bounded-staleness cache during an "
+    "API outage, by read kind")
+DEFERRED_WRITES = REGISTRY.counter(
+    "tpumounter_store_deferred_writes_total",
+    "Annotation writes accepted into the write-behind queue instead of "
+    "failing their caller")
+
+
+class CachedMasterStore(MasterStore):
+    def __init__(self, inner: MasterStore, cfg=None, apihealth=None,
+                 queue=None):
+        from gpumounter_tpu.config import get_config
+        from gpumounter_tpu.k8s.health import api_health
+        from gpumounter_tpu.store.writebehind import WriteBehindQueue
+        self.inner = inner
+        self.cfg = cfg or get_config()
+        self.apihealth = apihealth or api_health()
+        self.queue = queue or WriteBehindQueue(
+            self.cfg.writebehind_dir,
+            max_bytes=self.cfg.writebehind_max_bytes)
+        self.max_staleness_s = float(self.cfg.api_cache_max_staleness_s)
+        self.probe_interval_s = float(
+            getattr(self.cfg, "api_health_probe_interval_s", 0.0))
+        self._lock = threading.Lock()
+        #: key -> (monotonic_stamp, value). Values are stored as the
+        #: inner store returned them; served copies are deep so a
+        #: caller mutating a stale list cannot poison the cache.
+        self._cache: dict[tuple, tuple[float, object]] = {}
+        self._flush_lock = threading.Lock()
+        self._prober_lock = threading.Lock()
+        self._prober_running = False
+        # Flush the queue the moment the API heals — the subscriber
+        # fires outside ApiHealth's lock, on the observing thread; the
+        # actual replay runs on a short-lived worker thread so a
+        # recovery-triggering call does not pay the whole backlog.
+        self.apihealth.subscribe(self._on_health_transition)
+        # A master restarted mid-outage sees no transition (the machine
+        # is born degraded or the queue reloaded pending records): arm
+        # the write-plane prober directly.
+        if self.apihealth.state() != "healthy" \
+                or self.queue.pending_count():
+            self._ensure_prober()
+
+    # --- the read side (bounded-staleness cache) ---
+
+    def _cached_read(self, key: tuple, fn, *args, **kwargs):
+        try:
+            value = fn(*args, **kwargs)
+        except NotFoundError:
+            # An ANSWER: the object is gone. Evict so a later outage
+            # cannot resurrect it from cache, then propagate.
+            with self._lock:
+                self._cache.pop(key, None)
+            raise
+        except Exception as exc:  # noqa: BLE001 — outage boundary
+            if not is_outage(exc):
+                raise
+            with self._lock:
+                entry = self._cache.get(key)
+            if entry is None:
+                raise
+            stamp, cached = entry
+            age = time.monotonic() - stamp
+            if age > self.max_staleness_s:
+                logger.warning(
+                    "store read %s failed and cache is %.0fs old "
+                    "(bound %.0fs); refusing stale data: %s", key, age,
+                    self.max_staleness_s, exc)
+                raise
+            STALE_READS.inc(kind=key[0])
+            logger.info("store read %s served from cache (%.1fs stale; "
+                        "api %s)", key, age, self.apihealth.state())
+            return copy.deepcopy(cached)
+        with self._lock:
+            self._cache[key] = (time.monotonic(), copy.deepcopy(value))
+        return value
+
+    def list_worker_pods(self):
+        return self._cached_read(("worker_pods",),
+                                 self.inner.list_worker_pods)
+
+    def watch_worker_pods(self, timeout_s: float = 60.0):
+        # Watches cannot be cached (they are deltas); the registry's own
+        # cache + reconnect backoff ride out the outage.
+        return self.inner.watch_worker_pods(timeout_s=timeout_s)
+
+    def list_intents(self):
+        return self._cached_read(("intents",), self.inner.list_intents)
+
+    def get_intent(self, namespace: str, pod_name: str):
+        return self._cached_read(("intent", namespace, pod_name),
+                                 self.inner.get_intent, namespace,
+                                 pod_name)
+
+    def scan_journals(self):
+        return self._cached_read(("journals",), self.inner.scan_journals)
+
+    def list_pool_pods(self, node_name: str):
+        return self._cached_read(("pool_pods", node_name),
+                                 self.inner.list_pool_pods, node_name)
+
+    def get_node(self, node_name: str):
+        # NEVER cached: a stale Ready/NotReady verdict feeding an
+        # evacuation is exactly the corruption this wrapper exists to
+        # prevent. The inner store already degrades to None on failure.
+        return self.inner.get_node(node_name)
+
+    # --- the write side (write-behind deferral) ---
+
+    def put_intent(self, namespace, pod_name, intent):
+        # User-facing CRUD: never deferred (see module docstring).
+        return self.inner.put_intent(namespace, pod_name, intent)
+
+    def delete_intent(self, namespace, pod_name):
+        return self.inner.delete_intent(namespace, pod_name)
+
+    def _deferrable_write(self, namespace: str, pod_name: str,
+                          annotation: str, payload: str | None,
+                          fn, *args) -> None:
+        if self.queue.has_pending(namespace, pod_name, annotation):
+            # Order preservation: once a key has deferred writes, later
+            # writes for the SAME key must queue behind them (the
+            # coalescer keeps only the newest) — a direct write racing
+            # the flush could otherwise be overwritten by the replay of
+            # an OLDER queued value.
+            DEFERRED_WRITES.inc()
+            self.queue.enqueue(namespace, pod_name, annotation, payload)
+            return
+        if self.apihealth.plane_state("write") == "down":
+            # The WRITE plane is confirmed down: don't pay a doomed
+            # round trip (against a real apiserver each attempt is a
+            # 30 s timeout). Judged per plane — a read-side partition
+            # must not reroute perfectly deliverable writes through
+            # the queue.
+            DEFERRED_WRITES.inc()
+            self.queue.enqueue(namespace, pod_name, annotation, payload)
+            return
+        try:
+            fn(*args)
+        except NotFoundError:
+            raise  # the pod is gone; queueing cannot resurrect it
+        except Exception as exc:  # noqa: BLE001 — outage boundary
+            if not is_outage(exc):
+                raise
+            DEFERRED_WRITES.inc()
+            logger.warning("annotation write %s on %s/%s deferred to "
+                           "write-behind (%s)", annotation, namespace,
+                           pod_name, exc)
+            self.queue.enqueue(namespace, pod_name, annotation, payload)
+
+    def stamp_annotation(self, namespace, pod_name, annotation, payload):
+        self._deferrable_write(
+            namespace, pod_name, annotation, payload,
+            self.inner.stamp_annotation, namespace, pod_name, annotation,
+            payload)
+
+    def save_journal(self, journal: dict) -> None:
+        from gpumounter_tpu.migrate.journal import ANNOT_JOURNAL, dump
+        src = journal["source"]
+        self._deferrable_write(
+            src["namespace"], src["pod"], ANNOT_JOURNAL, dump(journal),
+            self.inner.save_journal, journal)
+
+    # --- reconnect flush ---
+
+    def _on_health_transition(self, old: str, new: str) -> None:
+        if new != "healthy":
+            # Reads recover on their own (every cached read still
+            # attempts the real call first), but writes DON'T: deferred
+            # annotation writes short-circuit into the queue while the
+            # write plane is down, and every subsystem that would
+            # naturally write is parked waiting for a healthy verdict.
+            # Without an active probe an idle master deadlocks after
+            # the API heals — so start one.
+            self._ensure_prober()
+            return
+        if self.queue.pending_count() == 0:
+            return
+        threading.Thread(target=self.flush_writes,
+                         name="writebehind-flush", daemon=True).start()
+
+    def _ensure_prober(self) -> None:
+        if self.probe_interval_s <= 0:
+            return
+        with self._prober_lock:
+            if self._prober_running:
+                return
+            self._prober_running = True
+        threading.Thread(target=self._probe_loop,
+                         name="apihealth-write-probe",
+                         daemon=True).start()
+
+    def _probe_loop(self) -> None:
+        """Issue one cheap real write per interval while the write
+        plane is unhealthy: a flush attempt when writes are queued
+        (its patch_pod calls double as probes AND make progress), else
+        a lease touch. Outcomes feed ApiHealth through the tracked
+        client, so post-heal the plane records the consecutive
+        successes it needs to recover — and the healthy transition
+        then triggers the normal subscriber flush."""
+        try:
+            while True:
+                time.sleep(self.probe_interval_s)
+                if self.apihealth.plane_state("write") == "healthy" \
+                        and self.queue.pending_count() == 0:
+                    return
+                try:
+                    if self.queue.pending_count():
+                        self.flush_writes()
+                    else:
+                        self._probe_write()
+                except Exception as exc:  # noqa: BLE001 — probe outcome
+                    logger.debug("write-plane probe failed: %s", exc)
+        finally:
+            with self._prober_lock:
+                self._prober_running = False
+            # A transition raced the shutdown check: re-arm.
+            if self.apihealth.plane_state("write") != "healthy" \
+                    or self.queue.pending_count():
+                self._ensure_prober()
+
+    PROBE_LEASE = "tpumounter-apihealth-probe"
+
+    def _probe_write(self) -> None:
+        import socket
+        kube = self._inner_kube()
+        namespace = self.cfg.worker_namespace
+        manifest = {
+            "metadata": {"name": self.PROBE_LEASE,
+                         "namespace": namespace},
+            "spec": {"holderIdentity": socket.gethostname(),
+                     "renewTime": None},
+        }
+        try:
+            kube.update_lease(namespace, self.PROBE_LEASE, manifest)
+        except NotFoundError:
+            kube.create_lease(namespace, manifest)
+
+    def flush_writes(self) -> dict:
+        """Replay the deferred writes (single-flight; concurrent
+        callers coalesce into one pass). Returns the flush summary."""
+        with self._flush_lock:
+            summary = self.queue.flush(self._inner_kube())
+        if summary["applied"] or summary["pending"]:
+            logger.info("write-behind flush: %s", summary)
+        return summary
+
+    def _inner_kube(self):
+        kube = getattr(self.inner, "kube", None)
+        if kube is None:
+            raise RuntimeError(
+                "write-behind flush needs the inner store's kube client")
+        return kube
+
+    # --- observability ---
+
+    def staleness(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            return {"/".join(str(p) for p in key):
+                    round(now - stamp, 3)
+                    for key, (stamp, _) in sorted(self._cache.items())}
+
+    def payload(self) -> dict:
+        return {
+            "cacheAgesS": self.staleness(),
+            "maxStalenessS": self.max_staleness_s,
+            "writeBehind": self.queue.stats(),
+        }

@@ -84,14 +84,14 @@ class KubeMasterStore(MasterStore):
     # --- migration journals ---
 
     def scan_journals(self) -> list[dict]:
+        # Failures propagate: the CachedMasterStore wrapper answers
+        # them from its bounded-staleness cache (swallowing here would
+        # hand the wrapper a fresh-stamped [] that both masks the
+        # outage and destroys the cached real data); unwrapped callers
+        # degrade at their own call sites.
         from gpumounter_tpu.migrate.journal import parse_journal
         out = []
-        try:
-            pods = self.kube.list_pods()
-        except Exception as exc:  # noqa: BLE001 — LIST is best-effort here
-            logger.warning("migration journal scan failed: %s", exc)
-            return out
-        for pod_json in pods:
+        for pod_json in self.kube.list_pods():
             journal = parse_journal(Pod(pod_json).annotations)
             if journal is not None:
                 out.append(journal)
@@ -121,14 +121,12 @@ class KubeMasterStore(MasterStore):
             return None
 
     def list_pool_pods(self, node_name: str) -> list[dict]:
-        try:
-            return self.kube.list_pods(
-                self.cfg.pool_namespace,
-                field_selector=f"spec.nodeName={node_name}")
-        except Exception as exc:  # noqa: BLE001 — evacuation retries
-            logger.warning("pool pod list for node %s failed: %s",
-                           node_name, exc)
-            return []
+        # Failures propagate (see scan_journals): the cache wrapper
+        # serves them stale-but-bounded; the evacuation call site
+        # degrades past that.
+        return self.kube.list_pods(
+            self.cfg.pool_namespace,
+            field_selector=f"spec.nodeName={node_name}")
 
     # --- raw annotation stamps ---
 

@@ -1,0 +1,329 @@
+"""Durable write-behind queue for annotation writes made during an
+API-server outage.
+
+The master's durable state is pod annotations (store/k8s.py): migration
+journals, phase/ack stamps, heal markers, disruption markers. When the
+API server is unreachable those writes used to fail up their call
+stacks — a migration machine would roll back a healthy tenant because a
+journal persist 503'd. Instead, the degraded store (store/cache.py)
+intent-logs the write HERE — an fsync'd append-only JSONL file,
+mirroring the worker mount ledger (worker/ledger.py) — and replays it
+idempotently when the API heals.
+
+Record kinds (one JSON object per line):
+
+  write   {"kind":"write","seq":N,"namespace":...,"pod":...,
+           "annotation":...,"payload":str|null,"queued_at":ts}
+  done    {"kind":"done","seq":N,"outcome":...} — closes a write;
+           outcomes: applied / superseded / pod-gone / lost-cas
+
+Exactly-once on reconnect: a write without a done record is pending;
+replay applies pending writes IN ORDER and appends a done record after
+each application, so a crash mid-flush re-applies at most the one
+write whose done record was lost — and annotation merge-patches are
+idempotent, so that re-application is a no-op.
+
+Coalescing: queueing a second write for the same (namespace, pod,
+annotation) supersedes the first (its done record is appended with
+outcome "superseded") — a migration that journals five phase
+transitions during a 30 s outage replays one patch, not five, and the
+survivor is always the NEWEST value (order preserved).
+
+CAS conflict resolution: payloads that parse to a JSON object carrying
+a "seq" or "generation" counter (disruption markers, heal markers) are
+compared against the pod's CURRENT annotation at replay time — when a
+newer writer (another replica, a post-heal stamp) already advanced the
+counter, the queued write is dropped with outcome "lost-cas" instead
+of rolling the annotation backward.
+
+Durability: `directory=""` keeps the queue in memory only (deferral
+still works within the process; lost on restart — the pre-queue
+shape); a configured TPUMOUNTER_WRITEBEHIND_DIR makes it an fsync'd
+file reloaded on construction, with ledger-style compaction (atomic
+tmp+rename rewrite to pending-only) once the file exceeds max_bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("store.writebehind")
+
+QUEUE_FILE = "writebehind.jsonl"
+
+WRITEBEHIND_PENDING = REGISTRY.gauge(
+    "tpumounter_writebehind_pending",
+    "Annotation writes deferred during an API outage, not yet replayed")
+WRITEBEHIND_QUEUED = REGISTRY.counter(
+    "tpumounter_writebehind_queued_total",
+    "Annotation writes accepted into the write-behind queue")
+WRITEBEHIND_REPLAYED = REGISTRY.counter(
+    "tpumounter_writebehind_replayed_total",
+    "Write-behind records closed at replay, by outcome")
+
+
+class WriteBehindQueue:
+    """One process's durable annotation-write deferral queue."""
+
+    def __init__(self, directory: str = "",
+                 max_bytes: int = 4 * 1024 * 1024, fsync: bool = True):
+        self.directory = directory
+        self.path = os.path.join(directory, QUEUE_FILE) if directory \
+            else ""
+        self.max_bytes = max(4096, int(max_bytes))
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._seq = 0
+        #: seq -> write record, insertion-ordered (dicts preserve it).
+        self._pending: dict[int, dict] = {}
+        self._closed_counts: dict[str, int] = {}
+        self._fd: int | None = None
+        if self.path:
+            os.makedirs(directory, exist_ok=True)
+            self._load()
+            self._fd = os.open(self.path,
+                               os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                               0o600)
+        WRITEBEHIND_PENDING.set(float(len(self._pending)))
+
+    # --- load / append (the ledger discipline) ---
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        dropped = 0
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    dropped += 1  # torn final line: the write was never
+                    continue      # acknowledged to its caller
+                self._apply(record)
+        if dropped:
+            logger.warning("write-behind %s: dropped %d torn line(s)",
+                           self.path, dropped)
+
+    def _apply(self, record: dict) -> None:
+        kind = record.get("kind")
+        if kind == "write":
+            seq = int(record.get("seq", 0))
+            self._pending[seq] = record
+            self._seq = max(self._seq, seq)
+        elif kind == "done":
+            closed = self._pending.pop(int(record.get("seq", -1)), None)
+            if closed is not None:
+                outcome = record.get("outcome", "?")
+                self._closed_counts[outcome] = \
+                    self._closed_counts.get(outcome, 0) + 1
+
+    def _append(self, record: dict) -> None:
+        if self._fd is None:
+            return  # in-memory mode: state lives in _pending only
+        data = (json.dumps(record, separators=(",", ":")) + "\n").encode()
+        os.write(self._fd, data)
+        if self.fsync:
+            os.fsync(self._fd)
+
+    # --- enqueue (the outage write path) ---
+
+    def enqueue(self, namespace: str, pod: str, annotation: str,
+                payload: str | None) -> int:
+        """Defer one annotation write (payload None = clear). A pending
+        write for the same (namespace, pod, annotation) is superseded —
+        replay applies only the newest value. Returns the seq id."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            record = {
+                "kind": "write", "seq": seq, "namespace": namespace,
+                "pod": pod, "annotation": annotation, "payload": payload,
+                "queued_at": time.time(),
+            }
+            superseded = [
+                s for s, r in self._pending.items()
+                if (r["namespace"], r["pod"], r["annotation"])
+                == (namespace, pod, annotation)]
+            self._append(record)
+            self._pending[seq] = record
+            for old_seq in superseded:
+                self._append({"kind": "done", "seq": old_seq,
+                              "outcome": "superseded"})
+                del self._pending[old_seq]
+                self._closed_counts["superseded"] = \
+                    self._closed_counts.get("superseded", 0) + 1
+                WRITEBEHIND_REPLAYED.inc(outcome="superseded")
+            WRITEBEHIND_QUEUED.inc()
+            WRITEBEHIND_PENDING.set(float(len(self._pending)))
+            self._maybe_compact_locked()
+        logger.info("write-behind: deferred %s on %s/%s (seq %d%s)",
+                    annotation, namespace, pod, seq,
+                    f", superseding {superseded}" if superseded else "")
+        return seq
+
+    # --- replay (the reconnect path) ---
+
+    @staticmethod
+    def _counter_of(payload: str | None) -> int | None:
+        """The CAS counter inside a JSON-object payload ("seq" or
+        "generation"), or None when the payload carries neither."""
+        if not payload:
+            return None
+        try:
+            obj = json.loads(payload)
+        except ValueError:
+            return None
+        if not isinstance(obj, dict):
+            return None
+        for key in ("seq", "generation"):
+            if isinstance(obj.get(key), int):
+                return obj[key]
+        return None
+
+    def flush(self, kube, max_records: int | None = None) -> dict:
+        """Replay pending writes in order against a healed API server.
+        Stops at the first outage-shaped failure (the API relapsed; the
+        remaining records stay pending for the next flush). Returns
+        {"applied", "superseded", "pod_gone", "lost_cas", "pending",
+        "error"}."""
+        from gpumounter_tpu.k8s.errors import NotFoundError, is_outage
+        summary = {"applied": 0, "pod_gone": 0, "lost_cas": 0,
+                   "pending": 0, "error": ""}
+        while True:
+            with self._lock:
+                ordered = sorted(self._pending)
+                if not ordered or (max_records is not None
+                                   and summary["applied"] >= max_records):
+                    summary["pending"] = len(self._pending)
+                    return summary
+                seq = ordered[0]
+                record = dict(self._pending[seq])
+            outcome = None
+            try:
+                outcome = self._replay_one(kube, record)
+            except Exception as exc:  # noqa: BLE001 — outage boundary
+                if is_outage(exc):
+                    summary["error"] = f"{type(exc).__name__}: {exc}"
+                    with self._lock:
+                        summary["pending"] = len(self._pending)
+                    logger.warning(
+                        "write-behind flush halted at seq %d (%d still "
+                        "pending): %s", seq, summary["pending"], exc)
+                    return summary
+                if isinstance(exc, NotFoundError):
+                    outcome = "pod-gone"
+                else:
+                    # A non-outage failure (bad request shape) cannot
+                    # succeed later either: close it, keep flushing.
+                    logger.error("write-behind seq %d unreplayable: %s",
+                                 seq, exc)
+                    outcome = "pod-gone"
+            with self._lock:
+                if seq in self._pending:
+                    self._append({"kind": "done", "seq": seq,
+                                  "outcome": outcome})
+                    del self._pending[seq]
+                    self._closed_counts[outcome] = \
+                        self._closed_counts.get(outcome, 0) + 1
+                    WRITEBEHIND_PENDING.set(float(len(self._pending)))
+                    self._maybe_compact_locked()
+            WRITEBEHIND_REPLAYED.inc(outcome=outcome)
+            summary[outcome.replace("-", "_")] = \
+                summary.get(outcome.replace("-", "_"), 0) + 1
+
+    def _replay_one(self, kube, record: dict) -> str:
+        """Apply one record; returns its outcome. Raises on transport
+        failure (flush halts) and NotFoundError (pod gone)."""
+        from gpumounter_tpu.k8s.types import Pod
+        namespace, pod_name = record["namespace"], record["pod"]
+        annotation, payload = record["annotation"], record["payload"]
+        queued_counter = self._counter_of(payload)
+        if queued_counter is not None:
+            # CAS: a newer writer may have advanced the counter while we
+            # were partitioned — never roll it backward.
+            current = Pod(kube.get_pod(namespace, pod_name)) \
+                .annotations.get(annotation)
+            current_counter = self._counter_of(current)
+            if current_counter is not None \
+                    and current_counter >= queued_counter:
+                logger.info(
+                    "write-behind: %s on %s/%s lost CAS (current "
+                    "counter %d >= queued %d); dropping", annotation,
+                    namespace, pod_name, current_counter, queued_counter)
+                return "lost-cas"
+        kube.patch_pod(namespace, pod_name, {
+            "metadata": {"annotations": {annotation: payload}}})
+        return "applied"
+
+    # --- views ---
+
+    def has_pending(self, namespace: str, pod: str,
+                    annotation: str) -> bool:
+        with self._lock:
+            return any((r["namespace"], r["pod"], r["annotation"])
+                       == (namespace, pod, annotation)
+                       for r in self._pending.values())
+
+    def pending(self) -> list[dict]:
+        with self._lock:
+            return [dict(self._pending[s]) for s in sorted(self._pending)]
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def stats(self) -> dict:
+        with self._lock:
+            oldest = min((r["queued_at"]
+                          for r in self._pending.values()), default=None)
+            return {
+                "pending": len(self._pending),
+                "oldestQueuedAgeS": round(time.time() - oldest, 3)
+                if oldest is not None else None,
+                "closed": dict(self._closed_counts),
+                "durable": bool(self.path),
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    # --- compaction (rotation; caller holds the lock) ---
+
+    def _maybe_compact_locked(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            size = os.fstat(self._fd).st_size
+        except OSError:
+            return
+        if size <= self.max_bytes:
+            return
+        tmp = self.path + ".compact"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        try:
+            payload = "".join(
+                json.dumps(self._pending[s], separators=(",", ":")) + "\n"
+                for s in sorted(self._pending)).encode()
+            os.write(fd, payload)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, self.path)
+        old_fd = self._fd
+        self._fd = os.open(self.path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o600)
+        os.close(old_fd)
+        logger.info("write-behind %s compacted (%d pending)",
+                    self.path, len(self._pending))

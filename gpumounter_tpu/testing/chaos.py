@@ -53,7 +53,17 @@ invariants after convergence:
      window is left open, every signalled-cause window carries the
      control-plane trace id the signal delivered, and that trace id
      resolves in the trace ring — tenant-perceived downtime is never
-     unattributable.
+     unattributable,
+ 14. API-outage degraded mode (run_api_outage_scenario): with the fake
+     API server partitioned mid-mount/-migrate/-heal/-recovery, no
+     destructive mutation lands from stale reads (reconciles park
+     read-only, the migration machine holds at a journaled phase
+     boundary, evacuations are suspended), no booking leaks (slave
+     releases defer into the ledger retry queue), and after the heal
+     every deferred annotation write lands exactly once — newest value
+     wins, CAS losers dropped — and books == mounts == ledger ==
+     intents; the negative control (replay disabled) must be DETECTED
+     as divergence.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -245,6 +255,15 @@ class ChaosHarness:
             recovery_confirm_failures=2,
             recovery_grace_s=0.0,
             recovery_probe_timeout_s=2.0,
+            # API-outage degraded mode at test speed: degraded after 2
+            # outage-shaped failures, down after 50 ms of continuous
+            # failure, recovered after the default 2-success hysteresis;
+            # deferred writes go to a durable queue under the harness
+            # root (invariant 14 re-reads it across the heal).
+            api_health_degraded_failures=2,
+            api_health_down_after_s=0.05,
+            api_health_recovery_successes=2,
+            writebehind_dir=os.path.join(root, "writebehind"),
             # High threshold: chaos injects isolated transport faults by
             # design; the breaker's own behavior has dedicated tests.
             breaker_failure_threshold=50)
@@ -322,6 +341,12 @@ class ChaosHarness:
         # (open spans, audit records) must judge THIS run only.
         trace.TRACER.reset()
         AUDIT.reset()
+        # Fresh per-endpoint ApiHealth machines: a previous scenario's
+        # outage verdict must not park this run's subsystems (the
+        # master, workers and store all share the process-global
+        # instance, exactly like one real process would).
+        from gpumounter_tpu.k8s import health as k8s_health
+        k8s_health.reset_all()
         self.cluster.start()
         for i, name in enumerate(self.cluster.node_names):
             self._ip_by_node[name] = f"10.9.0.{i + 1}"
@@ -728,6 +753,353 @@ class ChaosHarness:
         return {"detect_passes": passes,
                 "evacuation": self.app.recovery.payload()["evacuations"],
                 "reconverged": reconverged}
+
+    # --- invariant 14: API-server outage -> degraded mode -> heal ---
+
+    def run_api_outage_scenario(self, flavor: str = "mount",
+                                replay_enabled: bool = True) -> dict:
+        """Flip `fake.set_partitioned` mid-{mount,migrate,heal,recovery}
+        and prove invariant 14: during the outage no destructive
+        mutation lands from stale reads and no booking leaks; after the
+        heal every queued write lands exactly once (newest value wins,
+        CAS losers dropped) and books == mounts == ledger == intents.
+
+        replay_enabled=False is the negative control: the write-behind
+        flush is disabled, and the scenario must DETECT the resulting
+        divergence (queued writes that never landed) by raising
+        InvariantViolation."""
+        import json as jsonlib
+        import threading as threading_mod
+
+        from gpumounter_tpu.elastic.intents import Intent
+        from gpumounter_tpu.master.slice_ops import SliceTarget
+        assert flavor in ("mount", "migrate", "heal", "recovery"), flavor
+        self.check_ledgers = True
+        store = self.app.store
+        kube_raw = self.cluster.kube
+        tracked = self.app.kube  # health-tracked wrapper
+
+        # Converged substrate: one intent-managed pod per node.
+        intent_pods = [("default", "ao-a", NODE_A),
+                       ("default", "ao-b", NODE_B)]
+        desired_by_pod: dict[str, int] = {}
+        for ns, name, node in intent_pods:
+            self.add_pod(name, node, namespace=ns)
+            desired = self.rng.randint(1, 2)
+            desired_by_pod[name] = desired
+            self.app.elastic.store.put(ns, name, Intent(
+                desired_chips=desired, min_chips=1))
+            outcome = self.app.elastic.reconcile_once(ns, name)
+            if outcome.get("phase") != "converged":
+                raise InvariantViolation(
+                    f"pre-outage convergence failed for {name}: "
+                    f"{outcome}")
+            self.record(f"pre-outage {name} converged desired={desired}")
+
+        mode = "writes" if flavor == "heal" else "full"
+        mid = None
+        dead_uuid = None
+        if flavor == "migrate":
+            self.add_pod("ao-src", NODE_A)
+            self.add_pod("ao-dst", NODE_B)
+            self._coordinator().mount_slice(
+                [SliceTarget(namespace="default", pod="ao-src")], 2,
+                entire=False)
+            journal = self.app.migrations.begin(
+                "default", "ao-src", "default", "ao-dst")
+            mid = journal["id"]
+            # Let the machine get PAST begin() — the partition lands
+            # mid-migration, with the journal at whatever phase the
+            # race reaches.
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                j = self.app.migrations.get(mid) or {}
+                if j.get("phase") != "quiesce" or j.get("outcome"):
+                    break
+                time.sleep(0.005)
+            self.record(f"migration {mid} at phase "
+                        f"{(self.app.migrations.get(mid) or {}).get('phase')}")
+        elif flavor == "heal":
+            # A dead chip the reconciler WANTS to heal — but must not
+            # touch while the API is unhealthy (stale intent view).
+            victim = self.probe("default", "ao-a")[0]
+            index = next(str(d.index) for d in
+                         self.cluster.node(NODE_A).backend.list_devices()
+                         if d.uuid == victim.uuid)
+            self.cluster.kill_chip(index, NODE_A)
+            dead_uuid = victim.uuid
+            self.record(f"killed chip {dead_uuid} on {NODE_A}")
+        elif flavor == "recovery":
+            # A REAL node death immediately swallowed by the partition:
+            # the controller has every reason to evacuate — except that
+            # all its evidence is now stale.
+            self.app.recovery.check_once()  # track nodes while alive
+            self.kill_node(NODE_B)
+
+        if flavor == "mount":
+            # Flip the partition MID-mount: the mount thread is inside
+            # mount_slice when the API goes away.
+            def _racing_mount():
+                try:
+                    self._coordinator().mount_slice(
+                        [SliceTarget(namespace="default", pod="ao-a")],
+                        1, entire=False)
+                except Exception as exc:  # noqa: BLE001 — the point
+                    self.record(f"mid-outage mount -> "
+                                f"{type(exc).__name__}")
+
+            racer = threading_mod.Thread(target=_racing_mount,
+                                         daemon=True)
+            racer.start()
+            time.sleep(0.005)
+            kube_raw.set_partitioned(True, mode=mode)
+            self.record(f"partitioned mid-mount (mode={mode})")
+            racer.join(timeout=30.0)
+        else:
+            kube_raw.set_partitioned(True, mode=mode)
+            self.record(f"partitioned (mode={mode}, flavor={flavor})")
+
+        # Drive the health machine to its verdict with real failing
+        # calls (the production loops would supply these).
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and self.app.apihealth.ok():
+            try:
+                if mode == "writes":
+                    tracked.patch_pod("default", "ao-a",
+                                      {"metadata": {}})
+                else:
+                    tracked.get_pod("default", "ao-a")
+            except Exception:  # noqa: BLE001 — the failures ARE the feed
+                pass
+            time.sleep(0.01)
+        if self.app.apihealth.ok():
+            raise InvariantViolation(
+                f"api health never left healthy under partition "
+                f"(seed={self.seed})")
+        self.record(f"api health: {self.app.apihealth.state()}")
+        held_at_partition = self.held_chips()
+
+        # --- during the outage ---
+
+        # 1. Annotation writes defer into the durable queue.
+        queued_annotations: dict[str, str] = {}
+        for i in range(3):
+            annot = f"tpumounter.io/outage-test-{i}"
+            payload = jsonlib.dumps({"v": i, "flavor": flavor})
+            store.stamp_annotation("default", "ao-a", annot, payload)
+            queued_annotations[annot] = payload
+        # A CAS-carrying write that must LOSE to a newer post-heal
+        # writer (seq 1 vs 5).
+        store.stamp_annotation(
+            "default", "ao-a", "tpumounter.io/outage-cas",
+            jsonlib.dumps({"seq": 1, "from": "outage"}))
+        if store.queue.pending_count() < len(queued_annotations) + 1:
+            raise InvariantViolation(
+                f"writes were not deferred during the outage: "
+                f"{store.queue.stats()}")
+        self.record(f"deferred {store.queue.pending_count()} write(s)")
+
+        # 2. Reconcile passes stay read-only; nothing destructive lands.
+        for ns, name, node in intent_pods:
+            if node in self.dead_nodes:
+                continue
+            try:
+                outcome = self.app.elastic.reconcile_once(ns, name)
+            except Exception as exc:  # noqa: BLE001 — full partition:
+                # even the pod GET fails; a failed pass mutates nothing
+                self.record(f"outage reconcile {name} -> "
+                            f"{type(exc).__name__}")
+                continue
+            self.record(f"outage reconcile {name} -> "
+                        f"{outcome.get('phase')}")
+            if outcome.get("healed") or outcome.get("removed_excess") \
+                    or outcome.get("added"):
+                raise InvariantViolation(
+                    f"destructive reconcile during outage: {outcome}")
+        if flavor == "heal":
+            held_now = self.held_chips()[("default", "ao-a")]
+            if dead_uuid not in held_now:
+                raise InvariantViolation(
+                    f"dead chip {dead_uuid} was removed during the "
+                    f"outage (heal must park): held={sorted(held_now)}")
+
+        # 3. Recovery never evacuates during the outage.
+        for _ in range(4):
+            out = self.app.recovery.check_once()
+            if out["evacuated"]:
+                raise InvariantViolation(
+                    f"evacuation during api outage (stale evidence): "
+                    f"{out}")
+            time.sleep(0.02)
+        if self.app.recovery.payload()["evacuations"]:
+            raise InvariantViolation(
+                "evacuation recorded during the outage")
+
+        # 4. No mutation landed from stale reads while partitioned.
+        if self.held_chips() != held_at_partition:
+            raise InvariantViolation(
+                f"held chips changed during the outage: "
+                f"{held_at_partition} -> {self.held_chips()}")
+
+        # 5. Slave-release deferral (heal flavor: writes partitioned,
+        # the unmount itself is node-local): an unmount whose API
+        # delete fails must QUEUE the booking, not leak it. Runs after
+        # the stale-read snapshot check — this remove is an explicit
+        # operator action, not a stale-read mutation.
+        if flavor == "heal":
+            removable = sorted(self.held_chips()[("default", "ao-b")])
+            with self._client_for_node(NODE_B) as client:
+                client.remove_tpu("ao-b", "default", [removable[0]],
+                                  force=True)
+            pending_rel = \
+                self.services[NODE_B].ledger.pending_releases()
+            if not pending_rel:
+                raise InvariantViolation(
+                    "slave release during outage neither completed "
+                    "nor deferred into the ledger queue")
+            self.record(f"slave release deferred: "
+                        f"{pending_rel[0].get('pods')}")
+
+        # 6. The migration machine paused (journaled locally), never
+        # rolled back mid-outage.
+        if mid is not None:
+            time.sleep(0.1)  # give the machine a boundary to pause at
+            j = self.app.migrations.get(mid) or {}
+            if j.get("outcome"):
+                raise InvariantViolation(
+                    f"migration went terminal during the outage: {j}")
+            self.record(f"migration {mid} holding at phase "
+                        f"{j.get('phase')} "
+                        f"(paused_for_api={j.get('paused_for_api')})")
+
+        # --- heal ---
+        kube_raw.set_partitioned(False)
+        self.record("partition healed")
+        if not replay_enabled:
+            # Negative control: break the replay. The divergence below
+            # MUST be detected.
+            store.flush_writes = lambda: {"applied": 0, "pending":
+                                          store.queue.pending_count()}
+        # A newer writer advances the CAS counter before our queued
+        # seq-1 write can replay.
+        kube_raw.patch_pod("default", "ao-a", {
+            "metadata": {"annotations": {"tpumounter.io/outage-cas":
+                         jsonlib.dumps({"seq": 5,
+                                        "from": "post-heal"})}}})
+        # Drive recovery with real successful calls on BOTH planes.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and not self.app.apihealth.ok():
+            try:
+                tracked.get_pod("default", "ao-a")
+                tracked.patch_pod("default", "ao-a", {"metadata": {}})
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.01)
+        if not self.app.apihealth.ok():
+            raise InvariantViolation("api health never recovered after "
+                                     "the partition healed")
+        flush = store.flush_writes()
+        self.record(f"post-heal flush: {flush}")
+
+        reconverged: dict[str, dict] = {}
+        if flavor == "recovery":
+            # NOW the evidence is fresh: the controller must confirm
+            # and evacuate the genuinely dead node...
+            deadline = time.monotonic() + 30.0
+            evacuated = False
+            while time.monotonic() < deadline and not evacuated:
+                evacuated = NODE_B in \
+                    self.app.recovery.check_once()["evacuated"]
+                if not evacuated:
+                    time.sleep(0.05)
+            if not evacuated:
+                raise InvariantViolation(
+                    f"{NODE_B} never evacuated after the api healed: "
+                    f"{self.app.recovery.payload()}")
+            self.record(f"post-heal evacuation of {NODE_B}")
+            # ...and the stranded intent re-converges once rescheduled.
+            self.cluster.kube.delete_pod("default", "ao-b")
+            self.add_pod("ao-b", NODE_A)
+            self.app.elastic.store.put("default", "ao-b", Intent(
+                desired_chips=desired_by_pod["ao-b"], min_chips=1))
+        if mid is not None:
+            self._drive_to_terminal(mid)
+            j = self.app.migrations.get(mid) or {}
+            if not j.get("outcome"):
+                raise InvariantViolation(
+                    f"migration {mid} never went terminal after the "
+                    f"heal: {j}")
+            self.record(f"migration {mid} -> {j.get('outcome')}")
+        for node, service in self.services.items():
+            if node in self.dead_nodes or service.ledger is None:
+                continue
+            service.retry_pending_releases()
+        self.converge()
+        # Final drain: a write enqueued while the first flush was
+        # mid-pass (order-preservation rerouting) must not be left
+        # pending at judgment time. Idempotent when already empty.
+        if replay_enabled:
+            store.flush_writes()
+
+        # --- invariant 14: post-heal agreement ---
+        violations: list[str] = []
+        from gpumounter_tpu.k8s.types import Pod as PodView
+        annotations_a = PodView(
+            kube_raw.get_pod("default", "ao-a")).annotations
+        if replay_enabled:
+            if store.queue.pending_count():
+                violations.append(
+                    f"write-behind queue not drained after heal: "
+                    f"{store.queue.stats()}")
+            for annot, payload in queued_annotations.items():
+                if annotations_a.get(annot) != payload:
+                    violations.append(
+                        f"queued write {annot} did not land exactly "
+                        f"once: {annotations_a.get(annot)!r} != "
+                        f"{payload!r}")
+            cas_raw = annotations_a.get(
+                "tpumounter.io/outage-cas", "{}")
+            if jsonlib.loads(cas_raw).get("seq") != 5:
+                violations.append(
+                    f"CAS replay rolled a newer write backward: "
+                    f"{cas_raw}")
+            if self.app.apihealth.state() != "healthy":
+                violations.append(
+                    f"api health stuck {self.app.apihealth.state()} "
+                    f"after heal")
+            for node, service in self.services.items():
+                if node in self.dead_nodes or service.ledger is None:
+                    continue
+                if service.ledger.pending_releases():
+                    violations.append(
+                        f"deferred slave release never completed on "
+                        f"{node}: {service.ledger.pending_releases()}")
+        else:
+            missing = [a for a in queued_annotations
+                       if a not in annotations_a]
+            if missing or store.queue.pending_count():
+                raise InvariantViolation(
+                    f"write-behind divergence detected (replay "
+                    f"disabled): {missing} never landed, "
+                    f"{store.queue.pending_count()} write(s) stranded "
+                    f"in the queue (seed={self.seed})")
+            raise InvariantViolation(
+                "negative control failed: replay was disabled yet no "
+                "divergence exists")
+        if violations:
+            tail = "\n  ".join(self.schedule[-25:])
+            raise InvariantViolation(
+                f"invariant 14 violated (seed={self.seed}, "
+                f"flavor={flavor}):\n- " + "\n- ".join(violations)
+                + f"\nschedule tail:\n  {tail}")
+        # Books == mounts == ledger == intents (the shared closers).
+        self.check_invariants()
+        return {"flavor": flavor, "flush": flush,
+                "apihealth": self.app.apihealth.payload(),
+                "migration": mid,
+                "reconverged": reconverged,
+                "queue": store.queue.stats()}
 
     def _drive_to_terminal(self, mid: str, timeout_s: float = 30.0) -> None:
         """Wait out the machine; re-adopt after simulated master crashes

@@ -23,6 +23,13 @@ Record kinds (one JSON object per line):
              replayed-unmounted
   epoch     {"kind":"epoch","epoch":N} — the highest fencing epoch this
              worker has accepted (rpc epoch fencing; worker/server.py)
+  release   {"kind":"release","rel":id,"pods":[...]} — slave-pod
+             releases whose API delete failed (outage): the booking is
+             NOT leaked, it is queued here and retried — by the next
+             release attempt, by retry_pending_releases(), and by the
+             startup replay (worker/resync.py)
+  release_done {"kind":"release_done","rel":id} — closes a release
+             entry once every named pod is confirmed gone
   shutdown  {"kind":"shutdown"} — clean close marker (SIGTERM drain);
              its absence on a non-empty ledger means the last process
              crashed
@@ -84,6 +91,9 @@ class MountLedger:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._open_txns: dict[str, dict] = {}
+        #: rel id -> release record: slave-pod deletes deferred after an
+        #: API outage broke the unmount's release step.
+        self._pending_releases: dict[str, dict] = {}
         #: net holdings after every CLOSED txn: (namespace, pod) ->
         #: {uuid: chip record}. The books==mounts==ledger invariant
         #: compares this against injected nodes and scheduler bookings.
@@ -134,6 +144,11 @@ class MountLedger:
             self._clean_shutdown = False
         elif kind == "epoch":
             self._epoch = max(self._epoch, int(record.get("epoch", 0)))
+        elif kind == "release":
+            self._pending_releases[record.get("rel", "")] = record
+            self._clean_shutdown = False
+        elif kind == "release_done":
+            self._pending_releases.pop(record.get("rel", ""), None)
         elif kind == "snapshot":
             holdings: dict[tuple[str, str], dict[str, dict]] = {}
             for entry in record.get("holdings", []):
@@ -297,6 +312,35 @@ class MountLedger:
                           "at": time.time()})
             self._fold(record, "replayed-unmounted")
 
+    # --- deferred slave releases (API-outage booking-leak fix) ---
+
+    def queue_release(self, namespace: str, pods: list[str]) -> str:
+        """Durably record slave pods whose post-unmount delete failed
+        (API outage): the booking leak becomes a retry queue entry
+        instead of silence. Returns the release id."""
+        rel_id = f"r-{secrets.token_hex(5)}"
+        record = {"kind": "release", "rel": rel_id,
+                  "namespace": namespace, "pods": sorted(pods),
+                  "at": time.time()}
+        with self._lock:
+            self._append(record)
+            self._pending_releases[rel_id] = record
+        return rel_id
+
+    def complete_release(self, rel_id: str) -> None:
+        """Close a release entry (idempotent on unknown ids — a restart
+        replay and a live retry may race)."""
+        with self._lock:
+            if self._pending_releases.pop(rel_id, None) is None:
+                return
+            self._append({"kind": "release_done", "rel": rel_id,
+                          "at": time.time()})
+            self._maybe_compact_locked()
+
+    def pending_releases(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._pending_releases.values()]
+
     # --- compaction (rotation) ---
 
     def _maybe_compact_locked(self) -> None:
@@ -326,6 +370,7 @@ class MountLedger:
             if self._epoch:
                 lines.append({"kind": "epoch", "epoch": self._epoch})
             lines.extend(self._open_txns.values())
+            lines.extend(self._pending_releases.values())
             payload = "".join(
                 json.dumps(r, separators=(",", ":")) + "\n"
                 for r in lines).encode()

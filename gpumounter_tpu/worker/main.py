@@ -72,8 +72,26 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
             parsed = urllib.parse.urlsplit(self.path)
             auth = self.headers.get("Authorization")
             if parsed.path == "/healthz":
-                body = b"ok\n"
+                # Liveness stays 200 through an API outage (restarting
+                # the worker then would abandon in-flight mounts for
+                # nothing); the verdict rides in the body.
+                from gpumounter_tpu.k8s.health import api_health
+                state = api_health().state()
+                body = (b"ok\n" if state == "healthy"
+                        else f"ok\napi: {state}\n".encode())
                 ctype = "text/plain"
+            elif parsed.path == "/apihealth":
+                # The worker's half of the degraded-mode pane: the
+                # ApiHealth verdict this process's calls produced
+                # (read-scoped like /telemetry — it names the last
+                # error, which can carry pod names).
+                if not _read_allowed(auth):
+                    self.send_error(401)
+                    return
+                from gpumounter_tpu.k8s.health import api_health
+                body = (json.dumps({"api": api_health().payload()},
+                                   indent=1) + "\n").encode()
+                ctype = "application/json"
             elif parsed.path == "/metrics":
                 if read_token is not None and not _read_allowed(auth):
                     self.send_error(401)

@@ -120,7 +120,16 @@ class LedgerResync:
                    "replayed-unmounted": "unmounted"}[outcome]
             summary[key].append(txn.get("txn"))
         summary["holdings_corrected"] = self._reconcile_holdings()
-        if summary["open"] or summary["holdings_corrected"]:
+        # Deferred slave releases (API-outage booking-leak fix): the
+        # previous process queued deletes the outage broke; the restart
+        # is a natural retry point (the API may be back by now).
+        retry = getattr(self.service, "retry_pending_releases", None)
+        releases = retry() if retry is not None else {}
+        summary["releases_completed"] = releases.get("completed", 0)
+        summary["releases_pending"] = releases.get("pending", 0)
+        if summary["open"] or summary["holdings_corrected"] \
+                or summary["releases_completed"] \
+                or summary["releases_pending"]:
             logger.warning("ledger replay: %s", summary)
         return summary
 

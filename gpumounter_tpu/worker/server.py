@@ -54,6 +54,11 @@ SLAVE_RELEASE_FAILURES = REGISTRY.counter(
     "tpumounter_slave_release_failures_total",
     "Slave-pod releases that exhausted their bounded retry — leaked "
     "capacity until the reaper or the recovery plane sweeps it")
+SLAVE_RELEASE_DEFERRED = REGISTRY.counter(
+    "tpumounter_slave_release_deferred_total",
+    "Slave-pod releases deferred into the ledger-backed retry queue "
+    "after an API outage broke the delete (retried until the pods are "
+    "confirmed gone — not a leak)")
 
 #: stamped by the tenant's jaxside.watch_migration hook after it packs
 #: (or restores) state; mirror of migrate.journal.ANNOT_ACK — the worker
@@ -146,6 +151,12 @@ class TpuMountService:
                  mounter: TpuMounter | None = None, cfg=None,
                  pool=None, ledger=None):
         self.cfg = cfg or get_config()
+        # The worker's API calls feed the same process-global ApiHealth
+        # machine the ops port surfaces (k8s/health.py): the warm-pool
+        # refiller and the deferred-release queue key off its verdict.
+        from gpumounter_tpu.k8s.health import api_health, wrap_health
+        self.apihealth = api_health(cfg=self.cfg)
+        kube = wrap_health(kube, self.apihealth)
         self.kube = kube
         self.collector = collector or TpuCollector(cfg=self.cfg)
         # Durable mount ledger (worker/ledger.py): opened from
@@ -652,13 +663,28 @@ class TpuMountService:
 
         Release failures used to log and move on — a silent booking leak
         (the chips stay booked to slave pods the reaper never touches,
-        because their owner still exists). Now: bounded retry, a
-        tpumounter_slave_release_failures_total counter that trips
-        alerting, and a TPUSlaveReleaseFailed Warning Event so the
-        leaked capacity is operator-visible and reapable by hand.
+        because their owner still exists). Now: bounded retry, then —
+        when the worker carries a ledger — the still-undeleted pods are
+        queued as a durable `release` record and retried until
+        confirmed gone (the next release attempt, an explicit
+        retry_pending_releases(), and the startup replay all drive the
+        queue), so an API outage defers the release instead of leaking
+        it. Only a ledgerless worker still counts a true leak
+        (tpumounter_slave_release_failures_total + the
+        TPUSlaveReleaseFailed Warning Event).
         """
         if not unmounted:
             return
+        # Opportunistic retry of earlier deferred releases: the next
+        # unmount on this worker is a natural "is the API back?" probe.
+        # While the write plane is unhealthy, probe with at most ONE
+        # record — each doomed delete costs a full client timeout, and
+        # paying (pending x timeout) inside every unmount RPC turns a
+        # long outage into quadratically escalating stalls.
+        if self.apihealth.write_plane_ok():
+            self.retry_pending_releases()
+        else:
+            self.retry_pending_releases(limit=1)
         unmounted_keys = {d.uuid for d in unmounted}
         by_slave: dict[str, list] = {}
         for dev in requested:
@@ -673,7 +699,11 @@ class TpuMountService:
             try:
                 self.allocator.delete_slave_pods(releasable)
                 return
-            except SlavePodError as exc:
+            except Exception as exc:  # noqa: BLE001 — release boundary:
+                # SlavePodError (deletion timed out) and raw transport/
+                # PartitionError (API outage mid-delete) both mean "the
+                # booking is still held" — and must end in the deferral
+                # path below, never escape the unmount RPC.
                 last_exc = exc
                 logger.warning("slave pod release attempt %d/%d failed: "
                                "%s", attempt, attempts, exc)
@@ -693,6 +723,19 @@ class TpuMountService:
                 leaked.append(name)
         if not leaked:
             return
+        if self.ledger is not None:
+            # Ledger-backed deferral: the booking is queued, not
+            # leaked. Durable across worker restarts (the startup
+            # replay re-drives it) and retried opportunistically.
+            rel_id = self.ledger.queue_release(self.cfg.pool_namespace,
+                                               leaked)
+            SLAVE_RELEASE_DEFERRED.inc(float(len(leaked)))
+            logger.warning(
+                "slave pod release failed after %d attempt(s); %d "
+                "booking(s) deferred into the ledger retry queue as %s "
+                "(%s): %s", attempts, len(leaked), rel_id,
+                ", ".join(leaked), last_exc)
+            return
         SLAVE_RELEASE_FAILURES.inc(float(len(leaked)))
         logger.error("slave pod release failed after %d attempt(s); "
                      "%d booking(s) stay leaked until reaped: %s",
@@ -704,6 +747,42 @@ class TpuMountService:
                 f"({', '.join(leaked)}) after unmount: {last_exc}; "
                 f"their chip bookings are leaked until deleted manually "
                 f"or swept by the recovery plane", "Warning")
+
+    def retry_pending_releases(self, limit: int | None = None) -> dict:
+        """Drive the ledger's deferred-release queue: delete every
+        still-present pod of each pending release; entries whose pods
+        are all confirmed gone are closed (release_done). Safe to call
+        any time — deletes are idempotent and a pod already gone counts
+        as released. `limit` bounds how many records are attempted (the
+        degraded-mode probe passes 1). Returns
+        {"completed": n, "pending": m}."""
+        if self.ledger is None:
+            return {"completed": 0, "pending": 0}
+        pending = self.ledger.pending_releases()
+        total = len(pending)
+        if limit is not None:
+            pending = pending[:max(0, limit)]
+        completed = 0
+        for record in pending:
+            namespace = record.get("namespace", self.cfg.pool_namespace)
+            remaining = []
+            for name in record.get("pods", []):
+                try:
+                    self.kube.delete_pod(namespace, name,
+                                         grace_period_seconds=0)
+                    # delete-of-missing is a no-op in this client, so
+                    # reaching here means the pod is gone either way.
+                except Exception as exc:  # noqa: BLE001 — still down
+                    remaining.append(name)
+                    logger.info("deferred release of %s still failing: "
+                                "%s", name, exc)
+            if not remaining:
+                self.ledger.complete_release(record.get("rel", ""))
+                completed += 1
+                logger.info("deferred slave release %s completed (%s)",
+                            record.get("rel"),
+                            ", ".join(record.get("pods", [])))
+        return {"completed": completed, "pending": total - completed}
 
 
 def _bearer_interceptor(token: str):

@@ -39,6 +39,7 @@ def _reset_observability():
     zeroes every registered metric's samples, drops buffered spans and
     open-span records, and clears the audit trail."""
     yield
+    from gpumounter_tpu.k8s import health as k8s_health
     from gpumounter_tpu.obs import audit, trace
     from gpumounter_tpu.obs.tenants import TENANTS
     from gpumounter_tpu.utils.metrics import REGISTRY
@@ -46,6 +47,10 @@ def _reset_observability():
     trace.TRACER.reset()
     audit.AUDIT.reset()
     TENANTS.reset()
+    # The ApiHealth machines are process-global per endpoint: a test's
+    # simulated outage must not leak a degraded verdict (which parks
+    # destructive subsystem work) into the next test.
+    k8s_health.reset_all()
 
 
 @pytest.fixture()

@@ -1,0 +1,387 @@
+"""ApiHealth state machine + typed k8s errors + the /apihealth surfaces.
+
+The degraded-mode control plane's first layer: one per-endpoint state
+machine (healthy/degraded/down with hysteresis) fed by every API call
+through the HealthTrackingKubeClient wrapper, classified through the
+typed error hierarchy (k8s/errors.py), and surfaced on the master's
+/healthz + /apihealth routes, the worker ops port, and the
+`tpumounter apihealth` CLI verb.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.k8s.client import (
+    ApiError,
+    ApiTimeoutError,
+    ConflictError,
+    NotFoundError,
+    PartitionError,
+    ServerError,
+    is_retriable,
+    raise_for,
+)
+from gpumounter_tpu.k8s.errors import classify_exception, is_outage
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.k8s.health import (
+    ApiHealth,
+    HealthTrackingKubeClient,
+    api_health,
+    wrap_health,
+)
+
+CFG = Config().replace(api_health_degraded_failures=3,
+                       api_health_down_after_s=10.0,
+                       api_health_recovery_successes=2)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+# --- the typed error hierarchy (satellite: k8s/errors.py) ---
+
+def test_raise_for_maps_statuses_to_types():
+    with pytest.raises(NotFoundError):
+        raise_for(404, "gone")
+    with pytest.raises(ConflictError):
+        raise_for(409, "cas")
+    with pytest.raises(ApiTimeoutError):
+        raise_for(504, "slow")
+    with pytest.raises(ServerError) as exc:
+        raise_for(500, "boom")
+    assert exc.value.status == 500
+    with pytest.raises(ApiError) as exc:
+        raise_for(403, "nope")
+    assert not isinstance(exc.value, ServerError)
+
+
+def test_partition_error_is_a_5xx_api_error():
+    """Every pre-existing ApiError(5xx) handler must keep firing for
+    partition failures (back-compat contract of the hierarchy)."""
+    exc = PartitionError("unreachable")
+    assert isinstance(exc, ServerError)
+    assert isinstance(exc, ApiError)
+    assert exc.status == 503
+
+
+def test_classify_exception_wraps_transport_errors():
+    assert isinstance(classify_exception(ConnectionResetError("rst")),
+                      PartitionError)
+    assert isinstance(classify_exception(TimeoutError("deadline")),
+                      ApiTimeoutError)
+    original = NotFoundError("x")
+    assert classify_exception(original) is original
+
+
+def test_retriability_is_typed_not_string_matched():
+    assert is_retriable(ConflictError("cas"))
+    assert is_retriable(ServerError(502, ""))
+    assert is_retriable(PartitionError(""))
+    assert not is_retriable(NotFoundError(""))
+    assert not is_retriable(ApiError(400, "bad request"))
+
+
+def test_outage_classification_separates_answers_from_outages():
+    """4xx responses are ANSWERS (the server is alive); only 5xx /
+    transport failures count toward degraded/down."""
+    assert is_outage(ServerError(500, ""))
+    assert is_outage(PartitionError(""))
+    assert is_outage(BrokenPipeError("gone"))
+    assert not is_outage(NotFoundError(""))
+    assert not is_outage(ConflictError(""))
+
+
+def test_local_os_errors_are_not_outage_evidence():
+    """FileNotFoundError/PermissionError etc. are LOCAL failures (an
+    unreadable serviceaccount token, a bad path) — never evidence the
+    API server is unreachable; a kubelet rotating the token must not
+    park the control plane in degraded mode."""
+    from gpumounter_tpu.k8s.errors import classify_exception
+    for exc in (FileNotFoundError("/var/run/secrets/token"),
+                PermissionError("denied"),
+                IsADirectoryError("/etc/kubernetes")):
+        assert not is_outage(exc)
+        assert not is_retriable(exc)
+        assert not isinstance(classify_exception(exc), PartitionError)
+    # Genuine transport OSErrors still classify as partitions.
+    assert is_outage(ConnectionResetError("peer reset"))
+    assert is_outage(OSError("No route to host"))
+    assert isinstance(classify_exception(ConnectionResetError("x")),
+                      PartitionError)
+
+
+# --- the state machine ---
+
+def test_stays_healthy_below_the_degraded_threshold():
+    health = ApiHealth(cfg=CFG, now=Clock())
+    health.record_failure(ServerError(500, ""))
+    health.record_failure(ServerError(500, ""))
+    assert health.state() == "healthy"
+    assert health.ok()
+
+
+def test_degrades_after_consecutive_failures_then_downs_after_time():
+    clock = Clock()
+    health = ApiHealth(cfg=CFG, now=clock)
+    for _ in range(3):
+        health.record_failure(PartitionError("gone"))
+    assert health.state() == "degraded"
+    assert not health.ok()
+    clock.t += 11.0  # past down_after_s while the streak continues
+    health.record_failure(PartitionError("gone"))
+    assert health.state() == "down"
+    assert health.is_down()
+
+
+def test_fourxx_answers_count_as_successes():
+    """A NotFound mid-streak proves the server answered: the streak
+    resets and no degradation happens."""
+    health = ApiHealth(cfg=CFG, now=Clock())
+    health.record_failure(ServerError(500, ""))
+    health.record_failure(ServerError(500, ""))
+    health.observe(NotFoundError("an answer"))
+    health.record_failure(ServerError(500, ""))
+    health.record_failure(ServerError(500, ""))
+    assert health.state() == "healthy"
+
+
+def test_recovery_needs_consecutive_successes_hysteresis():
+    """One lucky call mid-outage must not flip the fleet back into
+    destructive mode (recovery_successes=2)."""
+    health = ApiHealth(cfg=CFG, now=Clock())
+    for _ in range(3):
+        health.record_failure(PartitionError(""))
+    assert health.state() == "degraded"
+    health.record_success()
+    assert health.state() == "degraded"  # hysteresis holds
+    health.record_failure(PartitionError(""))
+    health.record_success()
+    health.record_success()
+    assert health.state() == "healthy"
+
+
+def test_planes_are_judged_separately_asymmetric_partition():
+    """Writes black-holed while reads flow (the half-broken-LB shape):
+    read successes must NOT mask the broken write plane."""
+    health = ApiHealth(cfg=CFG, now=Clock())
+    for _ in range(5):
+        health.record_success(kind="read")
+        health.record_failure(PartitionError("write black-holed"),
+                              kind="write")
+    assert health.plane_state("read") == "healthy"
+    assert health.plane_state("write") == "degraded"
+    assert health.state() == "degraded"  # verdict = worst plane
+    assert not health.ok()
+    assert not health.write_plane_ok()
+
+
+def test_subscribers_fire_on_every_transition():
+    clock = Clock()
+    health = ApiHealth(cfg=CFG, now=clock)
+    transitions = []
+    health.subscribe(lambda old, new: transitions.append((old, new)))
+    for _ in range(3):
+        health.record_failure(PartitionError(""))
+    clock.t += 11.0
+    health.record_failure(PartitionError(""))
+    health.record_success()
+    health.record_success()
+    assert transitions == [("healthy", "degraded"), ("degraded", "down"),
+                           ("down", "healthy")]
+
+
+def test_payload_shape():
+    health = ApiHealth(cfg=CFG, endpoint="kube", now=Clock())
+    for _ in range(3):
+        health.record_failure(ServerError(503, "lb hiccup"))
+    payload = health.payload()
+    assert payload["state"] == "degraded"
+    assert payload["endpoint"] == "kube"
+    assert payload["planes"]["read"]["consecutiveFailures"] == 3
+    assert payload["planes"]["write"]["state"] == "healthy"
+    assert "ServerError" in payload["lastError"]
+    assert payload["config"]["degradedFailures"] == 3
+
+
+def test_process_global_registry_and_reset():
+    from gpumounter_tpu.k8s import health as k8s_health
+    first = api_health()
+    assert api_health() is first
+    first.record_failure(PartitionError(""))
+    k8s_health.reset_all()
+    fresh = api_health()
+    assert fresh is not first
+    assert fresh.ok()
+
+
+# --- the tracking client wrapper ---
+
+def test_tracking_client_feeds_both_planes():
+    fake = FakeKubeClient()
+    health = ApiHealth(cfg=CFG, now=Clock())
+    kube = HealthTrackingKubeClient(fake, health)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    kube.get_pod("default", "p")  # read success
+    fake.set_partitioned(True, mode="writes")
+    for _ in range(3):
+        with pytest.raises(PartitionError):
+            kube.patch_pod("default", "p", {"metadata": {}})
+    assert health.plane_state("write") == "degraded"
+    assert health.plane_state("read") == "healthy"
+    kube.get_pod("default", "p")  # reads still flow and still succeed
+    assert health.plane_state("write") == "degraded"
+
+
+def test_tracking_client_passes_fake_helpers_through():
+    """Unknown attributes (fake-only helpers) delegate to the inner
+    client, so tests can hold the wrapper transparently."""
+    fake = FakeKubeClient()
+    kube = wrap_health(fake, ApiHealth(cfg=CFG, now=Clock()))
+    kube.create_node("n1", ready=True)  # fake-only helper
+    assert kube.get_node("n1")["metadata"]["name"] == "n1"
+    assert wrap_health(kube) is kube  # idempotent wrap
+
+
+def test_notfound_does_not_count_against_health():
+    fake = FakeKubeClient()
+    health = ApiHealth(cfg=CFG, now=Clock())
+    kube = HealthTrackingKubeClient(fake, health)
+    for _ in range(5):
+        with pytest.raises(NotFoundError):
+            kube.get_pod("default", "ghost")
+    assert health.ok()
+
+
+# --- master surfaces (/healthz, /apihealth) ---
+
+@pytest.fixture()
+def app():
+    from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+    fake = FakeKubeClient()
+    cfg = Config().replace(api_health_degraded_failures=2,
+                           api_health_down_after_s=60.0)
+    app = MasterApp(fake, cfg=cfg,
+                    registry=WorkerRegistry(fake, cfg))
+    yield app, fake
+    app.registry.stop()
+
+
+def _get(app, path, authed=True):
+    from conftest import AUTH_HEADER
+    headers = dict(AUTH_HEADER) if authed else {}
+    return app.handle("GET", path, b"", headers)
+
+
+def test_healthz_carries_the_api_verdict(app):
+    app, fake = app
+    status, _, body, _ = _get(app, "/healthz", authed=False)
+    assert status == 200 and body == "ok\n"
+    fake.set_partitioned(True)
+    for _ in range(2):
+        with pytest.raises(Exception):
+            app.kube.get_pod("default", "x")
+    status, _, body, _ = _get(app, "/healthz", authed=False)
+    assert status == 200  # liveness NEVER fails on an API outage
+    assert "api: degraded" in body
+
+
+def test_apihealth_route_payload(app):
+    app, fake = app
+    status, ctype, body, _ = _get(app, "/apihealth")
+    assert status == 200 and ctype == "application/json"
+    payload = json.loads(body)
+    assert payload["api"]["state"] == "healthy"
+    # The degraded store wrapper's books ride along.
+    assert "writeBehind" in payload["store"]
+    assert payload["store"]["writeBehind"]["pending"] == 0
+    fake.set_partitioned(True)
+    for _ in range(2):
+        with pytest.raises(Exception):
+            app.kube.get_pod("default", "x")
+    payload = json.loads(_get(app, "/apihealth")[2])
+    assert payload["api"]["state"] == "degraded"
+    assert payload["api"]["planes"]["read"]["consecutiveFailures"] >= 2
+
+
+def test_apihealth_route_requires_auth(app):
+    app, _ = app
+    status, _, _, _ = _get(app, "/apihealth", authed=False)
+    assert status == 401
+
+
+# --- worker ops surface ---
+
+def test_worker_ops_apihealth_and_healthz(test_config):
+    import urllib.error
+    import urllib.request
+
+    from conftest import AUTH_HEADER
+
+    from gpumounter_tpu.worker.main import serve_ops
+    ops = serve_ops(0)
+    try:
+        port = ops.server_address[1]
+
+        def get(path, authed=True):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                headers=dict(AUTH_HEADER) if authed else {})
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, resp.read().decode()
+            except urllib.error.HTTPError as exc:
+                return exc.code, ""
+
+        status, body = get("/healthz", authed=False)
+        assert status == 200 and body == "ok\n"
+        status, body = get("/apihealth")
+        assert status == 200
+        assert json.loads(body)["api"]["state"] == "healthy"
+        assert get("/apihealth", authed=False)[0] == 401
+        # Degrade the global machine: both surfaces flip together.
+        health = api_health()
+        for _ in range(3):
+            health.record_failure(PartitionError("gone"))
+        status, body = get("/healthz", authed=False)
+        assert status == 200 and "api: degraded" in body
+        assert json.loads(get("/apihealth")[1])["api"]["state"] == \
+            "degraded"
+    finally:
+        ops.shutdown()
+        ops.server_close()
+
+
+# --- the CLI verb ---
+
+def test_cli_apihealth_verb(app):
+    import threading
+
+    from gpumounter_tpu.cli import main as cli_main
+    from gpumounter_tpu.master.app import build_http_server
+    app, fake = app
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        assert cli_main(["apihealth", "--master", base]) == 0
+        fake.set_partitioned(True)
+        for _ in range(2):
+            with pytest.raises(Exception):
+                app.kube.get_pod("default", "x")
+        fake.set_partitioned(False)
+        # The route read itself must not flip health back before the
+        # verdict is printed: hysteresis needs 2 successes and the
+        # /apihealth route makes no API calls.
+        assert cli_main(["apihealth", "--master", base]) == 3
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

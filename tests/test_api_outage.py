@@ -1,0 +1,437 @@
+"""API-server-outage degraded mode, end to end.
+
+Asymmetric partitions (reads fail while writes succeed and vice versa)
+across the store, lease renewal, and watch paths; the per-subsystem
+degraded policies (recovery suspends evacuations, the warm pool backs
+off, the worker defers slave releases into the ledger queue); the
+WorkerRegistry watch-reconnect jittered backoff; and chaos invariant 14
+— `run_api_outage_scenario` on 3 fixed seeds across mount, migrate,
+heal and recovery flavors, plus the negative control (write-behind
+replay disabled -> divergence DETECTED).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.k8s.client import PartitionError
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.k8s.health import ApiHealth, HealthTrackingKubeClient
+from gpumounter_tpu.k8s.types import Pod
+
+CFG = Config().replace(api_health_degraded_failures=2,
+                       api_health_down_after_s=60.0,
+                       k8s_write_attempts=2,
+                       k8s_write_retry_base_s=0.01)
+
+
+# --- asymmetric partitions: store reads vs writes ---
+
+def test_reads_partition_serves_cache_but_writes_land(tmp_path):
+    """mode="reads": LISTs fail (served stale from cache) while
+    annotation writes still go straight through — the write-behind
+    queue must NOT capture deliverable writes."""
+    from gpumounter_tpu.store import CachedMasterStore, KubeMasterStore
+    fake = FakeKubeClient()
+    health = ApiHealth(cfg=CFG)
+    cfg = CFG.replace(writebehind_dir=str(tmp_path / "wb"))
+    store = CachedMasterStore(
+        KubeMasterStore(HealthTrackingKubeClient(fake, health), cfg),
+        cfg=cfg, apihealth=health)
+    fake.create_pod("kube-system", {
+        "metadata": {"name": "w1", "namespace": "kube-system",
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": "n1", "containers": [{"name": "w"}]},
+        "status": {"phase": "Running", "podIP": "10.0.0.1"}})
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    assert len(store.list_worker_pods()) == 1  # primes the cache
+
+    fake.set_partitioned(True, mode="reads")
+    # Reads: stale-served from cache.
+    assert [Pod(p).name for p in store.list_worker_pods()] == ["w1"]
+    # Writes: land directly, never queued.
+    store.stamp_annotation("default", "p", "a/x", "direct")
+    assert store.queue.pending_count() == 0
+    fake.set_partitioned(False)
+    assert Pod(fake.get_pod("default", "p")).annotations["a/x"] == \
+        "direct"
+
+
+def test_writes_partition_defers_writes_but_reads_stay_fresh(tmp_path):
+    from gpumounter_tpu.store import CachedMasterStore, KubeMasterStore
+    fake = FakeKubeClient()
+    health = ApiHealth(cfg=CFG)
+    cfg = CFG.replace(writebehind_dir=str(tmp_path / "wb"))
+    store = CachedMasterStore(
+        KubeMasterStore(HealthTrackingKubeClient(fake, health), cfg),
+        cfg=cfg, apihealth=health)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    fake.set_partitioned(True, mode="writes")
+    store.stamp_annotation("default", "p", "a/x", "queued")
+    assert store.queue.pending_count() == 1
+    # Reads keep flowing fresh.
+    kube = HealthTrackingKubeClient(fake, health)
+    assert Pod(kube.get_pod("default", "p")).name == "p"
+    assert health.plane_state("read") == "healthy"
+    assert health.plane_state("write") == "degraded"
+    fake.set_partitioned(False)
+    assert store.flush_writes()["applied"] == 1
+
+
+# --- asymmetric partitions: lease renewal ---
+
+@pytest.mark.parametrize("mode", ["reads", "writes", "full"])
+def test_lease_acquire_survives_partitions_without_crashing(mode):
+    """The shard manager's acquire/renew pass must degrade cleanly
+    under any partition shape: no exception escapes, and no ownership
+    is claimed without a durable lease write."""
+    from gpumounter_tpu.master.shard import ShardManager
+    fake = FakeKubeClient()
+    cfg = CFG.replace(shard_count=2, shard_lease_duration_s=5.0,
+                      shard_preferred="")
+    manager = ShardManager(fake, cfg=cfg, replica_id="rep-0",
+                           advertise_url="http://rep-0",
+                           preferred=None).start_without_loop()
+    fake.set_partitioned(True, mode=mode)
+    newly = manager.acquire_once()  # must not raise
+    assert newly == set()
+    assert manager.owned_shards() == set()
+    fake.set_partitioned(False)
+    manager.acquire_once()
+    assert manager.owned_shards() == {0, 1}
+
+
+def test_lease_renewal_failure_under_write_partition_loses_cleanly():
+    """A holder whose renews are black-holed self-expires; the
+    challenger takes over after the TTL — no split ownership."""
+    from gpumounter_tpu.master.shard import ShardManager
+    fake = FakeKubeClient()
+    cfg = CFG.replace(shard_count=1, shard_lease_duration_s=0.3,
+                      shard_preferred="")
+    holder = ShardManager(fake, cfg=cfg, replica_id="holder",
+                          advertise_url="http://holder",
+                          preferred=None).start_without_loop()
+    holder.acquire_once()
+    assert holder.owned_shards() == {0}
+    fake.set_partitioned(True, mode="writes")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and holder.owned_shards():
+        holder.acquire_once()  # renew attempts fail; self-expiry fires
+        time.sleep(0.05)
+    assert holder.owned_shards() == set()
+    fake.set_partitioned(False)
+    challenger = ShardManager(fake, cfg=cfg, replica_id="challenger",
+                              advertise_url="http://challenger",
+                              preferred=None).start_without_loop()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not challenger.owned_shards():
+        challenger.acquire_once()
+        time.sleep(0.05)
+    assert challenger.owned_shards() == {0}
+
+
+# --- asymmetric partitions: watch paths + reconnect backoff ---
+
+def test_registry_serves_cached_addresses_through_reads_partition():
+    from gpumounter_tpu.master.app import WorkerRegistry
+    fake = FakeKubeClient()
+    cfg = CFG
+    fake.create_pod(cfg.worker_namespace, {
+        "metadata": {"name": "w1", "namespace": cfg.worker_namespace,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": "n1", "containers": [{"name": "w"}]},
+        "status": {"phase": "Running", "podIP": "10.0.0.9"}})
+    registry = WorkerRegistry(fake, cfg)
+    try:
+        assert registry.worker_address("n1") == \
+            f"10.0.0.9:{cfg.worker_port}"
+        fake.set_partitioned(True, mode="reads")
+        # The watch dies and re-LISTs fail, but reads keep answering
+        # from the informer cache.
+        assert registry.worker_address("n1") == \
+            f"10.0.0.9:{cfg.worker_port}"
+    finally:
+        fake.set_partitioned(False)
+        registry.stop()
+
+
+def test_watch_backoff_grows_with_jitter():
+    from gpumounter_tpu.master.app import WorkerRegistry
+    registry = WorkerRegistry.__new__(WorkerRegistry)  # no threads
+    low = [registry._watch_backoff(1) for _ in range(50)]
+    high = [registry._watch_backoff(10) for _ in range(50)]
+    assert all(0.25 <= d <= 0.5 for d in low)
+    assert all(7.5 <= d <= WorkerRegistry.WATCH_BACKOFF_CAP_S
+               for d in high)
+    assert len(set(low)) > 1  # jittered, not a fixed step
+
+
+def test_short_lived_watch_streams_do_not_tight_loop():
+    """The 410-Gone shape: every watch ends immediately (trimmed
+    backlog). The old loop re-LISTed in a zero-sleep spin; with the
+    jittered backoff only a handful of re-opens fit in the window."""
+    from gpumounter_tpu.master.app import WorkerRegistry
+    fake = FakeKubeClient()
+    registry = WorkerRegistry(fake, CFG)
+    opens = [0]
+
+    class _InstantEndStore:
+        def list_worker_pods(self):
+            return []
+
+        def watch_worker_pods(self, timeout_s=60.0):
+            opens[0] += 1
+            return iter(())  # ends instantly, no error — the 410 shape
+
+    registry.store = _InstantEndStore()
+    registry._ensure_started()
+    time.sleep(1.2)
+    registry.stop()
+    # Unbounded spin would mean thousands of opens; backoff (base .5s,
+    # doubling, jittered) allows only a few.
+    assert opens[0] <= 5, f"watch loop spun: {opens[0]} opens in 1.2s"
+
+
+# --- per-subsystem degraded policies ---
+
+def test_recovery_suspends_evacuation_while_api_unhealthy():
+    """Every confirmation signal says evacuate (worker gone, Node
+    NotReady, failures past threshold) — but the evidence was gathered
+    through a sick API, so the controller must hold; the SAME state
+    evacuates the moment the API heals."""
+    from gpumounter_tpu.recovery.controller import RecoveryController
+    from gpumounter_tpu.store import KubeMasterStore
+
+    class _Registry:
+        breaker = None
+
+        def registry_snapshot(self):
+            return {}
+
+    fake = FakeKubeClient()
+    fake.create_node("n1", ready=False)
+    cfg = CFG.replace(recovery_confirm_failures=1, recovery_grace_s=0.0)
+    health = ApiHealth(cfg=cfg)
+    controller = RecoveryController(
+        fake, _Registry(), lambda addr: None, cfg=cfg,
+        store=KubeMasterStore(fake, cfg), apihealth=health)
+    controller._nodes["n1"] = {"status": "healthy", "failures": 0,
+                               "first_failure_at": None, "reason": ""}
+    for _ in range(2):
+        health.record_failure(PartitionError("outage"))
+    out = controller.check_once()
+    assert out["evacuated"] == []
+    assert controller.payload()["nodes"]["n1"]["status"] == "suspect"
+    assert "suspended" in controller.payload()["nodes"]["n1"]["reason"]
+    # API heals -> same evidence, fresh -> evacuation proceeds.
+    health.record_success()
+    health.record_success()
+    out = controller.check_once()
+    assert out["evacuated"] == ["n1"]
+
+
+def test_warm_pool_refill_backs_off_during_outage():
+    from gpumounter_tpu.allocator.pool import WarmPodPool
+    fake = FakeKubeClient()
+    cfg = CFG.replace(warm_pool_size=2)
+    health = ApiHealth(cfg=cfg)
+    pool = WarmPodPool(fake, cfg=cfg, refill_async=False,
+                       apihealth=health)
+    pool.ensure_node("n1")
+    for _ in range(2):
+        health.record_failure(PartitionError("outage"))
+    before = fake.create_calls
+    assert pool.refill_once() == 0
+    assert fake.create_calls == before  # no doomed creates, no deletes
+    health.record_success()
+    health.record_success()
+    assert pool.refill_once() >= 0  # pass runs again once healthy
+    assert fake.create_calls > before
+
+
+def test_ledger_release_queue_is_durable(tmp_path):
+    from gpumounter_tpu.worker.ledger import MountLedger
+    ledger = MountLedger(str(tmp_path))
+    rel = ledger.queue_release("tpu-pool", ["slave-a", "slave-b"])
+    assert [r["pods"] for r in ledger.pending_releases()] == \
+        [["slave-a", "slave-b"]]
+    ledger.abandon()  # crash
+    reloaded = MountLedger(str(tmp_path))
+    assert [r["rel"] for r in reloaded.pending_releases()] == [rel]
+    reloaded.complete_release(rel)
+    assert reloaded.pending_releases() == []
+    reloaded.complete_release(rel)  # idempotent
+    reloaded.abandon()
+    third = MountLedger(str(tmp_path))
+    assert third.pending_releases() == []  # the done record persisted
+    third.abandon()
+
+
+def test_migration_pauses_at_phase_boundary_unit():
+    """Coordinator-level unit for the pause: with an unhealthy verdict
+    the machine holds before executing the next phase and journals the
+    pause; recovery releases it."""
+    import threading
+
+    from gpumounter_tpu.migrate.orchestrator import MigrationCoordinator
+    health = ApiHealth(cfg=CFG)
+    coordinator = MigrationCoordinator.__new__(MigrationCoordinator)
+    coordinator.cfg = CFG.replace(migrate_poll_interval_s=0.01)
+    coordinator.apihealth = health
+    coordinator._aborts = set()
+    persisted = []
+    coordinator._persist = lambda j: persisted.append(dict(j))
+    journal = {"id": "mig-x", "phase": "drain"}
+    for _ in range(2):
+        health.record_failure(PartitionError("outage"))
+    released = threading.Event()
+
+    def _wait():
+        coordinator._await_api_healthy(journal)
+        released.set()
+
+    thread = threading.Thread(target=_wait, daemon=True)
+    thread.start()
+    time.sleep(0.1)
+    assert not released.is_set()  # held at the boundary
+    assert persisted and persisted[0]["paused_for_api"] is True
+    health.record_success()
+    health.record_success()
+    assert released.wait(5.0)
+    assert "paused_for_api" not in journal
+
+
+# --- chaos invariant 14 ---
+
+SEEDS = [101, 202, 303]
+FLAVORS = ["mount", "migrate", "heal", "recovery"]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("flavor", FLAVORS)
+def test_invariant14_api_outage(tmp_path, seed, flavor):
+    from gpumounter_tpu.testing.chaos import ChaosHarness
+    with ChaosHarness(str(tmp_path), seed=seed) as harness:
+        out = harness.run_api_outage_scenario(flavor=flavor)
+    assert out["apihealth"]["state"] == "healthy"
+    assert out["queue"]["pending"] == 0
+
+
+def test_invariant14_negative_control_detects_broken_replay(tmp_path):
+    """With the write-behind replay disabled, the queued writes never
+    land — and the harness must DETECT that divergence, proving the
+    invariant check has teeth."""
+    from gpumounter_tpu.testing.chaos import (
+        ChaosHarness,
+        InvariantViolation,
+    )
+    with ChaosHarness(str(tmp_path), seed=SEEDS[0]) as harness:
+        with pytest.raises(InvariantViolation, match="divergence"):
+            harness.run_api_outage_scenario(flavor="mount",
+                                            replay_enabled=False)
+
+
+def test_long_healthy_stream_error_resets_backoff_escalation():
+    """Watch streams that live past MIN_HEALTHY_WATCH_S before erroring
+    did useful work: each such failure counts as the FIRST (backoff
+    stays at base), else hours-apart transport errors would ratchet
+    the reconnect delay to its cap forever."""
+    from gpumounter_tpu.master.app import WorkerRegistry
+    fake = FakeKubeClient()
+    registry = WorkerRegistry(fake, CFG)
+    registry.MIN_HEALTHY_WATCH_S = 0.05
+    backoff_args = []
+    real_backoff = registry._watch_backoff
+
+    def recording_backoff(failures):
+        backoff_args.append(failures)
+        real_backoff(failures)
+        return 0.01  # keep the test fast
+
+    registry._watch_backoff = recording_backoff
+
+    class _LongThenErrorStore:
+        def list_worker_pods(self):
+            return []
+
+        def watch_worker_pods(self, timeout_s=60.0):
+            def stream():
+                time.sleep(0.08)  # "healthy" lifetime, then a
+                raise PartitionError("LB reset")  # transport error
+                yield  # pragma: no cover — makes this a generator
+            return stream()
+
+    registry.store = _LongThenErrorStore()
+    registry._ensure_started()
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and len(backoff_args) < 4:
+        time.sleep(0.02)
+    registry.stop()
+    assert len(backoff_args) >= 4
+    assert set(backoff_args) == {1}, \
+        f"escalated across healthy streams: {backoff_args}"
+
+
+def test_deferred_release_retry_is_bounded_while_write_plane_down():
+    """During an ongoing outage the opportunistic retry inside each
+    unmount probes with at most ONE pending record — paying
+    (pending x delete timeout) inside every unmount RPC would turn a
+    long outage into quadratically escalating stalls."""
+    from gpumounter_tpu.worker.ledger import MountLedger
+    from gpumounter_tpu.worker.server import TpuMountService
+    import tempfile
+    fake = FakeKubeClient()
+    with tempfile.TemporaryDirectory() as led_dir:
+        ledger = MountLedger(led_dir)
+        service = TpuMountService.__new__(TpuMountService)
+        service.cfg = CFG
+        service.kube = fake
+        service.ledger = ledger
+        for i in range(4):
+            ledger.queue_release("tpu-pool", [f"slave-{i}"])
+        fake.set_partitioned(True)
+        attempts = []
+        orig_delete = fake.delete_pod
+
+        def counting_delete(namespace, name, **kwargs):
+            attempts.append(name)
+            return orig_delete(namespace, name, **kwargs)
+
+        fake.delete_pod = counting_delete
+        out = service.retry_pending_releases(limit=1)
+        # One record -> one doomed delete attempt, not four; the full
+        # backlog is still reported.
+        assert attempts == ["slave-0"]
+        assert out == {"completed": 0, "pending": 4}
+        fake.set_partitioned(False)
+        out = service.retry_pending_releases()
+        assert out == {"completed": 4, "pending": 0}
+        ledger.abandon()
+
+
+def test_migration_scan_degrades_to_memory_view_during_outage():
+    """When even the store's staleness cache cannot answer, /migrations
+    serves the in-memory journals instead of failing — and
+    resume_interrupted adopts nothing until the API heals."""
+    from gpumounter_tpu.migrate import MigrationCoordinator
+
+    class _RaisingStore:
+        def scan_journals(self):
+            raise PartitionError("no cache, api down")
+
+    fake = FakeKubeClient()
+    coord = MigrationCoordinator(fake, None, lambda addr: None,
+                                 cfg=CFG, store=_RaisingStore())
+    # The master-restart shape: nothing in memory, API down -> the
+    # scan degrades to empty and resume adopts nothing (vs raising).
+    assert coord.list_migrations() == []
+    assert coord.resume_interrupted() == []
+    # A running master keeps serving its in-memory journals.
+    with coord._lock:
+        coord._journals["m-1"] = {"id": "m-1", "phase": "drain",
+                                  "created_at": 1.0}
+    assert [j["id"] for j in coord.list_migrations()] == ["m-1"]
+    assert coord.get("m-1")["phase"] == "drain"

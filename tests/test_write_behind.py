@@ -1,0 +1,373 @@
+"""WriteBehindQueue + CachedMasterStore degraded-mode mechanics.
+
+The store seam's outage behavior: annotation writes made while the API
+is unreachable are intent-logged into an fsync'd JSONL queue (the
+worker-ledger discipline), coalesced per key, reloaded across process
+restarts, and replayed idempotently exactly-once on reconnect with CAS
+conflict resolution; reads fall back to a bounded-staleness cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.k8s.client import PartitionError
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.k8s.health import ApiHealth
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.store import (
+    CachedMasterStore,
+    KubeMasterStore,
+    WriteBehindQueue,
+)
+
+CFG = Config().replace(api_health_degraded_failures=2,
+                       api_health_down_after_s=60.0,
+                       api_cache_max_staleness_s=300.0,
+                       k8s_write_attempts=2,
+                       k8s_write_retry_base_s=0.01)
+
+
+def make_store(tmp_path, fake=None, durable=True):
+    from gpumounter_tpu.k8s.health import HealthTrackingKubeClient
+    fake = fake or FakeKubeClient()
+    health = ApiHealth(cfg=CFG)
+    cfg = CFG.replace(writebehind_dir=str(tmp_path / "wb")
+                      if durable else "")
+    # The production shape (MasterApp): the inner store talks through
+    # the health-tracked client, so its failures feed the machine.
+    store = CachedMasterStore(
+        KubeMasterStore(HealthTrackingKubeClient(fake, health), cfg),
+        cfg=cfg, apihealth=health)
+    return store, fake, health
+
+
+# --- queue mechanics ---
+
+def test_queue_is_durable_across_restart(tmp_path):
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/x", "v1")
+    q.enqueue("default", "p", "a/y", "v2")
+    q.close()
+    reloaded = WriteBehindQueue(str(tmp_path))
+    pending = reloaded.pending()
+    assert [(r["annotation"], r["payload"]) for r in pending] == \
+        [("a/x", "v1"), ("a/y", "v2")]
+
+
+def test_queue_coalesces_same_key_newest_wins(tmp_path):
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/x", "old")
+    q.enqueue("default", "p", "a/x", "newer")
+    q.enqueue("default", "p", "a/x", "newest")
+    pending = q.pending()
+    assert len(pending) == 1
+    assert pending[0]["payload"] == "newest"
+    assert q.stats()["closed"]["superseded"] == 2
+
+
+def test_flush_applies_in_order_exactly_once(tmp_path):
+    fake = FakeKubeClient()
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/x", "v1")
+    q.enqueue("default", "p", "a/y", "v2")
+    summary = q.flush(fake)
+    assert summary["applied"] == 2 and summary["pending"] == 0
+    annotations = Pod(fake.get_pod("default", "p")).annotations
+    assert annotations["a/x"] == "v1" and annotations["a/y"] == "v2"
+    # Replay is exactly-once: a second flush has nothing to do.
+    assert q.flush(fake)["applied"] == 0
+
+
+def test_flush_halts_on_outage_and_resumes(tmp_path):
+    fake = FakeKubeClient()
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/x", "v1")
+    fake.set_partitioned(True)
+    summary = q.flush(fake)
+    assert summary["applied"] == 0 and summary["pending"] == 1
+    assert "PartitionError" in summary["error"]
+    fake.set_partitioned(False)
+    assert q.flush(fake)["applied"] == 1
+
+
+def test_flush_cas_drops_writes_a_newer_counter_beat(tmp_path):
+    fake = FakeKubeClient()
+    fake.create_pod("default", {"metadata": {"name": "p", "annotations": {
+        "a/marker": json.dumps({"seq": 7, "who": "fresh-writer"})}}})
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/marker",
+              json.dumps({"seq": 3, "who": "stale-outage-writer"}))
+    summary = q.flush(fake)
+    assert summary["lost_cas"] == 1 and summary["applied"] == 0
+    current = json.loads(
+        Pod(fake.get_pod("default", "p")).annotations["a/marker"])
+    assert current["seq"] == 7  # never rolled backward
+
+
+def test_flush_cas_applies_when_newer_than_current(tmp_path):
+    fake = FakeKubeClient()
+    fake.create_pod("default", {"metadata": {"name": "p", "annotations": {
+        "a/marker": json.dumps({"seq": 2})}}})
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/marker", json.dumps({"seq": 5}))
+    assert q.flush(fake)["applied"] == 1
+    assert json.loads(Pod(fake.get_pod(
+        "default", "p")).annotations["a/marker"])["seq"] == 5
+
+
+def test_flush_drops_writes_for_deleted_pods(tmp_path):
+    fake = FakeKubeClient()
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "ghost", "a/x", "v")
+    summary = q.flush(fake)
+    assert summary["pod_gone"] == 1 and summary["pending"] == 0
+
+
+def test_torn_final_line_is_dropped_on_load(tmp_path):
+    q = WriteBehindQueue(str(tmp_path))
+    q.enqueue("default", "p", "a/x", "v1")
+    q.close()
+    path = os.path.join(str(tmp_path), "writebehind.jsonl")
+    with open(path, "ab") as f:
+        f.write(b'{"kind":"write","seq":2,"namespa')  # crash mid-append
+    reloaded = WriteBehindQueue(str(tmp_path))
+    assert [r["seq"] for r in reloaded.pending()] == [1]
+
+
+def test_compaction_keeps_pending_only(tmp_path):
+    q = WriteBehindQueue(str(tmp_path), max_bytes=4096)
+    fake = FakeKubeClient()
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    filler = "x" * 256
+    for i in range(64):
+        q.enqueue("default", "p", f"a/k{i % 4}", f"{filler}-{i}")
+    q.flush(fake)
+    q.enqueue("default", "p", "a/last", "survivor")
+    path = os.path.join(str(tmp_path), "writebehind.jsonl")
+    assert os.path.getsize(path) < 4096 + 1024  # rewritten, not grown
+    q.close()
+    reloaded = WriteBehindQueue(str(tmp_path), max_bytes=4096)
+    assert [r["annotation"] for r in reloaded.pending()] == ["a/last"]
+
+
+def test_in_memory_mode_defers_without_a_file(tmp_path):
+    q = WriteBehindQueue("")  # writebehind_dir unset
+    q.enqueue("default", "p", "a/x", "v")
+    assert q.pending_count() == 1
+    assert not q.stats()["durable"]
+
+
+# --- the degraded store wrapper ---
+
+def test_store_defers_writes_during_outage_and_flushes(tmp_path):
+    store, fake, health = make_store(tmp_path)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    fake.set_partitioned(True)
+    store.stamp_annotation("default", "p", "a/x", "deferred-value")
+    assert store.queue.pending_count() == 1
+    assert not health.ok()  # the failed attempts fed the machine
+    fake.set_partitioned(False)
+    summary = store.flush_writes()
+    assert summary["applied"] == 1
+    assert Pod(fake.get_pod("default", "p")).annotations["a/x"] == \
+        "deferred-value"
+
+
+def test_store_short_circuits_when_write_plane_is_down(tmp_path):
+    store, fake, health = make_store(tmp_path)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    clockless = CFG  # down requires time: drive the plane directly
+    for _ in range(3):
+        health.record_failure(PartitionError("x"), kind="write")
+    # force down: replay the streak after the down window
+    health.down_after_s = 0.0
+    health.record_failure(PartitionError("x"), kind="write")
+    assert health.plane_state("write") == "down"
+    before = fake.create_calls
+    store.stamp_annotation("default", "p", "a/x", "v")
+    # No round trip was paid: queued directly.
+    assert store.queue.pending_count() == 1
+    del clockless, before
+
+
+def test_store_preserves_order_once_a_key_is_queued(tmp_path):
+    """A direct write racing the flush must not be overwritten by the
+    replay of an OLDER queued value: later writes for a queued key
+    queue behind it."""
+    store, fake, _health = make_store(tmp_path)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    fake.set_partitioned(True)
+    store.stamp_annotation("default", "p", "a/x", "old-queued")
+    fake.set_partitioned(False)
+    # API healed, but the queue still holds the key: this write must
+    # NOT go direct (it would be clobbered by the old replay).
+    store.stamp_annotation("default", "p", "a/x", "newest")
+    assert store.queue.pending_count() == 1  # coalesced, newest wins
+    store.flush_writes()
+    assert Pod(fake.get_pod("default", "p")).annotations["a/x"] == \
+        "newest"
+
+
+def test_store_serves_bounded_stale_reads_during_outage(tmp_path):
+    store, fake, _health = make_store(tmp_path)
+    fake.create_pod("kube-system", {
+        "metadata": {"name": "w1", "namespace": "kube-system",
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": "n1", "containers": [{"name": "w"}]},
+        "status": {"phase": "Running", "podIP": "10.0.0.1"}})
+    fresh = store.list_worker_pods()
+    assert len(fresh) == 1
+    fake.set_partitioned(True)
+    stale = store.list_worker_pods()  # served from cache
+    assert [Pod(p).name for p in stale] == ["w1"]
+    assert store.staleness()["worker_pods"] >= 0.0
+
+
+def test_store_refuses_reads_past_the_staleness_bound(tmp_path):
+    store, fake, _health = make_store(tmp_path)
+    store.max_staleness_s = 0.0  # everything is immediately too old
+    store.list_worker_pods()
+    fake.set_partitioned(True)
+    with pytest.raises(PartitionError):
+        store.list_worker_pods()
+
+
+def test_store_never_caches_node_readiness(tmp_path):
+    """Evacuation evidence must never be stale: get_node has no cache
+    fallback (the recovery controller suspends itself instead)."""
+    store, fake, _health = make_store(tmp_path)
+    fake.create_node("n1", ready=True)
+    assert store.get_node("n1") is not None
+    fake.set_partitioned(True)
+    # The inner store degrades to None on failure; the wrapper must NOT
+    # resurrect a cached Ready verdict.
+    assert store.get_node("n1") is None
+
+
+def test_store_intent_crud_is_never_deferred(tmp_path):
+    """User-facing mutations fail loudly during an outage — an intent
+    the master cannot persist must not silently apply minutes later."""
+    from gpumounter_tpu.elastic.intents import Intent
+    store, fake, _health = make_store(tmp_path)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    fake.set_partitioned(True)
+    with pytest.raises(PartitionError):
+        store.put_intent("default", "p", Intent(desired_chips=1))
+    assert store.queue.pending_count() == 0
+
+
+def test_flush_triggers_automatically_on_recovery(tmp_path):
+    import time
+    store, fake, health = make_store(tmp_path)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    fake.set_partitioned(True)
+    store.stamp_annotation("default", "p", "a/x", "auto")
+    assert store.queue.pending_count() == 1
+    fake.set_partitioned(False)
+    # Two successes on the degraded (write) plane flip the machine
+    # healthy; the transition subscriber flushes on a worker thread.
+    health.record_success(kind="write")
+    health.record_success(kind="write")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and store.queue.pending_count():
+        time.sleep(0.01)
+    assert store.queue.pending_count() == 0
+    assert Pod(fake.get_pod("default", "p")).annotations["a/x"] == "auto"
+
+
+def test_notfound_evicts_cached_entry(tmp_path):
+    """A deleted object must not be resurrected from cache during a
+    later outage: the NotFound ANSWER evicts the stale entry."""
+    from gpumounter_tpu.k8s.client import NotFoundError
+    store, fake, health = make_store(tmp_path)
+    fake.create_pod("default", {"metadata": {"name": "t1"}})
+    store.get_intent("default", "t1")           # primes the cache
+    fake.delete_pod("default", "t1")
+    with pytest.raises(NotFoundError):
+        store.get_intent("default", "t1")       # evicts the ghost
+    fake.set_partitioned(True)
+    with pytest.raises(PartitionError):         # nothing stale served
+        store.get_intent("default", "t1")
+
+
+def test_pool_pods_and_journals_serve_cache_not_empty(tmp_path):
+    """The inner store must PROPAGATE outage failures on
+    scan_journals/list_pool_pods — swallowing them into [] would hand
+    the wrapper a fresh-stamped empty answer that both masks the
+    outage and destroys the cached real data."""
+    store, fake, health = make_store(tmp_path)
+    fake.create_pod(CFG.pool_namespace, {
+        "metadata": {"name": "slave-1", "namespace": CFG.pool_namespace},
+        "spec": {"nodeName": "n1", "containers": [{"name": "s"}]},
+        "status": {"phase": "Running"}})
+    assert [Pod(p).name for p in store.list_pool_pods("n1")] == \
+        ["slave-1"]                             # primes the cache
+    fake.set_partitioned(True)
+    assert [Pod(p).name for p in store.list_pool_pods("n1")] == \
+        ["slave-1"]                             # cached, not []
+
+
+def test_scan_and_pool_reads_propagate_outage_without_cache(tmp_path):
+    store, fake, health = make_store(tmp_path)
+    fake.set_partitioned(True)
+    with pytest.raises(PartitionError):
+        store.scan_journals()
+    with pytest.raises(PartitionError):
+        store.list_pool_pods("n1")
+
+
+def test_write_probe_recovers_idle_master_after_heal(tmp_path):
+    """Liveness regression: after the API heals, an IDLE master (every
+    subsystem parked on the unhealthy verdict, no natural write
+    traffic) must converge on its own — the prober's flush attempts
+    are the write-plane successes that flip the verdict back."""
+    from gpumounter_tpu.k8s.health import HealthTrackingKubeClient
+    fake = FakeKubeClient()
+    health = ApiHealth(cfg=CFG)
+    cfg = CFG.replace(writebehind_dir=str(tmp_path / "wb"),
+                      api_health_probe_interval_s=0.05)
+    store = CachedMasterStore(
+        KubeMasterStore(HealthTrackingKubeClient(fake, health), cfg),
+        cfg=cfg, apihealth=health)
+    fake.create_pod("default", {"metadata": {"name": "p"}})
+    fake.set_partitioned(True)
+    store.stamp_annotation("default", "p", "a/x", "v")  # deferred
+    assert not health.ok()
+    fake.set_partitioned(False)  # heal; NO further traffic from us
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and \
+            (not health.ok() or store.queue.pending_count()):
+        time.sleep(0.02)
+    assert health.ok()
+    assert store.queue.pending_count() == 0
+    assert Pod(fake.get_pod("default", "p")).annotations["a/x"] == "v"
+
+
+def test_write_probe_lease_touch_recovers_empty_queue(tmp_path):
+    """Same deadlock with nothing queued: the prober's lease touch is
+    the only write that can recover the plane."""
+    from gpumounter_tpu.k8s.health import HealthTrackingKubeClient
+    fake = FakeKubeClient()
+    health = ApiHealth(cfg=CFG)
+    cfg = CFG.replace(writebehind_dir=str(tmp_path / "wb"),
+                      api_health_probe_interval_s=0.05)
+    store = CachedMasterStore(
+        KubeMasterStore(HealthTrackingKubeClient(fake, health), cfg),
+        cfg=cfg, apihealth=health)
+    for _ in range(2):  # transition arms the prober
+        health.record_failure(PartitionError("outage"), kind="write")
+    assert not health.ok()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not health.ok():
+        time.sleep(0.02)
+    assert health.ok()
+    assert fake.get_lease(CFG.worker_namespace,
+                          CachedMasterStore.PROBE_LEASE) is not None
