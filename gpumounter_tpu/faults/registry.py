@@ -1,0 +1,54 @@
+"""Declared failpoint sites — the single source of truth tpulint checks.
+
+Every `failpoints.fire(...)` / `failpoints.value(...)` site name in the
+tree must be declared here exactly once (tools/tpulint rule
+`failpoint-registry`), and every declared name must (a) still have a
+site and (b) be armed from at least one chaos scenario or test — an
+injection point nothing exercises is a crash window nobody has proven
+survivable. Names are dotted `plane.site[.qualifier]`; sites built with
+f-strings are covered by DYNAMIC_PREFIXES instead (the arm specs for
+those carry the concrete suffix, e.g. `k8s.patch_pod`).
+
+This module is data, not behavior: the failpoint runtime
+(faults/failpoints.py) deliberately does NOT consult it, so arming an
+undeclared point still works in a dev loop — the lint gate is where
+drift is caught.
+"""
+
+from __future__ import annotations
+
+FAILPOINTS: dict[str, str] = {
+    # elastic reconciler (gpumounter_tpu/elastic/reconciler.py)
+    "elastic.reconcile": "top of one reconcile pass for a keyed intent",
+    "elastic.before_grow": "after placement, before the grow mounts fire",
+    # slice coordinator (gpumounter_tpu/master/slice_ops.py)
+    "master.slice.mount": "per-host mount fan-out, before the worker RPC",
+    "master.slice.rollback.skip": "value(): skip slice rollback (leak "
+                                  "simulation for the chaos harness)",
+    # migration machine (gpumounter_tpu/migrate/orchestrator.py)
+    "migrate.persist": "before a journal annotation persist",
+    # warm pool (gpumounter_tpu/allocator/pool.py)
+    "pool.refill": "per-node warm-pool refill attempt",
+    # rpc client (gpumounter_tpu/rpc/client.py)
+    "rpc.client.call": "before every outbound worker RPC attempt",
+    "rpc.client.deadline": "value(): per-call deadline override",
+    # worker daemon (gpumounter_tpu/worker/)
+    "worker.rpc": "top of every worker RPC handler (method= ctx)",
+    "worker.mount.before_grant": "mount batch: before the cgroup grant",
+    "worker.mount.after_grant": "mount batch: grant done, nodes not yet "
+                                "injected",
+    "worker.mount.mknod": "per-chip device-node injection",
+    "worker.mount.rollback": "per-cgroup grant undo during rollback",
+    "worker.addtpu.rollback.skip": "value(): skip mount rollback (leak "
+                                   "simulation)",
+    "worker.unmount.before_revoke": "unmount batch: before the cgroup "
+                                    "revoke",
+}
+
+#: f-string site families: any name starting with one of these prefixes
+#: is declared by the prefix (the suffix is data — a k8s verb, a
+#: migration phase).
+DYNAMIC_PREFIXES: frozenset[str] = frozenset({
+    "k8s.",            # k8s/client.py: k8s.<op> and k8s.<op>.status
+    "migrate.phase.",  # orchestrator: migrate.phase.<phase>
+})

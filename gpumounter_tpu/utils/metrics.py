@@ -22,6 +22,29 @@ import time
 from dataclasses import dataclass, field
 
 
+#: The bounded label-key vocabulary. Every label key used on any
+#: instrument must come from this set (tpulint rule metrics-discipline)
+#: — label VALUES are budgeted by tests/test_metrics_cardinality.py,
+#: label KEYS are budgeted here. Adding a key is a reviewed decision:
+#: each one multiplies the worst-case series count, so the addition
+#: must say what bounds its value domain.
+ALLOWED_LABEL_KEYS = frozenset({
+    "endpoint",   # k8s API endpoint (bounded by the client surface)
+    "kind",       # record/read kind (bounded enums per subsystem)
+    "method",     # RPC method name (bounded by the proto surface)
+    "name",       # failpoint site name (bounded by faults/registry.py)
+    "node",       # node name (budgeted: fleet-scoped series only)
+    "objective",  # SLO objective id (bounded by config)
+    "outcome",    # operation outcome enum
+    "phase",      # mount/migration phase enum
+    "reason",     # failure-reason enum
+    "result",     # success/error result enum
+    "state",      # health-state enum
+    "window",     # SLO burn window (bounded by config)
+    "worker",     # worker address (budgeted: fleet-scoped series only)
+})
+
+
 def _fmt_float(value: float) -> str:
     """Prometheus-style bucket bound: integral bounds render bare
     ("1", "30"), everything else as the shortest float repr."""

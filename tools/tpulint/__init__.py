@@ -1,0 +1,47 @@
+"""tpulint: project-invariant static analysis for the tpumounter tree.
+
+One parsed-module index, ~9 small AST rules (tools/tpulint/rules.py),
+a static lock-order deadlock check (tools/tpulint/lockorder.py) with a
+runtime cross-check (gpumounter_tpu/utils/locks.py), and a shrink-only
+baseline (tools/tpulint/baseline.py). Run it:
+
+    python -m tools.tpulint --check          # the CI gate
+    python -m tools.tpulint --json           # machine-readable
+    python -m tools.tpulint --lock-graph     # dump the static graph
+    python -m tools.tpulint --verify-dynamic TRACE.json
+
+Operator docs: docs/RUNBOOK.md, "Responding to a tpulint failure".
+"""
+
+from __future__ import annotations
+
+from tools.tpulint.index import Finding, Module, ProjectIndex  # noqa: F401
+
+
+def run(index: "ProjectIndex", rule_ids: set[str] | None = None):
+    """Run every rule (or the named subset) plus the lock-order pass.
+    Returns (findings, lock_graph); findings are deduplicated and
+    sorted by location."""
+    from tools.tpulint import lockorder
+    from tools.tpulint.rules import RULES
+
+    findings: list[Finding] = []
+    for rule in RULES:
+        if rule_ids is not None and rule.id not in rule_ids:
+            continue
+        findings.extend(rule.check(index))
+    graph = None
+    if rule_ids is None or lockorder.RULE_ID in rule_ids:
+        graph, cycle_findings = lockorder.check(index)
+        findings.extend(cycle_findings)
+    seen = set()
+    unique: list[Finding] = []
+    for finding in sorted(findings,
+                          key=lambda f: (f.path, f.line, f.rule,
+                                         f.message)):
+        key = (finding.rule, finding.path, finding.line, finding.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(finding)
+    return unique, graph

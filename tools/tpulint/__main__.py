@@ -1,0 +1,158 @@
+"""CLI for tpulint — see tools/tpulint/__init__.py and docs/RUNBOOK.md.
+
+Exit codes: 0 clean (modulo baseline), 1 findings, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.tpulint import baseline as baseline_mod
+from tools.tpulint import lockorder, run
+from tools.tpulint.index import ProjectIndex
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="Project-invariant static analysis + lock-order "
+                    "deadlock detection for the tpumounter tree.")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: cwd, or the tree "
+                             "containing this file)")
+    parser.add_argument("--check", action="store_true",
+                        help="explicit CI-gate mode (the default "
+                             "behavior; the flag documents intent)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    parser.add_argument("--baseline", action="store_true",
+                        help="apply the baseline (the default; flag "
+                             "kept for explicit invocations)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, grandfathered or "
+                             "not")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate tools/tpulint/baseline.json "
+                             "from the current findings (only after "
+                             "REDUCING debt)")
+    parser.add_argument("--baseline-path",
+                        default=baseline_mod.DEFAULT_PATH)
+    parser.add_argument("--rule", action="append", default=None,
+                        help="run only this rule id (repeatable)")
+    parser.add_argument("--lock-graph", action="store_true",
+                        help="dump the static lock-order graph and exit")
+    parser.add_argument("--verify-dynamic", metavar="TRACE_JSON",
+                        help="cross-check a runtime lock-order trace "
+                             "(chaos harness TPM_LOCK_TRACE export) "
+                             "against the static graph")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from tools.tpulint.rules import RULES
+        for rule in RULES:
+            print(f"{rule.id:26s} {rule.doc}")
+        print(f"{lockorder.RULE_ID:26s} static lock-nesting cycle "
+              "detection (see tools/tpulint/lockorder.py)")
+        return 0
+
+    root = args.root or _default_root()
+    try:
+        index = ProjectIndex.load(root)
+    except (OSError, SyntaxError) as exc:
+        print(f"tpulint: cannot load tree at {root}: {exc}",
+              file=sys.stderr)
+        return 2
+    if not index.modules:
+        print(f"tpulint: no {ProjectIndex.PACKAGE} modules under {root}",
+              file=sys.stderr)
+        return 2
+
+    if args.lock_graph:
+        graph = lockorder.build_graph(index)
+        payload = graph.as_dict()
+        cycle = lockorder.find_cycle(graph.edge_set())
+        payload["cycle"] = cycle
+        print(json.dumps(payload, indent=1) if args.json
+              else _render_graph(payload))
+        return 1 if cycle else 0
+
+    if args.verify_dynamic:
+        try:
+            with open(args.verify_dynamic, encoding="utf-8") as f:
+                trace = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"tpulint: cannot read trace {args.verify_dynamic}: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+        findings = lockorder.verify_dynamic(index, trace)
+        _print_findings(findings, args.json,
+                        note=f"dynamic trace: {len(trace.get('edges', []))}"
+                             " observed edge(s)")
+        return 1 if findings else 0
+
+    rule_ids = set(args.rule) if args.rule else None
+    if args.write_baseline and rule_ids is not None:
+        # A filtered run sees only a subset of findings; writing it out
+        # would silently drop every other rule's grandfathered entries
+        # and turn them into repo-wide regressions on the next check.
+        print("tpulint: --write-baseline needs a full run; drop --rule",
+              file=sys.stderr)
+        return 2
+    findings, _graph = run(index, rule_ids)
+
+    if args.write_baseline:
+        count = baseline_mod.write(findings, index, args.baseline_path)
+        print(f"tpulint: baseline written with {count} grandfathered "
+              f"finding(s) -> {args.baseline_path}")
+        return 0
+
+    absorbed = 0
+    if not args.no_baseline:
+        entries = baseline_mod.load(args.baseline_path)
+        findings, absorbed = baseline_mod.subtract(findings, index,
+                                                   entries)
+    _print_findings(findings, args.json,
+                    note=f"{absorbed} grandfathered by baseline"
+                    if absorbed else "")
+    return 1 if findings else 0
+
+
+def _default_root() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.isdir(os.path.join(os.getcwd(), ProjectIndex.PACKAGE)):
+        return os.getcwd()
+    return here
+
+
+def _render_graph(payload: dict) -> str:
+    lines = [f"{len(payload['nodes'])} lock node(s), "
+             f"{len(payload['edges'])} nesting edge(s)"]
+    for edge in payload["edges"]:
+        lines.append(f"  {edge['src']} -> {edge['dst']}   "
+                     f"[{edge['at']} {edge['via']}]")
+    lines.append("cycle: " + (" -> ".join(payload["cycle"])
+                              if payload["cycle"] else "none (acyclic)"))
+    return "\n".join(lines)
+
+
+def _print_findings(findings, as_json: bool, note: str = "") -> None:
+    if as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "count": len(findings), "note": note}, indent=1))
+        return
+    for finding in findings:
+        print(finding.render())
+    summary = f"tpulint: {len(findings)} finding(s)"
+    if note:
+        summary += f" ({note})"
+    print(summary)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

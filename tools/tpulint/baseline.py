@@ -1,0 +1,74 @@
+"""Baseline ("ratchet") file: grandfathered findings CI ignores.
+
+The baseline exists so turning tpulint on did not require fixing every
+historical violation in one PR: existing debt is recorded here, CI
+fails only on REGRESSIONS (new findings, or more instances of an old
+one), and the file is expected to shrink over time — never grow.
+A finding's baseline identity is its fingerprint (rule + path + the
+normalized text of the flagged line), so renumbering-only edits don't
+invalidate entries, while any change to the flagged line itself drops
+its grandfathering (you touched it, you fix it).
+
+  python -m tools.tpulint --write-baseline   # after REDUCING debt
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+from tools.tpulint.index import Finding, ProjectIndex
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def fingerprint_counts(findings: list[Finding],
+                       index: ProjectIndex) -> dict[str, int]:
+    counts: collections.Counter[str] = collections.Counter()
+    for finding in findings:
+        module = index.module(finding.path)
+        counts[finding.fingerprint(module)] += 1
+    return dict(counts)
+
+
+def load(path: str = DEFAULT_PATH) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("entries", {}).items()}
+
+
+def write(findings: list[Finding], index: ProjectIndex,
+          path: str = DEFAULT_PATH) -> int:
+    entries = fingerprint_counts(findings, index)
+    payload = {
+        "comment": ("tpulint grandfathered debt — shrink-only; see "
+                    "docs/RUNBOOK.md 'Responding to a tpulint failure'"),
+        "version": 1,
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return len(findings)
+
+
+def subtract(findings: list[Finding], index: ProjectIndex,
+             baseline: dict[str, int]) -> tuple[list[Finding], int]:
+    """(regressions, grandfathered-count): findings whose fingerprint
+    still has baseline budget are absorbed; the excess — newest lines
+    last — is reported."""
+    budget = dict(baseline)
+    fresh: list[Finding] = []
+    absorbed = 0
+    for finding in sorted(findings, key=lambda f: (f.path, f.line)):
+        module = index.module(finding.path)
+        key = finding.fingerprint(module)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            absorbed += 1
+        else:
+            fresh.append(finding)
+    return fresh, absorbed

@@ -1,0 +1,203 @@
+"""Shared parsed-module index the tpulint rules visit.
+
+Every rule is a small AST visitor; parsing the tree once and handing
+each rule the same :class:`ProjectIndex` keeps a full run at one parse
+per file. The index also owns the two cross-cutting conveniences every
+rule needs: inline waivers and import knowledge.
+
+Waivers
+-------
+A finding is suppressed by an inline comment on the flagged line (or on
+the enclosing ``with``/``try`` header the rule anchors to)::
+
+    with self._flush_lock:  # tpulint: allow[no-blocking-under-lock] single-flight by design
+        ...
+
+The reason text after the rule list is REQUIRED — a bare waiver is
+itself reported (rule ``waiver-needs-reason``). ``allow[*]`` waives
+every rule on the line. Waivers are for invariants the code genuinely
+must break with a reviewed reason; mechanical debt belongs in the
+baseline file instead (see tools/tpulint/baseline.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+#: comment grammar: `# tpulint: allow[rule-a,rule-b] reason text`
+WAIVER_RE = re.compile(
+    r"#\s*tpulint:\s*allow\[(?P<rules>[a-z0-9*,\s-]+)\]\s*(?P<reason>.*)$")
+
+
+@dataclass
+class Waiver:
+    rules: frozenset[str]
+    reason: str
+
+    def covers(self, rule_id: str) -> bool:
+        return "*" in self.rules or rule_id in self.rules
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+
+    def fingerprint(self, module: "Module | None" = None) -> str:
+        """Baseline identity: rule + path + the normalized source text
+        of the flagged line — stable across unrelated edits that only
+        shift line numbers."""
+        text = ""
+        if module is not None and 1 <= self.line <= len(module.lines):
+            text = module.lines[self.line - 1].strip()
+        return f"{self.rule}|{self.path}|{text}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint}
+
+
+class Module:
+    """One parsed source file plus the derived views rules share."""
+
+    def __init__(self, root: str, rel: str):
+        self.root = root
+        self.rel = rel.replace(os.sep, "/")
+        self.path = os.path.join(root, rel)
+        with open(self.path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.rel)
+        #: dotted module name, e.g. gpumounter_tpu.worker.ledger
+        self.dotted = self.rel[:-3].replace("/", ".") \
+            if self.rel.endswith(".py") else self.rel.replace("/", ".")
+        if self.dotted.endswith(".__init__"):
+            self.dotted = self.dotted[:-len(".__init__")]
+        self._waivers: dict[int, list[Waiver]] | None = None
+        self._imports: set[str] | None = None
+
+    # --- waivers ---
+
+    def waivers(self) -> dict[int, list[Waiver]]:
+        if self._waivers is None:
+            self._waivers = {}
+            for lineno, line in enumerate(self.lines, start=1):
+                if "tpulint" not in line:
+                    continue
+                match = WAIVER_RE.search(line)
+                if match is None:
+                    continue
+                rules = frozenset(
+                    r.strip() for r in match.group("rules").split(",")
+                    if r.strip())
+                self._waivers.setdefault(lineno, []).append(
+                    Waiver(rules=rules, reason=match.group("reason").strip()))
+        return self._waivers
+
+    def waived(self, rule_id: str, *linenos: int) -> bool:
+        """Is `rule_id` waived on any of these lines? Rules pass both
+        the finding line and the enclosing statement header line."""
+        table = self.waivers()
+        for lineno in linenos:
+            for waiver in table.get(lineno, ()):
+                if waiver.covers(rule_id):
+                    return True
+        return False
+
+    def reasonless_waivers(self) -> list[int]:
+        return [lineno for lineno, waivers in self.waivers().items()
+                if any(not w.reason for w in waivers)]
+
+    # --- imports ---
+
+    def imports(self) -> set[str]:
+        """Every dotted module name this file imports (both `import x.y`
+        and `from x.y import z` record `x.y`)."""
+        if self._imports is None:
+            found: set[str] = set()
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        found.add(alias.name)
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    found.add(node.module)
+            self._imports = found
+        return self._imports
+
+    def imports_package(self, prefix: str) -> bool:
+        return any(name == prefix or name.startswith(prefix + ".")
+                   for name in self.imports())
+
+    def finding(self, rule_id: str, node: ast.AST, message: str,
+                hint: str = "") -> Finding:
+        return Finding(rule=rule_id, path=self.rel,
+                       line=getattr(node, "lineno", 1), message=message,
+                       hint=hint)
+
+
+class ProjectIndex:
+    """All parsed modules under the analysis root (default: the
+    gpumounter_tpu package) plus the raw sources of the test/chaos tree
+    (for reachability checks that read string literals only)."""
+
+    PACKAGE = "gpumounter_tpu"
+    TEST_DIRS = ("tests", os.path.join("gpumounter_tpu", "testing"))
+
+    def __init__(self, root: str, modules: dict[str, Module],
+                 test_sources: dict[str, str]):
+        self.root = root
+        self.modules = modules
+        self.test_sources = test_sources
+
+    @classmethod
+    def load(cls, root: str, package: str | None = None) -> "ProjectIndex":
+        package = package or cls.PACKAGE
+        modules: dict[str, Module] = {}
+        pkg_root = os.path.join(root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), root)
+                module = Module(root, rel)
+                modules[module.rel] = module
+        test_sources: dict[str, str] = {}
+        for test_dir in cls.TEST_DIRS:
+            full = os.path.join(root, test_dir)
+            if not os.path.isdir(full):
+                continue
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for name in sorted(filenames):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, root).replace(os.sep, "/")
+                    with open(path, encoding="utf-8") as f:
+                        test_sources[rel] = f.read()
+        return cls(root, modules, test_sources)
+
+    def module(self, rel: str) -> Module | None:
+        return self.modules.get(rel.replace(os.sep, "/"))
+
+    def by_dotted(self, dotted: str) -> Module | None:
+        for module in self.modules.values():
+            if module.dotted == dotted:
+                return module
+        return None

@@ -1,0 +1,468 @@
+"""Static lock-order extraction + cycle detection (the deadlock half).
+
+Builds the project's static lock-nesting graph: one node per lock, one
+edge outer -> inner for every way the source can hold `outer` while
+acquiring `inner`. Edges come from three observations, cheapest first:
+
+  1. lexical nesting: a ``with <lock>:`` region containing another
+     ``with <lock>:`` (or a bare ``.acquire()``) in the same function;
+  2. same-class calls: a region calling ``self.method()`` where
+     `method` (transitively, within the class) acquires locks;
+  3. metrics instruments: calls like ``LEDGER_APPENDS.inc(...)`` on a
+     module-level ``REGISTRY.counter/gauge/histogram`` binding acquire
+     that instrument's internal lock (utils/metrics.py) — the most
+     common cross-module nesting in this codebase.
+
+Node identity:
+
+  * ``OrderedLock("name")`` / ``OrderedCondition("name")`` -> the name
+    (shared with the runtime recorder in utils/locks.py, which is what
+    makes the dynamic cross-check possible);
+  * bare ``threading.Lock()``-family locks -> a synthesized
+    ``<module>.<Class>.<attr>`` name, so un-migrated locks still
+    participate in cycle detection.
+
+A cycle in this graph is a potential deadlock: two threads taking the
+cycle's locks from different entry points can block each other forever.
+The analysis over-approximates (every method a region calls is assumed
+to reach every lock that method can ever take), so a reported cycle is
+a *candidate* — but an acyclic verdict is a real guarantee for the
+modeled edges, and the chaos harness's runtime validator then checks
+reality against this graph.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.tpulint.index import Finding, Module, ProjectIndex
+from tools.tpulint.rules import LOCK_NAME_RE, _attr_chain
+
+RULE_ID = "lock-order"
+HINT = ("break the cycle: pick one global order for these locks, copy "
+        "state out of the outer region instead of calling into the "
+        "inner one, or merge the locks")
+
+#: kind of metrics instrument -> shared node name. All instances of one
+#: instrument kind share a node (their locks are interchangeable
+#: leaves); utils/locks.py documents the same collapse for same-named
+#: OrderedLocks.
+INSTRUMENT_NODES = {"counter": "metrics.counter", "gauge": "metrics.gauge",
+                    "histogram": "metrics.histogram"}
+INSTRUMENT_METHODS = frozenset({
+    "inc", "dec", "set", "observe", "get", "snapshot", "total", "reset",
+    "collect", "quantile"})
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    via: str  # "nested-with" | "call:<name>" | "instrument:<name>"
+
+
+@dataclass
+class LockGraph:
+    edges: list[Edge] = field(default_factory=list)
+    nodes: set[str] = field(default_factory=set)
+
+    def edge_set(self) -> set[tuple[str, str]]:
+        return {(e.src, e.dst) for e in self.edges if e.src != e.dst}
+
+    def as_dict(self) -> dict:
+        return {
+            "nodes": sorted(self.nodes),
+            "edges": [
+                {"src": e.src, "dst": e.dst, "at": f"{e.path}:{e.line}",
+                 "via": e.via}
+                for e in sorted(self.edges,
+                                key=lambda e: (e.src, e.dst, e.path,
+                                               e.line))],
+        }
+
+
+def find_cycle(edges: set[tuple[str, str]]) -> list[str] | None:
+    """First cycle as a closed node path, or None. (Kept dependency-free
+    so `python -m tools.tpulint` needs nothing outside the stdlib; the
+    runtime twin lives in gpumounter_tpu/utils/locks.py.)"""
+    graph: dict[str, list[str]] = {}
+    for src, dst in sorted(edges):
+        if src == dst:
+            return [src, src]
+        graph.setdefault(src, []).append(dst)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: dict[str, int] = {}
+    parent: dict[str, str] = {}
+    for root in sorted(graph):
+        if color.get(root, WHITE) != WHITE:
+            continue
+        color[root] = GREY
+        stack = [(root, 0)]
+        while stack:
+            node, idx = stack[-1]
+            neighbours = graph.get(node, [])
+            if idx >= len(neighbours):
+                color[node] = BLACK
+                stack.pop()
+                continue
+            stack[-1] = (node, idx + 1)
+            nxt = neighbours[idx]
+            state = color.get(nxt, WHITE)
+            if state == GREY:
+                path = [node]
+                cur = node
+                while cur != nxt:
+                    cur = parent[cur]
+                    path.append(cur)
+                path.reverse()
+                return path + [nxt]
+            if state == WHITE:
+                color[nxt] = GREY
+                parent[nxt] = node
+                stack.append((nxt, 0))
+    return None
+
+
+class _ModuleLocks:
+    """Lock-name resolution for one module: maps `self.<attr>` (per
+    class), module-level names, and instrument bindings to node ids."""
+
+    LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+    ORDERED = frozenset({"OrderedLock", "OrderedCondition"})
+
+    def __init__(self, module: Module):
+        self.module = module
+        #: class name -> {attr -> node}
+        self.class_attrs: dict[str, dict[str, str]] = {}
+        #: module-level name -> node
+        self.globals: dict[str, str] = {}
+        #: module-level instrument name -> node ("metrics.counter"...)
+        self.instruments: dict[str, str] = {}
+        self._scan()
+
+    def _node_for_ctor(self, call: ast.Call, owner: str,
+                       attr: str) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name) and func.value.id == "threading" \
+                and func.attr in self.LOCK_FACTORIES:
+            return f"{owner}.{attr}"
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if name in self.ORDERED and call.args and isinstance(
+                call.args[0], ast.Constant):
+            return str(call.args[0].value)
+        return None
+
+    def _scan(self) -> None:
+        mod_prefix = self.module.dotted.removeprefix("gpumounter_tpu.")
+        for node in self.module.tree.body:
+            # module-level locks and instruments
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                for target in node.targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    lock_node = self._node_for_ctor(
+                        node.value, mod_prefix, target.id)
+                    if lock_node:
+                        self.globals[target.id] = lock_node
+                    func = node.value.func
+                    if isinstance(func, ast.Attribute) \
+                            and func.attr in INSTRUMENT_NODES:
+                        self.instruments[target.id] = \
+                            INSTRUMENT_NODES[func.attr]
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs: dict[str, str] = {}
+            owner = f"{mod_prefix}.{node.name}"
+            for item in ast.walk(node):
+                # self.X = threading.Lock() / OrderedLock("...")
+                if isinstance(item, ast.Assign) and isinstance(
+                        item.value, ast.Call):
+                    for target in item.targets:
+                        if isinstance(target, ast.Attribute) \
+                                and isinstance(target.value, ast.Name) \
+                                and target.value.id == "self":
+                            lock_node = self._node_for_ctor(
+                                item.value, owner, target.attr)
+                            if lock_node:
+                                attrs[target.attr] = lock_node
+                # dataclass: X: ... = field(default_factory=...)
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name) and isinstance(
+                        item.value, ast.Call):
+                    factory = self._field_factory(item.value)
+                    if factory is not None:
+                        lock_node = self._factory_node(
+                            factory, owner, item.target.id)
+                        if lock_node:
+                            attrs[item.target.id] = lock_node
+            if attrs:
+                self.class_attrs[node.name] = attrs
+
+    @staticmethod
+    def _field_factory(call: ast.Call) -> ast.AST | None:
+        func = call.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if name != "field":
+            return None
+        for kw in call.keywords:
+            if kw.arg == "default_factory":
+                return kw.value
+        return None
+
+    def _factory_node(self, factory: ast.AST, owner: str,
+                      attr: str) -> str | None:
+        # default_factory=threading.Lock
+        if isinstance(factory, ast.Attribute) and isinstance(
+                factory.value, ast.Name) \
+                and factory.value.id == "threading" \
+                and factory.attr in self.LOCK_FACTORIES:
+            return f"{owner}.{attr}"
+        # default_factory=lambda: OrderedLock("name")
+        if isinstance(factory, ast.Lambda) and isinstance(
+                factory.body, ast.Call):
+            return self._node_for_ctor(factory.body, owner, attr)
+        return None
+
+    def resolve(self, expr: ast.AST, class_name: str | None) -> str | None:
+        """Node id for a with-item / .acquire() receiver, or None."""
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self" \
+                and class_name:
+            node = self.class_attrs.get(class_name, {}).get(expr.attr)
+            if node:
+                return node
+            if LOCK_NAME_RE.search(expr.attr):
+                # lock-shaped attr with no visible constructor (built
+                # elsewhere): synthesize so nesting is still tracked
+                mod_prefix = self.module.dotted.removeprefix(
+                    "gpumounter_tpu.")
+                return f"{mod_prefix}.{class_name}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.globals:
+                return self.globals[expr.id]
+            if LOCK_NAME_RE.search(expr.id):
+                mod_prefix = self.module.dotted.removeprefix(
+                    "gpumounter_tpu.")
+                return f"{mod_prefix}.{expr.id}"
+        return None
+
+
+def _function_acquires(fn: ast.AST, locks: _ModuleLocks,
+                       class_name: str | None) -> tuple[set[str], set[str]]:
+    """(lock nodes this function may acquire, same-class methods it
+    calls) — the per-method summary the fixpoint combines."""
+    acquired: set[str] = set()
+    called: set[str] = set()
+    stack: list[ast.AST] = list(fn.body)
+    nodes: list[ast.AST] = []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        nodes.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    for node in nodes:
+        if isinstance(node, ast.With):
+            for item in node.items:
+                resolved = locks.resolve(item.context_expr, class_name)
+                if resolved:
+                    acquired.add(resolved)
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            if node.func.attr == "acquire":
+                resolved = locks.resolve(node.func.value, class_name)
+                if resolved:
+                    acquired.add(resolved)
+            if isinstance(node.func.value, ast.Name):
+                recv = node.func.value.id
+                if recv == "self":
+                    called.add(node.func.attr)
+                elif recv in locks.instruments \
+                        and node.func.attr in INSTRUMENT_METHODS:
+                    acquired.add(locks.instruments[recv])
+    return acquired, called
+
+
+def build_graph(index: ProjectIndex) -> LockGraph:
+    graph = LockGraph()
+    for module in index.modules.values():
+        locks = _ModuleLocks(module)
+        graph.nodes.update(locks.globals.values())
+        for attrs in locks.class_attrs.values():
+            graph.nodes.update(attrs.values())
+        # per-class method summaries + fixpoint over self-calls
+        for scope, class_name in _scopes(module):
+            methods = {n.name: n for n in scope
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            summaries = {name: _function_acquires(fn, locks, class_name)
+                         for name, fn in methods.items()}
+            closure: dict[str, set[str]] = {
+                name: set(acq) for name, (acq, _) in summaries.items()}
+            changed = True
+            while changed:
+                changed = False
+                for name, (_, called) in summaries.items():
+                    for callee in called & set(closure):
+                        extra = closure[callee] - closure[name]
+                        if extra:
+                            closure[name] |= extra
+                            changed = True
+            for name, fn in methods.items():
+                _emit_edges(module, fn, locks, class_name, closure, graph)
+    return graph
+
+
+def _scopes(module: Module):
+    """(statement list, class name or None) for the module body and
+    each class body."""
+    yield module.tree.body, None
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            yield node.body, node.name
+
+
+def _emit_edges(module: Module, fn, locks: _ModuleLocks,
+                class_name: str | None, closure: dict[str, set[str]],
+                graph: LockGraph) -> None:
+
+    def walk(body, held: list[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                inner_held = list(held)
+                for item in stmt.items:
+                    resolved = locks.resolve(item.context_expr, class_name)
+                    if resolved:
+                        if inner_held and inner_held[-1] != resolved:
+                            _add(inner_held[-1], resolved, stmt.lineno,
+                                 "nested-with")
+                        inner_held.append(resolved)
+                    else:
+                        _scan_expr(item.context_expr, inner_held,
+                                   stmt.lineno)
+                walk(stmt.body, inner_held)
+                continue
+            # Expressions attached directly to this statement (test,
+            # value, iter, ...), then recurse into nested bodies so a
+            # `with` under an if/for/try still nests correctly.
+            for _, value in ast.iter_fields(stmt):
+                for part in (value if isinstance(value, list) else [value]):
+                    if isinstance(part, ast.stmt):
+                        walk([part], held)
+                    elif isinstance(part, ast.excepthandler):
+                        if part.type is not None:
+                            _scan_expr(part.type, held, part.lineno)
+                        walk(part.body, held)
+                    elif isinstance(part, ast.AST):
+                        _scan_expr(part, held, stmt.lineno)
+
+    def _scan_expr(expr, held, lineno) -> None:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            _scan_node(node, held, lineno)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_node(node, held, lineno=None) -> None:
+        if not held or not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            return
+        line = lineno or getattr(node, "lineno", 0)
+        top = held[-1]
+        attr = node.func.attr
+        recv = node.func.value
+        if attr == "acquire":
+            resolved = locks.resolve(recv, class_name)
+            if resolved and resolved != top:
+                _add(top, resolved, line, "acquire")
+            return
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and attr in closure:
+                for target in closure[attr]:
+                    if target != top:
+                        _add(top, target, line, f"call:self.{attr}()")
+                return
+            if recv.id in locks.instruments \
+                    and attr in INSTRUMENT_METHODS:
+                target = locks.instruments[recv.id]
+                if target != top:
+                    _add(top, target, line, f"instrument:{recv.id}")
+
+    def _add(src: str, dst: str, line: int, via: str) -> None:
+        graph.nodes.update((src, dst))
+        graph.edges.append(Edge(src=src, dst=dst, path=module.rel,
+                                line=line, via=via))
+
+    walk(fn.body, [])
+
+
+def check(index: ProjectIndex) -> tuple[LockGraph, list[Finding]]:
+    """The lock-order rule entry point: build the static graph, report
+    one finding per cycle (edges are removed per detected cycle so
+    independent cycles each get a finding)."""
+    graph = build_graph(index)
+    findings: list[Finding] = []
+    edges = graph.edge_set()
+    witnesses = {(e.src, e.dst): e for e in graph.edges}
+    for _ in range(64):  # bounded: each pass removes one cycle
+        cycle = find_cycle(edges)
+        if cycle is None:
+            break
+        pairs = list(zip(cycle, cycle[1:]))
+        witness = next((witnesses[p] for p in pairs if p in witnesses),
+                       None)
+        detail = ", ".join(
+            f"{a}->{b} ({witnesses[(a, b)].path}:{witnesses[(a, b)].line}"
+            f" via {witnesses[(a, b)].via})"
+            for a, b in pairs if (a, b) in witnesses)
+        findings.append(Finding(
+            RULE_ID, witness.path if witness else "tools/tpulint",
+            witness.line if witness else 1,
+            "static lock-nesting cycle (potential deadlock): "
+            f"{' -> '.join(cycle)} [{detail}]", HINT))
+        edges -= set(pairs)
+    return graph, findings
+
+
+def verify_dynamic(index: ProjectIndex, trace: dict) -> list[Finding]:
+    """Cross-check a runtime lock-order trace (utils/locks.py
+    RECORDER.dump(), exported by the chaos lane via TPM_LOCK_TRACE)
+    against the static graph: the combined edge set must stay acyclic,
+    i.e. no observed acquisition order contradicts the reviewed static
+    nesting."""
+    graph = build_graph(index)
+    static_edges = graph.edge_set()
+    dynamic_edges = {tuple(e) for e in trace.get("edges", [])
+                     if len(e) == 2 and e[0] != e[1]}
+    findings: list[Finding] = []
+    cycle = find_cycle(dynamic_edges)
+    if cycle is not None:
+        findings.append(Finding(
+            RULE_ID, "runtime-trace", 0,
+            "observed (runtime) lock acquisitions form a cycle: "
+            f"{' -> '.join(cycle)}", HINT))
+    cycle = find_cycle(static_edges | dynamic_edges)
+    if cycle is not None and not findings:
+        observed = [f"{a}->{b}" for a, b in zip(cycle, cycle[1:])
+                    if (a, b) in dynamic_edges]
+        findings.append(Finding(
+            RULE_ID, "runtime-trace", 0,
+            "runtime acquisition order contradicts the static lock "
+            f"graph: cycle {' -> '.join(cycle)} (observed edges: "
+            f"{observed})", HINT))
+    return findings

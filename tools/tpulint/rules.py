@@ -1,0 +1,813 @@
+"""tpulint rule set: one small AST visitor per project invariant.
+
+Each rule exists because reviewers already fixed its violation class by
+hand at least once (ISSUE/ROADMAP history: the PR 6 SloEngine blocking
+call under its lock, the PR 7 takeover-off-the-renew-thread fix, the
+status-string matching PR 10's typed hierarchy replaced, the PR 4
+audit/span contract). A rule is intentionally narrow: it encodes the
+convention, not general style — style belongs to generic linters.
+
+Adding a rule: subclass Rule, give it a kebab-case `id`, a one-line
+`doc`, a `hint` (the one-line fix guidance findings carry), implement
+`check(index)`, and append it to RULES. Then add positive/negative
+fixture snippets under tests/fixtures/tpulint/ (test_tpulint.py picks
+them up by rule id).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.tpulint.index import Finding, Module, ProjectIndex
+
+#: KubeClient surface (k8s/client.py) — a call to one of these inside a
+#: held-lock region is network I/O against the API server.
+KUBE_METHODS = frozenset({
+    "get_pod", "create_pod", "delete_pod", "list_pods", "patch_pod",
+    "watch_pods", "create_event", "get_lease", "create_lease",
+    "update_lease", "get_node", "list_nodes", "wait_for_pod",
+    "patch_pod_with_retry",
+})
+
+#: MasterStore seam (store/base.py) — same I/O, one hop removed.
+STORE_METHODS = frozenset({
+    "list_worker_pods", "watch_worker_pods", "put_intent", "get_intent",
+    "delete_intent", "list_intents", "scan_journals", "save_journal",
+    "list_pool_pods", "stamp_annotation",
+})
+
+#: WorkerClient RPC surface (rpc/client.py).
+RPC_METHODS = frozenset({
+    "add_tpu", "add_tpu_detailed", "remove_tpu", "probe_tpu",
+    "quiesce_status", "collect_telemetry",
+})
+
+#: directly-blocking primitives.
+BLOCKING_METHODS = frozenset({"sleep", "fsync", "fdatasync", "urlopen"})
+
+#: receiver name segments that mark a call as API-server I/O even when
+#: the method name is project-specific (`self.kube.anything(...)`).
+KUBE_RECEIVERS = frozenset({"kube", "_kube", "kube_client"})
+
+#: attribute-name shapes that identify a lock object.
+LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|locks|mu|mutex|guard|cv|cond|condition|admission)$",
+    re.IGNORECASE)
+
+#: k8s error-triage helpers — a broad handler that routes through one of
+#: these has adopted the typed vocabulary (the convention, not a dodge).
+TRIAGE_CALLS = frozenset({"is_outage", "is_retriable", "classify_exception"})
+TYPED_ERROR_NAMES = frozenset({
+    "ApiError", "NotFoundError", "ConflictError", "ServerError",
+    "ApiTimeoutError", "PartitionError",
+})
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """`self.kube.get_pod` -> ["self", "kube", "get_pod"]; non-trivial
+    bases (calls, subscripts) contribute "?"."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        parts.append("?")
+    return list(reversed(parts))
+
+
+def _walk_skipping_defs(body: list[ast.stmt]):
+    """Statements + expressions in `body`, not descending into nested
+    function/class definitions (their bodies run later, not under the
+    enclosing lock)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def is_lock_expr(expr: ast.AST) -> bool:
+    """Does this with-item context expression look like a lock?"""
+    if isinstance(expr, ast.Attribute):
+        return bool(LOCK_NAME_RE.search(expr.attr))
+    if isinstance(expr, ast.Name):
+        return bool(LOCK_NAME_RE.search(expr.id))
+    if isinstance(expr, ast.Call):
+        # `with lock.acquire_timeout(...)`-style helpers: lock-like if
+        # the receiver (or the called name itself) is.
+        func = expr.func
+        if isinstance(func, ast.Attribute):
+            return bool(LOCK_NAME_RE.search(func.attr)) \
+                or is_lock_expr(func.value)
+        return is_lock_expr(func)
+    return False
+
+
+class Rule:
+    id: str = ""
+    doc: str = ""
+    hint: str = ""
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        raise NotImplementedError
+
+
+class NoBlockingUnderLock(Rule):
+    id = "no-blocking-under-lock"
+    doc = ("No KubeClient/store/RPC call, sleep, fsync, or HTTP request "
+           "lexically inside a held-lock region")
+    hint = ("copy the state you need under the lock, release, then do the "
+            "I/O; or waive with a reviewed reason if the lock exists to "
+            "serialize exactly this I/O")
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            for func in ast.walk(module.tree):
+                if not isinstance(func, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for stmt in ast.walk(func):
+                    if not isinstance(stmt, ast.With):
+                        continue
+                    lock_items = [item for item in stmt.items
+                                  if is_lock_expr(item.context_expr)]
+                    if not lock_items:
+                        continue
+                    findings.extend(
+                        self._scan_region(module, stmt))
+        return findings
+
+    def _scan_region(self, module: Module, stmt: ast.With) -> list[Finding]:
+        findings = []
+        for node in _walk_skipping_defs(stmt.body):
+            if not isinstance(node, ast.Call):
+                continue
+            label = self._blocking_label(node)
+            if label is None:
+                continue
+            if module.waived(self.id, node.lineno, stmt.lineno):
+                continue
+            findings.append(module.finding(
+                self.id, node,
+                f"{label} inside a held-lock region "
+                f"(lock taken at line {stmt.lineno})", self.hint))
+        return findings
+
+    @staticmethod
+    def _blocking_label(call: ast.Call) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in ("urlopen",):
+                return f"HTTP request `{func.id}()`"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        chain = _attr_chain(func)
+        method = chain[-1]
+        receivers = set(chain[:-1])
+        if method in BLOCKING_METHODS:
+            # `time.sleep` / `os.fsync` / `urllib.request.urlopen`
+            return f"blocking call `{'.'.join(chain)}`"
+        if method in KUBE_METHODS or receivers & KUBE_RECEIVERS:
+            return f"KubeClient call `{'.'.join(chain)}`"
+        if method in STORE_METHODS and receivers & {
+                "store", "_store", "inner", "self"}:
+            return f"MasterStore call `{'.'.join(chain)}`"
+        if method in RPC_METHODS:
+            return f"worker RPC `{'.'.join(chain)}`"
+        if "subprocess" in receivers and method in (
+                "run", "call", "check_call", "check_output", "Popen"):
+            return f"subprocess call `{'.'.join(chain)}`"
+        return None
+
+
+class TypedK8sErrors(Rule):
+    id = "typed-k8s-errors"
+    doc = ("k8s API failures are handled through the typed k8s/errors.py "
+           "hierarchy — no broad `except Exception` around API calls "
+           "without typed triage, no status-code matching on exceptions")
+    hint = ("catch ApiError subclasses, or keep the broad handler but "
+            "triage with is_outage()/is_retriable()/classify_exception() "
+            "(k8s/errors.py) before deciding")
+
+    #: files that ARE the raw mapping layer (they turn HTTP statuses
+    #: into the hierarchy, so they legitimately touch integers).
+    EXEMPT = frozenset({"gpumounter_tpu/k8s/errors.py",
+                        "gpumounter_tpu/k8s/client.py"})
+
+    EXC_NAMES = frozenset({"exc", "e", "err", "error", "cause"})
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            if module.rel in self.EXEMPT:
+                continue
+            if not module.imports_package("gpumounter_tpu.k8s"):
+                continue
+            findings.extend(self._check_handlers(module))
+            findings.extend(self._check_status_compares(module))
+        return findings
+
+    def _check_handlers(self, module: Module) -> list[Finding]:
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not self._try_does_kube_io(node):
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler):
+                    continue
+                if self._handler_triages(handler):
+                    continue
+                if module.waived(self.id, handler.lineno, node.lineno):
+                    continue
+                findings.append(module.finding(
+                    self.id, handler,
+                    "broad `except Exception` around a k8s API call "
+                    "without typed triage", self.hint))
+        return findings
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        names = []
+        if isinstance(handler.type, ast.Tuple):
+            names = [t.id for t in handler.type.elts
+                     if isinstance(t, ast.Name)]
+        elif isinstance(handler.type, ast.Name):
+            names = [handler.type.id]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _try_does_kube_io(node: ast.Try) -> bool:
+        for child in _walk_skipping_defs(node.body):
+            if isinstance(child, ast.Call) and isinstance(
+                    child.func, ast.Attribute):
+                chain = _attr_chain(child.func)
+                if chain[-1] in KUBE_METHODS \
+                        or set(chain[:-1]) & KUBE_RECEIVERS:
+                    return True
+        return False
+
+    @classmethod
+    def _handler_triages(cls, handler: ast.ExceptHandler) -> bool:
+        for child in _walk_skipping_defs(handler.body):
+            if isinstance(child, ast.Call):
+                if isinstance(child.func, ast.Name) \
+                        and child.func.id in TRIAGE_CALLS:
+                    return True
+                if isinstance(child.func, ast.Attribute) \
+                        and child.func.attr in TRIAGE_CALLS:
+                    return True
+                if isinstance(child.func, ast.Name) \
+                        and child.func.id == "isinstance":
+                    names = {n.id for n in ast.walk(child.args[1])
+                             if isinstance(n, ast.Name)} \
+                        if len(child.args) == 2 else set()
+                    if names & TYPED_ERROR_NAMES:
+                        return True
+        return False
+
+    def _check_status_compares(self, module: Module) -> list[Finding]:
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            left = node.left
+            if not (isinstance(left, ast.Attribute)
+                    and left.attr == "status"
+                    and isinstance(left.value, ast.Name)
+                    and left.value.id in self.EXC_NAMES):
+                continue
+            if not any(isinstance(c, ast.Constant)
+                       and isinstance(c.value, int)
+                       for c in node.comparators):
+                continue
+            if module.waived(self.id, node.lineno):
+                continue
+            findings.append(module.finding(
+                self.id, node,
+                "status-code matching on an exception (`"
+                f"{left.value.id}.status` vs an integer) — use the typed "
+                "k8s/errors.py hierarchy",
+                "replace with isinstance(exc, ConflictError/ServerError/"
+                "...) or is_retriable()/is_outage()"))
+        return findings
+
+
+class EnvThroughConfig(Rule):
+    id = "env-through-config"
+    doc = ("Every os.environ/os.getenv READ outside config/config.py is "
+           "a violation — runtime knobs flow through the Config seam")
+    hint = ("add a Config field (config/config.py) and read cfg.<field>; "
+            "env writes for child processes are allowed")
+
+    EXEMPT = frozenset({"gpumounter_tpu/config/config.py"})
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            if module.rel in self.EXEMPT:
+                continue
+            for node in ast.walk(module.tree):
+                read = self._env_read(node)
+                if read is None:
+                    continue
+                if module.waived(self.id, node.lineno):
+                    continue
+                findings.append(module.finding(
+                    self.id, node, f"environment read `{read}` outside "
+                    "config/config.py", self.hint))
+        return findings
+
+    @staticmethod
+    def _env_read(node: ast.AST) -> str | None:
+        # os.getenv(...)
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            chain = _attr_chain(node.func)
+            if chain == ["os", "getenv"]:
+                return "os.getenv(...)"
+            # os.environ.get(...)
+            if chain == ["os", "environ", "get"]:
+                return "os.environ.get(...)"
+        # os.environ[...] in Load context
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load):
+            chain = _attr_chain(node.value)
+            if chain == ["os", "environ"]:
+                return "os.environ[...]"
+        return None
+
+
+class MetricsDiscipline(Rule):
+    id = "metrics-discipline"
+    doc = ("Metric names carry the tpumounter_ prefix, counters end in "
+           "_total, histograms in a unit suffix, and label keys come "
+           "from utils/metrics.py ALLOWED_LABEL_KEYS")
+    hint = ("rename the series, or — for a genuinely new label key — add "
+            "it to ALLOWED_LABEL_KEYS with a cardinality justification "
+            "(test_metrics_cardinality.py budgets the series count)")
+
+    METRICS_MODULE = "gpumounter_tpu/utils/metrics.py"
+    UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio")
+    #: instrument-method kwargs that are parameters, not labels.
+    NON_LABEL_KWARGS = frozenset({"amount", "value", "trace_id"})
+    MUTATORS = frozenset({"inc", "dec", "set", "observe"})
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        allowed = self._allowed_label_keys(index)
+        if allowed is None:
+            findings.append(Finding(
+                self.id, self.METRICS_MODULE, 1,
+                "ALLOWED_LABEL_KEYS frozenset is missing from "
+                "utils/metrics.py — the bounded label-key set must be "
+                "declared", self.hint))
+            allowed = frozenset()
+        for module in index.modules.values():
+            instruments = self._module_instruments(module)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call) or not isinstance(
+                        node.func, ast.Attribute):
+                    continue
+                kind = node.func.attr
+                if kind in ("counter", "gauge", "histogram"):
+                    findings.extend(self._check_registration(
+                        module, node, kind))
+                elif kind in self.MUTATORS and node.keywords:
+                    findings.extend(self._check_labels(
+                        module, node, instruments, allowed))
+        return findings
+
+    def _allowed_label_keys(self, index: ProjectIndex) -> frozenset | None:
+        metrics = index.module(self.METRICS_MODULE)
+        if metrics is None:
+            return None
+        for node in metrics.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and t.id == "ALLOWED_LABEL_KEYS"
+                    for t in node.targets):
+                keys = {n.value for n in ast.walk(node.value)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)}
+                return frozenset(keys)
+        return None
+
+    def _check_registration(self, module: Module, node: ast.Call,
+                            kind: str) -> list[Finding]:
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return []
+        name = node.args[0].value
+        problems = []
+        if not name.startswith("tpumounter_"):
+            problems.append(f"{kind} `{name}` missing the tpumounter_ "
+                            "prefix")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(f"counter `{name}` must end in _total")
+        if kind != "counter" and name.endswith("_total"):
+            problems.append(f"{kind} `{name}` must not end in _total "
+                            "(that suffix is the counter contract)")
+        if kind == "histogram" and not name.endswith(self.UNIT_SUFFIXES):
+            problems.append(f"histogram `{name}` needs a unit suffix "
+                            f"({'/'.join(self.UNIT_SUFFIXES)})")
+        return [module.finding(self.id, node, p, self.hint)
+                for p in problems
+                if not module.waived(self.id, node.lineno)]
+
+    @staticmethod
+    def _module_instruments(module: Module) -> set[str]:
+        """Module-level `NAME = <registry>.counter/gauge/histogram(...)`
+        bindings — the receivers whose mutator labels we police."""
+        names: set[str] = set()
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in ("counter", "gauge",
+                                                 "histogram"):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        names.add(target.id)
+        return names
+
+    def _check_labels(self, module: Module, node: ast.Call,
+                      instruments: set[str],
+                      allowed: frozenset) -> list[Finding]:
+        receiver = node.func.value
+        if not (isinstance(receiver, ast.Name)
+                and (receiver.id in instruments or receiver.id.isupper())):
+            return []
+        findings = []
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in self.NON_LABEL_KWARGS:
+                continue
+            if kw.arg in allowed:
+                continue
+            if module.waived(self.id, node.lineno):
+                continue
+            findings.append(module.finding(
+                self.id, node,
+                f"label key `{kw.arg}` on {receiver.id}.{node.func.attr} "
+                "is not in the declared bounded set "
+                "(utils/metrics.py ALLOWED_LABEL_KEYS)", self.hint))
+        return findings
+
+
+class AuditedMutations(Rule):
+    id = "audited-mutations"
+    doc = ("Every mutating HTTP route (POST/PUT/DELETE/PATCH in _ROUTES) "
+           "must be in AUDITED_ROUTES (terminal audit record) and must "
+           "not be in UNTRACED_ROUTES (span contract)")
+    hint = ("add the route name to AUDITED_ROUTES (master/app.py) so the "
+            "edge writes its audit record, and keep it traced")
+
+    MUTATING = frozenset({"POST", "PUT", "DELETE", "PATCH"})
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            routes = self._routes(module)
+            if routes is None:
+                continue
+            route_node, entries = routes
+            audited = self._frozenset_attr(module, "AUDITED_ROUTES")
+            untraced = self._frozenset_attr(module, "UNTRACED_ROUTES") \
+                or set()
+            if audited is None:
+                findings.append(module.finding(
+                    self.id, route_node,
+                    "_ROUTES is defined but no AUDITED_ROUTES frozenset "
+                    "declares which mutations are audited", self.hint))
+                continue
+            for lineno, method, name in entries:
+                if method not in self.MUTATING:
+                    continue
+                if module.waived(self.id, lineno):
+                    continue
+                if name not in audited:
+                    findings.append(Finding(
+                        self.id, module.rel, lineno,
+                        f"mutating route `{name}` ({method}) is not in "
+                        "AUDITED_ROUTES — its outcome never reaches the "
+                        "audit trail", self.hint))
+                if name in untraced:
+                    findings.append(Finding(
+                        self.id, module.rel, lineno,
+                        f"mutating route `{name}` ({method}) is in "
+                        "UNTRACED_ROUTES — mutations must open a span",
+                        self.hint))
+        return findings
+
+    @staticmethod
+    def _routes(module: Module):
+        """Module-level `_ROUTES = [(method, pattern, name), ...]`."""
+        for node in module.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if not any(isinstance(t, ast.Name) and t.id == "_ROUTES"
+                           for t in targets):
+                    continue
+                value = node.value
+                if not isinstance(value, ast.List):
+                    return None
+                entries = []
+                for elt in value.elts:
+                    if not isinstance(elt, ast.Tuple) or len(elt.elts) < 3:
+                        continue
+                    method = elt.elts[0]
+                    name = elt.elts[-1]
+                    if isinstance(method, ast.Constant) and isinstance(
+                            name, ast.Constant):
+                        entries.append((elt.lineno, method.value,
+                                        name.value))
+                return node, entries
+        return None
+
+    @staticmethod
+    def _frozenset_attr(module: Module, attr: str) -> set | None:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == attr
+                    for t in node.targets):
+                return {c.value for c in ast.walk(node.value)
+                        if isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)}
+        return None
+
+
+class FailpointRegistry(Rule):
+    id = "failpoint-registry"
+    doc = ("Every failpoint site name is declared exactly once in "
+           "faults/registry.py and reachable from chaos scenarios")
+    hint = ("declare the site in gpumounter_tpu/faults/registry.py "
+            "(FAILPOINTS / DYNAMIC_PREFIXES) and arm it from a chaos "
+            "scenario or test so the injection point stays exercised")
+
+    REGISTRY_MODULE = "gpumounter_tpu/faults/registry.py"
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        registry = index.module(self.REGISTRY_MODULE)
+        if registry is None:
+            return [Finding(
+                self.id, self.REGISTRY_MODULE, 1,
+                "faults/registry.py is missing — failpoint sites have no "
+                "declaration to check against", self.hint)]
+        declared, prefixes = self._declarations(registry)
+        sites = self._sites(index)
+        used_names: set[str] = set()
+        for module, node, name, dynamic in sites:
+            if dynamic:
+                if not any(name.startswith(p) for p in prefixes):
+                    if not module.waived(self.id, node.lineno):
+                        findings.append(module.finding(
+                            self.id, node,
+                            f"dynamic failpoint site `{name}{{...}}` has "
+                            "no covering DYNAMIC_PREFIXES entry",
+                            self.hint))
+                continue
+            if any(name.startswith(p) for p in prefixes):
+                used_names.add(name)
+                continue
+            if name not in declared:
+                if not module.waived(self.id, node.lineno):
+                    findings.append(module.finding(
+                        self.id, node,
+                        f"failpoint site `{name}` is not declared in "
+                        "faults/registry.py", self.hint))
+            else:
+                used_names.add(name)
+        # Declared but siteless: dead declarations rot.
+        for name, lineno in declared.items():
+            if name not in used_names:
+                findings.append(Finding(
+                    self.id, registry.rel, lineno,
+                    f"declared failpoint `{name}` has no fire()/value() "
+                    "site in the tree", self.hint))
+        # Reachability: each declared name (or covering prefix) must be
+        # referenced from the chaos harness or a test.
+        test_blob = "\n".join(index.test_sources.values())
+        for name, lineno in declared.items():
+            if name in used_names and name not in test_blob:
+                findings.append(Finding(
+                    self.id, registry.rel, lineno,
+                    f"declared failpoint `{name}` is never armed from "
+                    "testing/ or tests/ — chaos scenarios cannot reach "
+                    "it", self.hint))
+        return findings
+
+    @staticmethod
+    def _declarations(registry: Module):
+        declared: dict[str, int] = {}
+        prefixes: set[str] = set()
+        duplicate_findings: list[str] = []
+        for node in registry.tree.body:
+            if isinstance(node, ast.Assign):
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name) and node.value is not None:
+                names = [node.target.id]
+                value = node.value
+            else:
+                continue
+            if "FAILPOINTS" in names and isinstance(value, ast.Dict):
+                for key in value.keys:
+                    if isinstance(key, ast.Constant):
+                        declared[key.value] = key.lineno
+            if "DYNAMIC_PREFIXES" in names:
+                prefixes = {c.value for c in ast.walk(value)
+                            if isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)}
+        return declared, prefixes
+
+    @staticmethod
+    def _sites(index: ProjectIndex):
+        """(module, node, name, is_dynamic) for every fire/value call."""
+        sites = []
+        for module in index.modules.values():
+            if module.rel == FailpointRegistry.REGISTRY_MODULE \
+                    or module.rel.endswith("faults/failpoints.py"):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                is_fp = (isinstance(func, ast.Attribute)
+                         and func.attr in ("fire", "value")
+                         and isinstance(func.value, ast.Name)
+                         and func.value.id == "failpoints")
+                if not is_fp:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str):
+                    sites.append((module, node, arg.value, False))
+                elif isinstance(arg, ast.JoinedStr):
+                    prefix = ""
+                    for value in arg.values:
+                        if isinstance(value, ast.Constant):
+                            prefix += str(value.value)
+                        else:
+                            break
+                    sites.append((module, node, prefix, True))
+        return sites
+
+
+class FsyncBeforeDone(Rule):
+    id = "fsync-before-done"
+    doc = ("In durability modules (any module that fsyncs), every raw "
+           "write path must fsync in the same function or delegate to "
+           "one that does — a done record must never land before its "
+           "bytes")
+    hint = ("route the append through the module's fsync'ing _append "
+            "helper, or add os.fsync(fd) before returning")
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            if "fsync" not in module.source:
+                continue
+            findings.extend(self._check_module(module))
+        return findings
+
+    def _check_module(self, module: Module) -> list[Finding]:
+        findings = []
+        for cls in [n for n in ast.walk(module.tree)
+                    if isinstance(n, ast.ClassDef)] + [None]:
+            body = cls.body if cls is not None else module.tree.body
+            methods = {n.name: n for n in body
+                       if isinstance(n, ast.FunctionDef)}
+            syncing = {name for name, fn in methods.items()
+                       if self._calls_fsync(fn)}
+            # one-hop delegation: calling a syncing sibling counts
+            for name, fn in methods.items():
+                if name in syncing:
+                    continue
+                if self._calls_sibling(fn, syncing):
+                    syncing.add(name)
+            for name, fn in methods.items():
+                if name in syncing:
+                    continue
+                for node in _walk_skipping_defs(fn.body):
+                    if not self._is_raw_write(node):
+                        continue
+                    if self._calls_sibling(fn, syncing):
+                        continue
+                    if module.waived(self.id, node.lineno, fn.lineno):
+                        continue
+                    findings.append(module.finding(
+                        self.id, node,
+                        f"raw write in `{name}` of a durability module "
+                        "with no fsync on the path", self.hint))
+        return findings
+
+    @staticmethod
+    def _calls_fsync(fn: ast.FunctionDef) -> bool:
+        for node in _walk_skipping_defs(fn.body):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr in ("fsync", "fdatasync"):
+                return True
+        return False
+
+    @staticmethod
+    def _calls_sibling(fn: ast.FunctionDef, siblings: set[str]) -> bool:
+        for node in _walk_skipping_defs(fn.body):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr in siblings \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                return True
+        return False
+
+    @staticmethod
+    def _is_raw_write(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute):
+            return False
+        chain = _attr_chain(node.func)
+        return chain in (["os", "write"],) \
+            or (node.func.attr == "write" and len(chain) == 2
+                and chain[0] in ("f", "fh", "fp", "file", "out"))
+
+
+class NamedLocks(Rule):
+    id = "named-locks"
+    doc = ("New locks use utils/locks.py OrderedLock/OrderedCondition "
+           "(named) so the runtime lock-order validator covers them")
+    hint = ("replace threading.Lock()/RLock()/Condition() with "
+            "OrderedLock(\"<area>.<role>\") / OrderedCondition(...) from "
+            "gpumounter_tpu.utils.locks")
+
+    EXEMPT = frozenset({"gpumounter_tpu/utils/locks.py"})
+    FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                           "BoundedSemaphore"})
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            if module.rel in self.EXEMPT:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = None
+                if isinstance(func, ast.Attribute) and isinstance(
+                        func.value, ast.Name) \
+                        and func.value.id == "threading" \
+                        and func.attr in self.FACTORIES:
+                    name = f"threading.{func.attr}"
+                if name is None:
+                    continue
+                if module.waived(self.id, node.lineno):
+                    continue
+                findings.append(module.finding(
+                    self.id, node, f"unnamed `{name}()` — the lock-order "
+                    "validator cannot see this lock", self.hint))
+        return findings
+
+
+class WaiverHygiene(Rule):
+    id = "waiver-needs-reason"
+    doc = "Every tpulint waiver carries a reason"
+    hint = "append the why: `# tpulint: allow[rule] <reason>`"
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings = []
+        for module in index.modules.values():
+            for lineno in module.reasonless_waivers():
+                findings.append(Finding(
+                    self.id, module.rel, lineno,
+                    "waiver without a reason", self.hint))
+        return findings
+
+
+RULES: list[Rule] = [
+    NoBlockingUnderLock(),
+    TypedK8sErrors(),
+    EnvThroughConfig(),
+    MetricsDiscipline(),
+    AuditedMutations(),
+    FailpointRegistry(),
+    FsyncBeforeDone(),
+    NamedLocks(),
+    WaiverHygiene(),
+]
