@@ -116,10 +116,14 @@ class Config:
     # "" = worker_namespace.
     shard_lease_namespace: str = field(default_factory=lambda: _env(
         "SHARD_LEASE_NAMESPACE", ""))
-    # This replica's identity in lease holder records; "" = hostname
-    # (the pod name in a StatefulSet — stable across restarts).
+    # This replica's identity in lease holder records; the default
+    # falls back to $HOSTNAME (the pod name in a StatefulSet — stable
+    # across restarts), "" = let the caller use socket.gethostname().
+    # The HOSTNAME read lives HERE, not in master/shard.py: every
+    # environment read flows through this module (tpulint
+    # env-through-config).
     replica_id: str = field(default_factory=lambda: _env(
-        "TPUMOUNTER_REPLICA_ID", ""))
+        "TPUMOUNTER_REPLICA_ID", "") or _env("HOSTNAME", ""))
     # URL peers/clients can reach THIS replica at; stamped into lease
     # holder records so a non-owner replica can 307-redirect or proxy
     # to the owner. "" = redirects degrade to 503 (clients retry).

@@ -449,7 +449,8 @@ class ElasticReconciler:
             # A degraded worker (circuit open, retry_after_s set) is also
             # 503 but is NOT capacity exhaustion — back off, don't start
             # shrinking toward the min_chips floor.
-            if exc.status != 503 or exc.retry_after_s is not None:
+            if exc.status != 503 or exc.retry_after_s is not None:  # tpulint: allow[typed-k8s-errors] SliceError.status is the master's own
+                # HTTP status, not a k8s API code
                 raise ReconcileError(f"mount of {gap} chip(s) failed: {exc}")
         # Capacity exhausted. Already at or above the declared floor:
         # that is the documented "degraded, not failed" state — keep

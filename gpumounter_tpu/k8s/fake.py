@@ -24,6 +24,7 @@ from gpumounter_tpu.k8s.client import (
     inject_write_fault,
 )
 from gpumounter_tpu.k8s.types import Pod, match_label_selector
+from gpumounter_tpu.utils.locks import OrderedCondition
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("k8s.fake")
@@ -83,7 +84,7 @@ class FakeKubeClient(KubeClient):
         self._partition_mode = "full"
         self._leases: dict[tuple[str, str], dict] = {}
         self._lease_rv = itertools.count(1)
-        self._lock = threading.Condition()
+        self._lock = OrderedCondition("k8s.fake.state")
         self._events: list[tuple[int, str, dict]] = []  # (seq, type, pod)
         self._seq = itertools.count(1)
         self.scheduler_hook = scheduler_hook
@@ -97,7 +98,7 @@ class FakeKubeClient(KubeClient):
         # retires when idle). The previous shape spawned a daemon thread
         # per pod — a 64-pod warm-pool refill meant 64 threads churning
         # in every test process.
-        self._sched_cv = threading.Condition()
+        self._sched_cv = OrderedCondition("k8s.fake.sched")
         self._sched_q: list[tuple[float, int, str, str]] = []
         self._sched_seq = itertools.count(1)
         self._sched_thread: threading.Thread | None = None

@@ -42,7 +42,6 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-import os
 import socket
 import threading
 import time
@@ -55,6 +54,7 @@ from gpumounter_tpu.k8s.client import (
     KubeClient,
     NotFoundError,
 )
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -136,7 +136,6 @@ class ShardManager:
                             else self.cfg.shard_count)
         self.ring = HashRing(self.shard_count)
         self.replica_id = (replica_id or self.cfg.replica_id
-                           or os.environ.get("HOSTNAME")
                            or socket.gethostname())
         self.advertise_url = (advertise_url
                               if advertise_url is not None
@@ -468,4 +467,5 @@ class ShardManager:
                 self.kube.update_lease(self.lease_namespace, name, lease)
             except Exception as exc:  # noqa: BLE001 — TTL covers us
                 logger.warning("shard %d release failed (%s); peers "
-                               "take over at lease expiry", shard, exc)
+                               "take over at lease expiry", shard,
+                               classify_exception(exc))

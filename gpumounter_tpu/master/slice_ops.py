@@ -24,6 +24,7 @@ from dataclasses import dataclass
 
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.rpc import api
@@ -207,7 +208,8 @@ class BulkMountCoordinator:
                              "error": f"no pod {t.namespace}/{t.pod}"}
                 continue
             except Exception as exc:  # noqa: BLE001 — API blip
-                errors[i] = {"result": "Error", "error": str(exc)}
+                errors[i] = {"result": "Error",
+                             "error": str(classify_exception(exc))}
                 continue
             if not pod.node_name:
                 errors[i] = {"result": "NotScheduled",
@@ -447,7 +449,10 @@ class SliceCoordinator:
                 t = resolved[i][0]
                 try:
                     pod = Pod(self.kube.get_pod(t.namespace, t.pod))
-                except Exception:  # noqa: BLE001 — pod may be gone
+                except Exception as exc:  # noqa: BLE001 — pod may be gone
+                    logger.debug("rollback event read of %s/%s failed: "
+                                 "%s", t.namespace, t.pod,
+                                 classify_exception(exc))
                     continue
                 post_pod_event(
                     self.kube, pod, "TPUSliceRollback",

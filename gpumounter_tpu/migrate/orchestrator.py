@@ -57,6 +57,7 @@ from gpumounter_tpu.migrate.journal import (
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
 from gpumounter_tpu.rpc import api
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -126,11 +127,11 @@ class MigrationCoordinator:
         #: resume_interrupted adopts only journals whose source pod lives
         #: on a node this replica owns — the owner re-drives the rest.
         self.shards = shards
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("migrate.journals")
         # Serializes begin(): the already-migrating check and the journal
         # persist must be atomic, or two concurrent /migrate requests for
         # one pod both pass validation and stomp each other's journal.
-        self._admission = threading.Lock()
+        self._admission = OrderedLock("migrate.admission")
         self._journals: dict[str, dict] = {}   # id -> last persisted copy
         self._threads: dict[str, threading.Thread] = {}
         self._aborts: set[str] = set()
@@ -160,7 +161,8 @@ class MigrationCoordinator:
             raise MigrationRejected(
                 f"pod {source_ns}/{source_pod} holds no tpumounter-"
                 f"managed chips; nothing to migrate", 400)
-        with self._admission:
+        with self._admission:  # tpulint: allow[no-blocking-under-lock] admission mutex exists to
+            # serialize exactly this read-check-claim I/O sequence
             # Atomic admit: re-read both pods, check neither is taken,
             # and persist the journal AND the destination lock before
             # releasing — a concurrent begin() for either pod then sees

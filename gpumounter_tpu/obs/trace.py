@@ -35,11 +35,11 @@ import contextvars
 import json
 import re
 import secrets
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("obs.trace")
@@ -118,7 +118,7 @@ class RingBufferExporter:
 
     def __init__(self, capacity: int = 2048):
         self._spans: deque[dict] = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("trace.ring")
 
     def export(self, span: dict) -> None:
         with self._lock:
@@ -149,7 +149,7 @@ class JsonlExporter:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("trace.jsonl")
         self._broken = False
 
     def export(self, span: dict) -> None:
@@ -172,7 +172,7 @@ class Tracer:
     def __init__(self, ring_capacity: int = 2048):
         self.ring = RingBufferExporter(ring_capacity)
         self._exporters: list = [self.ring]
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("trace.tracer")
         self._open: dict[str, str] = {}  # span_id -> name
 
     def add_exporter(self, exporter) -> None:

@@ -53,6 +53,7 @@ import time
 
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.k8s.client import NotFoundError
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
@@ -415,7 +416,7 @@ class RecoveryController:
                 pass
             except Exception as exc:  # noqa: BLE001 — keep releasing
                 logger.warning("evacuation delete of %s failed: %s",
-                               name, exc)
+                               name, classify_exception(exc))
         return released
 
     def _redrive_intents(self, node: str) -> list[tuple[str, str]]:
@@ -434,7 +435,12 @@ class RecoveryController:
         for namespace, pod_name, intent in intents:
             try:
                 pod = Pod(self.kube.get_pod(namespace, pod_name))
-            except Exception:  # noqa: BLE001 — gone or unreadable: skip
+            except Exception as exc:  # noqa: BLE001 — gone or
+                # unreadable: skip this intent, the next recovery pass
+                # (or the reconciler) picks it up once readable again
+                logger.debug("evacuation intent read of %s/%s failed: "
+                             "%s", namespace, pod_name,
+                             classify_exception(exc))
                 continue
             if pod.node_name != node:
                 continue
@@ -480,7 +486,8 @@ class RecoveryController:
                     ANNOT_DISRUPTION: json.dumps(marker)}}})
         except Exception as exc:  # noqa: BLE001 — marker is advisory
             logger.warning("disruption marker stamp on %s/%s failed: %s",
-                           pod.namespace, pod.name, exc)
+                           pod.namespace, pod.name,
+                           classify_exception(exc))
 
     def _redrive_migrations(self) -> list[str]:
         if self.migrations is None:

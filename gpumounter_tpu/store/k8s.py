@@ -14,6 +14,7 @@ from collections.abc import Iterator
 
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.k8s.client import KubeClient, patch_pod_with_retry
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.store.base import MasterStore
 from gpumounter_tpu.utils.log import get_logger
@@ -117,7 +118,8 @@ class KubeMasterStore(MasterStore):
         except NotImplementedError:
             return None
         except Exception as exc:  # noqa: BLE001 — readiness is advisory
-            logger.warning("node read %s failed: %s", node_name, exc)
+            logger.warning("node read %s failed: %s", node_name,
+                           classify_exception(exc))
             return None
 
     def list_pool_pods(self, node_name: str) -> list[dict]:

@@ -63,7 +63,14 @@ invariants after convergence:
      every deferred annotation write lands exactly once — newest value
      wins, CAS losers dropped — and books == mounts == ledger ==
      intents; the negative control (replay disabled) must be DETECTED
-     as divergence.
+     as divergence,
+ 15. lock-order consistency (utils/locks.py): every nested lock
+     acquisition observed at runtime across the instrumented modules
+     (metrics instruments, the fake apiserver, the migration machine,
+     the tracer, the worker ledger) forms an acyclic order — and, via
+     the TPM_LOCK_TRACE export cross-checked by `python -m
+     tools.tpulint --verify-dynamic`, never contradicts the static
+     nesting graph tpulint extracted from the source.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -90,6 +97,7 @@ from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
 from gpumounter_tpu.rpc.client import ChannelPool, WorkerClient
 from gpumounter_tpu.testing.cluster import FakeCluster
+from gpumounter_tpu.utils import locks
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
 from gpumounter_tpu.worker.server import TpuMountService, build_server
@@ -209,6 +217,7 @@ FAULTS_COMMON = [
     ("worker.mount.mknod", "1*pass->1*error(chaos mknod 2nd)"),
     ("worker.mount.before_grant", "1*crash(chaos)"),
     ("worker.mount.after_grant", "1*crash(chaos)"),
+    ("worker.unmount.before_revoke", "1*error(chaos revoke)"),
     ("k8s.patch_pod.status", "1*return(409)"),
     ("k8s.patch_pod.status", "1*return(500)"),
 ]
@@ -1363,6 +1372,24 @@ class ChaosHarness:
             violations.append(
                 f"channel leak: {stats['live']} live channel(s) for "
                 f"{len(self._port_by_ip)} worker(s)")
+
+        # 15. lock-order consistency: every nested OrderedLock
+        # acquisition the whole run observed (instrumented modules:
+        # metrics, fake apiserver, migration machine, tracer, worker
+        # ledger) must form an acyclic order. The static half of the
+        # check lives in tools/tpulint (lockorder.py); TPM_LOCK_TRACE
+        # exports what we validated so the static-analysis lane can
+        # cross-check runtime reality against the reviewed graph
+        # (python -m tools.tpulint --verify-dynamic <file>).
+        try:
+            locks.RECORDER.assert_consistent()
+        except locks.LockOrderViolation as exc:
+            violations.append(f"lock-order: {exc}")
+        trace_path = os.environ.get("TPM_LOCK_TRACE", "")  # tpulint: allow[env-through-config] CI-artifact path for the test harness, not a daemon runtime knob
+        if trace_path:
+            import json as _json
+            with open(trace_path, "w", encoding="utf-8") as f:
+                _json.dump(locks.RECORDER.dump(), f, indent=1)
 
         if violations:
             tail = "\n  ".join(self.schedule[-25:])

@@ -17,10 +17,10 @@ thread-pool of concurrent observers racing a renderer.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 
+from gpumounter_tpu.utils.locks import OrderedLock
 
 #: The bounded label-key vocabulary. Every label key used on any
 #: instrument must come from this set (tpulint rule metrics-discipline)
@@ -65,7 +65,8 @@ class Counter:
     name: str
     help: str
     _values: dict[tuple, float] = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: OrderedLock = field(
+        default_factory=lambda: OrderedLock("metrics.counter"))
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
@@ -112,7 +113,8 @@ class Gauge:
     name: str
     help: str
     _values: dict[tuple, float] = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: OrderedLock = field(
+        default_factory=lambda: OrderedLock("metrics.gauge"))
 
     def set(self, value: float, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
@@ -182,7 +184,8 @@ class Histogram:
     #: labels-tuple -> [cumulative counts (+Inf last), sum,
     #:                  {bucket index -> (trace_id, value, unix ts)}]
     _counts: dict[tuple, list] = field(default_factory=dict)
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: OrderedLock = field(
+        default_factory=lambda: OrderedLock("metrics.histogram"))
 
     def observe(self, value: float, trace_id: str = "",
                 **labels: str) -> None:
@@ -262,7 +265,7 @@ class Histogram:
 class Registry:
     def __init__(self) -> None:
         self._metrics: list = []
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.registry")
 
     def counter(self, name: str, help: str) -> Counter:
         c = Counter(name, help)

@@ -49,9 +49,9 @@ from __future__ import annotations
 import json
 import os
 import secrets
-import threading
 import time
 
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -89,7 +89,7 @@ class MountLedger:
         self.path = os.path.join(directory, LEDGER_FILE)
         self.max_bytes = max(4096, int(max_bytes))
         self.fsync = fsync
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("worker.ledger")
         self._open_txns: dict[str, dict] = {}
         #: rel id -> release record: slave-pod deletes deferred after an
         #: API outage broke the unmount's release step.

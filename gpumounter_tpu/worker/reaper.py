@@ -21,6 +21,7 @@ import threading
 
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.utils.log import get_logger
 
@@ -54,7 +55,8 @@ class SlaveReaper:
             slaves = self.kube.list_pods(self.cfg.pool_namespace,
                                          label_selector="app=tpu-pool")
         except Exception as exc:  # noqa: BLE001 — keep the loop alive
-            logger.warning("reaper list failed: %s", exc)
+            logger.warning("reaper list failed: %s",
+                           classify_exception(exc))
             return deleted
         for slave_json in slaves:
             slave = Pod(slave_json)
@@ -78,7 +80,7 @@ class SlaveReaper:
                 orphaned = True
             except Exception as exc:  # noqa: BLE001
                 logger.warning("reaper owner check %s/%s failed: %s",
-                               owner_ns, owner, exc)
+                               owner_ns, owner, classify_exception(exc))
                 continue
             if orphaned:
                 logger.info("reaping orphan slave pod %s (owner %s/%s gone)",
@@ -89,7 +91,7 @@ class SlaveReaper:
                     deleted.append(slave.name)
                 except Exception as exc:  # noqa: BLE001
                     logger.warning("reap delete %s failed: %s",
-                                   slave.name, exc)
+                                   slave.name, classify_exception(exc))
         return deleted
 
     def _loop(self) -> None:

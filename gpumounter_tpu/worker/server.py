@@ -32,6 +32,7 @@ from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.backend import backend_from_config
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import audited
 from gpumounter_tpu.k8s.types import Pod
@@ -699,7 +700,9 @@ class TpuMountService:
             try:
                 self.allocator.delete_slave_pods(releasable)
                 return
-            except Exception as exc:  # noqa: BLE001 — release boundary:
+            except Exception as exc:  # tpulint: allow[typed-k8s-errors] mixed-cause: SlavePodError is not an
+                # ApiError and both must defer (noqa: BLE001 — release
+                # boundary:)
                 # SlavePodError (deletion timed out) and raw transport/
                 # PartitionError (API outage mid-delete) both mean "the
                 # booking is still held" — and must end in the deferral
@@ -719,7 +722,13 @@ class TpuMountService:
                 leaked.append(name)
             except NotFoundError:
                 pass  # released after all (delete landed, wait timed out)
-            except Exception:  # noqa: BLE001 — unknown: assume leaked
+            except Exception as exc:  # noqa: BLE001 — unknown: assume
+                # leaked. Typed triage for the record: an outage-shaped
+                # failure means we could not VERIFY the release — the
+                # deferral path below retries it either way.
+                logger.debug("leak probe of %s inconclusive (%s); "
+                             "assuming leaked", name,
+                             classify_exception(exc))
                 leaked.append(name)
         if not leaked:
             return
@@ -775,7 +784,7 @@ class TpuMountService:
                 except Exception as exc:  # noqa: BLE001 — still down
                     remaining.append(name)
                     logger.info("deferred release of %s still failing: "
-                                "%s", name, exc)
+                                "%s", name, classify_exception(exc))
             if not remaining:
                 self.ledger.complete_release(record.get("rel", ""))
                 completed += 1

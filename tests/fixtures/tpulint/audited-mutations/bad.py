@@ -1,0 +1,13 @@
+"""Fixture: a mutating route missing from AUDITED_ROUTES."""
+import re
+
+_ROUTES = [
+    ("GET", re.compile(r"^/things$"), "things_list"),
+    ("POST", re.compile(r"^/things$"), "thing_create"),
+    ("DELETE", re.compile(r"^/things/x$"), "thing_delete"),
+]
+
+
+class App:
+    AUDITED_ROUTES = frozenset({"thing_create"})
+    UNTRACED_ROUTES = frozenset({"things_list", "thing_delete"})

@@ -1,0 +1,6 @@
+"""Fixture: runtime knobs read straight from the environment."""
+import os
+
+TIMEOUT = float(os.environ.get("TPM_TIMEOUT", "5"))
+DEBUG = os.getenv("TPM_DEBUG")
+HOME = os.environ["HOME"]

@@ -1,0 +1,13 @@
+"""Fixture: knobs come from Config; env WRITES (child process
+environment) are allowed."""
+import os
+
+from gpumounter_tpu.config import get_config
+
+
+def timeout() -> float:
+    return get_config().rpc_deadline_s
+
+
+def export_for_child(val: str) -> None:
+    os.environ["TPU_VISIBLE_CHIPS"] = val

@@ -1,0 +1,10 @@
+"""Fixture: an undeclared failpoint site and an uncovered f-string."""
+from gpumounter_tpu.faults import failpoints
+
+
+def mount() -> None:
+    failpoints.fire("fix.undeclared", pod="p")
+
+
+def op(verb: str) -> None:
+    failpoints.fire(f"fixdyn.{verb}")

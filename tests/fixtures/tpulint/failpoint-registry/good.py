@@ -1,0 +1,10 @@
+"""Fixture: declared site + prefix-covered dynamic site."""
+from gpumounter_tpu.faults import failpoints
+
+
+def mount() -> None:
+    failpoints.fire("fix.declared", pod="p")
+
+
+def op(verb: str) -> None:
+    failpoints.fire(f"k8s.{verb}")

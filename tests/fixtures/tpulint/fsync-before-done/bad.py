@@ -1,0 +1,16 @@
+"""Fixture: a durability module with a raw write path skipping fsync."""
+import json
+import os
+
+
+class Journal:
+    def __init__(self, fd: int):
+        self._fd = fd
+
+    def _append(self, record: dict) -> None:
+        os.write(self._fd, json.dumps(record).encode())
+        os.fsync(self._fd)
+
+    def quick_done(self, txn: str) -> None:
+        # BAD: done record written without fsync on the path
+        os.write(self._fd, json.dumps({"kind": "done", "txn": txn}).encode())

@@ -1,0 +1,15 @@
+"""Fixture: every write path delegates to the fsync'ing helper."""
+import json
+import os
+
+
+class Journal:
+    def __init__(self, fd: int):
+        self._fd = fd
+
+    def _append(self, record: dict) -> None:
+        os.write(self._fd, json.dumps(record).encode())
+        os.fsync(self._fd)
+
+    def done(self, txn: str) -> None:
+        self._append({"kind": "done", "txn": txn})

@@ -1,0 +1,18 @@
+"""Fixture: one global order, both paths agree."""
+from gpumounter_tpu.utils.locks import OrderedLock
+
+
+class Transfer:
+    def __init__(self):
+        self._books_lock = OrderedLock("fixture.books")
+        self._audit_lock = OrderedLock("fixture.audit")
+
+    def debit(self):
+        with self._books_lock:
+            with self._audit_lock:
+                pass
+
+    def report(self):
+        with self._books_lock:
+            with self._audit_lock:
+                pass

@@ -1,0 +1,13 @@
+"""Fixture: bad metric names and an undeclared label key."""
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+MOUNTS = REGISTRY.counter(
+    "tpumounter_mounts", "missing the _total suffix")
+DEPTH = REGISTRY.gauge(
+    "queue_depth", "missing the tpumounter_ prefix")
+LATENCY = REGISTRY.histogram(
+    "tpumounter_latency", "missing a unit suffix")
+
+
+def record(pod: str) -> None:
+    MOUNTS.inc(pod=pod)  # BAD: `pod` is not a declared label key

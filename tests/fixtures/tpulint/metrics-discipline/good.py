@@ -1,0 +1,14 @@
+"""Fixture: compliant names, declared label keys only."""
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+MOUNTS = REGISTRY.counter(
+    "tpumounter_fixture_mounts_total", "by result")
+DEPTH = REGISTRY.gauge(
+    "tpumounter_fixture_queue_depth", "current depth")
+LATENCY = REGISTRY.histogram(
+    "tpumounter_fixture_latency_seconds", "end to end")
+
+
+def record() -> None:
+    MOUNTS.inc(result="ok")
+    LATENCY.observe(0.2, trace_id="abc", phase="grant")

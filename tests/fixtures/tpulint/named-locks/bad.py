@@ -1,0 +1,9 @@
+"""Fixture: anonymous primitives the lock validator cannot see."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._mu = threading.RLock()

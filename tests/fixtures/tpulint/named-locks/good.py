@@ -1,0 +1,8 @@
+"""Fixture: named, order-recorded locks."""
+from gpumounter_tpu.utils.locks import OrderedCondition, OrderedLock
+
+
+class Store:
+    def __init__(self):
+        self._lock = OrderedLock("fixture.store")
+        self._cv = OrderedCondition("fixture.store.cv")

@@ -1,0 +1,24 @@
+"""Fixture: blocking I/O lexically inside held-lock regions."""
+import os
+import time
+import threading
+
+
+class Renewer:
+    def __init__(self, kube):
+        self.kube = kube
+        self._lock = threading.Lock()
+        self._leases = {}
+
+    def renew_all(self):
+        with self._lock:
+            for name, lease in self._leases.items():
+                self.kube.update_lease("ns", name, lease)  # BAD: API I/O
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.5)  # BAD: sleep under lock
+
+    def persist(self, fd):
+        with self._lock:
+            os.fsync(fd)  # BAD: fsync under lock (no waiver)

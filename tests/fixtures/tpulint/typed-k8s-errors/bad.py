@@ -1,0 +1,15 @@
+"""Fixture: broad handler + status matching around k8s API calls."""
+from gpumounter_tpu.k8s.client import KubeClient
+
+
+def read_node(kube: KubeClient, name: str):
+    try:
+        return kube.get_node(name)
+    except Exception as exc:  # BAD: no typed triage
+        return None
+
+
+def retry_patch(kube: KubeClient, exc: Exception) -> bool:
+    if exc.status == 409 or exc.status >= 500:  # BAD: status matching
+        return True
+    return False

@@ -1,0 +1,23 @@
+"""Fixture: typed handlers / broad-with-triage are both accepted."""
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.k8s.errors import ApiError, is_outage, is_retriable
+
+
+def read_node(kube: KubeClient, name: str):
+    try:
+        return kube.get_node(name)
+    except ApiError:
+        return None
+
+
+def read_node_boundary(kube: KubeClient, name: str):
+    try:
+        return kube.get_node(name)
+    except Exception as exc:  # noqa: BLE001 — outage boundary
+        if not is_outage(exc):
+            raise
+        return None
+
+
+def retry_patch(exc: Exception) -> bool:
+    return is_retriable(exc)

@@ -1,0 +1,4 @@
+"""Fixture: a waiver with no reason is itself a finding."""
+import os
+
+HOME = os.environ["HOME"]  # tpulint: allow[env-through-config]

@@ -1,0 +1,4 @@
+"""Fixture: waiver carries its why."""
+import os
+
+HOME = os.environ["HOME"]  # tpulint: allow[env-through-config] resolved before Config exists (process bootstrap)

@@ -93,6 +93,48 @@ def test_chaos_detects_disabled_rollback(tmp_path):
         assert "seed=1" in message
 
 
+def test_slice_admission_failpoint_fails_clean(tmp_path):
+    """master.slice.mount fires before anything is resolved or mounted:
+    an injected admission error fails the whole slice request with zero
+    side effects — the invariants hold with no cleanup at all."""
+    from gpumounter_tpu.master.slice_ops import SliceTarget
+    with ChaosHarness(str(tmp_path), seed=2) as h:
+        h.add_pod("adm", NODE_A)
+        with failpoints.armed(
+                {"master.slice.mount": "1*error(chaos admission)"}):
+            with pytest.raises(failpoints.FailpointError):
+                h._coordinator().mount_slice(
+                    [SliceTarget(namespace="default", pod="adm")], 1,
+                    entire=False)
+        h.check_invariants()
+
+
+def test_slice_rollback_skip_leaves_partial_slice(tmp_path):
+    """master.slice.rollback.skip is the documented invariant-breaker
+    switch at the SLICE level: with two hosts and the second mknod
+    failing, the all-or-nothing rollback is skipped and the surviving
+    host keeps its chip. That mount is still booked (books == mounts),
+    so it is a user-visible leak rather than an accounting one — which
+    is exactly why the switch exists only for harness controls."""
+    from gpumounter_tpu.master.slice_ops import SliceError, SliceTarget
+    from gpumounter_tpu.testing.chaos import NODE_B
+    with ChaosHarness(str(tmp_path), seed=3) as h:
+        h.add_pod("sl-a", NODE_A)
+        h.add_pod("sl-b", NODE_B)
+        with failpoints.armed({
+                "master.slice.rollback.skip": "return(true)",
+                "worker.mount.mknod": "1*pass->1*error(chaos mknod)"}):
+            with pytest.raises(SliceError):
+                h._coordinator().mount_slice(
+                    [SliceTarget(namespace="default", pod="sl-a"),
+                     SliceTarget(namespace="default", pod="sl-b")], 1,
+                    entire=False)
+        survivors = [key for key, chips in h.held_chips().items()
+                     if chips]
+        assert len(survivors) == 1, survivors
+        h.check_invariants()
+
+
 # --- invariant 9: single shard owner per node (ISSUE 7) ---
 
 

@@ -1,0 +1,270 @@
+"""tpulint test suite (ISSUE 12).
+
+Three layers:
+
+  * fixture tests — every rule has positive (bad.py, must fire) and
+    negative (good.py, must stay silent) snippets under
+    tests/fixtures/tpulint/<rule>/, run through the real CLI entry
+    point so "exits nonzero on a seeded violation of each rule" is
+    literally what is asserted;
+  * self-check — tpulint runs clean (modulo the committed baseline) on
+    the real tree, and the static lock graph of the migrated modules
+    is present and acyclic;
+  * runtime cross-check — the OrderedLock recorder's observed edges
+    from exercising the migrated modules are consistent with the
+    static graph (the chaos harness asserts the same as invariant 15).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tools.tpulint import lockorder, run
+from tools.tpulint import baseline as baseline_mod
+from tools.tpulint.__main__ import main as tpulint_main
+from tools.tpulint.index import ProjectIndex
+from tools.tpulint.rules import RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "tpulint")
+
+RULE_IDS = [rule.id for rule in RULES] + [lockorder.RULE_ID]
+
+#: minimal support tree the fixtures lean on: the declared label-key
+#: set, a fixture failpoint registry, and a test that "arms" the
+#: declared fixture failpoint (reachability).
+SUPPORT = {
+    "gpumounter_tpu/__init__.py": "",
+    "gpumounter_tpu/utils/__init__.py": "",
+    "gpumounter_tpu/utils/metrics.py":
+        'ALLOWED_LABEL_KEYS = frozenset({"result", "phase"})\n',
+    "gpumounter_tpu/faults/__init__.py": "",
+    "gpumounter_tpu/faults/registry.py":
+        'FAILPOINTS = {"fix.declared": "fixture site"}\n'
+        'DYNAMIC_PREFIXES = frozenset({"k8s."})\n',
+    "tests/test_fixture_arm.py":
+        '# arms the declared fixture failpoint: "fix.declared"\n',
+}
+
+
+def _build_tree(tmp_path, fixture_file: str) -> str:
+    root = str(tmp_path / "tree")
+    for rel, content in SUPPORT.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(content)
+    with open(fixture_file, encoding="utf-8") as f:
+        content = f.read()
+    target = os.path.join(root, "gpumounter_tpu", "fixture_mod.py")
+    with open(target, "w", encoding="utf-8") as f:
+        f.write(content)
+    return root
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_seeded_violation(rule_id, tmp_path, capsys):
+    """bad.py must make the CLI exit nonzero with a finding of exactly
+    this rule (a fresh tree has no baseline, so nothing is absorbed)."""
+    bad = os.path.join(FIXTURES, rule_id, "bad.py")
+    assert os.path.exists(bad), f"missing positive fixture for {rule_id}"
+    root = _build_tree(tmp_path, bad)
+    rc = tpulint_main(["--root", root, "--no-baseline", "--json",
+                       "--rule", rule_id])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    fired = {f["rule"] for f in out["findings"]}
+    assert rule_id in fired, out
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_stays_silent_on_clean_code(rule_id, tmp_path, capsys):
+    good = os.path.join(FIXTURES, rule_id, "good.py")
+    assert os.path.exists(good), f"missing negative fixture for {rule_id}"
+    root = _build_tree(tmp_path, good)
+    rc = tpulint_main(["--root", root, "--no-baseline", "--json",
+                       "--rule", rule_id])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out
+    assert out["findings"] == []
+
+
+# --- baseline mechanics ---
+
+
+def test_baseline_absorbs_and_ratchets(tmp_path, capsys):
+    """A written baseline absorbs existing findings (exit 0); an ADDED
+    violation of the same rule still fails (the ratchet)."""
+    bad = os.path.join(FIXTURES, "env-through-config", "bad.py")
+    root = _build_tree(tmp_path, bad)
+    baseline_path = str(tmp_path / "baseline.json")
+    assert tpulint_main(["--root", root, "--write-baseline",
+                         "--baseline-path", baseline_path]) == 0
+    capsys.readouterr()
+    assert tpulint_main(["--root", root,
+                         "--baseline-path", baseline_path]) == 0
+    capsys.readouterr()
+    # regression: one more env read appended
+    target = os.path.join(root, "gpumounter_tpu", "fixture_mod.py")
+    with open(target, "a", encoding="utf-8") as f:
+        f.write('EXTRA = os.environ.get("TPM_EXTRA")\n')
+    rc = tpulint_main(["--root", root, "--json",
+                       "--baseline-path", baseline_path])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(out["findings"]) == 1  # only the regression, not the debt
+    assert out["findings"][0]["line"] > 5  # the appended line, not debt
+
+
+def test_baseline_identity_survives_line_shift(tmp_path, capsys):
+    """Inserting lines ABOVE grandfathered findings must not invalidate
+    them — identity is the flagged line's text, not its number."""
+    bad = os.path.join(FIXTURES, "env-through-config", "bad.py")
+    root = _build_tree(tmp_path, bad)
+    baseline_path = str(tmp_path / "baseline.json")
+    tpulint_main(["--root", root, "--write-baseline",
+                  "--baseline-path", baseline_path])
+    capsys.readouterr()
+    target = os.path.join(root, "gpumounter_tpu", "fixture_mod.py")
+    with open(target, encoding="utf-8") as f:
+        content = f.read()
+    with open(target, "w", encoding="utf-8") as f:
+        f.write("# a comment pushing every line down\n" * 10 + content)
+    assert tpulint_main(["--root", root,
+                         "--baseline-path", baseline_path]) == 0
+
+
+# --- self-check on the real tree ---
+
+
+def _real_index() -> ProjectIndex:
+    return ProjectIndex.load(REPO_ROOT)
+
+
+def test_tree_is_clean_modulo_baseline():
+    index = _real_index()
+    findings, graph = run(index)
+    entries = baseline_mod.load()
+    fresh, _absorbed = baseline_mod.subtract(findings, index, entries)
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    assert graph is not None
+
+
+def test_static_lock_graph_is_acyclic_and_covers_migrated_modules():
+    graph = lockorder.build_graph(_real_index())
+    assert lockorder.find_cycle(graph.edge_set()) is None
+    migrated = {"metrics.counter", "metrics.gauge", "metrics.histogram",
+                "metrics.registry", "k8s.fake.state", "k8s.fake.sched",
+                "migrate.journals", "migrate.admission", "trace.ring",
+                "trace.tracer", "worker.ledger"}
+    missing = migrated - graph.nodes
+    assert not missing, f"migrated lock nodes absent from graph: {missing}"
+
+
+def test_failpoint_registry_matches_sites():
+    """Every fire()/value() site declared, every declaration live and
+    reachable — asserted directly (not via baseline) so this invariant
+    can never become grandfathered debt."""
+    index = _real_index()
+    from tools.tpulint.rules import FailpointRegistry
+    assert FailpointRegistry().check(index) == []
+
+
+# --- runtime validator (utils/locks.py) ---
+
+
+def test_runtime_edges_consistent_with_static_graph(tmp_path):
+    """Exercise the migrated modules, then assert every observed nested
+    acquisition is consistent with the static graph — the same check
+    the chaos harness runs as invariant 15 and CI re-runs from the
+    exported TPM_LOCK_TRACE artifact."""
+    from gpumounter_tpu.obs import trace as tr
+    from gpumounter_tpu.utils import locks
+    from gpumounter_tpu.worker.ledger import MountLedger
+
+    class Dev:
+        uuid, rel_path, major, minor, pod_name = "u0", "accel0", 1, 2, ""
+
+    ledger = MountLedger(str(tmp_path))
+    txn = ledger.begin("mount", target=object(), devices=[Dev()])
+    ledger.commit(txn, "success")
+    with tr.span("tpulint.fixture"):
+        pass
+    observed = locks.RECORDER.edges()
+    assert ("worker.ledger", "metrics.counter") in observed
+    static = lockorder.build_graph(_real_index()).edge_set()
+    locks.RECORDER.assert_consistent(static_edges=static)
+
+
+def test_recorder_detects_reversed_acquisition():
+    """A private recorder fed both orders must refuse (the global one
+    stays untouched — a seeded cycle there would fail invariant 15 for
+    the rest of the suite)."""
+    from gpumounter_tpu.utils import locks
+    recorder = locks.LockOrderRecorder()
+    recorder.note_acquired("a")
+    recorder.note_acquired("b")      # a -> b
+    recorder.note_released("b")
+    recorder.note_released("a")
+    recorder.note_acquired("b")
+    recorder.note_acquired("a")      # b -> a: cycle
+    recorder.note_released("a")
+    recorder.note_released("b")
+    with pytest.raises(locks.LockOrderViolation):
+        recorder.assert_consistent()
+
+
+def test_recorder_contradiction_with_static_graph():
+    """An order that is acyclic among observed edges alone but reverses
+    a static edge must still be refused."""
+    from gpumounter_tpu.utils import locks
+    recorder = locks.LockOrderRecorder()
+    recorder.note_acquired("metrics.counter")
+    recorder.note_acquired("worker.ledger")  # reverse of the real edge
+    recorder.note_released("worker.ledger")
+    recorder.note_released("metrics.counter")
+    static = {("worker.ledger", "metrics.counter")}
+    with pytest.raises(locks.LockOrderViolation):
+        recorder.assert_consistent(static_edges=static)
+
+
+def test_ordered_condition_wait_restores_holding(tmp_path):
+    """OrderedCondition.wait releases (and the held-stack reflects it),
+    then restores the entry on wakeup."""
+    from gpumounter_tpu.utils import locks
+    cv = locks.OrderedCondition("fixture.cv")
+    with cv:
+        assert "fixture.cv" in locks.held_locks()
+        cv.wait(timeout=0.01)
+        assert "fixture.cv" in locks.held_locks()
+    assert "fixture.cv" not in locks.held_locks()
+
+
+def test_verify_dynamic_cli_rejects_contradicting_trace(tmp_path, capsys):
+    """The chaos lane's TPM_LOCK_TRACE export contract: a trace
+    reversing a real static edge fails `--verify-dynamic`; an empty
+    trace passes."""
+    good = tmp_path / "trace_ok.json"
+    good.write_text(json.dumps({"edges": []}))
+    assert tpulint_main(["--root", REPO_ROOT,
+                         "--verify-dynamic", str(good)]) == 0
+    capsys.readouterr()
+    bad = tmp_path / "trace_bad.json"
+    bad.write_text(json.dumps(
+        {"edges": [["metrics.counter", "worker.ledger"]]}))
+    assert tpulint_main(["--root", REPO_ROOT,
+                         "--verify-dynamic", str(bad)]) == 1
+    capsys.readouterr()
+
+
+def test_find_cycle_reports_path():
+    from gpumounter_tpu.utils.locks import find_cycle
+    assert find_cycle({("a", "b"), ("b", "c")}) is None
+    cycle = find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+    assert cycle is not None
+    assert cycle[0] == cycle[-1]
+    assert set(cycle) == {"a", "b", "c"}
