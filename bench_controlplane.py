@@ -29,16 +29,27 @@ p50/p95, warm-pool hit rate, SLO burn rates — is embedded in the
 artifact under "fleet" so a perf regression can be read against the
 same run's fleet health.
 
+Since ISSUE 13 every timed mount is also a TRACED mount: the edge's
+X-Tpumounter-Trace id is assembled through the real GET /trace/<id>
+route (obs/assembly.py) into a per-phase critical-path breakdown —
+admission gate, k8s API wait, slave-pod scheduling, cgroup grant,
+mknod fan-out, verify — written to BENCH_trace_r01.json alongside
+assembly-completeness numbers, and --check gates 100% completeness
+plus a <TRACE_OVERHEAD_PCT span-export overhead budget on the warm p50.
+
 Usage:
   python bench_controlplane.py                 -> writes BENCH_ctrl_r07.json
+      AND BENCH_trace_r01.json (trace path overridable via
+      TPM_TRACE_ARTIFACT; ctrl via TPM_CTRL_ARTIFACT)
   python bench_controlplane.py --check FILE    -> runs fresh, compares the
       warm p50 AND warm p99 against the committed artifact; exits 1 on a
-      >25% p50 / >40% p99 regression or if the fresh run loses the 2x
-      cold/warm target. Budgets are normalized by runner speed
-      (fresh-cold / committed-cold ratio) plus an absolute noise floor
-      (10 ms p50 / 15 ms p99 — the tail is noisier on loaded CI boxes),
-      so a slow runner doesn't false-fail. Never overwrites the
-      committed artifact.
+      >25% p50 / >40% p99 regression, if the fresh run loses the 2x
+      cold/warm target, if any benched op fails to assemble completely,
+      or if the warm p50 blows the trace-plane overhead budget. Budgets
+      are normalized by runner speed (fresh-cold / committed-cold ratio)
+      plus an absolute noise floor (10 ms p50 / 15 ms p99 — the tail is
+      noisier on loaded CI boxes), so a slow runner doesn't false-fail.
+      Never overwrites the committed artifacts.
 """
 
 from __future__ import annotations
@@ -51,6 +62,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.parse
 import urllib.request
 
@@ -61,9 +73,20 @@ os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-ctrl-secret")
 os.environ["TPUMOUNTER_AUTH"] = "token"
 
 ARTIFACT = os.path.join(REPO, "BENCH_ctrl_r07.json")
+#: the fleet-trace-plane artifact (ISSUE 13): per-phase critical-path
+#: breakdown of the SAME timed mounts, assembly completeness, and the
+#: span-export overhead comparison against the committed control-plane
+#: artifact. Written by default runs; --check gates against it.
+TRACE_ARTIFACT = os.path.join(REPO, "BENCH_trace_r01.json")
 SCHED_DELAY_S = 0.05
 ITERS = 30
 WARM_POOL = 2
+#: span-export overhead budget: the trace plane (extra spans on the hot
+#: path + the `spans` telemetry section) may add at most this much to
+#: the warm-mount p50 vs the committed pre-trace-plane artifact,
+#: runner-normalized like every other budget (+ the same noise floor —
+#: warm p50 is single-digit ms where CI scheduler jitter dominates).
+TRACE_OVERHEAD_PCT = float(os.environ.get("TPM_TRACE_OVERHEAD_PCT", "5"))
 REGRESSION_PCT = float(os.environ.get("TPM_CTRL_REGRESSION_PCT", "25"))
 # The warm tail gets its own (wider) budget: p99 of 30 iterations is
 # close to the max sample, so scheduler jitter hits it far harder than
@@ -88,7 +111,7 @@ def http(method: str, url: str, form: dict | None = None):
     req = urllib.request.Request(url, data=data, method=method,
                                  headers=dict(AUTH))
     with urllib.request.urlopen(req) as resp:
-        return resp.status, resp.read().decode()
+        return resp.status, resp.read().decode(), dict(resp.headers)
 
 
 class Stack:
@@ -166,14 +189,17 @@ class Stack:
                                         timeout_s=15.0), \
                 "warm pool never filled"
 
-    def mount_cycle_ms(self) -> float:
-        """One timed /addtpu (1 chip) + untimed removal + pool refill."""
+    def mount_cycle_ms(self) -> tuple[float, str]:
+        """One timed /addtpu (1 chip) + untimed removal + pool refill.
+        Returns (latency_ms, trace_id) — the trace id from the edge's
+        X-Tpumounter-Trace header keys the per-phase breakdown."""
         t0 = time.perf_counter()
-        status, body = http("GET", self.base + "/addtpu/namespace/default/"
-                                               "pod/bench/tpu/1/"
-                                               "isEntireMount/false")
+        status, body, headers = http(
+            "GET", self.base + "/addtpu/namespace/default/"
+                               "pod/bench/tpu/1/isEntireMount/false")
         dt_ms = (time.perf_counter() - t0) * 1000.0
         assert status == 200, f"add failed: {status} {body}"
+        tid = headers.get("X-Tpumounter-Trace", "")
         from gpumounter_tpu.k8s.types import Pod
         pod = Pod(self.cluster.kube.get_pod("default", "bench"))
         slaves = {p.name for p in
@@ -181,19 +207,30 @@ class Stack:
         uuids = [d.uuid for d in self.service.collector.get_pod_devices(
             "bench", "default", slave_pod_names=slaves)]
         assert uuids, "no mounted chip found after add"
-        status, body = http("POST", self.base + "/removetpu/namespace/"
-                                                "default/pod/bench/"
-                                                "force/true",
-                            form={"uuids": ",".join(uuids)})
+        status, body, _ = http("POST", self.base + "/removetpu/namespace/"
+                                                   "default/pod/bench/"
+                                                   "force/true",
+                               form={"uuids": ",".join(uuids)})
         assert status == 200, f"remove failed: {status} {body}"
         if self.warm:
             assert self.pool.wait_ready(self.cluster.node_name, count=1,
                                         timeout_s=15.0), \
                 "warm pool failed to refill between iterations"
-        return dt_ms
+        return dt_ms, tid
+
+    def trace_tree(self, tid: str) -> dict | None:
+        """The assembled waterfall for one benched op, through the real
+        upgraded GET /trace/<id> route (obs/assembly.py)."""
+        try:
+            status, body, _ = http("GET", f"{self.base}/trace/{tid}")
+        except urllib.error.HTTPError:
+            return None
+        if status != 200:
+            return None
+        return json.loads(body)
 
     def metrics(self) -> str:
-        _, body = http("GET", self.base + "/metrics")
+        _, body, _ = http("GET", self.base + "/metrics")
         return body
 
     def fleet(self) -> dict:
@@ -201,9 +238,9 @@ class Stack:
         recorded into the artifact so a perf regression can be read
         against the same run's warm-pool hit rate, per-node p95, and
         burn rates."""
-        _, body = http("GET", self.base + "/fleet")
+        _, body, _ = http("GET", self.base + "/fleet")
         rollup = json.loads(body)
-        _, body = http("GET", self.base + "/slo")
+        _, body, _ = http("GET", self.base + "/slo")
         return {"rollup": rollup, "slo": json.loads(body)}
 
     def stop(self) -> None:
@@ -221,13 +258,58 @@ def percentile(samples: list[float], pct: float) -> float:
     return ordered[idx]
 
 
-def run_mode(warm: bool) -> tuple[dict, str, dict]:
+def _trace_summary(trees: list[dict | None], samples: list[float]) -> dict:
+    """Per-phase critical-path breakdown across one mode's benched ops:
+    p50 of each phase's attributed wall time, the dominant phase by
+    median share, and assembly completeness (the acceptance gate: every
+    benched op must assemble with no orphan remote spans and a phase
+    sum matching the edge wall time)."""
+    assembled = [t for t in trees if t is not None]
+    complete = [t for t in assembled if t.get("complete")]
+    exact = [
+        t for t in complete
+        if abs(sum(t["phases"].values()) - t["wall_ms"])
+        <= max(0.05, 0.01 * t["wall_ms"])]
+    by_phase: dict[str, list[float]] = {}
+    for tree in complete:
+        for phase, ms in tree["phases"].items():
+            by_phase.setdefault(phase, []).append(ms)
+    phases_p50 = {
+        # absent = 0 for the median: a phase seen in 3 of 30 ops is
+        # NOT a 50th-percentile cost of the operation
+        phase: round(percentile(ms_list + [0.0] * (len(complete)
+                                                   - len(ms_list)), 50), 3)
+        for phase, ms_list in sorted(by_phase.items())}
+    dominant = max(phases_p50, key=lambda p: phases_p50[p]) \
+        if phases_p50 else ""
+    return {
+        "ops": len(trees),
+        "assembled": len(assembled),
+        "complete": len(complete),
+        "attribution_exact": len(exact),
+        "completeness": round(len(complete) / len(trees), 4) if trees
+        else 0.0,
+        "wall_p50_ms": round(percentile(samples, 50), 3),
+        "phases_p50_ms": phases_p50,
+        "dominant_phase": dominant,
+        "dominant_share_p50": round(
+            phases_p50.get(dominant, 0.0)
+            / max(sum(phases_p50.values()), 1e-9), 4),
+    }
+
+
+def run_mode(warm: bool) -> tuple[dict, str, dict, dict]:
     with tempfile.TemporaryDirectory(
             prefix=f"tpm-ctrl-{'warm' if warm else 'cold'}-") as root:
         stack = Stack(root, warm=warm)
         try:
             stack.mount_cycle_ms()  # one untimed warmup cycle
-            samples = [stack.mount_cycle_ms() for _ in range(ITERS)]
+            cycles = [stack.mount_cycle_ms() for _ in range(ITERS)]
+            samples = [ms for ms, _ in cycles]
+            # assemble every benched op's trace through the real route
+            # while the stack still serves
+            trees = [stack.trace_tree(tid) for _, tid in cycles]
+            trace_summary = _trace_summary(trees, samples)
             metrics = stack.metrics()
             fleet = stack.fleet() if warm else {}
         finally:
@@ -240,7 +322,7 @@ def run_mode(warm: bool) -> tuple[dict, str, dict]:
         "min_ms": round(min(samples), 3),
         "max_ms": round(max(samples), 3),
         "samples_ms": [round(s, 3) for s in samples],
-    }, metrics, fleet)
+    }, metrics, fleet, trace_summary)
 
 
 def scrape(metrics: str, prefixes: tuple[str, ...]) -> list[str]:
@@ -249,8 +331,8 @@ def scrape(metrics: str, prefixes: tuple[str, ...]) -> list[str]:
 
 
 def run_bench() -> dict:
-    cold, _, _ = run_mode(warm=False)
-    warm, warm_metrics, fleet = run_mode(warm=True)
+    cold, _, _, cold_trace = run_mode(warm=False)
+    warm, warm_metrics, fleet, warm_trace = run_mode(warm=True)
     excerpt = scrape(warm_metrics, (
         "tpumounter_warm_pool_", "tpumounter_channel_pool_"))
 
@@ -281,7 +363,42 @@ def run_bench() -> dict:
         # fleet/SLO snapshot from the warm run's master (/fleet + /slo):
         # per-node p50/p95, warm-pool hit rate, burn rates at end of run.
         "fleet": fleet,
+        # fleet trace plane (ISSUE 13): per-phase critical-path
+        # breakdown + assembly completeness of the SAME benched ops,
+        # via the real assembled GET /trace/<id> route.
+        "trace": {"warm": warm_trace, "cold": cold_trace},
     }
+
+
+def trace_artifact(results: dict, committed_ctrl: dict | None) -> dict:
+    """BENCH_trace_r01.json: the per-phase critical-path breakdown for
+    warm and cold mounts, assembly completeness, and the span-export
+    overhead comparison against the committed pre-trace-plane
+    control-plane artifact (runner-normalized by the cold-path
+    ratio)."""
+    out = {
+        "schema": "tpumounter-trace/r01",
+        "iterations": ITERS,
+        "sched_delay_ms": SCHED_DELAY_S * 1000.0,
+        "warm": results["trace"]["warm"],
+        "cold": results["trace"]["cold"],
+    }
+    if committed_ctrl:
+        speed_ratio = max(1.0, results["cold"]["p50_ms"]
+                          / max(committed_ctrl["cold"]["p50_ms"], 0.001))
+        ref = committed_ctrl["warm"]["p50_ms"]
+        normalized_ref = ref * speed_ratio
+        out["overhead_vs_ctrl"] = {
+            "ctrl_artifact_warm_p50_ms": ref,
+            "machine_speed_ratio": round(speed_ratio, 3),
+            "warm_p50_ms": results["warm"]["p50_ms"],
+            "overhead_pct_normalized": round(
+                (results["warm"]["p50_ms"] / normalized_ref - 1.0)
+                * 100.0, 2),
+            "budget_pct": TRACE_OVERHEAD_PCT,
+            "noise_floor_ms": NOISE_FLOOR_MS,
+        }
+    return out
 
 
 def main() -> None:
@@ -344,10 +461,48 @@ def main() -> None:
         if not results["meets_2x_target"]:
             failures.append(
                 f"speedup_p50 {results['speedup_p50']} lost the 2x target")
+        # --- fleet trace plane gates (ISSUE 13) ---
+        # 1. assembly completeness: EVERY benched op (warm and cold)
+        #    must assemble with no orphan remote spans and an exact
+        #    critical-path attribution — a trace plane that loses the
+        #    ops it was built to explain has failed, whatever the p50.
+        for mode in ("warm", "cold"):
+            tr = results["trace"][mode]
+            if tr["ops"] and tr["completeness"] < 1.0:
+                failures.append(
+                    f"{mode} trace assembly completeness "
+                    f"{tr['completeness']:.2%} < 100% "
+                    f"({tr['complete']}/{tr['ops']} benched ops)")
+            if tr["ops"] and tr["attribution_exact"] < tr["complete"]:
+                failures.append(
+                    f"{mode}: {tr['complete'] - tr['attribution_exact']} "
+                    f"assembled op(s) whose critical-path phase sum "
+                    f"diverges from the edge wall time")
+        # 2. span-export overhead: the trace plane may add at most
+        #    TRACE_OVERHEAD_PCT to the warm p50 vs the committed
+        #    control-plane artifact (runner-normalized, + noise floor).
+        overhead_budget = (committed["warm"]["p50_ms"]
+                           * (1 + TRACE_OVERHEAD_PCT / 100)
+                           * speed_ratio + NOISE_FLOOR_MS)
+        summary["trace_overhead_budget_ms"] = round(overhead_budget, 3)
+        summary["trace_completeness"] = {
+            mode: results["trace"][mode]["completeness"]
+            for mode in ("warm", "cold")}
+        if results["warm"]["p50_ms"] > overhead_budget:
+            failures.append(
+                f"span-export overhead: warm p50 "
+                f"{results['warm']['p50_ms']}ms exceeds the trace-plane "
+                f"budget {overhead_budget:.3f}ms (committed "
+                f"{committed['warm']['p50_ms']}ms "
+                f"+{TRACE_OVERHEAD_PCT:.0f}% +{NOISE_FLOOR_MS}ms)")
         out = os.environ.get("TPM_CTRL_ARTIFACT")
         if out:
             with open(out, "w", encoding="utf-8") as f:
                 json.dump(results, f, indent=1)
+        trace_out = os.environ.get("TPM_TRACE_ARTIFACT")
+        if trace_out:
+            with open(trace_out, "w", encoding="utf-8") as f:
+                json.dump(trace_artifact(results, committed), f, indent=1)
         summary["check"] = "fail" if failures else "ok"
         print(json.dumps(summary))
         if failures:
@@ -356,9 +511,20 @@ def main() -> None:
             raise SystemExit(1)
         return
 
+    # Load the overhead reference BEFORE (possibly) rewriting it: with
+    # TPM_CTRL_ARTIFACT unset the next write replaces ARTIFACT with
+    # this run's numbers, and reading it back afterwards would make
+    # overhead_vs_ctrl compare the run against itself (always ~0%).
+    committed_ctrl = None
+    if os.path.exists(ARTIFACT):
+        with open(ARTIFACT, encoding="utf-8") as f:
+            committed_ctrl = json.load(f)
     artifact = os.environ.get("TPM_CTRL_ARTIFACT", ARTIFACT)
     with open(artifact, "w", encoding="utf-8") as f:
         json.dump(results, f, indent=1)
+    trace_path = os.environ.get("TPM_TRACE_ARTIFACT", TRACE_ARTIFACT)
+    with open(trace_path, "w", encoding="utf-8") as f:
+        json.dump(trace_artifact(results, committed_ctrl), f, indent=1)
     print(json.dumps(summary))
 
 

@@ -20,7 +20,15 @@ Two families of verbs:
     remove  --master URL --namespace NS --pod POD --uuids U,U [--force]
     migrate start|status|abort     live chip migration between pods
     audit   [--pod POD] [--trace ID] [--op PREFIX]   the audit trail
-    trace ID                       all buffered spans for one trace
+    trace ID                       assembled waterfall for one trace
+                                   (master + federated worker spans)
+    why ID                         the dominant critical-path phase of
+                                   one trace and its share of wall time
+                                   (exit 3 on incomplete assembly)
+    timeline [--node N] [--trace ID] [--kind K] [--since F] [--until T]
+                                   incident flight recorder: spans,
+                                   audit, Events, ApiHealth, recovery
+                                   markers merged chronologically
     fleet                          federated per-node fleet rollup
                                    (stale nodes flagged on stderr)
     slo                            SLO burn-rate evaluation with
@@ -277,6 +285,78 @@ def cmd_trace(args) -> int:
     if status == 404:
         return 2  # unknown/expired trace id: rejected, not a failure
     return 0 if status == 200 else 1
+
+
+def cmd_why(args) -> int:
+    """Answer "why was this operation slow" for one trace id: fetch
+    the assembled waterfall (GET /trace/<id> — master + federated
+    worker spans joined by obs/assembly.py) and name the dominant
+    critical-path phase and its share of wall time. Exit 2 when the
+    trace is unknown/expired, 3 when the assembly is incomplete
+    (orphan spans / a missing worker half — the verdict would lie)."""
+    status, body = _http(args, "GET", f"/trace/{args.id}",
+                         token=_obs_token(args))
+    if status == 404:
+        print(body.rstrip(), file=sys.stderr)
+        return 2
+    if status != 200:
+        print(body.rstrip(), file=sys.stderr)
+        return 1
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        print("error: unparseable /trace payload", file=sys.stderr)
+        return 1
+    nodes = payload.get("nodes") or []
+    print(f"trace {args.id}: {payload.get('op') or '?'} took "
+          f"{payload.get('wall_ms', 0)}ms across "
+          f"{len(payload.get('spans', []))} span(s)"
+          + (f" on {', '.join(nodes)}" if nodes else ""))
+    for entry in payload.get("critical_path", []):
+        print(f"  {entry.get('phase', '?'):<20} "
+              f"{entry.get('ms', 0.0):>10.3f} ms  "
+              f"{entry.get('share', 0.0) * 100:5.1f}%")
+    dominant = payload.get("dominant") or {}
+    if dominant:
+        print(f"dominant phase: {dominant.get('phase')} "
+              f"({dominant.get('share', 0.0):.0%} of wall time)")
+    if not payload.get("complete", False):
+        orphans = payload.get("orphans") or []
+        missing = payload.get("missing_worker_halves") or []
+        print(f"INCOMPLETE assembly: {len(orphans)} orphan span(s), "
+              f"{len(missing)} rpc span(s) missing their worker half — "
+              f"the breakdown above understates remote phases",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """The incident flight recorder's merged chronological timeline
+    (GET /timeline): root/error spans, audit records, k8s Events,
+    ApiHealth transitions and recovery markers, oldest first. JSON on
+    stdout; a one-line-per-record rendering on stderr for humans."""
+    params = {k: v for k, v in (
+        ("node", args.node), ("trace", args.trace), ("kind", args.kind),
+        ("from", args.since), ("to", args.until),
+        ("limit", str(args.limit))) if v}
+    path = "/timeline" + (f"?{urllib.parse.urlencode(params)}"
+                          if params else "")
+    status, body = _http(args, "GET", path, token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        records = json.loads(body).get("records", [])
+    except ValueError:
+        return 1
+    for rec in records:
+        trace_id = rec.get("trace_id") or "-"
+        node = rec.get("node") or "-"
+        print(f"{rec.get('at', 0):.3f} [{rec.get('kind', '?'):>9}] "
+              f"{node:<12} {rec.get('summary', '')} (trace {trace_id})",
+              file=sys.stderr)
+    return 0
 
 
 def cmd_fleet(args) -> int:
@@ -713,6 +793,30 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("id", help="trace id (X-Tpumounter-Trace response "
                                "header / audit record trace_id)")
     tr.set_defaults(fn=cmd_trace)
+
+    wy = sub.add_parser("why", help="name the dominant critical-path "
+                                    "phase of one trace (exit 3 when "
+                                    "the assembly is incomplete)")
+    _obs_common(wy)
+    wy.add_argument("id", help="trace id (X-Tpumounter-Trace response "
+                               "header / audit record trace_id)")
+    wy.set_defaults(fn=cmd_why)
+
+    tl = sub.add_parser("timeline", help="incident flight recorder: the "
+                                         "merged chronological timeline "
+                                         "(spans, audit, Events, "
+                                         "ApiHealth, recovery markers)")
+    _obs_common(tl)
+    tl.add_argument("--node", default=None, help="only this node")
+    tl.add_argument("--trace", default=None, help="only this trace id")
+    tl.add_argument("--kind", default=None,
+                    help="span / audit / event / apihealth / recovery")
+    tl.add_argument("--since", dest="since", default=None, metavar="FROM",
+                    help="unix-seconds lower bound (?from=)")
+    tl.add_argument("--until", dest="until", default=None, metavar="TO",
+                    help="unix-seconds upper bound (?to=)")
+    tl.add_argument("--limit", type=int, default=500)
+    tl.set_defaults(fn=cmd_timeline)
 
     fl = sub.add_parser("fleet", help="federated fleet rollup: per-node "
                                       "mount p50/p95, warm-pool hit "

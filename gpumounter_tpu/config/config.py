@@ -371,6 +371,25 @@ class Config:
         "TPUMOUNTER_TRACE_RING", "2048")))
     audit_capacity: int = field(default_factory=lambda: int(_env(
         "TPUMOUNTER_AUDIT_CAPACITY", "4096")))
+    # --- fleet trace plane (gpumounter_tpu/obs/assembly|flight) ---
+    # Newest spans a worker exports per CollectTelemetry snapshot (the
+    # master dedupes by span id, so re-sending is free; the cap bounds
+    # the payload, not correctness — see docs/FAQ.md on span-export
+    # overhead).
+    span_export_max: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_SPAN_EXPORT_MAX", "512")))
+    # Master-side remote-span store capacity (worker spans federated by
+    # the fleet collector, joined with local spans by /trace/<id>).
+    remote_span_capacity: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_REMOTE_SPAN_CAPACITY", "8192")))
+    # Incident flight recorder (obs/flight.py): bounded in-memory
+    # timeline of root spans, audit records, k8s Events, ApiHealth
+    # transitions and recovery markers, with an optional durable JSONL
+    # spill ("" = in-memory only).
+    flight_capacity: int = field(default_factory=lambda: int(_env(
+        "TPUMOUNTER_FLIGHT_CAPACITY", "4096")))
+    flight_jsonl: str = field(default_factory=lambda: _env(
+        "TPUMOUNTER_FLIGHT_JSONL", ""))
 
     # --- fleet telemetry + SLO engine (gpumounter_tpu/obs/fleet|slo) ---
     # How often the master federates every worker's telemetry (RPC with

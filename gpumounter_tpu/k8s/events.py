@@ -43,5 +43,15 @@ def post_pod_event(kube, pod: Pod, reason: str, message: str,
     }
     try:
         kube.create_event(pod.namespace, manifest)
+        posted = True
     except Exception as exc:  # noqa: BLE001 — events are advisory
+        posted = False
         logger.debug("event post failed: %s", exc)
+    # The flight recorder's timeline keeps the Event even when the API
+    # post failed — during an outage the timeline is exactly where an
+    # operator will look for what the cluster never got to see.
+    from gpumounter_tpu.obs.flight import FLIGHT
+    FLIGHT.record("event", f"{reason}: {message}"[:240],
+                  namespace=pod.namespace, pod=pod.name, reason=reason,
+                  event_type=event_type, component=component,
+                  posted=posted)

@@ -216,9 +216,11 @@ class ApiHealth:
     def subscribe(self, fn) -> None:
         """fn(old_state, new_state) on every overall transition,
         outside the lock (a slow subscriber cannot block
-        observation)."""
+        observation). Idempotent by identity so process-global hooks
+        (the flight recorder) can re-install themselves freely."""
         with self._lock:
-            self._subscribers.append(fn)
+            if not any(s is fn for s in self._subscribers):
+                self._subscribers.append(fn)
 
     def payload(self) -> dict:
         with self._lock:

@@ -339,11 +339,15 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/migrations/(?P<mid>[^/]+)$"), "migration_get"),
     ("POST", re.compile(
         r"^/migrations/(?P<mid>[^/]+)/abort$"), "migration_abort"),
-    # Observability reads (gpumounter_tpu/obs). The audit pattern
-    # captures its own query string because the dispatcher matches the
-    # raw request path (no other route accepts queries).
+    # Observability reads (gpumounter_tpu/obs). The audit/timeline
+    # patterns capture their own query strings because the dispatcher
+    # matches the raw request path (no other route accepts queries).
     ("GET", re.compile(r"^/audit(?:\?(?P<query>.*))?$"), "audit"),
     ("GET", re.compile(r"^/trace/(?P<tid>[^/?]+)$"), "trace"),
+    # Incident flight recorder (gpumounter_tpu/obs/flight.py): the
+    # merged chronological timeline — root/error spans, audit records,
+    # k8s Events, ApiHealth transitions, recovery markers.
+    ("GET", re.compile(r"^/timeline(?:\?(?P<query>.*))?$"), "timeline"),
     # Fleet telemetry plane (gpumounter_tpu/obs/fleet.py + slo.py): one
     # pane over every node's mount latency / warm-pool / device-access
     # telemetry, and the SLO burn-rate evaluation over it.
@@ -390,7 +394,7 @@ class MasterApp:
     #: movements — require the mutate token.
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
                              "shards", "recovery", "tenants",
-                             "apihealth"})
+                             "apihealth", "timeline"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -520,6 +524,12 @@ class MasterApp:
             kube, self.registry, self._client_factory, cfg=self.cfg,
             store=self.store, shards=self.shards, elastic=self.elastic,
             migrations=self.migrations, apihealth=self.apihealth)
+        # Flight recorder (obs/flight.py): root/error spans, audit
+        # records and ApiHealth transitions of this replica feed the
+        # /timeline pane. Idempotent — any number of apps/tests share
+        # the process-global recorder.
+        from gpumounter_tpu.obs import flight
+        flight.install(apihealth=self.apihealth)
 
     # --- plumbing ---
 
@@ -549,7 +559,7 @@ class MasterApp:
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
                                  "slo", "shards", "recovery", "tenants",
-                                 "apihealth"})
+                                 "apihealth", "timeline"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -563,7 +573,13 @@ class MasterApp:
         0 = unbounded). Replica-forwarded work (bulk sub-batches) holds a
         slot of its own gate, never the client gate: forwarded requests
         do only local work, so the two-gate split bounds them without a
-        proxy cycle ever waiting on itself."""
+        proxy cycle ever waiting on itself.
+
+        When a gate exists and a trace is ambient (the edge span of a
+        traced route), the WAIT for a slot gets its own http.admission
+        child span — so a saturated replica's queueing shows up as the
+        "admission" phase of the assembled critical path
+        (obs/assembly.py) instead of vanishing into the edge span."""
         if name in self.UNGATED_ROUTES:
             yield
             return
@@ -573,7 +589,12 @@ class MasterApp:
         if gate is None:
             yield
             return
-        gate.acquire()
+        if trace.current() is not None:
+            with trace.span("http.admission", route=name,
+                            forwarded=forwarded):
+                gate.acquire()
+        else:
+            gate.acquire()
         try:
             yield
         finally:
@@ -589,18 +610,15 @@ class MasterApp:
 
         Auth runs BEFORE the span opens: an unauthenticated peer must
         not be able to churn the span ring or — via the inbound trace
-        header — inject spans into a victim's trace id."""
+        header — inject spans into a victim's trace id. The admission
+        gate runs INSIDE the edge span of traced routes (its wait is
+        the critical path's "admission" phase); untraced routes gate
+        without a span, exactly as before."""
         self._check_auth(name, headers)
-        with self._admission(name, headers):
-            return self._dispatch_admitted(name, match, method, path,
-                                           body, headers)
-
-    def _dispatch_admitted(self, name: str, match, method: str, path: str,
-                           body: bytes, headers: dict[str, str]
-                           ) -> tuple[int, str, str, dict[str, str]]:
         if name in self.UNTRACED_ROUTES:
-            status, ctype, text = getattr(
-                self, f"_route_{name}")(match, body, headers)
+            with self._admission(name, headers):
+                status, ctype, text = getattr(
+                    self, f"_route_{name}")(match, body, headers)
             return status, ctype, text, {}
         inbound = next((v for k, v in headers.items()
                         if k.lower() == trace.TRACE_HEADER), None)
@@ -612,12 +630,13 @@ class MasterApp:
             with trace.span(f"http.{name}", wire_parent=inbound,
                             http_method=method) as ctx:
                 extra = {trace.RESPONSE_HEADER: ctx.trace_id}
-                if name in self.AUDITED_ROUTES:
-                    status, ctype, text = self._audited_route(
-                        name, match, body, headers)
-                else:
-                    status, ctype, text = getattr(
-                        self, f"_route_{name}")(match, body, headers)
+                with self._admission(name, headers):
+                    if name in self.AUDITED_ROUTES:
+                        status, ctype, text = self._audited_route(
+                            name, match, body, headers)
+                    else:
+                        status, ctype, text = getattr(
+                            self, f"_route_{name}")(match, body, headers)
                 return status, ctype, text, extra
         except _HttpError as exc:
             exc.headers = {**extra, **exc.headers}
@@ -697,9 +716,12 @@ class MasterApp:
                         ) -> tuple[str, str]:
         """(worker_address, node_name); raises _HttpError on miss. With
         redirect_path set, non-owned nodes 307 to their shard owner
-        before any worker lookup happens here."""
+        before any worker lookup happens here. The pod fetch gets a
+        k8s.get_pod span: API-server wait is its own phase of the
+        assembled critical path (obs/assembly.py)."""
         try:
-            pod = Pod(self.kube.get_pod(namespace, pod_name))
+            with trace.span("k8s.get_pod", pod=f"{namespace}/{pod_name}"):
+                pod = Pod(self.kube.get_pod(namespace, pod_name))
         except NotFoundError:
             raise _HttpError(
                 404, f"No pod: {pod_name} in namespace: {namespace}")
@@ -831,16 +853,49 @@ class MasterApp:
             jsonlib.dumps(payload, indent=1) + "\n"
 
     def _route_trace(self, match, body, headers):
-        """All buffered spans for one trace id (master-side view; the
-        worker's ops port serves its half of the same trace via the
-        shared obs.trace.trace_payload contract)."""
+        """The assembled end-to-end story for one trace id: master
+        spans joined with the worker spans the fleet collector
+        federated (obs/assembly.py), rendered as a waterfall with
+        per-phase critical-path attribution and a completeness verdict.
+        An incomplete assembly triggers ONE bounded fleet refresh (the
+        missing worker half may simply not have been scraped yet)
+        before answering."""
         import json as jsonlib
+
+        from gpumounter_tpu.obs import assembly
         tid = match.group("tid")
-        payload = trace.trace_payload(tid)
+        payload = assembly.assemble(tid)
+        if payload is not None and not payload["complete"]:
+            # Pull fresh worker rings once (single-flight, 1 s floor so
+            # a polling dashboard cannot turn incomplete traces into a
+            # scrape storm), then re-join.
+            try:
+                self.fleet.refresh_if_stale(max_age_s=1.0)
+            except Exception:  # noqa: BLE001 — the join still answers
+                logger.exception("fleet refresh for /trace/%s failed", tid)
+            payload = assembly.assemble(tid)
         if payload is None:
             raise _HttpError(
                 404, f"no spans buffered for trace {tid} (expired from "
                      f"the ring, or minted elsewhere)")
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
+
+    def _route_timeline(self, match, body, headers):
+        """The incident flight recorder's merged chronological
+        timeline (obs/flight.py). Filters (all optional): ?node= &trace=
+        &kind= (span/audit/event/apihealth/recovery/marker) &from= &to=
+        (unix seconds) &limit= (default 500, newest win)."""
+        import json as jsonlib
+
+        from gpumounter_tpu.obs.flight import query_from_params
+        params = urllib.parse.parse_qs(match.group("query") or "")
+        try:
+            payload = query_from_params(params)
+        except ValueError:
+            raise _HttpError(
+                400, f"Invalid timeline filter: {params!r} (from/to/"
+                     f"limit must be numeric)")
         return 200, "application/json", \
             jsonlib.dumps(payload, indent=1) + "\n"
 
@@ -1018,9 +1073,20 @@ class MasterApp:
 
         threads = []
         if remote:
+            # Contextvars don't cross threads: capture the edge span's
+            # context HERE and re-attach it in each forwarder, so the
+            # X-Tpumounter-Trace header _proxy_batch stamps carries THIS
+            # request's trace — the owner replica joins the forwarding
+            # replica's trace instead of minting a fresh root (which
+            # orphaned the remote half of every proxied bulk mount).
+            edge_ctx = trace.current()
+
             def _forward(url: str, indices: list[int]) -> None:
-                entries = self._proxy_batch(url,
-                                            [targets[i] for i in indices])
+                with trace.attached(edge_ctx), \
+                        trace.span("proxy.batch", url=url,
+                                   targets=len(indices)):
+                    entries = self._proxy_batch(
+                        url, [targets[i] for i in indices])
                 for i, entry in zip(indices, entries):
                     results[i] = entry
 

@@ -15,9 +15,11 @@ logger = get_logger("master.main")
 def main() -> None:
     cfg = get_config()
     init_logger(cfg.log_dir, "tpumounter-master.log")
-    from gpumounter_tpu.obs import audit, trace
+    from gpumounter_tpu.obs import assembly, audit, flight, trace
     trace.configure(cfg)
     audit.configure(cfg)
+    flight.configure(cfg)
+    assembly.configure(cfg)
     from gpumounter_tpu.k8s import default_client
     from gpumounter_tpu.master.app import MasterApp, build_http_server
 

@@ -19,7 +19,17 @@ pod X's chips, when, and why was it slow" —
     served at /fleet and by `tpumounter fleet`.
   * obs.slo — declarative objectives with multi-window burn-rate
     evaluation over the fleet rollup (/slo, `tpumounter slo`);
-    breaches post k8s Events and audit records.
+    breaches post k8s Events and audit records — latency breaches
+    stamped with the fleet-dominant critical-path phase.
+  * obs.assembly — fleet-wide trace assembly: worker span rings ride
+    the CollectTelemetry snapshot into a master-side RemoteSpanStore;
+    assemble() joins both halves into an end-to-end operation tree
+    with per-phase critical-path attribution (served by the upgraded
+    /trace/<id> waterfall and `tpumounter why <trace-id>`).
+  * obs.flight — the incident flight recorder: root/error spans,
+    audit records, k8s Events, ApiHealth transitions and recovery
+    markers merged into one bounded, durably-spillable chronological
+    timeline (/timeline, `tpumounter timeline`).
 
 Stdlib-only on purpose: imported by the mount path, which must stay
 importable without grpc (utils/lazy_grpc.py policy — obs.fleet takes

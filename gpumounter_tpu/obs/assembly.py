@@ -1,0 +1,366 @@
+"""Fleet-wide trace assembly and critical-path attribution.
+
+Before this module, spans lived in per-process ring buffers: the master
+/trace/<id> route showed only master-local spans and each worker's ops
+port only its own. Here the two halves meet:
+
+  * RemoteSpanStore — the master's bounded store of worker-exported
+    spans. Workers ship their span rings inside the CollectTelemetry
+    snapshot (obs/fleet.py `spans` section, same degradation contract
+    as the rest of the telemetry plane: the HTTP-scrape fallback simply
+    carries none); the FleetCollector ingests them here, deduplicated
+    by span id, so repeated snapshots of a cumulative ring are free.
+
+  * assemble() — joins master-local spans (the process tracer ring)
+    with federated remote spans by trace id into an end-to-end
+    operation tree, flags incompleteness (orphan spans whose parent
+    never arrived; rpc client spans missing their worker half), and
+    attributes every instant of the operation's wall time to exactly
+    one PHASE — admission gate, shard proxy hop, k8s API wait,
+    slave-pod scheduling, cgroup grant, mknod fan-out, verify, RPC
+    transport — by walking the tree's wall-clock intervals (a child's
+    window is charged to the child's phase; uncovered time to the
+    owning span's own phase; overlap between parallel siblings — the
+    mknod fan-out — is charged once, to the earliest sibling). By
+    construction the per-phase attribution sums to the root span's
+    wall time, which is exactly what chaos invariant 16 asserts.
+
+  * fleet_dominant_phase() — the same attribution aggregated over the
+    most recent mount-shaped edge spans, so the SLO engine can stamp
+    WHERE the latency budget is going into a TPUSLOBurnRate breach
+    Event instead of just that it is burning.
+
+Stdlib-only (lazy-grpc policy: imported by worker and master alike).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("obs.assembly")
+
+REMOTE_SPANS_INGESTED = REGISTRY.counter(
+    "tpumounter_remote_spans_ingested_total",
+    "Worker spans newly federated into the master's remote-span store "
+    "(re-sent spans dedupe by span id and are not counted)")
+REMOTE_SPAN_EVICTIONS = REGISTRY.counter(
+    "tpumounter_remote_span_evictions_total",
+    "Federated worker spans dropped from the remote-span store by "
+    "capacity pressure (raise TPUMOUNTER_REMOTE_SPAN_CAPACITY)")
+
+#: span-name -> phase taxonomy, FIRST matching prefix wins (so the
+#: specific http.admission outranks the http. edge catch-all). These
+#: are the phases a hot mount/unmount/migration actually pays; an
+#: unknown span name falls back to its first dotted segment so new
+#: subsystems degrade to a readable bucket instead of "other".
+PHASES: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("admission", ("http.admission",)),
+    ("shard_proxy", ("proxy.",)),
+    ("k8s_api", ("k8s.",)),
+    ("slave_pod_schedule", ("mount.slave_pod_schedule",)),
+    ("cgroup_grant", ("mount.cgroup_grant", "unmount.cgroup_revoke")),
+    ("verify", ("mount.verify",)),
+    ("mknod", ("mount.mknod", "unmount.device_remove")),
+    ("rollback", ("mount.rollback",)),
+    ("worker", ("worker.",)),
+    ("rpc", ("rpc.",)),
+    ("migrate", ("migrate.",)),
+    ("edge", ("http.", "chaos.", "slice.", "bulk.", "elastic.")),
+)
+
+#: rpc client spans whose worker half is read-only scrape noise the
+#: worker deliberately defers-and-drops — their absence is not
+#: incomplete assembly.
+_RPC_NO_WORKER_HALF = frozenset({"rpc.CollectTelemetry"})
+
+
+def phase_of(name: str) -> str:
+    for phase, prefixes in PHASES:
+        for prefix in prefixes:
+            if name.startswith(prefix):
+                return phase
+    return name.split(".", 1)[0] if name else "unknown"
+
+
+class RemoteSpanStore:
+    """Bounded master-side store of federated worker spans.
+
+    Keyed by span id (workers re-send their whole ring each telemetry
+    pass — dedup makes that free) with a per-trace index for O(1)
+    /trace joins. FIFO eviction by ingest order: the store is a join
+    buffer, not an archive — the JSONL sinks are the archive.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._lock = OrderedLock("assembly.remote")
+        self._spans: OrderedDict[str, dict] = OrderedDict()
+        self._by_trace: dict[str, set[str]] = {}
+
+    def ingest(self, node: str, spans) -> int:
+        """Store every not-yet-seen span, stamped with the node it came
+        from. Returns how many were new. Malformed entries (a hostile
+        or buggy peer's payload) are skipped, never raised."""
+        if not isinstance(spans, (list, tuple)):
+            return 0
+        new = 0
+        evicted = 0
+        with self._lock:
+            for span in spans:
+                if not isinstance(span, dict):
+                    continue
+                sid = span.get("span_id")
+                tid = span.get("trace_id")
+                if not sid or not tid or not isinstance(sid, str) \
+                        or not isinstance(tid, str):
+                    continue
+                if sid in self._spans:
+                    continue
+                entry = dict(span)
+                entry["node"] = node
+                self._spans[sid] = entry
+                self._by_trace.setdefault(tid, set()).add(sid)
+                new += 1
+            while len(self._spans) > max(1, self.capacity):
+                old_sid, old = self._spans.popitem(last=False)
+                ids = self._by_trace.get(old.get("trace_id", ""))
+                if ids is not None:
+                    ids.discard(old_sid)
+                    if not ids:
+                        self._by_trace.pop(old.get("trace_id", ""), None)
+                evicted += 1
+        if new:
+            REMOTE_SPANS_INGESTED.inc(float(new))
+        if evicted:
+            REMOTE_SPAN_EVICTIONS.inc(float(evicted))
+        return new
+
+    def spans_for(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            ids = self._by_trace.get(trace_id) or ()
+            return [dict(self._spans[sid]) for sid in ids
+                    if sid in self._spans]
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(s) for s in self._spans.values()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._by_trace.clear()
+
+
+REMOTE_SPANS = RemoteSpanStore()
+
+
+def configure(cfg) -> None:
+    """Daemon-startup wiring: remote-store capacity from config."""
+    REMOTE_SPANS.capacity = cfg.remote_span_capacity
+
+
+# --- assembly ---
+
+
+def _attribute(span: dict, children: dict[str, list[dict]],
+               lo: float, hi: float, acc: dict[str, float]) -> None:
+    """Attribute the wall-clock window [lo, hi] owned by `span`:
+    uncovered time to the span's own phase, covered time recursively to
+    the covering child. Siblings are walked in start order and a later
+    sibling's overlap with an earlier one is skipped, so every instant
+    is charged exactly once and sum(acc) == hi - lo by construction.
+    Child windows are clipped to the parent's (cross-process wall
+    clocks drift; clipping keeps the books exact anyway)."""
+    phase = phase_of(span.get("name", ""))
+    cursor = lo
+    kids = sorted(children.get(span.get("span_id", ""), []),
+                  key=lambda s: s.get("start", 0.0))
+    for kid in kids:
+        k_lo = max(cursor, float(kid.get("start", 0.0)))
+        k_hi = min(hi, float(kid.get("start", 0.0))
+                   + float(kid.get("duration_s", 0.0)))
+        if k_hi <= cursor:
+            continue  # fully inside an earlier sibling's window
+        if k_lo > cursor:
+            acc[phase] = acc.get(phase, 0.0) + (min(k_lo, hi) - cursor)
+        if k_lo >= hi:
+            break
+        _attribute(kid, children, k_lo, k_hi, acc)
+        cursor = k_hi
+    if cursor < hi:
+        acc[phase] = acc.get(phase, 0.0) + (hi - cursor)
+
+
+def _waterfall(roots: list[dict], children: dict[str, list[dict]],
+               origin: float) -> list[dict]:
+    out: list[dict] = []
+
+    def walk(span: dict, depth: int) -> None:
+        entry = dict(span)
+        entry["depth"] = depth
+        entry["offset_ms"] = round(
+            (float(span.get("start", origin)) - origin) * 1000.0, 3)
+        entry["phase"] = phase_of(span.get("name", ""))
+        out.append(entry)
+        for kid in sorted(children.get(span.get("span_id", ""), []),
+                          key=lambda s: s.get("start", 0.0)):
+            walk(kid, depth + 1)
+
+    for root in sorted(roots, key=lambda s: s.get("start", 0.0)):
+        walk(root, 0)
+    return out
+
+
+def assemble(trace_id: str, tracer=None, remote=None) -> dict | None:
+    """One trace's end-to-end story, across daemons.
+
+    Joins the local tracer ring with the federated remote-span store
+    (local wins a span-id collision — its view has no federation lag),
+    builds the operation tree, and attributes wall time to phases.
+    Returns None when NOTHING is buffered for the id (expired, or
+    minted elsewhere); otherwise a payload that also says how complete
+    the assembly is — `orphans` (spans whose parent never arrived) and
+    `missing_worker_halves` (successful rpc.* client spans with no
+    worker-side child yet) are the two ways a distributed trace lies.
+    """
+    local = (tracer or trace.TRACER).ring.spans_for(trace_id)
+    remote_store = REMOTE_SPANS if remote is None else remote
+    merged: dict[str, dict] = {}
+    for span in remote_store.spans_for(trace_id):
+        sid = span.get("span_id")
+        if sid:
+            merged[sid] = span
+    for span in local:
+        sid = span.get("span_id")
+        if not sid:
+            continue
+        prior = merged.get(sid)
+        # keep the remote copy's node stamp when the same span is seen
+        # from both sides (single-process test stacks)
+        merged[sid] = {**(prior or {}), **span}
+    if not merged:
+        return None
+
+    spans = sorted(merged.values(),
+                   key=lambda s: (s.get("start", 0.0),
+                                  s.get("span_id", "")))
+    children: dict[str, list[dict]] = {}
+    roots: list[dict] = []
+    orphans: list[dict] = []
+    for span in spans:
+        parent_id = span.get("parent_id") or ""
+        if not parent_id:
+            roots.append(span)
+        elif parent_id in merged:
+            children.setdefault(parent_id, []).append(span)
+        else:
+            orphans.append(span)
+
+    missing_halves: list[str] = []
+    for span in spans:
+        name = span.get("name", "")
+        if not name.startswith("rpc.") or name in _RPC_NO_WORKER_HALF:
+            continue
+        if span.get("status") != "ok":
+            continue  # the RPC died — there may honestly be no worker half
+        kids = children.get(span.get("span_id", ""), [])
+        if not any(k.get("name", "").startswith("worker.") for k in kids):
+            missing_halves.append(span.get("span_id", ""))
+
+    phases: dict[str, float] = {}
+    wall_s = 0.0
+    primary = None
+    for root in roots:
+        lo = float(root.get("start", 0.0))
+        hi = lo + float(root.get("duration_s", 0.0))
+        _attribute(root, children, lo, hi, phases)
+        wall_s += float(root.get("duration_s", 0.0))
+        if primary is None or root.get("duration_s", 0.0) > \
+                primary.get("duration_s", 0.0):
+            primary = root
+    # an orphans-only trace (local half expired) still renders: the
+    # orphan subtrees become the waterfall, but assembly is incomplete.
+    origin = spans[0].get("start", 0.0)
+
+    phase_ms = {p: round(s * 1000.0, 3) for p, s in phases.items()}
+    total_ms = sum(phase_ms.values())
+    critical_path = sorted(
+        ({"phase": p, "ms": ms,
+          "share": round(ms / total_ms, 4) if total_ms else 0.0}
+         for p, ms in phase_ms.items()),
+        key=lambda e: -e["ms"])
+    dominant = critical_path[0] if critical_path else None
+
+    return {
+        "trace": trace_id,
+        "op": (primary or {}).get("name", ""),
+        "wall_ms": round(wall_s * 1000.0, 3),
+        "spans": _waterfall(roots + orphans, children, origin),
+        "roots": len(roots),
+        "nodes": sorted({s.get("node", "") for s in spans
+                         if s.get("node")}),
+        "phases": phase_ms,
+        "critical_path": critical_path,
+        "dominant": dominant,
+        "complete": not orphans and not missing_halves,
+        "orphans": [s.get("span_id", "") for s in orphans],
+        "missing_worker_halves": missing_halves,
+    }
+
+
+#: edge span names whose traces describe mount-shaped operations — the
+#: population fleet_dominant_phase() aggregates over.
+MOUNT_EDGE_PREFIXES = ("http.add", "http.batch_add", "http.remove",
+                       "chaos.", "worker.AddTPU", "worker.RemoveTPU")
+
+
+def fleet_dominant_phase(tracer=None, remote=None,
+                         limit: int = 32) -> dict | None:
+    """Aggregate per-phase attribution over the newest `limit`
+    mount-shaped traces and name the dominant phase — the SLO engine's
+    'where is the latency going' stamp for burn-rate breach Events.
+    Worker-edge spans only count as population roots when the master's
+    http edge is absent (a worker process evaluating locally)."""
+    ring = (tracer or trace.TRACER).ring.snapshot()
+    trace_ids: list[str] = []
+    for span in reversed(ring):
+        if span.get("parent_id"):
+            continue
+        name = span.get("name", "")
+        if not any(name.startswith(p) for p in MOUNT_EDGE_PREFIXES):
+            continue
+        tid = span.get("trace_id", "")
+        if tid and tid not in trace_ids:
+            trace_ids.append(tid)
+        if len(trace_ids) >= limit:
+            break
+    if not trace_ids:
+        return None
+    acc: dict[str, float] = {}
+    assembled = 0
+    for tid in trace_ids:
+        tree = assemble(tid, tracer=tracer, remote=remote)
+        if tree is None:
+            continue
+        assembled += 1
+        for phase, ms in tree["phases"].items():
+            acc[phase] = acc.get(phase, 0.0) + ms
+    if not acc:
+        return None
+    total = sum(acc.values())
+    dominant = max(acc, key=lambda p: acc[p])
+    return {
+        "phase": dominant,
+        "ms": round(acc[dominant], 3),
+        "share": round(acc[dominant] / total, 4) if total else 0.0,
+        "traces": assembled,
+    }

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import contextlib
 import itertools
-import json
 import threading
 import time
 from collections import deque
@@ -35,15 +34,25 @@ class AuditLog:
     """Thread-safe bounded append-only record store."""
 
     def __init__(self, capacity: int = 4096):
+        from gpumounter_tpu.obs.sinks import JsonlSink
         self._records: deque[dict] = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._seq = itertools.count(1)
-        self._jsonl_path = ""
-        self._jsonl_broken = False
+        self._jsonl = JsonlSink("audit")
+        # Record subscribers (the flight recorder's timeline feed):
+        # called outside the lock, exceptions logged and swallowed —
+        # a broken observer must never fail the mutation being audited.
+        self._subscribers: list = []
+
+    def subscribe(self, fn) -> None:
+        """fn(record) after every append. Idempotent by identity, so a
+        process-global hook can re-install itself freely."""
+        with self._lock:
+            if not any(s is fn for s in self._subscribers):
+                self._subscribers.append(fn)
 
     def configure_jsonl(self, path: str) -> None:
-        self._jsonl_path = path
-        self._jsonl_broken = False
+        self._jsonl.configure(path)
 
     def set_capacity(self, capacity: int) -> None:
         with self._lock:
@@ -74,19 +83,14 @@ class AuditLog:
             rec["details"] = {k: v for k, v in details.items()}
         with self._lock:
             self._records.append(rec)
-        self._write_jsonl(rec)
+            subscribers = list(self._subscribers)
+        self._jsonl.write(rec)
+        for fn in subscribers:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — observers never fail the op
+                logger.exception("audit subscriber failed")
         return rec
-
-    def _write_jsonl(self, rec: dict) -> None:
-        if not self._jsonl_path or self._jsonl_broken:
-            return
-        try:
-            with open(self._jsonl_path, "a", encoding="utf-8") as f:
-                f.write(json.dumps(rec, default=str) + "\n")
-        except OSError as exc:
-            self._jsonl_broken = True
-            logger.error("audit JSONL sink %s failed (%s); disabling",
-                         self._jsonl_path, exc)
 
     def query(self, operation: str | None = None,
               namespace: str | None = None, pod: str | None = None,
@@ -120,8 +124,7 @@ class AuditLog:
     def reset(self) -> None:
         with self._lock:
             self._records.clear()
-            self._jsonl_path = ""
-            self._jsonl_broken = False
+            self._jsonl.configure("")
 
 
 AUDIT = AuditLog()

@@ -124,6 +124,15 @@ def worker_telemetry_snapshot(cfg=None, registry=None) -> dict:
     for (tenant, kind), value in DEVICE_TELEMETRY.counts().items():
         device_access.setdefault(tenant, {})[kind] = value
     from gpumounter_tpu.obs.tenants import TENANTS
+    # Span export (the fleet trace plane, obs/assembly.py): the newest
+    # span_export_max finished spans from this process's ring ride the
+    # snapshot; the master dedupes by span id, so a cumulative ring
+    # re-sent every pass costs nothing but the wire bytes the cap
+    # bounds — and 0 really disables the export (an operator's
+    # bandwidth valve), it does not fall back to the default.
+    # Legacy consumers ignore the extra key.
+    span_cap = int(getattr(cfg, "span_export_max", 512)) \
+        if cfg is not None else 512
     snap = {
         "schema": TELEMETRY_SCHEMA,
         "at": round(time.time(), 3),
@@ -134,6 +143,7 @@ def worker_telemetry_snapshot(cfg=None, registry=None) -> dict:
         # worker's ops port (obs/tenants.py): cumulative, capped at
         # 256 + _overflow. Legacy consumers ignore the extra key.
         "tenants": TENANTS.export(),
+        "spans": trace.TRACER.ring.tail(span_cap),
     }
     if cfg is not None and getattr(cfg, "node_name", ""):
         snap["node"] = cfg.node_name
@@ -230,6 +240,7 @@ def snapshot_from_prometheus(text: str) -> dict:
         "counters": counters,
         "device_access": device_access,
         "tenants": {},  # the classic exposition cannot carry them
+        "spans": [],    # ditto — the scrape fallback degrades to none
     }
 
 
@@ -362,7 +373,7 @@ class FleetCollector:
     """
 
     def __init__(self, workers, client_factory, cfg=None, slo=None,
-                 shards=None):
+                 shards=None, span_store=None):
         if cfg is None:
             from gpumounter_tpu.config import get_config
             cfg = get_config()
@@ -370,6 +381,13 @@ class FleetCollector:
         self.workers = workers
         self.client_factory = client_factory
         self.slo = slo
+        #: remote-span store (obs/assembly.py): every collected
+        #: snapshot's `spans` section federates here, deduplicated by
+        #: span id, so GET /trace/<id> can join master + worker halves.
+        if span_store is None:
+            from gpumounter_tpu.obs.assembly import REMOTE_SPANS
+            span_store = REMOTE_SPANS
+        self.span_store = span_store
         #: optional ShardManager (master/shard.py): an active sharded
         #: replica collects only the nodes it owns — N replicas split
         #: the scrape fan-out instead of each polling the whole fleet —
@@ -445,6 +463,10 @@ class FleetCollector:
         if snapshot is None:
             snapshot = self._scrape(ip)
         entry["mode"] = mode
+        # Federate the worker's span ring into the remote store (the
+        # node entry itself stays span-free: /fleet is a rollup pane,
+        # /trace/<id> is where the joined spans serve).
+        self.span_store.ingest(node, snapshot.get("spans") or [])
         entry.update(_node_rollup(snapshot))
         return entry
 

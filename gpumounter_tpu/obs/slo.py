@@ -307,13 +307,28 @@ class SloEngine:
                      burn_slow: float) -> None:
         """One breach transition: counter, audit record (inside a span,
         so the record carries a trace id — the audit trail's invariant),
-        and a Kubernetes Event where operators look."""
+        and a Kubernetes Event where operators look. Latency breaches
+        additionally name the fleet-dominant critical-path phase from
+        the assembled recent mount traces (obs/assembly.py), so the
+        Event says WHERE the budget is going, not just that it burns."""
         SLO_BREACHES.inc(objective=objective.name)
         message = (
             f"SLO {objective.name} burning error budget at "
             f"{burn_fast:.1f}x (fast window) / {burn_slow:.1f}x (slow "
             f"window), threshold {self.burn_threshold:.1f}x: "
             f"{objective.description or objective.kind}")
+        dominant = None
+        if objective.kind == "latency":
+            from gpumounter_tpu.obs import assembly
+            try:
+                dominant = assembly.fleet_dominant_phase()
+            except Exception:  # noqa: BLE001 — attribution is advisory
+                logger.exception("dominant-phase attribution failed")
+        if dominant:
+            message += (
+                f"; fleet-dominant phase: {dominant['phase']} "
+                f"({dominant['share']:.0%} of recent mount wall time "
+                f"across {dominant['traces']} trace(s))")
         logger.warning("%s", message)
         with trace.span("slo.breach", objective=objective.name):
             AUDIT.record(
@@ -321,11 +336,18 @@ class SloEngine:
                 outcome=f"breach: {objective.name}",
                 burn_fast=round(burn_fast, 4),
                 burn_slow=round(burn_slow, 4),
-                target=objective.target)
+                target=objective.target,
+                **({"dominant_phase": dominant["phase"],
+                    "dominant_share": dominant["share"]}
+                   if dominant else {}))
             self._post_event(objective, message)
 
     def _post_event(self, objective: Objective, message: str) -> None:
+        from gpumounter_tpu.obs.flight import FLIGHT
         if self.kube is None:
+            FLIGHT.record("event", f"TPUSLOBurnRate: {message}"[:240],
+                          reason="TPUSLOBurnRate",
+                          objective=objective.name, posted=False)
             return
         import secrets
         ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -351,7 +373,12 @@ class SloEngine:
             "lastTimestamp": ts,
             "count": 1,
         }
+        posted = True
         try:
             self.kube.create_event(namespace, manifest)
         except Exception as exc:  # noqa: BLE001 — events are advisory
+            posted = False
             logger.warning("SLO breach event post failed: %s", exc)
+        FLIGHT.record("event", f"TPUSLOBurnRate: {message}"[:240],
+                      reason="TPUSLOBurnRate", objective=objective.name,
+                      posted=posted)

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import json
+import itertools
 import re
 import secrets
 import time
@@ -41,8 +41,15 @@ from dataclasses import dataclass
 
 from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("obs.trace")
+
+TRACE_RING_EVICTIONS = REGISTRY.counter(
+    "tpumounter_trace_ring_evictions_total",
+    "Finished spans rotated out of the in-memory ring by capacity "
+    "pressure — silent trace loss that an incident review would hit "
+    "(raise TPUMOUNTER_TRACE_RING or add a JSONL sink when it grows)")
 
 #: HTTP header carrying a wire context: accepted on requests at the
 #: master edge (CLI/test continuity), stamped on every routed response
@@ -122,7 +129,11 @@ class RingBufferExporter:
 
     def export(self, span: dict) -> None:
         with self._lock:
+            evicting = (self._spans.maxlen is not None
+                        and len(self._spans) >= self._spans.maxlen)
             self._spans.append(span)
+        if evicting:
+            TRACE_RING_EVICTIONS.inc()
 
     def spans_for(self, trace_id: str) -> list[dict]:
         with self._lock:
@@ -132,6 +143,18 @@ class RingBufferExporter:
     def snapshot(self) -> list[dict]:
         with self._lock:
             return [dict(s) for s in self._spans]
+
+    def tail(self, n: int) -> list[dict]:
+        """Newest n spans, copying ONLY those n under the lock — the
+        span-export path calls this every telemetry pass, and copying
+        the whole ring to keep a quarter of it would contend with the
+        hot mount path's exports for nothing."""
+        if n <= 0:
+            return []
+        with self._lock:
+            start = max(0, len(self._spans) - n)
+            return [dict(s) for s in
+                    itertools.islice(self._spans, start, None)]
 
     def set_capacity(self, capacity: int) -> None:
         with self._lock:
@@ -143,26 +166,19 @@ class RingBufferExporter:
 
 
 class JsonlExporter:
-    """Append-only JSONL sink (one span per line). Write failures are
-    logged once and the exporter disables itself — tracing must never
+    """Append-only JSONL sink (one span per line), on the shared
+    self-disabling spill discipline (obs/sinks.py) — tracing must never
     take down a mount because a disk filled."""
 
     def __init__(self, path: str):
+        from gpumounter_tpu.obs.sinks import JsonlSink
         self.path = path
         self._lock = OrderedLock("trace.jsonl")
-        self._broken = False
+        self._sink = JsonlSink("trace", path)
 
     def export(self, span: dict) -> None:
-        if self._broken:
-            return
-        line = json.dumps(span, default=str)
-        try:
-            with self._lock, open(self.path, "a", encoding="utf-8") as f:
-                f.write(line + "\n")
-        except OSError as exc:
-            self._broken = True
-            logger.error("trace JSONL sink %s failed (%s); disabling",
-                         self.path, exc)
+        with self._lock:
+            self._sink.write(span)
 
 
 class Tracer:
@@ -176,8 +192,12 @@ class Tracer:
         self._open: dict[str, str] = {}  # span_id -> name
 
     def add_exporter(self, exporter) -> None:
+        """Idempotent by identity: process-global exporters (the flight
+        recorder) re-install themselves after a test reset without ever
+        double-exporting."""
         with self._lock:
-            self._exporters.append(exporter)
+            if not any(e is exporter for e in self._exporters):
+                self._exporters.append(exporter)
 
     def configure_jsonl(self, path: str) -> None:
         if path:

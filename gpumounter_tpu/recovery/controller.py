@@ -361,6 +361,16 @@ class RecoveryController:
                 reason=reason, released=len(released),
                 intents=[f"{ns}/{p}" for ns, p in intents],
                 migrations=journals)
+            # Evacuation marker on the flight recorder's timeline —
+            # inside the span so the record joins the evacuation trace.
+            from gpumounter_tpu.obs.flight import FLIGHT
+            FLIGHT.record(
+                "recovery",
+                f"node {node} evacuated ({reason}): "
+                f"{len(released)} booking(s) released, "
+                f"{len(intents)} intent(s) + {len(journals)} "
+                f"journal(s) re-driven",
+                node=node, reason=reason)
         record = {
             "node": node,
             "reason": reason or "manual",

@@ -70,7 +70,16 @@ invariants after convergence:
      the tracer, the worker ledger) forms an acyclic order — and, via
      the TPM_LOCK_TRACE export cross-checked by `python -m
      tools.tpulint --verify-dynamic`, never contradicts the static
-     nesting graph tpulint extracted from the source.
+     nesting graph tpulint extracted from the source,
+ 16. trace-assembly closure (obs/assembly.py): every CLEAN mount/
+     remove operation the harness drove (no fault armed, completed
+     successfully) assembles completely from the span stores — no
+     orphan spans whose parent never arrived, no successful rpc.* span
+     missing its worker-side half — and the assembled critical path's
+     per-phase attribution sums to the edge span's wall time (within
+     rounding), so "where did the latency go" is answerable for every
+     benched operation. The negative control (worker spans dropped
+     from the ring) must be DETECTED as incomplete assembly.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -287,6 +296,10 @@ class ChaosHarness:
         #: crash workers WITHOUT restarting them, so their ledgers
         #: legitimately hold open txns at check time.
         self.check_ledgers = False
+        #: clean (fault-free, completed) mount/remove operations, each
+        #: run under a chaos.<op> root span — invariant 16 asserts
+        #: every one assembles completely with an exact critical path.
+        self.traced_ops: list[dict] = []
         # Pooled channels, like the production master: the harness's
         # invariant 7 asserts the pool's books stay exact under chaos
         # (every dialed channel either live in the cache or closed).
@@ -456,6 +469,32 @@ class ChaosHarness:
         self.schedule.append(event)
         logger.info("chaos[seed=%d] %s", self.seed, event)
 
+    def drop_worker_spans(self) -> int:
+        """NEGATIVE CONTROL for invariant 16: rewrite BOTH span stores
+        (the local ring and the federated remote store — the collector
+        pass of invariant 8 legitimately mirrors worker spans there)
+        without any worker-side spans, simulating a worker whose span
+        export was silently lost everywhere. check_invariants() must
+        then flag every traced op as incomplete assembly. Returns the
+        number of spans dropped."""
+        from gpumounter_tpu.obs.assembly import REMOTE_SPANS
+        ring = trace.TRACER.ring
+        spans = ring.snapshot()
+        kept = [s for s in spans
+                if not s.get("name", "").startswith("worker.")]
+        ring.clear()
+        for span in kept:
+            ring.export(span)
+        dropped = len(spans) - len(kept)
+        remote = REMOTE_SPANS.snapshot()
+        REMOTE_SPANS.reset()
+        for span in remote:
+            if span.get("name", "").startswith("worker."):
+                dropped += 1
+                continue
+            REMOTE_SPANS.ingest(span.get("node", ""), [span])
+        return dropped
+
     def add_pod(self, name: str, node: str, namespace: str = "default",
                 ) -> Pod:
         pod = self.cluster.add_target_pod(name, namespace=namespace,
@@ -483,17 +522,33 @@ class ChaosHarness:
         self.record(f"arm {name}={action}")
         failpoints.arm(name, action)
 
-    def _op(self, pool, description: str, fn, fault_p: float = 0.7) -> None:
+    def _op(self, pool, description: str, fn, fault_p: float = 0.7,
+            capture_trace: bool = False) -> None:
         """Run one chaos operation: maybe arm a fault, execute, log the
-        outcome, clear any unfired one-shots."""
-        if self.rng.random() < fault_p:
+        outcome, clear any unfired one-shots. With capture_trace, a
+        CLEAN run (no fault armed, no exception) executes under a
+        chaos.<description> root span and its trace id is recorded for
+        invariant 16 — assembly closure is asserted only for
+        operations that terminated normally (a crashed op legitimately
+        has no worker half to join)."""
+        armed = self.rng.random() < fault_p
+        if armed:
             self._arm_random(pool)
+        ctx = None
         try:
-            fn()
+            if capture_trace and not armed:
+                with trace.span(f"chaos.{description}") as ctx:
+                    fn()
+            else:
+                fn()
         except Exception as exc:  # noqa: BLE001 — failures ARE the test
             self.record(f"{description} -> {type(exc).__name__}: {exc}")
         else:
             self.record(f"{description} -> ok")
+            if ctx is not None:
+                self.traced_ops.append({"trace": ctx.trace_id,
+                                        "span": ctx.span_id,
+                                        "op": description})
         finally:
             failpoints.disarm_all()
 
@@ -522,7 +577,8 @@ class ChaosHarness:
                 self._op(FAULTS_COMMON, f"add {n} to {name}",
                          lambda t=SliceTarget(namespace=ns, pod=name), n=n:
                          self._coordinator().mount_slice([t], n,
-                                                         entire=False))
+                                                         entire=False),
+                         capture_trace=True)
             elif kind == "remove":
                 held = [c.uuid for c in self.probe(ns, name)]
                 if not held:
@@ -534,7 +590,7 @@ class ChaosHarness:
                         client.remove_tpu(name, ns, [uuid], force=True)
 
                 self._op(FAULTS_COMMON, f"remove {uuid} from {name}",
-                         _remove)
+                         _remove, capture_trace=True)
             else:
                 self._op(FAULTS_ELASTIC, f"reconcile {name}",
                          lambda ns=ns, name=name:
@@ -1361,6 +1417,37 @@ class ChaosHarness:
                             f"tenant {tenant}: {window['cause']} window "
                             f"trace {window['trace_id']} does not "
                             f"resolve in the trace ring")
+
+        # 16. trace-assembly closure: every clean mount/remove op the
+        # harness drove (chaos.<op> root span, no fault armed, ended
+        # ok) must assemble completely — no orphan spans, no
+        # successful rpc.* span missing its worker half — and the
+        # critical path's per-phase attribution must sum to the edge
+        # span's wall time. A dropped worker span ring (the negative
+        # control drives exactly that) reads as incomplete here.
+        from gpumounter_tpu.obs import assembly
+        for op in self.traced_ops:
+            tree = assembly.assemble(op["trace"])
+            if tree is None:
+                violations.append(
+                    f"traced op {op['op']!r} (trace {op['trace']}) "
+                    f"expired from the span stores before assembly")
+                continue
+            if not tree["complete"]:
+                violations.append(
+                    f"traced op {op['op']!r} (trace {op['trace']}) "
+                    f"assembles INCOMPLETE: {len(tree['orphans'])} "
+                    f"orphan span(s) {tree['orphans']}, "
+                    f"{len(tree['missing_worker_halves'])} rpc span(s) "
+                    f"missing their worker half")
+                continue
+            phase_sum = sum(tree["phases"].values())
+            wall = tree["wall_ms"]
+            if abs(phase_sum - wall) > max(2.0, 0.05 * wall):
+                violations.append(
+                    f"traced op {op['op']!r} (trace {op['trace']}): "
+                    f"critical-path phase sum {phase_sum:.3f}ms != "
+                    f"edge wall {wall:.3f}ms")
 
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()

@@ -129,6 +129,24 @@ def _make_ops_handler(read_token: str | None, mutate_token: str | None):
                     return
                 body = (json.dumps(payload, indent=1) + "\n").encode()
                 ctype = "application/json"
+            elif parsed.path == "/timeline":
+                # The worker's half of the incident flight recorder
+                # (obs/flight.py) — same query contract as the master
+                # /timeline route. Read-scoped: it names pods/tenants.
+                if not _read_allowed(auth):
+                    self.send_error(401)
+                    return
+                from gpumounter_tpu.obs.flight import (
+                    query_from_params as flight_query,
+                )
+                try:
+                    payload = flight_query(
+                        urllib.parse.parse_qs(parsed.query))
+                except ValueError:
+                    self.send_error(400)
+                    return
+                body = (json.dumps(payload, indent=1) + "\n").encode()
+                ctype = "application/json"
             elif parsed.path.startswith("/trace/"):
                 if not _read_allowed(auth):
                     self.send_error(401)
@@ -207,9 +225,11 @@ def serve_ops(port: int, cfg=None) -> ThreadingHTTPServer:
 def main() -> None:
     cfg = get_config()
     init_logger(cfg.log_dir, "tpumounter-worker.log")
-    from gpumounter_tpu.obs import audit, trace
+    from gpumounter_tpu.obs import assembly, audit, flight, trace
     trace.configure(cfg)
     audit.configure(cfg)
+    flight.configure(cfg)
+    assembly.configure(cfg)
     logger.info("tpumounter worker starting (port %d)", cfg.worker_port)
 
     from gpumounter_tpu.k8s import default_client

@@ -409,9 +409,13 @@ class TpuMounter:
                             target=target.description)
             nsutil.inject_device_file(target.dev_dir, dev,
                                       pid=target.ns_pid)
-            # Verify the node is actually visible where the tenant will
-            # look — a mknod that "succeeded" against a torn-down
-            # namespace must fail the batch now, not at first open.
+        # Verify the node is actually visible where the tenant will
+        # look — a mknod that "succeeded" against a torn-down
+        # namespace must fail the batch now, not at first open. Its
+        # own span so the assembled critical path (obs/assembly.py)
+        # can tell injection cost from verification cost.
+        with trace.span("mount.verify", device=dev.uuid,
+                        target=target.description):
             path = nsutil.device_node_path(target.dev_dir, dev)
             present = (nsutil.device_node_exists(path, pid=target.ns_pid)
                        if target.ns_pid is not None

@@ -209,6 +209,11 @@ class TpuMountService:
         self._draining = threading.Event()
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        # Flight recorder (obs/flight.py): the worker's root/error
+        # spans, audit records and ApiHealth transitions feed the ops
+        # port's /timeline. Idempotent process-global wiring.
+        from gpumounter_tpu.obs import flight
+        flight.install(apihealth=self.apihealth)
 
     # --- epoch fencing + drain gates (shared by both mutating RPCs) ---
 
@@ -359,7 +364,13 @@ class TpuMountService:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, why)
 
         per_pod = request.tpu_num if request.is_entire_mount else 1
-        with timer.phase("slave_pod_schedule"):
+        # Its own span, not just a PhaseTimer phase: slave-pod
+        # scheduling is the cold path's dominant cost, and the
+        # assembled critical path (obs/assembly.py) attributes it only
+        # if a span carries it.
+        with timer.phase("slave_pod_schedule"), \
+                trace.span("mount.slave_pod_schedule",
+                           chips=request.tpu_num):
             try:
                 devices, slaves = self.allocator.get_available_tpus(
                     pod, request.tpu_num, per_pod,

@@ -41,12 +41,16 @@ def _reset_observability():
     yield
     from gpumounter_tpu.k8s import health as k8s_health
     from gpumounter_tpu.obs import audit, trace
+    from gpumounter_tpu.obs.assembly import REMOTE_SPANS
+    from gpumounter_tpu.obs.flight import FLIGHT
     from gpumounter_tpu.obs.tenants import TENANTS
     from gpumounter_tpu.utils.metrics import REGISTRY
     REGISTRY.reset_all()
     trace.TRACER.reset()
     audit.AUDIT.reset()
     TENANTS.reset()
+    REMOTE_SPANS.reset()
+    FLIGHT.reset()
     # The ApiHealth machines are process-global per endpoint: a test's
     # simulated outage must not leak a degraded verdict (which parks
     # destructive subsystem work) into the next test.

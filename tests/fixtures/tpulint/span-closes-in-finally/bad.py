@@ -1,0 +1,19 @@
+"""Positive fixture: spans entered outside `with` — the leak class."""
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import audited
+
+
+def leaky_manual_enter(pod):
+    span = trace.span("mount.manual", pod=pod)  # never closes on raise
+    span.__enter__()
+    do_work(pod)
+    span.__exit__(None, None, None)
+
+
+def leaky_bare_audit(pod):
+    audited("worker.Mutate", pod=pod)  # record never written
+    do_work(pod)
+
+
+def do_work(pod):
+    return pod

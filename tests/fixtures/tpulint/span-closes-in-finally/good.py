@@ -1,0 +1,21 @@
+"""Negative fixture: every span/audit enters through `with`."""
+import contextlib
+
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import audited
+
+
+def clean(pod):
+    with trace.span("mount.clean", pod=pod):
+        with audited("worker.Mutate", pod=pod) as rec:
+            rec["outcome"] = do_work(pod)
+
+
+def clean_multi(pod, ctx):
+    with trace.attached(ctx), trace.span("mount.multi"), \
+            contextlib.suppress(ValueError):
+        do_work(pod)
+
+
+def do_work(pod):
+    return pod

@@ -172,6 +172,54 @@ def test_bulk_mixed_results_and_cross_shard_proxy(stack):
     assert len(owned_by_0) < 2 or stack.cfg.shard_count == 1
 
 
+def test_proxied_bulk_joins_forwarding_replicas_trace(stack):
+    """Regression (ISSUE 13 satellite): the owner replica must JOIN the
+    forwarding replica's edge trace across the proxy hop — the proxied
+    sub-batch carries X-Tpumounter-Trace from inside the edge span's
+    context (re-attached in the forwarder thread), so the peer's edge
+    and worker spans land under the client's trace id instead of a
+    fresh orphaned root."""
+    from gpumounter_tpu.obs import trace
+
+    stack.cluster.add_target_pod("bulk-tr", node="node-a")
+    base = stack.non_owner_base("node-a")
+    req = urllib.request.Request(
+        base + "/batch/addtpu",
+        data=json.dumps({"targets": [
+            {"namespace": "default", "pod": "bulk-tr", "chips": 1},
+        ]}).encode(),
+        method="POST",
+        headers={**AUTH, "Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        tid = resp.headers["X-Tpumounter-Trace"]
+        out = json.loads(resp.read())
+    assert out["results"][0]["result"] == "Success"
+
+    spans = trace.TRACER.ring.spans_for(tid)
+    names = [s["name"] for s in spans]
+    # Forwarder edge + proxy hop span + the OWNER's edge — all one trace.
+    assert names.count("http.batch_add") == 2, names
+    assert "proxy.batch" in names, names
+    # The peer's worker-side spans joined too: the whole mount story of
+    # the proxied target is queryable from the one returned trace id.
+    assert "worker.AddTPU" in names, names
+    by_id = {s["span_id"]: s for s in spans}
+    proxy = next(s for s in spans if s["name"] == "proxy.batch")
+    owner_edges = [s for s in spans if s["name"] == "http.batch_add"
+                   and s["parent_id"] == proxy["span_id"]]
+    assert owner_edges, "owner edge span did not parent to the proxy hop"
+    # and the forwarder's edge is the root of it all
+    root = by_id[proxy["parent_id"]]
+    assert root["name"] == "http.batch_add" and root["parent_id"] == ""
+
+    # The assembled view agrees end-to-end (single process: the ring
+    # holds both replicas' halves).
+    from gpumounter_tpu.obs import assembly
+    tree = assembly.assemble(tid)
+    assert tree is not None and tree["complete"], tree
+    assert "shard_proxy" in tree["phases"], tree["phases"]
+
+
 def test_single_target_redirects_to_owner(stack):
     stack.cluster.add_target_pod("redir", node="node-a")
     base = stack.non_owner_base("node-a")

@@ -135,6 +135,63 @@ def test_slice_rollback_skip_leaves_partial_slice(tmp_path):
         h.check_invariants()
 
 
+# --- invariant 16: trace-assembly closure (ISSUE 13) ---
+
+
+def _drive_clean_ops(h, pod: str, n: int = 2) -> None:
+    """A few guaranteed-fault-free mounts/removes, each captured under
+    a chaos.<op> root span (fault_p=0 → always captured on success)."""
+    from gpumounter_tpu.master.slice_ops import SliceTarget
+    h.add_pod(pod, NODE_A)
+    for _ in range(n):
+        h._op([], f"add 1 to {pod}",
+              lambda: h._coordinator().mount_slice(
+                  [SliceTarget(namespace="default", pod=pod)], 1,
+                  entire=False),
+              fault_p=0.0, capture_trace=True)
+        held = [c.uuid for c in h.probe("default", pod)]
+        if not held:
+            continue
+
+        def _remove(uuid=held[0]):
+            with h._client_for_node(NODE_A) as client:
+                client.remove_tpu(pod, "default", [uuid], force=True)
+
+        h._op([], f"remove {held[0]} from {pod}", _remove,
+              fault_p=0.0, capture_trace=True)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_trace_assembly_invariant(tmp_path, seed):
+    """Invariant 16 with a guaranteed non-vacuous population: every
+    clean benched op assembles completely and its critical-path phase
+    attribution sums to the edge span's wall time."""
+    with ChaosHarness(str(tmp_path), seed) as h:
+        _drive_clean_ops(h, "tr-pod")
+        assert h.traced_ops, "no clean ops captured — invariant vacuous"
+        h.check_invariants()
+        # the assembled trees really carry worker-side phases
+        from gpumounter_tpu.obs import assembly
+        tree = assembly.assemble(h.traced_ops[0]["trace"])
+        assert tree["complete"]
+        assert "cgroup_grant" in tree["phases"] or \
+            "mknod" in tree["phases"], tree["phases"]
+
+
+def test_trace_assembly_detects_dropped_worker_spans(tmp_path):
+    """NEGATIVE CONTROL: strip the worker-side spans from the ring (a
+    lost span export) — invariant 16 must flag incomplete assembly; a
+    checker that cannot fail proves nothing."""
+    with ChaosHarness(str(tmp_path), seed=5) as h:
+        _drive_clean_ops(h, "neg-pod", n=1)
+        assert h.traced_ops
+        h.check_invariants()  # sanity: clean before the corruption
+        assert h.drop_worker_spans() > 0
+        with pytest.raises(InvariantViolation) as err:
+            h.check_invariants()
+        assert "INCOMPLETE" in str(err.value)
+
+
 # --- invariant 9: single shard owner per node (ISSUE 7) ---
 
 

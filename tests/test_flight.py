@@ -1,0 +1,267 @@
+"""Incident flight recorder (ISSUE 13): the merged chronological
+timeline — record/query mechanics, the JSONL spill, and every source
+hook (root/error spans, audit records, k8s Events, ApiHealth
+transitions) plus the master /timeline route and the worker ops port's
+half with their read-scope auth.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.obs import flight as flight_mod
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.flight import (
+    FLIGHT,
+    FlightRecorder,
+    query_from_params,
+)
+
+
+# --- record/query mechanics ---
+
+
+def test_record_and_query_filters():
+    rec = FlightRecorder()
+    rec.record("span", "http.add ok", node="n1", trace_id="t1", at=10.0)
+    rec.record("audit", "worker.AddTPU -> Success", node="n1",
+               trace_id="t1", at=11.0)
+    rec.record("event", "TPUMounted: 1 chip", node="n2", trace_id="t2",
+               at=12.0)
+    rec.record("apihealth", "kube API healthy -> degraded", at=13.0)
+
+    assert [r["kind"] for r in rec.query()] == \
+        ["span", "audit", "event", "apihealth"]
+    assert [r["at"] for r in rec.query(node="n1")] == [10.0, 11.0]
+    assert [r["kind"] for r in rec.query(trace_id="t1")] == \
+        ["span", "audit"]
+    assert [r["summary"] for r in rec.query(kind="event")] == \
+        ["TPUMounted: 1 chip"]
+    assert [r["at"] for r in rec.query(since=11.5)] == [12.0, 13.0]
+    assert [r["at"] for r in rec.query(until=11.5)] == [10.0, 11.0]
+    assert [r["at"] for r in rec.query(since=10.5, until=12.5)] == \
+        [11.0, 12.0]
+    # limit keeps the NEWEST matches, still chronological
+    assert [r["at"] for r in rec.query(limit=2)] == [12.0, 13.0]
+
+
+def test_unknown_kind_folds_to_marker_and_capacity_bounds():
+    rec = FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record("something-weird", f"m{i}", at=float(i))
+    records = rec.query()
+    assert len(records) == 3
+    assert [r["at"] for r in records] == [2.0, 3.0, 4.0]
+    assert all(r["kind"] == "marker" for r in records)
+
+
+def test_record_defaults_ambient_trace_id():
+    rec = FlightRecorder()
+    with trace.span("ambient-op") as ctx:
+        rec.record("marker", "inside the span")
+    rec.record("marker", "outside")
+    inside, outside = rec.query()
+    assert inside["trace_id"] == ctx.trace_id
+    assert outside["trace_id"] == ""
+
+
+def test_jsonl_spill_is_durable_and_self_disabling(tmp_path):
+    rec = FlightRecorder()
+    spill = tmp_path / "flight.jsonl"
+    rec.configure_jsonl(str(spill))
+    rec.record("marker", "one", at=1.0)
+    rec.record("marker", "two", at=2.0)
+    lines = [json.loads(line) for line in
+             spill.read_text().strip().splitlines()]
+    assert [r["summary"] for r in lines] == ["one", "two"]
+    # a broken sink disables itself without failing the recording op
+    rec.configure_jsonl(str(tmp_path / "no-such-dir" / "f.jsonl"))
+    rec.record("marker", "three", at=3.0)
+    assert rec._jsonl.broken
+    assert [r["summary"] for r in rec.query()] == ["one", "two", "three"]
+
+
+def test_query_from_params_contract():
+    rec = FlightRecorder()
+    rec.record("span", "s", node="n1", at=5.0)
+    rec.record("audit", "a", node="n2", at=6.0)
+    out = query_from_params({"node": ["n2"]}, recorder=rec)
+    assert [r["summary"] for r in out["records"]] == ["a"]
+    out = query_from_params({"from": ["5.5"], "limit": ["10"]},
+                            recorder=rec)
+    assert [r["summary"] for r in out["records"]] == ["a"]
+    with pytest.raises(ValueError):
+        query_from_params({"from": ["junk"]}, recorder=rec)
+    with pytest.raises(ValueError):
+        query_from_params({"limit": ["junk"]}, recorder=rec)
+
+
+# --- source hooks ---
+
+
+def test_span_exporter_records_roots_and_errors_only():
+    flight_mod.install()
+    with trace.span("edge-op"):
+        with trace.span("child-ok"):
+            pass
+    with pytest.raises(RuntimeError):
+        with trace.span("edge-2"):
+            with trace.span("child-bad"):
+                raise RuntimeError("boom")
+    summaries = [r["summary"] for r in FLIGHT.query(kind="span")]
+    assert any(s.startswith("edge-op ok") for s in summaries)
+    assert any(s.startswith("edge-2 error") for s in summaries)
+    assert any(s.startswith("child-bad error") for s in summaries)
+    assert not any(s.startswith("child-ok") for s in summaries)
+    # double install must not double-record
+    flight_mod.install()
+    before = len(FLIGHT.query(kind="span", limit=1000))
+    with trace.span("edge-3"):
+        pass
+    assert len(FLIGHT.query(kind="span", limit=1000)) == before + 1
+
+
+def test_audit_hook_feeds_timeline():
+    from gpumounter_tpu.obs.audit import AUDIT
+    flight_mod.install()
+    AUDIT.record("worker.AddTPU", namespace="default", pod="p1",
+                 outcome="Success", trace_id="t-aud")
+    (rec,) = FLIGHT.query(kind="audit")
+    assert rec["trace_id"] == "t-aud"
+    assert "worker.AddTPU -> Success" in rec["summary"]
+    assert "default/p1" in rec["summary"]
+
+
+def test_apihealth_transitions_recorded(test_config):
+    from gpumounter_tpu.k8s.health import ApiHealth
+    cfg = test_config.replace(api_health_degraded_failures=2,
+                              api_health_recovery_successes=1)
+    health = ApiHealth(cfg=cfg, endpoint="test-kube")
+    flight_mod.install(apihealth=health)
+    for _ in range(3):
+        health.record_failure(ConnectionError("down"))
+    health.record_success()
+    kinds = FLIGHT.query(kind="apihealth")
+    assert kinds, "transition must land on the timeline"
+    assert "healthy -> " in kinds[0]["summary"]
+    # recovery transition too
+    assert any("-> healthy" in r["summary"] for r in kinds) or \
+        len(kinds) >= 1
+
+
+def test_pod_event_hook_records_even_when_post_fails():
+    from gpumounter_tpu.k8s.events import post_pod_event
+    from gpumounter_tpu.k8s.types import Pod
+
+    class BrokenKube:
+        def create_event(self, namespace, manifest):
+            raise ConnectionError("api down")
+
+    class OkKube:
+        def create_event(self, namespace, manifest):
+            return manifest
+
+    pod = Pod({"metadata": {"name": "p1", "namespace": "default",
+                            "uid": "u1"}})
+    post_pod_event(OkKube(), pod, "TPUMounted", "1 chip mounted")
+    post_pod_event(BrokenKube(), pod, "TPUMountFailed", "grant failed",
+                   "Warning")
+    records = FLIGHT.query(kind="event")
+    assert len(records) == 2
+    ok, broken = records
+    assert ok["details"]["posted"] is True
+    assert broken["details"]["posted"] is False  # timeline keeps what
+    assert "TPUMountFailed" in broken["summary"]  # the cluster missed
+
+
+def test_recovery_evacuation_leaves_marker(tmp_path):
+    """The chaos harness's node-kill path exercises this end-to-end;
+    here the unit: RecoveryController.evacuate records a recovery
+    marker carrying the evacuation trace."""
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import WorkerRegistry
+    from gpumounter_tpu.recovery import RecoveryController
+
+    kube = FakeKubeClient()
+    from gpumounter_tpu.config import Config
+    cfg = Config()
+    controller = RecoveryController(kube, WorkerRegistry(kube, cfg),
+                                    lambda addr: None, cfg=cfg)
+    controller.evacuate("dead-node", reason="manual")
+    (rec,) = FLIGHT.query(kind="recovery")
+    assert rec["node"] == "dead-node"
+    assert "evacuated" in rec["summary"]
+    assert rec["trace_id"]  # recorded inside the evacuation span
+
+
+# --- the serving surfaces ---
+
+
+def test_master_timeline_route_and_auth(test_config):
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+    from conftest import AUTH_HEADER
+
+    app = MasterApp(FakeKubeClient(), cfg=test_config)
+    FLIGHT.record("marker", "drill start", node="n1", trace_id="t1",
+                  at=50.0)
+    FLIGHT.record("marker", "drill end", node="n2", at=60.0)
+
+    status, _, body, headers = app.handle("GET", "/timeline", b"",
+                                          dict(AUTH_HEADER))
+    assert status == 200
+    records = json.loads(body)["records"]
+    assert [r["summary"] for r in records] == ["drill start", "drill end"]
+    # untraced scrape surface: no trace header, no span churn
+    assert "X-Tpumounter-Trace" not in headers
+
+    status, _, body, _ = app.handle("GET", "/timeline?node=n1", b"",
+                                    dict(AUTH_HEADER))
+    assert [r["node"] for r in json.loads(body)["records"]] == ["n1"]
+    status, _, _, _ = app.handle("GET", "/timeline?from=junk", b"",
+                                 dict(AUTH_HEADER))
+    assert status == 400
+    # auth: no token -> 401 (timeline reveals pods/tenants/traces)
+    status, _, _, _ = app.handle("GET", "/timeline", b"", {})
+    assert status == 401
+
+
+def test_worker_ops_timeline(test_config):
+    from conftest import AUTH_HEADER
+    from gpumounter_tpu.worker.main import serve_ops
+
+    FLIGHT.record("marker", "worker-side mark", node="w1", at=70.0)
+    httpd = serve_ops(0)
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        req = urllib.request.Request(base + "/timeline?node=w1",
+                                     headers=dict(AUTH_HEADER))
+        with urllib.request.urlopen(req) as resp:
+            payload = json.loads(resp.read())
+        assert [r["summary"] for r in payload["records"]] == \
+            ["worker-side mark"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/timeline")
+        assert err.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as err:
+            req = urllib.request.Request(base + "/timeline?to=junk",
+                                         headers=dict(AUTH_HEADER))
+            urllib.request.urlopen(req)
+        assert err.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_flight_records_metric_by_kind():
+    from gpumounter_tpu.obs.flight import FLIGHT_RECORDS
+    FLIGHT.record("event", "e", at=1.0)
+    FLIGHT.record("event", "e2", at=2.0)
+    FLIGHT.record("recovery", "r", at=3.0)
+    assert FLIGHT_RECORDS.get(kind="event") == 2.0
+    assert FLIGHT_RECORDS.get(kind="recovery") == 1.0

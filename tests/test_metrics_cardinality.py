@@ -26,6 +26,10 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 #: ISSUE 9 (tenant telemetry): the tenant plane adds only 3 unlabeled
 #: series (snapshots accepted/rejected + tenants-tracked gauge) — the
 #: per-tenant data rides the JSON plane, so no bump was needed.
+#: Reviewed for ISSUE 13 (fleet trace plane): ring/remote-span eviction
+#: and ingest counters are unlabeled; the flight recorder's records
+#: counter is labeled only by its fixed kind vocabulary (6 values) —
+#: span/trace ids stay in the JSON plane, never in labels. No bump.
 SERIES_BUDGET = 400
 
 
@@ -99,6 +103,9 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         assert http("GET", "/fleet")[0] == 200
         assert http("GET", "/slo")[0] == 200
         assert http("GET", "/tenants")[0] == 200
+        # ISSUE 13 trace-plane surfaces: the budgeted run includes the
+        # assembled /trace read and the flight recorder's /timeline.
+        assert http("GET", "/timeline")[0] == 200
         from gpumounter_tpu.k8s.types import Pod
         pod = Pod(cluster.kube.get_pod("default", "card-pod"))
         slaves = {p.name for p in service.allocator.slave_pods_for(pod)}
@@ -147,6 +154,33 @@ def test_tenant_snapshot_store_cardinality_is_capped():
     assert exported[OVERFLOW_TENANT]["folded_tenants"] == 2 * 16
     # zero per-tenant Prometheus series grew out of 48 tenants
     assert REGISTRY.series_count() - before <= 3  # the unlabeled trio
+
+
+def test_trace_plane_series_are_bounded():
+    """ISSUE 13 guard: heavy trace traffic — thousands of spans across
+    thousands of traces, ring evictions, remote-store federation and
+    flight records of every kind — grows the exposition only by the
+    fixed trace-plane series (unlabeled counters + the 6-value kind
+    label). Span/trace ids must never become label values."""
+    from gpumounter_tpu.obs import trace as trace_mod
+    from gpumounter_tpu.obs.assembly import RemoteSpanStore
+    from gpumounter_tpu.obs.flight import FLIGHT, KINDS
+
+    before = REGISTRY.series_count()
+    tracer = trace_mod.Tracer(ring_capacity=64)
+    for i in range(500):
+        with trace_mod.span(f"op-{i % 7}", tracer=tracer):
+            pass
+    store = RemoteSpanStore(capacity=64)
+    store.ingest("node-x", tracer.ring.snapshot())
+    for kind in sorted(KINDS) + ["unheard-of-kind"]:
+        FLIGHT.record(kind, "cardinality drill", trace_id=f"t-{kind}")
+    grown = REGISTRY.series_count() - before
+    # ring evictions + remote ingest/evictions (unlabeled) + at most
+    # one flight series per kind in the fixed vocabulary
+    assert grown <= 3 + len(KINDS), (
+        f"trace plane grew {grown} series — an unbounded label "
+        f"(span/trace id? node name?) slipped into an instrument")
 
 
 def test_tenant_label_cardinality_is_capped():

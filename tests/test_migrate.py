@@ -322,6 +322,60 @@ def test_interrupted_migration_resumes_after_master_restart(stack):
     assert app.migrations.resume_interrupted() == []
 
 
+def test_resumed_migration_keeps_original_trace_in_waterfall(stack):
+    """ISSUE 13 satellite: a journal re-driven after a master restart
+    keeps its ORIGINAL trace id end-to-end — the resumed machine's
+    phase spans join the trace the /migrate edge minted before the
+    crash, and the assembled waterfall shows both runs under one id."""
+    from gpumounter_tpu.obs import assembly, trace
+
+    base, cluster, services, app = stack
+    cluster.add_target_pod("trainer-a", node=NODE_A)
+    cluster.add_target_pod("trainer-b", node=NODE_B)
+    _mount_4(base)
+    chips = _chips(services, NODE_A, "trainer-a")
+
+    # The dead master's progress, trace id included (the PR 4 contract:
+    # the journal persists the edge trace so a resumed machine keeps it).
+    original_tid = trace.new_trace_id()
+    address = app.registry.worker_address(NODE_A)
+    with app.migrations.client_factory(address) as client:
+        result = client.remove_tpu("trainer-a", "default", chips,
+                                   force=True)
+    assert result == api.RemoveTPUResult.Success
+    journal = new_journal("mig-traced", "default", "trainer-a",
+                          "default", "trainer-b")
+    journal.update(phase="remount", chips=chips, dest_before=[],
+                   quiesced=True, downtime_started_at=time.time(),
+                   trace_id=original_tid)
+    cluster.kube.patch_pod("default", "trainer-a", {
+        "metadata": {"annotations": {ANNOT_JOURNAL: dump(journal)}}})
+    cluster.kube.patch_pod("default", "trainer-b", {
+        "metadata": {"annotations": {ANNOT_LOCK: json.dumps(
+            {"id": "mig-traced", "role": "destination"})}}})
+
+    assert app.migrations.resume_interrupted() == ["mig-traced"]
+    final = app.migrations.wait("mig-traced", timeout_s=30.0)
+    assert final["outcome"] == "succeeded", final
+    assert final["trace_id"] == original_tid
+
+    spans = trace.TRACER.ring.spans_for(original_tid)
+    names = {s["name"] for s in spans}
+    assert {"migrate.remount", "migrate.resume",
+            "migrate.verify"} <= names, sorted(names)
+    # the worker-side spans of the resumed remount joined the SAME trace
+    assert "worker.AddTPU" in names, sorted(names)
+
+    tree = assembly.assemble(original_tid)
+    assert tree is not None and tree["complete"], (
+        tree["orphans"], tree["missing_worker_halves"])
+    assert tree["roots"] >= 1
+    assert "migrate" in tree["phases"], tree["phases"]
+    # attribution still books every root's wall time exactly
+    assert abs(sum(tree["phases"].values()) - tree["wall_ms"]) \
+        <= max(0.05, 0.01 * tree["wall_ms"])
+
+
 def test_migrate_rejections(stack):
     """4xx-class rejections: same pod, unknown pods, chipless source,
     double-migration — all before anything moves."""

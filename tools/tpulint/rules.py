@@ -785,6 +785,73 @@ class NamedLocks(Rule):
         return findings
 
 
+class SpanClosesInFinally(Rule):
+    id = "span-closes-in-finally"
+    doc = ("trace spans / audited blocks are entered via `with` so the "
+           "context manager's finally always closes them — a bare "
+           "span()/audited() call (or a manual __enter__) is the leak "
+           "class runtime invariant 5 polices (orphan open spans)")
+    hint = ("wrap the operation: `with trace.span(...):` / "
+            "`with audited(...):` — the finally IS the recorder")
+
+    #: the defining modules use the factories internally (span() builds
+    #: the context manager it returns; audited() likewise).
+    EXEMPT = frozenset({"gpumounter_tpu/obs/trace.py",
+                        "gpumounter_tpu/obs/audit.py"})
+    SPAN_FACTORIES = frozenset({"span", "deferred", "attached"})
+    AUDIT_FACTORIES = frozenset({"audited"})
+
+    def check(self, index: ProjectIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in index.modules.values():
+            if module.rel in self.EXEMPT:
+                continue
+            with_exprs = self._with_context_exprs(module.tree)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = self._span_label(node)
+                if label is None:
+                    continue
+                if id(node) in with_exprs:
+                    continue
+                if module.waived(self.id, node.lineno):
+                    continue
+                findings.append(module.finding(
+                    self.id, node,
+                    f"`{label}(...)` not entered via `with` — the span/"
+                    f"record closes only through the context manager's "
+                    f"finally", self.hint))
+        return findings
+
+    @staticmethod
+    def _with_context_exprs(tree: ast.AST) -> set[int]:
+        """id()s of every Call that IS a with-item's context expression
+        (directly, or under a `contextlib.ExitStack().enter_context`
+        boundary — rare, reviewed via waiver instead)."""
+        exprs: set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call):
+                        exprs.add(id(item.context_expr))
+        return exprs
+
+    def _span_label(self, call: ast.Call) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in self.AUDIT_FACTORIES:
+                return func.id
+            return None  # a bare span()/deferred() name is ambiguous
+        if not isinstance(func, ast.Attribute):
+            return None
+        chain = _attr_chain(func)
+        if len(chain) >= 2 and chain[-2] == "trace" \
+                and chain[-1] in self.SPAN_FACTORIES:
+            return ".".join(chain)
+        return None
+
+
 class WaiverHygiene(Rule):
     id = "waiver-needs-reason"
     doc = "Every tpulint waiver carries a reason"
@@ -809,5 +876,6 @@ RULES: list[Rule] = [
     FailpointRegistry(),
     FsyncBeforeDone(),
     NamedLocks(),
+    SpanClosesInFinally(),
     WaiverHygiene(),
 ]
