@@ -35,6 +35,7 @@ from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.tpu import TpuDevice
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
 from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("allocator")
@@ -108,7 +109,7 @@ class TpuAllocator:
         # create slaves, both observe Unschedulable, and both roll back
         # (the reference races exactly like this); serialized, the first
         # wins and the second gets a clean InsufficientTPU.
-        self._alloc_mutex = threading.Lock()
+        self._alloc_mutex = OrderedLock("allocator.alloc")
 
     # --- slave pod manifest (reference: newGPUSlavePod, allocator.go:189-234) ---
 
@@ -143,6 +144,7 @@ class TpuAllocator:
     def get_available_tpus(self, owner: Pod, total_tpu_num: int,
                            tpu_num_per_pod: int,
                            prefer_ici: bool = False,
+                           stats: dict | None = None,
                            ) -> tuple[list[TpuDevice], list[str]]:
         """Create slave pods and return (devices, slave_pod_names).
 
@@ -157,6 +159,13 @@ class TpuAllocator:
         cfg.alloc_ici_slack, opportunistic: capacity exhaustion just
         stops the widening), keep the best-connected subset, and release
         the rest. Entire-mounts get whatever block the plugin assigned.
+
+        stats: optional out-param dict filled with the warm-pool
+        outcome of this allocation — pool_hit (slaves adopted warm),
+        pool_gap (slaves that paid the cold create-and-wait path) and
+        pool_enabled — so the caller's trace span can say whether a
+        slow slave_pod_schedule phase was pool starvation or plain
+        scheduler wait (the BENCH_trace 88.7% question).
         """
         if total_tpu_num <= 0 or total_tpu_num % tpu_num_per_pod != 0:
             raise ValueError(
@@ -168,23 +177,41 @@ class TpuAllocator:
         n_pods = total_tpu_num // tpu_num_per_pod
         with self._alloc_mutex:
             devices, created = self._allocate_locked(
-                owner, total_tpu_num, tpu_num_per_pod, n_pods)
+                owner, total_tpu_num, tpu_num_per_pod, n_pods,
+                stats=stats)
             if prefer_ici and tpu_num_per_pod == 1 \
                     and self.cfg.alloc_ici_slack > 0:
                 devices, created = self._trim_to_ici_block(
                     owner, devices, total_tpu_num)
+            if stats is not None:
+                # Clamp the warm-pool outcome to what the ICI trim
+                # actually KEPT: an adopted holder released as slack
+                # must not be reported as a warm hit (the span attrs
+                # would overstate pool coverage).
+                adopted = set(stats.pop("_adopted", ()))
+                kept = set(created)
+                stats["pool_hit"] = len(adopted & kept)
+                stats["pool_gap"] = len(kept) - stats["pool_hit"]
             return devices, created
 
     def _allocate_locked(self, owner: Pod, total_tpu_num: int,
-                         tpu_num_per_pod: int,
-                         n_pods: int) -> tuple[list[TpuDevice], list[str]]:
+                         tpu_num_per_pod: int, n_pods: int,
+                         stats: dict | None = None,
+                         ) -> tuple[list[TpuDevice], list[str]]:
         # Warm fast path: adopt pre-scheduled holders first (single-chip
         # slaves only — an entire-mount needs one pod holding all chips,
         # which the pool does not stock). Adopted pods are already
         # Running, so only the cold remainder pays the schedule wait.
         adopted: list[str] = []
+        pool_usable = (self.pool is not None and tpu_num_per_pod == 1
+                       and getattr(self.pool, "enabled", True))
         if self.pool is not None and tpu_num_per_pod == 1:
             adopted = self.pool.acquire(owner, n_pods)
+        if stats is not None:
+            stats["pool_enabled"] = pool_usable
+            # provisional: get_available_tpus clamps hit/gap to the
+            # slaves the ICI trim keeps before the caller sees them
+            stats["_adopted"] = list(adopted)
         created: list[str] = list(adopted)
         try:
             cold: list[str] = []

@@ -46,6 +46,7 @@ from gpumounter_tpu.config import get_config
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
 from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -94,7 +95,7 @@ class WarmPodPool:
         self.apihealth = apihealth
         self.size = max(0, int(self.cfg.warm_pool_size))
         self.refill_async = refill_async
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("pool.ready")
         self._ready: dict[str, list[str]] = {}     # node -> holder names
         self._pending: dict[str, int] = {}         # node -> creates in flight
         self._backoff_until: dict[str, float] = {}  # node -> monotonic stamp
@@ -146,6 +147,11 @@ class WarmPodPool:
                 return
             self._ready[node_name] = []
             self._pending.setdefault(node_name, 0)
+        # The per-node ready gauge exists from registration on, not
+        # from the first refill: an empty pool and an unregistered node
+        # must be distinguishable on /metrics, and the /capacity
+        # plane's warm-coverage number reads the same book.
+        WARM_POOL_READY.set(0.0, node=node_name)
         self._resync(node_name)
         self._kick()
 
@@ -196,6 +202,13 @@ class WarmPodPool:
     def ready_count(self, node_name: str) -> int:
         with self._lock:
             return len(self._ready.get(node_name, []))
+
+    def ready_names(self, node_name: str) -> list[str]:
+        """The adoptable holder pods on a node — the capacity plane
+        classifies their booked chips as warm (reclaimable) rather
+        than held (obs/capacity.py node_capacity_snapshot)."""
+        with self._lock:
+            return list(self._ready.get(node_name, []))
 
     def wait_ready(self, node_name: str, count: int | None = None,
                    timeout_s: float = 10.0) -> bool:

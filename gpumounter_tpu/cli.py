@@ -35,6 +35,11 @@ Two families of verbs:
                                    per-objective fast/slow burn windows
     tenants [--tenant T]           per-tenant disruption ledger: every
                                    window attributed to a cause + trace
+    capacity [--accel-type T]      capacity & fragmentation pane: fleet
+                                   chip inventory, ICI fragmentation
+                                   index, per-size feasibility table,
+                                   headroom forecast (exit 3 when an
+                                   intent shape is infeasible)
     shards                         shard -> owner replica table
     recovery [--evacuate NODE]     node-failure recovery plane: liveness
                                    verdicts + evacuation history
@@ -320,6 +325,37 @@ def cmd_why(args) -> int:
     if dominant:
         print(f"dominant phase: {dominant.get('phase')} "
               f"({dominant.get('share', 0.0):.0%} of wall time)")
+    if dominant.get("phase") == "slave_pod_schedule":
+        # Name the COLD-MOUNT CAUSE: the slave_pod_schedule spans carry
+        # the allocator's warm-pool outcome (pool_hit/pool_gap), so a
+        # dominant scheduling phase is attributable to warm-pool
+        # starvation vs plain scheduler wait instead of a shrug.
+        hits = gap = 0
+        enabled = False
+        seen = False
+        for entry in payload.get("spans", []):
+            if entry.get("name") != "mount.slave_pod_schedule":
+                continue
+            attrs = entry.get("attrs") or {}
+            if "pool_gap" not in attrs and "pool_hit" not in attrs:
+                continue
+            seen = True
+            hits += int(attrs.get("pool_hit", 0) or 0)
+            gap += int(attrs.get("pool_gap", 0) or 0)
+            enabled = enabled or bool(attrs.get("pool_enabled"))
+        if not seen:
+            print("cold-mount cause: unknown (no warm-pool outcome on "
+                  "the scheduling span — pre-capacity worker?)")
+        elif not enabled:
+            print(f"cold-mount cause: scheduler wait ({gap} chip(s) "
+                  f"cold-created; warm pool disabled on this node)")
+        elif gap > 0:
+            print(f"cold-mount cause: warm-pool starvation ({gap} "
+                  f"chip(s) fell to the cold path, {hits} adopted "
+                  f"warm — the pool ran dry)")
+        else:
+            print(f"cold-mount cause: scheduler wait ({hits} chip(s) "
+                  f"adopted warm yet scheduling still dominated)")
     if not payload.get("complete", False):
         orphans = payload.get("orphans") or []
         missing = payload.get("missing_worker_halves") or []
@@ -421,6 +457,65 @@ def cmd_tenants(args) -> int:
             print(f"  OPEN: {w.get('cause')} for {w.get('age_s')}s "
                   f"(trace {w.get('trace_id') or '-'})", file=sys.stderr)
     return 3 if open_windows else 0
+
+
+def cmd_capacity(args) -> int:
+    """The capacity & fragmentation pane (GET /capacity): fleet chip
+    inventory, per-host and fleet ICI fragmentation indices, the
+    per-size allocation-feasibility table and the headroom forecast.
+    JSON on stdout; one-line verdicts on stderr. Exit 2 when
+    --accel-type names an unknown shape; exit 3 when that shape is
+    infeasible right now, or (without --accel-type) when the declared
+    intent demand no longer fits free capacity."""
+    path = "/capacity"
+    if args.accel_type:
+        path += f"?accel_type={urllib.parse.quote(args.accel_type)}"
+    status, body = _http(args, "GET", path, token=_obs_token(args))
+    print(body.rstrip())
+    if status == 404 and args.accel_type:
+        return 2
+    if status != 200:
+        return 1
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return 1
+    fleet = payload.get("fleet", {})
+    print(f"fleet: {fleet.get('free', 0)}/{fleet.get('total', 0)} "
+          f"chip(s) free (warm {fleet.get('warm', 0)}, fenced "
+          f"{fleet.get('fenced', 0)}), fragmentation index "
+          f"{fleet.get('fragmentation_index', 0.0)}, largest block "
+          f"{fleet.get('largest_block', 0)} across "
+          f"{fleet.get('hosts_reporting', 0)}/{fleet.get('hosts', 0)} "
+          f"reporting host(s)", file=sys.stderr)
+    infeasible_requested = False
+    for accel, entry in sorted((payload.get("feasibility") or {}).items()):
+        verdict = entry.get("verdict", "?")
+        line = (f"{accel}: {verdict} "
+                f"({entry.get('hosts_admissible_now', 0)}/"
+                f"{entry.get('hosts_needed', 0)} host(s) admissible "
+                f"now, {entry.get('hosts_after_defrag', 0)} after "
+                f"defrag)")
+        blocking = entry.get("blocking_hosts") or []
+        if blocking:
+            line += f" blocking: {', '.join(blocking)}"
+        print(line, file=sys.stderr)
+        if args.accel_type and verdict == "infeasible":
+            infeasible_requested = True
+    headroom = payload.get("headroom", {})
+    print(f"headroom: {headroom.get('forecast', '?')} "
+          f"(free {headroom.get('free_chips', 0)}, queue depth "
+          f"{headroom.get('queue_depth', 0)}, "
+          f"{headroom.get('tokens_per_s', 0)} tokens/s across "
+          f"{headroom.get('tenants', 0)} tenant(s))", file=sys.stderr)
+    demand = payload.get("demand", {})
+    if demand.get("intents") and not demand.get("satisfiable", True):
+        print(f"DEMAND UNSATISFIABLE: declared intents want "
+              f"{demand.get('gap', 0)} more chip(s) than free+warm "
+              f"capacity holds", file=sys.stderr)
+        if not args.accel_type:
+            return 3
+    return 3 if infeasible_requested else 0
 
 
 def cmd_apihealth(args) -> int:
@@ -843,6 +938,19 @@ def build_parser() -> argparse.ArgumentParser:
                                        "replica owns which node shard")
     _obs_common(sh)
     sh.set_defaults(fn=cmd_shards)
+
+    cp = sub.add_parser("capacity",
+                        help="capacity & fragmentation pane: fleet "
+                             "chip inventory, ICI fragmentation index, "
+                             "per-size feasibility + headroom forecast "
+                             "(exit 3 when --accel-type is infeasible "
+                             "or declared demand no longer fits)")
+    _obs_common(cp)
+    cp.add_argument("--accel-type", default=None,
+                    help="only this accelerator type's feasibility "
+                         "(e.g. v5litepod-16; exit 2 when unknown, "
+                         "3 when infeasible)")
+    cp.set_defaults(fn=cmd_capacity)
 
     ah = sub.add_parser("apihealth",
                         help="API-outage degraded mode: ApiHealth "

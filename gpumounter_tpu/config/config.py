@@ -15,8 +15,9 @@ google.com/tpu, pool namespace gpu-pool → tpu-pool, device prefix /dev/nvidia
 from __future__ import annotations
 
 import os
-import threading
 from dataclasses import dataclass, field, fields
+
+from gpumounter_tpu.utils.locks import OrderedLock
 
 
 def _env(name: str, default: str) -> str:
@@ -415,6 +416,21 @@ class Config:
     slo_burn_threshold: float = field(default_factory=lambda: float(
         _env("SLO_BURN_THRESHOLD", "2.0")))
 
+    # --- capacity & fragmentation plane (gpumounter_tpu/obs/capacity.py) ---
+    # How many blocking hosts a feasibility verdict names (the full
+    # fragmented set can be the whole fleet; the payload names where
+    # the defragmenter should aim, not every host).
+    capacity_blocking_hosts_max: int = field(default_factory=lambda: int(
+        _env("CAPACITY_BLOCKING_HOSTS_MAX", "8")))
+    # Headroom forecast: free/total below this ratio reads "tight"
+    # (queue depth exceeding free chips does too).
+    capacity_tight_free_ratio: float = field(default_factory=lambda: float(
+        _env("CAPACITY_TIGHT_FREE_RATIO", "0.1")))
+    # Trailing samples (one per collection pass) the headroom trend is
+    # derived from.
+    capacity_trend_samples: int = field(default_factory=lambda: int(
+        _env("CAPACITY_TREND_SAMPLES", "64")))
+
     # --- tenant-side telemetry (gpumounter_tpu/jaxside/telemetry.py +
     # obs/tenants.py) ---
     # How often the TenantTelemetry SDK's background publisher POSTs a
@@ -451,7 +467,7 @@ class Config:
         return out
 
 
-_lock = threading.Lock()
+_lock = OrderedLock("config.global")
 _config: Config | None = None
 
 

@@ -43,6 +43,7 @@ from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
 from gpumounter_tpu.rpc import api
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -116,7 +117,7 @@ class ElasticReconciler:
         #: succeeded, grow failed, retry mounted) must still be recorded
         #: — dropping it would leave jaxside unaware it has to repack.
         self._pending_heal: dict[str, list[str]] = {}
-        self._status_lock = threading.Lock()
+        self._status_lock = OrderedLock("elastic.status")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -454,7 +455,11 @@ class ElasticReconciler:
                 raise ReconcileError(f"mount of {gap} chip(s) failed: {exc}")
         # Capacity exhausted. Already at or above the declared floor:
         # that is the documented "degraded, not failed" state — keep
-        # retrying for desired on the backoff schedule without alarming.
+        # retrying for desired on the backoff schedule without
+        # alarming (and without stamping a rejection verdict every
+        # backoff pass: N capacity-limited intents would flood the
+        # bounded audit ring with identical records — only the
+        # below-floor TRUE failures below record).
         floor_gap = intent.min_chips - actual
         if floor_gap <= 0:
             logger.warning(
@@ -462,7 +467,13 @@ class ElasticReconciler:
                 "(desired %d); will keep retrying", pod.namespace,
                 pod.name, actual, intent.min_chips, intent.desired_chips)
             return False
-        # Below the floor: a smaller mount may still satisfy it.
+        # Below the floor: a smaller mount may still satisfy it. These
+        # are TRUE capacity failures (the intent cannot even reach its
+        # declared floor), so they stamp the feasibility verdict into
+        # the audit trail / flight recorder (obs/capacity.py — no-op
+        # when no capacity plane is registered): the incident timeline
+        # says whether fragmentation or exhaustion blocked the grow.
+        from gpumounter_tpu.obs import capacity as capacity_obs
         if floor_gap < gap:
             try:
                 coordinator.mount_slice([target], floor_gap, entire=False)
@@ -472,8 +483,12 @@ class ElasticReconciler:
                     pod.name, intent.min_chips, intent.desired_chips)
                 return False
             except SliceError as exc:
+                capacity_obs.record_rejection(
+                    pod.node_name, pod.namespace, pod.name, floor_gap)
                 raise ReconcileError(
                     f"floor mount of {floor_gap} chip(s) failed: {exc}")
+        capacity_obs.record_rejection(pod.node_name, pod.namespace,
+                                      pod.name, gap)
         raise ReconcileError(
             f"insufficient capacity for {gap} chip(s) "
             f"(actual={actual}, min={intent.min_chips})")

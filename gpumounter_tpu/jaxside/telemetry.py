@@ -64,6 +64,8 @@ import urllib.request
 from collections import deque
 from collections.abc import Callable
 
+from gpumounter_tpu.utils.locks import OrderedLock
+
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("jaxside.telemetry")
@@ -148,7 +150,7 @@ class TenantTelemetry:
                             else cfg.tenant_stall_min_s)
         self.minute_s = minute_s
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("tenant.telemetry")
         self._started_mono = clock()
         self._started_wall = time.time()
         # steps

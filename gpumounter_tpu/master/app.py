@@ -353,6 +353,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     # telemetry, and the SLO burn-rate evaluation over it.
     ("GET", re.compile(r"^/fleet$"), "fleet"),
     ("GET", re.compile(r"^/slo$"), "slo"),
+    # Capacity & fragmentation plane (gpumounter_tpu/obs/capacity.py):
+    # per-host chip inventory rolled into fragmentation indices, the
+    # per-size allocation-feasibility table and the headroom forecast.
+    # Captures its own query string (?accel_type=) like /audit.
+    ("GET", re.compile(r"^/capacity(?:\?(?P<query>.*))?$"), "capacity"),
     # Tenant-perceived disruption ledger (jaxside telemetry SDK ->
     # worker tenant store -> fleet merge): per-tenant step rates and
     # disruption windows, each joined to its control-plane trace.
@@ -394,7 +399,7 @@ class MasterApp:
     #: movements — require the mutate token.
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
                              "shards", "recovery", "tenants",
-                             "apihealth", "timeline"})
+                             "apihealth", "timeline", "capacity"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -514,6 +519,17 @@ class MasterApp:
         self.fleet = FleetCollector(self.registry, self._client_factory,
                                     cfg=self.cfg, slo=self.slo,
                                     shards=self.shards)
+        # Capacity & fragmentation plane (obs/capacity.py): observes
+        # every fleet collection pass (fragmentation gauges + the
+        # slice-feasibility SLO counters) and serves /capacity from the
+        # same node entries. Registered process-globally so the elastic
+        # reconciler's capacity-limited branch can stamp rejection
+        # verdicts without holding a reference.
+        from gpumounter_tpu.obs import capacity as capacity_obs
+        self.capacity = capacity_obs.CapacityPlane(
+            self.fleet, cfg=self.cfg, elastic=self.elastic)
+        self.fleet.capacity = self.capacity
+        capacity_obs.register_plane(self.capacity)
         # Node-failure recovery plane: liveness verdicts + automatic
         # evacuation. Constructed here so the /recovery routes and the
         # loop share one controller; the background loop only runs
@@ -559,7 +575,7 @@ class MasterApp:
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
                                  "slo", "shards", "recovery", "tenants",
-                                 "apihealth", "timeline"})
+                                 "apihealth", "timeline", "capacity"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -805,6 +821,33 @@ class MasterApp:
         self.fleet.refresh_if_stale(self.cfg.fleet_scrape_interval_s)
         return 200, "application/json", \
             jsonlib.dumps(self.slo.payload(), indent=1) + "\n"
+
+    def _route_capacity(self, match, body, headers):
+        """The capacity & fragmentation pane: per-host and fleet ICI
+        fragmentation indices, the per-size allocation-feasibility
+        table (blocking hosts named) and the headroom forecast —
+        collected on demand when the rollup is stale, federated
+        per-shard exactly like /fleet. ?accel_type= filters the
+        feasibility table to one accelerator type (404 on an unknown
+        one)."""
+        import json as jsonlib
+        params = urllib.parse.parse_qs(match.group("query") or "")
+        accel = params.get("accel_type", [None])[-1]
+        try:
+            payload = self.capacity.payload(
+                max_age_s=self.cfg.fleet_scrape_interval_s,
+                accel_type=accel)
+        except KeyError:
+            # Only the ?accel_type= filter raises KeyError by contract;
+            # an internal KeyError on an unfiltered read must stay a
+            # 500 (a server bug must not masquerade as a client error).
+            if accel is None:
+                raise
+            raise _HttpError(
+                404, f"unknown accelerator type {accel!r}; see "
+                     f"master/topology.py for the known shapes")
+        return 200, "application/json", \
+            jsonlib.dumps(payload, indent=1) + "\n"
 
     def _route_tenants(self, match, body, headers):
         """The per-tenant disruption ledger: what each tenant's training
@@ -1310,6 +1353,11 @@ class MasterApp:
         if result == api.AddTPUResult.Success:
             return 200, "text/plain", "Add TPU Success\n"
         if result == api.AddTPUResult.InsufficientTPU:
+            # Rejected for capacity: stamp the feasibility verdict into
+            # the audit trail + flight recorder (obs/capacity.py) so
+            # the incident timeline says WHY the intent couldn't place
+            # (fragmentation vs exhaustion, blocking numbers).
+            self.capacity.record_rejection(node, ns, pod_name, tpu_num)
             raise _HttpError(500, f"Insufficient TPU on Node: {node}")
         if result == api.AddTPUResult.PodNotFound:
             raise _HttpError(400, f"No Pod {pod_name} on Node: {node}")

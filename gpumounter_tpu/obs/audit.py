@@ -20,11 +20,11 @@ from __future__ import annotations
 
 import contextlib
 import itertools
-import threading
 import time
 from collections import deque
 
 from gpumounter_tpu.obs import trace
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("obs.audit")
@@ -36,7 +36,7 @@ class AuditLog:
     def __init__(self, capacity: int = 4096):
         from gpumounter_tpu.obs.sinks import JsonlSink
         self._records: deque[dict] = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("audit.records")
         self._seq = itertools.count(1)
         self._jsonl = JsonlSink("audit")
         # Record subscribers (the flight recorder's timeline feed):

@@ -1,0 +1,610 @@
+"""Capacity & fragmentation observability plane.
+
+The ROADMAP's two biggest open levers — the ICI defragmenter and the
+autoscaling loop — both start from a signal the system could not
+produce before this module: "how many free chips does the fleet have,
+in what ICI shapes, and what's the probability a v5litepod-16 intent
+admits right now?" `allocator/placement.py` scores per-host contiguity
+and `master/topology.py` knows real slice geometries, but that
+knowledge was consumed transiently at mount time and never observed.
+This module makes capacity, fragmentation and headroom first-class
+observable state BEFORE any controller acts on them:
+
+  * node_capacity_snapshot() — the worker half: per-host chip inventory
+    (free / held / warm-pool / fenced, WITH chip indices so contiguity
+    is computable fleet-side) riding the CollectTelemetry snapshot.
+    The HTTP-scrape fallback degrades like the rest of the telemetry
+    plane: the classic exposition cannot carry indices, so a legacy
+    worker's node simply reports no capacity section.
+
+  * host_capacity() — per-host derived view: an ICI fragmentation
+    index (1 - largest-achievable-contiguous-block / free chips; 0 =
+    every free chip reachable in one ICI-connected block, -> 1 =
+    scattered), the largest achievable block, and which per-host block
+    sizes (1/2/4/8 — the chips-per-host vocabulary of every published
+    slice shape) are admissible right now. Achievability is exact:
+    a contiguous block of size k exists iff the free set has an
+    ICI-connected component of >= k chips (any connected subgraph
+    prefix of a BFS tree realises it); placement.best_block then names
+    the concrete chips a mount would take.
+
+  * CapacityPlane — the master half: rolls every node's reported
+    inventory into (a) per-host and fleet fragmentation indices, (b) a
+    per-size allocation-feasibility table for every master/topology.py
+    accelerator type (admissible now / admissible-after-defrag /
+    infeasible, with the blocking hosts named), and (c) a headroom
+    forecast joining the /tenants queue-depth and tokens/sec signals
+    against free capacity. Served at GET /capacity (read scope,
+    per-shard collection federated exactly like /fleet — the rollup is
+    derived from the same FleetCollector pass), consumed by the
+    `tpumounter capacity` verb, and sampled into the slice-feasibility
+    SLO objective (obs/slo.py) via two cumulative counters.
+
+  * record_rejection() — rejected-for-capacity admissions stamp the
+    feasibility verdict into the audit trail (and, via the audit
+    subscriber, the incident flight recorder's timeline) so an
+    incident review sees WHY an intent couldn't place, not just that
+    it didn't.
+
+Chip indices ride the JSON plane only — never metric labels (the
+cardinality guard in tests/test_metrics_cardinality.py asserts this).
+Stdlib-only (lazy-grpc policy: the worker imports the snapshot half on
+its telemetry path).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from gpumounter_tpu.allocator import placement
+from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("obs.capacity")
+
+CAPACITY_SCHEMA = "tpumounter-capacity/1"
+
+#: the chips-per-host vocabulary of every published slice shape
+#: (master/topology.py): v5e hosts carry 1/2/4/8 chips, v4/v5p always 4.
+HOST_BLOCK_SIZES = (1, 2, 4, 8)
+
+# Fleet-level gauges only: per-node numbers ride the JSON plane (the
+# /capacity payload), never node-labeled series — same cardinality
+# discipline as the rest of the fleet plane.
+CAPACITY_FREE_CHIPS = REGISTRY.gauge(
+    "tpumounter_capacity_free_chips",
+    "Free (healthy, unbooked) chips across the last capacity rollup")
+CAPACITY_FRAG_INDEX = REGISTRY.gauge(
+    "tpumounter_capacity_fragmentation_index",
+    "Fleet ICI fragmentation index: 1 - achievable-contiguous / free "
+    "(0 = perfectly defragmented, -> 1 = scattered)")
+CAPACITY_SIZE_FEASIBLE = REGISTRY.counter(
+    "tpumounter_capacity_size_feasible_total",
+    "Per-collection-pass accelerator-size feasibility evaluations NOT "
+    "denied by fragmentation: admissible now, or out of reach for raw "
+    "free capacity (utilization — capacity planning's problem, not a "
+    "page). The slice-feasibility SLO's good events")
+CAPACITY_SIZE_INFEASIBLE = REGISTRY.counter(
+    "tpumounter_capacity_size_infeasible_total",
+    "Per-collection-pass accelerator-size feasibility evaluations "
+    "where the free chips EXIST but ICI fragmentation denies placement "
+    "(admissible-after-defrag) — the slice-feasibility SLO's bad "
+    "events and the defragmenter's work signal")
+
+
+# --- worker half: the per-host inventory snapshot ---
+
+
+def node_capacity_snapshot(collector, pool=None, cfg=None) -> dict:
+    """This worker's chip inventory, classified free / held / warm /
+    fenced with indices — the `capacity` section of the CollectTelemetry
+    snapshot. Classification priority: an unhealthy chip is fenced no
+    matter who books it (a dead chip is capacity to nobody); a healthy
+    booked chip is warm when its holder is a warm-pool pod, held
+    otherwise; everything else is free. Ownership refresh degrades like
+    the collector always has (a kubelet blip keeps the old marks and
+    flips ownership_known, it never fails the telemetry pass)."""
+    if cfg is None:
+        from gpumounter_tpu.config import get_config
+        cfg = get_config()
+    collector.update_status()
+    devices = collector.snapshot()
+    node = getattr(cfg, "node_name", "") or ""
+    ready: set[str] = set()
+    if pool is not None and getattr(pool, "enabled", False):
+        ready = set(pool.ready_names(node))
+    free: list[int] = []
+    warm: list[int] = []
+    fenced: list[int] = []
+    held: dict[str, str] = {}
+    for dev in devices:
+        healthy, _reason = collector.backend.probe_device(dev)
+        if not healthy:
+            fenced.append(dev.index)
+            continue
+        if not dev.pod_name:
+            free.append(dev.index)
+            continue
+        if dev.namespace == cfg.pool_namespace and dev.pod_name in ready:
+            # ONLY the pool's ready book decides warm — never the
+            # warm-slave- name prefix: adopted holders keep their names
+            # (pods cannot be renamed; ownership moves by label), so a
+            # prefix match would count a tenant's chips as reclaimable
+            # forever. The book survives restarts via ensure_node's
+            # resync; with no pool, leftover holders read held, which
+            # is the conservative truth (nobody will adopt them).
+            warm.append(dev.index)
+        else:
+            held[str(dev.index)] = f"{dev.namespace}/{dev.pod_name}"
+    return {
+        "schema": CAPACITY_SCHEMA,
+        "total": len(devices),
+        "free": sorted(free),
+        "warm": sorted(warm),
+        "fenced": sorted(fenced),
+        "held": {k: held[k] for k in sorted(held, key=int)},
+        # The pool's own ready book, so /capacity warm coverage and the
+        # tpumounter_warm_pool_ready gauge describe the same number.
+        "warm_ready": (pool.ready_count(node)
+                       if pool is not None and node else len(warm)),
+        "ownership_known": bool(getattr(collector, "ownership_known",
+                                        True)),
+    }
+
+
+# --- per-host derived view ---
+
+
+def largest_ici_block(free: list[int]) -> int:
+    """Size of the largest ICI-connected component of the free set —
+    the largest contiguous block any single mount on this host could
+    get. Exact: a connected subgraph of any size up to the component
+    size always exists (a BFS-tree prefix realises it).
+
+    On the 2-wide row-major grid (placement.chip_coord) a chip's ICI
+    neighbors are exactly {i^1, i-2, i+2} — i^1 flips x within the
+    tray row, ±2 steps y — so components fall out of an O(n) BFS with
+    constant-time neighbor lookups (this runs per host per collection
+    pass; the collect-overhead budget is 5%)."""
+    pending = set(free)
+    best = 0
+    while pending:
+        seed = pending.pop()
+        component = 1
+        frontier = [seed]
+        while frontier:
+            chip = frontier.pop()
+            for nbr in (chip ^ 1, chip - 2, chip + 2):
+                if nbr in pending:
+                    pending.discard(nbr)
+                    component += 1
+                    frontier.append(nbr)
+        best = max(best, component)
+    return best
+
+
+def host_capacity(snapshot: dict | None) -> dict:
+    """One node's derived capacity view from its reported inventory.
+    None (legacy worker / scrape fallback) yields capacity_unknown —
+    the fleet rollup excludes the node from feasibility math instead of
+    treating it as empty. The best_block search is the expensive part;
+    the plane's inventory-keyed cache (CapacityPlane._derive_hosts)
+    runs this only for hosts whose chips actually moved, which is how
+    a whole-fleet pass stays inside the collect-overhead budget
+    (bench_capacity.py gates 5%)."""
+    if not isinstance(snapshot, dict):
+        return {"capacity_unknown": True}
+    free = sorted(int(i) for i in snapshot.get("free") or [])
+    warm = sorted(int(i) for i in snapshot.get("warm") or [])
+    fenced = sorted(int(i) for i in snapshot.get("fenced") or [])
+    held = snapshot.get("held") or {}
+    largest = largest_ici_block(free)
+    n_free = len(free)
+    entry = {
+        "total": int(snapshot.get("total", 0)),
+        "free": n_free,
+        "held": len(held),
+        "warm": len(warm),
+        "fenced": len(fenced),
+        "free_indices": free,
+        "warm_ready": int(snapshot.get("warm_ready", len(warm))),
+        "largest_block": largest,
+        "fragmentation_index": (round(1.0 - largest / n_free, 4)
+                                if n_free else 0.0),
+        # which per-host block sizes admit right now; best_block names
+        # the concrete chips size-4 (the modal slice host) would take.
+        "admissible_block_sizes": [s for s in HOST_BLOCK_SIZES
+                                   if s <= largest],
+        "ownership_known": bool(snapshot.get("ownership_known", True)),
+    }
+    probe = min(4, largest)
+    if probe > 0:
+        entry["best_block"] = placement.best_block(free, probe)
+    return entry
+
+
+def _inventory_key(raw: object) -> tuple:
+    """Cheap change-detection key over a reported inventory section —
+    building it costs a fraction of re-deriving host_capacity, so
+    steady-state passes (the common case: a fleet that did not move
+    between scrapes) skip the derivation entirely."""
+    if not isinstance(raw, dict):
+        return ("unknown",)
+    held = raw.get("held") or {}
+    return (raw.get("total"),
+            tuple(raw.get("free") or ()),
+            tuple(raw.get("warm") or ()),
+            tuple(raw.get("fenced") or ()),
+            tuple(sorted(held.items())),
+            raw.get("warm_ready"),
+            bool(raw.get("ownership_known", True)))
+
+
+# --- the master plane ---
+
+
+class CapacityPlane:
+    """Fleet capacity rollup over the FleetCollector's node entries.
+
+    Shares the collector's shard federation for free: a sharded
+    replica's collector only scrapes the nodes it owns, so this
+    plane's /capacity payload covers exactly the same slice /fleet
+    does (the payload says which shards, like /fleet).
+    """
+
+    def __init__(self, fleet, cfg=None, elastic=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        self.fleet = fleet
+        self.elastic = elastic
+        self._lock = OrderedLock("capacity.trend")
+        #: trailing (wall time, free chips, queue depth) samples the
+        #: headroom forecast derives its trends from (one per observe()
+        #: — i.e. one per collection pass).
+        self._trend: deque[tuple[float, int, float]] = deque(
+            maxlen=max(2, int(cfg.capacity_trend_samples)))
+        #: node -> (inventory key, derived entry): a steady-state
+        #: collection pass (and a polled /capacity read) re-derives
+        #: only the nodes whose inventory actually changed — the 5%
+        #: collect-overhead budget (bench_capacity.py) is met by not
+        #: recomputing a fleet that did not move. Entries are
+        #: read-only once built; concurrent derivers (collect pass vs
+        #: a route thread) at worst waste a recompute, never corrupt.
+        self._host_cache: dict[str, tuple[tuple, dict]] = {}
+
+    def _derive_hosts(self, nodes: dict[str, dict]) -> dict[str, dict]:
+        """Per-node derived capacity views, cache-deduped by inventory.
+        A STALE node (the collector kept its last entry because the
+        worker stopped answering) derives as capacity_unknown: its
+        last-known chips must not count as live capacity — a feasibility
+        verdict resting on a dead node's free chips would green-light
+        mounts that are guaranteed to fail."""
+        hosts: dict[str, dict] = {}
+        fresh_cache: dict[str, tuple[tuple, dict]] = {}
+        for node, entry in nodes.items():
+            stale = bool(entry.get("stale"))
+            raw = None if stale else entry.get("capacity")
+            key = ("stale",) if stale else _inventory_key(raw)
+            cached = self._host_cache.get(node)
+            if cached is not None and cached[0] == key:
+                derived = cached[1]
+            else:
+                derived = host_capacity(raw)
+                if stale:
+                    derived["stale"] = True
+            fresh_cache[node] = (key, derived)
+            hosts[node] = derived
+        # replaced wholesale, keyed by node: evicted nodes leave with
+        # their entries (same discipline as the collector's node map)
+        self._host_cache = fresh_cache
+        return hosts
+
+    # --- per-pass observation (called by FleetCollector.collect_once) ---
+
+    def observe(self, nodes: dict[str, dict]) -> dict:
+        """Derive the fleet capacity view from one collection pass's
+        node entries, update the fleet gauges, the slice-feasibility
+        SLO counters and the trend window. Exception-safe by contract
+        with the collector (a capacity bug must not fail telemetry)."""
+        hosts = self._derive_hosts(nodes)
+        fleet = self._fleet_rollup(hosts)
+        feasibility = self._feasibility(hosts, fleet)
+        tracked = [e for e in feasibility.values() if e["tracked"]]
+        # The SLO's bad events are FRAGMENTATION-caused denials only
+        # (admissible-after-defrag): a fully-utilized fleet legitimately
+        # has no room for big slices and must not page — that's the
+        # headroom forecast's story. Burn means defrag would unlock
+        # blocked slice shapes.
+        frag_blocked = sum(1 for e in tracked
+                           if e["verdict"] == "admissible-after-defrag")
+        if tracked:
+            CAPACITY_SIZE_FEASIBLE.inc(float(len(tracked)
+                                             - frag_blocked))
+            CAPACITY_SIZE_INFEASIBLE.inc(float(frag_blocked))
+        CAPACITY_FREE_CHIPS.set(float(fleet["free"]))
+        CAPACITY_FRAG_INDEX.set(fleet["fragmentation_index"])
+        queue_depth = self._queue_depth(nodes)
+        with self._lock:
+            self._trend.append((time.time(), fleet["free"], queue_depth))
+        return {"hosts": hosts, "fleet": fleet,
+                "feasibility": feasibility}
+
+    @staticmethod
+    def _fleet_rollup(hosts: dict[str, dict]) -> dict:
+        total = free = held = warm = fenced = 0
+        achievable = 0
+        largest = 0
+        reporting = 0
+        for entry in hosts.values():
+            if entry.get("capacity_unknown"):
+                continue
+            reporting += 1
+            total += entry["total"]
+            free += entry["free"]
+            held += entry["held"]
+            warm += entry["warm"]
+            fenced += entry["fenced"]
+            achievable += entry["largest_block"]
+            largest = max(largest, entry["largest_block"])
+        return {
+            "hosts": len(hosts),
+            "hosts_reporting": reporting,
+            "total": total,
+            "free": free,
+            "held": held,
+            "warm": warm,
+            "fenced": fenced,
+            "largest_block": largest,
+            # Weighted fleet index: 1 - sum(largest per-host block) /
+            # free — the fraction of free chips a contiguity-demanding
+            # mount CANNOT reach without defragmentation.
+            "fragmentation_index": (round(1.0 - achievable / free, 4)
+                                    if free else 0.0),
+        }
+
+    def _feasibility(self, hosts: dict[str, dict],
+                     fleet: dict) -> dict[str, dict]:
+        """The per-size allocation-feasibility table: for every
+        accelerator type the topology module knows, would an intent of
+        that shape admit right now (enough hosts each holding an
+        ICI-connected free block of chips_per_host), only after a
+        defragmentation pass (enough hosts with the free+warm CHIPS but
+        not the contiguity — warm holders are reclaimable bookings), or
+        not at all. Blocking hosts are named so the defragmenter (and
+        the operator) know where to aim."""
+        from gpumounter_tpu.master import topology
+        name_cap = max(1, int(self.cfg.capacity_blocking_hosts_max))
+        # One host scan per DISTINCT chips-per-host size (4 values
+        # cover every published shape), not per accelerator type (20+):
+        # the whole-fleet observe() pass runs this every collection and
+        # must stay inside the collect-overhead budget.
+        sizes = {t.chips_per_host_count
+                 for t in topology._TOPOLOGIES.values()}
+        reporting = [(node, entry) for node, entry in sorted(hosts.items())
+                     if not entry.get("capacity_unknown")]
+        by_size: dict[int, tuple[list[str], list[str]]] = {}
+        for cph in sizes:
+            now: list[str] = []
+            after: list[str] = []
+            for node, entry in reporting:
+                if entry["largest_block"] >= cph:
+                    now.append(node)
+                elif entry["free"] + entry["warm"] >= cph:
+                    after.append(node)
+            by_size[cph] = (now, after)
+        table: dict[str, dict] = {}
+        for accel_type, topo in sorted(topology._TOPOLOGIES.items()):
+            cph = topo.chips_per_host_count
+            needed = topo.num_hosts
+            now, after = by_size[cph]
+            if len(now) >= needed:
+                verdict = "admissible"
+                blocking: list[str] = []
+            elif len(now) + len(after) >= needed:
+                verdict = "admissible-after-defrag"
+                blocking = after[:name_cap]
+            else:
+                verdict = "infeasible"
+                blocking = after[:name_cap]
+            table[accel_type] = {
+                "verdict": verdict,
+                "chips_per_host": cph,
+                "hosts_needed": needed,
+                "total_chips": topo.total_chips,
+                "hosts_admissible_now": len(now),
+                "hosts_after_defrag": len(now) + len(after),
+                "blocking_hosts": blocking,
+                # Sizes the fleet could never host don't feed the SLO:
+                # they would burn budget forever on a small fleet.
+                "tracked": topo.total_chips <= fleet["total"],
+            }
+        return table
+
+    @staticmethod
+    def _queue_depth(nodes: dict[str, dict]) -> float:
+        from gpumounter_tpu.obs.fleet import merge_tenants
+        depth = 0.0
+        for snap in merge_tenants(nodes).values():
+            value = snap.get("queue_depth")
+            if isinstance(value, (int, float)):
+                depth += float(value)
+        return depth
+
+    # --- the /capacity payload ---
+
+    def payload(self, max_age_s: float | None = None,
+                accel_type: str | None = None) -> dict:
+        """The GET /capacity response. Refreshes the underlying fleet
+        rollup when stale (single-flight, exactly like /fleet), derives
+        the capacity view from the same node entries, and joins the
+        tenant demand signals into the headroom forecast. With
+        `accel_type`, the feasibility table is filtered to that type
+        (raises KeyError for an unknown one — the route maps it to
+        404)."""
+        rollup = self.fleet.payload(max_age_s=max_age_s)
+        nodes = rollup["nodes"]
+        hosts = self._derive_hosts(nodes)
+        fleet = self._fleet_rollup(hosts)
+        feasibility = self._feasibility(hosts, fleet)
+        if accel_type is not None:
+            norm = accel_type.strip().lower()
+            feasibility = {norm: feasibility[norm]}
+        payload = {
+            "at": rollup.get("at"),
+            "nodes": hosts,
+            "fleet": fleet,
+            "feasibility": feasibility,
+            "headroom": self._headroom(nodes, fleet),
+            "demand": self._demand(fleet),
+        }
+        if "shard" in rollup:
+            payload["shard"] = rollup["shard"]
+        return payload
+
+    def _headroom(self, nodes: dict[str, dict], fleet: dict) -> dict:
+        """Free capacity joined against the tenant-plane demand
+        signals: current queue depth and tokens/sec, plus the trends
+        the trailing observe() window saw. The forecast is deliberately
+        coarse — ok / tight / exhausted — because it feeds operators
+        and the future autoscaler's guardrails, not a control loop."""
+        from gpumounter_tpu.obs.fleet import merge_tenants
+        merged = merge_tenants(nodes)
+        queue_depth = 0.0
+        tokens_per_s = 0.0
+        for snap in merged.values():
+            value = snap.get("queue_depth")
+            if isinstance(value, (int, float)):
+                queue_depth += float(value)
+            tokens_per_s += float(snap.get("tokens_per_s", 0.0) or 0.0)
+        with self._lock:
+            trend = list(self._trend)
+        trend_out = {"window_s": 0.0, "free_delta": 0, "queue_delta": 0.0}
+        if len(trend) >= 2:
+            (t0, free0, q0), (t1, free1, q1) = trend[0], trend[-1]
+            trend_out = {"window_s": round(t1 - t0, 3),
+                         "free_delta": free1 - free0,
+                         "queue_delta": round(q1 - q0, 3)}
+        free = fleet["free"]
+        total = fleet["total"]
+        tight_ratio = float(self.cfg.capacity_tight_free_ratio)
+        if total and free == 0:
+            forecast = "exhausted"
+        elif total and (free / total < tight_ratio
+                        or queue_depth > free):
+            forecast = "tight"
+        else:
+            forecast = "ok"
+        return {
+            "free_chips": free,
+            "warm_chips": fleet["warm"],
+            "queue_depth": queue_depth,
+            "tokens_per_s": round(tokens_per_s, 3),
+            "tenants": len(merged),
+            "trend": trend_out,
+            "forecast": forecast,
+        }
+
+    def _demand(self, fleet: dict) -> dict:
+        """Declared-intent demand vs free capacity: the scriptable
+        "does what operators asked for still fit" verdict the CLI's
+        exit code keys off."""
+        intents = 0
+        desired = 0
+        actual = 0
+        if self.elastic is not None:
+            try:
+                listed = self.elastic.store.list()
+            except Exception as exc:  # noqa: BLE001 — demand is advisory;
+                # any store failure (outage, staleness bound) degrades
+                # to "no declared demand" rather than failing /capacity
+                logger.warning("intent list for capacity demand "
+                               "failed: %s", exc)
+                listed = []
+            for namespace, pod_name, intent in listed:
+                intents += 1
+                desired += int(intent.desired_chips)
+                status = self.elastic.status_for(namespace, pod_name)
+                if status and isinstance(status.get("actual"), int):
+                    actual += status["actual"]
+        gap = max(0, desired - actual)
+        return {
+            "intents": intents,
+            "desired_chips": desired,
+            "actual_chips": actual,
+            "gap": gap,
+            "satisfiable": gap <= fleet["free"] + fleet["warm"],
+        }
+
+    # --- rejected-for-capacity admissions ---
+
+    def record_rejection(self, node: str, namespace: str, pod: str,
+                         chips: int) -> dict:
+        """Stamp the feasibility verdict for a rejected-for-capacity
+        admission into the audit trail (the audit subscriber mirrors it
+        onto the flight recorder's timeline). Uses the LAST collected
+        rollup — no forced refresh; the verdict describes what the
+        plane believed when the intent failed to place. Never raises."""
+        verdict: dict = {"node": node, "want": int(chips)}
+        try:
+            nodes = self.fleet.payload(max_age_s=None).get("nodes", {})
+            hosts = self._derive_hosts(nodes)
+            entry = hosts.get(node) or {"capacity_unknown": True}
+            fleet = self._fleet_rollup(hosts)
+            if entry.get("capacity_unknown"):
+                verdict["node_view"] = "unknown"
+            else:
+                verdict.update(
+                    node_free=entry["free"],
+                    node_largest_block=entry["largest_block"],
+                    node_fragmentation_index=entry["fragmentation_index"])
+                if entry["free"] >= int(chips) > entry["largest_block"]:
+                    verdict["cause"] = "fragmentation"
+                else:
+                    verdict["cause"] = "exhaustion"
+            verdict["fleet_free"] = fleet["free"]
+            verdict["fleet_fragmentation_index"] = \
+                fleet["fragmentation_index"]
+        except Exception as exc:  # noqa: BLE001 — the verdict is
+            # advisory; a capacity-plane bug must never mask the real
+            # admission failure the caller is about to report
+            logger.exception("capacity rejection verdict failed: %s", exc)
+            verdict["error"] = f"{type(exc).__name__}: {exc}"
+        outcome = (f"infeasible: want {chips} chip(s) on {node} "
+                   f"(cause: {verdict.get('cause', 'unknown')}, node "
+                   f"free {verdict.get('node_free', '?')}, largest "
+                   f"block {verdict.get('node_largest_block', '?')}, "
+                   f"fleet free {verdict.get('fleet_free', '?')})")
+        AUDIT.record("capacity.reject", actor="capacity-plane",
+                     namespace=namespace, pod=pod, outcome=outcome,
+                     **verdict)
+        return verdict
+
+
+# --- process-global plane (the reconciler's hook) ---
+
+_PLANE: CapacityPlane | None = None
+
+
+def register_plane(plane: CapacityPlane) -> None:
+    """MasterApp construction registers its plane here so subsystems
+    without a direct reference (the elastic reconciler's
+    capacity-limited branch) can stamp rejection verdicts. Latest
+    wins — one live MasterApp per process is the deployed shape; test
+    stacks that build several get the newest, which is what their
+    requests hit anyway."""
+    global _PLANE
+    _PLANE = plane
+
+
+def record_rejection(node: str, namespace: str, pod: str,
+                     chips: int) -> None:
+    """Module-level rejection stamp: no-op when no plane is registered
+    (a bare worker process, unit tests), never raises."""
+    plane = _PLANE
+    if plane is not None:
+        plane.record_rejection(node, namespace, pod, chips)

@@ -31,6 +31,7 @@ from concurrent import futures
 
 from gpumounter_tpu.cgroup.ebpf import DEVICE_TELEMETRY
 from gpumounter_tpu.obs import trace
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import (
     REGISTRY,
@@ -75,6 +76,10 @@ _MASTER_COUNTER_NAMES = (
     ("tpumounter_chips_heal_failures_total", "heal_failures"),
     ("tpumounter_migrations_total", "migrations"),
     ("tpumounter_worker_breaker_trips_total", "breaker_trips"),
+    # Capacity plane (obs/capacity.py): per-pass accelerator-size
+    # feasibility evaluations — the slice-feasibility SLO's ratio.
+    ("tpumounter_capacity_size_feasible_total", "slice_feasible"),
+    ("tpumounter_capacity_size_infeasible_total", "slice_infeasible"),
 )
 
 
@@ -241,6 +246,11 @@ def snapshot_from_prometheus(text: str) -> dict:
         "device_access": device_access,
         "tenants": {},  # the classic exposition cannot carry them
         "spans": [],    # ditto — the scrape fallback degrades to none
+        # Chip indices never become labels, so the classic exposition
+        # cannot carry the inventory either: a legacy worker's node
+        # reports no capacity section (obs/capacity.py marks it
+        # capacity_unknown instead of pretending it is empty).
+        "capacity": None,
     }
 
 
@@ -288,6 +298,12 @@ def _node_rollup(snapshot: dict) -> dict:
         "ebpf_program_swaps": _counter(snapshot, "ebpf_program_swaps"),
         "device_access": snapshot.get("device_access") or {},
         "tenants": snapshot.get("tenants") or {},
+        # The per-host chip inventory (obs/capacity.py) rides the node
+        # entry verbatim: the capacity plane derives fragmentation and
+        # feasibility from it fleet-side, and None (legacy worker /
+        # scrape fallback) stays None so consumers can tell "empty"
+        # from "unknown".
+        "capacity": snapshot.get("capacity"),
         "exemplars": (snapshot.get("mount_latency") or {}).get(
             "exemplars", []),
     }
@@ -393,12 +409,18 @@ class FleetCollector:
         #: the scrape fan-out instead of each polling the whole fleet —
         #: and the payload says which slice this rollup covers.
         self.shards = shards
+        #: optional CapacityPlane (obs/capacity.py): observes every
+        #: collection pass (fragmentation gauges + the
+        #: slice-feasibility SLO counters) and derives the /capacity
+        #: payload from the same node entries /fleet serves — so the
+        #: two panes can never disagree about what was collected.
+        self.capacity = None
         self.interval_s = cfg.fleet_scrape_interval_s
         #: per-node collection fan-out width: a few wedged workers each
         #: burn their full RPC deadline, so a serial pass would stall
         #: the whole fleet behind them.
         self.collect_width = 16
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("fleet.nodes")
         # Single-flight guard: concurrent stale observers (dashboards
         # polling /fleet at the interval edge) must not each launch
         # their own whole-fleet fan-out. RLock: collect_once holds it,
@@ -522,6 +544,15 @@ class FleetCollector:
             with self._lock:
                 self._nodes = fresh
                 self._collected_at = time.time()
+            if self.capacity is not None:
+                # Before payload(): the SLO counters this bumps ride
+                # the rollup's master section, and the rollup ingested
+                # below must describe THIS pass, not the previous one.
+                try:
+                    self.capacity.observe(fresh)
+                except Exception:  # noqa: BLE001 — capacity is an
+                    # observer; its bugs must not fail telemetry
+                    logger.exception("capacity observation failed")
             FLEET_NODES.set(float(len(fresh)))
             FLEET_COLLECT_DURATION.observe(time.monotonic() - t0)
             rollup = self.payload(max_age_s=None)

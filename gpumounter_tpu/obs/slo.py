@@ -26,13 +26,13 @@ never as negative burn. Stdlib-only.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import deque
 from dataclasses import asdict, dataclass, field
 
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -108,6 +108,21 @@ DEFAULT_OBJECTIVES: tuple[Objective, ...] = (
               bad="tenant_disrupted_minutes",
               description="99.9% of tenant wall-clock minutes are "
                           "disruption-free"),
+    # Capacity plane (obs/capacity.py): every collection pass evaluates
+    # per-accelerator-size admissibility (sizes the fleet could host).
+    # Bad events are FRAGMENTATION-caused denials only — the free
+    # chips exist but no ICI-contiguous blocks do — so a fully-utilized
+    # fleet never pages here (that's the headroom forecast's story);
+    # burn means a defrag pass would unlock blocked slice shapes.
+    # Fleets without a capacity plane wired see zero traffic and never
+    # breach.
+    Objective(name="slice-feasibility", kind="ratio", target=0.9,
+              good="slice_feasible", bad="slice_infeasible",
+              description="90% of per-pass accelerator-size "
+                          "feasibility evaluations are not denied by "
+                          "fragmentation alone (large-block "
+                          "admissibility: burn means defrag would "
+                          "unlock blocked slice shapes)"),
 )
 
 
@@ -199,7 +214,7 @@ class SloEngine:
         # threads evaluate: sample deques and breach-state transitions
         # share one lock (breach emission — Event POST, audit — runs
         # outside it so a slow API server cannot stall ingestion).
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("slo.states")
 
     # --- sampling ---
 

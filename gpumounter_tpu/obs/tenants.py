@@ -18,9 +18,9 @@ counting contract (chaos invariant 8) extends to tenant series.
 
 from __future__ import annotations
 
-import threading
 import time
 
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -71,7 +71,7 @@ class TenantStore:
 
     def __init__(self, max_tenants: int = 256):
         self.max_tenants = max_tenants
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("tenants.store")
         self._snapshots: dict[str, dict] = {}
         self._received_at: dict[str, float] = {}
         self._overflow_folded: set[str] = set()

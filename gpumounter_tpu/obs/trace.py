@@ -100,6 +100,24 @@ _current: contextvars.ContextVar[TraceContext | None] = \
 _deferred: contextvars.ContextVar["_DeferredSpans | None"] = \
     contextvars.ContextVar("tpumounter_trace_deferred", default=None)
 
+#: the innermost open span's mutable attribute dict — set_attrs()
+#: writes through it for outcomes only known mid-span (a mount's
+#: warm-pool hit/gap is decided by the allocator, inside the
+#: already-open slave_pod_schedule span).
+_span_attrs: contextvars.ContextVar[dict | None] = \
+    contextvars.ContextVar("tpumounter_trace_attrs", default=None)
+
+
+def set_attrs(**attrs) -> None:
+    """Attach attributes to the innermost open span of THIS context.
+    No-op when no span is open — call sites need no conditional, and a
+    background thread without an attached context simply records
+    nothing. Attributes land when the span closes (same export record
+    as open-time attrs; later writes to the same key win)."""
+    current_attrs = _span_attrs.get()
+    if current_attrs is not None:
+        current_attrs.update(attrs)
+
 
 def current() -> TraceContext | None:
     """The ambient context (for explicit cross-thread handoff)."""
@@ -282,6 +300,8 @@ def span(name: str, wire_parent: str | None = None,
     span_id = _new_span_id()
     ctx = TraceContext(trace_id, span_id)
     token = _current.set(ctx)
+    mutable_attrs = dict(attrs)
+    attrs_token = _span_attrs.set(mutable_attrs)
     t._open_add(span_id, name)
     started_at = time.time()
     t0 = time.monotonic()
@@ -293,6 +313,7 @@ def span(name: str, wire_parent: str | None = None,
         raise
     finally:
         _current.reset(token)
+        _span_attrs.reset(attrs_token)
         t._open_remove(span_id)
         record = {
             "trace_id": trace_id,
@@ -305,8 +326,8 @@ def span(name: str, wire_parent: str | None = None,
         }
         if error:
             record["error"] = error
-        if attrs:
-            record["attrs"] = {k: v for k, v in attrs.items()}
+        if mutable_attrs:
+            record["attrs"] = dict(mutable_attrs)
         pending = _deferred.get()
         if pending is not None:
             pending.append(record)

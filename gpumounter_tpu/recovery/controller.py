@@ -57,6 +57,7 @@ from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -110,7 +111,7 @@ class RecoveryController:
         self.shards = shards
         self.elastic = elastic
         self.migrations = migrations
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("recovery.state")
         #: node -> {"status": healthy|suspect|evacuated,
         #:          "failures": int, "first_failure_at": monotonic,
         #:          "reason": str, "last_seen": wall}

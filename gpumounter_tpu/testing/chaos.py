@@ -80,6 +80,14 @@ invariants after convergence:
      rounding), so "where did the latency go" is answerable for every
      benched operation. The negative control (worker spans dropped
      from the ring) must be DETECTED as incomplete assembly.
+ 17. capacity-plane agreement (obs/capacity.py): after every scenario,
+     the /capacity payload's per-node free/held/warm/fenced chips
+     exactly equal the fake scheduler's ground truth — books ==
+     mounts == ledger == capacity — so the pane controllers will act
+     on (the defragmenter, the autoscaler) can never drift from
+     reality undetected. The negative control (withhold_unmount: one
+     held chip's kubelet claim silently erased, as a lost unmount
+     would) must be DETECTED as divergence.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -494,6 +502,33 @@ class ChaosHarness:
                 continue
             REMOTE_SPANS.ingest(span.get("node", ""), [span])
         return dropped
+
+    def withhold_unmount(self, node_name: str = NODE_A) -> str | None:
+        """NEGATIVE CONTROL for invariant 17: silently erase one held
+        chip from the fake kubelet's claims WITHOUT unmounting it or
+        touching the scheduler's assignment — exactly the divergence a
+        lost/withheld unmount would leave (the worker-side capacity
+        snapshot reports the chip free while the ground-truth books
+        still hold it). check_invariants() must flag it as capacity
+        divergence. Returns the tampered chip id (None when the node
+        holds nothing)."""
+        node = self.cluster.node(node_name)
+        with self.cluster._alloc_lock:
+            victim = next(
+                (cid for cid, owner in sorted(node.assignment.items(),
+                                              key=lambda kv: int(kv[0]))
+                 if owner is not None and cid not in node.dead), None)
+            if victim is None:
+                return None
+            trimmed = []
+            for pod, ns, container, resource, ids in node.kubelet.claims:
+                kept = [i for i in ids if i != victim]
+                if kept or not ids:
+                    trimmed.append((pod, ns, container, resource, kept))
+            node.kubelet.claims = trimmed
+        self.record(f"withhold unmount of chip {victim} on {node_name} "
+                    f"(kubelet claim erased, booking kept)")
+        return victim
 
     def add_pod(self, name: str, node: str, namespace: str = "default",
                 ) -> Pod:
@@ -1358,6 +1393,44 @@ class ChaosHarness:
                 violations.append(
                     f"collector restart changed node {node} mount count "
                     f"{a} -> {b} (rollup not restart-stable)")
+
+        # 17. capacity-plane agreement: the collected /capacity
+        # inventory (same pass as invariant 8's first rollup) must
+        # equal the fake scheduler's ground truth chip-for-chip —
+        # free indices, held+warm count, fenced indices. A withheld
+        # unmount (the negative control erases a kubelet claim without
+        # unmounting) reads as divergence here.
+        for node in sorted(expected_nodes & set(first["nodes"])):
+            cap = first["nodes"][node].get("capacity")
+            if not isinstance(cap, dict):
+                violations.append(
+                    f"capacity divergence on {node}: node reported no "
+                    f"capacity section")
+                continue
+            fake = self.cluster.node(node)
+            with self.cluster._alloc_lock:
+                free_truth = sorted(int(c) for c in fake.free_ids())
+                held_truth = sorted(
+                    int(c) for c, owner in fake.assignment.items()
+                    if owner is not None and c not in fake.dead)
+                fenced_truth = sorted(int(c) for c in fake.dead)
+            free_rep = sorted(int(i) for i in cap.get("free") or [])
+            warm_rep = sorted(int(i) for i in cap.get("warm") or [])
+            held_rep = sorted(int(i) for i in cap.get("held") or {})
+            fenced_rep = sorted(int(i) for i in cap.get("fenced") or [])
+            if free_rep != free_truth:
+                violations.append(
+                    f"capacity divergence on {node}: reported free "
+                    f"{free_rep} != ground truth {free_truth}")
+            if sorted(held_rep + warm_rep) != held_truth:
+                violations.append(
+                    f"capacity divergence on {node}: reported "
+                    f"held+warm {sorted(held_rep + warm_rep)} != "
+                    f"ground-truth bookings {held_truth}")
+            if fenced_rep != fenced_truth:
+                violations.append(
+                    f"capacity divergence on {node}: reported fenced "
+                    f"{fenced_rep} != ground-truth dead {fenced_truth}")
 
         # 10. ledger agreement (armed by run_worker_crash_scenario):
         # after crash+restart+replay at any failpoint, every node's

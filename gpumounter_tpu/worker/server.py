@@ -368,13 +368,24 @@ class TpuMountService:
         # scheduling is the cold path's dominant cost, and the
         # assembled critical path (obs/assembly.py) attributes it only
         # if a span carries it.
+        pool_stats: dict = {}
         with timer.phase("slave_pod_schedule"), \
                 trace.span("mount.slave_pod_schedule",
                            chips=request.tpu_num):
             try:
                 devices, slaves = self.allocator.get_available_tpus(
                     pod, request.tpu_num, per_pod,
-                    prefer_ici=bool(request.prefer_ici))
+                    prefer_ici=bool(request.prefer_ici),
+                    stats=pool_stats)
+                # Warm-pool outcome onto the span: `tpumounter why`
+                # reads these to name pool starvation (pool_gap > 0)
+                # vs plain scheduler wait as the cold-mount cause —
+                # closing the loop on BENCH_trace_r01's finding that
+                # cold mounts are ~89% slave-pod scheduling.
+                trace.set_attrs(
+                    pool_hit=pool_stats.get("pool_hit", 0),
+                    pool_gap=pool_stats.get("pool_gap", 0),
+                    pool_enabled=pool_stats.get("pool_enabled", False))
             except InsufficientTpuError as exc:
                 logger.warning("insufficient TPU: %s", exc)
                 return api.AddTPUResponse(
@@ -538,15 +549,29 @@ class TpuMountService:
         mount-latency histogram (trace exemplars included), mount and
         warm-pool counters, per-tenant device-access counts (read from
         the eBPF telemetry table with plain map lookups — collection
-        never swaps a program), and the program-swap count that proves
-        it. Read-only and allocation-free beyond the JSON encode."""
+        never swaps a program), the program-swap count that proves it,
+        and the per-host chip inventory for the capacity plane.
+        Read-only, but NOT free: the inventory pays one kubelet
+        pod-resources refresh plus one device stat per chip each pass
+        (the FAQ's capacity-plane-overhead entry quantifies it; the
+        degraded kubelet path keeps old ownership marks and flips
+        ownership_known rather than failing the scrape)."""
         import json as jsonlib
 
+        from gpumounter_tpu.obs.capacity import node_capacity_snapshot
         from gpumounter_tpu.obs.fleet import worker_telemetry_snapshot
         with trace.span("worker.CollectTelemetry",
                         wire_parent=request.trace_context):
             failpoints.fire("worker.rpc", method="CollectTelemetry")
             snapshot = worker_telemetry_snapshot(cfg=self.cfg)
+            # Per-host chip inventory (free/held/warm/fenced with
+            # indices) for the master's capacity plane. Attached HERE —
+            # not inside worker_telemetry_snapshot — because it needs
+            # THIS service's collector and pool (one process can host
+            # several services in tests/chaos, but registry metrics are
+            # process-global while chip inventories are per-node).
+            snapshot["capacity"] = node_capacity_snapshot(
+                self.collector, pool=self.pool, cfg=self.cfg)
             return api.CollectTelemetryResponse(
                 collect_telemetry_result=api.CollectTelemetryResult.Success,
                 node_name=self.cfg.node_name or "",

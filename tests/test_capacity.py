@@ -1,0 +1,665 @@
+"""Capacity & fragmentation plane (ISSUE 14).
+
+Covers the worker half (node_capacity_snapshot classification, warm
+coverage agreeing with the tpumounter_warm_pool_ready gauge), the
+derivation math (largest ICI block cross-checked against the placement
+module's neighbor relation, fragmentation index, per-host admissible
+sizes), the master plane (feasibility verdicts for EVERY
+master/topology.py accelerator type, headroom forecast, demand), the
+/capacity route (read-scope auth, accel_type filter, 404 on unknown),
+the slice-feasibility SLO counters, rejected-for-capacity audit
+verdicts landing on the flight-recorder timeline, the warm-pool
+outcome riding mount.slave_pod_schedule spans (and `tpumounter why`
+naming pool starvation), and the CLI's exit-code contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+
+import pytest
+
+from gpumounter_tpu.allocator import placement
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.obs import capacity as capacity_mod
+from gpumounter_tpu.obs.capacity import (
+    CAPACITY_SCHEMA,
+    CapacityPlane,
+    host_capacity,
+    largest_ici_block,
+    node_capacity_snapshot,
+)
+
+
+# --- derivation math ---
+
+
+def _brute_largest_block(free: list[int]) -> int:
+    """Reference implementation over placement.ici_neighbors: largest
+    connected component by pairwise BFS."""
+    pending = set(free)
+    best = 0
+    while pending:
+        seed = pending.pop()
+        grown = {seed}
+        frontier = [seed]
+        while frontier:
+            chip = frontier.pop()
+            linked = [c for c in pending
+                      if placement.ici_neighbors(chip, c)]
+            for c in linked:
+                pending.discard(c)
+                grown.add(c)
+            frontier.extend(linked)
+        best = max(best, len(grown))
+    return best
+
+
+def test_largest_block_matches_placement_neighbor_relation():
+    """The O(n) {i^1, i+-2} neighbor shortcut must agree with
+    placement.ici_neighbors for every subset of an 8-chip host and for
+    random subsets of a 16-chip index space."""
+    for r in range(9):
+        for combo in itertools.combinations(range(8), r):
+            free = list(combo)
+            assert largest_ici_block(free) == _brute_largest_block(free), \
+                free
+    rng = random.Random(7)
+    for _ in range(200):
+        free = rng.sample(range(16), rng.randint(0, 12))
+        assert largest_ici_block(free) == _brute_largest_block(free), free
+
+
+def _snap(free, warm=(), fenced=(), held=None, total=8):
+    return {"schema": CAPACITY_SCHEMA, "total": total,
+            "free": sorted(free), "warm": sorted(warm),
+            "fenced": sorted(fenced),
+            "held": held or {}, "warm_ready": len(warm),
+            "ownership_known": True}
+
+
+def test_host_capacity_fragmentation_index():
+    # 2x2 block 0..3: one component -> index 0
+    entry = host_capacity(_snap([0, 1, 2, 3]))
+    assert entry["fragmentation_index"] == 0.0
+    assert entry["largest_block"] == 4
+    assert entry["admissible_block_sizes"] == [1, 2, 4]
+    assert entry["best_block"] == [0, 1, 2, 3]
+    # scattered corners of a 2x4 host: 0 and 7 share no link
+    entry = host_capacity(_snap([0, 7]))
+    assert entry["largest_block"] == 1
+    assert entry["fragmentation_index"] == 0.5
+    # empty free set: nothing to fragment
+    entry = host_capacity(_snap([]))
+    assert entry["fragmentation_index"] == 0.0
+    assert entry["admissible_block_sizes"] == []
+    assert "best_block" not in entry
+    # unknown (legacy worker / scrape fallback)
+    assert host_capacity(None) == {"capacity_unknown": True}
+
+
+# --- the master plane: feasibility for every topology type ---
+
+
+class _FleetStub:
+    """Minimal FleetCollector stand-in: canned node entries."""
+
+    def __init__(self, nodes):
+        self.nodes = nodes
+
+    def payload(self, max_age_s=None):
+        return {"at": 1.0, "nodes": self.nodes}
+
+
+def _plane(nodes, cfg=None, elastic=None):
+    return CapacityPlane(_FleetStub(nodes), cfg=cfg or Config(),
+                         elastic=elastic)
+
+
+def _node_entry(free, warm=(), fenced=(), held=None, total=8):
+    return {"capacity": _snap(free, warm, fenced, held, total)}
+
+
+def test_feasibility_every_topology_type_admissible_when_fleet_free():
+    """64 fully-free 8-chip hosts (512 chips): every published shape
+    whose chips-per-host fits an 8-chip host and whose host count fits
+    the fleet must be admissible; every verdict is one of the three
+    documented values; types bigger than the fleet are untracked."""
+    from gpumounter_tpu.master import topology
+    nodes = {f"n-{i}": _node_entry(range(8)) for i in range(64)}
+    table = _plane(nodes).payload()["feasibility"]
+    assert set(table) == set(topology._TOPOLOGIES)
+    for accel_type, topo in topology._TOPOLOGIES.items():
+        entry = table[accel_type]
+        assert entry["verdict"] in ("admissible",
+                                    "admissible-after-defrag",
+                                    "infeasible"), accel_type
+        assert entry["chips_per_host"] == topo.chips_per_host_count
+        assert entry["hosts_needed"] == topo.num_hosts
+        assert entry["tracked"] == (topo.total_chips <= 512)
+        if topo.chips_per_host_count <= 8 and topo.num_hosts <= 64:
+            assert entry["verdict"] == "admissible", accel_type
+            assert entry["blocking_hosts"] == []
+
+
+def test_feasibility_fragmented_hosts_read_after_defrag():
+    """4 hosts each holding 4 free chips whose set {0,3,4,7} has no
+    4-chip ICI component on the 2x4 grid: v5litepod-16 (4 hosts x 4
+    chips) must read admissible-after-defrag with the fragmented hosts
+    named."""
+    scattered = [0, 3, 4, 7]  # on the 2x4 grid: no 4-chip component
+    assert largest_ici_block(scattered) < 4
+    nodes = {f"frag-{i}": _node_entry(scattered) for i in range(4)}
+    entry = _plane(nodes).payload()["feasibility"]["v5litepod-16"]
+    assert entry["verdict"] == "admissible-after-defrag"
+    assert entry["hosts_admissible_now"] == 0
+    assert entry["hosts_after_defrag"] == 4
+    assert sorted(entry["blocking_hosts"]) == [f"frag-{i}"
+                                               for i in range(4)]
+
+
+def test_feasibility_infeasible_when_chips_missing():
+    nodes = {"only": _node_entry([0, 1])}
+    table = _plane(nodes).payload()["feasibility"]
+    assert table["v5litepod-16"]["verdict"] == "infeasible"
+    assert table["v5litepod-16"]["tracked"] is False  # 16 > 8 chips
+
+
+def test_feasibility_warm_chips_count_toward_defrag():
+    """Warm holders are reclaimable bookings: a host with 2 free + 2
+    warm chips can host a 4-block after the pool is drained+defragged,
+    but not right now."""
+    nodes = {"w": _node_entry(free=[0, 3], warm=[4, 7])}
+    entry = _plane(nodes).payload()["feasibility"]["v5litepod-4"]
+    assert entry["verdict"] == "admissible-after-defrag"
+    assert entry["blocking_hosts"] == ["w"]
+
+
+def test_fleet_rollup_and_fragmentation_weighting():
+    nodes = {
+        "a": _node_entry(free=[0, 1, 2, 3]),        # one 4-block
+        "b": _node_entry(free=[0, 7]),              # scattered pair
+        "legacy": {"capacity": None},               # scrape fallback
+    }
+    payload = _plane(nodes).payload()
+    fleet = payload["fleet"]
+    assert fleet["hosts"] == 3
+    assert fleet["hosts_reporting"] == 2
+    assert fleet["free"] == 6
+    assert fleet["largest_block"] == 4
+    # achievable = 4 + 1 of 6 free -> index 1 - 5/6
+    assert fleet["fragmentation_index"] == round(1 - 5 / 6, 4)
+    assert payload["nodes"]["legacy"]["capacity_unknown"] is True
+
+
+def test_observe_counts_only_fragmentation_denials():
+    """The slice-feasibility SLO counters: a fully-utilized fleet (no
+    free chips) must record ZERO bad events — only
+    fragmentation-caused denials (admissible-after-defrag) burn."""
+    from gpumounter_tpu.obs.capacity import (
+        CAPACITY_SIZE_FEASIBLE,
+        CAPACITY_SIZE_INFEASIBLE,
+    )
+    held = {str(i): "default/p" for i in range(8)}
+    busy = {f"busy-{i}": {"capacity": _snap([], held=held)}
+            for i in range(4)}
+    good0, bad0 = CAPACITY_SIZE_FEASIBLE.total(), \
+        CAPACITY_SIZE_INFEASIBLE.total()
+    _plane(busy).observe(busy)
+    assert CAPACITY_SIZE_INFEASIBLE.total() == bad0
+    assert CAPACITY_SIZE_FEASIBLE.total() > good0
+    # fragmented-but-free fleet: bad events accrue
+    scattered = {f"s-{i}": _node_entry([0, 3, 4, 7]) for i in range(4)}
+    _plane(scattered).observe(scattered)
+    assert CAPACITY_SIZE_INFEASIBLE.total() > bad0
+
+
+def test_observe_host_cache_reuses_unchanged_nodes(monkeypatch):
+    """Steady-state passes must not re-derive hosts whose inventory
+    did not change (the collect-overhead budget)."""
+    nodes = {f"n-{i}": _node_entry(range(8)) for i in range(4)}
+    plane = _plane(nodes)
+    plane.observe(nodes)
+    calls = []
+    real = capacity_mod.host_capacity
+    monkeypatch.setattr(capacity_mod, "host_capacity",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    plane.observe(nodes)
+    assert not calls  # every node served from the cache
+    changed = dict(nodes)
+    changed["n-0"] = _node_entry(range(4))
+    plane.observe(changed)
+    assert len(calls) == 1  # only the changed node re-derived
+
+
+def test_stale_nodes_never_count_as_live_capacity():
+    """A node the collector marked stale (worker stopped answering —
+    the entry is its LAST KNOWN state) must not feed feasibility or
+    the fleet rollup: a verdict resting on a dead node's free chips
+    would green-light mounts that are guaranteed to fail."""
+    nodes = {"live": _node_entry(range(8)),
+             "dead": {**_node_entry(range(8)), "stale": True}}
+    payload = _plane(nodes).payload()
+    assert payload["nodes"]["dead"]["capacity_unknown"] is True
+    assert payload["nodes"]["dead"]["stale"] is True
+    fleet = payload["fleet"]
+    assert fleet["hosts_reporting"] == 1
+    assert fleet["free"] == 8  # only the live node's chips
+    # v4-16 needs 2 hosts of 4 contiguous chips: one live host is not
+    # enough, and the dead node must not make up the difference
+    assert payload["feasibility"]["v4-16"]["verdict"] == "infeasible"
+    assert payload["feasibility"]["v4-16"]["hosts_admissible_now"] == 1
+
+
+def test_payload_reuses_host_cache(monkeypatch):
+    """A polled /capacity read over an unchanged fleet must not
+    re-derive every host (same dedup the observe path gets)."""
+    nodes = {f"n-{i}": _node_entry(range(8)) for i in range(4)}
+    plane = _plane(nodes)
+    plane.payload()
+    calls = []
+    real = capacity_mod.host_capacity
+    monkeypatch.setattr(capacity_mod, "host_capacity",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    payload = plane.payload()
+    assert not calls
+    assert payload["fleet"]["free"] == 32
+
+
+def test_headroom_forecast_tightens_with_queue_depth():
+    free_nodes = {"a": _node_entry(range(8))}
+    plane = _plane(free_nodes)
+    assert plane.payload()["headroom"]["forecast"] == "ok"
+    # tenants with queue depth above free capacity -> tight
+    entry = _node_entry([0])
+    entry["tenants"] = {"t1": {"at": 5.0, "queue_depth": 50,
+                               "tokens_per_s": 10.0}}
+    held = {str(i): "d/p" for i in range(1, 8)}
+    entry["capacity"]["held"] = held
+    busy = {"a": entry}
+    headroom = _plane(busy).payload()["headroom"]
+    assert headroom["queue_depth"] == 50
+    assert headroom["forecast"] == "tight"
+    # zero free on a non-empty fleet -> exhausted
+    drained = {"a": _node_entry([], held={str(i): "d/p"
+                                          for i in range(8)})}
+    assert _plane(drained).payload()["headroom"]["forecast"] == \
+        "exhausted"
+
+
+def test_rejection_verdict_lands_in_audit_and_timeline():
+    from gpumounter_tpu.obs.audit import AUDIT
+    from gpumounter_tpu.obs.flight import FLIGHT, install
+    install()
+    nodes = {"n-0": _node_entry([0, 3, 4, 7])}  # fragmented: no 4-block
+    plane = _plane(nodes)
+    before = len(AUDIT.snapshot())
+    verdict = plane.record_rejection("n-0", "default", "victim", 4)
+    assert verdict["cause"] == "fragmentation"
+    assert verdict["node_free"] == 4
+    assert verdict["node_largest_block"] < 4
+    records = AUDIT.snapshot()[before:]
+    rejections = [r for r in records
+                  if r["operation"] == "capacity.reject"]
+    assert len(rejections) == 1
+    rec = rejections[0]
+    assert rec["pod"] == "victim"
+    assert "fragmentation" in rec["outcome"]
+    assert rec["details"]["node"] == "n-0"
+    # the audit subscriber mirrors it onto the flight timeline
+    timeline = [r for r in FLIGHT.snapshot()
+                if r["kind"] == "audit"
+                and "capacity.reject" in r["summary"]]
+    assert timeline, "rejection verdict missing from the timeline"
+    # exhaustion shape: fewer free chips than wanted
+    verdict = plane.record_rejection("n-0", "default", "victim", 6)
+    assert verdict["cause"] == "exhaustion"
+
+
+def test_module_level_rejection_is_noop_without_plane():
+    capacity_mod.register_plane(None)
+    capacity_mod.record_rejection("n", "ns", "p", 1)  # must not raise
+    nodes = {"n": _node_entry(range(8))}
+    plane = _plane(nodes)
+    capacity_mod.register_plane(plane)
+    capacity_mod.record_rejection("n", "ns", "p", 1)
+
+
+def test_default_objectives_include_slice_feasibility():
+    from gpumounter_tpu.obs.slo import DEFAULT_OBJECTIVES
+    names = {o.name: o for o in DEFAULT_OBJECTIVES}
+    assert "slice-feasibility" in names
+    objective = names["slice-feasibility"]
+    assert objective.kind == "ratio"
+    assert objective.good == "slice_feasible"
+    assert objective.bad == "slice_infeasible"
+
+
+# --- worker half: snapshot classification + warm-gauge agreement ---
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    from gpumounter_tpu.testing.cluster import FakeCluster
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    yield cluster
+    cluster.stop()
+
+
+def _collector(cluster, cfg):
+    from gpumounter_tpu.collector.collector import TpuCollector
+    from gpumounter_tpu.collector.podresources import PodResourcesClient
+    return TpuCollector(
+        backend=cluster.backend,
+        podresources=PodResourcesClient(cfg.kubelet_socket,
+                                        timeout_s=5.0),
+        cfg=cfg)
+
+
+def test_node_capacity_snapshot_classification(cluster):
+    cfg = cluster.cfg.replace(node_name=cluster.node_name)
+    collector = _collector(cluster, cfg)
+    # a held chip: schedule a TPU-requesting pod through the fake
+    cluster.kube.create_pod("default", {
+        "metadata": {"name": "holder", "namespace": "default"},
+        "spec": {"containers": [{
+            "name": "m",
+            "resources": {"limits": {cfg.tpu_resource_name: "1"}}}]},
+    })
+    # a dead chip
+    cluster.kill_chip(3)
+    snap = node_capacity_snapshot(collector, cfg=cfg)
+    assert snap["schema"] == CAPACITY_SCHEMA
+    assert snap["total"] == 4
+    assert snap["fenced"] == [3]
+    held_indices = sorted(int(i) for i in snap["held"])
+    assert len(held_indices) == 1
+    assert set(snap["free"]) == {0, 1, 2} - set(held_indices)
+    assert snap["warm"] == []
+    assert snap["ownership_known"] is True
+    owner = snap["held"][str(held_indices[0])]
+    assert owner == "default/holder"
+
+
+def test_warm_holders_classified_warm_and_gauge_agrees(cluster):
+    """Satellite: the warm pool's per-node ready gauge and the
+    /capacity warm coverage must describe the same number — both read
+    the pool's own book, and the chip classification follows it."""
+    from gpumounter_tpu.allocator.pool import WarmPodPool
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    cfg = cluster.cfg.replace(node_name=cluster.node_name,
+                              warm_pool_size=2)
+    pool = WarmPodPool(cluster.kube, cfg=cfg, refill_async=False)
+    pool.refill_once()
+    assert pool.ready_count(cluster.node_name) == 2
+    collector = _collector(cluster, cfg)
+    snap = node_capacity_snapshot(collector, pool=pool, cfg=cfg)
+    assert len(snap["warm"]) == 2
+    assert snap["warm_ready"] == 2
+    gauge = REGISTRY.find("tpumounter_warm_pool_ready")
+    assert gauge.get(node=cluster.node_name) == 2.0
+    assert len(snap["free"]) == 2
+    assert snap["held"] == {}
+
+
+def test_warm_gauge_series_exists_from_registration(cluster):
+    from gpumounter_tpu.allocator.pool import WarmPodPool
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    cfg = cluster.cfg.replace(warm_pool_size=1)
+    pool = WarmPodPool(cluster.kube, cfg=cfg, refill_async=False)
+    pool.ensure_node("fresh-node")
+    gauge = REGISTRY.find("tpumounter_warm_pool_ready")
+    assert gauge.get(node="fresh-node") == 0.0
+
+
+# --- the /capacity route + satellite 1 e2e over the fake cluster ---
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """Worker gRPC + master HTTP over one fake node, warm pool of 1 —
+    the smallest stack where /capacity, warm classification and the
+    slave_pod_schedule span attrs are all real."""
+    import threading
+    import urllib.request
+
+    from gpumounter_tpu.allocator.allocator import TpuAllocator
+    from gpumounter_tpu.allocator.pool import WarmPodPool
+    from gpumounter_tpu.config import set_config
+    from gpumounter_tpu.master.app import (
+        MasterApp,
+        WorkerRegistry,
+        build_http_server,
+    )
+    from gpumounter_tpu.testing.cluster import FakeCluster
+    from gpumounter_tpu.worker.mounter import MountTarget, TpuMounter
+    from gpumounter_tpu.worker.server import TpuMountService, build_server
+    from conftest import AUTH_HEADER, TEST_AUTH_TOKEN
+
+    cluster = FakeCluster(str(tmp_path), n_chips=4).start()
+    cfg0 = cluster.cfg.replace(node_name=cluster.node_name,
+                               warm_pool_size=1,
+                               auth_token=TEST_AUTH_TOKEN)
+    set_config(cfg0)
+    collector = _collector(cluster, cfg0)
+    pool = WarmPodPool(cluster.kube, cfg=cfg0, refill_async=False)
+    pool.refill_once()
+    allocator = TpuAllocator(cluster.kube, collector, cfg=cfg0,
+                             pool=pool)
+    mounter = TpuMounter(cluster.backend, cfg=cfg0)
+    container_dev = tmp_path / "container-dev"
+    container_dev.mkdir()
+    mounter.resolve_target = lambda pod: MountTarget(
+        dev_dir=str(container_dev),
+        description=f"{pod.namespace}/{pod.name}")
+    service = TpuMountService(cluster.kube, collector=collector,
+                              allocator=allocator, mounter=mounter,
+                              cfg=cfg0, pool=pool)
+    grpc_server = build_server(service, address="localhost:0")
+    grpc_server.start()
+    cfg = cfg0.replace(worker_port=grpc_server.bound_port,
+                       fleet_scrape_interval_s=3600.0)
+    cluster.kube.create_pod(cfg.worker_namespace, {
+        "metadata": {"name": "cap-worker",
+                     "namespace": cfg.worker_namespace,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": cluster.node_name,
+                 "containers": [{"name": "w"}]},
+        "status": {"phase": "Running", "podIP": "127.0.0.1"}})
+    app = MasterApp(cluster.kube, cfg=cfg,
+                    registry=WorkerRegistry(cluster.kube, cfg))
+    httpd = build_http_server(app, port=0, host="127.0.0.1")
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def http(method, path, token_header=AUTH_HEADER):
+        req = urllib.request.Request(base + path, method=method,
+                                     headers=dict(token_header))
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    yield SimpleStack(cluster=cluster, app=app, http=http, pool=pool,
+                      service=service)
+    httpd.shutdown()
+    httpd.server_close()
+    app.registry.stop()
+    grpc_server.stop(grace=None)
+    cluster.stop()
+    from gpumounter_tpu.config import Config as _Config
+    set_config(_Config())
+
+
+class SimpleStack:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def test_capacity_route_payload_and_auth(stack):
+    status, body = stack.http("GET", "/capacity")
+    assert status == 200
+    payload = json.loads(body)
+    node = payload["nodes"][stack.cluster.node_name]
+    assert node["total"] == 4
+    assert node["warm"] == 1  # the warm holder books one chip
+    assert node["free"] == 3
+    assert payload["fleet"]["warm"] == 1
+    assert payload["feasibility"]["v5litepod-1"]["verdict"] == \
+        "admissible"
+    assert payload["headroom"]["forecast"] == "ok"
+    # read scope: no credentials -> 401 (mutate token required without
+    # a read token configured)
+    status, _ = stack.http("GET", "/capacity", token_header={})
+    assert status == 401
+    # accel_type filter + unknown 404
+    status, body = stack.http("GET", "/capacity?accel_type=v5litepod-4")
+    assert status == 200
+    assert list(json.loads(body)["feasibility"]) == ["v5litepod-4"]
+    status, _ = stack.http("GET", "/capacity?accel_type=bogus-9000")
+    assert status == 404
+
+
+def test_insufficient_add_records_capacity_verdict(stack):
+    from gpumounter_tpu.obs.audit import AUDIT
+    stack.cluster.add_target_pod("greedy")
+    before = len([r for r in AUDIT.snapshot()
+                  if r["operation"] == "capacity.reject"])
+    # 5 chips on a 4-chip node: unschedulable -> InsufficientTPU
+    status, _ = stack.http(
+        "GET", "/addtpu/namespace/default/pod/greedy/tpu/5"
+               "/isEntireMount/false")
+    assert status == 500
+    rejections = [r for r in AUDIT.snapshot()
+                  if r["operation"] == "capacity.reject"]
+    assert len(rejections) == before + 1
+    assert rejections[-1]["pod"] == "greedy"
+    assert "want 5 chip(s)" in rejections[-1]["outcome"]
+
+
+def test_mount_span_carries_pool_outcome(stack):
+    """Satellite 1: the mount.slave_pod_schedule span carries
+    pool_hit/pool_gap — here a 2-chip mount against a pool of 1 adopts
+    one warm holder and cold-creates the other."""
+    from gpumounter_tpu.obs import trace
+    stack.cluster.add_target_pod("spanpod")
+    status, _ = stack.http(
+        "GET", "/addtpu/namespace/default/pod/spanpod/tpu/2"
+               "/isEntireMount/false")
+    assert status == 200
+    spans = [s for s in trace.TRACER.ring.snapshot()
+             if s.get("name") == "mount.slave_pod_schedule"]
+    assert spans, "no slave_pod_schedule span exported"
+    attrs = spans[-1].get("attrs") or {}
+    assert attrs.get("pool_enabled") is True
+    assert attrs.get("pool_hit") == 1
+    assert attrs.get("pool_gap") == 1
+
+
+# --- trace.set_attrs unit ---
+
+
+def test_set_attrs_lands_on_innermost_open_span():
+    from gpumounter_tpu.obs import trace
+    tracer = trace.Tracer(ring_capacity=16)
+    with trace.span("outer", tracer=tracer):
+        with trace.span("inner", tracer=tracer, fixed="x"):
+            trace.set_attrs(late=1)
+        trace.set_attrs(outer_late=2)
+    spans = {s["name"]: s for s in tracer.ring.snapshot()}
+    assert spans["inner"]["attrs"] == {"fixed": "x", "late": 1}
+    assert spans["outer"]["attrs"] == {"outer_late": 2}
+    # no open span: a plain no-op
+    trace.set_attrs(ignored=True)
+
+
+# --- CLI exit codes (the /capacity payload contract) ---
+
+
+def _cli_payload(feasibility_verdict="admissible", satisfiable=True):
+    return {
+        "fleet": {"free": 4, "total": 8, "warm": 0, "fenced": 0,
+                  "fragmentation_index": 0.0, "largest_block": 4,
+                  "hosts": 1, "hosts_reporting": 1},
+        "feasibility": {"v5litepod-4": {
+            "verdict": feasibility_verdict, "hosts_admissible_now": 1,
+            "hosts_needed": 1, "hosts_after_defrag": 1,
+            "blocking_hosts": []}},
+        "headroom": {"forecast": "ok", "free_chips": 4,
+                     "queue_depth": 0, "tokens_per_s": 0, "tenants": 0},
+        "demand": {"intents": 1, "desired_chips": 9, "actual_chips": 1,
+                   "gap": 8, "satisfiable": satisfiable},
+    }
+
+
+def _run_capacity_cli(monkeypatch, payload, status=200, accel=None):
+    from gpumounter_tpu import cli
+    monkeypatch.setattr(
+        cli, "_http",
+        lambda args, method, path, **kw: (status, json.dumps(payload)))
+    monkeypatch.setattr(cli, "_obs_token", lambda args: None)
+    args = ["capacity", "--master", "http://x"]
+    if accel:
+        args += ["--accel-type", accel]
+    parsed = cli.build_parser().parse_args(args)
+    return parsed.fn(parsed)
+
+
+def test_cli_capacity_exit_codes(monkeypatch, capsys):
+    assert _run_capacity_cli(monkeypatch, _cli_payload()) == 0
+    # --accel-type infeasible -> 3
+    assert _run_capacity_cli(
+        monkeypatch, _cli_payload("infeasible"), accel="v5litepod-4") == 3
+    # after-defrag is not infeasible -> 0
+    assert _run_capacity_cli(
+        monkeypatch, _cli_payload("admissible-after-defrag"),
+        accel="v5litepod-4") == 0
+    # unknown accel type -> 2
+    assert _run_capacity_cli(monkeypatch, {}, status=404,
+                             accel="bogus") == 2
+    # declared demand no longer fits -> 3 (without --accel-type)
+    assert _run_capacity_cli(
+        monkeypatch, _cli_payload(satisfiable=False)) == 3
+    err = capsys.readouterr().err
+    assert "DEMAND UNSATISFIABLE" in err
+
+
+def test_cli_why_names_pool_starvation(monkeypatch, capsys):
+    from gpumounter_tpu import cli
+
+    def payload(pool_hit, pool_gap, enabled):
+        return {
+            "op": "http.add", "wall_ms": 100.0, "nodes": ["n"],
+            "complete": True, "roots": 1,
+            "critical_path": [
+                {"phase": "slave_pod_schedule", "ms": 88.7,
+                 "share": 0.887}],
+            "dominant": {"phase": "slave_pod_schedule", "share": 0.887},
+            "phases": {"slave_pod_schedule": 88.7},
+            "spans": [{"name": "mount.slave_pod_schedule",
+                       "attrs": {"pool_hit": pool_hit,
+                                 "pool_gap": pool_gap,
+                                 "pool_enabled": enabled}}],
+        }
+
+    def run(doc):
+        monkeypatch.setattr(
+            cli, "_http",
+            lambda args, method, path, **kw: (200, json.dumps(doc)))
+        monkeypatch.setattr(cli, "_obs_token", lambda args: None)
+        parsed = cli.build_parser().parse_args(
+            ["why", "--master", "http://x", "deadbeef"])
+        rc = parsed.fn(parsed)
+        return rc, capsys.readouterr().out
+
+    rc, out = run(payload(0, 2, True))
+    assert rc == 0
+    assert "warm-pool starvation" in out
+    rc, out = run(payload(0, 2, False))
+    assert "scheduler wait" in out
+    assert "warm pool disabled" in out
+    rc, out = run(payload(2, 0, True))
+    assert "scheduler wait" in out

@@ -192,6 +192,31 @@ def test_trace_assembly_detects_dropped_worker_spans(tmp_path):
         assert "INCOMPLETE" in str(err.value)
 
 
+# --- invariant 17: capacity-plane agreement (ISSUE 14) ---
+
+
+def test_capacity_invariant_detects_withheld_unmount(tmp_path):
+    """NEGATIVE CONTROL for invariant 17: after a clean mount, erase
+    one held chip's kubelet claim without unmounting it (the divergence
+    a lost/withheld unmount leaves) — the capacity check must flag it
+    as divergence; a books==capacity check that cannot fail proves
+    nothing. (The positive side — capacity == ground truth after every
+    scenario — rides the three seeded scenario tests above, which now
+    run invariant 17 inside check_invariants.)"""
+    from gpumounter_tpu.master.slice_ops import SliceTarget
+    with ChaosHarness(str(tmp_path), seed=3) as h:
+        h.add_pod("cap-pod", NODE_A)
+        h._coordinator().mount_slice(
+            [SliceTarget(namespace="default", pod="cap-pod")], 1,
+            entire=False)
+        h.check_invariants()  # sanity: capacity agrees before tampering
+        assert h.withhold_unmount(NODE_A) is not None
+        with pytest.raises(InvariantViolation) as err:
+            h.check_invariants()
+        assert "capacity divergence" in str(err.value)
+        assert "seed=3" in str(err.value)
+
+
 # --- invariant 9: single shard owner per node (ISSUE 7) ---
 
 

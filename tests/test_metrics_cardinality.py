@@ -30,6 +30,9 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 #: and ingest counters are unlabeled; the flight recorder's records
 #: counter is labeled only by its fixed kind vocabulary (6 values) —
 #: span/trace ids stay in the JSON plane, never in labels. No bump.
+#: Reviewed for ISSUE 14 (capacity plane): two fleet-level gauges and
+#: two unlabeled counters — chip indices, host names and accelerator
+#: types ride the JSON plane (/capacity), never labels. No bump.
 SERIES_BUDGET = 400
 
 
@@ -103,6 +106,10 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         assert http("GET", "/fleet")[0] == 200
         assert http("GET", "/slo")[0] == 200
         assert http("GET", "/tenants")[0] == 200
+        # ISSUE 14 capacity plane: the budgeted run includes the
+        # /capacity rollup (chip indices + host names + accelerator
+        # types must all stay in the JSON payload, never labels).
+        assert http("GET", "/capacity")[0] == 200
         # ISSUE 13 trace-plane surfaces: the budgeted run includes the
         # assembled /trace read and the flight recorder's /timeline.
         assert http("GET", "/timeline")[0] == 200
@@ -181,6 +188,42 @@ def test_trace_plane_series_are_bounded():
     assert grown <= 3 + len(KINDS), (
         f"trace plane grew {grown} series — an unbounded label "
         f"(span/trace id? node name?) slipped into an instrument")
+
+
+def test_capacity_plane_series_are_bounded():
+    """ISSUE 14 guard: heavy capacity traffic — hundreds of hosts with
+    distinct free-index sets, every accelerator type evaluated, many
+    observe passes — grows the exposition only by the fixed fleet-level
+    capacity series. Chip indices, host names and accelerator types
+    must never become label values."""
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.obs.capacity import CAPACITY_SCHEMA, CapacityPlane
+
+    class _Fleet:
+        def payload(self, max_age_s=None):
+            return {"at": 0.0, "nodes": {}}
+
+    before = REGISTRY.series_count()
+    plane = CapacityPlane(_Fleet(), cfg=Config())
+    for round_i in range(5):
+        nodes = {}
+        for host in range(200):
+            free = [i for i in range(8) if (host + i + round_i) % 3]
+            nodes[f"card-host-{host}"] = {"capacity": {
+                "schema": CAPACITY_SCHEMA, "total": 8,
+                "free": free, "warm": [], "fenced": [],
+                "held": {str(i): f"ns/pod-{host}"
+                         for i in range(8) if i not in free},
+                "warm_ready": 0, "ownership_known": True}}
+        plane.observe(nodes)
+        plane.record_rejection(f"card-host-{round_i}", "ns",
+                               f"pod-{round_i}", 4)
+    grown = REGISTRY.series_count() - before
+    # 2 fleet gauges + 2 unlabeled counters, nothing per-host/per-type
+    assert grown <= 4, (
+        f"capacity plane grew {grown} series — an unbounded label "
+        f"(chip index? host name? accelerator type?) slipped into an "
+        f"instrument")
 
 
 def test_tenant_label_cardinality_is_capped():
