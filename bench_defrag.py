@@ -1,0 +1,561 @@
+"""ICI defragmenter bench: capacity recovered under churn + the
+checkpoint-assisted drain's tenant-visible cost.
+
+Three legs, each against the production code for the layer it measures:
+
+  * churn (A/B) — a 256-node fleet under seeded, ICI-blind
+    mount/unmount churn, run twice from the same seed: defrag off vs
+    defrag on (the REAL planner, gpumounter_tpu/defrag/planner.py,
+    planning every DEFRAG_INTERVAL steps and its moves applied to the
+    books). Sampled throughout: the fleet fragmentation index and the
+    large-slice allocation success rate — graded multi-host slice
+    requests (4 contiguous chips per host across N/32..N/4 hosts)
+    admitted right now. The committed artifact must show the defrag-on
+    run admitting measurably more large slices;
+
+  * drain (real stack) — live migrations over the chaos harness with a
+    REAL instrumented tenant (jaxside TenantTelemetry over the worker
+    ops port): N classic drains vs N checkpoint-assisted drains
+    (migrate v2, begin(checkpoint=True)), tenant-visible downtime
+    windows read back from the tenant ledger and split per class. The
+    checkpoint p95 must beat BOTH the in-run classic p95 and the
+    committed BENCH_tenant_r01.json tenant-visible p95 baseline;
+
+  * live defrag (real stack) — the full controller path on a
+    fragmented fleet with the moved tenant attached and publishing:
+    plan -> run -> completed, every move checkpoint-assisted, the
+    tenant SLOs NOT breached by the moves (zero breaches attributable
+    to defrag), and chaos invariant 18 over the recorded run.
+
+Usage:
+  python bench_defrag.py               -> writes BENCH_defrag_r01.json
+  python bench_defrag.py --check FILE  -> CI smoke (env-shrunk): gates
+      the allocation-success win, the checkpoint-drain win, tenant-SLO
+      non-regression and invariant 18; never overwrites the committed
+      artifact (TPM_DEFRAG_ARTIFACT redirects the fresh copy).
+
+Env knobs (CI smoke uses small values):
+  TPM_DEFRAG_NODES        churn fleet nodes              (default 256)
+  TPM_DEFRAG_CHIPS        chips per node                 (default 8)
+  TPM_DEFRAG_STEPS        churn operations               (default 600)
+  TPM_DEFRAG_SAMPLE       sample every N churn ops       (default 25)
+  TPM_DEFRAG_INTERVAL     defrag planning period (steps) (default 50)
+  TPM_DEFRAG_MIGRATIONS   drains per class (real stack)  (default 3)
+  TPM_DEFRAG_UTIL         churn target chip utilization  (default 0.65)
+  TPM_DEFRAG_SEED         churn rng seed                 (default 20260807)
+  TPM_DEFRAG_ARTIFACT     where to write the artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-defrag-secret")
+os.environ.setdefault("TPUMOUNTER_AUTH", "token")
+
+ARTIFACT = os.path.join(REPO, "BENCH_defrag_r01.json")
+TENANT_BASELINE = os.path.join(REPO, "BENCH_tenant_r01.json")
+
+NODES = int(os.environ.get("TPM_DEFRAG_NODES", "256"))
+CHIPS = int(os.environ.get("TPM_DEFRAG_CHIPS", "8"))
+STEPS = int(os.environ.get("TPM_DEFRAG_STEPS", "600"))
+SAMPLE_EVERY = int(os.environ.get("TPM_DEFRAG_SAMPLE", "25"))
+INTERVAL = int(os.environ.get("TPM_DEFRAG_INTERVAL", "50"))
+MIGRATIONS = int(os.environ.get("TPM_DEFRAG_MIGRATIONS", "3"))
+UTIL = float(os.environ.get("TPM_DEFRAG_UTIL", "0.65"))
+SEED = int(os.environ.get("TPM_DEFRAG_SEED", "20260807"))
+
+TARGET_BLOCK = 4
+
+
+# --- leg 1: churn A/B over the real planner ------------------------------
+
+
+class ChurnSim:
+    """Per-node chip books under ICI-blind churn: small tenants mount
+    1-2 RANDOM free indices (the placement pattern that fragments a
+    fleet), unmount at random. The defrag-on run feeds these books to
+    the real planner and applies its moves — the same book mutation a
+    live migration performs."""
+
+    def __init__(self, nodes: int, chips: int, seed: int):
+        self.rng = random.Random(seed)
+        self.chips = chips
+        self.total_chips = nodes * chips
+        self.held_chips = 0
+        self.state = {f"df-node-{i}": {"free": set(range(chips)),
+                                       "held": {}}
+                      for i in range(nodes)}
+        self.allocations: dict[str, tuple[str, list[int]]] = {}
+        self._seq = 0
+
+    @property
+    def utilization(self) -> float:
+        return self.held_chips / self.total_chips
+
+    def mount(self) -> bool:
+        want = self.rng.randint(1, 2)
+        fits = [n for n, s in self.state.items()
+                if len(s["free"]) >= want]
+        if not fits:
+            return False
+        node = self.rng.choice(fits)
+        state = self.state[node]
+        picked = self.rng.sample(sorted(state["free"]), want)
+        tenant = f"bench/t{self._seq}"
+        self._seq += 1
+        for index in picked:
+            state["free"].discard(index)
+            state["held"][index] = tenant
+        self.held_chips += len(picked)
+        self.allocations[tenant] = (node, picked)
+        return True
+
+    def unmount(self) -> bool:
+        if not self.allocations:
+            return False
+        tenant = self.rng.choice(sorted(self.allocations))
+        node, picked = self.allocations.pop(tenant)
+        state = self.state[node]
+        for index in picked:
+            state["held"].pop(index, None)
+            state["free"].add(index)
+        self.held_chips -= len(picked)
+        return True
+
+    def capacity_nodes(self) -> dict:
+        """The fleet-collector node-entry shape the planner consumes."""
+        return {node: {"capacity": {
+            "free": sorted(s["free"]),
+            "held": {i: s["held"][i] for i in sorted(s["held"])},
+            "warm": [], "fenced": [],
+        }} for node, s in self.state.items()}
+
+    def apply(self, plan: dict) -> int:
+        """Execute a plan against the books — the same free/held flip a
+        live migration's unmount+remount performs."""
+        applied = 0
+        for move in plan["moves"]:
+            tenant = f"{move['namespace']}/{move['pod']}"
+            src = self.state[move["source_node"]]
+            dst = self.state[move["dest_node"]]
+            for index in move["source_indices"]:
+                src["held"].pop(index, None)
+                src["free"].add(index)
+            for index in move["dest_indices"]:
+                dst["free"].discard(index)
+                dst["held"][index] = tenant
+            self.allocations[tenant] = (move["dest_node"],
+                                        list(move["dest_indices"]))
+            applied += 1
+        return applied
+
+
+def run_churn(defrag_on: bool) -> dict:
+    from gpumounter_tpu.defrag.planner import (
+        fleet_fragmentation_index,
+        parse_hosts,
+    )
+    from gpumounter_tpu.obs.capacity import largest_ici_block
+
+    sim = ChurnSim(NODES, CHIPS, SEED)
+    # Pre-fill to the target utilization so the measured churn runs at
+    # the operating point where fragmentation bites: random 1-2 chip
+    # placements at ~60% leave most hosts with free chips but few with
+    # a contiguous TARGET_BLOCK.
+    while sim.utilization < UTIL:
+        if not sim.mount():
+            break
+    # graded multi-host slice shapes: 4 contiguous chips per host
+    # across an increasing host count — "large slices" relative to the
+    # fleet (N/32, N/16, N/8, N/4 hosts)
+    shapes = sorted({max(1, NODES // d) for d in (32, 16, 8, 4)})
+    samples: list[dict] = []
+    attempts = 0
+    successes = 0
+    moves_applied = 0
+    plans = 0
+    for step in range(1, STEPS + 1):
+        # biased coin holds utilization at the target equilibrium while
+        # every op still churns chip positions (ICI-blind)
+        p_mount = 0.85 if sim.utilization < UTIL else 0.15
+        op = "mount" if sim.rng.random() < p_mount else "unmount"
+        getattr(sim, op)()
+        if defrag_on and step % INTERVAL == 0:
+            from gpumounter_tpu.defrag.planner import plan_moves
+            now = time.time()
+            plan = plan_moves(sim.capacity_nodes(),
+                              target_block=TARGET_BLOCK,
+                              max_moves=8, tenant_move_budget=1,
+                              snapshot_at=now, max_snapshot_age_s=60.0,
+                              now=now)
+            plans += 1
+            moves_applied += sim.apply(plan)
+        if step % SAMPLE_EVERY and step != STEPS:
+            continue
+        admitting = sum(
+            largest_ici_block(sorted(s["free"])) >= TARGET_BLOCK
+            for s in sim.state.values())
+        frag = fleet_fragmentation_index(
+            parse_hosts(sim.capacity_nodes()))
+        granted = {}
+        for hosts_needed in shapes:
+            attempts += 1
+            ok = admitting >= hosts_needed
+            successes += ok
+            granted[str(hosts_needed)] = ok
+        samples.append({"step": step, "hosts_admitting": admitting,
+                        "fragmentation_index": frag,
+                        "slices_admitted": granted})
+    frags = [s["fragmentation_index"] for s in samples]
+    admits = [s["hosts_admitting"] for s in samples]
+    return {
+        "defrag": defrag_on,
+        "samples": len(samples),
+        "slice_shapes_hosts": shapes,
+        "allocation_attempts": attempts,
+        "allocation_successes": successes,
+        "allocation_success_rate": round(successes / attempts, 4)
+        if attempts else 0.0,
+        "hosts_admitting_mean": round(sum(admits) / len(admits), 2)
+        if admits else 0.0,
+        "fragmentation_mean": round(sum(frags) / len(frags), 4)
+        if frags else 0.0,
+        "fragmentation_final": frags[-1] if frags else 0.0,
+        "plans": plans,
+        "moves_applied": moves_applied,
+        "trajectory": samples,
+    }
+
+
+# --- legs 2+3: the real stack ---------------------------------------------
+
+
+def _pct(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    index = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return round(ordered[index], 3)
+
+
+def run_drain() -> dict:
+    """N classic vs N checkpoint-assisted live migrations of the SAME
+    instrumented tenant, ping-ponged between two nodes; tenant-visible
+    downtime windows split per class via the journals' trace ids."""
+    from gpumounter_tpu.master.slice_ops import SliceTarget
+    from gpumounter_tpu.obs.tenants import TENANTS
+    from gpumounter_tpu.testing.chaos import NODE_A, NODE_B, ChaosHarness
+    from gpumounter_tpu.worker.main import serve_ops
+
+    token = os.environ["TPUMOUNTER_AUTH_TOKEN"]
+    TENANTS.reset()
+    with tempfile.TemporaryDirectory() as root:
+        with ChaosHarness(os.path.join(root, "cluster"), seed=11) as h:
+            ops = serve_ops(0, cfg=h.cfg)
+            publish = f"http://127.0.0.1:{ops.server_address[1]}"
+            try:
+                coordinator = h._coordinator()
+                h.add_pod("drain-a", NODE_A)
+                h.add_pod("drain-b", NODE_B)
+                coordinator.mount_slice(
+                    [SliceTarget(namespace="default", pod="drain-a")],
+                    2, entire=False)
+                sim = h.attach_tenant(
+                    "default", "drain-a",
+                    extra_pods=(("default", "drain-b"),),
+                    publish_url=publish, token=token)
+                time.sleep(0.3)
+
+                journals = []
+                source, dest = "drain-a", "drain-b"
+                # alternate classes so runner drift (thermal, page
+                # cache) cannot bias one side
+                for i in range(2 * MIGRATIONS):
+                    checkpoint = bool(i % 2)
+                    journal = h.app.migrations.begin(
+                        "default", source, "default", dest,
+                        checkpoint=checkpoint)
+                    final = h.app.migrations.wait(journal["id"],
+                                                  timeout_s=60.0)
+                    assert final and final.get("outcome") == \
+                        "succeeded", final
+                    journals.append(final)
+                    source, dest = dest, source
+                    time.sleep(0.2)  # window closes + steps resume
+
+                time.sleep(1.0)
+                sim.settle()
+                assert sim.telemetry.publish(), "tenant publish lost"
+                h.app.fleet.collect_once()
+                ledger = h.app.fleet.tenants_payload()
+                h.check_invariants()
+
+                by_trace = {j.get("trace_id"): j for j in journals
+                            if j.get("trace_id")}
+                classic: list[float] = []
+                ckpt: list[float] = []
+                unmatched = 0
+                for entry in ledger["tenants"].values():
+                    for window in entry["disruption"]["windows"]:
+                        if window["cause"] != "migration":
+                            continue
+                        journal = by_trace.get(window.get("trace_id"))
+                        if journal is None:
+                            unmatched += 1
+                            continue
+                        ms = window["duration_s"] * 1000.0
+                        if journal.get("checkpointed"):
+                            ckpt.append(ms)
+                        else:
+                            classic.append(ms)
+                return {
+                    "migrations_per_class": MIGRATIONS,
+                    "classic": {
+                        "windows": len(classic),
+                        "p50_ms": _pct(classic, 0.50),
+                        "p95_ms": _pct(classic, 0.95),
+                    },
+                    "checkpoint": {
+                        "windows": len(ckpt),
+                        "p50_ms": _pct(ckpt, 0.50),
+                        "p95_ms": _pct(ckpt, 0.95),
+                    },
+                    "unmatched_windows": unmatched,
+                    "control_plane_downtime_s": {
+                        "classic": [j.get("downtime_s") for j in journals
+                                    if not j.get("checkpointed")],
+                        "checkpoint": [j.get("downtime_s")
+                                       for j in journals
+                                       if j.get("checkpointed")],
+                    },
+                }
+            finally:
+                ops.shutdown()
+                ops.server_close()
+
+
+def run_live_defrag() -> dict:
+    """The full controller path on a fragmented fleet with the moved
+    tenant attached: plan -> run -> completed, moves checkpoint-
+    assisted, tenant SLOs unburned, invariant 18 over the run."""
+    from gpumounter_tpu.obs.tenants import TENANTS
+    from gpumounter_tpu.testing.chaos import ChaosHarness
+    from gpumounter_tpu.worker.main import serve_ops
+
+    token = os.environ["TPUMOUNTER_AUTH_TOKEN"]
+    TENANTS.reset()
+    with tempfile.TemporaryDirectory() as root:
+        with ChaosHarness(os.path.join(root, "cluster"), seed=12) as h:
+            ops = serve_ops(0, cfg=h.cfg)
+            publish = f"http://127.0.0.1:{ops.server_address[1]}"
+            try:
+                h.seed_fragmentation()
+                sim = h.attach_tenant(
+                    "default", "df-keep",
+                    extra_pods=(("default", "df-standby"),),
+                    publish_url=publish, token=token)
+                time.sleep(0.3)
+
+                before = h.app.capacity.payload(max_age_s=0.0)
+                plan = h.app.defrag.plan(target_block=TARGET_BLOCK)
+                h.app.defrag.run(plan["id"], wait=True)
+                run = h.app.defrag.payload()["history"][-1]
+                h.defrag_runs.append(run)
+
+                time.sleep(1.0)
+                sim.settle()
+                assert sim.telemetry.publish(), "tenant publish lost"
+                h.app.fleet.collect_once()
+                after = h.app.capacity.payload(max_age_s=0.0)
+                slo = h.app.slo.evaluate()
+                h.check_invariants()
+
+                tenant_slo = {
+                    o["name"]: {"sli": o["sli"],
+                                "breached": o["breached"],
+                                "burn_fast": o["burn_fast"]}
+                    for o in slo["objectives"]
+                    if o["name"] in ("tenant-migration-downtime",
+                                     "slice-feasibility")}
+                return {
+                    "plan_moves": len(plan["moves"]),
+                    "run_status": run["status"],
+                    "moves": [{"outcome": m.get("outcome"),
+                               "checkpointed": m.get("checkpointed"),
+                               "downtime_s": m.get("downtime_s"),
+                               "trace_id": m.get("trace_id")}
+                              for m in run["moves"]],
+                    "barriers": [
+                        {"label": b["label"],
+                         "fragmentation_index":
+                             b.get("fragmentation_index")}
+                        for b in run["barriers"]],
+                    "verdict_before": before["feasibility"]["v4-16"][
+                        "verdict"],
+                    "verdict_after": after["feasibility"]["v4-16"][
+                        "verdict"],
+                    "tenant_slo": tenant_slo,
+                    "slo_breaches": sum(
+                        1 for entry in tenant_slo.values()
+                        if entry["breached"]),
+                    "invariant_18": "pass",
+                }
+            finally:
+                ops.shutdown()
+                ops.server_close()
+
+
+def run_bench() -> dict:
+    t_start = time.time()
+    churn_off = run_churn(defrag_on=False)
+    churn_on = run_churn(defrag_on=True)
+    drain = run_drain()
+    live = run_live_defrag()
+    baseline_p95 = None
+    if os.path.exists(TENANT_BASELINE):
+        with open(TENANT_BASELINE, encoding="utf-8") as fh:
+            baseline_p95 = json.load(fh).get(
+                "migration_downtime_ms", {}).get("p95")
+    return {
+        "bench": "defrag",
+        "schema": "tpumounter-defrag-bench/r01",
+        "at": round(t_start, 3),
+        "duration_s": round(time.time() - t_start, 3),
+        "config": {
+            "nodes": NODES, "chips_per_node": CHIPS,
+            "churn_steps": STEPS, "defrag_interval_steps": INTERVAL,
+            "target_block": TARGET_BLOCK, "seed": SEED,
+            "migrations_per_class": MIGRATIONS,
+        },
+        "churn": {
+            "defrag_off": {k: v for k, v in churn_off.items()
+                           if k != "trajectory"},
+            "defrag_on": {k: v for k, v in churn_on.items()
+                          if k != "trajectory"},
+            "allocation_success_win": round(
+                churn_on["allocation_success_rate"]
+                - churn_off["allocation_success_rate"], 4),
+            "trajectory_off": churn_off["trajectory"],
+            "trajectory_on": churn_on["trajectory"],
+        },
+        "drain": {
+            **drain,
+            "tenant_baseline_p95_ms": baseline_p95,
+        },
+        "live_defrag": live,
+        "invariants": "pass",
+    }
+
+
+def check(committed_path: str, fresh: dict) -> int:
+    with open(committed_path, encoding="utf-8") as fh:
+        committed = json.load(fh)
+    failures = []
+    churn = fresh["churn"]
+    if churn["allocation_success_win"] <= 0.0:
+        failures.append(
+            f"defrag-on allocation success rate "
+            f"{churn['defrag_on']['allocation_success_rate']} not above "
+            f"defrag-off {churn['defrag_off']['allocation_success_rate']}"
+            f" (committed win "
+            f"{committed['churn']['allocation_success_win']})")
+    if churn["defrag_on"]["fragmentation_mean"] \
+            >= churn["defrag_off"]["fragmentation_mean"]:
+        failures.append(
+            f"defrag-on mean fragmentation "
+            f"{churn['defrag_on']['fragmentation_mean']} not below "
+            f"defrag-off {churn['defrag_off']['fragmentation_mean']}")
+    if not churn["defrag_on"]["moves_applied"]:
+        failures.append("defrag-on run applied zero moves — the "
+                        "planner never engaged under churn")
+    drain = fresh["drain"]
+    if drain["checkpoint"]["p95_ms"] >= drain["classic"]["p95_ms"]:
+        failures.append(
+            f"checkpoint-drain p95 {drain['checkpoint']['p95_ms']}ms "
+            f"not below classic {drain['classic']['p95_ms']}ms in-run")
+    # Runner-tolerant absolute ceiling vs the committed tenant
+    # baseline: catches the drain window breaking open, not CI jitter.
+    baseline = drain.get("tenant_baseline_p95_ms") or 487.5
+    budget = max(4.0 * baseline, 5000.0)
+    if drain["checkpoint"]["p95_ms"] > budget:
+        failures.append(
+            f"checkpoint-drain p95 {drain['checkpoint']['p95_ms']}ms "
+            f"above runner budget {budget:.0f}ms (tenant baseline "
+            f"{baseline}ms)")
+    if drain["unmatched_windows"]:
+        failures.append(f"{drain['unmatched_windows']} migration "
+                        f"window(s) without a matching journal trace")
+    live = fresh["live_defrag"]
+    if live["run_status"] != "completed":
+        failures.append(f"live defrag run ended {live['run_status']}")
+    if live["slo_breaches"]:
+        failures.append(f"{live['slo_breaches']} tenant-SLO breach(es) "
+                        f"attributable to defrag moves")
+    if any(not m.get("checkpointed") for m in live["moves"]):
+        failures.append("a live defrag move degraded to the classic "
+                        "drain (tenant checkpoint ack lost)")
+    if live["verdict_after"] != "admissible":
+        failures.append(
+            f"feasibility verdict after defrag is "
+            f"{live['verdict_after']}, expected admissible "
+            f"(before: {live['verdict_before']})")
+    if failures:
+        print("DEFRAG BENCH CHECK FAILED:", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print(f"defrag bench check ok: allocation success "
+          f"{churn['defrag_off']['allocation_success_rate']} -> "
+          f"{churn['defrag_on']['allocation_success_rate']} "
+          f"(+{churn['allocation_success_win']}), checkpoint p95 "
+          f"{drain['checkpoint']['p95_ms']}ms vs classic "
+          f"{drain['classic']['p95_ms']}ms, live run "
+          f"{live['run_status']} with {live['slo_breaches']} SLO "
+          f"breach(es)")
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", metavar="ARTIFACT", default=None,
+                        help="CI smoke: re-run (env-shrunk) and gate "
+                             "against the committed artifact; never "
+                             "overwrites it")
+    args = parser.parse_args()
+    fresh = run_bench()
+    if args.check:
+        out = os.environ.get("TPM_DEFRAG_ARTIFACT")
+        if out:
+            with open(out, "w", encoding="utf-8") as fh:
+                json.dump(fresh, fh, indent=1)
+        raise SystemExit(check(args.check, fresh))
+    artifact = os.environ.get("TPM_DEFRAG_ARTIFACT", ARTIFACT)
+    with open(artifact, "w", encoding="utf-8") as fh:
+        json.dump(fresh, fh, indent=1)
+    summary = {
+        "metric": "defrag",
+        "allocation_success_off":
+            fresh["churn"]["defrag_off"]["allocation_success_rate"],
+        "allocation_success_on":
+            fresh["churn"]["defrag_on"]["allocation_success_rate"],
+        "checkpoint_p95_ms": fresh["drain"]["checkpoint"]["p95_ms"],
+        "classic_p95_ms": fresh["drain"]["classic"]["p95_ms"],
+        "live_run": fresh["live_defrag"]["run_status"],
+        "slo_breaches": fresh["live_defrag"]["slo_breaches"],
+    }
+    print(json.dumps(summary))
+    print(f"wrote {artifact}")
+
+
+if __name__ == "__main__":
+    main()

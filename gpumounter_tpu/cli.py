@@ -43,6 +43,10 @@ Two families of verbs:
     shards                         shard -> owner replica table
     recovery [--evacuate NODE]     node-failure recovery plane: liveness
                                    verdicts + evacuation history
+    defrag [--plan|--run|--pause]  ICI defragmenter: plan/run/pause a
+                                   capacity-recovery migration sequence
+                                   (no flag: the state pane; exit 3
+                                   when the controller is gated)
     apihealth                      API-outage degraded mode: ApiHealth
                                    verdict, cache staleness, write-behind
                                    queue (exit 3 when not healthy)
@@ -579,6 +583,46 @@ def cmd_recovery(args) -> int:
     return 3 if unhealthy else 0
 
 
+def cmd_defrag(args) -> int:
+    """The ICI defragmenter. No flag: the state pane (GET /defrag, exit
+    3 when the controller is gated — API degraded or an SLO burning).
+    --plan computes and adopts a migration plan, --run executes the
+    adopted plan, --pause stops after the in-flight move (all POST;
+    mutate token). A 409/503 refusal (stale snapshot, SLO burn,
+    degraded API) exits 2: the controller refused, nothing moved."""
+    if args.plan:
+        body_json = ({"target_block": args.target_block}
+                     if args.target_block else {})
+        status, body = _http(args, "POST", "/defrag/plan",
+                             json_body=body_json,
+                             token=_remote_token(args))
+    elif args.run:
+        body_json = {"plan_id": args.plan_id} if args.plan_id else {}
+        status, body = _http(args, "POST", "/defrag/run",
+                             json_body=body_json,
+                             token=_remote_token(args))
+    elif args.pause:
+        status, body = _http(args, "POST", "/defrag/pause",
+                             json_body={}, token=_remote_token(args))
+    else:
+        status, body = _http(args, "GET", "/defrag",
+                             token=_obs_token(args))
+        print(body.rstrip())
+        if status != 200:
+            return 1
+        try:
+            gates = json.loads(body).get("gates", {})
+        except ValueError:
+            return 1
+        gated = (not gates.get("api_ok", True)
+                 or gates.get("slo_burning"))
+        return 3 if gated else 0
+    print(body.rstrip())
+    if status in (409, 503):
+        return 2
+    return 0 if status == 200 else 1
+
+
 def _parse_bulk_target(raw: str, default_ns: str) -> dict:
     """"[ns/]pod[:chips]" -> a /batch/addtpu target entry."""
     body, _, chips = raw.partition(":")
@@ -668,6 +712,7 @@ def cmd_migrate_start(args) -> int:
         "source": {"namespace": args.namespace, "pod": args.pod},
         "destination": {"namespace": args.dest_namespace or args.namespace,
                         "pod": args.dest_pod},
+        "checkpoint": bool(args.checkpoint),
     }
     token = _remote_token(args)
     status, body = _http(args, "POST", "/migrate",
@@ -702,13 +747,37 @@ def cmd_migrate_start(args) -> int:
     return EXIT_ERROR
 
 
+def _print_phase_durations(journal: dict) -> None:
+    """One stderr line per terminal migration naming where the wall
+    time went — the journal's per-phase durations are the same numbers
+    the defrag cost model reads, so an operator sees exactly what a
+    future move of this tenant is priced at."""
+    durations = journal.get("phase_durations_s")
+    if not durations or not journal.get("outcome"):
+        return
+    rendered = " ".join(f"{phase}={seconds:.2f}s"
+                        for phase, seconds in durations.items())
+    total = sum(durations.values())
+    print(f"{journal.get('id')}: {journal.get('outcome')} in "
+          f"{total:.2f}s ({rendered})", file=sys.stderr)
+
+
 def cmd_migrate_status(args) -> int:
     path = f"/migrations/{args.id}" if args.id else "/migrations"
     status, body = _http(args, "GET", path, token=_remote_token(args))
     print(body.rstrip())
     if 400 <= status < 500:
         return EXIT_REJECTED
-    return EXIT_OK if status == 200 else EXIT_ERROR
+    if status != 200:
+        return EXIT_ERROR
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return EXIT_OK
+    for journal in (payload.get("migrations", [])
+                    if args.id is None else [payload]):
+        _print_phase_durations(journal)
+    return EXIT_OK
 
 
 def cmd_migrate_abort(args) -> int:
@@ -841,6 +910,10 @@ def build_parser() -> argparse.ArgumentParser:
     ms.add_argument("--dest-namespace", default=None,
                     help="destination namespace (default: --namespace)")
     ms.add_argument("--dest-pod", required=True, help="destination pod")
+    ms.add_argument("--checkpoint", action="store_true",
+                    help="checkpoint-assisted drain (migration v2): "
+                         "snapshot tenant state before the chips move "
+                         "so the drain window shrinks to a copy")
     ms.add_argument("--wait", action="store_true",
                     help="block until the migration is terminal")
     ms.add_argument("--wait-timeout", type=float, default=300.0)
@@ -969,6 +1042,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="manually evacuate NODE (operator-confirmed "
                          "death; needs the mutate token)")
     rc.set_defaults(fn=cmd_recovery)
+
+    df = sub.add_parser("defrag",
+                        help="ICI defragmenter: recover large-slice "
+                             "capacity by live-migrating tenants off "
+                             "fragmented hosts (no flag: state pane, "
+                             "exit 3 when gated; --plan/--run/--pause "
+                             "mutate, exit 2 on a controller refusal)")
+    _obs_common(df)
+    group = df.add_mutually_exclusive_group()
+    group.add_argument("--plan", action="store_true",
+                       help="compute + adopt a plan from a fresh "
+                            "capacity snapshot")
+    group.add_argument("--run", action="store_true",
+                       help="execute the adopted plan")
+    group.add_argument("--pause", action="store_true",
+                       help="stop after the in-flight move")
+    df.add_argument("--target-block", type=int, default=None,
+                    help="ICI block size to recover (default: "
+                         "DEFRAG_TARGET_BLOCK)")
+    df.add_argument("--plan-id", default=None,
+                    help="with --run: refuse unless this exact plan "
+                         "is still adopted")
+    df.set_defaults(fn=cmd_defrag)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

@@ -287,6 +287,13 @@ class Config:
     # declaring the downtime window closed at the signal instead.
     migrate_resume_timeout_s: float = field(default_factory=lambda: float(
         _env("MIGRATE_RESUME_TIMEOUT_S", "30")))
+    # Migration v2 only (begin(checkpoint=True)): how long the extra
+    # checkpoint phase waits for the tenant's HotResumable pack to land
+    # host-side before draining anyway — a hookless tenant degrades to
+    # the classic cold-restore path, exactly like a missed quiesce ack.
+    migrate_checkpoint_timeout_s: float = field(
+        default_factory=lambda: float(
+            _env("MIGRATE_CHECKPOINT_TIMEOUT_S", "30")))
     migrate_poll_interval_s: float = field(default_factory=lambda: float(
         _env("MIGRATE_POLL_INTERVAL_S", "0.2")))
 
@@ -430,6 +437,38 @@ class Config:
     # derived from.
     capacity_trend_samples: int = field(default_factory=lambda: int(
         _env("CAPACITY_TREND_SAMPLES", "64")))
+
+    # --- ICI defragmenter (gpumounter_tpu/defrag) ---
+    # The background controller is off by default: planning is cheap but
+    # executing a plan migrates live tenants, so turning capacity
+    # recovery into an always-on behavior is an explicit operator
+    # decision. GET/POST /defrag work either way.
+    defrag_enabled: bool = field(default_factory=lambda: _env(
+        "TPUMOUNTER_DEFRAG", "false").lower() in ("1", "true", "yes"))
+    # Cadence of the background plan-and-run loop when enabled.
+    defrag_interval_s: float = field(default_factory=lambda: float(
+        _env("DEFRAG_INTERVAL_S", "300")))
+    # Hard ceiling on moves per plan: a defragmenter that relocates the
+    # whole fleet in one sweep is indistinguishable from an outage.
+    defrag_max_moves: int = field(default_factory=lambda: int(
+        _env("DEFRAG_MAX_MOVES", "8")))
+    # Per-tenant disruption budget: how many times one tenant may be
+    # migrated across a single plan (the planner refuses plans that
+    # need more, rather than silently exceeding it).
+    defrag_tenant_move_budget: int = field(default_factory=lambda: int(
+        _env("DEFRAG_TENANT_MOVE_BUDGET", "1")))
+    # A plan is only valid against the capacity snapshot it was computed
+    # from; past this age the planner REFUSES (the negative-control
+    # contract: refuse, never thrash against a stale view).
+    defrag_snapshot_max_age_s: float = field(default_factory=lambda: float(
+        _env("DEFRAG_SNAPSHOT_MAX_AGE_S", "60")))
+    # ICI block size (chips) the planner recovers toward when no
+    # explicit target is requested: 4 is the largest per-host block on
+    # the 8-chip hosts this tree models (obs/capacity.py
+    # HOST_BLOCK_SIZES) and the per-host unit of every multi-host slice
+    # in master/topology.py.
+    defrag_target_block: int = field(default_factory=lambda: int(
+        _env("DEFRAG_TARGET_BLOCK", "4")))
 
     # --- tenant-side telemetry (gpumounter_tpu/jaxside/telemetry.py +
     # obs/tenants.py) ---

@@ -1,0 +1,32 @@
+"""ICI defragmenter: capacity recovery through live migration.
+
+PR 14's capacity plane (obs/capacity.py) can *say* a slice shape is
+`admissible-after-defrag`; this package is the subsystem that acts on
+the verdict. The planner (planner.py) turns a capacity snapshot into a
+minimal-cost sequence of tenant moves, the controller (controller.py)
+executes it through the live-migration machine (migrate/orchestrator.py)
+with the v2 checkpoint-assisted drain, hard-gated on tenant-SLO burn and
+ApiHealth, and closes the loop with a `capacity.recovered` audit stamp.
+"""
+
+from gpumounter_tpu.defrag.controller import (
+    ANNOT_DEFRAG_DEST,
+    DefragController,
+    DefragRefused,
+)
+from gpumounter_tpu.defrag.planner import (
+    PlanError,
+    fleet_fragmentation_index,
+    parse_hosts,
+    plan_moves,
+)
+
+__all__ = [
+    "ANNOT_DEFRAG_DEST",
+    "DefragController",
+    "DefragRefused",
+    "PlanError",
+    "fleet_fragmentation_index",
+    "parse_hosts",
+    "plan_moves",
+]

@@ -1,0 +1,610 @@
+"""Defrag controller: plan, gate, and drive capacity-recovery moves.
+
+Master-side, wired by MasterApp after the recovery controller. The
+controller owns the policy seams the planner deliberately does not:
+
+  * gating — a plan is never computed or continued while the k8s API
+    is degraded (`ApiHealth`) or while the `tenant-migration-downtime`
+    or `slice-feasibility` SLOs are burning (a defragmenter that adds
+    migration downtime while migration downtime is the problem would
+    be the outage's accelerant),
+  * shard awareness — a sharded replica only plans over nodes it owns
+    (the capacity view already covers exactly those), and every move's
+    fencing epoch rides the migration machine's own stamps,
+  * execution — each move is a real live migration with the v2
+    checkpoint-assisted drain (begin(checkpoint=True)); after every
+    plan group (the barrier points) capacity is re-collected and the
+    fleet fragmentation index sampled, and a completed run stamps
+    `capacity.recovered` — closing the loop `capacity.reject` opened.
+
+Destinations: a move's target pod is an operator-provisioned standby —
+a Running pod on the destination node annotated
+`tpumounter.io/defrag-dest` (see docs/RUNBOOK.md). No standby on the
+planned node blocks that move; the controller records it and stops
+rather than inventing a destination.
+"""
+
+from __future__ import annotations
+
+import copy
+import secrets
+import statistics
+import threading
+import time
+from collections import deque
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.defrag.planner import PlanError, plan_moves
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.k8s.errors import is_outage
+from gpumounter_tpu.k8s.types import Pod
+from gpumounter_tpu.migrate.journal import migration_active
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.obs.flight import FLIGHT
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("defrag")
+
+#: standby destination marker (annotation; any value). Operators
+#: provision warm destination pods and mark them; the controller only
+#: ever mounts chips into pods that opted in.
+ANNOT_DEFRAG_DEST = "tpumounter.io/defrag-dest"
+
+#: SLO objectives whose burn parks the controller (never start or
+#: continue a plan while either is burning).
+GATING_OBJECTIVES = ("tenant-migration-downtime", "slice-feasibility")
+
+DEFRAG_PLANS = REGISTRY.counter(
+    "tpumounter_defrag_plans_total",
+    "Defrag plans computed (including empty no-op plans)")
+DEFRAG_MOVES = REGISTRY.counter(
+    "tpumounter_defrag_moves_total",
+    "Defrag moves executed, by migration outcome")
+DEFRAG_REFUSALS = REGISTRY.counter(
+    "tpumounter_defrag_refusals_total",
+    "Plans/runs refused, by bounded cause vocabulary")
+DEFRAG_RUNNING = REGISTRY.gauge(
+    "tpumounter_defrag_running",
+    "1 while a defrag run is executing moves")
+
+
+class DefragRefused(Exception):
+    """Gate or staleness refusal; maps to an HTTP status. The bounded
+    `cause` vocabulary: stale-snapshot | slo-burn | api-degraded |
+    no-plan | busy."""
+
+    def __init__(self, message: str, cause: str, status: int = 409):
+        super().__init__(message)
+        self.cause = cause
+        self.status = status
+
+
+class DefragController:
+    """One per master process; all state in memory (a restarted master
+    re-plans from fresh capacity — plans are cheap and deliberately
+    not durable, unlike the per-move migration journals, which crash-
+    recover through the migration machine itself)."""
+
+    def __init__(self, kube, migrations, capacity, fleet, slo=None,
+                 apihealth=None, shards=None, cfg=None):
+        self.cfg = cfg or get_config()
+        self.kube = kube
+        self.migrations = migrations
+        self.capacity = capacity
+        self.fleet = fleet
+        self.slo = slo
+        self.apihealth = apihealth
+        self.shards = shards
+        self._lock = OrderedLock("defrag.state")
+        self._plan: dict | None = None
+        self._run: dict | None = None          # the in-flight run view
+        self._history: deque[dict] = deque(maxlen=32)
+        self._pause = threading.Event()
+        self._run_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._loop_thread: threading.Thread | None = None
+
+    # --- gates ---
+
+    def _gate_state(self) -> dict:
+        burning = []
+        if self.slo is not None:
+            try:
+                evaluation = self.slo.evaluate()
+            except Exception as exc:  # noqa: BLE001 — a broken SLO
+                # engine reads as burning: fail closed, the defragmenter
+                # is optional capacity recovery, not a liveness path
+                logger.warning("slo evaluation for defrag gate failed: "
+                               "%s", exc)
+                burning = ["slo-engine-error"]
+            else:
+                threshold = float(evaluation.get("burn_threshold", 2.0))
+                for objective in evaluation.get("objectives", []):
+                    if objective.get("name") not in GATING_OBJECTIVES:
+                        continue
+                    if objective.get("breached") or \
+                            float(objective.get("burn_fast", 0.0)) \
+                            >= threshold:
+                        burning.append(objective["name"])
+        api_ok = self.apihealth is None or self.apihealth.ok()
+        return {"api_ok": api_ok,
+                "api_state": (self.apihealth.state()
+                              if self.apihealth is not None else "ok"),
+                "slo_burning": burning}
+
+    def _check_gates(self, action: str) -> dict:
+        gates = self._gate_state()
+        if not gates["api_ok"]:
+            self._refuse(action, "api-degraded",
+                         f"k8s api is {gates['api_state']}; the "
+                         f"defragmenter parks until it heals", 503)
+        if gates["slo_burning"]:
+            self._refuse(action, "slo-burn",
+                         f"SLO burning: {', '.join(gates['slo_burning'])}"
+                         f"; refusing to add migration disruption on "
+                         f"top of it", 503)
+        return gates
+
+    def _refuse(self, action: str, cause: str, message: str,
+                status: int = 409) -> None:
+        DEFRAG_REFUSALS.inc(outcome=cause)
+        AUDIT.record(f"defrag.{action}", actor="defrag-controller",
+                     outcome=f"refused: {cause}", cause=cause,
+                     detail=message)
+        raise DefragRefused(message, cause, status)
+
+    # --- cost model ---
+
+    def _cost_fn(self):
+        """Per-tenant move-cost estimator from REAL migration history:
+        the journals' terminal stamps carry per-phase wall times (the
+        satellite contract in migrate/orchestrator.py), summed per
+        tenant; the fleet median covers tenants that never moved; the
+        assembled trace (obs/assembly.py) backfills journals that
+        predate the phase stamps. Seconds per move, scaled per chip for
+        tenants with a different chip count than their history."""
+        per_tenant: dict[str, float] = {}
+        totals: list[float] = []
+        try:
+            journals = self.migrations.list_migrations()
+        except Exception as exc:  # noqa: BLE001 — cost model degrades
+            # to the flat default; planning must not fail on history
+            logger.warning("migration history for cost model "
+                           "unavailable: %s", exc)
+            journals = []
+        for journal in journals:
+            if journal.get("outcome") != "succeeded":
+                continue
+            durations = journal.get("phase_durations_s") or {}
+            total = sum(float(v) for v in durations.values())
+            if not total and journal.get("trace_id"):
+                try:
+                    from gpumounter_tpu.obs import assembly
+                    assembled = assembly.assemble(journal["trace_id"])
+                    if assembled:
+                        total = float(
+                            assembled.get("wall_ms", 0.0)) / 1000.0
+                except Exception:  # noqa: BLE001 — backfill only
+                    total = 0.0
+            if not total:
+                continue
+            src = journal.get("source") or {}
+            tenant = f"{src.get('namespace')}/{src.get('pod')}"
+            per_tenant[tenant] = total
+            totals.append(total)
+        fleet_median = statistics.median(totals) if totals else 1.0
+
+        def cost(tenant: str, n_chips: int) -> float:
+            base = per_tenant.get(tenant)
+            if base is not None:
+                return base
+            return fleet_median * max(1, n_chips)
+        return cost
+
+    def _resolve_tenants(self, nodes: dict) -> dict:
+        """Capacity's per-chip `held` map names the BOOKING holder —
+        for chips mounted through slave pods that is the tpu-pool
+        slave, not the tenant. Rewrite every holder to its owner pod
+        (the slave's tpumounter.io/owner[-namespace] annotations,
+        allocator/allocator.py) so plans, per-tenant budgets and the
+        cost model all speak in tenants the migration machine can
+        actually move. A holder that cannot be resolved stays as-is:
+        the planner will price and budget it under the booking name,
+        and the move fails loudly at admission instead of silently."""
+        cache: dict[str, str] = {}
+        resolved: dict[str, dict] = {}
+        for node, entry in nodes.items():
+            cap = entry.get("capacity") \
+                if isinstance(entry, dict) else None
+            if not isinstance(cap, dict) \
+                    or not isinstance(cap.get("held"), dict):
+                resolved[node] = entry
+                continue
+            entry = dict(entry)
+            entry["capacity"] = dict(cap)
+            entry["capacity"]["held"] = {
+                index: self._owner_of(holder, cache)
+                for index, holder in cap["held"].items()}
+            resolved[node] = entry
+        return resolved
+
+    def _owner_of(self, holder, cache: dict[str, str]) -> str:
+        holder = str(holder)
+        if holder in cache:
+            return cache[holder]
+        namespace, _, pod_name = holder.partition("/")
+        owner = holder
+        try:
+            pod = Pod(self.kube.get_pod(namespace, pod_name))
+        except Exception as exc:  # noqa: BLE001 — triage, then keep
+            # the booking name (admission is the loud failure path)
+            (logger.debug if is_outage(exc) else logger.info)(
+                "holder %s unresolvable (%s); planning against the "
+                "booking name", holder, exc)
+        else:
+            owner_ns = pod.annotations.get(
+                "tpumounter.io/owner-namespace")
+            owner_name = pod.annotations.get("tpumounter.io/owner")
+            if owner_ns and owner_name:
+                owner = f"{owner_ns}/{owner_name}"
+        cache[holder] = owner
+        return owner
+
+    # --- planning ---
+
+    def plan(self, target_block: int | None = None) -> dict:
+        """Compute and adopt a plan from a FRESH capacity snapshot.
+        Raises DefragRefused on any gate; a no-move plan is returned,
+        not raised (nothing blocked is a fine fleet state)."""
+        with trace.span("defrag.plan"):
+            self._check_gates("plan")
+            target = int(target_block or self.cfg.defrag_target_block)
+            max_age = float(self.cfg.defrag_snapshot_max_age_s)
+            try:
+                rollup = self.fleet.payload(max_age_s=max_age)
+            except Exception as exc:  # noqa: BLE001 — collection
+                # failure means NO trustworthy snapshot: refuse like a
+                # stale one (same contract), louder when it was an
+                # outage (the api gate will catch the next attempt)
+                self._refuse(
+                    "plan", "stale-snapshot",
+                    f"capacity collection failed "
+                    f"({'api outage' if is_outage(exc) else exc}); "
+                    f"refusing to plan blind", 503)
+            nodes = rollup.get("nodes") or {}
+            if self.shards is not None and self.shards.active():
+                nodes = {n: e for n, e in nodes.items()
+                         if self.shards.owns_node(n)}
+            nodes = self._resolve_tenants(nodes)
+            try:
+                plan = plan_moves(
+                    nodes,
+                    target_block=target,
+                    max_moves=int(self.cfg.defrag_max_moves),
+                    tenant_move_budget=int(
+                        self.cfg.defrag_tenant_move_budget),
+                    snapshot_at=rollup.get("at"),
+                    max_snapshot_age_s=max_age,
+                    now=time.time(),
+                    cost_fn=self._cost_fn())
+            except PlanError as exc:
+                self._refuse("plan", exc.cause, str(exc), exc.status)
+            plan["id"] = f"dfp-{secrets.token_hex(4)}"
+            plan["created_at"] = time.time()
+            if self.shards is not None and self.shards.active():
+                # epoch stamp per source node: an operator reading the
+                # plan can tell which fencing epoch its moves will carry
+                plan["epochs"] = {
+                    m["source_node"]:
+                        self.shards.node_epoch(m["source_node"])
+                    for m in plan["moves"]}
+            with self._lock:
+                self._plan = plan
+            DEFRAG_PLANS.inc()
+            summary = (f"defrag plan {plan['id']}: {len(plan['moves'])} "
+                       f"move(s) over {len(plan['groups'])} host(s), "
+                       f"fragmentation {plan['fragmentation_before']} "
+                       f"-> {plan['fragmentation_after']} (predicted)")
+            AUDIT.record("defrag.plan", actor="defrag-controller",
+                         outcome=f"planned: {len(plan['moves'])} move(s)",
+                         plan_id=plan["id"], moves=len(plan["moves"]),
+                         target_block=target,
+                         fragmentation_before=plan["fragmentation_before"],
+                         fragmentation_after=plan["fragmentation_after"])
+            FLIGHT.record("marker", summary, plan_id=plan["id"])
+            logger.info("%s", summary)
+            return copy.deepcopy(plan)
+
+    # --- running ---
+
+    def run(self, plan_id: str | None = None,
+            wait: bool = False) -> dict:
+        """Execute the adopted plan (optionally checked against
+        `plan_id`). Gates re-checked now AND before every group. The
+        moves run on a background thread unless wait=True (tests, the
+        background loop)."""
+        self._check_gates("run")
+        with self._lock:
+            if self._run_thread is not None \
+                    and self._run_thread.is_alive():
+                self._refuse("run", "busy",
+                             "a defrag run is already executing", 409)
+            plan = self._plan
+            if plan is None:
+                self._refuse("run", "no-plan",
+                             "no adopted plan; POST /defrag/plan first",
+                             409)
+            if plan_id is not None and plan["id"] != plan_id:
+                self._refuse("run", "no-plan",
+                             f"adopted plan is {plan['id']}, not "
+                             f"{plan_id}", 409)
+            age = time.time() - float(plan["created_at"])
+            if age > float(self.cfg.defrag_snapshot_max_age_s):
+                self._plan = None
+                bound = float(self.cfg.defrag_snapshot_max_age_s)
+                self._refuse("run", "stale-snapshot",
+                             f"plan {plan['id']} is {age:.1f}s old "
+                             f"(bound {bound:.0f}s); the fleet has "
+                             f"moved on — re-plan", 409)
+            plan = copy.deepcopy(plan)
+            self._pause.clear()
+            run = {"plan_id": plan["id"], "status": "running",
+                   "started_at": time.time(), "moves": [],
+                   "barriers": [], "trace_id": None}
+            self._run = run
+        if wait:
+            self._execute(plan)
+            return self.payload()
+        thread = threading.Thread(target=self._execute, args=(plan,),
+                                  name=f"defrag-{plan['id']}",
+                                  daemon=True)
+        with self._lock:
+            self._run_thread = thread
+        thread.start()
+        return self.payload()
+
+    def pause(self) -> dict:
+        """Stop after the in-flight move; idempotent, also clears an
+        adopted-but-unstarted plan from consideration."""
+        self._pause.set()
+        AUDIT.record("defrag.pause", actor="defrag-controller",
+                     outcome="pause requested")
+        return self.payload()
+
+    def _barrier(self, run: dict, label: str) -> float | None:
+        """Re-collect capacity and sample the fleet fragmentation index
+        — one of the plan's barrier points (chaos invariant 18 asserts
+        the samples are monotonically non-increasing)."""
+        try:
+            payload = self.capacity.payload(max_age_s=0.0)
+            index = float(payload["fleet"]["fragmentation_index"])
+        except Exception as exc:  # noqa: BLE001 — a failed sample is
+            # recorded as such; the run's verdict does not depend on it
+            logger.warning("defrag barrier capacity sample failed: %s",
+                           exc)
+            run["barriers"].append({"label": label, "error": str(exc)})
+            return None
+        run["barriers"].append({"label": label, "at": time.time(),
+                                "fragmentation_index": index})
+        FLIGHT.record("marker",
+                      f"defrag {run['plan_id']} barrier {label}: "
+                      f"fragmentation index {index}",
+                      plan_id=run["plan_id"])
+        return index
+
+    def _execute(self, plan: dict) -> None:
+        with trace.span("defrag.run", plan_id=plan["id"]):
+            self._execute_traced(plan)
+
+    def _execute_traced(self, plan: dict) -> None:
+        run = self._run
+        run["trace_id"] = trace.current_trace_id()
+        DEFRAG_RUNNING.set(1.0)
+        frag_before = None
+        succeeded = 0
+        try:
+            failpoints.fire("defrag.run", plan_id=plan["id"])
+            frag_before = self._barrier(run, "start")
+            by_group: dict[str, list[dict]] = {}
+            for move in plan["moves"]:
+                by_group.setdefault(move["group"], []).append(move)
+            for group in plan["groups"]:
+                node = group["node"]
+                if self._pause.is_set():
+                    run["status"] = "paused"
+                    break
+                gates = self._gate_state()
+                if not gates["api_ok"]:
+                    run["status"] = "parked-api"
+                    run["parked"] = gates["api_state"]
+                    DEFRAG_REFUSALS.inc(outcome="api-degraded")
+                    break
+                if gates["slo_burning"]:
+                    run["status"] = "parked-slo"
+                    run["parked"] = gates["slo_burning"]
+                    DEFRAG_REFUSALS.inc(outcome="slo-burn")
+                    break
+                group_ok = True
+                for move in by_group.get(node, []):
+                    outcome = self._execute_move(run, move)
+                    if outcome == "succeeded":
+                        succeeded += 1
+                    else:
+                        group_ok = False
+                        break
+                self._barrier(run, node)
+                if not group_ok:
+                    run["status"] = "failed-move"
+                    break
+            else:
+                if run["status"] == "running":
+                    run["status"] = "completed"
+        except Exception as exc:  # noqa: BLE001 — terminal boundary:
+            # the run view must reach history with a truthful status
+            logger.exception("defrag run %s died: %s", plan["id"], exc)
+            run["status"] = "failed"
+            run["error"] = f"{type(exc).__name__}: {exc}"
+        finally:
+            DEFRAG_RUNNING.set(0.0)
+            run["finished_at"] = time.time()
+            frag_after = self._barrier(run, "end")
+            if succeeded and frag_before is not None \
+                    and frag_after is not None:
+                # the capacity-plane follow-through: the re-collect
+                # above refreshed the rollup; stamp what the run
+                # bought back (mirrored onto the flight recorder by
+                # the audit subscriber)
+                self.capacity.record_recovery(
+                    cause="defrag", plan_id=plan["id"],
+                    fragmentation_before=frag_before,
+                    fragmentation_after=frag_after,
+                    moves=succeeded)
+            AUDIT.record(
+                "defrag.run", actor="defrag-controller",
+                outcome=f"{run['status']}: {succeeded}/"
+                        f"{len(plan['moves'])} move(s)",
+                plan_id=plan["id"], status=run["status"],
+                moves_succeeded=succeeded,
+                moves_planned=len(plan["moves"]))
+            FLIGHT.record("marker",
+                          f"defrag run {plan['id']} {run['status']}: "
+                          f"{succeeded}/{len(plan['moves'])} move(s)",
+                          plan_id=plan["id"])
+            with self._lock:
+                self._history.append(copy.deepcopy(run))
+                self._run = None
+                self._run_thread = None
+                if self._plan is not None \
+                        and self._plan["id"] == plan["id"]:
+                    self._plan = None  # consumed, even on failure
+
+    def _execute_move(self, run: dict, move: dict) -> str:
+        """One live migration with the checkpoint-assisted drain.
+        Returns the migration outcome ("succeeded", ...), or a
+        controller-level refusal string when no destination standby
+        exists."""
+        record = {"namespace": move["namespace"], "pod": move["pod"],
+                  "source_node": move["source_node"],
+                  "dest_node": move["dest_node"],
+                  "chips": move["chips"]}
+        run["moves"].append(record)
+        dest = self._resolve_destination(move)
+        if dest is None:
+            record["outcome"] = "blocked-no-destination"
+            DEFRAG_MOVES.inc(outcome="blocked")
+            logger.warning(
+                "defrag %s: no standby destination pod on %s (annotate "
+                "a Running pod with %s); move of %s/%s blocked",
+                run["plan_id"], move["dest_node"], ANNOT_DEFRAG_DEST,
+                move["namespace"], move["pod"])
+            return "blocked-no-destination"
+        dest_ns, dest_pod = dest
+        record["dest_pod"] = f"{dest_ns}/{dest_pod}"
+        try:
+            journal = self.migrations.begin(
+                move["namespace"], move["pod"], dest_ns, dest_pod,
+                checkpoint=True)
+        except Exception as exc:  # noqa: BLE001 — admission boundary
+            record["outcome"] = "rejected"
+            record["error"] = str(exc)
+            DEFRAG_MOVES.inc(outcome="rejected")
+            return "rejected"
+        record["migration_id"] = journal["id"]
+        record["trace_id"] = journal.get("trace_id")
+        final = self.migrations.wait(journal["id"], timeout_s=600.0)
+        outcome = (final or {}).get("outcome") or "unknown"
+        record["outcome"] = outcome
+        if final is not None:
+            record["downtime_s"] = final.get("downtime_s")
+            record["phases"] = final.get("phase_durations_s")
+            record["checkpointed"] = final.get("checkpointed")
+        DEFRAG_MOVES.inc(outcome=outcome)
+        return outcome
+
+    def _resolve_destination(self, move: dict) -> tuple[str, str] | None:
+        """Find an operator-provisioned standby on the destination node:
+        Running, annotated tpumounter.io/defrag-dest, same namespace as
+        the tenant, not already part of a migration. Deterministic pick
+        (sorted by name) so a re-run converges on the same standby."""
+        try:
+            listed = self.kube.list_pods(move["namespace"])
+        except Exception as exc:  # noqa: BLE001 — treated as no
+            # destination; the run stops instead of guessing (an outage
+            # here would fail the migration admission anyway)
+            logger.warning("standby listing on %s failed (%s): %s",
+                           move["dest_node"],
+                           "api outage" if is_outage(exc) else "api "
+                           "error", exc)
+            return None
+        candidates = []
+        for raw in listed:
+            pod = Pod(raw)
+            if pod.node_name != move["dest_node"]:
+                continue
+            if ANNOT_DEFRAG_DEST not in pod.annotations:
+                continue
+            if pod.name == move["pod"] \
+                    and pod.namespace == move["namespace"]:
+                continue
+            if (pod.phase or "").lower() != "running":
+                continue
+            if migration_active(pod.annotations):
+                continue
+            candidates.append((pod.namespace, pod.name))
+        return min(candidates) if candidates else None
+
+    # --- surfaces ---
+
+    def payload(self) -> dict:
+        """The GET /defrag response."""
+        gates = self._gate_state()
+        with self._lock:
+            plan = copy.deepcopy(self._plan)
+            run = copy.deepcopy(self._run)
+            history = [copy.deepcopy(r) for r in self._history]
+        return {
+            "at": round(time.time(), 3),
+            "enabled": bool(self.cfg.defrag_enabled),
+            "gates": gates,
+            "plan": plan,
+            "run": run,
+            "history": history[-8:],
+        }
+
+    # --- background loop (opt-in via defrag_enabled) ---
+
+    def start(self) -> None:
+        if self._loop_thread is not None:
+            return
+        self._stop.clear()
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="defrag-loop", daemon=True)
+        self._loop_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pause.set()
+        thread, runner = self._loop_thread, self._run_thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        if runner is not None:
+            runner.join(timeout=5.0)
+        self._loop_thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(float(self.cfg.defrag_interval_s)):
+            try:
+                plan = self.plan()
+                if plan["moves"]:
+                    self.run(plan["id"], wait=True)
+            except DefragRefused as exc:
+                logger.info("defrag background pass parked: %s (%s)",
+                            exc, exc.cause)
+            except Exception as exc:  # noqa: BLE001 — the loop is the
+                # capacity-recovery heartbeat; one bad pass must not
+                # kill it
+                logger.exception("defrag background pass failed: %s",
+                                 exc)

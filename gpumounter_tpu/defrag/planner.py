@@ -1,0 +1,319 @@
+"""Defragmentation planner: capacity snapshot -> minimal-cost move plan.
+
+Pure logic, no I/O: the controller feeds it the fleet collector's raw
+node entries (the same `capacity` inventory sections obs/capacity.py
+derives its views from) and gets back a JSON-able plan. Keeping the
+planner side-effect free is what makes the negative control enforceable:
+a stale snapshot is refused HERE, by construction, before anything can
+act on it.
+
+The unit of work is a *group*: the set of moves that flips one blocked
+host's verdict (free chips become one ICI-connected block of the target
+size). Groups are the plan's barrier points — the controller re-collects
+capacity after each one and the chaos harness asserts the fleet
+fragmentation index is monotonically non-increasing across them, which
+the planner guarantees by simulation: a group whose predicted post-state
+raises the index is dropped, not scheduled.
+
+Constraints (config.py `defrag_*`):
+  * at most `max_moves` tenant migrations per plan,
+  * no tenant moved more than `tenant_move_budget` times,
+  * per-move cost from the caller's cost model (real per-tenant phase
+    timings out of the migration journals' terminal stamps; fleet
+    median as fallback) — groups are scheduled cheapest-first, so when
+    the move budget bites, the budget bought the most capacity it could.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+from gpumounter_tpu.allocator import placement
+from gpumounter_tpu.obs.capacity import largest_ici_block
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("defrag.planner")
+
+#: upper bound on tenants evicted per host unblock — subsets are
+#: enumerated exhaustively below this size (hosts have <= 8 chips, so
+#: the search space is tiny); a host needing more eviction than this is
+#: reported blocked instead of swept wholesale.
+MAX_EVICTIONS_PER_HOST = 3
+
+
+class PlanError(Exception):
+    """Planner refusal. `cause` is machine-readable and bounded:
+    "stale-snapshot" is the negative-control contract (a planner fed an
+    outdated capacity view must refuse, not thrash)."""
+
+    def __init__(self, message: str, cause: str = "invalid",
+                 status: int = 409):
+        super().__init__(message)
+        self.cause = cause
+        self.status = status
+
+
+@dataclass
+class HostView:
+    """One host's planning view, parsed from its inventory section."""
+
+    node: str
+    free: set[int] = field(default_factory=set)
+    warm: set[int] = field(default_factory=set)
+    fenced: set[int] = field(default_factory=set)
+    held: dict[int, str] = field(default_factory=dict)  # index -> ns/pod
+    stale: bool = False
+    known: bool = True
+
+    def tenants(self) -> dict[str, list[int]]:
+        out: dict[str, list[int]] = {}
+        for index, tenant in sorted(self.held.items()):
+            out.setdefault(tenant, []).append(index)
+        return out
+
+
+def parse_hosts(nodes: dict[str, dict]) -> dict[str, HostView]:
+    """Fleet-collector node entries -> planning views. Stale entries and
+    nodes without an inventory section parse as unknown: the planner
+    neither evicts from nor places onto a host it cannot see."""
+    hosts: dict[str, HostView] = {}
+    for node, entry in (nodes or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        raw = entry.get("capacity")
+        if bool(entry.get("stale")) or not isinstance(raw, dict):
+            hosts[node] = HostView(node=node, stale=bool(
+                entry.get("stale")), known=False)
+            continue
+        held = {int(k): str(v) for k, v in (raw.get("held") or {}).items()}
+        hosts[node] = HostView(
+            node=node,
+            free={int(i) for i in raw.get("free") or []},
+            warm={int(i) for i in raw.get("warm") or []},
+            fenced={int(i) for i in raw.get("fenced") or []},
+            held=held,
+            known=bool(raw.get("ownership_known", True)))
+    return hosts
+
+
+def fleet_fragmentation_index(hosts: dict[str, HostView]) -> float:
+    """The capacity plane's weighted fleet index (1 - achievable/free)
+    recomputed over planning views — identical math, so a predicted
+    post-plan index and the /capacity payload's are comparable."""
+    free = 0
+    achievable = 0
+    for view in hosts.values():
+        if not view.known and not view.free:
+            continue
+        free += len(view.free)
+        achievable += largest_ici_block(sorted(view.free))
+    return round(1.0 - achievable / free, 4) if free else 0.0
+
+
+def _blocked_hosts(hosts: dict[str, HostView],
+                   target_block: int) -> list[HostView]:
+    """Hosts the feasibility table would call admissible-after-defrag
+    at this block size: enough reclaimable chips (free + warm), but the
+    free set's largest ICI component is too small."""
+    out = []
+    for view in hosts.values():
+        if view.stale or not view.known:
+            continue
+        if len(view.free) + len(view.warm) < target_block:
+            continue
+        if largest_ici_block(sorted(view.free)) >= target_block:
+            continue
+        out.append(view)
+    return sorted(out, key=lambda v: v.node)
+
+
+def _unblock_subset(view: HostView, target_block: int,
+                    cost_fn) -> tuple[list[str], float] | None:
+    """The cheapest tenant subset whose eviction makes this host's free
+    set hold an ICI block of `target_block` chips. Minimality order:
+    fewest moves, then lowest summed cost, then fewest chips evicted.
+    Exhaustive over subsets up to MAX_EVICTIONS_PER_HOST (hosts are
+    small). None when no subset within the bound works."""
+    tenants = view.tenants()
+    names = sorted(tenants)
+    best: tuple[tuple[int, float, int], list[str]] | None = None
+    for size in range(1, min(MAX_EVICTIONS_PER_HOST, len(names)) + 1):
+        for combo in itertools.combinations(names, size):
+            evicted = set().union(*(tenants[t] for t in combo))
+            if largest_ici_block(sorted(view.free | evicted)) \
+                    < target_block:
+                continue
+            cost = sum(cost_fn(t, len(tenants[t])) for t in combo)
+            rank = (size, cost, len(evicted))
+            if best is None or rank < best[0]:
+                best = (rank, list(combo))
+        if best is not None:
+            break  # a smaller subset always beats a larger one
+    if best is None:
+        return None
+    return best[1], best[0][1]
+
+
+def _place(sim: dict[str, HostView], source: str, n_chips: int,
+           avoid: set[str]) -> tuple[str, list[int]] | None:
+    """Pick a destination host + chips for an evicted tenant: best-fit
+    over the simulated free sets (the smallest sufficient ICI component,
+    so a big contiguous block is not shredded for a small tenant), never
+    a host in `avoid` (the hosts this plan is unblocking — re-fragmenting
+    one would undo the plan from inside)."""
+    candidates: list[tuple[int, str]] = []
+    for node, view in sim.items():
+        if node == source or node in avoid or view.stale or not view.known:
+            continue
+        block = largest_ici_block(sorted(view.free))
+        if block >= n_chips:
+            candidates.append((block, node))
+    if not candidates:
+        return None
+    # best fit: smallest sufficient block; node name breaks ties for
+    # deterministic plans (a re-plan over the same snapshot converges)
+    candidates.sort()
+    node = candidates[0][1]
+    chips = placement.best_block(sorted(sim[node].free), n_chips)
+    return node, chips
+
+
+def plan_moves(nodes: dict[str, dict], *,
+               target_block: int,
+               max_moves: int,
+               tenant_move_budget: int = 1,
+               snapshot_at: float | None = None,
+               max_snapshot_age_s: float | None = None,
+               now: float | None = None,
+               cost_fn=None) -> dict:
+    """Compute a move plan from a capacity snapshot.
+
+    `nodes` is the fleet collector's node map (entries carrying the
+    worker-reported `capacity` section). With `snapshot_at` +
+    `max_snapshot_age_s` + `now` the snapshot's age is checked FIRST and
+    a stale one raises PlanError("stale-snapshot") — the negative
+    control. Returns a JSON-able plan dict; `moves` empty when nothing
+    is blocked (a no-op plan is a fine answer, a stale plan is not)."""
+    if max_snapshot_age_s is not None and now is not None:
+        if snapshot_at is None:
+            raise PlanError(
+                "capacity snapshot has no collection timestamp; "
+                "refusing to plan against a view of unknown age",
+                cause="stale-snapshot")
+        age = now - float(snapshot_at)
+        if age > max_snapshot_age_s:
+            raise PlanError(
+                f"capacity snapshot is {age:.1f}s old (bound "
+                f"{max_snapshot_age_s:.0f}s); refusing to plan moves "
+                f"against a stale view — re-collect and re-plan",
+                cause="stale-snapshot")
+    if cost_fn is None:
+        def cost_fn(_tenant: str, n_chips: int) -> float:  # noqa: ANN001
+            return float(n_chips)  # flat per-chip estimate
+    hosts = parse_hosts(nodes)
+    frag_before = fleet_fragmentation_index(hosts)
+    blocked = _blocked_hosts(hosts, target_block)
+
+    # Candidate groups, one per blocked host, cheapest-first.
+    candidates: list[dict] = []
+    skipped: list[dict] = []
+    for view in blocked:
+        found = _unblock_subset(view, target_block, cost_fn)
+        if found is None:
+            skipped.append({"node": view.node,
+                            "reason": "no-eviction-subset"})
+            continue
+        tenants, cost = found
+        candidates.append({"node": view.node, "tenants": tenants,
+                           "est_cost_s": round(cost, 3)})
+    candidates.sort(key=lambda g: (g["est_cost_s"], g["node"]))
+
+    sim = parse_hosts(nodes)  # independent mutable copy to simulate on
+    moves: list[dict] = []
+    groups: list[dict] = []
+    tenant_moves: dict[str, int] = {}
+    frag_at_barrier = frag_before
+    # Never place an evicted tenant onto ANY blocked host (not just the
+    # ones already scheduled): consuming a blocked host's free chips
+    # could make its own unblock — computed upfront — unachievable.
+    blocked_names = {v.node for v in blocked}
+    for group in candidates:
+        node = group["node"]
+        view = sim[node]
+        tenants_here = view.tenants()
+        if len(moves) + len(group["tenants"]) > max_moves:
+            skipped.append({"node": node, "reason": "move-budget"})
+            continue
+        if any(tenant_moves.get(t, 0) + 1 > tenant_move_budget
+               for t in group["tenants"]):
+            skipped.append({"node": node, "reason": "tenant-budget"})
+            continue
+        # Tentatively place every eviction; all-or-nothing per group.
+        unblocking = blocked_names | {node}
+        staged: list[dict] = []
+        placed_ok = True
+        snapshot = {n: (set(v.free), dict(v.held)) for n, v in sim.items()}
+        for tenant in group["tenants"]:
+            chips = tenants_here.get(tenant) or []
+            placed = _place(sim, node, len(chips), avoid=unblocking)
+            if placed is None:
+                placed_ok = False
+                skipped.append({"node": node, "tenant": tenant,
+                                "reason": "no-destination"})
+                break
+            dest, dest_chips = placed
+            namespace, _, pod = tenant.partition("/")
+            staged.append({
+                "namespace": namespace, "pod": pod,
+                "source_node": node, "dest_node": dest,
+                "chips": len(chips), "source_indices": sorted(chips),
+                "dest_indices": sorted(dest_chips),
+                "est_cost_s": round(cost_fn(tenant, len(chips)), 3),
+                "group": node,
+            })
+            # apply to the simulation
+            view.free.update(chips)
+            for index in chips:
+                view.held.pop(index, None)
+            sim[dest].free.difference_update(dest_chips)
+            for index in dest_chips:
+                sim[dest].held[index] = tenant
+        frag_here = fleet_fragmentation_index(sim)
+        if not placed_ok or frag_here > frag_at_barrier:
+            # roll the simulation back; a group that cannot fully place
+            # or would RAISE the fleet index is dropped, never partially
+            # scheduled (the monotonic-barrier invariant is planned-in,
+            # not hoped-for)
+            for n, (free, held) in snapshot.items():
+                sim[n].free = free
+                sim[n].held = held
+            if placed_ok:
+                skipped.append({"node": node,
+                                "reason": "would-raise-fragmentation"})
+            continue
+        for staged_move in staged:
+            tenant = (f"{staged_move['namespace']}/"
+                      f"{staged_move['pod']}")
+            tenant_moves[tenant] = tenant_moves.get(tenant, 0) + 1
+        moves.extend(staged)
+        groups.append({"node": node, "moves": len(staged),
+                       "est_cost_s": group["est_cost_s"],
+                       "predicted_fragmentation_index": frag_here})
+        frag_at_barrier = frag_here
+
+    return {
+        "target_block": int(target_block),
+        "snapshot_at": snapshot_at,
+        "moves": moves,
+        "groups": groups,
+        "skipped": skipped,
+        "blocked_hosts": [v.node for v in blocked],
+        "fragmentation_before": frag_before,
+        "fragmentation_after": frag_at_barrier,
+        "est_disruption_s": {t: round(sum(
+            m["est_cost_s"] for m in moves
+            if f"{m['namespace']}/{m['pod']}" == t), 3)
+            for t in tenant_moves},
+        "tenant_moves": tenant_moves,
+    }

@@ -27,6 +27,9 @@ FAILPOINTS: dict[str, str] = {
                                   "simulation for the chaos harness)",
     # migration machine (gpumounter_tpu/migrate/orchestrator.py)
     "migrate.persist": "before a journal annotation persist",
+    # defragmenter (gpumounter_tpu/defrag/controller.py)
+    "defrag.run": "top of a defrag plan execution, before the first "
+                  "barrier sample",
     # warm pool (gpumounter_tpu/allocator/pool.py)
     "pool.refill": "per-node warm-pool refill attempt",
     # rpc client (gpumounter_tpu/rpc/client.py)

@@ -23,6 +23,22 @@ After each callback returns, the watcher acks by stamping
 `tpumounter.io/migration-ack` — the worker's QuiesceStatus RPC reads it
 back so the orchestrator knows state is packed before it pulls the
 chips (and closes the downtime clock when the restore lands).
+
+Migration v2 (checkpoint-assisted drain, the defrag controller's
+path) adds an optional third signal between quiesce and drain:
+
+    # source-pod process
+    def on_checkpoint(signal):
+        # confirm the pack from on_quiesce is durable host-side —
+        # the orchestrator will not drain a chip until this acks
+        state.save(SHARED_CKPT)
+
+    watch_migration(kube, ns, pod, on_quiesce, on_resume,
+                    on_checkpoint=on_checkpoint)
+
+A tenant without an on_checkpoint handler marks the signal seen but
+does NOT ack it — the orchestrator times out and degrades to the
+classic cold-restore drain, never blocking on a hookless tenant.
 """
 
 from __future__ import annotations
@@ -33,6 +49,7 @@ import time
 from collections.abc import Callable
 
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.errors import is_outage
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.utils.log import get_logger
 
@@ -45,6 +62,7 @@ ANNOT_ACK = "tpumounter.io/migration-ack"
 
 #: signal phase -> (callback slot, ack phase)
 _PHASE_MAP = {"quiesce": ("on_quiesce", "quiesced"),
+              "checkpoint": ("on_checkpoint", "checkpointed"),
               "resume": ("on_resume", "resumed")}
 
 
@@ -66,7 +84,9 @@ def watch_migration(kube: KubeClient, namespace: str, pod_name: str,
                     on_resume: Callable[[dict], None] | None = None,
                     stop: threading.Event | None = None,
                     watch_timeout_s: float = 30.0,
-                    ack: bool = True) -> None:
+                    ack: bool = True,
+                    on_checkpoint: Callable[[dict], None] | None = None,
+                    ) -> None:
     """Blocking loop mirroring watch_chip_replacements: invoke the phase
     callback each time the migration signal changes, then (ack=True)
     stamp the ack annotation the orchestrator is polling for.
@@ -93,7 +113,9 @@ def watch_migration(kube: KubeClient, namespace: str, pod_name: str,
             state["last"] = key  # terminal phases ("done") dedupe too
             return
         slot, ack_phase = _PHASE_MAP[phase]
-        callback = on_quiesce if slot == "on_quiesce" else on_resume
+        callback = {"on_quiesce": on_quiesce,
+                    "on_checkpoint": on_checkpoint,
+                    "on_resume": on_resume}[slot]
         logger.info("migration %s: %s signal received", signal["id"], phase)
         if callback is None:
             # No handler registered for this phase: record it seen but
@@ -119,8 +141,13 @@ def watch_migration(kube: KubeClient, namespace: str, pod_name: str,
                         ANNOT_ACK: json.dumps(marker)}}})
                 logger.info("migration %s: acked %s", signal["id"],
                             ack_phase)
-            except Exception as exc:  # noqa: BLE001 — orchestrator will
-                logger.warning("migration ack failed: %s", exc)  # time out
+            except Exception as exc:  # noqa: BLE001 — the orchestrator
+                # times out and degrades either way; an outage-shaped
+                # failure means the NEXT watch iteration likely fails
+                # too, so say which it was.
+                logger.warning("migration ack failed (%s): %s",
+                               "api outage" if is_outage(exc)
+                               else "api error", exc)
 
     while not stop.is_set():
         try:
@@ -145,6 +172,9 @@ def watch_migration(kube: KubeClient, namespace: str, pod_name: str,
                                 namespace, pod_name)
                     return
                 _deliver(Pod(pod_json).annotations)
-        except Exception as exc:  # noqa: BLE001 — keep watching
-            logger.warning("migration watch failed (%s); retrying", exc)
+        except Exception as exc:  # noqa: BLE001 — keep watching; an
+            # outage is routine (the re-subscribe + re-read pattern
+            # absorbs it), anything else deserves the louder line.
+            (logger.info if is_outage(exc) else logger.warning)(
+                "migration watch failed (%s); retrying", exc)
             stop.wait(1.0)

@@ -35,6 +35,7 @@ from gpumounter_tpu.obs import trace
 from gpumounter_tpu.obs.audit import AUDIT, audited
 from gpumounter_tpu.rpc import api
 from gpumounter_tpu.rpc.client import WorkerClient
+from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -95,8 +96,9 @@ class WorkerRegistry:
         # DELETED eviction exact even when the terminal event no longer
         # carries a podIP (names are unique per namespace at any instant).
         self._cache: dict[str, tuple[str, str]] = {}
-        self._lock = threading.Lock()
-        self._refresh_mu = threading.Lock()  # serializes miss-path LISTs
+        self._lock = OrderedLock("registry.cache")
+        # serializes miss-path LISTs; always taken BEFORE registry.cache
+        self._refresh_mu = OrderedLock("registry.refresh")
         self._primed = threading.Event()
         self._last_list = 0.0
         self._stop = threading.Event()
@@ -368,6 +370,14 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/recovery$"), "recovery"),
     ("POST", re.compile(
         r"^/recovery/evacuate/(?P<node>[^/]+)$"), "recovery_evacuate"),
+    # ICI defragmenter (gpumounter_tpu/defrag/): the plane that acts on
+    # /capacity's `admissible-after-defrag` verdicts — plans a
+    # minimal-cost live-migration sequence and drives it with the
+    # checkpoint-assisted drain. One read pane + three operator verbs.
+    ("GET", re.compile(r"^/defrag$"), "defrag"),
+    ("POST", re.compile(r"^/defrag/plan$"), "defrag_plan"),
+    ("POST", re.compile(r"^/defrag/run$"), "defrag_run"),
+    ("POST", re.compile(r"^/defrag/pause$"), "defrag_pause"),
 ]
 
 
@@ -399,7 +409,8 @@ class MasterApp:
     #: movements — require the mutate token.
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
                              "shards", "recovery", "tenants",
-                             "apihealth", "timeline", "capacity"})
+                             "apihealth", "timeline", "capacity",
+                             "defrag"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -407,7 +418,8 @@ class MasterApp:
     AUDITED_ROUTES = frozenset({
         "add", "remove", "batch_add", "addslice", "removeslice",
         "intent_put", "intent_delete", "migrate_start",
-        "migration_abort", "recovery_evacuate"})
+        "migration_abort", "recovery_evacuate", "defrag_plan",
+        "defrag_run", "defrag_pause"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
@@ -540,6 +552,18 @@ class MasterApp:
             kube, self.registry, self._client_factory, cfg=self.cfg,
             store=self.store, shards=self.shards, elastic=self.elastic,
             migrations=self.migrations, apihealth=self.apihealth)
+        # ICI defragmenter (gpumounter_tpu/defrag/): plans minimal-cost
+        # migration sequences off the capacity plane's fragmentation
+        # verdicts and drives them through the migration machine with
+        # the checkpoint-assisted drain. The background loop only runs
+        # after an explicit defrag.start() (master/main.py, opt-in via
+        # TPUMOUNTER_DEFRAG) — the /defrag routes drive plan()/run()
+        # directly.
+        from gpumounter_tpu.defrag import DefragController
+        self.defrag = DefragController(
+            kube, self.migrations, self.capacity, self.fleet,
+            slo=self.slo, apihealth=self.apihealth, shards=self.shards,
+            cfg=self.cfg)
         # Flight recorder (obs/flight.py): root/error spans, audit
         # records and ApiHealth transitions of this replica feed the
         # /timeline pane. Idempotent — any number of apps/tests share
@@ -575,7 +599,8 @@ class MasterApp:
     #: dashboard-polled scrape surfaces of the same kind.
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
                                  "slo", "shards", "recovery", "tenants",
-                                 "apihealth", "timeline", "capacity"})
+                                 "apihealth", "timeline", "capacity",
+                                 "defrag"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -879,6 +904,74 @@ class MasterApp:
         record = self.recovery.evacuate(node, reason="manual")
         return 200, "application/json", \
             jsonlib.dumps(record, indent=1) + "\n"
+
+    def _route_defrag(self, match, body, headers):
+        """The defragmenter's state pane: gate verdicts (ApiHealth +
+        SLO burn), the adopted plan, the in-flight run with its barrier
+        fragmentation samples, and recent run history — the RUNBOOK's
+        'Recovering capacity with the defragmenter' walkthrough reads
+        this between every step."""
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.defrag.payload(), indent=1) + "\n"
+
+    def _defrag_call(self, fn, *args, **kwargs):
+        """Shared refusal mapping: a DefragRefused carries its own HTTP
+        status (409 stale/no-plan/busy, 503 parked) — the 503s get a
+        Retry-After so operator scripts back off instead of spinning."""
+        from gpumounter_tpu.defrag import DefragRefused
+        try:
+            return fn(*args, **kwargs)
+        except DefragRefused as exc:
+            headers = {}
+            # DefragRefused is our own HTTP refusal type, not a k8s API
+            # error — .status IS the response code it asks for.
+            if exc.status == 503:  # tpulint: allow[typed-k8s-errors] own HTTP type
+                headers["Retry-After"] = str(
+                    int(self.cfg.defrag_interval_s))
+            raise _HttpError(exc.status, str(exc), headers=headers)
+
+    def _route_defrag_plan(self, match, body, headers):
+        """Compute and adopt a plan from a fresh capacity snapshot.
+        Optional JSON body: {"target_block": N} overrides the
+        configured defrag_target_block for this plan only."""
+        import json as jsonlib
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        if not isinstance(payload, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        target = payload.get("target_block")
+        if target is not None and (not isinstance(target, int)
+                                   or target < 1):
+            raise _HttpError(
+                400, f"target_block must be a positive integer, "
+                     f"got {target!r}")
+        plan = self._defrag_call(self.defrag.plan, target_block=target)
+        return 200, "application/json", \
+            jsonlib.dumps(plan, indent=1) + "\n"
+
+    def _route_defrag_run(self, match, body, headers):
+        """Execute the adopted plan on a background thread. Optional
+        JSON body: {"plan_id": "dfp-..."} pins the run to a specific
+        plan (409 if another plan was adopted since)."""
+        import json as jsonlib
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        if not isinstance(payload, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        out = self._defrag_call(self.defrag.run,
+                                plan_id=payload.get("plan_id"))
+        return 200, "application/json", \
+            jsonlib.dumps(out, indent=1) + "\n"
+
+    def _route_defrag_pause(self, match, body, headers):
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.defrag.pause(), indent=1) + "\n"
 
     def _route_audit(self, match, body, headers):
         """Query the append-only audit trail. Filters (all optional):
@@ -1293,9 +1386,13 @@ class MasterApp:
 
         src_ns, src_pod = _ref("source")
         dst_ns, dst_pod = _ref("destination")
+        checkpoint = payload.get("checkpoint", False)
+        if not isinstance(checkpoint, bool):
+            raise _HttpError(400, '"checkpoint" must be a boolean')
         try:
             journal = self.migrations.begin(src_ns, src_pod,
-                                            dst_ns, dst_pod)
+                                            dst_ns, dst_pod,
+                                            checkpoint=checkpoint)
         except MigrationError as exc:
             raise _HttpError(exc.status, str(exc))
         return 200, "application/json", \

@@ -57,6 +57,17 @@ def main() -> None:
         logger.info("recovery controller on (interval %.0fs, confirm "
                     "%d failures + %.0fs grace)", cfg.recovery_interval_s,
                     cfg.recovery_confirm_failures, cfg.recovery_grace_s)
+    # ICI defragmenter background loop (opt-in via TPUMOUNTER_DEFRAG):
+    # every DEFRAG_INTERVAL_S plan against a fresh capacity snapshot and
+    # execute when the plan has moves. Plans are in-memory (re-computed
+    # cheaply after a restart); the per-move migration journals are what
+    # crash-recover, through resume_interrupted below like any other
+    # migration.
+    if cfg.defrag_enabled:
+        app.defrag.start()
+        logger.info("defragmenter on (interval %.0fs, target block %d, "
+                    "max %d moves/plan)", cfg.defrag_interval_s,
+                    cfg.defrag_target_block, cfg.defrag_max_moves)
     # Fleet telemetry poll loop: federate every worker's telemetry each
     # FLEET_SCRAPE_INTERVAL_S and evaluate the SLO burn rates (breaches
     # emit k8s Events + audit records). Restart-safe: workers report
@@ -78,6 +89,8 @@ def main() -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if cfg.defrag_enabled:
+            app.defrag.stop()
         app.recovery.stop()
         app.fleet.stop()
         app.elastic.stop()

@@ -29,8 +29,13 @@ ANNOT_LOCK = "tpumounter.io/migration-lock"
 ANNOT_PHASE = "tpumounter.io/migration-phase"
 ANNOT_ACK = "tpumounter.io/migration-ack"
 
-#: the machine's phases, in order; "done" is terminal.
-PHASES = ("quiesce", "drain", "remount", "resume", "verify")
+#: the machine's phases, in order; "done" is terminal. "checkpoint" is
+#: the opt-in migration-v2 phase (begin(checkpoint=True), the defrag
+#: controller's path): after the quiesce ack the tenant's HotResumable
+#: pack is confirmed on the host side before any chip is drained, so
+#: the drain window shrinks to a copy. Default migrations skip it and
+#: keep the classic five-phase shape.
+PHASES = ("quiesce", "checkpoint", "drain", "remount", "resume", "verify")
 PHASE_DONE = "done"
 
 #: terminal outcomes (journal["outcome"]; None while in flight)
@@ -51,6 +56,8 @@ def new_journal(mid: str, source_ns: str, source_pod: str,
         "dest_before": None,  # dest's pre-existing chip set (remount diff)
         "dest_chips": [],     # uuids mounted on the destination
         "quiesced": None,     # tenant acked the quiesce signal in time
+        "checkpoint": False,  # v2 checkpoint-assisted drain requested
+        "checkpointed": None,  # tenant acked the checkpoint pack in time
         "resumed": None,      # destination tenant acked the resume signal
         "downtime_started_at": None,
         "downtime_s": None,
@@ -108,8 +115,11 @@ def migration_active(annotations: dict[str, str],
             source["pod"])).annotations)
     except NotFoundError:
         return None  # source pod (and its journal) gone: lock is stale
-    except Exception:  # noqa: BLE001 — can't prove staleness: stay safe
-        return mid
+    except Exception as exc:  # noqa: BLE001 — triage before deciding
+        from gpumounter_tpu.k8s.errors import classify_exception
+        if isinstance(classify_exception(exc), NotFoundError):
+            return None  # a wrapped not-found is still proof: stale
+        return mid  # outage/unclassifiable: can't prove staleness, stay safe
     if src_journal is None or src_journal.get("id") != mid \
             or src_journal.get("outcome") is not None:
         return None

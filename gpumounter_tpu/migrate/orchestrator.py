@@ -7,6 +7,11 @@ crash-safe workflow:
     quiesce   signal the tenant (tpumounter.io/migration-phase) so
               jaxside.watch_migration packs state with HotResumable;
               poll the worker's QuiesceStatus read-back for the ack
+    checkpoint (v2, opt-in via begin(checkpoint=True) — the defrag
+              controller's path) confirm the tenant's HotResumable
+              pack landed host-side BEFORE draining, so the drain
+              window shrinks to a host copy; journaled as its own
+              phase so resume_interrupted re-drives it after a crash
     drain     RemoveTPU (forced) of the whole set on the source pod
     remount   AddTPU on the destination via the slice coordinator —
               its all-or-nothing rollback covers the multi-chip set —
@@ -44,6 +49,7 @@ from gpumounter_tpu.config import get_config
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.faults.failpoints import CrashError
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.errors import is_outage
 from gpumounter_tpu.k8s.events import post_pod_event
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.migrate.journal import (
@@ -99,7 +105,7 @@ class MigrationCoordinator:
     #: phases during which an abort request still triggers a rollback —
     #: past remount the chips live on the destination and finishing
     #: forward is strictly safer than a second move.
-    ABORTABLE_PHASES = ("quiesce", "drain", "remount")
+    ABORTABLE_PHASES = ("quiesce", "checkpoint", "drain", "remount")
 
     def __init__(self, kube: KubeClient, registry, client_factory,
                  cfg=None, store=None, shards=None, apihealth=None):
@@ -144,9 +150,13 @@ class MigrationCoordinator:
     # --- public API (HTTP routes + CLI land here) ---
 
     def begin(self, source_ns: str, source_pod: str,
-              dest_ns: str, dest_pod: str) -> dict:
+              dest_ns: str, dest_pod: str,
+              checkpoint: bool = False) -> dict:
         """Validate, journal phase=quiesce, and start the machine.
-        Raises MigrationRejected (4xx) before anything has moved."""
+        checkpoint=True opts into the v2 checkpoint-assisted drain (an
+        extra journaled phase between quiesce and drain that waits for
+        the tenant's HotResumable pack to land host-side). Raises
+        MigrationRejected (4xx) before anything has moved."""
         if (source_ns, source_pod) == (dest_ns, dest_pod):
             raise MigrationRejected(
                 "source and destination are the same pod", 400)
@@ -179,6 +189,7 @@ class MigrationCoordinator:
             mid = f"mig-{secrets.token_hex(5)}"
             journal = new_journal(mid, source_ns, source_pod,
                                   dest_ns, dest_pod)
+            journal["checkpoint"] = bool(checkpoint)
             # The whole migration — every phase, on whatever master
             # drives it after a crash — runs under the trace the HTTP
             # edge minted for /migrate; the journal is the carrier.
@@ -199,8 +210,15 @@ class MigrationCoordinator:
                     self.kube.patch_pod(source_ns, source_pod, {
                         "metadata": {"annotations": {ANNOT_JOURNAL:
                                                      None}}})
-                except Exception:  # noqa: BLE001 — best effort
-                    pass
+                except Exception as undo_exc:  # noqa: BLE001 — best
+                    # effort; the resume_interrupted scan sweeps up a
+                    # left-behind journal either way, but an outage
+                    # (vs a healthy API refusing) is worth the louder
+                    # line — both stamps likely failed for one cause.
+                    logger.warning(
+                        "journal withdrawal for %s failed (%s): %s",
+                        mid, "api outage" if is_outage(undo_exc)
+                        else "api error", undo_exc)
                 with self._lock:
                     self._journals.pop(mid, None)
                 raise MigrationError(
@@ -290,7 +308,15 @@ class MigrationCoordinator:
         key = (namespace, pod_name)
         try:
             node = Pod(self.kube.get_pod(namespace, pod_name)).node_name
-        except Exception:  # noqa: BLE001 — use the cached resolution
+        except Exception as exc:  # noqa: BLE001 — use the cached
+            # resolution; an outage is the expected caller of this
+            # fallback (the pod GET will heal), a healthy API saying
+            # no (gone/forbidden) means the cache is the last evidence
+            # this machine will ever get — say so.
+            logger.warning(
+                "node resolution for %s/%s degraded to cache (%s): %s",
+                namespace, pod_name,
+                "api outage" if is_outage(exc) else "api error", exc)
             node = self._node_cache.get(key, "")
         if node:
             self._node_cache[key] = node
@@ -308,7 +334,15 @@ class MigrationCoordinator:
         src = journal["source"]
         try:
             pod = Pod(self.kube.get_pod(src["namespace"], src["pod"]))
-        except Exception:  # noqa: BLE001 — can't prove ownership: skip
+        except NotFoundError:
+            return False  # source pod (and its journal) gone
+        except Exception as exc:  # noqa: BLE001 — can't prove
+            # ownership: skip this pass. During an outage every
+            # replica degrades the same way (nobody adopts until the
+            # API heals) — only a healthy API failing the GET is odd
+            # enough to warrant the louder line.
+            (logger.debug if is_outage(exc) else logger.warning)(
+                "ownership check for %s skipped: %s", journal["id"], exc)
             return False
         return bool(pod.node_name) and self.shards.owns_node(pod.node_name)
 
@@ -437,6 +471,10 @@ class MigrationCoordinator:
                 # crash closes its migration in the trail (the chaos
                 # harness asserts every terminal journal has one).
                 src = journal["source"]
+                # The per-phase wall times ride the terminal stamp:
+                # the defrag cost model prices THIS tenant's next move
+                # from its own history instead of fleet p50s, and
+                # `tpumounter migrations` prints them.
                 AUDIT.record(
                     "migrate", actor="orchestrator",
                     namespace=src["namespace"], pod=src["pod"],
@@ -444,6 +482,9 @@ class MigrationCoordinator:
                     outcome=journal.get("outcome") or "failed",
                     duration_s=time.time() - journal.get("created_at", 0.0),
                     id=mid,
+                    phases=dict(journal.get("phase_durations_s") or {}),
+                    downtime_s=journal.get("downtime_s"),
+                    checkpoint=bool(journal.get("checkpoint")),
                     destination=f"{journal['destination']['namespace']}/"
                                 f"{journal['destination']['pod']}")
             with self._lock:
@@ -504,6 +545,33 @@ class MigrationCoordinator:
                 "path, not the chips' state on disk)", journal["id"],
                 src["namespace"], src["pod"],
                 self.cfg.migrate_quiesce_timeout_s)
+        return "checkpoint" if journal.get("checkpoint") else "drain"
+
+    def _phase_checkpoint(self, journal: dict) -> str:
+        """Migration v2: confirm the tenant's HotResumable pack landed
+        host-side BEFORE any chip is drained — the drain window then
+        shrinks to the pack's host copy plus the control-plane moves,
+        because the destination tenant restores from the packed host
+        buffers instead of cold-rebuilding its device state.
+        Re-entrant: the stamp is idempotent and the ack poll re-reads
+        worker state, so a master crash here re-drives cleanly. A
+        hookless tenant simply times out and falls back to the classic
+        cold-restore path (same contract as the quiesce ack)."""
+        src = journal["source"]
+        self._stamp(src, ANNOT_PHASE, {
+            "id": journal["id"], "phase": "checkpoint",
+            "trace_id": journal.get("trace_id", ""),
+            "destination": journal["destination"]})
+        journal["checkpointed"] = self._await_ack(
+            src, journal["id"], "checkpointed",
+            self.cfg.migrate_checkpoint_timeout_s, abortable=True)
+        if not journal["checkpointed"]:
+            logger.warning(
+                "migration %s: no checkpoint ack from %s/%s within "
+                "%.0fs; draining anyway (the destination tenant will "
+                "cold-restore instead of copying the packed state)",
+                journal["id"], src["namespace"], src["pod"],
+                self.cfg.migrate_checkpoint_timeout_s)
         return "drain"
 
     def _phase_drain(self, journal: dict) -> str:
@@ -585,6 +653,11 @@ class MigrationCoordinator:
         self._stamp(dst, ANNOT_PHASE, {
             "id": journal["id"], "phase": "resume",
             "trace_id": journal.get("trace_id", ""),
+            # v2 contract: the destination tenant restores from the
+            # packed host buffers ONLY when the pack was confirmed
+            # durable (the checkpoint ack); otherwise it must
+            # cold-rebuild its device state from the source of truth.
+            "checkpointed": bool(journal.get("checkpointed")),
             "chips": journal["dest_chips"], "source": journal["source"]})
         signaled_at = time.time()
         journal["resumed"] = self._await_ack(
@@ -667,9 +740,14 @@ class MigrationCoordinator:
                             journal["id"], intent.desired_chips,
                             src["namespace"], src["pod"],
                             dst["namespace"], dst["pod"])
-        except Exception as exc:  # noqa: BLE001 — advisory
-            logger.warning("intent transfer for migration %s failed: %s",
-                           journal["id"], exc)
+        except Exception as exc:  # noqa: BLE001 — advisory; triage so
+            # the operator-visible double intent reads correctly: an
+            # outage heals itself on the next reconcile, a healthy API
+            # refusing the patch needs a human.
+            logger.warning("intent transfer for migration %s failed "
+                           "(%s): %s", journal["id"],
+                           "api outage" if is_outage(exc)
+                           else "api error", exc)
 
     # --- rollback ---
 
@@ -787,7 +865,6 @@ class MigrationCoordinator:
         # past the staleness bound), an outage degrades the scan to the
         # in-memory view instead of failing /migrations — and
         # resume_interrupted simply adopts nothing until the API heals.
-        from gpumounter_tpu.k8s.errors import is_outage
         try:
             return self.store.scan_journals()
         except Exception as exc:  # noqa: BLE001 — outage boundary
@@ -891,7 +968,15 @@ class MigrationCoordinator:
     def _try_pod(self, ref: dict) -> Pod | None:
         try:
             return Pod(self.kube.get_pod(ref["namespace"], ref["pod"]))
-        except Exception:  # noqa: BLE001 — event targets are best-effort
+        except NotFoundError:
+            return None  # the common case: the pod is simply gone
+        except Exception as exc:  # noqa: BLE001 — event targets are
+            # best-effort either way; only an outage is worth a line
+            # (the event will be missing from kubectl describe).
+            if is_outage(exc):
+                logger.debug("pod lookup for event target %s/%s lost "
+                             "to api outage: %s", ref["namespace"],
+                             ref["pod"], exc)
             return None
 
     def _worker_addr(self, namespace: str, pod_name: str) -> str:

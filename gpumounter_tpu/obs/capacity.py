@@ -584,6 +584,43 @@ class CapacityPlane:
                      **verdict)
         return verdict
 
+    # --- recovered capacity (the defragmenter's follow-through) ---
+
+    def record_recovery(self, *, cause: str, plan_id: str,
+                        fragmentation_before: float,
+                        fragmentation_after: float,
+                        moves: int) -> dict:
+        """Close the loop `capacity.reject` opened: a completed defrag
+        run re-collects capacity and stamps what it bought back into
+        the audit trail (the audit subscriber mirrors it onto the
+        flight recorder's timeline, so an incident review sees the
+        recovery next to the rejections it answers). Uses the LAST
+        collected rollup — the defrag controller forces the re-collect
+        before calling. Never raises."""
+        record: dict = {
+            "cause": cause, "plan_id": plan_id, "moves": int(moves),
+            "fragmentation_before": round(float(fragmentation_before), 4),
+            "fragmentation_after": round(float(fragmentation_after), 4),
+        }
+        try:
+            hosts = self._derive_hosts(
+                self.fleet.payload(max_age_s=None).get("nodes", {}))
+            fleet = self._fleet_rollup(hosts)
+            record["fleet_free"] = fleet["free"]
+            record["fleet_largest_block"] = fleet["largest_block"]
+        except Exception as exc:  # noqa: BLE001 — the stamp is
+            # advisory; a capacity-plane bug must never turn a finished
+            # defrag run into a failure after the moves landed
+            logger.exception("capacity recovery stamp failed: %s", exc)
+            record["error"] = f"{type(exc).__name__}: {exc}"
+        outcome = (f"recovered: fleet fragmentation "
+                   f"{record['fragmentation_before']} -> "
+                   f"{record['fragmentation_after']} after {moves} "
+                   f"move(s) (cause: {cause})")
+        AUDIT.record("capacity.recovered", actor="capacity-plane",
+                     outcome=outcome, **record)
+        return record
+
 
 # --- process-global plane (the reconciler's hook) ---
 

@@ -176,8 +176,14 @@ class TenantSim:
             self._pause.set()      # HotResumable.pack stand-in
             time.sleep(0.005)
 
+        def _on_checkpoint(signal: dict) -> None:
+            time.sleep(0.002)      # durable host-side save stand-in
+
         def _on_resume(signal: dict) -> None:
-            time.sleep(0.005)      # restore stand-in
+            if signal.get("checkpointed"):
+                time.sleep(0.005)  # warm restore: copy packed host buffers
+            else:
+                time.sleep(0.08)   # cold restore: rebuild + re-shard state
             self._pause.clear()
 
         def _on_heal(marker: dict) -> None:
@@ -199,7 +205,8 @@ class TenantSim:
             _spawn(watch_migration, kube, ns, name,
                    self.telemetry.migration_quiesce(_on_quiesce),
                    on_resume=self.telemetry.migration_resume(_on_resume),
-                   stop=self._stop, watch_timeout_s=1.0)
+                   stop=self._stop, watch_timeout_s=1.0,
+                   on_checkpoint=_on_checkpoint)
             _spawn(watch_chip_replacements, kube, ns, name,
                    self.telemetry.heal(_on_heal), stop=self._stop,
                    watch_timeout_s=1.0)
@@ -244,6 +251,7 @@ FAULTS_ELASTIC = FAULTS_COMMON + [
 ]
 FAULTS_MIGRATE = FAULTS_COMMON + [
     ("migrate.phase.quiesce", "1*crash(chaos)"),
+    ("migrate.phase.checkpoint", "1*crash(chaos)"),
     ("migrate.phase.drain", "1*crash(chaos)"),
     ("migrate.phase.remount", "1*crash(chaos)"),
     ("migrate.phase.resume", "1*crash(chaos)"),
@@ -265,6 +273,7 @@ class ChaosHarness:
             root, nodes=nodes or {NODE_A: 6, NODE_B: 6})
         self.cfg = self.cluster.cfg.replace(
             migrate_quiesce_timeout_s=0.3,
+            migrate_checkpoint_timeout_s=0.3,
             migrate_resume_timeout_s=0.3,
             migrate_poll_interval_s=0.02,
             elastic_resync_interval_s=30.0,
@@ -317,6 +326,9 @@ class ChaosHarness:
         #: (namespace, pod) -> TenantSim: fake tenants running the real
         #: jaxside telemetry SDK; non-empty arms invariant 13.
         self.tenant_sims: dict[tuple[str, str], TenantSim] = {}
+        #: terminal defrag run views (run_defrag_scenario appends);
+        #: non-empty arms invariant 18.
+        self.defrag_runs: list[dict] = []
         self.app: MasterApp | None = None
 
     # --- lifecycle ---
@@ -682,9 +694,15 @@ class ChaosHarness:
         for _ in range(n_migrations):
             if self.rng.random() < 0.8:
                 self._arm_random(FAULTS_MIGRATE)
+            # Half the traffic takes the v2 checkpoint-assisted drain:
+            # with no tenant watcher attached the checkpoint ack times
+            # out and the machine must degrade to the classic drain —
+            # under the same crash faults as every other phase.
+            checkpoint = self.rng.random() < 0.5
             try:
                 journal = self.app.migrations.begin(
-                    source[0], source[1], dest[0], dest[1])
+                    source[0], source[1], dest[0], dest[1],
+                    checkpoint=checkpoint)
             except Exception as exc:  # noqa: BLE001 — rejection is fine
                 self.record(f"migrate begin -> {type(exc).__name__}: {exc}")
                 failpoints.disarm_all()
@@ -698,6 +716,65 @@ class ChaosHarness:
             if final.get("outcome") == "succeeded":
                 source, dest = dest, source  # ping-pong back
         self.converge()
+
+    def seed_fragmentation(self) -> None:
+        """Fragment NODE_A so a 4-chip block is infeasible there
+        despite 4 free chips, and provision the standby destination on
+        NODE_B — the setup the defrag scenario and the verdict-flip
+        test both build on."""
+        from gpumounter_tpu.defrag import ANNOT_DEFRAG_DEST
+        from gpumounter_tpu.master.slice_ops import SliceTarget
+        # Placement packs blocks in order, so df-pad takes [0,1] and
+        # df-keep [2,3]; freeing df-pad leaves NODE_A free {0,1,4,5} —
+        # 4 free chips but largest ICI block 2: blocked for a 4-block
+        # until df-keep's middle block moves out.
+        # Healthy history first: the slice-feasibility SLO is a ratio
+        # over per-pass feasibility evaluations, and the controller
+        # hard-gates on its burn. In a real fleet one fragmentation
+        # event sits in hours of clean passes; compressed test time has
+        # to provide those passes explicitly or the single fragmented
+        # collect IS the whole window and the gate (correctly) refuses.
+        for _ in range(20):
+            self.app.fleet.refresh_if_stale(0.0)
+        self.add_pod("df-pad", NODE_A)
+        self.add_pod("df-keep", NODE_A)
+        coordinator = self._coordinator()
+        coordinator.mount_slice(
+            [SliceTarget(namespace="default", pod="df-pad")], 2,
+            entire=False)
+        coordinator.mount_slice(
+            [SliceTarget(namespace="default", pod="df-keep")], 2,
+            entire=False)
+        pad_held = [c.uuid for c in self.probe("default", "df-pad")]
+        with self._client_for_node(NODE_A) as client:
+            client.remove_tpu("df-pad", "default", pad_held, force=True)
+        self.record("fragmented NODE_A: df-keep holds the middle block")
+        # The operator-provisioned standby destination on NODE_B: a
+        # Running pod annotated tpumounter.io/defrag-dest is the only
+        # thing the controller will ever mount a moved tenant into.
+        self.add_pod("df-standby", NODE_B)
+        self.cluster.kube.patch_pod("default", "df-standby", {
+            "metadata": {"annotations": {ANNOT_DEFRAG_DEST: "ready"}}})
+        self.app.fleet.refresh_if_stale(0.0)
+
+    def run_defrag_scenario(self, target_block: int = 4) -> dict:
+        """Fragment NODE_A so a target_block slice is infeasible there
+        despite enough free chips, then let the REAL defrag controller
+        plan and execute the recovery (checkpoint-assisted moves to an
+        operator-provisioned standby on NODE_B). check_invariants()
+        then also asserts invariant 18 over the recorded run."""
+        self.seed_fragmentation()
+        plan = self.app.defrag.plan(target_block=target_block)
+        self.record(f"defrag plan {plan['id']}: {len(plan['moves'])} "
+                    f"move(s), predicted fragmentation "
+                    f"{plan['fragmentation_before']} -> "
+                    f"{plan['fragmentation_after']}")
+        self.app.defrag.run(plan["id"], wait=True)
+        run = self.app.defrag.payload()["history"][-1]
+        self.defrag_runs.append(run)
+        self.record(f"defrag run {run['plan_id']} -> {run['status']}")
+        self.converge()
+        return run
 
     # --- invariant 10: worker crash mid-batch + ledger replay ---
 
@@ -1521,6 +1598,51 @@ class ChaosHarness:
                     f"traced op {op['op']!r} (trace {op['trace']}): "
                     f"critical-path phase sum {phase_sum:.3f}ms != "
                     f"edge wall {wall:.3f}ms")
+
+        # 18. defrag closure (armed by run_defrag_scenario): after a
+        # defrag run the fleet fragmentation index sampled at the
+        # plan's barrier points must be monotonically non-increasing (a
+        # "defragmenter" that fragments is worse than none), every
+        # executed move must have succeeded with its migration journal
+        # terminal (invariant 4 re-checks cleanliness), and every
+        # move's disruption window must be trace-attributed: the
+        # assembled trace carries migrate-phase wall time. Books ==
+        # mounts == ledger == capacity over the same run are invariants
+        # 1-3, 10 and 17.
+        for run in self.defrag_runs:
+            samples = [b["fragmentation_index"]
+                       for b in run.get("barriers", [])
+                       if "fragmentation_index" in b]
+            for earlier, later in zip(samples, samples[1:]):
+                if later > earlier + 1e-9:
+                    violations.append(
+                        f"defrag {run.get('plan_id')}: fragmentation "
+                        f"index rose across a barrier point "
+                        f"({earlier} -> {later}; samples {samples})")
+            if run.get("status") != "completed":
+                violations.append(
+                    f"defrag {run.get('plan_id')} did not complete: "
+                    f"{run.get('status')!r} ({run.get('error')})")
+            for move in run.get("moves", []):
+                who = f"{move.get('namespace')}/{move.get('pod')}"
+                if move.get("outcome") != "succeeded":
+                    violations.append(
+                        f"defrag {run.get('plan_id')}: move of {who} "
+                        f"-> {move.get('dest_node')} ended "
+                        f"{move.get('outcome')!r}")
+                    continue
+                tree = assembly.assemble(move.get("trace_id") or "")
+                if tree is None:
+                    violations.append(
+                        f"defrag {run.get('plan_id')}: move of {who} "
+                        f"(trace {move.get('trace_id')}) does not "
+                        f"assemble — unattributed tenant window")
+                elif not tree["phases"].get("migrate"):
+                    violations.append(
+                        f"defrag {run.get('plan_id')}: move of {who} "
+                        f"(trace {move.get('trace_id')}) assembled "
+                        f"without migrate-phase wall time: "
+                        f"{tree['phases']}")
 
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()

@@ -1,0 +1,341 @@
+"""ICI defragmenter suite (ISSUE 16 acceptance).
+
+Three layers, matching the subsystem's own split:
+
+  * planner unit tests — pure function, synthetic 8-chip host views:
+    move minimality, disruption budgets, the stale-snapshot negative
+    control (a planner fed an outdated capacity view must refuse, not
+    thrash),
+  * controller gate tests — fakes for the SLO engine and ApiHealth
+    prove the hard gates (never plan or run while tenant-migration-
+    downtime / slice-feasibility burn, park under degraded API, fail
+    closed when the SLO engine itself breaks),
+  * end-to-end over the chaos harness — the admissible-after-defrag
+    verdict flip on /capacity, chaos invariant 18 across the three
+    fixed seeds, and the armed `defrag.run` failpoint proving a run
+    that dies at the top lands in history as `failed` and a re-plan
+    re-drives the recovery to completion.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.defrag import (
+    DefragController,
+    DefragRefused,
+    PlanError,
+    plan_moves,
+)
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.testing.chaos import ChaosHarness
+
+SEEDS = [7, 1337, 20260803]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def _auth():
+    from conftest import AUTH_HEADER
+    return dict(AUTH_HEADER)
+
+
+# --- planner units: synthetic 8-chip hosts -------------------------------
+
+
+def _entry(free, held=None, warm=()):
+    return {"capacity": {
+        "free": list(free),
+        "held": {int(i): t for i, t in (held or {}).items()},
+        "warm": list(warm),
+        "fenced": [],
+    }}
+
+
+def _fragmented_fleet():
+    """host-a has 4 free chips but no 4-block (t1 holds the middle pair,
+    t2 the tail pair); host-b is fully free. Either single eviction
+    unblocks host-a — minimality must pick exactly one."""
+    return {
+        "host-a": _entry([0, 1, 4, 5], {2: "ns/t1", 3: "ns/t1",
+                                        6: "ns/t2", 7: "ns/t2"}),
+        "host-b": _entry(range(8)),
+    }
+
+
+def test_planner_unblocks_with_minimal_moves():
+    plan = plan_moves(_fragmented_fleet(), target_block=4, max_moves=8)
+    assert plan["blocked_hosts"] == ["host-a"]
+    assert len(plan["moves"]) == 1  # one eviction suffices; no sweep
+    (move,) = plan["moves"]
+    assert move["source_node"] == "host-a"
+    assert move["dest_node"] == "host-b"
+    assert move["chips"] == 2
+    assert plan["fragmentation_after"] < plan["fragmentation_before"]
+    # groups carry the barrier prediction invariant 18 later asserts
+    (group,) = plan["groups"]
+    assert group["predicted_fragmentation_index"] \
+        <= plan["fragmentation_before"]
+
+
+def test_planner_picks_cheapest_eviction():
+    """Both single evictions unblock host-a; the cost model (real
+    per-tenant migration timings in production) breaks the tie."""
+
+    def cost(tenant, n_chips):
+        return 0.5 if tenant == "ns/t2" else 50.0
+
+    plan = plan_moves(_fragmented_fleet(), target_block=4, max_moves=8,
+                      cost_fn=cost)
+    (move,) = plan["moves"]
+    assert move["pod"] == "t2"
+    assert move["est_cost_s"] == 0.5
+
+
+def test_planner_respects_disruption_budgets():
+    # tenant budget 0: the group needs a tenant move it may not spend
+    plan = plan_moves(_fragmented_fleet(), target_block=4, max_moves=8,
+                      tenant_move_budget=0)
+    assert plan["moves"] == []
+    assert any(s["reason"] == "tenant-budget" for s in plan["skipped"])
+    # move budget 0: same plan, different ceiling
+    plan = plan_moves(_fragmented_fleet(), target_block=4, max_moves=0)
+    assert plan["moves"] == []
+    assert any(s["reason"] == "move-budget" for s in plan["skipped"])
+
+
+def test_planner_stale_snapshot_refuses_not_thrashes():
+    """The negative control: an outdated capacity view must refuse —
+    loudly, with the bounded cause — instead of scheduling moves."""
+    now = time.time()
+    with pytest.raises(PlanError) as exc:
+        plan_moves(_fragmented_fleet(), target_block=4, max_moves=8,
+                   snapshot_at=now - 120.0, max_snapshot_age_s=60.0,
+                   now=now)
+    assert exc.value.cause == "stale-snapshot"
+    assert exc.value.status == 409
+    # a snapshot of unknown age is exactly as untrustworthy
+    with pytest.raises(PlanError) as exc:
+        plan_moves(_fragmented_fleet(), target_block=4, max_moves=8,
+                   snapshot_at=None, max_snapshot_age_s=60.0, now=now)
+    assert exc.value.cause == "stale-snapshot"
+
+
+def test_planner_noop_on_healthy_fleet():
+    nodes = {"host-a": _entry(range(8)), "host-b": _entry(range(8))}
+    plan = plan_moves(nodes, target_block=4, max_moves=8)
+    assert plan["moves"] == []
+    assert plan["blocked_hosts"] == []
+    assert plan["fragmentation_after"] == plan["fragmentation_before"]
+
+
+def test_planner_refuses_partial_groups_without_destination():
+    """A lone blocked host with nowhere to place its evicted tenant:
+    the group is dropped whole, never partially scheduled."""
+    nodes = {"host-a": _entry([0, 1, 4, 5], {2: "ns/t1", 3: "ns/t1",
+                                             6: "ns/t2", 7: "ns/t2"})}
+    plan = plan_moves(nodes, target_block=4, max_moves=8)
+    assert plan["moves"] == []
+    assert any(s["reason"] == "no-destination" for s in plan["skipped"])
+
+
+# --- controller gates: fakes for the SLO engine and ApiHealth ------------
+
+
+class _BurningSlo:
+    def evaluate(self):
+        return {"burn_threshold": 2.0, "objectives": [
+            {"name": "tenant-migration-downtime", "breached": False,
+             "burn_fast": 3.5},
+            {"name": "slice-feasibility", "burn_fast": 0.0},
+        ]}
+
+
+class _BrokenSlo:
+    def evaluate(self):
+        raise RuntimeError("slo store corrupt")
+
+
+class _DeadApi:
+    def ok(self):
+        return False
+
+    def state(self):
+        return "down"
+
+
+def test_controller_refuses_to_plan_while_slo_burns():
+    ctrl = DefragController(None, None, None, None, slo=_BurningSlo(),
+                            cfg=Config())
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.plan()
+    assert exc.value.cause == "slo-burn"
+    assert exc.value.status == 503
+    assert "tenant-migration-downtime" in str(exc.value)
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.run()
+    assert exc.value.cause == "slo-burn"
+
+
+def test_controller_fails_closed_when_slo_engine_breaks():
+    ctrl = DefragController(None, None, None, None, slo=_BrokenSlo(),
+                            cfg=Config())
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.plan()
+    assert exc.value.cause == "slo-burn"
+
+
+def test_controller_parks_under_degraded_api():
+    ctrl = DefragController(None, None, None, None, apihealth=_DeadApi(),
+                            cfg=Config())
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.plan()
+    assert exc.value.cause == "api-degraded"
+    assert exc.value.status == 503
+
+
+def test_controller_run_requires_an_adopted_plan():
+    ctrl = DefragController(None, None, None, None, cfg=Config())
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.run()
+    assert exc.value.cause == "no-plan"
+    assert exc.value.status == 409
+
+
+def test_controller_refuses_stale_adopted_plan():
+    """Controller half of the negative control: a plan older than the
+    snapshot bound is discarded at run time — refuse, not thrash."""
+    ctrl = DefragController(None, None, None, None, cfg=Config())
+    ctrl._plan = {"id": "dfp-old", "created_at": time.time() - 3600.0,
+                  "moves": [], "groups": []}
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.run()
+    assert exc.value.cause == "stale-snapshot"
+    assert ctrl._plan is None  # discarded, no retry loop possible
+    with pytest.raises(DefragRefused) as exc:
+        ctrl.run()
+    assert exc.value.cause == "no-plan"
+
+
+# --- HTTP surface over a bare MasterApp ----------------------------------
+
+
+@pytest.fixture()
+def app(test_config):
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+
+    return MasterApp(FakeKubeClient(), cfg=test_config)
+
+
+def test_defrag_routes(app):
+    status, _, body, _ = app.handle("GET", "/defrag", b"", _auth())
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["gates"]["api_ok"] is True
+    assert payload["plan"] is None and payload["run"] is None
+
+    # running with nothing adopted is a 409, cause in the message
+    status, _, body, _ = app.handle("POST", "/defrag/run", b"{}", _auth())
+    assert status == 409
+    assert "no adopted plan" in body
+
+    # a plan over a healthy (here: empty) fleet is a fine no-op
+    status, _, body, _ = app.handle("POST", "/defrag/plan", b"{}", _auth())
+    assert status == 200
+    plan = json.loads(body)
+    assert plan["moves"] == [] and plan["id"].startswith("dfp-")
+
+    status, _, _, _ = app.handle("POST", "/defrag/pause", b"", _auth())
+    assert status == 200
+
+    # malformed override is rejected before any planning happens
+    status, _, _, _ = app.handle("POST", "/defrag/plan",
+                                 b'{"target_block": 0}', _auth())
+    assert status == 400
+
+
+def test_defrag_mutate_routes_require_auth(app):
+    for path in ("/defrag/plan", "/defrag/run", "/defrag/pause"):
+        status, _, _, _ = app.handle("POST", path, b"{}", {})
+        assert status == 401, path
+
+
+def test_defrag_route_parks_with_retry_after(app):
+    app.defrag.slo = _BurningSlo()
+    status, _, body, headers = app.handle("POST", "/defrag/plan", b"{}",
+                                          _auth())
+    assert status == 503
+    assert "Retry-After" in headers
+    assert "refusing to add migration disruption" in body
+
+
+# --- end-to-end over the chaos harness -----------------------------------
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_defrag_chaos(tmp_path, seed):
+    """Invariant 18 across the fixed seeds: after the plan, books ==
+    mounts == ledger == capacity, every move's window trace-attributed,
+    fragmentation index monotonically non-increasing at the barriers."""
+    with ChaosHarness(str(tmp_path), seed) as h:
+        run = h.run_defrag_scenario()
+        assert run["status"] == "completed"
+        assert all(m["outcome"] == "succeeded" for m in run["moves"])
+        h.check_invariants()
+
+
+def test_admissible_after_defrag_verdict_flips(tmp_path):
+    """The satellite's end-to-end: a fleet where a 4-chip-per-host
+    slice is infeasible-now, the planner's moves make it feasible, and
+    GET /capacity flips the verdict."""
+    with ChaosHarness(str(tmp_path), 7) as h:
+        h.seed_fragmentation()
+        before = h.app.capacity.payload(max_age_s=0.0)["feasibility"]
+        assert before["v4-16"]["verdict"] == "admissible-after-defrag"
+
+        plan = h.app.defrag.plan(target_block=4)
+        assert plan["moves"], "planner found nothing on a blocked fleet"
+        h.app.defrag.run(plan["id"], wait=True)
+        run = h.app.defrag.payload()["history"][-1]
+        assert run["status"] == "completed"
+        h.defrag_runs.append(run)
+
+        status, _, body, _ = h.app.handle("GET", "/capacity", b"",
+                                          _auth())
+        assert status == 200
+        after = json.loads(body)["feasibility"]
+        assert after["v4-16"]["verdict"] == "admissible"
+        h.check_invariants()
+
+
+def test_defrag_run_failpoint_fails_closed_then_redrives(tmp_path):
+    """Arm the declared `defrag.run` failpoint: a run that dies at the
+    top must land in history as `failed` (truthful status, plan
+    consumed), and a fresh plan re-drives the recovery."""
+    with ChaosHarness(str(tmp_path), 7) as h:
+        h.seed_fragmentation()
+        plan = h.app.defrag.plan(target_block=4)
+        failpoints.arm("defrag.run", "1*error(chaos defrag abort)")
+        h.app.defrag.run(plan["id"], wait=True)
+        run = h.app.defrag.payload()["history"][-1]
+        assert run["status"] == "failed"
+        assert "chaos defrag abort" in run["error"]
+        assert run["moves"] == []  # died before any migration began
+
+        plan2 = h.app.defrag.plan(target_block=4)
+        assert plan2["id"] != plan["id"]
+        h.app.defrag.run(plan2["id"], wait=True)
+        run2 = h.app.defrag.payload()["history"][-1]
+        assert run2["status"] == "completed"
+        h.defrag_runs.append(run2)
+        h.check_invariants()

@@ -33,6 +33,10 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 #: Reviewed for ISSUE 14 (capacity plane): two fleet-level gauges and
 #: two unlabeled counters — chip indices, host names and accelerator
 #: types ride the JSON plane (/capacity), never labels. No bump.
+#: Reviewed for ISSUE 16 (defragmenter): the plans counter and running
+#: gauge are unlabeled; moves/refusals are labeled only by the bounded
+#: outcome/cause vocabulary — plan ids, tenant pods and host names ride
+#: the JSON plane (/defrag), never labels. No bump.
 SERIES_BUDGET = 400
 
 
@@ -110,6 +114,9 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         # /capacity rollup (chip indices + host names + accelerator
         # types must all stay in the JSON payload, never labels).
         assert http("GET", "/capacity")[0] == 200
+        # ISSUE 16 defragmenter: the budgeted run includes the defrag
+        # pane (plan ids / host names stay JSON, never labels).
+        assert http("GET", "/defrag")[0] == 200
         # ISSUE 13 trace-plane surfaces: the budgeted run includes the
         # assembled /trace read and the flight recorder's /timeline.
         assert http("GET", "/timeline")[0] == 200
@@ -224,6 +231,53 @@ def test_capacity_plane_series_are_bounded():
         f"capacity plane grew {grown} series — an unbounded label "
         f"(chip index? host name? accelerator type?) slipped into an "
         f"instrument")
+
+
+def test_defrag_plane_series_are_bounded():
+    """ISSUE 16 guard: heavy defrag traffic — dozens of plans (each
+    with a fresh dfp- id), a thousand distinct host names through the
+    planner, repeated gate refusals — grows the exposition only by the
+    fixed defrag series. Plan ids, host names and tenant pods must
+    never become label values (they live in the /defrag JSON pane)."""
+    import time
+
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.defrag import DefragController, DefragRefused
+
+    class _Fleet:
+        def __init__(self):
+            self.round = 0
+
+        def payload(self, max_age_s=None):
+            self.round += 1
+            nodes = {}
+            for host in range(40):
+                nodes[f"card-df-{self.round}-{host}"] = {"capacity": {
+                    "free": list(range(8)), "held": {}, "warm": [],
+                    "fenced": []}}
+            return {"at": time.time(), "nodes": nodes}
+
+    class _BurningSlo:
+        def evaluate(self):
+            return {"burn_threshold": 2.0, "objectives": [
+                {"name": "slice-feasibility", "burn_fast": 9.0}]}
+
+    before = REGISTRY.series_count()
+    ctrl = DefragController(None, None, None, _Fleet(), cfg=Config())
+    for _ in range(25):
+        ctrl.plan()  # 25 distinct plan ids, 1000 distinct host names
+    ctrl.slo = _BurningSlo()
+    for _ in range(10):
+        try:
+            ctrl.plan()
+        except DefragRefused:
+            pass
+    grown = REGISTRY.series_count() - before
+    # plans counter + at most the bounded refusal-cause vocabulary;
+    # nothing per-plan, per-host or per-tenant
+    assert grown <= 6, (
+        f"defrag plane grew {grown} series — an unbounded label "
+        f"(plan id? host name? tenant pod?) slipped into an instrument")
 
 
 def test_tenant_label_cardinality_is_capped():
