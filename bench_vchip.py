@@ -1,0 +1,452 @@
+"""Fractional chip virtualization bench: co-location throughput,
+QoS isolation, and the O(1) warm re-grant contract.
+
+Three measurements, all over the production vchip code paths:
+
+  co-location   a prefill-heavy (bursty) and a decode-heavy (steady)
+                tenant packed onto ONE shared chip with QoS weights
+                60/40, against the whole-chip baseline that parks each
+                tenant on its own chip. The headline is per-chip
+                aggregate throughput: FlexNPU's utilization-recovery
+                claim (PAPERS.md) reproduced at control-plane scale —
+                the shared chip carries both tenants' demand that the
+                whole-chip layout spreads over two.
+
+  isolation     the light tenant surges to ~2x its quiet demand. With
+                policy enforcement (QoS weights consulted by the
+                weighted-fair device model + the in-kernel token
+                budget throttling admissions through the REAL
+                UserspacePolicyEngine), the heavy tenant's p95 stays
+                within SLO. The negative control strips the policy
+                (free-for-all device, no throttling) and shows the
+                heavy tenant's p95 degrading by a documented factor —
+                proving the mechanism, not the model, provides the
+                isolation. Every throttling decision is mirrored
+                through interpret_device_program over the REAL eBPF
+                bytecode (build_device_program) and must agree
+                step-for-step with the engine (divergences gate at 0).
+
+  warm re-grant the V2DeviceController over a stubbed bpf(2) kernel
+                (no bpffs in CI): the FIRST grant on a cgroup swaps
+                the device program once; every re-grant after it is a
+                pure policy-map write. The gate is the ISSUE 17
+                contract itself: tpumounter_ebpf_program_swaps_total
+                must not move during the warm phase while
+                tpumounter_ebpf_map_grants_total advances.
+
+The serving model is a deterministic discrete-event loop (1 tick =
+1 ms of simulated time): chips serve 1 work unit/tick, split between
+backlogged tenants by the QoS weights read from the policy engine
+(work-conserving — an idle tenant's share flows to the busy one),
+equal-split when no policy is armed. No wall-clock sleeps; identical
+inputs give identical artifacts.
+
+Usage:
+  python bench_vchip.py                 -> writes BENCH_vchip_r01.json
+  python bench_vchip.py --check FILE    -> CI smoke: re-runs and gates
+      zero warm-phase program swaps, the co-location throughput floor,
+      the heavy tenant's p95 SLO under surge, the negative control's
+      degradation factor, and engine/bytecode throttle parity; never
+      overwrites the committed artifact (set TPM_VCHIP_ARTIFACT to
+      redirect the fresh copy).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+ARTIFACT = "BENCH_vchip_r01.json"
+
+# The control plane is fail-closed (TPUMOUNTER_AUTH=token): give the
+# in-process stack one shared secret BEFORE any Config() exists.
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-vchip-secret")
+os.environ.setdefault("TPUMOUNTER_AUTH", "token")
+
+#: simulated run length: 30 s at 1 ms ticks
+TICKS = 30_000
+#: token-budget refill cadence (the userspace refiller's write)
+REFILL_TICKS = 1_000
+#: tokens granted to the metered light tenant per refill window
+LIGHT_BUDGET = 80
+#: work units per request (both tenants; profiles differ in ARRIVALS)
+SERVICE_UNITS = 4
+#: reported tokens/sec scale: one work unit ~ 25 generated tokens
+TOKENS_PER_UNIT = 25
+#: the heavy tenant's p95 SLO under an enforced co-location surge
+HEAVY_P95_SLO_MS = 150.0
+#: how much worse the negative control must be (mechanism proof)
+DEGRADATION_FLOOR = 2.0
+#: per-chip aggregate-throughput floor, co-located vs whole-chip
+COLOC_RATIO_FLOOR = 1.5
+
+HEAVY = "default/decode"
+LIGHT = "default/prefill"
+SHARED_DEV = (250, 0)   # the co-located chip
+LIGHT_DEV = (250, 1)    # the light tenant's own chip (baseline only)
+
+
+def _arrives(tenant: str, tick: int, surge: bool) -> bool:
+    """Deterministic arrival schedules: decode is steady (every 7 ms),
+    prefill is bursty (500 ms on / 500 ms off, every 12 ms while on;
+    every 5 ms continuously when surging)."""
+    if tenant == HEAVY:
+        return tick % 7 == 0
+    if surge:
+        return tick % 5 == 0
+    return tick % 1000 < 500 and tick % 12 == 0
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return float(ordered[idx])
+
+
+class _Parity:
+    """Mirrors every engine admission through interpret_device_program
+    over the real device-program bytecode and counts divergences."""
+
+    def __init__(self, weight: int, tokens: int):
+        from gpumounter_tpu.cgroup.ebpf import (
+            build_device_program,
+            policy_value,
+            telemetry_key,
+        )
+        self.key = telemetry_key(*SHARED_DEV)
+        self.tmap_fd, self.pmap_fd = 5, 7
+        self.prog = build_device_program(
+            (), telemetry_map_fd=self.tmap_fd, policy_map_fd=self.pmap_fd)
+        self.maps = {self.tmap_fd: {self.key: 0},
+                     self.pmap_fd: {self.key: policy_value(weight, tokens)}}
+        self._value = policy_value
+        self.checked = 0
+        self.divergences = 0
+
+    def refill(self, weight: int, tokens: int) -> None:
+        self.maps[self.pmap_fd][self.key] = self._value(weight, tokens)
+
+    def mirror(self, engine_admitted: bool) -> None:
+        from gpumounter_tpu.cgroup.ebpf import (
+            BPF_DEVCG_ACC_READ,
+            BPF_DEVCG_ACC_WRITE,
+            BPF_DEVCG_DEV_CHAR,
+        )
+        from gpumounter_tpu.cgroup.policy import interpret_device_program
+        kernel = interpret_device_program(
+            self.prog, self.maps, BPF_DEVCG_DEV_CHAR,
+            BPF_DEVCG_ACC_READ | BPF_DEVCG_ACC_WRITE, *SHARED_DEV)
+        self.checked += 1
+        if bool(kernel) != bool(engine_admitted):
+            self.divergences += 1
+
+
+def _simulate(layout: str, surge: bool, enforce_policy: bool) -> dict:
+    """One serving run. layout: 'split' (each tenant its own chip) or
+    'shared' (both on SHARED_DEV). Returns per-tenant latency stats and
+    aggregate throughput per chip."""
+    from gpumounter_tpu.cgroup.ebpf import (
+        POLICY_UNMETERED,
+        policy_weight,
+        telemetry_key,
+    )
+    from gpumounter_tpu.cgroup.policy import UserspacePolicyEngine
+
+    engine = UserspacePolicyEngine()
+    parity = None
+    if enforce_policy:
+        engine.set_policy(HEAVY, *SHARED_DEV, 60, POLICY_UNMETERED)
+        light_dev = SHARED_DEV if layout == "shared" else LIGHT_DEV
+        light_tokens = LIGHT_BUDGET if surge else POLICY_UNMETERED
+        engine.set_policy(LIGHT, *light_dev, 40, light_tokens)
+        if surge and layout == "shared":
+            parity = _Parity(40, light_tokens)
+
+    chips = ({HEAVY: "chip-0", LIGHT: "chip-0"} if layout == "shared"
+             else {HEAVY: "chip-0", LIGHT: "chip-1"})
+    devs = {HEAVY: SHARED_DEV,
+            LIGHT: SHARED_DEV if layout == "shared" else LIGHT_DEV}
+
+    def weight_of(tenant: str) -> int:
+        entry = engine.entries(tenant).get(telemetry_key(*devs[tenant]))
+        return policy_weight(entry) if entry else 50
+
+    queues: dict[str, list[list[float]]] = {HEAVY: [], LIGHT: []}
+    latencies: dict[str, list[float]] = {HEAVY: [], LIGHT: []}
+    done_units = {HEAVY: 0.0, LIGHT: 0.0}
+    throttled = 0
+
+    for tick in range(TICKS):
+        if tick % REFILL_TICKS == 0 and tick and enforce_policy and surge:
+            engine.refill(LIGHT, *devs[LIGHT], LIGHT_BUDGET)
+            if parity is not None:
+                parity.refill(40, LIGHT_BUDGET)
+        for tenant in (HEAVY, LIGHT):
+            if not _arrives(tenant, tick, surge and tenant == LIGHT):
+                continue
+            verdict = engine.admit(tenant, *devs[tenant])
+            if parity is not None and tenant == LIGHT:
+                parity.mirror(verdict is not False)
+            if verdict is False:
+                throttled += 1
+                continue  # the kernel denied the open(); request dropped
+            queues[tenant].append([float(SERVICE_UNITS), float(tick)])
+        # serve: per chip, split the tick across backlogged tenants by
+        # policy weight (work-conserving)
+        for chip in set(chips.values()):
+            busy = [t for t in (HEAVY, LIGHT)
+                    if chips[t] == chip and queues[t]]
+            if not busy:
+                continue
+            total_w = sum(weight_of(t) for t in busy)
+            for tenant in busy:
+                slice_units = (weight_of(tenant) / total_w if total_w
+                               else 1.0 / len(busy))
+                head = queues[tenant][0]
+                head[0] -= slice_units
+                done_units[tenant] += min(slice_units,
+                                          slice_units + head[0])
+                if head[0] <= 0:
+                    queues[tenant].pop(0)
+                    latencies[tenant].append(tick + 1 - head[1])
+
+    n_chips = len(set(chips.values()))
+    total_units = sum(done_units.values())
+    return {
+        "layout": layout, "surge": surge,
+        "policy_enforced": enforce_policy,
+        "chips": n_chips,
+        "per_tenant": {
+            tenant.split("/", 1)[1]: {
+                "completed": len(latencies[tenant]),
+                "backlog_end": len(queues[tenant]),
+                "p50_ms": _percentile(latencies[tenant], 0.50),
+                "p95_ms": _percentile(latencies[tenant], 0.95),
+                "tokens_per_s": round(
+                    done_units[tenant] * TOKENS_PER_UNIT
+                    / (TICKS / 1000.0), 1),
+            } for tenant in (HEAVY, LIGHT)},
+        "aggregate_tokens_per_s": round(
+            total_units * TOKENS_PER_UNIT / (TICKS / 1000.0), 1),
+        "per_chip_tokens_per_s": round(
+            total_units * TOKENS_PER_UNIT / (TICKS / 1000.0) / n_chips,
+            1),
+        "throttled": throttled,
+        "parity": (None if parity is None else
+                   {"checked": parity.checked,
+                    "divergences": parity.divergences}),
+    }
+
+
+def _bench_regrant() -> dict:
+    """V2DeviceController grant timing over a stubbed bpf(2): one cold
+    grant (program swap), then 200 warm re-grants with shifting QoS
+    weights — all map writes, zero swaps."""
+    from gpumounter_tpu.cgroup import ebpf
+    from gpumounter_tpu.device.tpu import TpuDevice
+
+    maps: dict[int, dict[int, int]] = {}
+    saved = {name: getattr(ebpf, name) for name in (
+        "prog_load", "prog_attach", "prog_detach", "prog_query",
+        "probe_map_support", "map_create", "map_update", "map_delete",
+        "map_lookup", "map_keys", "obj_pin", "obj_get")}
+
+    def map_create(key_size=8, value_size=8, max_entries=1024,
+                   name="tpum_telemetry"):
+        fd = os.open("/dev/null", os.O_RDONLY)
+        maps[fd] = {}
+        return fd
+
+    def map_update(map_fd, key, value=0, flags=0):
+        if flags & ebpf.BPF_NOEXIST and key in maps[map_fd]:
+            return
+        maps[map_fd][key] = value
+
+    ebpf.prog_load = lambda insns, name="x": os.open(
+        "/dev/null", os.O_RDONLY)
+    ebpf.prog_attach = lambda cg_fd, fd, flags=0: None
+    ebpf.prog_detach = lambda cg_fd, fd: None
+    ebpf.prog_query = lambda cg_fd, max_progs=64: []
+    ebpf.probe_map_support = lambda: True
+    ebpf.map_create = map_create
+    ebpf.map_update = map_update
+    ebpf.map_delete = lambda fd, key: maps[fd].pop(key, None)
+    ebpf.map_lookup = lambda fd, key: maps.get(fd, {}).get(key)
+    ebpf.map_keys = lambda fd, limit=4096: list(maps.get(fd, {}))[:limit]
+    def obj_pin(path, fd):
+        with open(path, "w") as fh:
+            fh.write("0")
+
+    ebpf.obj_pin = obj_pin
+    ebpf.obj_get = lambda path: os.open("/dev/null", os.O_RDONLY)
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            cg = os.path.join(root, "cgroup")
+            os.mkdir(cg)
+            ctl = ebpf.V2DeviceController(
+                pin_dir=os.path.join(root, "bpffs"),
+                state_dir=os.path.join(root, "state"))
+            dev = TpuDevice(index=0, device_path="/dev/accel0",
+                            major=250, minor=0, uuid="chip0")
+            t0 = time.perf_counter()
+            ctl.grant(cg, dev, tenant=HEAVY, policy={"chip0": (60, 0)})
+            cold_ms = (time.perf_counter() - t0) * 1000.0
+            swaps_before = ebpf.PROGRAM_SWAPS.get()
+            grants_before = ebpf.MAP_GRANTS.get()
+            warm_ms: list[float] = []
+            for i in range(200):
+                weight = 30 + (i % 60)
+                t0 = time.perf_counter()
+                ctl.grant(cg, dev, tenant=HEAVY,
+                          policy={"chip0": (weight, 0)})
+                warm_ms.append((time.perf_counter() - t0) * 1000.0)
+            return {
+                "cold_grant_ms": round(cold_ms, 3),
+                "warm_regrants": len(warm_ms),
+                "warm_p50_ms": round(_percentile(warm_ms, 0.50), 4),
+                "warm_p95_ms": round(_percentile(warm_ms, 0.95), 4),
+                "swaps_during_warm": ebpf.PROGRAM_SWAPS.get()
+                - swaps_before,
+                "map_grants_during_warm": ebpf.MAP_GRANTS.get()
+                - grants_before,
+            }
+    finally:
+        for name, fn in saved.items():
+            setattr(ebpf, name, fn)
+
+
+def run_bench() -> dict:
+    t_start = time.time()
+    baseline = _simulate("split", surge=False, enforce_policy=True)
+    colocated = _simulate("shared", surge=False, enforce_policy=True)
+    enforced = _simulate("shared", surge=True, enforce_policy=True)
+    free_for_all = _simulate("shared", surge=True, enforce_policy=False)
+    regrant = _bench_regrant()
+
+    ratio = (colocated["per_chip_tokens_per_s"]
+             / baseline["per_chip_tokens_per_s"]
+             if baseline["per_chip_tokens_per_s"] else 0.0)
+    heavy_enforced = enforced["per_tenant"]["decode"]["p95_ms"]
+    heavy_free = free_for_all["per_tenant"]["decode"]["p95_ms"]
+    return {
+        "bench": "vchip-colocation",
+        "at": round(t_start, 3),
+        "duration_s": round(time.time() - t_start, 3),
+        "config": {
+            "ticks": TICKS,
+            "service_units": SERVICE_UNITS,
+            "tokens_per_unit": TOKENS_PER_UNIT,
+            "weights": {"decode": 60, "prefill": 40},
+            "light_surge_budget_per_s": LIGHT_BUDGET,
+            "heavy_p95_slo_ms": HEAVY_P95_SLO_MS,
+            "coloc_ratio_floor": COLOC_RATIO_FLOOR,
+            "degradation_floor": DEGRADATION_FLOOR,
+        },
+        "colocation": {
+            "baseline_split": baseline,
+            "colocated": colocated,
+            "per_chip_throughput_ratio": round(ratio, 3),
+        },
+        "isolation": {
+            "enforced": enforced,
+            "free_for_all": free_for_all,
+            "heavy_p95_ms_enforced": heavy_enforced,
+            "heavy_p95_ms_free_for_all": heavy_free,
+            "degradation_factor": round(
+                heavy_free / heavy_enforced, 2) if heavy_enforced
+            else 0.0,
+        },
+        "regrant": regrant,
+    }
+
+
+def check(committed_path: str, fresh: dict) -> int:
+    with open(committed_path) as fh:
+        committed = json.load(fh)
+    failures = []
+
+    regrant = fresh["regrant"]
+    if regrant["swaps_during_warm"]:
+        failures.append(
+            f"{regrant['swaps_during_warm']:.0f} program swap(s) during "
+            f"the warm re-grant phase — the O(1) map-write contract "
+            f"broke")
+    if regrant["map_grants_during_warm"] < regrant["warm_regrants"]:
+        failures.append(
+            f"only {regrant['map_grants_during_warm']:.0f} map grants "
+            f"for {regrant['warm_regrants']} warm re-grants")
+    committed_warm = committed.get("regrant", {}).get("warm_p95_ms", 0.0)
+    warm_budget = max(4.0 * committed_warm, 50.0)
+    if regrant["warm_p95_ms"] > warm_budget:
+        failures.append(
+            f"warm re-grant p95 {regrant['warm_p95_ms']}ms > budget "
+            f"{warm_budget:.1f}ms (committed {committed_warm}ms)")
+
+    ratio = fresh["colocation"]["per_chip_throughput_ratio"]
+    if ratio < COLOC_RATIO_FLOOR:
+        failures.append(
+            f"co-located per-chip aggregate throughput ratio {ratio} "
+            f"< floor {COLOC_RATIO_FLOOR} — sharing stopped recovering "
+            f"utilization")
+
+    iso = fresh["isolation"]
+    if iso["heavy_p95_ms_enforced"] > HEAVY_P95_SLO_MS:
+        failures.append(
+            f"heavy tenant p95 {iso['heavy_p95_ms_enforced']}ms under "
+            f"enforced surge > SLO {HEAVY_P95_SLO_MS}ms")
+    if iso["degradation_factor"] < DEGRADATION_FLOOR:
+        failures.append(
+            f"negative control degraded the heavy tenant only "
+            f"{iso['degradation_factor']}x (floor {DEGRADATION_FLOOR}x) "
+            f"— the bench no longer proves the policy mechanism")
+    if not iso["enforced"]["throttled"]:
+        failures.append("the enforced surge throttled nothing — the "
+                        "token budget is not being consulted")
+    parity = iso["enforced"]["parity"] or {}
+    if parity.get("divergences", 1):
+        failures.append(
+            f"{parity.get('divergences')} engine/bytecode throttle "
+            f"divergence(s) over {parity.get('checked')} admissions")
+
+    if failures:
+        print("VCHIP BENCH CHECK FAILED:", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print(f"vchip bench check ok: coloc ratio {ratio}x, heavy p95 "
+          f"{iso['heavy_p95_ms_enforced']}ms enforced / "
+          f"{iso['heavy_p95_ms_free_for_all']}ms free-for-all, "
+          f"{iso['enforced']['throttled']} throttled "
+          f"({parity.get('checked')} parity-checked), warm re-grant "
+          f"p95 {regrant['warm_p95_ms']}ms with 0 swaps")
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", metavar="ARTIFACT", default=None,
+                        help="CI smoke: re-run and gate against the "
+                             "committed artifact (never overwrites it)")
+    args = parser.parse_args()
+    fresh = run_bench()
+    if args.check:
+        out = os.environ.get("TPM_VCHIP_ARTIFACT")
+        if out:
+            with open(out, "w") as fh:
+                json.dump(fresh, fh, indent=1)
+        raise SystemExit(check(args.check, fresh))
+    artifact = os.environ.get("TPM_VCHIP_ARTIFACT", ARTIFACT)
+    with open(artifact, "w") as fh:
+        json.dump(fresh, fh, indent=1)
+    print(json.dumps(fresh, indent=1))
+    print(f"\nwrote {artifact}")
+
+
+if __name__ == "__main__":
+    main()

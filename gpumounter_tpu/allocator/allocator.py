@@ -174,6 +174,21 @@ class TpuAllocator:
         if not owner.node_name:
             raise SlavePodError(
                 f"owner pod {owner.namespace}/{owner.name} is not scheduled")
+        # The owner pins the host, so the blocked-host set is advisory
+        # here: flag placements landing where the defragmenter needs
+        # quiet (the span/stats consumer and the operator see WHY a
+        # defrag run later had to move this tenant's chips). Free-host
+        # avoidance proper happens where a host choice exists — the
+        # vchip packer and the warm-pool stocking.
+        from gpumounter_tpu.obs import capacity as capacity_plane
+        blocked = capacity_plane.blocked_hosts()
+        if owner.node_name in blocked:
+            logger.warning(
+                "placing %s/%s on defrag-blocked host %s (no host "
+                "choice: owner is pinned there)", owner.namespace,
+                owner.name, owner.node_name)
+        if stats is not None:
+            stats["defrag_blocked_host"] = owner.node_name in blocked
         n_pods = total_tpu_num // tpu_num_per_pod
         with self._alloc_mutex:
             devices, created = self._allocate_locked(
@@ -302,8 +317,15 @@ class TpuAllocator:
                 logger.warning("ICI widening readback failed: %s", exc)
 
         candidates = sorted(by_slave.values(), key=lambda d: d.index)
-        chosen_idx = set(placement.best_block(
-            [d.index for d in candidates], want))
+        # Defrag-aware hint: among equally-connected blocks keep the one
+        # that leaves the host's remaining free set most contiguous, so
+        # churn doesn't manufacture fragmentation the defragmenter must
+        # later undo (the capacity plane's blocked-host set is exactly
+        # the record of hosts where that already happened).
+        chooser = (placement.defrag_aware_block
+                   if getattr(self.cfg, "alloc_defrag_hint", True)
+                   else placement.best_block)
+        chosen_idx = set(chooser([d.index for d in candidates], want))
         keep = [d for d in candidates if d.index in chosen_idx]
         keep_slaves = {d.pod_name for d in keep}
         # Release over (mapped ∪ created-extras): an extra whose device

@@ -85,3 +85,55 @@ def best_block(free: list[int], want: int) -> list[int]:
             best_score = score
             best_set = sorted(chosen)
     return best_set
+
+
+def largest_component(indices: list[int]) -> int:
+    """Size of the largest ICI-connected component of `indices` — the
+    biggest contiguous block a future mount could take from this set."""
+    pending = set(indices)
+    best = 0
+    while pending:
+        frontier = [pending.pop()]
+        size = 1
+        while frontier:
+            chip = frontier.pop()
+            for nbr in (chip ^ 1, chip - 2, chip + 2):
+                if nbr in pending:
+                    pending.discard(nbr)
+                    size += 1
+                    frontier.append(nbr)
+        best = max(best, size)
+    return best
+
+
+def defrag_aware_block(free: list[int], want: int) -> list[int]:
+    """best_block with a defrag-aware tiebreak: among the subsets with
+    maximal internal ICI links, prefer the one whose REMOVAL leaves the
+    remaining free set with the largest surviving contiguous block.
+
+    best_block only optimizes the chips it takes; under churn that
+    habitually carves blocks out of the middle of the free set, leaving
+    fragments the defragmenter later has to migrate back together. The
+    tiebreak costs nothing the mount cares about (the chosen block is
+    equally well-connected) and measurably lowers the steady-state
+    fragmentation index (tests drive the A/B). Falls back to the greedy
+    best_block result when the candidate space is too large to
+    enumerate — the hint is opportunistic, never required."""
+    free = sorted(set(free))
+    if want <= 0:
+        return []
+    if len(free) < want:
+        raise ValueError(f"need {want} chip(s), only {len(free)} free")
+    if len(free) == want:
+        return free
+    n_subsets = 1
+    for i in range(want):
+        n_subsets = n_subsets * (len(free) - i) // (i + 1)
+    if n_subsets > _EXHAUSTIVE_LIMIT:
+        return best_block(free, want)
+    free_set = set(free)
+    best = max(itertools.combinations(free, want),
+               key=lambda c: (contiguity_score(list(c)),
+                              largest_component(sorted(free_set - set(c))),
+                              [-i for i in c]))
+    return list(best)

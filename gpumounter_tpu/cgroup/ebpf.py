@@ -43,7 +43,7 @@ import ctypes
 import os
 import struct
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from gpumounter_tpu.device.tpu import TpuDevice
 from gpumounter_tpu.utils.log import get_logger
@@ -85,6 +85,7 @@ BPF_DEVCG_ACC_WRITE = 4
 # --- instruction opcodes ---
 
 OP_LDX_MEM_W = 0x61   # dst = *(u32 *)(src + off)
+OP_LDX_MEM_DW = 0x79  # dst = *(u64 *)(src + off)
 OP_STX_MEM_DW = 0x7B  # *(u64 *)(dst + off) = src
 OP_LD_IMM64 = 0x18    # 16-byte: dst = imm64 (src=BPF_PSEUDO_MAP_FD -> map)
 OP_MOV64_IMM = 0xB7
@@ -96,6 +97,7 @@ OP_LSH64_IMM = 0x67
 OP_RSH64_IMM = 0x77
 OP_JNE_IMM = 0x55
 OP_JEQ_IMM = 0x15
+OP_JEQ_REG = 0x1D
 OP_CALL = 0x85
 OP_EXIT = 0x95
 OP_XADD_DW = 0xDB     # lock *(u64 *)(dst + off) += src
@@ -111,9 +113,12 @@ def insn_ld_imm64(dst: int, imm: int, src: int = 0) -> bytes:
     """The only 16-byte eBPF instruction: dst = 64-bit immediate. With
     src=BPF_PSEUDO_MAP_FD the verifier relocates imm (a map fd) into a
     map pointer at load time."""
-    return (struct.pack("<BBhi", OP_LD_IMM64, (src << 4) | dst, 0,
-                        imm & 0xFFFFFFFF)
-            + struct.pack("<BBhi", 0, 0, 0, (imm >> 32) & 0xFFFFFFFF))
+    lo = imm & 0xFFFFFFFF
+    hi = (imm >> 32) & 0xFFFFFFFF
+    lo = lo - (1 << 32) if lo >= 1 << 31 else lo
+    hi = hi - (1 << 32) if hi >= 1 << 31 else hi
+    return (struct.pack("<BBhi", OP_LD_IMM64, (src << 4) | dst, 0, lo)
+            + struct.pack("<BBhi", 0, 0, 0, hi))
 
 
 _ACCESS_BITS = {"r": BPF_DEVCG_ACC_READ, "w": BPF_DEVCG_ACC_WRITE,
@@ -192,16 +197,106 @@ def _telemetry_block(map_fd: int) -> bytes:
     return bytes(out)
 
 
+# --- policy-carrying grants (the enforcement half of the gpu_ext-style
+# policy engine; the telemetry half landed in PR 6) ---
+#
+# A grant is no longer a static rule compiled into the program: it is one
+# u64 entry in a per-cgroup BPF hash map keyed like the telemetry map
+# ((major << 32) | minor). The value packs the share's QoS policy:
+#
+#     bits 48..63  QoS weight   (u16; advisory — read by the scheduler
+#                                and the /shares plane, not the kernel)
+#     bits 32..47  reserved (0)
+#     bits  0..31  token budget (u32 admits remaining; decremented
+#                                in-kernel per access attempt;
+#                                POLICY_UNMETERED = never decremented)
+#
+# The program's policy block looks the key up; an entry with tokens
+# left admits (consuming one), tokens == 0 denies in-kernel, and a map
+# MISS falls through to the static rule set (base + defaults) — so
+# grant/re-grant/re-weight/revoke are all plain map writes and the
+# program is loaded exactly once per cgroup. Userspace refills token
+# budgets (classic split token bucket: check in-kernel, refill in
+# userspace, gpu_ext-style).
+
+POLICY_UNMETERED = 0xFFFFFFFF  # token field sentinel: admit, never decrement
+
+
+def policy_value(weight: int, tokens: int = POLICY_UNMETERED) -> int:
+    """Pack one share's (QoS weight, token budget) into a map value."""
+    return ((weight & 0xFFFF) << 48) | (tokens & 0xFFFFFFFF)
+
+
+def policy_weight(value: int) -> int:
+    return (value >> 48) & 0xFFFF
+
+
+def policy_tokens(value: int) -> int:
+    return value & 0xFFFFFFFF
+
+
+def _policy_block(map_fd: int) -> bytes:
+    """In-kernel admit/deny + token bucket, evaluated BEFORE the static
+    rules. Self-contained (saves/restores ctx) so it composes with the
+    telemetry block, which runs first — denied/throttled attempts are
+    still counted.
+
+    Decision table for the device key's policy-map entry:
+      miss                  -> fall through to the static rule set
+      tokens == UNMETERED   -> allow, no decrement
+      tokens >  0           -> allow, atomically consume one token
+      tokens == 0           -> deny in-kernel (throttled)
+
+    The throttle deny is authoritative: an entry's presence means policy
+    governs that device, so not even the default mknod-any rule admits a
+    throttled chip. The XADD decrement is approximate under concurrency
+    (two CPUs can both see tokens==1), the standard in-kernel token-
+    bucket trade; the userspace refiller re-clamps each period."""
+    out = bytearray()
+    out += insn(OP_MOV64_REG, dst=6, src=1)            # save ctx
+    out += insn(OP_LDX_MEM_W, dst=4, src=1, off=4)     # major
+    out += insn(OP_LDX_MEM_W, dst=5, src=1, off=8)     # minor
+    out += insn(OP_LSH64_IMM, dst=4, imm=32)
+    out += insn(OP_OR64_REG, dst=4, src=5)             # r4 = key
+    out += insn(OP_STX_MEM_DW, dst=10, src=4, off=-8)  # key -> stack
+    out += insn_ld_imm64(dst=1, imm=map_fd, src=BPF_PSEUDO_MAP_FD)
+    out += insn(OP_MOV64_REG, dst=2, src=10)
+    out += insn(OP_ADD64_IMM, dst=2, imm=-8)           # r2 = &key
+    out += insn(OP_CALL, imm=BPF_FUNC_map_lookup_elem)
+    out += insn(OP_JEQ_IMM, dst=0, off=14, imm=0)      # miss: static rules
+    out += insn(OP_LDX_MEM_DW, dst=7, src=0, off=0)    # r7 = value
+    out += insn(OP_MOV64_REG, dst=8, src=7)
+    out += insn(OP_LSH64_IMM, dst=8, imm=32)
+    out += insn(OP_RSH64_IMM, dst=8, imm=32)           # r8 = tokens
+    out += insn_ld_imm64(dst=9, imm=POLICY_UNMETERED)
+    out += insn(OP_JEQ_REG, dst=8, src=9, off=5)       # unmetered: allow
+    out += insn(OP_JNE_IMM, dst=8, off=2, imm=0)       # tokens left: consume
+    out += insn(OP_MOV64_IMM, dst=0, imm=0)            # throttled: deny
+    out += insn(OP_EXIT)
+    out += insn(OP_MOV64_IMM, dst=1, imm=-1)
+    out += insn(OP_XADD_DW, dst=0, src=1, off=0)       # lock tokens--
+    out += insn(OP_MOV64_IMM, dst=0, imm=1)            # allow
+    out += insn(OP_EXIT)
+    out += insn(OP_MOV64_REG, dst=1, src=6)            # miss path: restore ctx
+    return bytes(out)
+
+
 def build_device_program(rules: list[DeviceRule] | tuple[DeviceRule, ...],
-                         telemetry_map_fd: int | None = None) -> bytes:
+                         telemetry_map_fd: int | None = None,
+                         policy_map_fd: int | None = None) -> bytes:
     """Assemble the allow-list program; returns raw bpf_insn bytes.
 
     With `telemetry_map_fd`, the program additionally counts every
     device-access attempt into that map (see _telemetry_block) — the
-    allow/deny semantics are unchanged."""
+    allow/deny semantics are unchanged. With `policy_map_fd`, granted
+    devices are admitted via policy-map entries (see _policy_block)
+    before the static rules run, so the static `rules` only need to
+    carry the base/default set."""
     out = bytearray()
     if telemetry_map_fd is not None:
         out += _telemetry_block(telemetry_map_fd)
+    if policy_map_fd is not None:
+        out += _policy_block(policy_map_fd)
     # prologue: unpack ctx (r1) into r2=type, r3=access, r4=major, r5=minor
     out += insn(OP_LDX_MEM_W, dst=2, src=1, off=0)
     out += insn(OP_MOV64_REG, dst=3, src=2)
@@ -423,6 +518,20 @@ def map_update(map_fd: int, key: int, value: int = 0,
         raise BpfError(err, f"BPF_MAP_UPDATE_ELEM: {os.strerror(err)}")
 
 
+def map_delete(map_fd: int, key: int) -> None:
+    """Remove a u64 key (BPF_MAP_DELETE_ELEM). ENOENT is tolerated —
+    revoke of an already-gone entry (crash replay, double revoke) must
+    be idempotent."""
+    key_buf = ctypes.create_string_buffer(struct.pack("<Q", key), 8)
+    attr = struct.pack(_MAP_OP_FMT, map_fd, ctypes.addressof(key_buf), 0, 0)
+    ret, _ = _bpf(BPF_MAP_DELETE_ELEM, attr)
+    if ret < 0:
+        err = ctypes.get_errno()
+        if err == 2:  # ENOENT
+            return
+        raise BpfError(err, f"BPF_MAP_DELETE_ELEM: {os.strerror(err)}")
+
+
 def map_keys(map_fd: int, limit: int = 4096) -> list[int]:
     """Every u64 key in the map (BPF_MAP_GET_NEXT_KEY iteration)."""
     keys: list[int] = []
@@ -460,6 +569,11 @@ PROGRAM_SWAPS = REGISTRY.counter(
     "tpumounter_ebpf_program_swaps_total",
     "Device-program replacement cycles (grant/revoke). Telemetry "
     "collection reads maps only and must never move this counter")
+
+MAP_GRANTS = REGISTRY.counter(
+    "tpumounter_ebpf_map_grants_total",
+    "Grants/revokes applied as pure policy-map writes — the O(1) warm "
+    "path that must never move tpumounter_ebpf_program_swaps_total")
 
 TELEMETRY_OVERFLOW_TENANT = "_overflow"
 
@@ -587,6 +701,14 @@ class _CgroupState:
     # treats like any counter reset.
     telemetry_fd: int | None = None
     tenant: str = ""
+    # Policy half (ISSUE 17): the per-cgroup grant-table map the device
+    # program consults (None = kernel maps unavailable -> legacy static-
+    # rule grants with a program swap per batch) and the userspace
+    # shadow of its entries, device key -> packed policy_value. The
+    # shadow is bookkeeping only — enumerate_policies() reads the REAL
+    # map so drift between the two is detectable (chaos invariant 19).
+    policy_fd: int | None = None
+    policies: dict[int, int] = field(default_factory=dict)
 
 
 class V2DeviceController:
@@ -671,6 +793,14 @@ class V2DeviceController:
                     os.unlink(tmp_pin)
                 obj_pin(tmp_pin, st.our_fd)
                 os.replace(tmp_pin, ours_pin)
+            if st.policy_fd is not None:
+                # Pinning the grant-table map (maps pin like programs)
+                # means a restarted worker re-opens the SAME kernel map
+                # the still-attached program reads — fractional grants
+                # survive the crash with zero swaps on the replay path.
+                pmap_pin = os.path.join(self.pin_dir, f"{key}-pmap")
+                if not os.path.exists(pmap_pin):
+                    obj_pin(pmap_pin, st.policy_fd)
             record = {
                 "cgroup_dir": cgroup_dir,
                 "n_orig": len(st.original_fds),
@@ -680,6 +810,8 @@ class V2DeviceController:
                             for (maj, minor), group in st.granted.items()],
                 "base_rules": [[r.type, r.major, r.minor, r.access]
                                for r in st.base_rules],
+                "policies": [[mkey, value]
+                             for mkey, value in st.policies.items()],
             }
             with open(self._journal_path(cgroup_dir), "w") as f:
                 json.dump(record, f)
@@ -692,7 +824,7 @@ class V2DeviceController:
             return
         key = self._key(cgroup_dir)
         for name in ([f"{key}-orig-{i}" for i in range(n_orig)]
-                     + [f"{key}-ours"]):
+                     + [f"{key}-ours", f"{key}-pmap"]):
             try:
                 os.unlink(os.path.join(self.pin_dir, name))
             except FileNotFoundError:
@@ -735,6 +867,14 @@ class V2DeviceController:
                 if os.path.exists(ours_pin):
                     our_fd = obj_get(ours_pin)
                     opened.append(our_fd)
+                policy_fd = None
+                policies: dict[int, int] = {}
+                pmap_pin = os.path.join(self.pin_dir, f"{key}-pmap")
+                if os.path.exists(pmap_pin):
+                    policy_fd = obj_get(pmap_pin)
+                    opened.append(policy_fd)
+                    policies = {int(k): int(v)
+                                for k, v in record.get("policies", [])}
                 granted: dict[tuple[int, int], tuple[DeviceRule, ...]] = {}
                 for entry in record["granted"]:
                     maj, minor, tail = entry[0], entry[1], entry[2]
@@ -749,7 +889,8 @@ class V2DeviceController:
                               in record.get("base_rules", [])]
                 self._state[cgroup_dir] = _CgroupState(
                     cgroup_fd=cgroup_fd, original_fds=original_fds,
-                    our_fd=our_fd, granted=granted, base_rules=base_rules)
+                    our_fd=our_fd, granted=granted, base_rules=base_rules,
+                    policy_fd=policy_fd, policies=policies)
                 logger.info("restored v2 grant state for %s (%d grant(s))",
                             cgroup_dir, len(granted))
             except (OSError, BpfError, KeyError, ValueError, TypeError) as exc:
@@ -770,7 +911,8 @@ class V2DeviceController:
                 n_orig = (record.get("n_orig", 64)
                           if isinstance(record, dict) else 64)
                 for pin in ([f"{key}-orig-{i}" for i in range(n_orig)]
-                            + [f"{key}-ours", f"{key}-ours.new"]):
+                            + [f"{key}-ours", f"{key}-ours.new",
+                               f"{key}-pmap"]):
                     try:
                         os.unlink(os.path.join(self.pin_dir, pin))
                     except OSError:
@@ -803,21 +945,35 @@ class V2DeviceController:
                 f"cannot query existing device progs on {cgroup_dir} "
                 f"({exc}); refusing to grant blindly") from exc
         telemetry_fd = None
+        policy_fd = None
         if self._telemetry_maps:
             try:
                 telemetry_fd = map_create()
             except BpfError as exc:
                 logger.warning("telemetry map create failed for %s: %s "
                                "(userspace counting only)", cgroup_dir, exc)
+            try:
+                policy_fd = map_create(name="tpum_policy")
+            except BpfError as exc:
+                logger.warning("policy map create failed for %s: %s "
+                               "(static-rule grants with program swaps)",
+                               cgroup_dir, exc)
         st = _CgroupState(cgroup_fd=cgroup_fd, original_fds=original_fds,
                           our_fd=None, granted={},
                           base_rules=list(base_rules or []),
-                          telemetry_fd=telemetry_fd)
+                          telemetry_fd=telemetry_fd, policy_fd=policy_fd)
         self._state[cgroup_dir] = st
         return st
 
     def _rules(self, st: _CgroupState) -> list[DeviceRule]:
         out = list(DEFAULT_CONTAINER_RULES) + st.base_rules
+        if st.policy_fd is not None:
+            # Grant-table entries live in the policy map, not the
+            # program: the static set is base + defaults only, and is
+            # therefore IMMUTABLE for the cgroup's lifetime — why one
+            # program load suffices and every grant after it is a map
+            # write.
+            return out
         seen: set[DeviceRule] = set(out)
         for group in st.granted.values():
             for rule in group:
@@ -829,7 +985,8 @@ class V2DeviceController:
     def _swap_program(self, st: _CgroupState) -> None:
         PROGRAM_SWAPS.inc()
         new_fd = prog_load(build_device_program(
-            self._rules(st), telemetry_map_fd=st.telemetry_fd))
+            self._rules(st), telemetry_map_fd=st.telemetry_fd,
+            policy_map_fd=st.policy_fd))
         try:
             prog_attach(st.cgroup_fd, new_fd)
         except BpfError:
@@ -863,6 +1020,60 @@ class V2DeviceController:
         with self._mu:
             return {cg: set(st.granted)
                     for cg, st in self._state.items() if st.granted}
+
+    def enumerate_policies(self) -> dict[str, dict[int, int]]:
+        """cgroup dir -> {device key: packed policy value}, read from the
+        KERNEL map (not the userspace shadow) wherever one exists — the
+        'map entries' leg of chaos invariant 19's three-way books
+        comparison, and the orphan detector's ground truth."""
+        out: dict[str, dict[int, int]] = {}
+        with self._mu:
+            for cg, st in self._state.items():
+                if st.policy_fd is None:
+                    continue
+                entries: dict[int, int] = {}
+                for mkey in map_keys(st.policy_fd):
+                    value = map_lookup(st.policy_fd, mkey)
+                    if value is not None:
+                        entries[mkey] = value
+                out[cg] = entries
+        return out
+
+    def orphan_policy_keys(self) -> dict[str, list[int]]:
+        """Map entries no tracked grant references (leaked by a crash
+        between map_update and journal write, or by an out-of-band map
+        writer). Detection only — gc_policy_orphans() removes them."""
+        out: dict[str, list[int]] = {}
+        with self._mu:
+            for cg, st in self._state.items():
+                if st.policy_fd is None:
+                    continue
+                live = {telemetry_key(r.major, r.minor)
+                        for group in st.granted.values() for r in group
+                        if r.major is not None and r.minor is not None}
+                orphans = [k for k in map_keys(st.policy_fd)
+                           if k not in live]
+                if orphans:
+                    out[cg] = orphans
+        return out
+
+    def gc_policy_orphans(self) -> int:
+        """Delete orphaned policy-map entries (see orphan_policy_keys);
+        returns the number removed. Called from the reaper's reconcile
+        loop alongside gc_dead_cgroups."""
+        removed = 0
+        with self._mu:
+            for cg, orphans in self.orphan_policy_keys().items():
+                st = self._state[cg]
+                for mkey in orphans:
+                    map_delete(st.policy_fd, mkey)
+                    st.policies.pop(mkey, None)
+                    removed += 1
+                if orphans:
+                    self._persist(cg, st)
+                    logger.info("GC'd %d orphan policy entr(ies) on %s",
+                                len(orphans), cg)
+        return removed
 
     def _seed_telemetry(self, st: _CgroupState, devs: list[TpuDevice],
                         tenant: str) -> None:
@@ -907,71 +1118,128 @@ class V2DeviceController:
 
     def grant(self, cgroup_dir: str, dev: TpuDevice,
               base_rules: list[DeviceRule] | None = None,
-              tenant: str = "") -> None:
+              tenant: str = "",
+              policy: dict[str, tuple[int, int]] | None = None) -> None:
         with self._mu:
-            self._grant_locked(cgroup_dir, dev, base_rules, tenant=tenant)
+            self._grant_many_locked(cgroup_dir, [dev], base_rules,
+                                    tenant=tenant, policy=policy)
 
     def grant_many(self, cgroup_dir: str, devs: list[TpuDevice],
                    base_rules: list[DeviceRule] | None = None,
-                   tenant: str = "") -> None:
-        """Grant a batch of chips with ONE program swap.
+                   tenant: str = "",
+                   policy: dict[str, tuple[int, int]] | None = None) -> None:
+        """Grant a batch of chips; policy-map entries when the kernel
+        supports maps, one program swap otherwise.
 
-        The replacement program carries the full rule set anyway, so N
-        chips cost the same bpf(BPF_PROG_LOAD)+attach cycle as one —
-        the worker's batch mount path (mounter.mount_many) uses this
-        instead of N swap cycles. All-or-nothing: a failed swap restores
-        the tracked rule set exactly (no chip from the batch granted).
-        """
+        Map path (ISSUE 17): the FIRST grant on a cgroup loads + attaches
+        the replacement program once (base rules + policy-map lookup);
+        every grant after that — including this whole batch — is a
+        bpf(BPF_MAP_UPDATE_ELEM) per chip, so warm re-grants are O(1)
+        and `tpumounter_ebpf_program_swaps_total` does not move.
+        `policy` maps chip uuid -> (qos_weight, token_budget); chips
+        without an entry get weight 0 / POLICY_UNMETERED (the classic
+        whole-chip grant). Legacy path (no kernel maps): the replacement
+        program carries the full rule set, one swap per batch, exactly
+        as before. Both paths are all-or-nothing: a failure rolls the
+        tracked grant set back (no chip from the batch granted)."""
         with self._mu:
-            st = self._get_state(cgroup_dir, base_rules)
-            self._seed_telemetry(st, devs, tenant)
-            priors = {}
-            for dev in devs:
-                key = (dev.major, dev.minor)
-                priors[key] = st.granted.get(key)
-                st.granted[key] = (device_rule(dev),) + tuple(
-                    DeviceRule("c", comp.major, comp.minor, "rw")
-                    for comp in dev.companions)
-            try:
-                self._swap_program(st)
-            except BpfError:
-                for key, prior in priors.items():
-                    if prior is None:
-                        st.granted.pop(key, None)
-                    else:
-                        st.granted[key] = prior
-                if not st.granted and st.our_fd is None:
-                    self._close_state(cgroup_dir)
-                raise
-            self._persist(cgroup_dir, st)
-            logger.info("cgroup v2: granted %d chip rule(s) on %s in one "
-                        "program swap", len(devs), cgroup_dir)
+            self._grant_many_locked(cgroup_dir, devs, base_rules,
+                                    tenant=tenant, policy=policy)
 
-    def _grant_locked(self, cgroup_dir: str, dev: TpuDevice,
-                      base_rules: list[DeviceRule] | None = None,
-                      tenant: str = "") -> None:
+    @staticmethod
+    def _policy_for(dev: TpuDevice,
+                    policy: dict[str, tuple[int, int]] | None) -> int:
+        if policy and dev.uuid in policy:
+            weight, tokens = policy[dev.uuid]
+            return policy_value(weight, tokens)
+        return policy_value(0, POLICY_UNMETERED)
+
+    def _grant_many_locked(self, cgroup_dir: str, devs: list[TpuDevice],
+                           base_rules: list[DeviceRule] | None = None,
+                           tenant: str = "",
+                           policy: dict[str, tuple[int, int]] | None = None,
+                           ) -> None:
         st = self._get_state(cgroup_dir, base_rules)
-        self._seed_telemetry(st, [dev], tenant)
-        key = (dev.major, dev.minor)
-        prior = st.granted.get(key)
-        st.granted[key] = (device_rule(dev),) + tuple(
-            DeviceRule("c", comp.major, comp.minor, "rw")
-            for comp in dev.companions)
+        self._seed_telemetry(st, devs, tenant)
+        priors = {}
+        for dev in devs:
+            key = (dev.major, dev.minor)
+            priors[key] = st.granted.get(key)
+            st.granted[key] = (device_rule(dev),) + tuple(
+                DeviceRule("c", comp.major, comp.minor, "rw")
+                for comp in dev.companions)
         try:
-            self._swap_program(st)
-        except BpfError:
-            # Roll the rule back out: a later successful grant must not
-            # silently include a chip whose grant failed.
-            if prior is None:
-                st.granted.pop(key, None)
+            if st.policy_fd is not None:
+                first_grant = st.our_fd is None
+                if first_grant:
+                    # One-time: attach the policy-carrying program. The
+                    # grant table itself rides the map writes below.
+                    self._swap_program(st)
+                prior_entries = dict(st.policies)
+                try:
+                    for dev in devs:
+                        mkey = telemetry_key(dev.major, dev.minor)
+                        value = self._policy_for(dev, policy)
+                        map_update(st.policy_fd, mkey, value)
+                        st.policies[mkey] = value
+                        for comp in dev.companions:
+                            ckey = telemetry_key(comp.major, comp.minor)
+                            if ckey not in st.policies:
+                                cval = policy_value(0, POLICY_UNMETERED)
+                                map_update(st.policy_fd, ckey, cval)
+                                st.policies[ckey] = cval
+                    MAP_GRANTS.inc(float(len(devs)))
+                except BpfError:
+                    # Unwind the entries this batch added/changed; the
+                    # attached program with the restored map is exactly
+                    # the pre-batch policy.
+                    for mkey in list(st.policies):
+                        if mkey not in prior_entries:
+                            map_delete(st.policy_fd, mkey)
+                            st.policies.pop(mkey, None)
+                        elif st.policies[mkey] != prior_entries[mkey]:
+                            map_update(st.policy_fd, mkey,
+                                       prior_entries[mkey])
+                            st.policies[mkey] = prior_entries[mkey]
+                    raise
             else:
-                st.granted[key] = prior
+                self._swap_program(st)
+        except BpfError:
+            for key, prior in priors.items():
+                if prior is None:
+                    st.granted.pop(key, None)
+                else:
+                    st.granted[key] = prior
             if not st.granted and st.our_fd is None:
                 self._close_state(cgroup_dir)
             raise
         self._persist(cgroup_dir, st)
-        logger.info("cgroup v2: granted c %d:%d rw on %s",
-                    dev.major, dev.minor, cgroup_dir)
+        logger.info(
+            "cgroup v2: granted %d chip rule(s) on %s via %s", len(devs),
+            cgroup_dir,
+            "map update (no swap)" if st.policy_fd is not None
+            and st.our_fd is not None else "program swap")
+
+    def update_policy(self, cgroup_dir: str, dev: TpuDevice,
+                      weight: int, tokens: int = POLICY_UNMETERED) -> None:
+        """Re-weight / refill an existing grant in place: pure
+        bpf(BPF_MAP_UPDATE_ELEM), zero program swaps. This is the QoS
+        control knob the vchip packer turns on live shares (and the
+        userspace token refiller's write path)."""
+        with self._mu:
+            st = self._state.get(cgroup_dir)
+            if st is None or st.policy_fd is None:
+                raise BpfError(0, f"no policy map for {cgroup_dir}; "
+                                  "cannot update policy in place")
+            mkey = telemetry_key(dev.major, dev.minor)
+            if (dev.major, dev.minor) not in st.granted:
+                raise BpfError(0, f"device {dev.major}:{dev.minor} not "
+                                  f"granted on {cgroup_dir}")
+            value = policy_value(weight, tokens)
+            map_update(st.policy_fd, mkey, value)
+            st.policies[mkey] = value
+            MAP_GRANTS.inc()
+            self._persist(cgroup_dir, st)
 
     def revoke(self, cgroup_dir: str, dev: TpuDevice) -> None:
         with self._mu:
@@ -983,7 +1251,26 @@ class V2DeviceController:
             logger.warning("revoke on untracked cgroup %s; no-op", cgroup_dir)
             return
         st.granted.pop((dev.major, dev.minor), None)
-        if st.granted:
+        if st.policy_fd is not None:
+            # Map-path revoke: delete the chip's entry, then GC any
+            # companion entry no remaining grant group references —
+            # leaving one behind would keep kernel access to a shared
+            # node (vfio container) the pod no longer legitimately
+            # holds, and is exactly the orphan the lifecycle tests hunt.
+            mkey = telemetry_key(dev.major, dev.minor)
+            map_delete(st.policy_fd, mkey)
+            st.policies.pop(mkey, None)
+            live = {telemetry_key(r.major, r.minor)
+                    for group in st.granted.values() for r in group
+                    if r.major is not None and r.minor is not None}
+            for okey in [k for k in st.policies if k not in live]:
+                map_delete(st.policy_fd, okey)
+                st.policies.pop(okey, None)
+            MAP_GRANTS.inc()
+            if st.granted:
+                self._persist(cgroup_dir, st)
+                return
+        elif st.granted:
             self._swap_program(st)
             self._persist(cgroup_dir, st)
             return
@@ -1060,6 +1347,8 @@ class V2DeviceController:
                 logger.warning("final telemetry harvest for %s failed: %s",
                                cgroup_dir, exc)
             os.close(st.telemetry_fd)
+        if st.policy_fd is not None:
+            os.close(st.policy_fd)
         os.close(st.cgroup_fd)
 
     def close(self) -> None:

@@ -623,6 +623,74 @@ def cmd_defrag(args) -> int:
     return 0 if status == 200 else 1
 
 
+def cmd_shares(args) -> int:
+    """Fractional chip shares. No flag: the share books (GET /shares;
+    exit 3 when any chip's booked load exceeds the weight capacity —
+    a books bug worth a page). --admit books shares for a tenant
+    (--pod, --profile, --chips, --weight, --rate-budget, candidate
+    chips via repeated --chip UUID=NODE); --release drops every share
+    the tenant holds. A 409 admission refusal exits 2: the packer
+    refused, nothing was booked."""
+    if args.admit or args.release:
+        if not args.pod:
+            print("error: --pod is required with --admit/--release",
+                  file=sys.stderr)
+            return 2
+    if args.admit:
+        inventory = {}
+        for raw in args.chip:
+            uuid, sep, node = raw.partition("=")
+            if not sep or not uuid or not node:
+                print(f"error: bad --chip {raw!r} (want UUID=NODE)",
+                      file=sys.stderr)
+                return 2
+            inventory[uuid] = node
+        status, body = _http(
+            args, "POST", "/shares",
+            json_body={"namespace": args.namespace, "pod": args.pod,
+                       "profile": args.profile, "chips": args.chips,
+                       "weight": args.weight,
+                       "rate_budget": args.rate_budget,
+                       "inventory": inventory},
+            token=_remote_token(args))
+        print(body.rstrip())
+        if status == 409:
+            return 2
+        return 0 if status == 200 else 1
+    if args.release:
+        status, body = _http(
+            args, "DELETE",
+            f"/shares/{urllib.parse.quote(args.namespace)}/"
+            f"{urllib.parse.quote(args.pod)}",
+            token=_remote_token(args))
+        print(body.rstrip())
+        return 0 if status == 200 else 1
+    status, body = _http(args, "GET", "/shares", token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return 1
+    capacity = payload.get("weight_capacity", 0)
+    overbooked = False
+    for uuid, entry in sorted((payload.get("chips") or {}).items()):
+        line = (f"{uuid} on {entry.get('node', '?')}: "
+                f"{entry.get('tenants', 0)} tenant(s), load "
+                f"{entry.get('load', 0)}/{capacity} "
+                f"[{', '.join(entry.get('profiles') or [])}]")
+        if capacity and entry.get("load", 0) > capacity:
+            line += " OVERBOOKED"
+            overbooked = True
+        print(line, file=sys.stderr)
+    totals = payload.get("totals", {})
+    print(f"{totals.get('shares', 0)} share(s) over "
+          f"{totals.get('chips', 0)} chip(s), "
+          f"{totals.get('shared_chips', 0)} co-located", file=sys.stderr)
+    return 3 if overbooked else 0
+
+
 def _parse_bulk_target(raw: str, default_ns: str) -> dict:
     """"[ns/]pod[:chips]" -> a /batch/addtpu target entry."""
     body, _, chips = raw.partition(":")
@@ -1065,6 +1133,39 @@ def build_parser() -> argparse.ArgumentParser:
                     help="with --run: refuse unless this exact plan "
                          "is still adopted")
     df.set_defaults(fn=cmd_defrag)
+
+    vs = sub.add_parser("shares",
+                        help="fractional chip shares: the co-location "
+                             "books (no flag: state pane, exit 3 when "
+                             "any chip is over its weight capacity; "
+                             "--admit/--release mutate, exit 2 on an "
+                             "admission refusal)")
+    _obs_common(vs)
+    vs_group = vs.add_mutually_exclusive_group()
+    vs_group.add_argument("--admit", action="store_true",
+                          help="book fractional shares for a tenant "
+                               "(needs --pod; mutate token)")
+    vs_group.add_argument("--release", action="store_true",
+                          help="release every share a tenant holds "
+                               "(needs --pod; mutate token)")
+    vs.add_argument("--namespace", default="default")
+    vs.add_argument("--pod", default=None)
+    vs.add_argument("--profile", default="balanced",
+                    help="tenant serving profile: prefill, decode or "
+                         "balanced (complementary profiles co-locate "
+                         "first)")
+    vs.add_argument("--chips", type=int, default=1,
+                    help="how many chips to take a share of")
+    vs.add_argument("--weight", type=int, default=50,
+                    help="QoS weight per chip (1..VCHIP_WEIGHT_CAPACITY)")
+    vs.add_argument("--rate-budget", type=int, default=0,
+                    help="device-access token budget per chip "
+                         "(0 = unmetered)")
+    vs.add_argument("--chip", action="append", default=[],
+                    metavar="UUID=NODE",
+                    help="candidate chip for --admit (repeatable); the "
+                         "packer also considers already-shared chips")
+    vs.set_defaults(fn=cmd_shares)
 
     r = sub.add_parser("remove", help="hot-remove via a running master")
     r.add_argument("--master", required=True)

@@ -469,6 +469,41 @@ class Config:
     # in master/topology.py.
     defrag_target_block: int = field(default_factory=lambda: int(
         _env("DEFRAG_TARGET_BLOCK", "4")))
+    # Concurrent move groups the defrag executor may run when their
+    # host sets (source + destination nodes) are disjoint. 1 = strictly
+    # serial (the PR 16 behavior); gates are still re-checked between
+    # batches whatever the fan-out.
+    defrag_group_fanout: int = field(default_factory=lambda: int(
+        _env("DEFRAG_GROUP_FANOUT", "2")))
+
+    # --- fractional chip virtualization (gpumounter_tpu/vchip) ---
+    # The admission controller for policy-carrying fractional shares:
+    # inert until a share is requested (POST /shares), so it defaults
+    # on. Off = /shares answers 503 and every grant stays whole-chip.
+    vchip_enabled: bool = field(default_factory=lambda: _env(
+        "TPUMOUNTER_VCHIP", "true").lower() in ("1", "true", "yes"))
+    # Total QoS weight one chip can host; the packer refuses admissions
+    # that would push a chip's share-weight sum past this. 100 makes
+    # weights read as percentages.
+    vchip_weight_capacity: int = field(default_factory=lambda: int(
+        _env("VCHIP_WEIGHT_CAPACITY", "100")))
+    # Registry bound (the 256-tenant _overflow convention's analogue for
+    # shares): admissions past this are refused, not silently dropped.
+    vchip_max_shares: int = field(default_factory=lambda: int(
+        _env("VCHIP_MAX_SHARES", "1024")))
+    # Default per-share token budget for rate-limited shares; 0 =
+    # unmetered (admit always, weight still recorded). A tenant can
+    # override per admission.
+    vchip_rate_budget: int = field(default_factory=lambda: int(
+        _env("VCHIP_RATE_BUDGET", "0")))
+
+    # --- defrag-aware admission hint (allocator placement) ---
+    # When placing new slave pods the allocator consults the capacity
+    # plane's blocked-host set (hosts whose free chips are too
+    # fragmented for the target block size) and prefers other hosts —
+    # placements the defragmenter would otherwise have to undo.
+    alloc_defrag_hint: bool = field(default_factory=lambda: _env(
+        "ALLOC_DEFRAG_HINT", "true").lower() in ("1", "true", "yes"))
 
     # --- tenant-side telemetry (gpumounter_tpu/jaxside/telemetry.py +
     # obs/tenants.py) ---

@@ -32,6 +32,7 @@ import statistics
 import threading
 import time
 from collections import deque
+from concurrent import futures
 
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.defrag.planner import PlanError, plan_moves
@@ -411,37 +412,47 @@ class DefragController:
             by_group: dict[str, list[dict]] = {}
             for move in plan["moves"]:
                 by_group.setdefault(move["group"], []).append(move)
-            for group in plan["groups"]:
-                node = group["node"]
+            # Cross-host group parallelism: consecutive groups whose
+            # host sets (source node + every move's destination) are
+            # pairwise disjoint share no chips, no standby pods and no
+            # kubelet, so their moves cannot conflict — they execute
+            # concurrently, bounded by defrag_group_fanout (1 = the
+            # serial shape). Gates and pause are re-checked between
+            # BATCHES, and the barrier samples land after a batch
+            # completes — the fleet state they sample is quiescent, so
+            # chaos invariant 18 (monotonically non-increasing
+            # fragmentation at barriers) holds unchanged.
+            batches = self._disjoint_batches(plan["groups"], by_group)
+            aborted = False
+            for batch in batches:
                 if self._pause.is_set():
                     run["status"] = "paused"
+                    aborted = True
                     break
                 gates = self._gate_state()
                 if not gates["api_ok"]:
                     run["status"] = "parked-api"
                     run["parked"] = gates["api_state"]
                     DEFRAG_REFUSALS.inc(outcome="api-degraded")
+                    aborted = True
                     break
                 if gates["slo_burning"]:
                     run["status"] = "parked-slo"
                     run["parked"] = gates["slo_burning"]
                     DEFRAG_REFUSALS.inc(outcome="slo-burn")
+                    aborted = True
                     break
-                group_ok = True
-                for move in by_group.get(node, []):
-                    outcome = self._execute_move(run, move)
-                    if outcome == "succeeded":
-                        succeeded += 1
-                    else:
-                        group_ok = False
-                        break
-                self._barrier(run, node)
-                if not group_ok:
+                batch_ok, batch_succeeded = self._run_batch(
+                    run, batch, by_group)
+                succeeded += batch_succeeded
+                for group in batch:
+                    self._barrier(run, group["node"])
+                if not batch_ok:
                     run["status"] = "failed-move"
+                    aborted = True
                     break
-            else:
-                if run["status"] == "running":
-                    run["status"] = "completed"
+            if not aborted and run["status"] == "running":
+                run["status"] = "completed"
         except Exception as exc:  # noqa: BLE001 — terminal boundary:
             # the run view must reach history with a truthful status
             logger.exception("defrag run %s died: %s", plan["id"], exc)
@@ -480,6 +491,75 @@ class DefragController:
                 if self._plan is not None \
                         and self._plan["id"] == plan["id"]:
                     self._plan = None  # consumed, even on failure
+
+    def _disjoint_batches(self, groups: list[dict],
+                          by_group: dict[str, list[dict]],
+                          ) -> list[list[dict]]:
+        """Partition the plan's groups, in order, into batches of
+        consecutive groups with pairwise-disjoint host footprints
+        (source node plus every move's destination node), capped at
+        cfg.defrag_group_fanout. Order-preserving on purpose: the
+        planner ranks groups by recovery value, and a reordering
+        "optimization" here would quietly change which hosts recover
+        first."""
+        fanout = max(1, int(getattr(self.cfg, "defrag_group_fanout", 1)))
+
+        def hosts_of(group: dict) -> set[str]:
+            hosts = {group["node"]}
+            for move in by_group.get(group["node"], []):
+                hosts.add(move["source_node"])
+                hosts.add(move["dest_node"])
+            return hosts
+
+        batches: list[list[dict]] = []
+        batch: list[dict] = []
+        batch_hosts: set[str] = set()
+        for group in groups:
+            hosts = hosts_of(group)
+            if batch and (len(batch) >= fanout
+                          or batch_hosts & hosts):
+                batches.append(batch)
+                batch, batch_hosts = [], set()
+            batch.append(group)
+            batch_hosts |= hosts
+        if batch:
+            batches.append(batch)
+        return batches
+
+    def _run_batch(self, run: dict, batch: list[dict],
+                   by_group: dict[str, list[dict]],
+                   ) -> tuple[bool, int]:
+        """Execute one batch of host-disjoint groups — concurrently
+        when the batch has more than one. Moves WITHIN a group stay
+        serial (they share the source host's kubelet and standby
+        pool). Returns (every move succeeded, succeeded count)."""
+
+        def run_group(group: dict) -> tuple[bool, int]:
+            ok, done = True, 0
+            for move in by_group.get(group["node"], []):
+                if self._execute_move(run, move) == "succeeded":
+                    done += 1
+                else:
+                    ok = False
+                    break
+            return ok, done
+
+        if len(batch) == 1:
+            return run_group(batch[0])
+        ctx = trace.current()
+
+        def traced(group: dict) -> tuple[bool, int]:
+            # Contextvars don't cross threads: re-attach the run's
+            # trace so each move's spans join the same story.
+            with trace.attached(ctx):
+                return run_group(group)
+
+        with futures.ThreadPoolExecutor(
+                max_workers=len(batch),
+                thread_name_prefix="defrag-group") as pool:
+            results = list(pool.map(traced, batch))
+        return (all(ok for ok, _ in results),
+                sum(done for _, done in results))
 
     def _execute_move(self, run: dict, move: dict) -> str:
         """One live migration with the checkpoint-assisted drain.

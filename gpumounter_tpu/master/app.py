@@ -378,6 +378,13 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/defrag/plan$"), "defrag_plan"),
     ("POST", re.compile(r"^/defrag/run$"), "defrag_run"),
     ("POST", re.compile(r"^/defrag/pause$"), "defrag_pause"),
+    # Fractional chip shares (gpumounter_tpu/vchip/): the share books
+    # (who holds what fraction of which chip at what QoS weight) and
+    # the co-location admission controller that fills them.
+    ("GET", re.compile(r"^/shares$"), "shares"),
+    ("POST", re.compile(r"^/shares$"), "shares_admit"),
+    ("DELETE", re.compile(
+        r"^/shares/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "shares_release"),
 ]
 
 
@@ -410,7 +417,7 @@ class MasterApp:
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
                              "shards", "recovery", "tenants",
                              "apihealth", "timeline", "capacity",
-                             "defrag"})
+                             "defrag", "shares"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -419,7 +426,7 @@ class MasterApp:
         "add", "remove", "batch_add", "addslice", "removeslice",
         "intent_put", "intent_delete", "migrate_start",
         "migration_abort", "recovery_evacuate", "defrag_plan",
-        "defrag_run", "defrag_pause"})
+        "defrag_run", "defrag_pause", "shares_admit", "shares_release"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
@@ -564,6 +571,16 @@ class MasterApp:
             kube, self.migrations, self.capacity, self.fleet,
             slo=self.slo, apihealth=self.apihealth, shards=self.shards,
             cfg=self.cfg)
+        # Fractional chip shares (gpumounter_tpu/vchip/): the master's
+        # share books plus the co-location admission controller behind
+        # GET/POST /shares. The capacity plane gets the registry so
+        # /capacity reports fractional free capacity next to the
+        # whole-chip numbers.
+        from gpumounter_tpu.vchip.packer import SharePacker
+        from gpumounter_tpu.vchip.shares import ShareRegistry
+        self.shares = ShareRegistry(cfg=self.cfg)
+        self.packer = SharePacker(self.shares, cfg=self.cfg)
+        self.capacity.shares = self.shares
         # Flight recorder (obs/flight.py): root/error spans, audit
         # records and ApiHealth transitions of this replica feed the
         # /timeline pane. Idempotent — any number of apps/tests share
@@ -600,7 +617,7 @@ class MasterApp:
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
                                  "slo", "shards", "recovery", "tenants",
                                  "apihealth", "timeline", "capacity",
-                                 "defrag"})
+                                 "defrag", "shares"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -972,6 +989,78 @@ class MasterApp:
         import json as jsonlib
         return 200, "application/json", \
             jsonlib.dumps(self.defrag.pause(), indent=1) + "\n"
+
+    def _route_shares(self, match, body, headers):
+        """The fractional share books: every (tenant, chip, weight,
+        rate budget) share, per-chip load/headroom, and the co-location
+        totals — the read half of the RUNBOOK's 'Co-locating tenants on
+        shared chips' walkthrough."""
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.shares.payload(), indent=1) + "\n"
+
+    def _route_shares_admit(self, match, body, headers):
+        """Admit a tenant onto fractional shares. JSON body:
+        {"namespace","pod","profile","chips",N,"weight",W,
+         "rate_budget":B?, "inventory":{chip_uuid:node}?}. The packer
+        prefers already-shared chips with a complementary profile, then
+        free chips off the defragmenter's blocked hosts; a typed
+        refusal maps to 409 (never a silent partial booking)."""
+        import json as jsonlib
+        from gpumounter_tpu.vchip.packer import PackRefused
+        from gpumounter_tpu.vchip.shares import ShareLimitError
+        if not self.cfg.vchip_enabled:
+            raise _HttpError(503, "fractional shares are disabled "
+                                  "(TPUMOUNTER_VCHIP=false)")
+        try:
+            payload = jsonlib.loads(body or b"{}")
+        except ValueError:
+            raise _HttpError(400, "body must be JSON")
+        if not isinstance(payload, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        namespace = payload.get("namespace")
+        pod = payload.get("pod")
+        if not namespace or not pod:
+            raise _HttpError(400, "namespace and pod are required")
+        inventory = payload.get("inventory") or {}
+        if not isinstance(inventory, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in inventory.items()):
+            raise _HttpError(
+                400, "inventory must map chip uuid -> node name")
+        try:
+            chips = int(payload.get("chips", 1))
+            weight = int(payload.get("weight", 0))
+            rate_budget = int(payload.get("rate_budget", 0))
+        except (TypeError, ValueError):
+            raise _HttpError(
+                400, "chips, weight and rate_budget must be integers")
+        try:
+            booked = self.packer.admit(
+                str(namespace), str(pod),
+                str(payload.get("profile", "balanced")), chips, weight,
+                rate_budget=rate_budget, inventory=inventory,
+                blocked_hosts=self.capacity.blocked_hosts(
+                    max_age_s=self.cfg.fleet_scrape_interval_s))
+        except (PackRefused, ShareLimitError) as exc:
+            # Typed admission refusals carry their own story; 409 tells
+            # scripted callers "the fleet, not your request, said no".
+            raise _HttpError(409, str(exc))  # tpulint: allow[typed-k8s-errors] own HTTP type
+        return 200, "application/json", jsonlib.dumps({
+            "admitted": [s.to_json() for s in booked],
+        }, indent=1) + "\n"
+
+    def _route_shares_release(self, match, body, headers):
+        """Release every share a tenant holds (DELETE
+        /shares/<ns>/<pod>); 404 when the tenant holds none."""
+        import json as jsonlib
+        ns, pod = match.group("ns"), match.group("pod")
+        released = self.packer.release(ns, pod)
+        if not released:
+            raise _HttpError(404, f"{ns}/{pod} holds no shares")
+        return 200, "application/json", jsonlib.dumps({
+            "released": [s.to_json() for s in released],
+        }, indent=1) + "\n"
 
     def _route_audit(self, match, body, headers):
         """Query the append-only audit trail. Filters (all optional):

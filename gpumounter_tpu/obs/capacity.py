@@ -255,13 +255,17 @@ class CapacityPlane:
     does (the payload says which shards, like /fleet).
     """
 
-    def __init__(self, fleet, cfg=None, elastic=None):
+    def __init__(self, fleet, cfg=None, elastic=None, shares=None):
         if cfg is None:
             from gpumounter_tpu.config import get_config
             cfg = get_config()
         self.cfg = cfg
         self.fleet = fleet
         self.elastic = elastic
+        #: optional vchip.shares.ShareRegistry — when wired, /capacity
+        #: reports fractional free capacity (weight-unit headroom on
+        #: shared chips) next to the whole-chip numbers.
+        self.shares = shares
         self._lock = OrderedLock("capacity.trend")
         #: trailing (wall time, free chips, queue depth) samples the
         #: headroom forecast derives its trends from (one per observe()
@@ -425,6 +429,63 @@ class CapacityPlane:
             }
         return table
 
+    def _shares_view(self, hosts: dict[str, dict],
+                     fleet: dict) -> dict | None:
+        """Fractional free capacity in weight units: whole free chips
+        contribute a full vchip_weight_capacity each, shared chips
+        contribute their remaining headroom. A shared chip whose host
+        is NOT currently reporting (stale node, scrape fallback — the
+        same degradation the whole-chip inventory has) is counted as
+        capacity_unknown, never as free headroom: its books may be
+        arbitrarily stale, and advertising it would green-light shares
+        onto a chip nobody can confirm exists (the PR 14 capacity-none
+        contract, applied to fractions)."""
+        if self.shares is None:
+            return None
+        capacity = int(self.cfg.vchip_weight_capacity)
+        view = {"weight_capacity": capacity, "shares": 0, "chips": 0,
+                "booked_weight": 0, "share_headroom": 0,
+                "unknown_chips": 0}
+        for _uuid, holders in self.shares.shared_chips().items():
+            view["shares"] += len(holders)
+            node = holders[0].node
+            entry = hosts.get(node)
+            if entry is None or entry.get("capacity_unknown"):
+                view["unknown_chips"] += 1
+                continue
+            load = sum(s.weight for s in holders)
+            view["chips"] += 1
+            view["booked_weight"] += load
+            view["share_headroom"] += max(0, capacity - load)
+        view["capacity_unknown"] = view["unknown_chips"] > 0
+        # Whole-chip free capacity expressed in the same unit, so the
+        # admission question "does weight W x N chips fit?" reads off
+        # one number. Unknown chips contribute NOTHING here.
+        view["effective_free_weight"] = (fleet["free"] * capacity
+                                         + view["share_headroom"])
+        return view
+
+    def blocked_hosts(self, max_age_s: float | None = None,
+                      ) -> frozenset[str]:
+        """Hosts named as blocking in the feasibility table — the
+        defragmenter's work queue. Consumers (the vchip packer, the
+        allocator's placement hint) treat these as last-resort
+        placements: packing fresh work there undoes the defrag plan.
+        Never raises; degrades to the empty set."""
+        try:
+            nodes = self.fleet.payload(max_age_s=max_age_s).get(
+                "nodes", {})
+            hosts = self._derive_hosts(nodes)
+            fleet = self._fleet_rollup(hosts)
+            out: set[str] = set()
+            for entry in self._feasibility(hosts, fleet).values():
+                if entry["verdict"] == "admissible-after-defrag":
+                    out.update(entry["blocking_hosts"])
+            return frozenset(out)
+        except Exception as exc:  # noqa: BLE001 — the hint is advisory
+            logger.warning("blocked-host derivation failed: %s", exc)
+            return frozenset()
+
     @staticmethod
     def _queue_depth(nodes: dict[str, dict]) -> float:
         from gpumounter_tpu.obs.fleet import merge_tenants
@@ -462,6 +523,9 @@ class CapacityPlane:
             "headroom": self._headroom(nodes, fleet),
             "demand": self._demand(fleet),
         }
+        shares_view = self._shares_view(hosts, fleet)
+        if shares_view is not None:
+            payload["shares"] = shares_view
         if "shard" in rollup:
             payload["shard"] = rollup["shard"]
         return payload
@@ -645,3 +709,12 @@ def record_rejection(node: str, namespace: str, pod: str,
     plane = _PLANE
     if plane is not None:
         plane.record_rejection(node, namespace, pod, chips)
+
+
+def blocked_hosts() -> frozenset[str]:
+    """Module-level blocked-host hint: empty when no plane is
+    registered (a bare worker process, unit tests), never raises."""
+    plane = _PLANE
+    if plane is None:
+        return frozenset()
+    return plane.blocked_hosts(max_age_s=None)

@@ -50,6 +50,14 @@ class AddTPURequest(Message):
     # where a partitioned old shard owner mutates a node the new owner
     # already manages. 0 (the proto3 default, i.e. legacy/unsharded
     # masters) never fences.
+    # Fields 9-10 carry the fractional (vchip) share policy: a
+    # share_weight > 0 turns the grant into a policy-carrying fractional
+    # grant — every chip this request mounts gets a policy-map entry
+    # (QoS weight + token rate budget, cgroup/ebpf.py) instead of a
+    # whole-chip static rule, recorded in the worker ledger's share
+    # records for crash replay. share_weight == 0 (the proto3 default,
+    # i.e. every legacy caller) keeps exact whole-chip semantics.
+    # share_rate_budget == 0 means unmetered.
     # Wire-compatible: legacy peers skip the unknown fields and see
     # reference semantics.
     FIELDS = [
@@ -61,6 +69,8 @@ class AddTPURequest(Message):
         Field(6, "idempotency_key", "string"),
         Field(7, "trace_context", "string"),
         Field(8, "epoch", "int64"),
+        Field(9, "share_weight", "int32"),
+        Field(10, "share_rate_budget", "int32"),
     ]
 
 

@@ -449,6 +449,8 @@ class WorkerClient:
                          timeout_s: float | None = None,
                          idempotency_key: str | None = None,
                          epoch: int = 0,
+                         share_weight: int = 0,
+                         share_rate_budget: int = 0,
                          ) -> tuple[api.AddTPUResult, list[str]]:
         """(result, mounted device uuids) — uuids empty unless Success.
 
@@ -457,12 +459,17 @@ class WorkerClient:
         recorded response back instead of a second mount.
 
         epoch: the caller's fencing epoch for the target node (0 =
-        unfenced). A stale epoch raises FencedError — never retried."""
+        unfenced). A stale epoch raises FencedError — never retried.
+
+        share_weight/share_rate_budget: fractional (vchip) grant policy;
+        share_weight > 0 makes every mounted chip a policy-carrying
+        fractional grant (rate budget 0 = unmetered)."""
         request = api.AddTPURequest(
             pod_name=pod_name, namespace=namespace, tpu_num=tpu_num,
             is_entire_mount=is_entire_mount, prefer_ici=prefer_ici,
             idempotency_key=idempotency_key or f"add-{secrets.token_hex(8)}",
-            epoch=int(epoch))
+            epoch=int(epoch), share_weight=int(share_weight),
+            share_rate_budget=int(share_rate_budget))
         resp = self._call("AddTPU", self._add, request, timeout_s)
         return api.AddTPUResult(resp.add_tpu_result), list(resp.uuids)
 

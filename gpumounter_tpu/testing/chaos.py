@@ -88,6 +88,21 @@ invariants after convergence:
      reality undetected. The negative control (withhold_unmount: one
      held chip's kubelet claim silently erased, as a lost unmount
      would) must be DETECTED as divergence.
+ 18. defrag closure (run_defrag_scenario): the fleet fragmentation
+     index sampled at the plan's barrier points is monotonically
+     non-increasing, every executed move succeeded with a terminal
+     journal, and every move's tenant disruption is trace-attributed
+     (assembled migrate-phase wall time),
+ 19. fractional-share agreement (run_share_scenario): after every
+     scenario the three share ledgers agree chip-for-chip and
+     value-for-value — master share books == policy-map entries (the
+     userspace engine standing in for the kernel map on fake
+     backends) == worker ledger share records — and a metered tenant
+     driven past its token budget is throttled identically by the
+     userspace engine and by the interpreter executing the real
+     in-kernel program bytecode. The negative control
+     (disable_enforcement: the engine flipped to pure bookkeeping)
+     must be DETECTED as decision divergence.
 
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
@@ -329,6 +344,10 @@ class ChaosHarness:
         #: terminal defrag run views (run_defrag_scenario appends);
         #: non-empty arms invariant 18.
         self.defrag_runs: list[dict] = []
+        #: run_share_scenario arms this so check_invariants also
+        #: asserts invariant 19 (fractional-share agreement + throttle
+        #: decision parity).
+        self.vchip_armed = False
         self.app: MasterApp | None = None
 
     # --- lifecycle ---
@@ -389,6 +408,13 @@ class ChaosHarness:
         # instance, exactly like one real process would).
         from gpumounter_tpu.k8s import health as k8s_health
         k8s_health.reset_all()
+        # Fresh policy-engine table with enforcement ON: a previous
+        # run's share scopes must not leak into this run's invariant-19
+        # books comparison, and the negative control
+        # (disable_enforcement) must not outlive its scenario.
+        from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+        POLICY_ENGINE.reset()
+        POLICY_ENGINE.enforce = True
         self.cluster.start()
         for i, name in enumerate(self.cluster.node_names):
             self._ip_by_node[name] = f"10.9.0.{i + 1}"
@@ -424,6 +450,15 @@ class ChaosHarness:
         old = self.services[name]
         if old.ledger is not None:
             old.ledger.abandon()
+        # Process death takes the in-process policy engine with it:
+        # drop this node's scopes so the ledger replay must re-arm
+        # them (a fresh worker process starts from an empty table —
+        # the engine is process-global only because the harness runs
+        # every "process" in one).
+        from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+        for (ns, pod_name), node_of in self.pods.items():
+            if node_of == name:
+                POLICY_ENGINE.drop_scope(f"{ns}/{pod_name}")
         service = self._build_node_service(name)
         summary = LedgerResync(service).replay_once()
         self._serve_node(name, service)
@@ -837,6 +872,167 @@ class ChaosHarness:
                          self.app.elastic.reconcile_once(ns, name),
                          fault_p=0.0)
         self.converge()
+
+    # --- invariant 19: fractional shares — books == policy == ledger ---
+
+    #: the two co-located share tenants the scenario drives:
+    #: (namespace, pod, profile, weight, rate budget). Weights and
+    #: budgets are fixed per tenant so the probe-driven books resync is
+    #: idempotent; the decode tenant is metered (finite budget) so the
+    #: throttle-parity check always has a share to drive.
+    SHARE_TENANTS = [
+        ("default", "vc-prefill", "prefill", 60, 0),
+        ("default", "vc-decode", "decode", 40, 64),
+    ]
+
+    #: share-op fault pool: no crash actions — worker crashes are
+    #: driven explicitly (crash + restart + replay, like invariant 10)
+    #: so an open mount txn never survives into the invariant check.
+    FAULTS_SHARE = [
+        ("rpc.client.call", "1*unavailable(chaos drop)"),
+        ("rpc.client.call", "1*delay(0.05)"),
+        ("worker.rpc", "1*delay(0.05)"),
+        ("worker.mount.mknod", "1*error(chaos mknod)"),
+        ("worker.unmount.before_revoke", "1*error(chaos revoke)"),
+        ("k8s.patch_pod.status", "1*return(409)"),
+    ]
+
+    def run_share_scenario(self, n_ops: int = 10) -> None:
+        """Fractional (vchip) share traffic under faults: two
+        complementary tenants mount policy-carrying grants on NODE_A
+        through the real RPC path (share_weight/share_rate_budget on
+        the wire -> worker mount_many(policy=...) -> ledger share
+        records + policy engine entries), the master share registry is
+        resynced from probe ground truth after every op, worker
+        crashes are followed by restart + ledger replay (the
+        fractional replay re-arms the policy engine), and releases
+        clear all three ledgers. check_invariants() then asserts
+        invariant 19."""
+        self.vchip_armed = True
+        self.check_ledgers = True
+        for ns, name, _profile, _w, _b in self.SHARE_TENANTS:
+            self.add_pod(name, NODE_A, namespace=ns)
+        for _ in range(n_ops):
+            ns, name, profile, weight, budget = self.rng.choice(
+                self.SHARE_TENANTS)
+            roll = self.rng.random()
+            if roll < 0.25:
+                # Worker crash mid-fractional-mount, restart + replay:
+                # the replay either completes the policy-carrying grant
+                # (ledger + engine re-armed) or rolls it back cleanly.
+                site, action = self.rng.choice(self.CRASH_SITES)
+                self.record(f"arm {site}={action}")
+                failpoints.arm(site, action)
+                try:
+                    self._share_mount(ns, name, weight, budget)
+                except Exception as exc:  # noqa: BLE001 — the crash
+                    self.record(f"crash share-mount {name} -> "
+                                f"{type(exc).__name__}")
+                else:
+                    self.record(f"crash share-mount {name} -> ok "
+                                f"(fault unfired)")
+                finally:
+                    failpoints.disarm_all()
+                self.restart_worker(NODE_A)
+            elif roll < 0.55:
+                self._op(self.FAULTS_SHARE, f"share-mount {name}",
+                         lambda ns=ns, name=name, weight=weight,
+                         budget=budget:
+                         self._share_mount(ns, name, weight, budget))
+            elif roll < 0.8:
+                held = [c.uuid for c in self.probe(ns, name)]
+                if held:
+                    uuid = self.rng.choice(held)
+
+                    def _release(ns=ns, name=name, uuid=uuid):
+                        with self._client_for_node(NODE_A) as client:
+                            client.remove_tpu(name, ns, [uuid],
+                                              force=True)
+
+                    self._op(self.FAULTS_SHARE,
+                             f"share-release {uuid} from {name}",
+                             _release)
+            else:
+                # Warm re-grant: re-book a held share in place — the
+                # O(1) map_update path on the books side (no new slot).
+                held = [c.uuid for c in self.probe(ns, name)]
+                if held:
+                    from gpumounter_tpu.vchip.shares import Share
+                    uuid = self.rng.choice(held)
+                    self.app.shares.add(Share(
+                        namespace=ns, pod=name, chip_uuid=uuid,
+                        node=NODE_A, weight=weight, rate_budget=budget,
+                        profile=profile))
+                    self.record(f"re-grant {ns}/{name}/{uuid}")
+            self._sync_share_books(ns, name, profile, weight, budget)
+        # The throttle-parity check needs a metered share to exist:
+        # make sure the decode tenant ends holding at least one chip
+        # (freeing a prefill chip first if the node is full).
+        ns, name, profile, weight, budget = self.SHARE_TENANTS[1]
+        if not self.probe(ns, name):
+            result, _uuids = self._share_mount(ns, name, weight, budget)
+            if result.name != "Success":
+                p_ns, p_name = self.SHARE_TENANTS[0][:2]
+                p_held = [c.uuid for c in self.probe(p_ns, p_name)]
+                if p_held:
+                    with self._client_for_node(NODE_A) as client:
+                        client.remove_tpu(p_name, p_ns, [p_held[0]],
+                                          force=True)
+                    self._sync_share_books(
+                        p_ns, p_name, *self.SHARE_TENANTS[0][2:])
+                self._share_mount(ns, name, weight, budget)
+            self._sync_share_books(ns, name, profile, weight, budget)
+        # One final clean restart: the fractional-replay leg — share
+        # policies must survive a worker restart via the ledger
+        # (resync._replay_share_policies re-arms the engine).
+        summary = self.restart_worker(NODE_A)
+        if not summary.get("share_policies_replayed"):
+            self.record("WARNING: restart replayed no share policies")
+        for ns, name, profile, weight, budget in self.SHARE_TENANTS:
+            self._sync_share_books(ns, name, profile, weight, budget)
+        self.converge()
+
+    def _share_mount(self, ns: str, name: str, weight: int,
+                     budget: int, n: int = 1):
+        """One fractional mount through the real RPC path; returns
+        (result, uuids)."""
+        with self._client_for_node(NODE_A) as client:
+            result, uuids = client.add_tpu_detailed(
+                name, ns, n, share_weight=weight,
+                share_rate_budget=budget)
+        self.record(f"share-mount {ns}/{name} w={weight} b={budget} "
+                    f"-> {result.name} {uuids}")
+        return result, uuids
+
+    def _sync_share_books(self, ns: str, name: str, profile: str,
+                          weight: int, budget: int) -> None:
+        """Reconcile the master share registry to the worker's ground
+        truth for one tenant — the probe-driven resync a production
+        share controller runs after faults (the registry is a books
+        plane; the worker's ledger + policy engine are authoritative
+        for what is actually granted)."""
+        from gpumounter_tpu.vchip.shares import Share
+        held = {c.uuid for c in self.probe(ns, name)}
+        booked = {s.chip_uuid
+                  for s in self.app.shares.by_tenant(ns, name)}
+        for uuid in sorted(held - booked):
+            self.app.shares.add(Share(
+                namespace=ns, pod=name, chip_uuid=uuid,
+                node=self.pods[(ns, name)], weight=weight,
+                rate_budget=budget, profile=profile))
+        for uuid in sorted(booked - held):
+            self.app.shares.remove(ns, name, uuid)
+
+    def disable_enforcement(self) -> None:
+        """NEGATIVE CONTROL for invariant 19: flip the userspace policy
+        engine into pure-bookkeeper mode (admits everything once the
+        budget is exhausted, exactly what a broken enforcement path
+        would do). The decision procedure now diverges from the
+        in-kernel program the interpreter executes, and
+        check_invariants() must flag the disagreement."""
+        from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+        POLICY_ENGINE.enforce = False
+        self.record("negative control: policy enforcement disabled")
 
     # --- invariant 11: node kill -> evacuation -> re-convergence ---
 
@@ -1644,6 +1840,92 @@ class ChaosHarness:
                         f"without migrate-phase wall time: "
                         f"{tree['phases']}")
 
+        # 19. fractional-share agreement (armed by run_share_scenario):
+        # after convergence the three share ledgers agree chip-for-chip
+        # and value-for-value — master share books == policy entries
+        # (the userspace engine standing in for the kernel map on fake
+        # backends) == worker ledger share records. Weights must be
+        # equal; metered-ness must be equal in kind (the engine's
+        # REMAINING tokens may legitimately sit below the booked
+        # budget — they are consumed — but an unmetered book entry must
+        # never be metered in the map or vice versa). Then the throttle
+        # decision procedure itself is proven: a metered share refilled
+        # to k tokens admits exactly k accesses and then denies,
+        # identically through the engine and through the interpreter
+        # executing the real in-kernel program bytecode, with matching
+        # post-state. The negative control (disable_enforcement) admits
+        # past exhaustion and reads as decision divergence here.
+        if self.vchip_armed:
+            from gpumounter_tpu.cgroup import ebpf as ebpf_mod
+            from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+            books = self.app.shares.books()
+            for scope in POLICY_ENGINE.scopes():
+                if scope not in books:
+                    violations.append(
+                        f"policy engine scope {scope!r} has entries but "
+                        f"no master share books (leaked policy)")
+            for (ns, name), node in sorted(self.pods.items()):
+                tenant = f"{ns}/{name}"
+                if node in self.dead_nodes:
+                    continue
+                want = books.get(tenant, {})
+                service = self.services[node]
+                ledger_shares = {}
+                if service.ledger is not None:
+                    ledger_shares = service.ledger.share_holdings().get(
+                        (ns, name), {})
+                # Books <-> ledger: chip-exact, value-exact.
+                if set(want) != set(ledger_shares):
+                    violations.append(
+                        f"share books/ledger diverge for {tenant}: "
+                        f"books {sorted(want)} != ledger "
+                        f"{sorted(ledger_shares)}")
+                else:
+                    for uuid, (weight, budget) in sorted(want.items()):
+                        if ledger_shares[uuid] != (weight, budget):
+                            violations.append(
+                                f"ledger share record diverges for "
+                                f"{tenant} chip {uuid}: "
+                                f"{ledger_shares[uuid]} != books "
+                                f"({weight}, {budget})")
+                # Books <-> policy entries: at the map's REAL
+                # granularity, (major, minor) keys — the fake backend
+                # mknods every chip from the same device numbers, so
+                # distinct chips legitimately project onto one key
+                # (exactly what the kernel map would hold there too).
+                devs_by_uuid = {
+                    d.uuid: d for d in
+                    self.cluster.node(node).backend.list_devices()}
+                expected: dict[int, set[tuple[int, bool]]] = {}
+                for uuid, (weight, budget) in want.items():
+                    dev = devs_by_uuid.get(uuid)
+                    if dev is None:
+                        violations.append(
+                            f"booked share chip {uuid} for {tenant} "
+                            f"is not a device {node} has")
+                        continue
+                    expected.setdefault(
+                        ebpf_mod.telemetry_key(dev.major, dev.minor),
+                        set()).add((weight, budget > 0))
+                entries = POLICY_ENGINE.entries(tenant)
+                if set(entries) != set(expected):
+                    violations.append(
+                        f"share policy keys diverge for {tenant}: "
+                        f"books project to "
+                        f"{sorted(hex(k) for k in expected)} != policy "
+                        f"entries {sorted(hex(k) for k in entries)}")
+                    continue
+                for key, value in sorted(entries.items()):
+                    got = (ebpf_mod.policy_weight(value),
+                           ebpf_mod.policy_tokens(value)
+                           != ebpf_mod.POLICY_UNMETERED)
+                    if got not in expected[key]:
+                        violations.append(
+                            f"share policy value diverges for {tenant} "
+                            f"key {key:#x}: entry (weight, metered) "
+                            f"{got} not among booked {expected[key]}")
+            violations.extend(self._throttle_agreement(books))
+
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()
         if stats["dialed"] != stats["live"] + stats["closed"]:
@@ -1679,6 +1961,69 @@ class ChaosHarness:
                 f"chaos invariants violated (seed={self.seed}):\n- "
                 + "\n- ".join(violations)
                 + f"\nschedule tail:\n  {tail}")
+
+    def _throttle_agreement(self, books: dict) -> list[str]:
+        """Invariant 19's decision-parity half: drive one metered share
+        past a refilled k-token budget through BOTH deciders — the
+        userspace engine and the interpreter executing the real program
+        bytecode over dict-backed maps — and report any access where
+        they disagree, any access past the budget that is NOT denied,
+        and any remaining-token post-state mismatch. Repeatable: the
+        probe refills the engine entry to k tokens before driving, so a
+        second check_invariants() call reproduces the same walk."""
+        from gpumounter_tpu.cgroup import ebpf as ebpf_mod
+        from gpumounter_tpu.cgroup.policy import (
+            POLICY_ENGINE, interpret_device_program)
+        target = next(
+            ((tenant, uuid, weight, budget)
+             for tenant, shares in sorted(books.items())
+             for uuid, (weight, budget) in sorted(shares.items())
+             if budget > 0), None)
+        if target is None:
+            return ["share scenario converged with no metered share "
+                    "left to probe throttling"]
+        tenant, uuid, weight, _budget = target
+        ns, name = tenant.split("/", 1)
+        node = self.pods[(ns, name)]
+        dev = next(d for d in
+                   self.cluster.node(node).backend.list_devices()
+                   if d.uuid == uuid)
+        probe_tokens = 3
+        POLICY_ENGINE.refill(tenant, dev.major, dev.minor, probe_tokens)
+        key = ebpf_mod.telemetry_key(dev.major, dev.minor)
+        tmap_fd, pmap_fd = 5, 7
+        prog = ebpf_mod.build_device_program(
+            (), telemetry_map_fd=tmap_fd, policy_map_fd=pmap_fd)
+        maps = {tmap_fd: {key: 0},
+                pmap_fd: {key: ebpf_mod.policy_value(weight,
+                                                     probe_tokens)}}
+        rw = ebpf_mod.BPF_DEVCG_ACC_READ | ebpf_mod.BPF_DEVCG_ACC_WRITE
+        out: list[str] = []
+        for step in range(1, probe_tokens + 3):
+            engine = POLICY_ENGINE.admit(tenant, dev.major, dev.minor)
+            kernel = bool(interpret_device_program(
+                prog, maps, ebpf_mod.BPF_DEVCG_DEV_CHAR, rw,
+                dev.major, dev.minor))
+            if bool(engine) != kernel:
+                out.append(
+                    f"throttle divergence for {tenant} chip {uuid} at "
+                    f"access {step} of a {probe_tokens}-token budget: "
+                    f"engine admits={engine} != in-kernel program "
+                    f"admits={kernel}")
+            if step > probe_tokens and kernel:
+                out.append(
+                    f"tenant {tenant} chip {uuid} NOT throttled "
+                    f"in-kernel past its {probe_tokens}-token budget "
+                    f"(access {step} admitted)")
+        left_engine = ebpf_mod.policy_tokens(
+            POLICY_ENGINE.entries(tenant).get(key, 0))
+        left_kernel = ebpf_mod.policy_tokens(maps[pmap_fd][key])
+        if left_engine != left_kernel:
+            out.append(
+                f"throttle post-state diverges for {tenant} chip "
+                f"{uuid}: engine tokens left {left_engine} != map "
+                f"tokens left {left_kernel}")
+        return out
 
 
 # --- invariant 12: stale-shard partition -> fencing (run standalone) ---

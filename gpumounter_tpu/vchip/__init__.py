@@ -1,0 +1,21 @@
+"""Fractional chip virtualization (ISSUE 17).
+
+A *share* is (chip, weight, tenant): one physical chip carried by one
+slave pod can be split across N tenants, each holding a QoS weight and
+an optional rate budget. The pieces:
+
+  * shares.py — the master-side ShareRegistry: the source of truth for
+    who holds what fraction of which chip (the "books count shares not
+    chips" half of the allocation model), with the payload served at
+    GET /shares and the `books()` view chaos invariant 19 compares
+    against the kernel policy maps and the worker ledger.
+
+  * packer.py — the SharePacker admission controller: co-locates
+    complementary tenants (prefill-heavy with decode-heavy) on already-
+    shared chips first, then opens fresh chips, avoiding hosts the
+    defragmenter is about to rearrange.
+
+Enforcement lives in cgroup/ebpf.py (policy-map token buckets consulted
+in-kernel by the device program) with cgroup/policy.py as the userspace
+fallback proving identical admit/deny decisions.
+"""

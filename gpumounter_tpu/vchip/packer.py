@@ -1,0 +1,141 @@
+"""Co-location admission control: which chips should a fractional
+tenant share?
+
+The flagship scenario (FlexNPU, PAPERS.md): a prefill-heavy tenant is
+compute-bound in bursts, a decode-heavy tenant is latency-bound and
+steady — packed onto the same chips with QoS weights, the pair
+recovers utilization headroom that whole-chip granularity wastes. The
+packer encodes that preference directly:
+
+  1. already-shared chips whose resident profiles COMPLEMENT the
+     request (prefill packs with decode and vice versa) and whose
+     booked load leaves room for the new weight — tightest-packed
+     (highest load) first, so sharing concentrates instead of
+     smearing across the fleet;
+  2. then any other shared chip with headroom (same-profile
+     co-location is allowed, just not preferred);
+  3. then free chips, skipping hosts the capacity plane flags as
+     defrag-blocked (the defragmenter is about to rearrange them —
+     packing new shares there would undo its plan; the same hint the
+     allocator's placement consults, satellite 1);
+  4. refuse (PackRefused) when the fleet cannot carry the request —
+     a typed refusal the /shares route maps to 409, never a silent
+     partial placement.
+
+The packer only DECIDES and books; the caller (master route, bench,
+chaos harness) pushes the resulting policy to the enforcement layer.
+"""
+
+from __future__ import annotations
+
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.vchip.shares import Share, ShareRegistry
+
+logger = get_logger("vchip.packer")
+
+#: profiles that pack well together: bursty-compute with steady-latency
+COMPLEMENTS = {"prefill": "decode", "decode": "prefill"}
+
+
+class PackRefused(RuntimeError):
+    """The request cannot be placed: bad arguments, or no chip set
+    with enough weight headroom exists."""
+
+
+class SharePacker:
+    def __init__(self, registry: ShareRegistry, cfg=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        self.registry = registry
+
+    def admit(self, namespace: str, pod: str, profile: str, chips: int,
+              weight: int, rate_budget: int = 0,
+              inventory: dict[str, str] | None = None,
+              blocked_hosts: frozenset[str] | set[str] = frozenset(),
+              ) -> list[Share]:
+        """Book `chips` fractional shares for tenant namespace/pod.
+
+        inventory: chip uuid -> node for every chip the caller may
+        place on (free chips plus already-shared ones); the packer
+        never invents chips. blocked_hosts: hosts the defragmenter
+        needs quiet — free chips there are last-resort only.
+
+        Returns the booked shares (the caller turns each into a policy
+        map entry). All-or-nothing: a refusal books nothing.
+        """
+        capacity = int(self.cfg.vchip_weight_capacity)
+        if chips <= 0:
+            raise PackRefused(f"chips must be positive, got {chips}")
+        if not 1 <= weight <= capacity:
+            raise PackRefused(
+                f"weight {weight} outside 1..{capacity} "
+                f"(vchip_weight_capacity)")
+        if rate_budget < 0:
+            raise PackRefused(f"rate_budget must be >= 0, got {rate_budget}")
+        inventory = dict(inventory or {})
+        shared = self.registry.shared_chips()
+        held = {s.chip_uuid for s in self.registry.by_tenant(namespace, pod)}
+        want = COMPLEMENTS.get(profile)
+
+        complementary: list[tuple[int, str]] = []
+        other_shared: list[tuple[int, str]] = []
+        for uuid, holders in shared.items():
+            if uuid in held:
+                continue  # re-grants go through admit on the same chip
+            load = sum(s.weight for s in holders)
+            if load + weight > capacity:
+                continue
+            node = holders[0].node
+            if uuid not in inventory:
+                inventory[uuid] = node
+            profiles = {s.profile for s in holders}
+            # tightest-packed first: sort key is -load
+            if want is not None and want in profiles \
+                    and profile not in profiles:
+                complementary.append((-load, uuid))
+            else:
+                other_shared.append((-load, uuid))
+        taken = set(held) | set(shared)
+        free_clear = sorted(u for u, node in inventory.items()
+                            if u not in taken and node not in blocked_hosts)
+        free_blocked = sorted(u for u, node in inventory.items()
+                              if u not in taken and node in blocked_hosts)
+
+        ranked = ([u for _, u in sorted(complementary)]
+                  + [u for _, u in sorted(other_shared)]
+                  + free_clear + free_blocked)
+        if len(ranked) < chips:
+            raise PackRefused(
+                f"need {chips} chip(s) with weight headroom {weight}, "
+                f"only {len(ranked)} available "
+                f"(shared with room: {len(complementary) + len(other_shared)}, "
+                f"free: {len(free_clear) + len(free_blocked)})")
+        chosen = ranked[:chips]
+        booked: list[Share] = []
+        try:
+            for uuid in chosen:
+                booked.append(self.registry.add(Share(
+                    namespace=namespace, pod=pod, chip_uuid=uuid,
+                    node=inventory[uuid], weight=weight,
+                    rate_budget=rate_budget, profile=profile)))
+        except Exception:
+            for share in booked:  # all-or-nothing
+                self.registry.remove(share.namespace, share.pod,
+                                     share.chip_uuid)
+            raise
+        n_coloc = sum(1 for u in chosen if u in shared)
+        logger.info(
+            "admitted %d share(s) for %s/%s (profile=%s weight=%d "
+            "budget=%d): %d co-located, %d fresh%s",
+            chips, namespace, pod, profile, weight, rate_budget,
+            n_coloc, chips - n_coloc,
+            " [used defrag-blocked hosts]" if any(
+                u in free_blocked for u in chosen) else "")
+        return booked
+
+    def release(self, namespace: str, pod: str) -> list[Share]:
+        """Drop every share a tenant holds; returns what was removed
+        so the caller can clear the matching policy entries."""
+        return self.registry.remove_tenant(namespace, pod)

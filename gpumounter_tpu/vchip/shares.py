@@ -1,0 +1,234 @@
+"""Master-side share books: who holds what fraction of which chip.
+
+The registry is deliberately dumb storage with indexes — admission
+logic (who MAY take a share of which chip) lives in packer.py, and
+enforcement (what an admitted tenant may actually do) lives in the
+cgroup policy maps. What the registry guarantees:
+
+  * every share is bounded by cfg.vchip_max_shares (a runaway client
+    cannot grow the books without bound — same discipline as the
+    tenant plane's cardinality caps);
+  * per-chip load (sum of weights) is tracked so the packer's
+    "load + weight <= vchip_weight_capacity" check is O(1);
+  * `books()` exposes tenant -> {chip: (weight, rate_budget)} in the
+    SAME packed shape the kernel policy maps and the worker ledger
+    carry, so chaos invariant 19 can compare the three ledgers
+    value-for-value after every scenario.
+
+Share ids are stable, human-readable (`<namespace>/<pod>/<chip>`), and
+the natural idempotency key: re-admitting the same (tenant, chip) is a
+re-grant — the weight/budget are updated in place, mirroring the O(1)
+map_update the enforcement layer does on warm re-grants.
+
+Gauges are fleet-scalar only (no tenant/chip labels) — the per-share
+detail rides the JSON plane at GET /shares, exactly like /capacity.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("vchip.shares")
+
+SHARES_SCHEMA = "tpumounter-shares/1"
+
+SHARES_ACTIVE = REGISTRY.gauge(
+    "tpumounter_vchip_shares_active",
+    "Fractional chip shares currently on the books")
+SHARED_CHIPS = REGISTRY.gauge(
+    "tpumounter_vchip_shared_chips",
+    "Physical chips currently split across more than one tenant")
+SHARE_ADMITS = REGISTRY.counter(
+    "tpumounter_vchip_share_admits_total",
+    "Shares admitted onto the books (re-grants of an existing "
+    "(tenant, chip) share count too — they are the O(1) warm path)")
+SHARE_RELEASES = REGISTRY.counter(
+    "tpumounter_vchip_share_releases_total",
+    "Shares released from the books")
+
+
+class ShareLimitError(RuntimeError):
+    """The books are full (cfg.vchip_max_shares)."""
+
+
+@dataclass(frozen=True)
+class Share:
+    """One tenant's fraction of one chip."""
+    namespace: str
+    pod: str
+    chip_uuid: str
+    node: str
+    weight: int
+    rate_budget: int  # 0 = unmetered
+    profile: str      # "prefill" | "decode" | "balanced" | free-form
+    created_at: float = field(default_factory=time.time)
+
+    @property
+    def tenant(self) -> str:
+        return f"{self.namespace}/{self.pod}"
+
+    @property
+    def share_id(self) -> str:
+        return f"{self.namespace}/{self.pod}/{self.chip_uuid}"
+
+    def to_json(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "namespace": self.namespace,
+            "pod": self.pod,
+            "chip_uuid": self.chip_uuid,
+            "node": self.node,
+            "weight": self.weight,
+            "rate_budget": self.rate_budget,
+            "profile": self.profile,
+            "created_at": round(self.created_at, 3),
+        }
+
+
+class ShareRegistry:
+    def __init__(self, cfg=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        self._lock = OrderedLock("vchip.shares")
+        self._shares: dict[str, Share] = {}
+        self._by_chip: dict[str, set[str]] = {}
+
+    # --- mutation ---
+
+    def add(self, share: Share) -> Share:
+        """Put a share on the books. Re-adding an existing
+        (tenant, chip) replaces it in place (warm re-grant) and does
+        not consume a new books slot."""
+        with self._lock:
+            sid = share.share_id
+            if sid not in self._shares and \
+                    len(self._shares) >= int(self.cfg.vchip_max_shares):
+                raise ShareLimitError(
+                    f"share books full ({self.cfg.vchip_max_shares}); "
+                    f"refusing {sid}")
+            self._shares[sid] = share
+            self._by_chip.setdefault(share.chip_uuid, set()).add(sid)
+            self._update_gauges_locked()
+        SHARE_ADMITS.inc()
+        return share
+
+    def remove(self, namespace: str, pod: str, chip_uuid: str) -> bool:
+        with self._lock:
+            removed = self._remove_locked(
+                f"{namespace}/{pod}/{chip_uuid}")
+            self._update_gauges_locked()
+        if removed:
+            SHARE_RELEASES.inc()
+        return removed
+
+    def remove_tenant(self, namespace: str, pod: str) -> list[Share]:
+        """Drop every share a tenant holds (pod deletion, revoke-all).
+        Returns the shares removed so callers can clear the matching
+        policy entries."""
+        prefix = f"{namespace}/{pod}/"
+        with self._lock:
+            victims = [s for sid, s in self._shares.items()
+                       if sid.startswith(prefix)]
+            for share in victims:
+                self._remove_locked(share.share_id)
+            self._update_gauges_locked()
+        if victims:
+            SHARE_RELEASES.inc(float(len(victims)))
+        return victims
+
+    def _remove_locked(self, sid: str) -> bool:
+        share = self._shares.pop(sid, None)
+        if share is None:
+            return False
+        holders = self._by_chip.get(share.chip_uuid)
+        if holders is not None:
+            holders.discard(sid)
+            if not holders:
+                self._by_chip.pop(share.chip_uuid, None)
+        return True
+
+    def _update_gauges_locked(self) -> None:
+        SHARES_ACTIVE.set(float(len(self._shares)))
+        SHARED_CHIPS.set(float(sum(
+            1 for sids in self._by_chip.values() if len(sids) > 1)))
+
+    # --- queries ---
+
+    def get(self, namespace: str, pod: str,
+            chip_uuid: str) -> Share | None:
+        with self._lock:
+            return self._shares.get(f"{namespace}/{pod}/{chip_uuid}")
+
+    def by_chip(self, chip_uuid: str) -> list[Share]:
+        with self._lock:
+            return [self._shares[sid]
+                    for sid in sorted(self._by_chip.get(chip_uuid, ()))]
+
+    def by_tenant(self, namespace: str, pod: str) -> list[Share]:
+        prefix = f"{namespace}/{pod}/"
+        with self._lock:
+            return [s for sid, s in sorted(self._shares.items())
+                    if sid.startswith(prefix)]
+
+    def chip_load(self, chip_uuid: str) -> int:
+        """Sum of weights booked on a chip."""
+        with self._lock:
+            return sum(self._shares[sid].weight
+                       for sid in self._by_chip.get(chip_uuid, ()))
+
+    def shared_chips(self) -> dict[str, list[Share]]:
+        """chip uuid -> its shares, for every chip on the books."""
+        with self._lock:
+            return {uuid: [self._shares[sid] for sid in sorted(sids)]
+                    for uuid, sids in sorted(self._by_chip.items())}
+
+    def books(self) -> dict[str, dict[str, tuple[int, int]]]:
+        """tenant -> {chip uuid: (weight, rate_budget)} — the view
+        chaos invariant 19 compares against the kernel policy maps and
+        the worker ledger's share records."""
+        out: dict[str, dict[str, tuple[int, int]]] = {}
+        with self._lock:
+            for share in self._shares.values():
+                out.setdefault(share.tenant, {})[share.chip_uuid] = (
+                    share.weight, share.rate_budget)
+        return out
+
+    def payload(self) -> dict:
+        """The GET /shares response body."""
+        with self._lock:
+            shares = [self._shares[sid].to_json()
+                      for sid in sorted(self._shares)]
+            chips = {}
+            for uuid, sids in sorted(self._by_chip.items()):
+                load = sum(self._shares[sid].weight for sid in sids)
+                chips[uuid] = {
+                    "node": next(iter(
+                        self._shares[sid].node for sid in sorted(sids))),
+                    "tenants": len(sids),
+                    "load": load,
+                    "headroom": max(
+                        0, int(self.cfg.vchip_weight_capacity) - load),
+                    "profiles": sorted({self._shares[sid].profile
+                                        for sid in sids}),
+                }
+        return {
+            "schema": SHARES_SCHEMA,
+            "at": time.time(),
+            "weight_capacity": int(self.cfg.vchip_weight_capacity),
+            "max_shares": int(self.cfg.vchip_max_shares),
+            "shares": shares,
+            "chips": chips,
+            "totals": {
+                "shares": len(shares),
+                "chips": len(chips),
+                "shared_chips": sum(
+                    1 for c in chips.values() if c["tenants"] > 1),
+            },
+        }

@@ -74,10 +74,20 @@ class LedgerError(RuntimeError):
     pass
 
 
-def _chip_record(dev) -> dict:
-    return {"uuid": dev.uuid, "rel_path": dev.rel_path,
-            "major": dev.major, "minor": dev.minor,
-            "slave": dev.pod_name or ""}
+def _chip_record(dev, policy=None) -> dict:
+    record = {"uuid": dev.uuid, "rel_path": dev.rel_path,
+              "major": dev.major, "minor": dev.minor,
+              "slave": dev.pod_name or ""}
+    # Fractional grants journal their QoS policy next to the chip: a
+    # restarted worker replays not just WHICH chips a tenant holds but
+    # the weight/budget they hold them at (worker/resync.py re-arms the
+    # policy engine; the kernel maps survive on their own via bpffs
+    # pins). Whole-chip grants stay record-compatible: no share key.
+    if policy and dev.uuid in policy:
+        weight, rate_budget = policy[dev.uuid]
+        record["share"] = {"weight": int(weight),
+                           "rate_budget": int(rate_budget)}
+    return record
 
 
 class MountLedger:
@@ -189,9 +199,12 @@ class MountLedger:
             self._clean_shutdown = False
         LEDGER_APPENDS.inc(kind=record.get("kind", "?"))
 
-    def begin(self, op: str, *, target, devices, pod=None) -> str:
+    def begin(self, op: str, *, target, devices, pod=None,
+              policy=None) -> str:
         """Intent-log one mutating batch BEFORE its first side effect.
-        Returns the txn id the caller closes with commit()."""
+        Returns the txn id the caller closes with commit(). policy:
+        optional chip uuid -> (weight, rate_budget) for fractional
+        grants — journaled per chip so replay restores QoS state."""
         txn_id = f"{op[0]}-{secrets.token_hex(5)}"
         pod_obj = pod or getattr(target, "pod", None)
         record = {
@@ -204,7 +217,7 @@ class MountLedger:
             "dev_dir": getattr(target, "dev_dir", ""),
             "ns_pid": getattr(target, "ns_pid", None),
             "cgroup_dirs": list(getattr(target, "cgroup_dirs", []) or []),
-            "chips": [_chip_record(d) for d in devices],
+            "chips": [_chip_record(d, policy) for d in devices],
             "at": time.time(),
         }
         with self._lock:
@@ -283,6 +296,24 @@ class MountLedger:
         with self._lock:
             return {key: set(chips)
                     for key, chips in self._holdings.items() if chips}
+
+    def share_holdings(self) -> dict[tuple[str, str],
+                                     dict[str, tuple[int, int]]]:
+        """(namespace, pod) -> {chip uuid: (weight, rate_budget)} for
+        every held chip journaled WITH a fractional policy — the
+        ledger's leg of chaos invariant 19 (share books == kernel map
+        entries == worker ledger), and what resync replays into the
+        policy engine after a crash."""
+        out: dict[tuple[str, str], dict[str, tuple[int, int]]] = {}
+        with self._lock:
+            for key, chips in self._holdings.items():
+                shares = {uuid: (int(c["share"]["weight"]),
+                                 int(c["share"]["rate_budget"]))
+                          for uuid, c in chips.items()
+                          if isinstance(c.get("share"), dict)}
+                if shares:
+                    out[key] = shares
+        return out
 
     def forget_holding(self, namespace: str, pod: str,
                        uuids=None) -> None:

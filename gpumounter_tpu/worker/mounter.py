@@ -35,7 +35,11 @@ from gpumounter_tpu.cgroup import (
     device_controller,
     get_cgroup_pids,
 )
-from gpumounter_tpu.cgroup.ebpf import DEFAULT_CONTAINER_RULES, DeviceRule
+from gpumounter_tpu.cgroup.ebpf import (
+    DEFAULT_CONTAINER_RULES,
+    DeviceRule,
+    telemetry_key,
+)
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.device.backend import DeviceBackend, scan_proc_for_device
 from gpumounter_tpu.device.tpu import TpuDevice
@@ -230,12 +234,16 @@ class TpuMounter:
         return rules
 
     def mount(self, target: MountTarget, dev: TpuDevice,
-              base_rules: list[DeviceRule] | None = None) -> dict:
+              base_rules: list[DeviceRule] | None = None,
+              policy: dict[str, tuple[int, int]] | None = None) -> dict:
         """Grant + inject one chip. Returns phase timings (ms)."""
-        return self.mount_many(target, [dev], base_rules=base_rules)
+        return self.mount_many(target, [dev], base_rules=base_rules,
+                               policy=policy)
 
     def mount_many(self, target: MountTarget, devices: list[TpuDevice],
-                   base_rules: list[DeviceRule] | None = None) -> dict:
+                   base_rules: list[DeviceRule] | None = None,
+                   policy: dict[str, tuple[int, int]] | None = None,
+                   ) -> dict:
         """Grant + inject a batch of chips, all-or-nothing.
 
         The reference mounts serially, one full grant+mknod round trip
@@ -250,6 +258,11 @@ class TpuMounter:
         Returns phase timings (ms). Phase/span names match the serial
         path (mount.cgroup_grant, mount.mknod per chip, mount.rollback)
         so `tpumounter trace` shows the same story, just wider.
+
+        policy: optional chip uuid -> (weight, rate_budget) for
+        fractional (vchip) grants — the grant becomes a policy-map
+        entry carrying the QoS weight and token budget instead of a
+        binary allow, journaled per chip so crash replay restores it.
         """
         if not devices:
             return {}
@@ -261,7 +274,8 @@ class TpuMounter:
         # the batch leaves an open ledger txn naming exactly these chips,
         # paths and cgroups — what the restart replay converges. A real
         # crash (CrashError, or the process dying) never closes it.
-        txn = (self.ledger.begin("mount", target=target, devices=devices)
+        txn = (self.ledger.begin("mount", target=target, devices=devices,
+                                 policy=policy)
                if self.ledger is not None else None)
         try:
             # Crash sites bracketing the grant: a worker dying here leaves
@@ -275,7 +289,8 @@ class TpuMounter:
                     trace.span("mount.cgroup_grant", device=uuids,
                                chips=len(devices),
                                target=target.description):
-                self._grant_batch(target, devices, base_rules, granted)
+                self._grant_batch(target, devices, base_rules, granted,
+                                  policy=policy)
             failpoints.fire("worker.mount.after_grant", device=uuids,
                             target=target.description)
             with timer.phase("device_inject"):
@@ -329,11 +344,16 @@ class TpuMounter:
 
     def _grant_batch(self, target: MountTarget, devices: list[TpuDevice],
                      base_rules: list[DeviceRule] | None,
-                     granted: list[tuple[str, TpuDevice]]) -> None:
+                     granted: list[tuple[str, TpuDevice]],
+                     policy: dict[str, tuple[int, int]] | None = None,
+                     ) -> None:
         """Grant every chip on every target cgroup, appending to
         `granted` as rules land so the caller can roll back exactly what
-        took effect."""
+        took effect. On environments without a kernel policy map
+        (cgroup v1, bare-dir targets) a fractional policy lands in the
+        userspace engine instead — coarser enforcement, same books."""
         if not target.cgroup_dirs:
+            self._engine_policies(target, devices, policy)
             return
         if self.cgroup_version == 2:
             # The controller captures base rules only at FIRST grant per
@@ -346,23 +366,54 @@ class TpuMounter:
             grant_many = getattr(self.controller, "grant_many", None)
             for cg in target.cgroup_dirs:
                 if grant_many is not None:
-                    # One program swap for the whole batch. The tenant
-                    # tag attributes the cgroup's in-kernel access
-                    # telemetry (ebpf.DEVICE_TELEMETRY) to this pod.
+                    # First grant per cgroup loads one program; every
+                    # later (re-)grant is a map_update — the O(1) warm
+                    # path. The tenant tag attributes the cgroup's
+                    # in-kernel access telemetry to this pod.
                     grant_many(cg, devices, base_rules=base_rules,
-                               tenant=target.description)
+                               tenant=target.description, policy=policy)
                     granted.extend((cg, d) for d in devices)
                 else:
                     for dev in devices:
                         self.controller.grant(cg, dev,
                                               base_rules=base_rules,
-                                              tenant=target.description)
+                                              tenant=target.description,
+                                              policy=policy)
                         granted.append((cg, dev))
         else:
+            self._engine_policies(target, devices, policy)
             for cg in target.cgroup_dirs:
                 for dev in devices:
                     self.controller.grant(cg, dev)
                     granted.append((cg, dev))
+
+    @staticmethod
+    def _engine_policies(target: MountTarget, devices: list[TpuDevice],
+                         policy: dict[str, tuple[int, int]] | None,
+                         ) -> None:
+        """Register fractional policies with the userspace engine, the
+        enforcement fallback where no kernel policy map exists. Scope is
+        the target description ("ns/pod") — the same identity the share
+        books and the ledger use."""
+        if not policy:
+            return
+        from gpumounter_tpu.cgroup.ebpf import POLICY_UNMETERED
+        from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+        for dev in devices:
+            if dev.uuid not in policy:
+                continue
+            weight, rate_budget = policy[dev.uuid]
+            tokens = (POLICY_UNMETERED if int(rate_budget) <= 0
+                      else int(rate_budget))
+            POLICY_ENGINE.set_policy(target.description, dev.major,
+                                     dev.minor, int(weight), tokens)
+
+    def _dev_numbers(self, uuid: str) -> tuple[int, int] | None:
+        """(major, minor) for a chip uuid this node owns, or None."""
+        for dev in self.backend.list_devices():
+            if dev.uuid == uuid:
+                return dev.major, dev.minor
+        return None
 
     def _inject_batch(self, target: MountTarget, devices: list[TpuDevice],
                       injected: list[TpuDevice]) -> None:
@@ -549,6 +600,31 @@ class TpuMounter:
         UNMOUNT_TOTAL.inc(result="success")
         if txn is not None:
             self.ledger.commit(txn, "success")
+        # Fractional bookkeeping: a revoked chip's userspace policy
+        # entry must not outlive the grant (the kernel-map entry is
+        # deleted by the controller's revoke; this is the fallback
+        # engine's half of the same hygiene — orphan entries are what
+        # invariant 19 hunts). Policy entries are keyed by
+        # (major, minor), so the entry stays while ANOTHER still-held
+        # share of this tenant projects onto the same key (the fake
+        # backend mknods every chip from one device node; real chips
+        # have unique numbers and always clear here). The commit above
+        # runs first so the ledger read sees post-unmount holdings.
+        from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+        key = telemetry_key(dev.major, dev.minor)
+        still_keyed = False
+        if self.ledger is not None:
+            ns_pod = tuple(target.description.split("/", 1))
+            remaining = self.ledger.share_holdings().get(ns_pod, {})
+            for uuid in remaining:
+                other = self._dev_numbers(uuid)
+                if other is not None and \
+                        telemetry_key(*other) == key:
+                    still_keyed = True
+                    break
+        if not still_keyed:
+            POLICY_ENGINE.clear_policy(target.description, dev.major,
+                                       dev.minor)
         for phase, seconds in timer.phases.items():
             PHASE_LATENCY.observe(seconds, phase=phase)
         summary = timer.summary_ms()

@@ -120,6 +120,7 @@ class LedgerResync:
                    "replayed-unmounted": "unmounted"}[outcome]
             summary[key].append(txn.get("txn"))
         summary["holdings_corrected"] = self._reconcile_holdings()
+        summary["share_policies_replayed"] = self._replay_share_policies()
         # Deferred slave releases (API-outage booking-leak fix): the
         # previous process queued deletes the outage broke; the restart
         # is a natural retry point (the API may be back by now).
@@ -183,7 +184,15 @@ class LedgerResync:
             try:
                 pod = Pod(self.kube.get_pod(namespace, pod_name))
                 target = self.mounter.resolve_target(pod)
-                self.mounter.mount_many(target, devices)
+                # Fractional txns carry their QoS policy per chip —
+                # the forward replay re-grants at the SAME weight and
+                # budget the dead worker promised, not a whole chip.
+                policy = {c["uuid"]: (int(c["share"]["weight"]),
+                                      int(c["share"]["rate_budget"]))
+                          for c in chips
+                          if isinstance(c.get("share"), dict)}
+                self.mounter.mount_many(target, devices,
+                                        policy=policy or None)
                 self.ledger.commit(txn["txn"], "replayed-completed")
                 logger.warning(
                     "replayed mount txn %s forward: %d chip(s) onto %s "
@@ -253,6 +262,36 @@ class LedgerResync:
         except Exception as exc:  # noqa: BLE001 — reaper sweeps leftovers
             logger.error("replay slave release failed (reaper will "
                          "sweep): %s", exc)
+
+    # --- fractional-grant replay (policy engine re-arm) ---
+
+    def _replay_share_policies(self) -> int:
+        """Re-arm the userspace policy engine from the ledger's
+        journaled fractional grants. The kernel policy maps restore
+        themselves through their bpffs pins
+        (V2DeviceController._restore_all); this is the fallback
+        engine's equivalent — a crashed worker on a host without
+        kernel maps comes back enforcing the same weights and budgets
+        it promised, instead of silently un-metering every share."""
+        from gpumounter_tpu.cgroup.ebpf import POLICY_UNMETERED
+        from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+        replayed = 0
+        for (namespace, pod_name), shares in \
+                self.ledger.share_holdings().items():
+            scope = f"{namespace}/{pod_name}"
+            for uuid, (weight, rate_budget) in sorted(shares.items()):
+                dev = self.mounter.backend.device_by_uuid(uuid)
+                if dev is None:
+                    logger.warning(
+                        "share policy for %s on %s not replayed: chip "
+                        "unknown to this backend", uuid, scope)
+                    continue
+                tokens = (POLICY_UNMETERED if rate_budget <= 0
+                          else rate_budget)
+                POLICY_ENGINE.set_policy(scope, dev.major, dev.minor,
+                                         weight, tokens)
+                replayed += 1
+        return replayed
 
     # --- net-holdings reconciliation (ledger == books) ---
 

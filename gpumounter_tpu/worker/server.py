@@ -398,6 +398,14 @@ class TpuMountService:
         base_rules = [device_rule(d) for d in self.collector.snapshot()
                       if d.pod_name == pod.name
                       and d.namespace == pod.namespace]
+        # Fractional (vchip) grants: a share_weight on the wire turns
+        # every chip of this mount into a policy-map entry instead of a
+        # static rule — recorded in the ledger for crash replay.
+        policy = None
+        if request.share_weight > 0:
+            policy = {d.uuid: (int(request.share_weight),
+                               int(request.share_rate_budget))
+                      for d in devices}
         try:
             with timer.phase("mount"):
                 target = self.mounter.resolve_target(pod)
@@ -410,7 +418,8 @@ class TpuMountService:
                 # reference mounts serially with no undo of grants at
                 # all (server.go:74-91).
                 self.mounter.mount_many(target, devices,
-                                        base_rules=base_rules)
+                                        base_rules=base_rules,
+                                        policy=policy)
         except MountError as exc:
             # The mounter already rolled the batch back; what remains is
             # freeing the scheduler's books (reference: server.go:86-91).

@@ -67,6 +67,12 @@ class FakeKernel:
 
         monkeypatch.setattr(ebpf, "obj_pin", obj_pin)
         monkeypatch.setattr(ebpf, "obj_get", obj_get)
+        # This suite pins the LEGACY swap-per-grant state machine; left
+        # unstubbed, the probe would ask the host kernel and flip the
+        # controller onto the policy-map path on map-capable machines
+        # (different pin set: + {key}-pmap). The map path has its own
+        # suite in test_vchip.py.
+        monkeypatch.setattr(ebpf, "probe_map_support", lambda: False)
 
     def preattach(self, cgroup_dir: str, prog_id: int) -> None:
         self.attached.setdefault(cgroup_dir, []).append(prog_id)

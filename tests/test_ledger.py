@@ -318,3 +318,106 @@ def test_sigterm_drain_closes_clean(cluster, tmp_path):
     with pytest.raises(RuntimeError, match="draining"):
         service.add_tpu(api.AddTPURequest(
             pod_name="trainer", namespace="default", tpu_num=1), _Ctx())
+
+
+# --- fractional (vchip) share records: journal + replay (ISSUE 17) ---
+
+
+@pytest.fixture()
+def _clean_policy_engine():
+    from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+    POLICY_ENGINE.reset()
+    yield
+    POLICY_ENGINE.reset()
+
+
+def _grpc_share_mount(service, n=2, weight=60, budget=8):
+    from gpumounter_tpu.rpc import api
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise RuntimeError(f"abort {code}: {details}")
+
+    return service.add_tpu(
+        api.AddTPURequest(pod_name="trainer", namespace="default",
+                          tpu_num=n, share_weight=weight,
+                          share_rate_budget=budget), _Ctx())
+
+
+def test_fractional_grant_journals_share_records(
+        cluster, tmp_path, _clean_policy_engine):
+    """A share_weight-carrying mount journals (weight, rate_budget)
+    per chip; a legacy whole-chip mount journals none."""
+    from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+
+    service, _ = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    _grpc_share_mount(service, n=2, weight=60, budget=8)
+
+    shares = service.ledger.share_holdings()
+    assert set(shares) == {("default", "trainer")}
+    assert len(shares[("default", "trainer")]) == 2
+    assert set(shares[("default", "trainer")].values()) == {(60, 8)}
+    # the enforcement fallback was armed at grant time
+    assert POLICY_ENGINE.entries("default/trainer")
+
+    # a second, whole-chip tenant stays out of the share records
+    cluster.add_target_pod("legacy")
+    from gpumounter_tpu.rpc import api
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise RuntimeError(f"abort {code}: {details}")
+
+    service.add_tpu(api.AddTPURequest(
+        pod_name="legacy", namespace="default", tpu_num=1), _Ctx())
+    assert set(service.ledger.share_holdings()) == \
+        {("default", "trainer")}
+    assert POLICY_ENGINE.entries("default/legacy") == {}
+
+
+def test_fractional_replay_rearms_policy_engine(
+        cluster, tmp_path, _clean_policy_engine):
+    """Worker restart on a host without kernel maps: the fresh process
+    has an EMPTY userspace policy table — replay must re-arm it from
+    the ledger's share records, weights and budgets intact."""
+    from gpumounter_tpu.cgroup.ebpf import policy_tokens, policy_weight
+    from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+
+    service, _ = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    _grpc_share_mount(service, n=2, weight=60, budget=8)
+    service.ledger.close()
+    POLICY_ENGINE.reset()  # the table died with the old process
+
+    restarted, _ = _build_service(cluster, tmp_path)
+    summary = LedgerResync(restarted).replay_once()
+    assert summary["share_policies_replayed"] == 2
+    entries = POLICY_ENGINE.entries("default/trainer")
+    assert entries  # fake chips share device numbers -> >= 1 key
+    for value in entries.values():
+        assert policy_weight(value) == 60
+        assert policy_tokens(value) == 8
+
+
+def test_fractional_crash_replay_keeps_share_records(
+        cluster, tmp_path, _clean_policy_engine):
+    """after_grant crash on a fractional mount: replay completes the
+    mount forward AND the rolled-forward holdings keep their share
+    policy — a crash must not silently un-meter a tenant."""
+    from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
+
+    service, _ = _build_service(cluster, tmp_path)
+    cluster.add_target_pod("trainer")
+    failpoints.arm("worker.mount.after_grant", "1*crash(ledger-test)")
+    with pytest.raises(CrashError):
+        _grpc_share_mount(service, n=2, weight=40, budget=16)
+    service.ledger.close()
+    POLICY_ENGINE.reset()
+
+    restarted, _ = _build_service(cluster, tmp_path)
+    summary = LedgerResync(restarted).replay_once()
+    assert summary["completed"], summary
+    shares = restarted.ledger.share_holdings()
+    assert set(shares[("default", "trainer")].values()) == {(40, 16)}
+    assert POLICY_ENGINE.entries("default/trainer")

@@ -120,6 +120,19 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         # ISSUE 13 trace-plane surfaces: the budgeted run includes the
         # assembled /trace read and the flight recorder's /timeline.
         assert http("GET", "/timeline")[0] == 200
+        # ISSUE 17 fractional shares: the budgeted run includes the
+        # share books pane — tenants and chip uuids stay in the JSON
+        # payload, and the vchip gauges/counters are fleet-scalar.
+        from gpumounter_tpu.vchip.shares import Share
+        app.shares.add(Share(
+            namespace="default", pod="card-pod", chip_uuid="card-chip",
+            node=cluster.node_name, weight=60, rate_budget=8,
+            profile="prefill"))
+        app.shares.add(Share(
+            namespace="default", pod="card-peer", chip_uuid="card-chip",
+            node=cluster.node_name, weight=40, rate_budget=0,
+            profile="decode"))
+        assert http("GET", "/shares")[0] == 200
         from gpumounter_tpu.k8s.types import Pod
         pod = Pod(cluster.kube.get_pod("default", "card-pod"))
         slaves = {p.name for p in service.allocator.slave_pods_for(pod)}
