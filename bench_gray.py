@@ -1,0 +1,398 @@
+"""Gray-failure detection bench: the limping node, caught and fenced.
+
+Four measurements, all over the REAL HealthPlane (the production
+scorer + quarantine state machine — no reimplementation):
+
+  detection     a deterministic synthetic fleet (TPM_GRAY_NODES nodes,
+                ~5% limping: mount p95 inflated ~40x and an elevated
+                error ratio) is driven through HealthPlane.observe one
+                fleet-collect pass at a time. The headline is detection
+                latency: how many passes until every limper lands in
+                excluded_hosts(). The gate is total — a single limper
+                that escapes quarantine fails the bench.
+
+  control       the same fleet with every node healthy (jittered but
+                in-family p95s). Zero tolerance: one false-positive
+                quarantine fails the bench. This is the guard against
+                an over-eager scorer retune.
+
+  softness      quarantine must stay reversible and must never leak
+                into the destructive plane. A spy recovery object
+                records every attribute the plane touches; any
+                evacuation-like call fails the bench, as does a node
+                vanishing from the payload. Then the limpers are
+                healed (p95 back in-family) and driven through rehab:
+                canary passes -> rehabilitating -> probation ->
+                healthy. A healed node still quarantined at the end
+                fails the bench.
+
+  placement A/B the capacity argument for quarantine: route synthetic
+                mount placements across the fleet with and without the
+                excluded set. Without quarantine, ~5% of placements
+                land on a limper and the fleet mount p99 IS the limper
+                latency; with quarantine on, p99 collapses back to the
+                healthy family. The gate is the A/B itself — the
+                quarantine-on p99 must beat the no-quarantine p99 by
+                P99_RECOVERY_FLOOR.
+
+The fleet model is seeded and wall-clock-free: identical inputs give
+identical artifacts. No kube, no threads — observe() is called
+directly, the same entry shape FleetCollector hands it in production.
+
+Usage:
+  python bench_gray.py                 -> writes BENCH_gray_r01.json
+  python bench_gray.py --check FILE    -> CI smoke: re-runs and gates
+      full limper capture, zero false positives, zero evacuations,
+      detection latency vs the committed artifact, rehab release of
+      healed nodes, and the placement-p99 A/B; never overwrites the
+      committed artifact (set TPM_GRAY_ARTIFACT to redirect the fresh
+      copy).
+
+Shrink knobs (CI uses both): TPM_GRAY_NODES (default 256),
+TPM_GRAY_ROUNDS (default 20; passes per phase).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+ARTIFACT = "BENCH_gray_r01.json"
+
+# The control plane is fail-closed (TPUMOUNTER_AUTH=token): give the
+# in-process stack one shared secret BEFORE any Config() exists.
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-gray-secret")
+os.environ.setdefault("TPUMOUNTER_AUTH", "token")
+
+#: fleet size (CI shrinks to 64)
+NODES = int(os.environ.get("TPM_GRAY_NODES", "256"))
+#: observe passes per phase (CI shrinks to 12)
+ROUNDS = int(os.environ.get("TPM_GRAY_ROUNDS", "20"))
+#: fraction of the fleet that limps in the detection phase
+LIMP_FRACTION = 0.05
+#: healthy mount p95 family: ~N(MU, SIGMA) ms, clipped positive
+HEALTHY_MU_MS = 10.0
+HEALTHY_SIGMA_MS = 2.5
+#: the limper's mount p95 family (gray: slow, not dead)
+LIMP_MU_MS = 420.0
+LIMP_SIGMA_MS = 60.0
+#: limper error ratio (errors / (errors + successes)) per pass
+LIMP_ERROR_RATIO = 0.30
+#: mount samples every node reports per pass
+SAMPLES_PER_PASS = 40
+#: synthetic placements per arm of the A/B
+PLACEMENTS = 4000
+#: quarantine-on placement p99 must beat no-quarantine by this factor
+P99_RECOVERY_FLOOR = 4.0
+#: everything is seeded off this (vary via env only for exploration)
+SEED = int(os.environ.get("TPM_GRAY_SEED", "20260807"))
+
+
+class _SpyRecovery:
+    """Stands in for the RecoveryController. The health plane may ask
+    whether recovery evacuated a node (release's cross-plane check);
+    anything that smells like the plane *driving* an evacuation is
+    recorded and fails the softness gate."""
+
+    def __init__(self):
+        self.destructive_calls: list[str] = []
+
+    def is_evacuated(self, node: str) -> bool:  # noqa: ARG002
+        return False
+
+    def __getattr__(self, name: str):
+        # Any other method the plane reaches for gets recorded; the
+        # call itself is a harmless no-op so the bench keeps running
+        # and reports the violation through the gate instead of dying.
+        def _recorded(*args, **kwargs):  # noqa: ARG001
+            self.destructive_calls.append(name)
+
+        self.destructive_calls.append(f"getattr:{name}")
+        return _recorded
+
+
+def _percentile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return float(ordered[idx])
+
+
+def _p95_sample(rng: random.Random, limping: bool) -> float:
+    if limping:
+        return max(50.0, rng.gauss(LIMP_MU_MS, LIMP_SIGMA_MS))
+    return max(1.0, rng.gauss(HEALTHY_MU_MS, HEALTHY_SIGMA_MS))
+
+
+def _entry(rng: random.Random, limping: bool) -> dict:
+    """One node's fleet-collect entry, the shape FleetCollector hands
+    HealthPlane.observe."""
+    errors = 0
+    if limping:
+        errors = sum(1 for _ in range(SAMPLES_PER_PASS)
+                     if rng.random() < LIMP_ERROR_RATIO)
+    elif rng.random() < 0.02:
+        errors = 1  # healthy nodes hiccup occasionally; far under the bar
+    return {
+        "mount": {
+            "count": SAMPLES_PER_PASS,
+            "p95_ms": round(_p95_sample(rng, limping), 3),
+            "success": SAMPLES_PER_PASS - errors,
+            "error": errors,
+        },
+        "breaker": "closed",
+    }
+
+
+def _fleet_names(n: int) -> list[str]:
+    return [f"node-{i:04d}" for i in range(n)]
+
+
+def _build_plane(recovery):
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.health.plane import HealthPlane
+
+    cfg = Config().replace(health_enabled=True)
+    return HealthPlane(cfg, recovery=recovery), cfg
+
+
+def _drive(plane, rng: random.Random, names: list[str],
+           limpers: set[str], rounds: int) -> dict[str, int]:
+    """Run `rounds` observe passes; returns, per limper, the 1-based
+    pass at which it first appeared in excluded_hosts (0 = never)."""
+    caught: dict[str, int] = {n: 0 for n in limpers}
+    for rnd in range(1, rounds + 1):
+        plane.observe({n: _entry(rng, n in limpers) for n in names})
+        fenced = plane.excluded_hosts()
+        for n in limpers:
+            if not caught[n] and n in fenced:
+                caught[n] = rnd
+    return caught
+
+
+def _bench_detection(rng: random.Random) -> tuple[dict, object, set]:
+    spy = _SpyRecovery()
+    plane, _cfg = _build_plane(spy)
+    names = _fleet_names(NODES)
+    n_limp = max(1, int(NODES * LIMP_FRACTION))
+    limpers = set(rng.sample(names, n_limp))
+
+    caught = _drive(plane, rng, names, limpers, ROUNDS)
+    fenced = plane.excluded_hosts()
+    false_pos = sorted(fenced - limpers)
+    rounds_caught = [r for r in caught.values() if r]
+    payload = plane.payload()
+    missing = sorted(n for n in limpers if n not in payload["nodes"])
+
+    return ({
+        "nodes": NODES,
+        "rounds": ROUNDS,
+        "limpers": n_limp,
+        "quarantined": len(fenced & limpers),
+        "escaped": sorted(n for n, r in caught.items() if not r),
+        "false_positives": false_pos,
+        "rounds_to_quarantine": {
+            "p50": _percentile([float(r) for r in rounds_caught], 0.50),
+            "p95": _percentile([float(r) for r in rounds_caught], 0.95),
+            "max": max(rounds_caught) if rounds_caught else 0,
+        },
+        "budget": payload["quarantine_budget"],
+        "spy_recovery_calls": sorted(set(spy.destructive_calls)),
+        "nodes_missing_from_payload": missing,
+    }, plane, limpers)
+
+
+def _bench_control(rng: random.Random) -> dict:
+    plane, _cfg = _build_plane(_SpyRecovery())
+    names = _fleet_names(NODES)
+    for _ in range(ROUNDS):
+        plane.observe({n: _entry(rng, False) for n in names})
+    payload = plane.payload()
+    return {
+        "nodes": NODES,
+        "rounds": ROUNDS,
+        "quarantined": sorted(plane.excluded_hosts()),
+        "states": payload["states"],
+    }
+
+
+def _bench_rehab(plane, rng: random.Random, names: list[str],
+                 limpers: set[str]) -> dict:
+    """Heal the limpers, feed canary passes, and drive the release
+    path: quarantined -> rehabilitating -> probation -> healthy."""
+    for rnd in range(1, ROUNDS + 1):
+        for n in sorted(plane.excluded_hosts() | plane.probation_hosts()):
+            plane.record_canary(n, ok=True, detail="bench-canary")
+        plane.observe({n: _entry(rng, False) for n in names})
+    payload = plane.payload()
+    still_fenced = sorted(plane.excluded_hosts() & limpers)
+    states = {n: payload["nodes"][n]["state"]
+              for n in sorted(limpers) if n in payload["nodes"]}
+    return {
+        "rounds": ROUNDS,
+        "still_quarantined": still_fenced,
+        "probation": sorted(plane.probation_hosts() & limpers),
+        "limper_states": states,
+    }
+
+
+def _bench_placement(rng: random.Random, limpers: set[str],
+                     excluded: frozenset) -> dict:
+    """A/B the fleet mount p99 with and without routing around the
+    excluded set. Same seed, same arrival order in both arms."""
+    names = _fleet_names(NODES)
+
+    def run_arm(fenced: frozenset) -> list[float]:
+        arm = random.Random(rng.randrange(2**31))
+        eligible = [n for n in names if n not in fenced]
+        lats = []
+        for _ in range(PLACEMENTS):
+            node = eligible[arm.randrange(len(eligible))]
+            lats.append(_p95_sample(arm, node in limpers))
+        return lats
+
+    base = run_arm(frozenset())
+    fenced = run_arm(excluded)
+    base_p99 = _percentile(base, 0.99)
+    fenced_p99 = _percentile(fenced, 0.99)
+    return {
+        "placements": PLACEMENTS,
+        "no_quarantine": {
+            "p50_ms": round(_percentile(base, 0.50), 2),
+            "p99_ms": round(base_p99, 2),
+        },
+        "quarantine_on": {
+            "p50_ms": round(_percentile(fenced, 0.50), 2),
+            "p99_ms": round(fenced_p99, 2),
+        },
+        "p99_recovery_factor": round(
+            base_p99 / fenced_p99, 2) if fenced_p99 else 0.0,
+    }
+
+
+def run_bench() -> dict:
+    t_start = time.time()
+    rng = random.Random(SEED)
+    detection, plane, limpers = _bench_detection(rng)
+    excluded = plane.excluded_hosts()
+    placement = _bench_placement(rng, limpers, excluded)
+    rehab = _bench_rehab(plane, rng, _fleet_names(NODES), limpers)
+    control = _bench_control(rng)
+    return {
+        "bench": "gray-failure-quarantine",
+        "at": round(t_start, 3),
+        "duration_s": round(time.time() - t_start, 3),
+        "config": {
+            "nodes": NODES,
+            "rounds": ROUNDS,
+            "seed": SEED,
+            "limp_fraction": LIMP_FRACTION,
+            "healthy_p95_ms": [HEALTHY_MU_MS, HEALTHY_SIGMA_MS],
+            "limp_p95_ms": [LIMP_MU_MS, LIMP_SIGMA_MS],
+            "limp_error_ratio": LIMP_ERROR_RATIO,
+            "placements": PLACEMENTS,
+            "p99_recovery_floor": P99_RECOVERY_FLOOR,
+        },
+        "detection": detection,
+        "control": control,
+        "rehab": rehab,
+        "placement": placement,
+    }
+
+
+def check(committed_path: str, fresh: dict) -> int:
+    with open(committed_path) as fh:
+        committed = json.load(fh)
+    failures = []
+
+    det = fresh["detection"]
+    if det["escaped"]:
+        failures.append(
+            f"{len(det['escaped'])} limping node(s) escaped quarantine "
+            f"after {det['rounds']} passes: {det['escaped'][:5]}")
+    if det["false_positives"]:
+        failures.append(
+            f"{len(det['false_positives'])} healthy node(s) falsely "
+            f"quarantined in the limping fleet: "
+            f"{det['false_positives'][:5]}")
+    if det["spy_recovery_calls"]:
+        failures.append(
+            f"the health plane reached into the recovery plane: "
+            f"{det['spy_recovery_calls']} — quarantine must stay soft")
+    if det["nodes_missing_from_payload"]:
+        failures.append(
+            f"quarantined node(s) vanished from the health payload: "
+            f"{det['nodes_missing_from_payload'][:5]}")
+    committed_p95 = (committed.get("detection", {})
+                     .get("rounds_to_quarantine", {}).get("p95", 0.0))
+    latency_budget = max(committed_p95 + 2.0, 6.0)
+    if det["rounds_to_quarantine"]["p95"] > latency_budget:
+        failures.append(
+            f"detection latency p95 {det['rounds_to_quarantine']['p95']}"
+            f" passes > budget {latency_budget:.0f} (committed "
+            f"{committed_p95})")
+
+    ctl = fresh["control"]
+    if ctl["quarantined"]:
+        failures.append(
+            f"healthy-control run quarantined {len(ctl['quarantined'])} "
+            f"node(s): {ctl['quarantined'][:5]} — zero tolerance")
+
+    rehab = fresh["rehab"]
+    if rehab["still_quarantined"]:
+        failures.append(
+            f"{len(rehab['still_quarantined'])} healed node(s) still "
+            f"quarantined after {rehab['rounds']} clean passes with "
+            f"canary green: {rehab['still_quarantined'][:5]} — "
+            f"quarantine stopped being reversible")
+
+    ab = fresh["placement"]
+    if ab["p99_recovery_factor"] < P99_RECOVERY_FLOOR:
+        failures.append(
+            f"quarantine-on placement p99 recovered only "
+            f"{ab['p99_recovery_factor']}x over the no-quarantine arm "
+            f"(floor {P99_RECOVERY_FLOOR}x) — fencing stopped paying "
+            f"for itself")
+
+    if failures:
+        print("GRAY BENCH CHECK FAILED:", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print(f"gray bench check ok: {det['quarantined']}/{det['limpers']} "
+          f"limpers quarantined (p95 {det['rounds_to_quarantine']['p95']:.0f}"
+          f" passes), 0 false positives, 0 evacuations, healed nodes "
+          f"released, placement p99 "
+          f"{ab['no_quarantine']['p99_ms']}ms -> "
+          f"{ab['quarantine_on']['p99_ms']}ms "
+          f"({ab['p99_recovery_factor']}x)")
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", metavar="ARTIFACT", default=None,
+                        help="CI smoke: re-run and gate against the "
+                             "committed artifact (never overwrites it)")
+    args = parser.parse_args()
+    fresh = run_bench()
+    if args.check:
+        out = os.environ.get("TPM_GRAY_ARTIFACT")
+        if out:
+            with open(out, "w") as fh:
+                json.dump(fresh, fh, indent=1)
+        raise SystemExit(check(args.check, fresh))
+    artifact = os.environ.get("TPM_GRAY_ARTIFACT", ARTIFACT)
+    with open(artifact, "w") as fh:
+        json.dump(fresh, fh, indent=1)
+    print(json.dumps(fresh, indent=1))
+    print(f"\nwrote {artifact}")
+
+
+if __name__ == "__main__":
+    main()

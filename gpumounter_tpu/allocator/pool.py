@@ -45,6 +45,7 @@ import time
 from gpumounter_tpu.config import get_config
 from gpumounter_tpu.faults import failpoints
 from gpumounter_tpu.k8s.client import KubeClient, NotFoundError
+from gpumounter_tpu.k8s.errors import classify_exception
 from gpumounter_tpu.k8s.types import Pod
 from gpumounter_tpu.utils.locks import OrderedLock
 from gpumounter_tpu.utils.log import get_logger
@@ -70,6 +71,10 @@ WARM_POOL_REFILLS = REGISTRY.counter(
 WARM_POOL_REFILL_FAILURES = REGISTRY.counter(
     "tpumounter_warm_pool_refill_failures_total",
     "Refill attempts that failed (pod deleted, node backed off)")
+WARM_POOL_DRAINED = REGISTRY.counter(
+    "tpumounter_warm_pool_drained_total",
+    "Warm holder pods released because the master's health plane "
+    "quarantined the node (CollectTelemetry carries the verdict)")
 
 
 class WarmPodPool:
@@ -99,6 +104,7 @@ class WarmPodPool:
         self._ready: dict[str, list[str]] = {}     # node -> holder names
         self._pending: dict[str, int] = {}         # node -> creates in flight
         self._backoff_until: dict[str, float] = {}  # node -> monotonic stamp
+        self._drained: set[str] = set()            # health-plane quarantine
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -198,6 +204,55 @@ class WarmPodPool:
                         len(readopted), node_name)
 
     # --- adoption (the mount critical path) ---
+
+    def set_drained(self, node_name: str, flag: bool) -> int:
+        """Health-plane quarantine drain (the verdict rides the
+        master's CollectTelemetry pull — worker/server.py). While
+        drained a node's refill is paused and its Running holders are
+        deleted: a quarantined node must not bank standby capacity, and
+        pre-scheduled holders there would defeat the whole point of the
+        placement exclusion. Reversible — un-draining just lets the
+        next refill pass restock. Returns holders released this call."""
+        if not self.enabled or not node_name:
+            return 0
+        with self._lock:
+            if flag:
+                self._drained.add(node_name)
+                names = list(self._ready.get(node_name, []))
+            else:
+                self._drained.discard(node_name)
+                names = []
+        gone: list[str] = []
+        for name in names:
+            try:
+                self.kube.delete_pod(self.cfg.pool_namespace, name,
+                                     grace_period_seconds=0)
+                gone.append(name)
+            except NotFoundError:
+                gone.append(name)  # already gone: drained is drained
+            except Exception as exc:  # noqa: BLE001 — retried next pull
+                logger.warning("warm-pool drain delete %s failed: %s",
+                               name, classify_exception(exc))
+        released = len(gone)
+        if names:
+            with self._lock:
+                bucket = self._ready.get(node_name, [])
+                self._ready[node_name] = [n for n in bucket
+                                          if n not in gone]
+                WARM_POOL_READY.set(
+                    float(len(self._ready[node_name])), node=node_name)
+            if released:
+                WARM_POOL_DRAINED.inc(released)
+                logger.warning(
+                    "warm-pool: drained %d holder(s) on quarantined "
+                    "node %s", released, node_name)
+        if not flag:
+            self._kick()  # restock promptly after release
+        return released
+
+    def drained(self, node_name: str) -> bool:
+        with self._lock:
+            return node_name in self._drained
 
     def ready_count(self, node_name: str) -> int:
         with self._lock:
@@ -341,6 +396,8 @@ class WarmPodPool:
             nodes = list(self._ready)
         for node in nodes:
             with self._lock:
+                if node in self._drained:
+                    continue  # quarantined: no standby capacity here
                 if time.monotonic() < self._backoff_until.get(node, 0.0):
                     continue
                 gap = (self.size - len(self._ready.get(node, []))

@@ -329,6 +329,28 @@ def cmd_why(args) -> int:
     if dominant:
         print(f"dominant phase: {dominant.get('phase')} "
               f"({dominant.get('share', 0.0):.0%} of wall time)")
+    if dominant and nodes:
+        # Gray-failure verdict: if a node this trace touched is
+        # quarantined, the slow phase is the limping node, not the
+        # control plane — name it before the operator starts digging
+        # through warm-pool stats. Best-effort: a master without the
+        # health plane (or an auth scope without it) just skips this.
+        try:
+            h_status, h_body = _http(args, "GET", "/health/nodes",
+                                     token=_obs_token(args))
+            health_nodes = (json.loads(h_body).get("nodes") or {}
+                            if h_status == 200 else {})
+        except (SystemExit, ValueError):
+            health_nodes = {}
+        if not isinstance(health_nodes, dict):
+            health_nodes = {}  # not a health payload: skip the verdict
+        for node in nodes:
+            pane = health_nodes.get(node) or {}
+            if pane.get("state") == "quarantined":
+                print(f"verdict: quarantine — node {node} is quarantined "
+                      f"({pane.get('reason') or 'no reason recorded'}); "
+                      f"this operation ran through a limping node")
+                break
     if dominant.get("phase") == "slave_pod_schedule":
         # Name the COLD-MOUNT CAUSE: the slave_pod_schedule spans carry
         # the allocator's warm-pool outcome (pool_hit/pool_gap), so a
@@ -581,6 +603,43 @@ def cmd_recovery(args) -> int:
     unhealthy = any(entry.get("status") in ("suspect", "evacuated")
                     for entry in nodes.values())
     return 3 if unhealthy else 0
+
+
+def cmd_health(args) -> int:
+    """The gray-failure health plane: per-node scorer verdicts +
+    quarantine states (GET /health/nodes), or --quarantine NODE /
+    --release NODE to drive the state machine by hand (POST; mutate
+    token). A 409 refusal (release of a non-quarantined node, quarantine
+    of an evacuated one) exits 2: the plane refused, nothing changed.
+    Exit 3 while ANY node is quarantined — scriptable like
+    `tpumounter recovery`."""
+    if args.quarantine or args.release:
+        node = args.quarantine or args.release
+        action = "quarantine" if args.quarantine else "release"
+        body_json: dict = {"action": action}
+        if args.quarantine and args.reason:
+            body_json["reason"] = args.reason
+        status, body = _http(args, "POST", f"/health/quarantine/{node}",
+                             json_body=body_json,
+                             token=_remote_token(args))
+        print(body.rstrip())
+        if status == 409:
+            return 2
+        return 0 if status == 200 else 1
+    status, body = _http(args, "GET", "/health/nodes",
+                         token=_obs_token(args))
+    print(body.rstrip())
+    if status != 200:
+        return 1
+    try:
+        payload = json.loads(body)
+    except ValueError:
+        return 1
+    nodes = payload.get("nodes") or {}
+    quarantined = any(entry.get("state") == "quarantined"
+                      and not entry.get("evacuated")
+                      for entry in nodes.values())
+    return 3 if quarantined else 0
 
 
 def cmd_defrag(args) -> int:
@@ -1110,6 +1169,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="manually evacuate NODE (operator-confirmed "
                          "death; needs the mutate token)")
     rc.set_defaults(fn=cmd_recovery)
+
+    hl = sub.add_parser("health",
+                        help="gray-failure health plane: per-node "
+                             "scorer verdicts + quarantine states "
+                             "(no flag: pane, exit 3 while any node is "
+                             "quarantined; --quarantine/--release "
+                             "mutate, exit 2 on a plane refusal)")
+    _obs_common(hl)
+    hl_group = hl.add_mutually_exclusive_group()
+    hl_group.add_argument("--quarantine", metavar="NODE", default=None,
+                          help="manually quarantine NODE (budget-exempt; "
+                               "needs the mutate token)")
+    hl_group.add_argument("--release", metavar="NODE", default=None,
+                          help="release NODE straight to healthy "
+                               "(needs the mutate token)")
+    hl.add_argument("--reason", default=None,
+                    help="with --quarantine: why (lands in the pane and "
+                         "the flight recorder)")
+    hl.set_defaults(fn=cmd_health)
 
     df = sub.add_parser("defrag",
                         help="ICI defragmenter: recover large-slice "

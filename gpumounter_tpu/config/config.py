@@ -205,6 +205,71 @@ class Config:
     recovery_probe_timeout_s: float = field(default_factory=lambda: float(
         _env("RECOVERY_PROBE_TIMEOUT_S", "5")))
 
+    # --- gray-failure health plane (gpumounter_tpu/health/) ---
+    # Passive outlier scorer + quarantine state machine over the fleet
+    # collector's node entries, plus the active canary prober. Opt-out
+    # like recovery: the plane observes by default, quarantine is its
+    # only verdict, and everything it gates fails open when disabled.
+    health_enabled: bool = field(default_factory=lambda: _env(
+        "TPUMOUNTER_HEALTH", "1") not in ("0", "false", ""))
+    # A node's mount p95 is an outlier when it exceeds BOTH
+    # multiplier x fleet-median AND median + floor_ms (the floor keeps
+    # a 2 ms median fleet from flagging a 17 ms node as 8x-slow).
+    health_p95_multiplier: float = field(default_factory=lambda: float(
+        _env("HEALTH_P95_MULTIPLIER", "8")))
+    health_p95_floor_ms: float = field(default_factory=lambda: float(
+        _env("HEALTH_P95_FLOOR_MS", "50")))
+    # Minimum per-node mount samples before the p95/error-ratio signals
+    # may fire — two slow mounts are noise, not evidence.
+    health_min_samples: int = field(default_factory=lambda: int(
+        _env("HEALTH_MIN_SAMPLES", "5")))
+    health_error_ratio: float = field(default_factory=lambda: float(
+        _env("HEALTH_ERROR_RATIO", "0.2")))
+    # Hysteresis windows (consecutive scoring passes): bad passes to
+    # suspect, bad passes to quarantine, clean passes back to healthy.
+    health_suspect_strikes: int = field(default_factory=lambda: int(
+        _env("HEALTH_SUSPECT_STRIKES", "2")))
+    health_quarantine_strikes: int = field(default_factory=lambda: int(
+        _env("HEALTH_QUARANTINE_STRIKES", "4")))
+    health_clear_passes: int = field(default_factory=lambda: int(
+        _env("HEALTH_CLEAR_PASSES", "2")))
+    # Fleet-wide quarantine budget: the scorer never quarantines more
+    # than this fraction of the fleet on its own (min 1 node). Manual
+    # operator quarantines are exempt — the budget guards against
+    # scorer bugs, not operators. See docs/FAQ.md.
+    health_quarantine_budget: float = field(default_factory=lambda: float(
+        _env("HEALTH_QUARANTINE_BUDGET", "0.10")))
+    # Fail-open bound: a scoring pass where fewer than this fraction of
+    # fleet entries collected fresh is skipped outright (the
+    # capacity_unknown convention — a collector bug must not quarantine
+    # the fleet).
+    health_min_fresh_fraction: float = field(default_factory=lambda: float(
+        _env("HEALTH_MIN_FRESH_FRACTION", "0.5")))
+    # Canary prober cadence + per-RPC deadline; 0 interval disables the
+    # loop (tests drive probe_once directly). The reserved canary pod on
+    # node N is <prefix>N in the canary namespace.
+    health_canary_interval_s: float = field(default_factory=lambda: float(
+        _env("HEALTH_CANARY_INTERVAL_S", "30")))
+    health_canary_timeout_s: float = field(default_factory=lambda: float(
+        _env("HEALTH_CANARY_TIMEOUT_S", "5")))
+    health_canary_namespace: str = field(default_factory=lambda: _env(
+        "HEALTH_CANARY_NAMESPACE", "kube-system"))
+    health_canary_pod_prefix: str = field(default_factory=lambda: _env(
+        "HEALTH_CANARY_POD_PREFIX", "tpumounter-canary-"))
+    # Rehabilitation: consecutive canary passes required to leave
+    # quarantine (clean passive passes when no prober runs), then clean
+    # passes in the placement-deprioritized probation tier before the
+    # node is healthy again.
+    health_rehab_canary_passes: int = field(default_factory=lambda: int(
+        _env("HEALTH_REHAB_CANARY_PASSES", "3")))
+    health_probation_passes: int = field(default_factory=lambda: int(
+        _env("HEALTH_PROBATION_PASSES", "3")))
+    # Consecutive quarantined-and-still-outlier passes before the pane
+    # recommends migrating existing tenants off (SLO-burn attribution;
+    # quarantine alone never moves a tenant).
+    health_drain_burn_passes: int = field(default_factory=lambda: int(
+        _env("HEALTH_DRAIN_BURN_PASSES", "3")))
+
     # --- API-outage degraded mode (k8s/health.py + store/cache.py +
     # store/writebehind.py) ---
     # ApiHealth state machine: consecutive outage-shaped failures

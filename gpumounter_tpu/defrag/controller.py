@@ -90,7 +90,7 @@ class DefragController:
     recover through the migration machine itself)."""
 
     def __init__(self, kube, migrations, capacity, fleet, slo=None,
-                 apihealth=None, shards=None, cfg=None):
+                 apihealth=None, shards=None, cfg=None, health=None):
         self.cfg = cfg or get_config()
         self.kube = kube
         self.migrations = migrations
@@ -99,6 +99,10 @@ class DefragController:
         self.slo = slo
         self.apihealth = apihealth
         self.shards = shards
+        #: optional HealthPlane: quarantined hosts are non-destinations
+        #: for every planned move (excluded_hosts degrades to the empty
+        #: set, so a broken health plane never blocks planning).
+        self.health = health
         self._lock = OrderedLock("defrag.state")
         self._plan: dict | None = None
         self._run: dict | None = None          # the in-flight run view
@@ -290,6 +294,9 @@ class DefragController:
                     snapshot_at=rollup.get("at"),
                     max_snapshot_age_s=max_age,
                     now=time.time(),
+                    non_destinations=(
+                        self.health.excluded_hosts()
+                        if self.health is not None else frozenset()),
                     cost_fn=self._cost_fn())
             except PlanError as exc:
                 self._refuse("plan", exc.cause, str(exc), exc.status)

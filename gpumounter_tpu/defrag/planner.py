@@ -186,6 +186,7 @@ def plan_moves(nodes: dict[str, dict], *,
                snapshot_at: float | None = None,
                max_snapshot_age_s: float | None = None,
                now: float | None = None,
+               non_destinations: frozenset[str] | set[str] = frozenset(),
                cost_fn=None) -> dict:
     """Compute a move plan from a capacity snapshot.
 
@@ -193,8 +194,11 @@ def plan_moves(nodes: dict[str, dict], *,
     worker-reported `capacity` section). With `snapshot_at` +
     `max_snapshot_age_s` + `now` the snapshot's age is checked FIRST and
     a stale one raises PlanError("stale-snapshot") — the negative
-    control. Returns a JSON-able plan dict; `moves` empty when nothing
-    is blocked (a no-op plan is a fine answer, a stale plan is not)."""
+    control. `non_destinations` (the health plane's quarantined set) are
+    hosts no evicted tenant may land on — moving a tenant ONTO a limping
+    node would convert fragmentation pain into gray-failure pain.
+    Returns a JSON-able plan dict; `moves` empty when nothing is blocked
+    (a no-op plan is a fine answer, a stale plan is not)."""
     if max_snapshot_age_s is not None and now is not None:
         if snapshot_at is None:
             raise PlanError(
@@ -250,7 +254,7 @@ def plan_moves(nodes: dict[str, dict], *,
             skipped.append({"node": node, "reason": "tenant-budget"})
             continue
         # Tentatively place every eviction; all-or-nothing per group.
-        unblocking = blocked_names | {node}
+        unblocking = blocked_names | {node} | set(non_destinations)
         staged: list[dict] = []
         placed_ok = True
         snapshot = {n: (set(v.free), dict(v.held)) for n, v in sim.items()}

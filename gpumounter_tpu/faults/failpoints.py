@@ -36,6 +36,15 @@ Action semantics at a `fire()` site:
                     network latency).
   unavailable(msg)  raise InjectedUnavailable — the RPC client treats it
                     exactly like a dropped connection (retriable).
+  pdelay([p, s])    with probability p, time.sleep(s); otherwise pass.
+                    The gray-failure shape: a limping node is not DOWN,
+                    it is intermittently slow — deterministic delay()
+                    makes every call slow (an outage), pdelay makes SOME
+                    calls slow (a degradation the liveness probes miss).
+  pdrop(p)          with probability p, raise InjectedUnavailable;
+                    otherwise pass. Intermittent packet loss / flaky NIC.
+                    Draws come from a registry-owned RNG — `seed(n)`
+                    before a scenario makes a chaos run reproducible.
   return(v)         no-op at fire() sites; at `value()` sites the parsed
                     v (JSON when possible) replaces the default — used
                     for deadline overrides, k8s status-code injection,
@@ -49,6 +58,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -83,7 +93,8 @@ class InjectedUnavailable(RuntimeError):
     StatusCode.UNAVAILABLE."""
 
 
-_KINDS = ("off", "pass", "error", "crash", "delay", "unavailable", "return")
+_KINDS = ("off", "pass", "error", "crash", "delay", "unavailable", "return",
+          "pdelay", "pdrop")
 
 
 @dataclass
@@ -134,6 +145,25 @@ def _parse_term(raw: str) -> _Action:
             arg = float(arg)  # type: ignore[arg-type]
         except (TypeError, ValueError):
             raise FailpointSpecError(f"delay needs a number: {raw!r}")
+    if kind == "pdelay":
+        try:
+            p, seconds = arg  # type: ignore[misc]
+            arg = (float(p), float(seconds))
+        except (TypeError, ValueError):
+            raise FailpointSpecError(
+                f"pdelay needs [probability, seconds]: {raw!r}")
+        if not 0.0 <= arg[0] <= 1.0:
+            raise FailpointSpecError(
+                f"pdelay probability must be in [0, 1]: {raw!r}")
+    if kind == "pdrop":
+        try:
+            arg = float(arg)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            raise FailpointSpecError(
+                f"pdrop needs a probability: {raw!r}")
+        if not 0.0 <= arg <= 1.0:
+            raise FailpointSpecError(
+                f"pdrop probability must be in [0, 1]: {raw!r}")
     return _Action(kind=kind, arg=arg, remaining=count)
 
 
@@ -176,6 +206,15 @@ class Registry:
         #: of a bool are atomic, and a stale False only delays arming by
         #: one call — never corrupts state.
         self._any_armed = False
+        #: one RNG per registry, seeded constant so an un-seeded run is
+        #: still reproducible; `seed(n)` rewinds it before a scenario.
+        self._rng = random.Random(0)
+
+    def seed(self, n: int) -> None:
+        """Rewind the probabilistic-action RNG (pdelay/pdrop draws), so a
+        chaos scenario replays the same coin flips for the same seed."""
+        with self._lock:
+            self._rng.seed(n)
 
     # --- arming ---
 
@@ -246,6 +285,12 @@ class Registry:
                         self._any_armed = bool(self._points)
             return action
 
+    def _coin(self, p: float) -> bool:
+        # Under the lock: Random is not documented thread-safe, and a
+        # serialized draw order is what makes seeded runs reproducible.
+        with self._lock:
+            return self._rng.random() < p
+
     def fire(self, name: str, /, **ctx) -> None:
         """Injection site. Zero-cost unless something is armed.
         (`name` is positional-only so ctx may carry its own `name`.)"""
@@ -254,6 +299,19 @@ class Registry:
         action = self._take(name)
         if action is None or action.kind == "pass":
             return
+        if action.kind == "pdelay":
+            p, seconds = action.arg  # type: ignore[misc]
+            if not self._coin(p):
+                return  # the lucky call: no count, no log spam
+            FAILPOINT_FIRES.inc(name=name)
+            time.sleep(seconds)
+            return
+        if action.kind == "pdrop":
+            if not self._coin(float(action.arg)):  # type: ignore[arg-type]
+                return
+            FAILPOINT_FIRES.inc(name=name)
+            raise InjectedUnavailable(
+                f"failpoint {name}: injected drop (p={action.arg})")
         FAILPOINT_FIRES.inc(name=name)
         detail = action.arg if action.arg is not None else name
         logger.warning("failpoint %s firing %s%s ctx=%s", name, action.kind,
@@ -278,6 +336,18 @@ class Registry:
         action = self._take(name)
         if action is None or action.kind == "pass":
             return default
+        if action.kind == "pdelay":
+            p, seconds = action.arg  # type: ignore[misc]
+            if self._coin(p):
+                FAILPOINT_FIRES.inc(name=name)
+                time.sleep(seconds)
+            return default
+        if action.kind == "pdrop":
+            if not self._coin(float(action.arg)):  # type: ignore[arg-type]
+                return default
+            FAILPOINT_FIRES.inc(name=name)
+            raise InjectedUnavailable(
+                f"failpoint {name}: injected drop (p={action.arg})")
         FAILPOINT_FIRES.inc(name=name)
         logger.warning("failpoint %s (value) firing %s(%s) ctx=%s",
                        name, action.kind, action.arg, ctx)
@@ -306,6 +376,7 @@ active = _REGISTRY.active
 hits = _REGISTRY.hits
 fire = _REGISTRY.fire
 value = _REGISTRY.value
+seed = _REGISTRY.seed
 
 
 class armed:

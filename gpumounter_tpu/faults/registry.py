@@ -32,6 +32,12 @@ FAILPOINTS: dict[str, str] = {
                   "barrier sample",
     # warm pool (gpumounter_tpu/allocator/pool.py)
     "pool.refill": "per-node warm-pool refill attempt",
+    # health plane (gpumounter_tpu/health/plane.py)
+    "health.observe": "top of one gray-failure scoring pass (nodes= "
+                      "ctx); armed with pdrop/pdelay by the gray chaos "
+                      "scenario",
+    "health.canary": "canary probe, before the synthetic mount dials "
+                     "the worker (node= ctx)",
     # rpc client (gpumounter_tpu/rpc/client.py)
     "rpc.client.call": "before every outbound worker RPC attempt",
     "rpc.client.deadline": "value(): per-call deadline override",

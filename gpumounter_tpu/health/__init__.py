@@ -1,0 +1,17 @@
+"""Gray-failure detection and node quarantine plane (ISSUE 18).
+
+The recovery controller (gpumounter_tpu/recovery/) only acts on
+confirmed-DEAD nodes; this package catches the node that is alive but
+limping — mounts 50x slower, drops a fraction of RPCs — scores it from
+the fleet telemetry the collector already federates plus an active
+canary probe, and quarantines it softly (no placements, warm pool
+drained, defrag non-destination) without ever evacuating it.
+"""
+
+from gpumounter_tpu.health.plane import (
+    STATES,
+    CanaryProber,
+    HealthPlane,
+)
+
+__all__ = ["STATES", "CanaryProber", "HealthPlane"]

@@ -1,0 +1,752 @@
+"""Per-node gray-failure scorer, quarantine state machine, canary prober.
+
+The recovery plane's world is binary — a node is reachable or it is
+dead — because that is the only verdict its positive-corroboration
+rules (registry gone + Node NotReady) can prove. Production incidents
+are dominated by the third state neither verdict covers: the *limping*
+node that answers every liveness probe while mounting 50x slower,
+dropping a fraction of RPCs, or fsyncing its ledger at disk-timeout
+speeds. Such a node passes recovery's checks forever and keeps
+receiving placements, silently burning the mount-latency SLO fleet-wide
+(the partial/fail-slow taxonomies in PAPERS.md; GPUMounter itself
+assumes reachable-or-not).
+
+Two signal sources drive a per-node state machine
+
+    healthy -> suspect -> quarantined -> rehabilitating -> healthy
+
+* **passive outlier scoring** over the node entries the FleetCollector
+  already federates: per-node mount p95 vs the fleet median, mount
+  error ratios, and circuit-breaker state from the RPC plane. The
+  scorer is a collect-pass observer exactly like the capacity plane —
+  wired as `fleet.health`, exception-isolated, and *fail-open*: stale
+  entries freeze a node's counters (no signal is not a bad signal, per
+  the capacity plane's `capacity_unknown` convention), and a pass in
+  which most of the fleet failed to collect is skipped outright — a
+  master-side collector bug must not quarantine the fleet.
+* an **active canary prober** that periodically drives a real synthetic
+  mount -> verify -> unmount through the full worker path (grant,
+  mknod, ledger) against a reserved canary pod on the node. Canary
+  probes target the decision-relevant set (suspect / quarantined /
+  rehabilitating nodes): the passive scorer is what watches the healthy
+  herd; the canary is what *proves* a verdict either way.
+
+Quarantine is **soft and reversible**, unlike evacuation: nothing is
+unmounted and no tenant is touched. Consumers read
+:meth:`HealthPlane.excluded_hosts` (never raises; degrades to the empty
+set) — the SharePacker refuses quarantined hosts outright, the defrag
+planner treats them as non-destinations, and the fleet collector tells
+the node's worker to drain its warm holders via the CollectTelemetry
+pull. The recovery controller is explicitly taught quarantined != dead:
+it keeps probing a quarantined node under its normal
+positive-corroboration rules, so a quarantined node that *then* dies is
+evacuated normally, and a gray one never is.
+
+Flap damping: hysteresis windows in both directions (N consecutive bad
+passes to demote, M consecutive clean passes to promote) plus a
+fleet-wide quarantine budget — the scorer never quarantines more than
+`health_quarantine_budget` of the fleet on its own (manual operator
+quarantines are exempt: the budget guards against scorer bugs, not
+operators). Rehabilitation requires `health_rehab_canary_passes`
+consecutive canary passes and re-enters through a placement-
+deprioritized probation tier (`rehabilitating`) before the node is
+trusted again.
+
+Breaker/canary dedupe: canary probes ride the breaker-aware client, so
+a failing canary trips the node's CircuitBreaker — the same incident
+must not count as evidence twice (once as canary failure, once as
+breaker state). While canary-failure evidence is active for a node the
+scorer suppresses the `breaker_open` signal; real-traffic signals
+(p95 outlier, error ratio) still count.
+
+Quarantine state persists through the `store/` seam
+(save_health_state / load_health_state) so a master shard takeover
+rebuilds the quarantine set instead of un-quarantining the fleet.
+
+Every transition lands in the flight recorder (kind="health") carrying
+the concrete signals that caused it — chaos invariant 20 audits exactly
+that trail.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("health.plane")
+
+#: the bounded state vocabulary (metric label; node names never ride
+#: labels — they ride the /health/nodes JSON pane).
+STATES = ("healthy", "suspect", "quarantined", "rehabilitating")
+
+NODE_HEALTH_STATE = REGISTRY.gauge(
+    "tpumounter_node_health_state",
+    "Nodes per gray-failure health state (healthy / suspect / "
+    "quarantined / rehabilitating); node names ride GET /health/nodes")
+CANARY_PROBES = REGISTRY.counter(
+    "tpumounter_canary_probes_total",
+    "Canary mount->verify->unmount probes driven through the full "
+    "worker path")
+CANARY_FAILURES = REGISTRY.counter(
+    "tpumounter_canary_failures_total",
+    "Canary probes that failed (mount refused, chip unhealthy, or "
+    "transport error)")
+QUARANTINE_TRANSITIONS = REGISTRY.counter(
+    "tpumounter_quarantine_transitions_total",
+    "Health state-machine transitions by (from_state, to_state) — "
+    "bounded by the 4-state vocabulary")
+SCORER_SKIPS = REGISTRY.counter(
+    "tpumounter_health_scorer_skips_total",
+    "Whole scoring passes skipped fail-open (collector staleness / "
+    "plane disabled)")
+BUDGET_DENIALS = REGISTRY.counter(
+    "tpumounter_quarantine_budget_denials_total",
+    "Automatic quarantine verdicts suppressed by the fleet-wide "
+    "quarantine budget")
+
+
+def _flight():
+    from gpumounter_tpu.obs.flight import FLIGHT
+    return FLIGHT
+
+
+@dataclass
+class _NodeRecord:
+    """One node's scoring counters. Counters are consecutive-pass
+    streaks — the hysteresis windows — not lifetime totals."""
+
+    state: str = "healthy"
+    since: float = field(default_factory=time.time)
+    reason: str = ""
+    signals: list = field(default_factory=list)
+    #: consecutive bad scoring passes (drives healthy->suspect->quarantined)
+    strikes: int = 0
+    #: consecutive clean scoring passes (drives suspect->healthy)
+    clear: int = 0
+    #: consecutive canary passes / failures (rehab gate + active signal)
+    canary_ok: int = 0
+    canary_fails: int = 0
+    canary_detail: str = ""
+    #: consecutive clean passes while rehabilitating (probation gate)
+    probation_clear: int = 0
+    #: operator-forced: exempt from the budget, never auto-rehabilitated
+    manual: bool = False
+    #: consecutive quarantined passes with the node's p95 still past the
+    #: outlier bar — the SLO-burn attribution that justifies migrating
+    #: existing tenants off (recommendation only; the migration itself
+    #: rides the existing defrag/migration tooling)
+    slo_burn: int = 0
+    drain_recommended: bool = False
+    #: superseded by a recovery-plane evacuation (the hard verdict wins)
+    evacuated: bool = False
+
+    def pane(self) -> dict:
+        return {
+            "state": self.state,
+            "since": round(self.since, 3),
+            "reason": self.reason,
+            "signals": list(self.signals),
+            "strikes": self.strikes,
+            "canary": {"consecutive_ok": self.canary_ok,
+                       "consecutive_failures": self.canary_fails,
+                       "detail": self.canary_detail},
+            "manual": self.manual,
+            "drain_recommended": self.drain_recommended,
+            "evacuated": self.evacuated,
+        }
+
+
+class HealthPlane:
+    """The scorer + quarantine state machine. A collect-pass observer
+    (``fleet.health``): its bugs must never fail telemetry, and its
+    reads (:meth:`excluded_hosts`) must never fail a consumer."""
+
+    def __init__(self, cfg=None, recovery=None, store=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        #: RecoveryController: release() refuses nodes it evacuated, and
+        #: evacuation supersedes quarantine (note_evacuated).
+        self.recovery = recovery
+        #: MasterStore seam: quarantine state survives shard takeover.
+        self.store = store
+        #: set by the prober; when no canary runs, rehabilitation falls
+        #: back to consecutive clean passive passes (documented in FAQ).
+        self.canary_active = False
+        self._lock = OrderedLock("health.state")
+        self._nodes: dict[str, _NodeRecord] = {}
+        #: last pass verdict for the pane: "" | "scoring" | "stale"
+        self._last_pass = {"at": 0.0, "verdict": "", "fresh": 0,
+                           "total": 0, "median_p95_ms": None}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cfg.health_enabled)
+
+    # --- the passive scorer (collect-pass observer) ---
+
+    def observe(self, nodes: dict[str, dict]) -> None:
+        """Score one fleet collection pass. Called by
+        FleetCollector.collect_once right after the capacity plane,
+        inside the same exception guard."""
+        if not self.enabled or not nodes:
+            return
+        failpoints.fire("health.observe", nodes=len(nodes))
+        fresh = {n: e for n, e in nodes.items()
+                 if not e.get("stale") and not e.get("error")}
+        total = len(nodes)
+        # Fail-open (the capacity_unknown convention): when most of the
+        # fleet failed to collect the problem is the collector, not the
+        # fleet — skip the pass entirely rather than score the survivors
+        # against a broken median.
+        floor = max(1, int(total * float(self.cfg.health_min_fresh_fraction)))
+        if len(fresh) < floor:
+            SCORER_SKIPS.inc()
+            with self._lock:
+                self._last_pass = {"at": time.time(), "verdict": "stale",
+                                   "fresh": len(fresh), "total": total,
+                                   "median_p95_ms": None}
+            logger.warning(
+                "health scorer skipped pass fail-open: %d/%d nodes "
+                "fresh (< %d)", len(fresh), total, floor)
+            return
+        median = self._fleet_median_p95(fresh)
+        events: list[dict] = []
+        with self._lock:
+            self._last_pass = {"at": time.time(), "verdict": "scoring",
+                               "fresh": len(fresh), "total": total,
+                               "median_p95_ms": median}
+            # forget healthy records for nodes that left the fleet;
+            # quarantined/rehabilitating records survive a node restart
+            # (the worker coming back does not make the hardware whole).
+            for node in list(self._nodes):
+                if node not in nodes and \
+                        self._nodes[node].state in ("healthy", "suspect"):
+                    del self._nodes[node]
+            quarantined = sum(1 for r in self._nodes.values()
+                              if r.state == "quarantined")
+            budget = max(1, int(total * float(
+                self.cfg.health_quarantine_budget)))
+            for node in sorted(fresh):
+                rec = self._nodes.setdefault(node, _NodeRecord())
+                if rec.evacuated:
+                    continue  # recovery's hard verdict superseded ours
+                signals = self._score(rec, fresh[node], median)
+                ev = self._step(node, rec, signals,
+                                budget_left=budget - quarantined)
+                if ev:
+                    events.append(ev)
+                    if ev["to"] == "quarantined":
+                        quarantined += 1
+                    elif ev["from"] == "quarantined":
+                        quarantined -= 1
+            self._export_gauge_locked()
+        # flight records / persistence OUTSIDE health.state: the
+        # recorder and store have locks of their own and nothing here
+        # needs atomicity with the scoring pass.
+        for ev in events:
+            self._announce(ev)
+        if any(ev["to"] == "quarantined" or ev["from"] == "quarantined"
+               for ev in events):
+            self._persist()
+
+    def _fleet_median_p95(self, fresh: dict[str, dict]) -> float | None:
+        """Median of per-node mount p95 over nodes with enough samples
+        to mean anything. None (< 2 contributing nodes) disables the
+        outlier signal for the pass — an outlier needs a herd."""
+        import statistics
+        samples = []
+        for entry in fresh.values():
+            mount = entry.get("mount") or {}
+            if (mount.get("count") or 0) < int(self.cfg.health_min_samples):
+                continue
+            p95 = mount.get("p95_ms")
+            if p95 is not None:
+                samples.append(float(p95))
+        if len(samples) < 2:
+            return None
+        return float(statistics.median(samples))
+
+    def _score(self, rec: _NodeRecord, entry: dict,
+               median: float | None) -> list[str]:
+        """One node's gray-failure signals for this pass. Every string
+        names the concrete evidence — it is what the flight record (and
+        chaos invariant 20) attributes the quarantine to."""
+        signals: list[str] = []
+        mount = entry.get("mount") or {}
+        count = int(mount.get("count") or 0)
+        p95 = mount.get("p95_ms")
+        if median is not None and median > 0 \
+                and count >= int(self.cfg.health_min_samples) \
+                and p95 is not None:
+            bar = max(median * float(self.cfg.health_p95_multiplier),
+                      median + float(self.cfg.health_p95_floor_ms))
+            if float(p95) >= bar:
+                signals.append(
+                    f"mount_p95_outlier(p95={float(p95):.0f}ms "
+                    f"fleet_median={median:.0f}ms bar={bar:.0f}ms)")
+        errors = int(mount.get("error") or 0)
+        successes = int(mount.get("success") or 0)
+        if errors + successes >= int(self.cfg.health_min_samples):
+            ratio = errors / float(errors + successes)
+            if ratio >= float(self.cfg.health_error_ratio):
+                signals.append(
+                    f"mount_error_ratio({errors}/{errors + successes})")
+        if rec.canary_fails > 0:
+            signals.append(f"canary_failures(x{rec.canary_fails}: "
+                           f"{rec.canary_detail or 'probe failed'})")
+        if entry.get("breaker") == "open":
+            if rec.canary_fails > 0:
+                # breaker/canary dedupe: the canary's own failed probes
+                # are (or may be) what tripped this breaker — one
+                # incident is one signal, not two.
+                pass
+            else:
+                signals.append("breaker_open")
+        return signals
+
+    def _step(self, node: str, rec: _NodeRecord, signals: list[str],
+              budget_left: int) -> dict | None:
+        """Advance one node's state machine by one scoring pass; returns
+        the transition event (for flight/metrics, emitted outside the
+        lock) or None."""
+        bad = bool(signals)
+        if bad:
+            rec.signals = list(signals)
+        if rec.state in ("healthy", "suspect"):
+            if bad:
+                rec.strikes += 1
+                rec.clear = 0
+                if rec.state == "healthy" and \
+                        rec.strikes >= int(self.cfg.health_suspect_strikes):
+                    return self._transition(node, rec, "suspect", signals)
+                if rec.state == "suspect" and \
+                        rec.strikes >= int(self.cfg.health_quarantine_strikes):
+                    if budget_left <= 0:
+                        BUDGET_DENIALS.inc()
+                        logger.warning(
+                            "quarantine of %s suppressed: fleet "
+                            "quarantine budget exhausted (signals: %s)",
+                            node, "; ".join(signals))
+                        return None
+                    return self._transition(node, rec, "quarantined",
+                                            signals)
+            else:
+                rec.clear += 1
+                rec.signals = []
+                if rec.clear >= int(self.cfg.health_clear_passes):
+                    rec.strikes = 0
+                    if rec.state == "suspect":
+                        return self._transition(node, rec, "healthy",
+                                                ["cleared"])
+        elif rec.state == "quarantined":
+            # SLO-burn attribution: while quarantined AND still an
+            # outlier, the node is actively burning tenant SLOs —
+            # after health_drain_burn_passes consecutive such passes
+            # the pane recommends migrating its tenants off.
+            if any(s.startswith("mount_p95_outlier") for s in signals):
+                rec.slo_burn += 1
+                if rec.slo_burn >= int(self.cfg.health_drain_burn_passes) \
+                        and not rec.drain_recommended:
+                    rec.drain_recommended = True
+                    return {"node": node, "from": "quarantined",
+                            "to": "quarantined", "signals": list(signals),
+                            "summary": f"{node}: drain recommended "
+                            f"(SLO burn attributed for {rec.slo_burn} "
+                            f"passes while quarantined)"}
+            else:
+                rec.slo_burn = 0
+            if rec.manual:
+                return None  # operator put it there; operator takes it out
+            if not bad:
+                rec.clear += 1
+                ready = (rec.canary_ok
+                         >= int(self.cfg.health_rehab_canary_passes)
+                         if self.canary_active else
+                         rec.clear >= int(self.cfg.health_rehab_canary_passes))
+                if ready:
+                    rec.probation_clear = 0
+                    return self._transition(node, rec, "rehabilitating",
+                                            ["canary_passes"
+                                             if self.canary_active
+                                             else "clean_passes"])
+            else:
+                rec.clear = 0
+                rec.canary_ok = 0
+        elif rec.state == "rehabilitating":
+            if bad:
+                # flap: straight back to quarantined — no budget check,
+                # the node held a quarantine slot moments ago.
+                rec.canary_ok = 0
+                rec.clear = 0
+                return self._transition(node, rec, "quarantined", signals)
+            rec.probation_clear += 1
+            if rec.probation_clear >= int(self.cfg.health_probation_passes):
+                rec.strikes = rec.clear = 0
+                rec.slo_burn = 0
+                rec.drain_recommended = False
+                return self._transition(node, rec, "healthy",
+                                        ["probation_complete"])
+        return None
+
+    def _transition(self, node: str, rec: _NodeRecord, to: str,
+                    signals: list[str]) -> dict:
+        """Mutate the record; returns the event the caller announces
+        outside the lock."""
+        src = rec.state
+        rec.state = to
+        rec.since = time.time()
+        rec.reason = "; ".join(signals)
+        if to in ("healthy",):
+            rec.signals = []
+        return {"node": node, "from": src, "to": to,
+                "signals": list(signals),
+                "summary": f"{node}: {src} -> {to} ({rec.reason})"}
+
+    def _announce(self, ev: dict) -> None:
+        """One transition's observability: bounded transition counter +
+        a flight-recorder timeline entry naming the concrete signals
+        (the trail chaos invariant 20 audits)."""
+        if ev["from"] != ev["to"]:
+            QUARANTINE_TRANSITIONS.inc(from_state=ev["from"],
+                                       to_state=ev["to"])
+        try:
+            _flight().record("health", ev["summary"], node=ev["node"],
+                             from_state=ev["from"], to_state=ev["to"],
+                             signals=list(ev["signals"]))
+        except Exception:  # noqa: BLE001 — observability of the observer
+            logger.exception("health flight record failed")
+        logger.warning("health: %s", ev["summary"])
+
+    def _export_gauge_locked(self) -> None:
+        counts = {s: 0 for s in STATES}
+        for rec in self._nodes.values():
+            if not rec.evacuated:
+                counts[rec.state] += 1
+        for state, n in counts.items():
+            NODE_HEALTH_STATE.set(float(n), state=state)
+
+    # --- the canary's feedback ---
+
+    def record_canary(self, node: str, ok: bool, detail: str = "") -> None:
+        """One canary probe outcome. Streak counters only — the scoring
+        pass is what turns them into transitions, so canary cadence and
+        collect cadence stay decoupled."""
+        with self._lock:
+            rec = self._nodes.setdefault(node, _NodeRecord())
+            if ok:
+                rec.canary_ok += 1
+                rec.canary_fails = 0
+                rec.canary_detail = ""
+            else:
+                rec.canary_fails += 1
+                rec.canary_ok = 0
+                rec.canary_detail = detail
+
+    # --- consumer reads (never raise; degrade open) ---
+
+    def excluded_hosts(self) -> frozenset[str]:
+        """Hosts no new work may be placed on: the quarantined set.
+        Never raises; degrades to the empty set — a broken health plane
+        must fail open, not fence the fleet."""
+        try:
+            with self._lock:
+                return frozenset(
+                    n for n, r in self._nodes.items()
+                    if r.state == "quarantined" and not r.evacuated)
+        except Exception:  # noqa: BLE001 — consumer-facing read
+            return frozenset()
+
+    def probation_hosts(self) -> frozenset[str]:
+        """Rehabilitating nodes: placeable, but deprioritized — new work
+        goes there only when nowhere better exists."""
+        try:
+            with self._lock:
+                return frozenset(
+                    n for n, r in self._nodes.items()
+                    if r.state == "rehabilitating" and not r.evacuated)
+        except Exception:  # noqa: BLE001 — consumer-facing read
+            return frozenset()
+
+    def is_quarantined(self, node: str) -> bool:
+        return node in self.excluded_hosts()
+
+    # --- operator verbs (POST /health/quarantine/<node>) ---
+
+    def quarantine(self, node: str, reason: str = "",
+                   actor: str = "operator") -> dict:
+        """Manual quarantine. Exempt from the fleet budget (the budget
+        guards against scorer bugs, not operators) and never
+        auto-rehabilitated — release is manual too."""
+        with self._lock:
+            rec = self._nodes.setdefault(node, _NodeRecord())
+            if rec.evacuated:
+                raise ValueError(
+                    f"{node} was evacuated by the recovery plane; "
+                    f"quarantine would be meaningless")
+            if rec.state == "quarantined":
+                return rec.pane()
+            rec.manual = True
+            ev = self._transition(
+                node, rec, "quarantined",
+                [f"manual({actor}: {reason or 'no reason given'})"])
+            self._export_gauge_locked()
+            pane = rec.pane()
+        self._announce(ev)
+        self._persist()
+        return pane
+
+    def release(self, node: str, actor: str = "operator") -> dict:
+        """Manual release, straight to healthy (the operator has judged
+        the node; probation is for the scorer's own verdicts). REFUSES
+        a node the recovery plane evacuated — release cannot resurrect
+        the dead."""
+        # Cross-plane check OUTSIDE health.state: recovery.state must
+        # never nest under our lock (keeps the static lock graph
+        # acyclic — tools/tpulint lock-order validator).
+        recovery_says_dead = self._recovery_evacuated(node)
+        with self._lock:
+            rec = self._nodes.get(node)
+            if rec is None or rec.state == "healthy":
+                raise ValueError(f"{node} is not quarantined")
+            if rec.evacuated or recovery_says_dead:
+                raise ValueError(
+                    f"{node} was evacuated by the recovery plane; "
+                    f"it cannot be released back — it must re-register "
+                    f"as a fresh worker")
+            ev = self._transition(node, rec, "healthy",
+                                  [f"manual_release({actor})"])
+            rec.manual = False
+            rec.strikes = rec.clear = rec.canary_ok = rec.canary_fails = 0
+            rec.slo_burn = 0
+            rec.drain_recommended = False
+            self._export_gauge_locked()
+            pane = rec.pane()
+        self._announce(ev)
+        self._persist()
+        return pane
+
+    def _recovery_evacuated(self, node: str) -> bool:
+        if self.recovery is None:
+            return False
+        try:
+            return self.recovery.is_evacuated(node)
+        except Exception:  # noqa: BLE001 — advisory cross-check
+            return False
+
+    def note_evacuated(self, node: str) -> None:
+        """Recovery-plane hook: evacuation supersedes quarantine (the
+        hard verdict wins; the node's record is retired so the scorer
+        stops reasoning about a corpse)."""
+        with self._lock:
+            rec = self._nodes.get(node)
+            if rec is None or rec.evacuated:
+                return
+            was = rec.state
+            rec.evacuated = True
+            self._export_gauge_locked()
+        if was in ("quarantined", "rehabilitating"):
+            try:
+                _flight().record(
+                    "health", f"{node}: {was} superseded by evacuation",
+                    node=node, from_state=was, to_state="evacuated",
+                    signals=["recovery.evacuate"])
+            except Exception:  # noqa: BLE001
+                logger.exception("health flight record failed")
+            self._persist()
+
+    # --- persistence (shard-takeover continuity) ---
+
+    def _persist(self) -> None:
+        if self.store is None:
+            return
+        with self._lock:
+            state = {
+                "version": 1,
+                "nodes": {
+                    n: {"state": r.state, "since": r.since,
+                        "reason": r.reason, "manual": r.manual}
+                    for n, r in self._nodes.items()
+                    if r.state in ("quarantined", "rehabilitating")
+                    and not r.evacuated},
+            }
+        try:
+            self.store.save_health_state(state)
+        except Exception as exc:  # noqa: BLE001 — best-effort; the
+            # in-memory machine is authoritative for THIS master
+            logger.warning("health state persist failed: %s", exc)
+
+    def load(self) -> int:
+        """Restore the quarantine set a previous master persisted (shard
+        takeover / restart). Only quarantined/rehabilitating records are
+        stored — healthy/suspect rebuild from live telemetry. Returns
+        the number of nodes restored."""
+        if self.store is None:
+            return 0
+        try:
+            state = self.store.load_health_state()
+        except Exception as exc:  # noqa: BLE001 — fail open
+            logger.warning("health state load failed: %s", exc)
+            return 0
+        if not state or not isinstance(state.get("nodes"), dict):
+            return 0
+        restored = 0
+        with self._lock:
+            for node, saved in state["nodes"].items():
+                if saved.get("state") not in ("quarantined",
+                                              "rehabilitating"):
+                    continue
+                rec = self._nodes.setdefault(node, _NodeRecord())
+                rec.state = saved["state"]
+                rec.since = float(saved.get("since") or time.time())
+                rec.reason = str(saved.get("reason") or "restored")
+                rec.manual = bool(saved.get("manual"))
+                restored += 1
+            self._export_gauge_locked()
+        if restored:
+            logger.warning(
+                "health: restored %d quarantined/rehabilitating node(s) "
+                "from the store (takeover continuity)", restored)
+        return restored
+
+    # --- the pane ---
+
+    def payload(self) -> dict:
+        with self._lock:
+            nodes = {n: r.pane() for n, r in self._nodes.items()}
+            counts = {s: 0 for s in STATES}
+            for r in self._nodes.values():
+                if not r.evacuated:
+                    counts[r.state] += 1
+            total = self._last_pass.get("total") or len(nodes)
+            return {
+                "enabled": self.enabled,
+                "nodes": nodes,
+                "states": counts,
+                "quarantine_budget": {
+                    "fraction": float(self.cfg.health_quarantine_budget),
+                    "max_nodes": max(1, int(
+                        total * float(self.cfg.health_quarantine_budget))),
+                    "used": counts["quarantined"],
+                },
+                "canary_active": self.canary_active,
+                "last_pass": dict(self._last_pass),
+            }
+
+
+class CanaryProber:
+    """Active gray-failure probe: a real synthetic mount -> verify ->
+    unmount through the full worker path (grant, mknod, ledger) against
+    a reserved canary pod, on the interval, for every decision-relevant
+    node (suspect / quarantined / rehabilitating).
+
+    The probe rides the breaker-aware client on purpose — it exercises
+    exactly the path tenants pay — and the plane's scorer dedupes the
+    breaker echo (see module docstring). A node without its canary pod
+    scheduled answers PodNotFound; that is a *skip*, not a failure (the
+    RUNBOOK covers deploying canary pods)."""
+
+    def __init__(self, plane: HealthPlane, registry, client_factory,
+                 cfg=None, probe=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.cfg = cfg
+        self.plane = plane
+        self.registry = registry
+        self.client_factory = client_factory
+        #: injectable probe(node, address) -> (ok: bool | None, detail);
+        #: None = skip (no canary pod there). Tests/bench inject stubs.
+        self.probe = probe or self._default_probe
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "CanaryProber":
+        if not self.plane.enabled \
+                or float(self.cfg.health_canary_interval_s) <= 0:
+            return self
+        self.plane.canary_active = True
+        self._thread = threading.Thread(
+            target=self._loop, name="canary-prober", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.plane.canary_active = False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(float(self.cfg.health_canary_interval_s)):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("canary probe pass failed")
+
+    def targets(self) -> list[str]:
+        pane = self.plane.payload()["nodes"]
+        return sorted(n for n, rec in pane.items()
+                      if rec["state"] in ("suspect", "quarantined",
+                                          "rehabilitating")
+                      and not rec["evacuated"])
+
+    def probe_once(self) -> int:
+        """One probe pass over the decision-relevant set; returns probes
+        actually driven (skips excluded)."""
+        driven = 0
+        snapshot = dict(self.registry.registry_snapshot())
+        for node in self.targets():
+            ip = snapshot.get(node)
+            if ip is None:
+                continue  # not registered: recovery's problem, not ours
+            address = f"{ip}:{self.cfg.worker_port}"
+            try:
+                ok, detail = self.probe(node, address)
+            except Exception as exc:  # noqa: BLE001 — a probe that
+                # cannot even dial IS the evidence
+                ok, detail = False, f"{type(exc).__name__}: {exc}"
+            if ok is None:
+                continue  # no canary pod on the node: skip, not fail
+            driven += 1
+            CANARY_PROBES.inc()
+            if not ok:
+                CANARY_FAILURES.inc()
+            self.plane.record_canary(node, ok, detail)
+        return driven
+
+    def _default_probe(self, node: str,
+                       address: str) -> tuple[bool | None, str]:
+        from gpumounter_tpu.rpc import api
+        pod = f"{self.cfg.health_canary_pod_prefix}{node}"
+        ns = self.cfg.health_canary_namespace
+        timeout = float(self.cfg.health_canary_timeout_s)
+        failpoints.fire("health.canary", node=node)
+        t0 = time.monotonic()
+        with self.client_factory(address) as client:
+            result = client.add_tpu(pod, ns, 1, timeout_s=timeout)
+            if result == api.AddTPUResult.PodNotFound:
+                return None, "canary pod not scheduled"
+            if result != api.AddTPUResult.Success:
+                return False, f"canary mount refused: {result.name}"
+            try:
+                probe, chips = client.probe_tpu(pod, ns, timeout_s=timeout)
+                if probe != api.ProbeTPUResult.Success or not chips:
+                    return False, "canary chip probe failed"
+                if any(not c.healthy for c in chips):
+                    return False, "canary chip unhealthy"
+            finally:
+                client.remove_tpu(pod, ns, [], force=True,
+                                  remove_all=True, timeout_s=timeout)
+        ms = (time.monotonic() - t0) * 1000.0
+        if ms > timeout * 1000.0:
+            return False, f"canary path took {ms:.0f}ms (> deadline)"
+        return True, f"ok ({ms:.0f}ms)"

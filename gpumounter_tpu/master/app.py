@@ -370,6 +370,13 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/recovery$"), "recovery"),
     ("POST", re.compile(
         r"^/recovery/evacuate/(?P<node>[^/]+)$"), "recovery_evacuate"),
+    # Gray-failure health plane (gpumounter_tpu/health/): per-node
+    # quarantine state machine over the fleet telemetry + canary
+    # probes. One read pane + the manual quarantine/release verb
+    # (body {"action": "quarantine"|"release"}).
+    ("GET", re.compile(r"^/health/nodes$"), "health_nodes"),
+    ("POST", re.compile(
+        r"^/health/quarantine/(?P<node>[^/]+)$"), "health_quarantine"),
     # ICI defragmenter (gpumounter_tpu/defrag/): the plane that acts on
     # /capacity's `admissible-after-defrag` verdicts — plans a
     # minimal-cost live-migration sequence and drives it with the
@@ -417,7 +424,7 @@ class MasterApp:
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
                              "shards", "recovery", "tenants",
                              "apihealth", "timeline", "capacity",
-                             "defrag", "shares"})
+                             "defrag", "shares", "health_nodes"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -425,8 +432,9 @@ class MasterApp:
     AUDITED_ROUTES = frozenset({
         "add", "remove", "batch_add", "addslice", "removeslice",
         "intent_put", "intent_delete", "migrate_start",
-        "migration_abort", "recovery_evacuate", "defrag_plan",
-        "defrag_run", "defrag_pause", "shares_admit", "shares_release"})
+        "migration_abort", "recovery_evacuate", "health_quarantine",
+        "defrag_plan", "defrag_run", "defrag_pause", "shares_admit",
+        "shares_release"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
@@ -559,6 +567,23 @@ class MasterApp:
             kube, self.registry, self._client_factory, cfg=self.cfg,
             store=self.store, shards=self.shards, elastic=self.elastic,
             migrations=self.migrations, apihealth=self.apihealth)
+        # Gray-failure health plane (gpumounter_tpu/health/): scores
+        # every fleet collection pass for the limping node recovery
+        # cannot see and quarantines it softly. load() restores the
+        # quarantine set a previous master persisted (shard-takeover
+        # continuity). The canary prober loop only runs after an
+        # explicit canary.start() (master/main.py) — tests drive
+        # probe_once() directly. Recovery learns the plane so
+        # quarantined != dead (its evacuation rules are untouched; it
+        # only reports the flag and retires our record on evacuation).
+        from gpumounter_tpu.health import CanaryProber, HealthPlane
+        self.health = HealthPlane(self.cfg, recovery=self.recovery,
+                                  store=self.store)
+        self.health.load()
+        self.fleet.health = self.health
+        self.recovery.health = self.health
+        self.canary = CanaryProber(self.health, self.registry,
+                                   self._client_factory, cfg=self.cfg)
         # ICI defragmenter (gpumounter_tpu/defrag/): plans minimal-cost
         # migration sequences off the capacity plane's fragmentation
         # verdicts and drives them through the migration machine with
@@ -570,7 +595,7 @@ class MasterApp:
         self.defrag = DefragController(
             kube, self.migrations, self.capacity, self.fleet,
             slo=self.slo, apihealth=self.apihealth, shards=self.shards,
-            cfg=self.cfg)
+            cfg=self.cfg, health=self.health)
         # Fractional chip shares (gpumounter_tpu/vchip/): the master's
         # share books plus the co-location admission controller behind
         # GET/POST /shares. The capacity plane gets the registry so
@@ -617,7 +642,7 @@ class MasterApp:
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
                                  "slo", "shards", "recovery", "tenants",
                                  "apihealth", "timeline", "capacity",
-                                 "defrag", "shares"})
+                                 "defrag", "shares", "health_nodes"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -922,6 +947,52 @@ class MasterApp:
         return 200, "application/json", \
             jsonlib.dumps(record, indent=1) + "\n"
 
+    def _route_health_nodes(self, match, body, headers):
+        """The gray-failure plane's pane: per-node quarantine state
+        machine (state / signals / canary streaks / drain
+        recommendation — node names ride HERE, never metric labels),
+        the fleet quarantine budget, and the last scoring pass's
+        verdict. The 'health' step of the RUNBOOK's limping-node
+        walkthrough."""
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.health.payload(), indent=1) + "\n"
+
+    def _route_health_quarantine(self, match, body, headers):
+        """Manual quarantine/release (body {"action": "quarantine" |
+        "release", "reason": ...}; default quarantine). Shard-gated
+        like every per-node mutation. Quarantine is soft — nothing is
+        unmounted — so unlike /recovery/evacuate there is no
+        confirmation window to skip; release REFUSES a node the
+        recovery plane evacuated (resurrection is not a release)."""
+        import json as jsonlib
+        node = match.group("node")
+        self._shard_gate(node, f"/health/quarantine/{node}")
+        try:
+            req = jsonlib.loads(body.decode() or "{}")
+        except ValueError:
+            raise _HttpError(400, "body is not valid JSON")
+        if not isinstance(req, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        action = str(req.get("action") or "quarantine")
+        actor = headers.get("x-tpumounter-actor", "http")
+        try:
+            if action == "quarantine":
+                pane = self.health.quarantine(
+                    node, reason=str(req.get("reason") or ""),
+                    actor=actor)
+            elif action == "release":
+                pane = self.health.release(node, actor=actor)
+            else:
+                raise _HttpError(
+                    400, f"unknown action {action!r} "
+                         "(quarantine or release)")
+        except ValueError as exc:
+            raise _HttpError(409, str(exc))
+        return 200, "application/json", \
+            jsonlib.dumps({"node": node, "action": action,
+                           "health": pane}, indent=1) + "\n"
+
     def _route_defrag(self, match, body, headers):
         """The defragmenter's state pane: gate verdicts (ApiHealth +
         SLO burn), the adopted plan, the in-flight run with its barrier
@@ -1041,7 +1112,13 @@ class MasterApp:
                 str(payload.get("profile", "balanced")), chips, weight,
                 rate_budget=rate_budget, inventory=inventory,
                 blocked_hosts=self.capacity.blocked_hosts(
-                    max_age_s=self.cfg.fleet_scrape_interval_s))
+                    max_age_s=self.cfg.fleet_scrape_interval_s),
+                # Quarantined hosts are a HARD exclusion (unlike the
+                # defragmenter's last-resort blocked_hosts): no new
+                # work lands on a limping node. Probation hosts stay
+                # placeable but rank last.
+                excluded_hosts=self.health.excluded_hosts(),
+                probation_hosts=self.health.probation_hosts())
         except (PackRefused, ShareLimitError) as exc:
             # Typed admission refusals carry their own story; 409 tells
             # scripted callers "the fleet, not your request, said no".

@@ -68,6 +68,16 @@ def main() -> None:
         logger.info("defragmenter on (interval %.0fs, target block %d, "
                     "max %d moves/plan)", cfg.defrag_interval_s,
                     cfg.defrag_target_block, cfg.defrag_max_moves)
+    # Canary prober: active gray-failure probes (synthetic mount ->
+    # verify -> unmount) against suspect/quarantined nodes. The passive
+    # scorer rides the fleet collect pass and needs no thread of its
+    # own; quarantine state was already reloaded from the store seam in
+    # MasterApp.__init__, so a takeover keeps the set.
+    if cfg.health_enabled and cfg.health_canary_interval_s > 0:
+        app.canary.start()
+        logger.info("health plane on (canary every %.0fs, quarantine "
+                    "budget %.0f%%)", cfg.health_canary_interval_s,
+                    cfg.health_quarantine_budget * 100)
     # Fleet telemetry poll loop: federate every worker's telemetry each
     # FLEET_SCRAPE_INTERVAL_S and evaluate the SLO burn rates (breaches
     # emit k8s Events + audit records). Restart-safe: workers report
@@ -91,6 +101,7 @@ def main() -> None:
     finally:
         if cfg.defrag_enabled:
             app.defrag.stop()
+        app.canary.stop()
         app.recovery.stop()
         app.fleet.stop()
         app.elastic.stop()

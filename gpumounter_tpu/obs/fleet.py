@@ -415,6 +415,12 @@ class FleetCollector:
         #: payload from the same node entries /fleet serves — so the
         #: two panes can never disagree about what was collected.
         self.capacity = None
+        #: optional HealthPlane (health/plane.py): scores every
+        #: collection pass for gray failures (per-node p95 vs fleet
+        #: median, error ratios, breaker + canary evidence) and drives
+        #: the quarantine state machine. Same observer contract as the
+        #: capacity plane: exception-isolated, fail-open.
+        self.health = None
         self.interval_s = cfg.fleet_scrape_interval_s
         #: per-node collection fan-out width: a few wedged workers each
         #: burn their full RPC deadline, so a serial pass would stall
@@ -468,9 +474,14 @@ class FleetCollector:
         entry = {"address": address, "collected_at": round(time.time(), 3)}
         snapshot = None
         mode = "rpc"
+        quarantined = (self.health is not None
+                       and node in self.health.excluded_hosts())
         try:
             with self.client_factory(address) as client:
-                resp = client.collect_telemetry()
+                # kwarg only when set: absent means not-quarantined on
+                # the wire, and plain stubs/legacy clients keep working.
+                resp = (client.collect_telemetry(quarantined=True)
+                        if quarantined else client.collect_telemetry())
             snapshot = parse_telemetry(resp.telemetry)
             if snapshot is None:
                 logger.warning(
@@ -553,6 +564,16 @@ class FleetCollector:
                 except Exception:  # noqa: BLE001 — capacity is an
                     # observer; its bugs must not fail telemetry
                     logger.exception("capacity observation failed")
+            if self.health is not None:
+                # After capacity, before the rollup: the gray-failure
+                # scorer reads the same per-pass node entries, so the
+                # /health/nodes pane can never disagree with /fleet
+                # about what was collected.
+                try:
+                    self.health.observe(fresh)
+                except Exception:  # noqa: BLE001 — same observer
+                    # contract as capacity: never fail telemetry
+                    logger.exception("health observation failed")
             FLEET_NODES.set(float(len(fresh)))
             FLEET_COLLECT_DURATION.observe(time.monotonic() - t0)
             rollup = self.payload(max_age_s=None)

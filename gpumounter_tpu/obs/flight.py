@@ -45,12 +45,12 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 FLIGHT_RECORDS = REGISTRY.counter(
     "tpumounter_flight_records_total",
     "Flight-recorder timeline records by kind (span / audit / event / "
-    "apihealth / recovery / marker)")
+    "apihealth / recovery / health / marker)")
 
 #: the bounded record-kind vocabulary (the `kind` label rides on
 #: FLIGHT_RECORDS; anything else is folded to "marker").
 KINDS = frozenset({"span", "audit", "event", "apihealth", "recovery",
-                   "marker"})
+                   "health", "marker"})
 
 
 class FlightRecorder:

@@ -111,6 +111,16 @@ class RecoveryController:
         self.shards = shards
         self.elastic = elastic
         self.migrations = migrations
+        #: optional HealthPlane (health/plane.py), set by MasterApp.
+        #: Quarantined != dead: this controller NEVER consumes the
+        #: quarantine verdict as death evidence — a quarantined node is
+        #: probed under exactly the same positive-corroboration rules
+        #: as any other, so a limping node is never evacuated and a
+        #: quarantined node that then dies is evacuated normally. The
+        #: reference is only used the other way: evacuation retires the
+        #: health plane's record (the hard verdict supersedes the soft
+        #: one) and the payload reports the flag for operators.
+        self.health = None
         self._lock = OrderedLock("recovery.state")
         #: node -> {"status": healthy|suspect|evacuated,
         #:          "failures": int, "first_failure_at": monotonic,
@@ -392,6 +402,14 @@ class RecoveryController:
         NODES_EVACUATED.inc()
         EVACUATED_BOOKINGS.inc(float(len(released)))
         EVACUATED_INTENTS.inc(float(len(intents)))
+        if self.health is not None:
+            # Evacuation supersedes quarantine: retire the health
+            # plane's record so the scorer stops reasoning about a
+            # corpse and `release` can refuse resurrection.
+            try:
+                self.health.note_evacuated(node)
+            except Exception:  # noqa: BLE001 — advisory cross-plane
+                logger.exception("health note_evacuated failed")
         logger.warning(
             "node %s EVACUATED (%s): released %d booking(s), re-drove "
             "%d intent(s) + %d migration journal(s)", node, reason,
@@ -511,13 +529,27 @@ class RecoveryController:
 
     # --- the /recovery payload ---
 
+    def is_evacuated(self, node: str) -> bool:
+        """Whether this controller evacuated `node` (and it has not
+        come back alive since). The health plane's `release` refuses
+        such nodes — a release cannot resurrect the dead."""
+        with self._lock:
+            entry = self._nodes.get(node)
+            return bool(entry and entry.get("status") == "evacuated")
+
     def payload(self) -> dict:
+        quarantined = frozenset()
+        if self.health is not None:
+            quarantined = self.health.excluded_hosts()  # never raises
         with self._lock:
             nodes = {
                 node: {
                     "status": entry.get("status", "healthy"),
                     "consecutiveFailures": entry.get("failures", 0),
                     "reason": entry.get("reason", ""),
+                    # advisory cross-plane flag: quarantined != dead —
+                    # this controller never consumes it as evidence.
+                    "quarantined": node in quarantined,
                 }
                 for node, entry in sorted(self._nodes.items())}
             evacuations = list(self._evacuations)

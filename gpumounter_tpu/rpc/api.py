@@ -209,6 +209,13 @@ class CollectTelemetryResult(enum.IntEnum):
 class CollectTelemetryRequest(Message):
     FIELDS = [
         Field(1, "trace_context", "string"),
+        # Health-plane verdict for the dialed node, carried on the
+        # collector's pull so the worker needs no extra RPC to learn
+        # it: while true the worker drains its warm holder pods and
+        # pauses refill (a quarantined node must not bank standby
+        # capacity nobody may adopt). Absent/false (older masters)
+        # means not quarantined — fail open.
+        Field(2, "quarantined", "bool"),
     ]
 
 

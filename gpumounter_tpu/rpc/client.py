@@ -486,12 +486,16 @@ class WorkerClient:
         return api.QuiesceStatusResult(resp.quiesce_status_result), resp
 
     def collect_telemetry(self, timeout_s: float | None = None,
+                          quarantined: bool = False,
                           ) -> "api.CollectTelemetryResponse":
         """One worker's telemetry snapshot (raw response; the JSON in
         .telemetry parses via obs.fleet.parse_telemetry). Read-only —
-        safe to retry like Probe/Quiesce."""
+        safe to retry like Probe/Quiesce. `quarantined` piggybacks the
+        master's health verdict for this node (the worker drains its
+        warm pool while flagged; see health/plane.py)."""
         return self._call("CollectTelemetry", self._telemetry,
-                          api.CollectTelemetryRequest(), timeout_s)
+                          api.CollectTelemetryRequest(
+                              quarantined=bool(quarantined)), timeout_s)
 
     def probe_tpu(self, pod_name: str, namespace: str,
                   timeout_s: float | None = None,

@@ -94,6 +94,21 @@ class MasterStore(abc.ABC):
         node — the bookings an evacuation must release. Default: none."""
         return []
 
+    # --- health plane (quarantine-set takeover continuity) ---
+
+    def load_health_state(self) -> dict | None:
+        """The quarantine set a previous master persisted ({"version",
+        "nodes": {node: {...}}}), or None. A shard takeover restores it
+        so a master crash does not silently un-quarantine a limping
+        node. Default: nothing persisted (non-cluster backends) — the
+        health plane then rebuilds from live telemetry, fail-open."""
+        return None
+
+    def save_health_state(self, state: dict) -> None:
+        """Persist the quarantine set (best-effort; the in-memory state
+        machine stays authoritative for the running master). Default:
+        no-op."""
+
     # --- raw annotation stamps (phase/ack/lock markers) ---
 
     @abc.abstractmethod

@@ -208,6 +208,18 @@ class CachedMasterStore(MasterStore):
             src["namespace"], src["pod"], ANNOT_JOURNAL, dump(journal),
             self.inner.save_journal, journal)
 
+    # --- health plane ---
+
+    def load_health_state(self):
+        # Never cached: read once at startup/takeover; a stale
+        # quarantine set is worse than none (the plane fails open).
+        return self.inner.load_health_state()
+
+    def save_health_state(self, state: dict) -> None:
+        # Best-effort by contract (the in-memory machine stays
+        # authoritative); the inner store already bounds its retries.
+        return self.inner.save_health_state(state)
+
     # --- reconnect flush ---
 
     def _on_health_transition(self, old: str, new: str) -> None:

@@ -130,6 +130,77 @@ class KubeMasterStore(MasterStore):
             self.cfg.pool_namespace,
             field_selector=f"spec.nodeName={node_name}")
 
+    # --- health plane (quarantine-set takeover continuity) ---
+
+    #: The quarantine set lives on a Lease object (pods come and go with
+    #: the nodes being quarantined; a Lease is the one durable,
+    #: annotation-capable object the client API already supports).
+    HEALTH_LEASE = "tpumounter-health"
+    ANNOT_HEALTH = "tpumounter.io/health-state"
+
+    def load_health_state(self) -> dict | None:
+        import json as jsonlib
+
+        from gpumounter_tpu.k8s.errors import NotFoundError
+        try:
+            lease = self.kube.get_lease(self.cfg.worker_namespace,
+                                        self.HEALTH_LEASE)
+        except NotFoundError:
+            return None
+        except Exception as exc:  # noqa: BLE001 — fail open: the plane
+            # rebuilds from live telemetry rather than blocking startup
+            logger.warning("health-state read failed: %s",
+                           classify_exception(exc))
+            return None
+        raw = (lease.get("metadata", {}).get("annotations")
+               or {}).get(self.ANNOT_HEALTH)
+        if not raw:
+            return None
+        try:
+            state = jsonlib.loads(raw)
+        except ValueError:
+            logger.warning("health-state annotation is malformed; "
+                           "ignoring")
+            return None
+        return state if isinstance(state, dict) else None
+
+    def save_health_state(self, state: dict) -> None:
+        import json as jsonlib
+
+        from gpumounter_tpu.k8s.errors import ConflictError, NotFoundError
+        payload = jsonlib.dumps(state, sort_keys=True)
+        namespace = self.cfg.worker_namespace
+        for _attempt in range(max(1, int(self.cfg.k8s_write_attempts))):
+            try:
+                lease = self.kube.get_lease(namespace, self.HEALTH_LEASE)
+            except NotFoundError:
+                manifest = {
+                    "metadata": {"name": self.HEALTH_LEASE,
+                                 "namespace": namespace,
+                                 "annotations": {
+                                     self.ANNOT_HEALTH: payload}},
+                    "spec": {},
+                }
+                try:
+                    self.kube.create_lease(namespace, manifest)
+                    return
+                except ConflictError:
+                    continue  # another replica created it; re-read
+            meta = lease.setdefault("metadata", {})
+            meta.setdefault("annotations", {})[self.ANNOT_HEALTH] = payload
+            try:
+                # resourceVersion rides along from the GET: CAS update,
+                # so two replicas interleaving never silently clobber.
+                self.kube.update_lease(namespace, self.HEALTH_LEASE,
+                                       lease)
+                return
+            except ConflictError:
+                continue
+            except NotFoundError:
+                continue  # deleted between GET and PUT; recreate
+        logger.warning("health-state write did not land after %d "
+                       "attempts", self.cfg.k8s_write_attempts)
+
     # --- raw annotation stamps ---
 
     def stamp_annotation(self, namespace: str, pod_name: str,

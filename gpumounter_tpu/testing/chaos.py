@@ -104,6 +104,18 @@ invariants after convergence:
      (disable_enforcement: the engine flipped to pure bookkeeping)
      must be DETECTED as decision divergence.
 
+ 20. gray-failure attribution closure (run_gray_scenario): every
+     automatic quarantine the health plane committed is trace-attributed
+     in the flight recorder to at least one concrete scoring signal
+     (mount_p95_outlier / mount_error_ratio / canary_failures /
+     breaker_open — never a shrug), no node outside the deliberately
+     degraded set is ever quarantined (zero false positives: a healthy
+     fleet driven through the same scenario must end with an empty
+     quarantine set), and every deliberately degraded node IS
+     quarantined by the end — which makes the negative control
+     (disable_scorer: the plane switched off while the node limps)
+     DETECTED as a missed detection,
+
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
 InvariantViolation message so a failing run reproduces from its seed.
@@ -316,7 +328,15 @@ class ChaosHarness:
             writebehind_dir=os.path.join(root, "writebehind"),
             # High threshold: chaos injects isolated transport faults by
             # design; the breaker's own behavior has dedicated tests.
-            breaker_failure_threshold=50)
+            breaker_failure_threshold=50,
+            # Health plane OFF by default: the harness runs every fake
+            # node in ONE process, so the global metrics registry folds
+            # all nodes' mount stats together — fleet-wide error ratios
+            # from injected faults would read as per-node signals and
+            # quarantine an innocent node mid-scenario.
+            # run_gray_scenario re-enables it with per-node entries the
+            # harness measures itself (see _gray_entries).
+            health_enabled=False)
         self.services: dict[str, TpuMountService] = {}
         self._servers: dict[str, object] = {}   # node -> live gRPC server
         self._ip_by_node: dict[str, str] = {}
@@ -348,6 +368,11 @@ class ChaosHarness:
         #: asserts invariant 19 (fractional-share agreement + throttle
         #: decision parity).
         self.vchip_armed = False
+        #: run_gray_scenario arms this so check_invariants also asserts
+        #: invariant 20 (gray-failure attribution closure); gray_nodes
+        #: is the set of nodes the scenario deliberately degraded.
+        self.gray_armed = False
+        self.gray_nodes: set[str] = set()
         self.app: MasterApp | None = None
 
     # --- lifecycle ---
@@ -402,6 +427,10 @@ class ChaosHarness:
         # (open spans, audit records) must judge THIS run only.
         trace.TRACER.reset()
         AUDIT.reset()
+        # Fresh flight recorder: invariant 20 audits THIS run's health
+        # transitions only.
+        from gpumounter_tpu.obs.flight import FLIGHT
+        FLIGHT.reset()
         # Fresh per-endpoint ApiHealth machines: a previous scenario's
         # outage verdict must not park this run's subsystems (the
         # master, workers and store all share the process-global
@@ -1033,6 +1062,131 @@ class ChaosHarness:
         from gpumounter_tpu.cgroup.policy import POLICY_ENGINE
         POLICY_ENGINE.enforce = False
         self.record("negative control: policy enforcement disabled")
+
+    # --- invariant 20: gray failure -> scoring -> quarantine ---
+
+    #: probabilistic degradation armed ONLY while operating against the
+    #: limping node: a gray failure is intermittent slowness, not an
+    #: outage — deterministic delay() would make every call slow (a
+    #: liveness failure the recovery controller already catches); these
+    #: draws come from the seeded failpoint RNG, so the limp reproduces.
+    GRAY_FAULTS = [
+        ("worker.mount.mknod", "pdelay([0.9, 0.2])"),
+        ("worker.rpc", "pdelay([0.5, 0.06])"),
+        ("rpc.client.call", "pdrop(0.2)"),
+    ]
+
+    def run_gray_scenario(self, limping: tuple = (NODE_B,),
+                          n_rounds: int = 4, mounts_per_round: int = 3,
+                          disable_scorer: bool = False) -> dict:
+        """Drive real mount/unmount traffic through the full worker path
+        on every node, with probabilistic degradation (GRAY_FAULTS)
+        armed only around the limping nodes' operations, and feed the
+        REAL health plane per-node scoring passes built from the
+        harness's own wall-clock measurements of those operations.
+
+        The harness must measure per-node latency itself because every
+        fake node shares one process — and therefore one global metrics
+        registry, which folds all nodes' mount histograms together. In
+        production each worker is its own process and CollectTelemetry
+        returns genuinely per-node stats; the measured numbers here are
+        the same real operations, bucketed by the node that served them.
+
+        Needs >= 4 nodes (3-node healthy herd) so the fleet median is a
+        healthy number the outlier bar can stand on.
+
+        disable_scorer=False: the limping node must end quarantined and
+        check_invariants() proves the attribution trail (invariant 20).
+        disable_scorer=True is the NEGATIVE CONTROL: the plane is
+        switched off while the node limps, the quarantine never
+        happens, and invariant 20 must DETECT the missed detection.
+
+        Returns {"states": final pane per node, "passes": scoring
+        passes driven}."""
+        if len(self.services) < 4:
+            raise ValueError(
+                "run_gray_scenario needs a >=4-node cluster "
+                "(3-node healthy herd for the fleet median); build the "
+                "harness with nodes={...4 entries...}")
+        failpoints.seed(self.seed)
+        self.gray_armed = True
+        self.gray_nodes.update(limping)
+        # Fast-hysteresis health knobs at test speed; the plane stays
+        # OFF for the negative control (its observe() is a no-op, the
+        # exact failure mode of a disabled/broken scorer).
+        self.app.health.cfg = self.cfg.replace(
+            health_enabled=not disable_scorer,
+            health_min_samples=3,
+            health_p95_multiplier=3.0,
+            health_p95_floor_ms=20.0,
+            health_suspect_strikes=2,
+            health_quarantine_strikes=3,
+            health_clear_passes=2)
+        if disable_scorer:
+            self.record("negative control: health scorer disabled")
+        pods_by_node: dict[str, tuple[str, str]] = {}
+        for i, node in enumerate(sorted(self.services)):
+            name = f"gf-{i}"
+            self.add_pod(name, node)
+            pods_by_node[node] = ("default", name)
+        samples: dict[str, list[float]] = {n: [] for n in pods_by_node}
+        errors: dict[str, int] = {n: 0 for n in pods_by_node}
+        passes = 0
+        for _round in range(n_rounds):
+            for node, (ns, name) in sorted(pods_by_node.items()):
+                for _ in range(mounts_per_round):
+                    if node in limping:
+                        for site, action in self.GRAY_FAULTS:
+                            failpoints.arm(site, action)
+                    started = time.monotonic()
+                    ok = False
+                    try:
+                        with self._client_for_node(node) as client:
+                            result, uuids = client.add_tpu_detailed(
+                                name, ns, 1)
+                        ok = result.name == "Success"
+                        if ok and uuids:
+                            with self._client_for_node(node) as client:
+                                client.remove_tpu(name, ns, list(uuids),
+                                                  force=True)
+                    except Exception as exc:  # noqa: BLE001 — the limp
+                        self.record(f"gray mount on {node} -> "
+                                    f"{type(exc).__name__}")
+                    finally:
+                        failpoints.disarm_all()
+                    samples[node].append(
+                        (time.monotonic() - started) * 1000.0)
+                    if not ok:
+                        errors[node] += 1
+            self.app.health.observe(self._gray_entries(samples, errors))
+            passes += 1
+            states = {n: p["state"] for n, p in
+                      self.app.health.payload()["nodes"].items()}
+            self.record(f"gray pass {passes}: {states}")
+        self.converge()
+        return {"states": {n: p["state"] for n, p in
+                           self.app.health.payload()["nodes"].items()},
+                "passes": passes}
+
+    def _gray_entries(self, samples: dict[str, list[float]],
+                      errors: dict[str, int]) -> dict[str, dict]:
+        """Per-node CollectTelemetry-shaped entries from the harness's
+        own measurements (see run_gray_scenario for why)."""
+        entries: dict[str, dict] = {}
+        for node, vals in samples.items():
+            if node in self.dead_nodes:
+                continue
+            ordered = sorted(vals)
+            p95 = (ordered[min(len(ordered) - 1,
+                               int(0.95 * len(ordered)))]
+                   if ordered else None)
+            entries[node] = {
+                "mount": {"count": len(vals), "p95_ms": p95,
+                          "success": len(vals) - errors[node],
+                          "error": errors[node]},
+                "breaker": "closed",
+            }
+        return entries
 
     # --- invariant 11: node kill -> evacuation -> re-convergence ---
 
@@ -1925,6 +2079,50 @@ class ChaosHarness:
                             f"key {key:#x}: entry (weight, metered) "
                             f"{got} not among booked {expected[key]}")
             violations.extend(self._throttle_agreement(books))
+
+        # 20. gray-failure attribution closure (armed by
+        # run_gray_scenario): every automatic quarantine the health
+        # plane committed is flight-recorded with at least one concrete
+        # scoring signal, no node outside the deliberately degraded set
+        # was ever quarantined, and every degraded node ended
+        # quarantined — a disabled scorer (the negative control) reads
+        # as a missed detection here.
+        if self.gray_armed:
+            from gpumounter_tpu.obs.flight import FLIGHT
+            panes = self.app.health.payload()["nodes"]
+            quarantined_now = {
+                n for n, p in panes.items()
+                if p["state"] == "quarantined" and not p["evacuated"]}
+            for rec in FLIGHT.snapshot():
+                if rec.get("kind") != "health":
+                    continue
+                det = rec.get("details") or {}
+                if det.get("to_state") != "quarantined" \
+                        or det.get("from_state") == "quarantined":
+                    continue
+                node = rec.get("node")
+                if not det.get("signals"):
+                    violations.append(
+                        f"quarantine of {node} carries no concrete "
+                        f"signal in the flight record (unattributed "
+                        f"quarantine): {rec.get('summary')}")
+                if node not in self.gray_nodes:
+                    violations.append(
+                        f"false quarantine: {node} was quarantined but "
+                        f"no gray fault was armed on it "
+                        f"(signals: {det.get('signals')})")
+            if quarantined_now - self.gray_nodes:
+                violations.append(
+                    f"false quarantine set: "
+                    f"{sorted(quarantined_now - self.gray_nodes)} "
+                    f"quarantined without an armed gray fault")
+            for node in sorted(self.gray_nodes):
+                if node not in quarantined_now:
+                    violations.append(
+                        f"gray failure NOT detected: {node} limped "
+                        f"through the whole scenario but ended "
+                        f"{panes.get(node, {}).get('state', 'untracked')!r}"
+                        f" instead of quarantined")
 
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()

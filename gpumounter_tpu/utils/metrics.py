@@ -30,6 +30,7 @@ from gpumounter_tpu.utils.locks import OrderedLock
 #: must say what bounds its value domain.
 ALLOWED_LABEL_KEYS = frozenset({
     "endpoint",   # k8s API endpoint (bounded by the client surface)
+    "from_state",  # quarantine transition source (health STATES, 4 values)
     "kind",       # record/read kind (bounded enums per subsystem)
     "method",     # RPC method name (bounded by the proto surface)
     "name",       # failpoint site name (bounded by faults/registry.py)
@@ -40,6 +41,9 @@ ALLOWED_LABEL_KEYS = frozenset({
     "reason",     # failure-reason enum
     "result",     # success/error result enum
     "state",      # health-state enum
+    "to_state",   # quarantine transition target (health STATES, 4 values;
+                  # with from_state ≤16 series — test_metrics_cardinality
+                  # budgets the plane)
     "window",     # SLO burn window (bounded by config)
     "worker",     # worker address (budgeted: fleet-scoped series only)
 })

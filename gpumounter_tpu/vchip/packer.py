@@ -54,6 +54,8 @@ class SharePacker:
               weight: int, rate_budget: int = 0,
               inventory: dict[str, str] | None = None,
               blocked_hosts: frozenset[str] | set[str] = frozenset(),
+              excluded_hosts: frozenset[str] | set[str] = frozenset(),
+              probation_hosts: frozenset[str] | set[str] = frozenset(),
               ) -> list[Share]:
         """Book `chips` fractional shares for tenant namespace/pod.
 
@@ -61,6 +63,10 @@ class SharePacker:
         place on (free chips plus already-shared ones); the packer
         never invents chips. blocked_hosts: hosts the defragmenter
         needs quiet — free chips there are last-resort only.
+        excluded_hosts: a HARD exclusion (health-plane quarantine) —
+        chips there are never candidates, even when refusal is the
+        alternative. probation_hosts: placeable but deprioritized
+        (rehabilitating nodes rank after every equivalent candidate).
 
         Returns the booked shares (the caller turns each into a policy
         map entry). All-or-nothing: a refusal books nothing.
@@ -79,8 +85,8 @@ class SharePacker:
         held = {s.chip_uuid for s in self.registry.by_tenant(namespace, pod)}
         want = COMPLEMENTS.get(profile)
 
-        complementary: list[tuple[int, str]] = []
-        other_shared: list[tuple[int, str]] = []
+        complementary: list[tuple[int, int, str]] = []
+        other_shared: list[tuple[int, int, str]] = []
         for uuid, holders in shared.items():
             if uuid in held:
                 continue  # re-grants go through admit on the same chip
@@ -88,30 +94,41 @@ class SharePacker:
             if load + weight > capacity:
                 continue
             node = holders[0].node
+            if node in excluded_hosts:
+                continue  # quarantined: never a candidate
             if uuid not in inventory:
                 inventory[uuid] = node
             profiles = {s.profile for s in holders}
-            # tightest-packed first: sort key is -load
+            # probation last within its class, then tightest-packed
+            # first (sort key -load)
+            penalty = 1 if node in probation_hosts else 0
             if want is not None and want in profiles \
                     and profile not in profiles:
-                complementary.append((-load, uuid))
+                complementary.append((penalty, -load, uuid))
             else:
-                other_shared.append((-load, uuid))
+                other_shared.append((penalty, -load, uuid))
         taken = set(held) | set(shared)
-        free_clear = sorted(u for u, node in inventory.items()
-                            if u not in taken and node not in blocked_hosts)
-        free_blocked = sorted(u for u, node in inventory.items()
+        placeable = {u: node for u, node in inventory.items()
+                     if node not in excluded_hosts}
+        free_clear = sorted(u for u, node in placeable.items()
+                            if u not in taken and node not in blocked_hosts
+                            and node not in probation_hosts)
+        free_probation = sorted(u for u, node in placeable.items()
+                                if u not in taken
+                                and node not in blocked_hosts
+                                and node in probation_hosts)
+        free_blocked = sorted(u for u, node in placeable.items()
                               if u not in taken and node in blocked_hosts)
 
-        ranked = ([u for _, u in sorted(complementary)]
-                  + [u for _, u in sorted(other_shared)]
-                  + free_clear + free_blocked)
+        ranked = ([u for *_, u in sorted(complementary)]
+                  + [u for *_, u in sorted(other_shared)]
+                  + free_clear + free_probation + free_blocked)
         if len(ranked) < chips:
             raise PackRefused(
                 f"need {chips} chip(s) with weight headroom {weight}, "
                 f"only {len(ranked)} available "
                 f"(shared with room: {len(complementary) + len(other_shared)}, "
-                f"free: {len(free_clear) + len(free_blocked)})")
+                f"free: {len(free_clear) + len(free_probation) + len(free_blocked)})")
         chosen = ranked[:chips]
         booked: list[Share] = []
         try:

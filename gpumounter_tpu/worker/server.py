@@ -572,6 +572,18 @@ class TpuMountService:
         with trace.span("worker.CollectTelemetry",
                         wire_parent=request.trace_context):
             failpoints.fire("worker.rpc", method="CollectTelemetry")
+            # The master's health-plane verdict rides the pull: while
+            # this node is quarantined its warm holders drain and the
+            # refiller pauses (health/plane.py — a quarantined node
+            # must not bank standby capacity). Fail-open: an older
+            # master never sets the field, so nothing drains.
+            if self.pool is not None and self.cfg.node_name:
+                try:
+                    self.pool.set_drained(self.cfg.node_name,
+                                          bool(request.quarantined))
+                except Exception:  # noqa: BLE001 — the drain is a side
+                    # effect; it must not fail the telemetry answer
+                    logger.exception("warm-pool drain toggle failed")
             snapshot = worker_telemetry_snapshot(cfg=self.cfg)
             # Per-host chip inventory (free/held/warm/fenced with
             # indices) for the master's capacity plane. Attached HERE —

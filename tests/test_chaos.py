@@ -272,3 +272,47 @@ def test_share_chaos_detects_disabled_enforcement(tmp_path):
             h.check_invariants()
         assert "throttle divergence" in str(err.value)
         assert "seed=7" in str(err.value)
+
+
+# --- invariant 20: gray failure -> scoring -> quarantine (ISSUE 18) ---
+
+#: 4 nodes: a 3-node healthy herd keeps the fleet median honest while
+#: one node limps.
+GRAY_NODES = {NODE_A: 4, "chaos-b": 4, "chaos-c": 4, "chaos-d": 4}
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_gray_failure_chaos(tmp_path, seed):
+    """One node limps under seeded probabilistic degradation (pdelay on
+    the mounter's mknod + the worker RPC entry, pdrop on the client
+    call) while the rest of the fleet serves clean traffic; the health
+    plane's scorer must quarantine exactly that node, and invariant 20
+    proves every quarantine is flight-attributed to a concrete signal
+    with zero false positives."""
+    with ChaosHarness(str(tmp_path), seed, nodes=dict(GRAY_NODES)) as h:
+        out = h.run_gray_scenario()
+        h.check_invariants()
+        assert out["states"]["chaos-b"] == "quarantined"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_gray_chaos_healthy_fleet_no_false_quarantine(tmp_path, seed):
+    """Zero-false-positive control: the same scenario with NO node
+    degraded must end with an empty quarantine set on every seed."""
+    with ChaosHarness(str(tmp_path), seed, nodes=dict(GRAY_NODES)) as h:
+        out = h.run_gray_scenario(limping=(), n_rounds=3)
+        h.check_invariants()
+        assert all(s != "quarantined" for s in out["states"].values()), \
+            out["states"]
+
+
+def test_gray_chaos_detects_disabled_scorer(tmp_path):
+    """NEGATIVE CONTROL: with the scorer switched off the limping node
+    is never quarantined — invariant 20 must flag the missed detection
+    (a chaos suite that cannot fail proves nothing)."""
+    with ChaosHarness(str(tmp_path), seed=7, nodes=dict(GRAY_NODES)) as h:
+        h.run_gray_scenario(disable_scorer=True)
+        with pytest.raises(InvariantViolation) as err:
+            h.check_invariants()
+        assert "gray failure NOT detected" in str(err.value)
+        assert "seed=7" in str(err.value)

@@ -1,0 +1,709 @@
+"""Gray-failure detection and node quarantine plane (ISSUE 18).
+
+Unit half: the passive scorer's signals (p95 outlier vs fleet median,
+error ratio, breaker state) with the minimum-evidence floors, the
+hysteresis state machine (healthy -> suspect -> quarantined ->
+rehabilitating -> healthy) with the fleet quarantine budget and its
+manual-operator exemption, the breaker/canary dedupe regression, the
+fail-open staleness skip, the canary prober's target selection and
+rehab gating, and the Lease-backed persistence that carries the
+quarantine set across a master restart / shard takeover.
+
+Consumer half: the /health routes (read pane + audited manual verb),
+the warm pool's quarantine drain, the SharePacker's hard exclusion and
+probation deprioritization, the defrag planner's non-destinations, and
+the probabilistic failpoints (pdelay/pdrop) the gray chaos scenario is
+built on.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.health import CanaryProber, HealthPlane
+from gpumounter_tpu.health.plane import BUDGET_DENIALS, SCORER_SKIPS
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.obs.flight import FLIGHT
+from gpumounter_tpu.store import KubeMasterStore
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def _cfg(**over):
+    base = dict(health_enabled=True,
+                health_min_samples=3,
+                health_p95_multiplier=3.0,
+                health_p95_floor_ms=20.0,
+                health_error_ratio=0.25,
+                health_suspect_strikes=2,
+                health_quarantine_strikes=3,
+                health_clear_passes=2,
+                health_rehab_canary_passes=2,
+                health_probation_passes=2,
+                health_drain_burn_passes=2,
+                health_quarantine_budget=0.10,
+                health_min_fresh_fraction=0.5)
+    base.update(over)
+    return Config().replace(**base)
+
+
+def _entry(p95=10.0, count=10, success=10, error=0, breaker="closed",
+           **extra):
+    e = {"mount": {"count": count, "p95_ms": p95, "success": success,
+                   "error": error},
+         "breaker": breaker}
+    e.update(extra)
+    return e
+
+
+def _fleet(special=None, herd=3):
+    """`herd` healthy nodes (p95 10ms) plus the special entries — the
+    honest-median herd every outlier test needs."""
+    nodes = {f"h-{i}": _entry() for i in range(herd)}
+    nodes.update(special or {})
+    return nodes
+
+
+def _counter(metric, **labels) -> float:
+    key = tuple(sorted(labels.items())) if labels else ()
+    return metric._values.get(key, 0.0)
+
+
+def _state(plane, node):
+    return plane.payload()["nodes"][node]["state"]
+
+
+# --- the passive scorer's signals ---
+
+
+def test_p95_outlier_drives_suspect_then_quarantine():
+    """median 10ms, multiplier 3, floor 20 -> bar 30ms; a 200ms node is
+    the limping outlier. 2 strikes -> suspect, 3 -> quarantined, and
+    the flight record carries the concrete evidence."""
+    plane = HealthPlane(_cfg())
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "healthy"   # one strike is noise
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "suspect"
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "quarantined"
+    assert plane.is_quarantined("limpy")
+    assert plane.excluded_hosts() == frozenset({"limpy"})
+
+    pane = plane.payload()
+    assert pane["last_pass"]["verdict"] == "scoring"
+    assert pane["last_pass"]["median_p95_ms"] == 10.0
+    assert any(s.startswith("mount_p95_outlier")
+               for s in pane["nodes"]["limpy"]["signals"])
+    recs = [r for r in FLIGHT.snapshot()
+            if r["kind"] == "health" and r.get("node") == "limpy"
+            and r["details"]["to_state"] == "quarantined"]
+    assert recs and recs[-1]["details"]["signals"]
+
+
+def test_outlier_needs_min_samples():
+    """Two slow mounts are noise, not evidence: below health_min_samples
+    neither the p95 nor the error-ratio signal may fire."""
+    plane = HealthPlane(_cfg())
+    slow = _entry(500.0, count=2, success=1, error=1)
+    for _ in range(6):
+        plane.observe(_fleet({"limpy": slow}))
+    assert _state(plane, "limpy") == "healthy"
+
+
+def test_outlier_needs_a_herd():
+    """An outlier needs a fleet median to be an outlier OF: with fewer
+    than two sample-bearing nodes the p95 signal is disabled."""
+    plane = HealthPlane(_cfg())
+    nodes = {"h-0": _entry(count=0, success=0),
+             "h-1": _entry(count=0, success=0),
+             "limpy": _entry(500.0)}
+    for _ in range(6):
+        plane.observe(nodes)
+    assert _state(plane, "limpy") == "healthy"
+
+
+def test_error_ratio_signal():
+    plane = HealthPlane(_cfg())
+    flaky = _entry(10.0, success=5, error=5)   # 50% >= 25%
+    for _ in range(3):
+        plane.observe(_fleet({"flaky": flaky}))
+    pane = plane.payload()["nodes"]["flaky"]
+    assert pane["state"] == "quarantined"
+    assert any(s.startswith("mount_error_ratio") for s in pane["signals"])
+
+
+def test_single_bad_pass_clears_back_to_zero():
+    """Hysteresis forgiveness: one strike followed by clear passes
+    resets the counter — the node never demotes."""
+    plane = HealthPlane(_cfg())
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    for _ in range(2):
+        plane.observe(_fleet({"limpy": _entry()}))
+    plane.observe(_fleet({"limpy": _entry(200.0)}))   # strike 1 again
+    assert _state(plane, "limpy") == "healthy"
+
+
+def test_full_cycle_through_probation_without_canary():
+    """No prober running (canary_active False): rehab falls back to
+    consecutive clean passive passes, then probation passes, then
+    healthy — and the node is placement-deprioritized in between."""
+    plane = HealthPlane(_cfg())
+    for _ in range(3):
+        plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "quarantined"
+    plane.observe(_fleet({"limpy": _entry()}))
+    plane.observe(_fleet({"limpy": _entry()}))
+    assert _state(plane, "limpy") == "rehabilitating"
+    assert plane.excluded_hosts() == frozenset()
+    assert plane.probation_hosts() == frozenset({"limpy"})
+    plane.observe(_fleet({"limpy": _entry()}))
+    plane.observe(_fleet({"limpy": _entry()}))
+    assert _state(plane, "limpy") == "healthy"
+    assert plane.probation_hosts() == frozenset()
+
+
+def test_probation_flapback_requarantines_without_budget():
+    """A rehabilitating node that goes bad again flaps straight back to
+    quarantined — no budget check (it held a slot moments ago), even
+    when a manual quarantine has since consumed the whole budget."""
+    herd = {f"h-{i}": _entry() for i in range(9)}   # 10 nodes, budget 1
+    plane = HealthPlane(_cfg())
+    for _ in range(3):
+        plane.observe(dict(herd, **{"limpy": _entry(200.0)}))
+    for _ in range(2):
+        plane.observe(dict(herd, **{"limpy": _entry()}))
+    assert _state(plane, "limpy") == "rehabilitating"
+    plane.quarantine("h-0", reason="operator judgement")   # budget used
+    plane.observe(dict(herd, **{"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "quarantined"
+    assert plane.payload()["quarantine_budget"]["used"] == 2
+
+
+def test_drain_recommendation_after_slo_burn():
+    """Quarantined AND still an outlier for health_drain_burn_passes
+    consecutive passes: the pane recommends migrating tenants off.
+    Quarantine alone never moves a tenant."""
+    plane = HealthPlane(_cfg())
+    for _ in range(3):
+        plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert not plane.payload()["nodes"]["limpy"]["drain_recommended"]
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert plane.payload()["nodes"]["limpy"]["drain_recommended"]
+
+
+# --- fail-open discipline ---
+
+
+def test_fail_open_on_stale_collector():
+    """A pass where most of the fleet failed to collect is a collector
+    problem, not a fleet problem: skipped outright, counted, verdict
+    'stale', and nobody's strikes advance."""
+    plane = HealthPlane(_cfg())
+    plane.observe(_fleet({"limpy": _entry(200.0)}))   # strike 1
+    skips0 = _counter(SCORER_SKIPS)
+    mostly_stale = {"h-0": {"stale": True}, "h-1": {"stale": True},
+                    "h-2": {"error": "unreachable"},
+                    "limpy": _entry(200.0)}
+    plane.observe(mostly_stale)
+    assert _counter(SCORER_SKIPS) == skips0 + 1
+    assert plane.payload()["last_pass"]["verdict"] == "stale"
+    # strikes froze at 1: the next real bad pass makes 2 (suspect), not 3
+    plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "suspect"
+
+
+def test_disabled_plane_is_inert():
+    plane = HealthPlane(_cfg(health_enabled=False))
+    for _ in range(6):
+        plane.observe(_fleet({"limpy": _entry(500.0)}))
+    assert plane.payload()["enabled"] is False
+    assert plane.payload()["nodes"] == {}
+    assert plane.excluded_hosts() == frozenset()
+
+
+def test_excluded_hosts_degrades_to_empty_set():
+    """A broken health plane must fail open, not fence the fleet."""
+    plane = HealthPlane(_cfg())
+    plane.quarantine("limpy", reason="x")
+
+    class _BrokenLock:
+        def __enter__(self):
+            raise RuntimeError("lock plane broke")
+
+        def __exit__(self, *exc):
+            return False
+
+    plane._lock = _BrokenLock()
+    assert plane.excluded_hosts() == frozenset()
+    assert plane.probation_hosts() == frozenset()
+
+
+# --- the quarantine budget ---
+
+
+def test_budget_caps_automatic_quarantine():
+    """10-node fleet, 10% budget -> 1 slot. Two limping nodes: the
+    first quarantines, the second is denied (stays suspect, counted)."""
+    herd = {f"h-{i}": _entry() for i in range(8)}
+    plane = HealthPlane(_cfg())
+    denials0 = _counter(BUDGET_DENIALS)
+    for _ in range(3):
+        plane.observe(dict(herd, **{"limp-a": _entry(200.0),
+                                    "limp-b": _entry(200.0)}))
+    assert _state(plane, "limp-a") == "quarantined"
+    assert _state(plane, "limp-b") == "suspect"
+    assert _counter(BUDGET_DENIALS) == denials0 + 1
+    budget = plane.payload()["quarantine_budget"]
+    assert budget["max_nodes"] == 1 and budget["used"] == 1
+
+
+def test_manual_quarantine_exempt_from_budget_and_sticky():
+    """The budget guards against scorer bugs, not operators: a manual
+    quarantine lands past a full budget, is never auto-rehabilitated,
+    and only a manual release takes it out."""
+    herd = {f"h-{i}": _entry() for i in range(8)}
+    plane = HealthPlane(_cfg())
+    for _ in range(3):
+        plane.observe(dict(herd, **{"limp-a": _entry(200.0),
+                                    "limp-b": _entry(200.0)}))
+    pane = plane.quarantine("limp-b", reason="nvme timeouts",
+                            actor="oncall")
+    assert pane["state"] == "quarantined" and pane["manual"] is True
+    assert plane.excluded_hosts() == frozenset({"limp-a", "limp-b"})
+    # clean passes rehab the scorer's verdict, never the operator's
+    for _ in range(6):
+        plane.observe(dict(herd, **{"limp-a": _entry(),
+                                    "limp-b": _entry()}))
+    assert _state(plane, "limp-b") == "quarantined"
+    assert _state(plane, "limp-a") == "healthy"
+    released = plane.release("limp-b", actor="oncall")
+    assert released["state"] == "healthy" and released["manual"] is False
+
+
+def test_release_refuses_nodes_that_are_not_quarantined():
+    plane = HealthPlane(_cfg())
+    with pytest.raises(ValueError):
+        plane.release("never-seen")
+
+
+# --- breaker/canary dedupe (satellite regression) ---
+
+
+def test_breaker_open_counts_without_canary_evidence():
+    plane = HealthPlane(_cfg())
+    tripped = _entry(10.0, breaker="open")
+    plane.observe(_fleet({"tripped": tripped}))
+    plane.observe(_fleet({"tripped": tripped}))
+    pane = plane.payload()["nodes"]["tripped"]
+    assert pane["state"] == "suspect"
+    assert "breaker_open" in pane["signals"]
+
+
+def test_breaker_canary_dedupe_one_incident_one_signal():
+    """The canary rides the breaker-aware client, so its own failed
+    probes trip the breaker — while canary-failure evidence is active
+    the breaker_open signal is suppressed (one incident, one signal)."""
+    plane = HealthPlane(_cfg())
+    plane.record_canary("tripped", ok=False, detail="mount refused")
+    plane.observe(_fleet({"tripped": _entry(10.0, breaker="open")}))
+    pane = plane.payload()["nodes"]["tripped"]
+    assert "breaker_open" not in pane["signals"]
+    assert any(s.startswith("canary_failures") for s in pane["signals"])
+    # the canary recovering re-enables the breaker signal
+    plane.record_canary("tripped", ok=True)
+    plane.observe(_fleet({"tripped": _entry(10.0, breaker="open")}))
+    assert "breaker_open" in plane.payload()["nodes"]["tripped"]["signals"]
+
+
+# --- evacuation interplay ---
+
+
+class _DeadRecovery:
+    def __init__(self, dead=()):
+        self.dead = set(dead)
+
+    def is_evacuated(self, node):
+        return node in self.dead
+
+
+def test_evacuation_supersedes_quarantine():
+    plane = HealthPlane(_cfg())
+    plane.quarantine("limpy", reason="slow")
+    plane.note_evacuated("limpy")
+    assert plane.excluded_hosts() == frozenset()   # the corpse left
+    assert plane.payload()["nodes"]["limpy"]["evacuated"] is True
+    with pytest.raises(ValueError):
+        plane.release("limpy")
+    with pytest.raises(ValueError):
+        plane.quarantine("limpy", reason="again")
+
+
+def test_release_refuses_recovery_evacuated_node():
+    """Even when our own record missed the evacuation, the cross-plane
+    check refuses resurrection."""
+    plane = HealthPlane(_cfg(), recovery=_DeadRecovery(dead={"limpy"}))
+    plane.quarantine("limpy", reason="slow")
+    with pytest.raises(ValueError) as exc:
+        plane.release("limpy")
+    assert "evacuated" in str(exc.value)
+
+
+# --- the canary prober ---
+
+
+class _Registry:
+    def __init__(self, snap):
+        self._snap = dict(snap)
+
+    def registry_snapshot(self):
+        return dict(self._snap)
+
+
+def test_canary_probes_only_the_decision_relevant_set():
+    """The passive scorer watches the healthy herd; the canary probes
+    only suspect/quarantined/rehabilitating nodes. A node without its
+    canary pod (probe returns None) is a skip, not a failure."""
+    plane = HealthPlane(_cfg())
+    bad = {"limpy": _entry(200.0), "skippy": _entry(200.0)}
+    for _ in range(2):
+        plane.observe(_fleet(dict(bad), herd=4))
+    assert _state(plane, "limpy") == "suspect"
+    probed = []
+
+    def probe(node, address):
+        probed.append(node)
+        if node == "skippy":
+            return None, "canary pod not scheduled"
+        return False, "slow rpc"
+
+    reg = _Registry({"limpy": "10.0.0.1", "skippy": "10.0.0.2",
+                     "h-0": "10.0.0.3"})
+    prober = CanaryProber(plane, reg, None, cfg=plane.cfg, probe=probe)
+    assert prober.targets() == ["limpy", "skippy"]
+    assert prober.probe_once() == 1   # skippy skipped, herd never probed
+    assert sorted(probed) == ["limpy", "skippy"]
+    canary = plane.payload()["nodes"]["limpy"]["canary"]
+    assert canary["consecutive_failures"] == 1
+    assert canary["detail"] == "slow rpc"
+
+
+def test_canary_gates_rehab_when_active():
+    """With a live prober, clean passive passes alone never
+    rehabilitate — the canary must prove the path works."""
+    plane = HealthPlane(_cfg())
+    plane.canary_active = True
+    for _ in range(3):
+        plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "quarantined"
+    for _ in range(4):
+        plane.observe(_fleet({"limpy": _entry()}))
+    assert _state(plane, "limpy") == "quarantined"   # no canary proof
+    plane.record_canary("limpy", ok=True)
+    plane.record_canary("limpy", ok=True)
+    plane.observe(_fleet({"limpy": _entry()}))
+    assert _state(plane, "limpy") == "rehabilitating"
+
+
+def test_canary_probe_exception_is_evidence():
+    plane = HealthPlane(_cfg())
+    for _ in range(2):
+        plane.observe(_fleet({"limpy": _entry(200.0)}))
+
+    def probe(node, address):
+        raise ConnectionError("dial tcp: connection refused")
+
+    prober = CanaryProber(plane, _Registry({"limpy": "10.0.0.1"}), None,
+                          cfg=plane.cfg, probe=probe)
+    assert prober.probe_once() == 1
+    canary = plane.payload()["nodes"]["limpy"]["canary"]
+    assert canary["consecutive_failures"] == 1
+    assert "ConnectionError" in canary["detail"]
+
+
+# --- persistence through the store seam (takeover continuity) ---
+
+
+def test_quarantine_survives_master_restart_via_store():
+    cfg = _cfg()
+    kube = FakeKubeClient()
+    store = KubeMasterStore(kube, cfg)
+    plane1 = HealthPlane(cfg, store=store)
+    plane1.quarantine("node-q", reason="nvme timeouts", actor="oncall")
+
+    plane2 = HealthPlane(cfg, store=store)
+    assert plane2.load() == 1
+    assert plane2.is_quarantined("node-q")
+    pane = plane2.payload()["nodes"]["node-q"]
+    assert pane["manual"] is True
+    assert "nvme timeouts" in pane["reason"]
+    # the restored record still refuses auto-rehab and honors release
+    plane2.release("node-q")
+    plane3 = HealthPlane(cfg, store=store)
+    assert plane3.load() == 0
+
+
+def test_store_load_fails_open_on_garbage():
+    cfg = _cfg()
+    kube = FakeKubeClient()
+    kube.create_lease(cfg.worker_namespace, {
+        "metadata": {"name": KubeMasterStore.HEALTH_LEASE,
+                     "namespace": cfg.worker_namespace,
+                     "annotations": {
+                         KubeMasterStore.ANNOT_HEALTH: "{not json"}},
+        "spec": {}})
+    store = KubeMasterStore(kube, cfg)
+    assert store.load_health_state() is None
+    assert HealthPlane(cfg, store=store).load() == 0
+
+
+def test_cached_store_delegates_health_state():
+    from gpumounter_tpu.k8s.health import ApiHealth
+    from gpumounter_tpu.store.cache import CachedMasterStore
+    cfg = _cfg().replace(writebehind_dir="")
+    fake = FakeKubeClient()
+    store = CachedMasterStore(KubeMasterStore(fake, cfg), cfg=cfg,
+                              apihealth=ApiHealth(cfg=cfg))
+    state = {"version": 1,
+             "nodes": {"n": {"state": "quarantined", "since": 1.0,
+                             "reason": "r", "manual": False}}}
+    store.save_health_state(state)
+    assert store.load_health_state()["nodes"]["n"]["state"] \
+        == "quarantined"
+
+
+# --- the /health HTTP surface ---
+
+
+def test_health_routes():
+    from tests.conftest import AUTH_HEADER
+
+    from gpumounter_tpu.master.app import MasterApp, WorkerRegistry
+    cfg = _cfg()
+    kube = FakeKubeClient()
+    registry = WorkerRegistry(kube, cfg)
+    try:
+        app = MasterApp(kube, cfg=cfg,
+                        worker_client_factory=lambda addr: None,
+                        registry=registry)
+        status, _, body, _ = app.handle("GET", "/health/nodes", b"",
+                                        AUTH_HEADER)
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert "quarantine_budget" in payload and "nodes" in payload
+        # Unauthenticated read rejected (read scope).
+        status, _, _, _ = app.handle("GET", "/health/nodes", b"", {})
+        assert status == 401
+        # Manual quarantine: audited mutating route.
+        status, _, body, _ = app.handle(
+            "POST", "/health/quarantine/node-x",
+            json.dumps({"action": "quarantine",
+                        "reason": "disk timeouts"}).encode(),
+            AUTH_HEADER)
+        assert status == 200
+        out = json.loads(body)
+        assert out["health"]["state"] == "quarantined"
+        assert out["health"]["manual"] is True
+        assert app.health.is_quarantined("node-x")
+        # Release round-trips; a second release is a 409 refusal.
+        status, _, body, _ = app.handle(
+            "POST", "/health/quarantine/node-x",
+            json.dumps({"action": "release"}).encode(), AUTH_HEADER)
+        assert status == 200
+        assert json.loads(body)["health"]["state"] == "healthy"
+        status, _, _, _ = app.handle(
+            "POST", "/health/quarantine/node-x",
+            json.dumps({"action": "release"}).encode(), AUTH_HEADER)
+        assert status == 409
+        status, _, _, _ = app.handle(
+            "POST", "/health/quarantine/node-x",
+            json.dumps({"action": "explode"}).encode(), AUTH_HEADER)
+        assert status == 400
+        from gpumounter_tpu.obs.audit import AUDIT
+        ops = [r["operation"] for r in AUDIT.snapshot()]
+        assert "http.health_quarantine" in ops
+    finally:
+        registry.stop()
+
+
+# --- consumers: pool drain, packer exclusion, planner destinations ---
+
+
+def test_pool_drains_warm_holders_on_quarantine(tmp_path):
+    """A quarantined node must not bank standby capacity: drain deletes
+    its Running holders and pauses refill; un-draining restocks."""
+    from gpumounter_tpu.allocator.pool import WARM_SELECTOR, WarmPodPool
+    from gpumounter_tpu.testing.cluster import FakeCluster
+    c = FakeCluster(str(tmp_path), n_chips=4).start()
+    try:
+        cfg = c.cfg.replace(warm_pool_size=2)
+        pool = WarmPodPool(c.kube, cfg=cfg, refill_async=False)
+        pool.ensure_node(c.node_name)
+        pool.refill_once()
+        assert pool.ready_count(c.node_name) == 2
+
+        assert pool.set_drained(c.node_name, True) == 2
+        assert pool.drained(c.node_name)
+        assert pool.ready_count(c.node_name) == 0
+        assert c.kube.list_pods(cfg.pool_namespace,
+                                label_selector=WARM_SELECTOR) == []
+        pool.refill_once()   # paused while drained
+        assert pool.ready_count(c.node_name) == 0
+
+        assert pool.set_drained(c.node_name, False) == 0
+        pool.refill_once()
+        assert pool.ready_count(c.node_name) == 2
+    finally:
+        c.stop()
+
+
+def test_packer_hard_excludes_quarantined_hosts():
+    """excluded_hosts is a HARD exclusion: chips there are never
+    candidates, even when refusal is the alternative."""
+    from gpumounter_tpu.vchip.packer import PackRefused, SharePacker
+    from gpumounter_tpu.vchip.shares import ShareRegistry
+    cfg = Config()
+    packer = SharePacker(ShareRegistry(cfg=cfg), cfg=cfg)
+    with pytest.raises(PackRefused):
+        packer.admit("default", "p", "balanced", 1, 50,
+                     inventory={"chip-q": "node-q"},
+                     excluded_hosts={"node-q"})
+    booked = packer.admit("default", "p", "balanced", 1, 50,
+                          inventory={"chip-q": "node-q",
+                                     "chip-ok": "node-ok"},
+                          excluded_hosts={"node-q"})
+    assert [s.chip_uuid for s in booked] == ["chip-ok"]
+
+
+def test_packer_probation_hosts_rank_last_but_stay_placeable():
+    from gpumounter_tpu.vchip.packer import SharePacker
+    from gpumounter_tpu.vchip.shares import ShareRegistry
+    cfg = Config()
+    packer = SharePacker(ShareRegistry(cfg=cfg), cfg=cfg)
+    booked = packer.admit("default", "p", "balanced", 1, 50,
+                          inventory={"a-rehab": "node-r",
+                                     "b-clear": "node-ok"},
+                          probation_hosts={"node-r"})
+    assert [s.chip_uuid for s in booked] == ["b-clear"]
+    # ...but probation beats refusal when it is all that is left
+    booked = packer.admit("default", "q", "balanced", 1, 60,
+                          inventory={"a-rehab": "node-r",
+                                     "b-clear": "node-ok"},
+                          probation_hosts={"node-r"})
+    assert [s.chip_uuid for s in booked] == ["a-rehab"]
+
+
+def test_planner_refuses_quarantined_destinations():
+    """Moving a tenant ONTO a limping node would convert fragmentation
+    pain into gray-failure pain: quarantined hosts are
+    non-destinations, and a group with nowhere else to place is dropped
+    whole."""
+    from gpumounter_tpu.defrag import plan_moves
+
+    def _dentry(free, held=None):
+        return {"capacity": {"free": list(free),
+                             "held": {int(i): t
+                                      for i, t in (held or {}).items()},
+                             "warm": [], "fenced": []}}
+
+    nodes = {"host-a": _dentry([0, 1, 4, 5],
+                               {2: "ns/t1", 3: "ns/t1",
+                                6: "ns/t2", 7: "ns/t2"}),
+             "host-b": _dentry(range(8))}
+    plan = plan_moves(nodes, target_block=4, max_moves=8)
+    assert plan["moves"]   # sanity: host-b is the natural destination
+    plan = plan_moves(nodes, target_block=4, max_moves=8,
+                      non_destinations={"host-b"})
+    assert plan["moves"] == []
+    assert any(s["reason"] == "no-destination" for s in plan["skipped"])
+
+
+# --- probabilistic failpoints (the gray chaos substrate) ---
+
+
+def test_probabilistic_failpoint_specs_validate():
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm("t.bad", "pdrop(1.5)")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm("t.bad", "pdelay([2.0, 0.1])")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints.arm("t.bad", "pdelay(0.5)")   # needs [p, seconds]
+    failpoints.arm("t.ok", "pdrop(0.5)")
+    failpoints.arm("t.ok2", "pdelay([0.5, 0.01])")
+
+
+def test_pdrop_is_seeded_and_reproducible():
+    """The registry owns one seeded RNG: the same seed replays the same
+    coin sequence, which is what makes the gray chaos scenarios
+    deterministic per seed."""
+    failpoints.arm("t.pdrop", "pdrop(0.5)")
+
+    def draw(n=32):
+        outcomes = []
+        for _ in range(n):
+            try:
+                failpoints.fire("t.pdrop")
+                outcomes.append(False)
+            except failpoints.InjectedUnavailable:
+                outcomes.append(True)
+        return outcomes
+
+    failpoints.seed(42)
+    first = draw()
+    failpoints.seed(42)
+    assert draw() == first
+    assert any(first) and not all(first)   # a coin, not a constant
+
+
+def test_pdelay_full_probability_always_fires():
+    import time as _time
+    failpoints.arm("t.pdelay", "pdelay([1.0, 0.02])")
+    t0 = _time.monotonic()
+    failpoints.fire("t.pdelay")
+    assert _time.monotonic() - t0 >= 0.02
+
+
+def test_health_observe_failpoint_is_armable():
+    """The declared `health.observe` site (faults/registry.py) is live:
+    a pdrop-armed scoring pass raises out of observe() — in production
+    the FleetCollector's collect-pass guard absorbs it, so an injected
+    scorer outage costs one pass, never the collector loop."""
+    plane = HealthPlane(_cfg())
+    failpoints.arm("health.observe", "pdrop(1.0)")
+    with pytest.raises(failpoints.InjectedUnavailable):
+        plane.observe(_fleet())
+    failpoints.disarm_all()
+    plane.observe(_fleet())
+    assert plane.payload()["last_pass"]["verdict"] == "scoring"
+
+
+def test_health_canary_failpoint_turns_into_probe_evidence():
+    """The declared `health.canary` site fires inside the default probe
+    before any worker dial: a pdrop hit surfaces as canary-failure
+    evidence (probe_once's exception path), not a prober crash."""
+    plane = HealthPlane(_cfg())
+    for _ in range(2):
+        plane.observe(_fleet({"limpy": _entry(200.0)}))
+    assert _state(plane, "limpy") == "suspect"
+
+    def exploding_factory(address):  # the dial must never happen
+        raise AssertionError("probe dialed past the failpoint")
+
+    prober = CanaryProber(plane, _Registry({"limpy": "10.0.0.1"}),
+                          exploding_factory, cfg=plane.cfg)
+    failpoints.arm("health.canary", "pdrop(1.0)")
+    assert prober.probe_once() == 1
+    canary = plane.payload()["nodes"]["limpy"]["canary"]
+    assert canary["consecutive_failures"] == 1
+    assert "InjectedUnavailable" in canary["detail"]

@@ -307,3 +307,49 @@ def test_tenant_label_cardinality_is_capped():
         2.0 * ebpf.DEVICE_TELEMETRY.max_tenants
     grown = REGISTRY.series_count() - before
     assert grown <= ebpf.DEVICE_TELEMETRY.max_tenants + 1
+
+
+def test_health_plane_series_are_bounded():
+    """ISSUE 18 guard: a 200-node fleet churning through the full
+    quarantine lifecycle — outlier strikes, manual quarantines, canary
+    streaks, releases — grows the exposition only by the fixed health
+    series: the 4-value state gauge, the (from_state, to_state)
+    transition counter bounded by the state vocabulary, and unlabeled
+    probe/skip/denial counters. Node names ride GET /health/nodes,
+    never metric labels."""
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.health import HealthPlane
+
+    def entry(p95):
+        return {"mount": {"count": 10, "p95_ms": p95, "success": 10,
+                          "error": 0},
+                "breaker": "closed"}
+
+    cfg = Config().replace(
+        health_enabled=True, health_min_samples=3,
+        health_p95_multiplier=3.0, health_p95_floor_ms=20.0,
+        health_suspect_strikes=2, health_quarantine_strikes=3,
+        health_clear_passes=2, health_rehab_canary_passes=2,
+        health_probation_passes=2)
+    before = REGISTRY.series_count()
+    plane = HealthPlane(cfg)
+    for round_i in range(6):
+        # 200 distinct node names per round, a few limping
+        nodes = {f"card-hp-{round_i}-{h}": entry(10.0)
+                 for h in range(197)}
+        for limper in ("limp-a", "limp-b", "limp-c"):
+            nodes[limper] = entry(400.0 if round_i < 3 else 10.0)
+        plane.observe(nodes)
+        plane.record_canary(f"card-canary-{round_i}", ok=bool(round_i % 2),
+                            detail="probe detail")
+    plane.quarantine(f"card-manual-{round_i}", reason="op", actor="t")
+    plane.release(f"card-manual-{round_i}", actor="t")
+    grown = REGISTRY.series_count() - before
+    # 4 state-gauge values + transition pairs from the bounded 4-state
+    # vocabulary + unlabeled probe/skip/denial counters
+    assert grown <= 16, (
+        f"health plane grew {grown} series — an unbounded label "
+        f"(node name? reason? canary detail?) slipped into an "
+        f"instrument")
+    pane = plane.payload()
+    assert any(n.startswith("card-hp-") for n in pane["nodes"])

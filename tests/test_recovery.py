@@ -444,3 +444,79 @@ def test_replay_release_after_evacuation_is_idempotent(tmp_path, stack):
     summary = LedgerResync(service).replay_once()
     assert summary["rolled_back"]
     assert ledger.open_transactions() == []
+
+
+# --- satellite: quarantine (health plane) vs evacuation interplay ---
+
+
+def _wire_health(cfg, controller):
+    from gpumounter_tpu.health import HealthPlane
+    plane = HealthPlane(cfg.replace(health_enabled=True),
+                        recovery=controller)
+    controller.health = plane
+    return plane
+
+
+def test_quarantined_is_not_dead(stack):
+    """Quarantine alone must never feed the evacuation rules: a
+    quarantined-but-alive node stays healthy in recovery's books, with
+    the advisory flag riding the payload."""
+    kube, cfg, registry, factory, controller, _, _ = stack
+    plane = _wire_health(cfg, controller)
+    plane.quarantine(NODE, reason="limping", actor="test")
+    for _ in range(4):
+        assert controller.check_once()["evacuated"] == []
+    entry = controller.payload()["nodes"][NODE]
+    assert entry["status"] == "healthy"
+    assert entry["quarantined"] is True
+    assert controller.payload()["nodes"][OTHER]["quarantined"] is False
+
+
+def test_quarantined_node_that_then_dies_is_evacuated_normally(stack):
+    """The gray verdict must not shadow the hard one: a quarantined
+    node that goes truly dead is evacuated under the unchanged
+    positive-corroboration rules, and the evacuation retires the health
+    record (excluded_hosts stops reporting a corpse)."""
+    kube, cfg, registry, factory, controller, _, _ = stack
+    plane = _wire_health(cfg, controller)
+    plane.quarantine(NODE, reason="limping", actor="test")
+    factory.dead.add(_addr(kube, cfg, NODE))
+    kube.set_node_ready(NODE, False, reason="KubeletStopped")
+    evacuated = [n for _ in range(3)
+                 for n in controller.check_once()["evacuated"]]
+    assert evacuated == [NODE]
+    assert controller.payload()["nodes"][NODE]["status"] == "evacuated"
+    assert plane.payload()["nodes"][NODE]["evacuated"] is True
+    assert plane.excluded_hosts() == frozenset()
+
+
+def test_release_cannot_resurrect_an_evacuated_node(stack):
+    kube, cfg, registry, factory, controller, _, _ = stack
+    plane = _wire_health(cfg, controller)
+    plane.quarantine(NODE, reason="limping", actor="test")
+    factory.dead.add(_addr(kube, cfg, NODE))
+    kube.set_node_ready(NODE, False)
+    for _ in range(3):
+        controller.check_once()
+    assert controller.is_evacuated(NODE)
+    with pytest.raises(ValueError) as exc:
+        plane.release(NODE, actor="test")
+    assert "evacuated" in str(exc.value)
+
+
+def test_quarantine_survives_shard_takeover_store_seam(stack):
+    """A peer replica adopting the shard rebuilds the quarantine set
+    from the store seam instead of un-quarantining the fleet — the
+    same seam MasterApp.__init__ loads through."""
+    from gpumounter_tpu.health import HealthPlane
+    kube, cfg, registry, factory, controller, _, _ = stack
+    store = KubeMasterStore(kube, cfg)
+    plane = HealthPlane(cfg.replace(health_enabled=True),
+                        recovery=controller, store=store)
+    plane.quarantine(NODE, reason="limping", actor="test")
+
+    takeover = HealthPlane(cfg.replace(health_enabled=True),
+                           recovery=controller, store=store)
+    assert takeover.load() == 1
+    assert takeover.is_quarantined(NODE)
+    assert takeover.payload()["nodes"][NODE]["manual"] is True
