@@ -1,0 +1,452 @@
+"""Diurnal autoscale bench: the control-plane capstone.
+
+Runs the REAL AutoscaleController + ThroughputModel over the simulated
+fleet in gpumounter_tpu/testing/diurnal.py — millions of simulated
+requests across ~256 fake hosts and two dozen phase-shifted tenant
+profiles — with every subsystem the last 18 PRs built exercised
+CONCURRENTLY: warm-pool grows, quarantine (hosts excluded mid-run,
+then healed), an ICI fragmentation wave that forces
+admissible-after-defrag deferrals and defrag compactions, a hard node
+kill, a k8s API outage (the controller must park), and an SLO burn
+window (the controller must refuse). Three legs serve the identical
+seeded arrival sequence:
+
+  autoscaled    the controller evaluates once per tick (simulated
+                60 s), writing elastic intents the sim's reconciler
+                places/releases like the allocator would.
+
+  static-peak   fixed per-tenant allocation sized at 105% of peak
+                demand — the classic over-provisioned fleet the
+                autoscaler must beat on utilization.
+
+  static-mean   fixed allocation sized at mean demand — the
+                under-provisioned strawman that MUST breach, proving
+                the sim's SLO instrument discriminates.
+
+Gates (all hard; see check()):
+
+  correctness   every fired decision: recorded gates open, trace-
+                stamped, hysteresis streak met, thresholds satisfied
+                at decision time, step/ceiling/floor bounds honored,
+                per-tenant cooldown spacing respected, no decision
+                inside the outage/burn windows, zero placements on
+                quarantined hosts, zero unplaceable grows.
+
+  SLO           zero breach-ticks attributable to scaling (a breach
+                within 15 ticks after a shrink, absent a node kill).
+
+  utilization   the autoscaled leg beats static-peak by >= 1.10x.
+
+  coverage      grows AND shrinks fired; the outage parked passes; the
+                burn refused passes; the frag wave deferred a grow into
+                a defrag request that ran a compaction; warm chips were
+                reattached; static-mean breached.
+
+Usage:
+  python bench_diurnal.py              -> writes BENCH_diurnal_r01.json
+  python bench_diurnal.py --check FILE -> CI smoke: re-runs (shrunk via
+      env) and gates correctness/SLO/utilization plus the committed
+      artifact's scale + zero-scaling-breach claims; never overwrites
+      the committed artifact (set TPM_DIURNAL_ARTIFACT to redirect).
+
+Shrink knobs (CI uses all three): TPM_DIURNAL_NODES (default 256),
+TPM_DIURNAL_TICKS (default 2880 = two simulated days at 60 s/tick),
+TPM_DIURNAL_SCALE (tenant-count multiplier, default 2 -> 24 tenants).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from collections import Counter
+
+ARTIFACT = "BENCH_diurnal_r01.json"
+
+# The control plane is fail-closed (TPUMOUNTER_AUTH=token): give the
+# in-process stack one shared secret BEFORE any Config() exists.
+os.environ.setdefault("TPUMOUNTER_AUTH_TOKEN", "bench-diurnal-secret")
+os.environ.setdefault("TPUMOUNTER_AUTH", "token")
+
+#: fleet size (CI shrinks to 64)
+NODES = int(os.environ.get("TPM_DIURNAL_NODES", "256"))
+#: simulated 60 s ticks; default is two diurnal days (CI shrinks to 288)
+TICKS = int(os.environ.get("TPM_DIURNAL_TICKS", "2880"))
+#: tenant-count multiplier over the 5 profile templates
+SCALE = int(os.environ.get("TPM_DIURNAL_SCALE", "2"))
+#: everything is seeded off this (vary via env only for exploration)
+SEED = int(os.environ.get("TPM_DIURNAL_SEED", "20260807"))
+
+TICK_S = 60.0
+PER_CHIP_RPS = 1.0
+SLO_WAIT_S = 180.0
+#: autoscaled leg must beat static-peak utilization by this factor
+UTIL_WIN_FLOOR = 1.10
+#: committed artifact must prove at least this much simulated traffic
+MIN_COMMITTED_REQUESTS = 2_000_000
+#: breach attribution windows (ticks)
+SHRINK_BLAME_WINDOW = 15
+KILL_EXCUSE_WINDOW = 20
+
+#: chaos schedule as fractions of the run, so shrunk CI runs keep
+#: every event
+QUAR_START, QUAR_END = 0.20, 0.32
+FRAG_WAVE_AT = 0.35
+KILL_AT = 0.45
+OUTAGE = (0.62, 0.64)
+SLO_BURN = (0.73, 0.75)
+
+#: (namespace/pod stem, base rps, amplitude rps, peak phase, instances
+#: per SCALE unit) — phase-shifted so grows and shrinks overlap in time
+PROFILE_TEMPLATES = [
+    ("prod/web", 10.0, 30.0, 0.00, 3),
+    ("prod/asia", 8.0, 26.0, 0.50, 3),
+    ("batch/nightly", 4.0, 18.0, 0.66, 2),
+    ("research/train", 12.0, 0.0, 0.00, 2),
+    ("dev/notebooks", 3.0, 8.0, 0.25, 2),
+]
+
+
+def build_profiles():
+    from gpumounter_tpu.testing.diurnal import TenantProfile
+
+    profiles = []
+    for stem, base, amp, phase, count in PROFILE_TEMPLATES:
+        for k in range(count * SCALE):
+            profiles.append(TenantProfile(
+                name=f"{stem}-{k:02d}",
+                base_rps=base * (1.0 + 0.06 * k),
+                amp_rps=amp * (1.0 + 0.04 * k),
+                phase=phase + 0.015 * k))
+    return profiles
+
+
+def _tick_at(fraction: float) -> int:
+    return int(TICKS * fraction)
+
+
+def run_bench() -> dict:
+    from gpumounter_tpu.autoscale import (
+        AutoscaleController,
+        AutoscaleRefused,
+    )
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.testing.diurnal import (
+        CHIPS_PER_NODE,
+        DiurnalSim,
+        build_arrivals,
+        run_static_leg,
+    )
+
+    t_start = time.time()
+    cfg = Config()
+    day_ticks = max(2, TICKS // 2)
+    profiles = build_profiles()
+    arrivals = build_arrivals(profiles, TICKS, day_ticks, TICK_S, SEED)
+
+    peak_chips = sum(int(math.ceil(p.peak_rps(day_ticks) / PER_CHIP_RPS))
+                     for p in profiles)
+    open_nodes = min(NODES,
+                     int(math.ceil(peak_chips * 1.3 / CHIPS_PER_NODE))
+                     + 4)
+    sim = DiurnalSim(profiles, n_nodes=NODES, seed=SEED, tick_s=TICK_S,
+                     per_chip_rps=PER_CHIP_RPS, day_ticks=day_ticks,
+                     warm_ttl_ticks=max(10, day_ticks // 6),
+                     slo_wait_s=SLO_WAIT_S)
+    sim.seed_ballast(open_nodes)
+    sim.reconcile()  # place the initial provision
+
+    ctrl = AutoscaleController(cfg=cfg, **sim.controller_kwargs())
+
+    quar_start, quar_end = _tick_at(QUAR_START), _tick_at(QUAR_END)
+    frag_tick = _tick_at(FRAG_WAVE_AT)
+    kill_tick = _tick_at(KILL_AT)
+    outage = range(_tick_at(OUTAGE[0]), _tick_at(OUTAGE[1]))
+    burn = range(_tick_at(SLO_BURN[0]), _tick_at(SLO_BURN[1]))
+
+    fired: list[tuple[int, dict]] = []       # (tick, decision)
+    deferred: list[tuple[int, str]] = []     # (tick, tenant)
+    refusals: Counter = Counter()
+    refusal_ticks: list[tuple[int, str]] = []
+    skip_reasons: Counter = Counter()
+    statuses: Counter = Counter()
+    killed_nodes: list[str] = []
+    quarantined: list[str] = []
+
+    for i in range(TICKS):
+        if i == quar_start:
+            quarantined = sim.quarantine_hosts(max(4, NODES // 20))
+        if i == quar_end:
+            sim.release_quarantine()  # healed
+        if i == frag_tick:
+            sim.fragment_wave()
+        if i == kill_tick:
+            killed_nodes = sim.kill_nodes(max(2, NODES // 40))
+        sim.api.down = i in outage
+        sim.slo.burning = i in burn
+        sim.tick(arrivals)
+        try:
+            record = ctrl.evaluate_once()
+        except AutoscaleRefused as exc:
+            refusals[exc.cause] += 1
+            refusal_ticks.append((i, exc.cause))
+            continue
+        statuses[record["status"]] += 1
+        for decision in record["decisions"]:
+            if decision["action"] in ("grow", "shrink"):
+                fired.append((i, decision))
+            elif decision.get("deferred") == "requested-defrag":
+                deferred.append((i, decision["tenant"]))
+            else:
+                skip_reasons[decision["reason"]] += 1
+        sim.reconcile()
+
+    # --- decision-correctness audit over every fired decision ---------
+    min_chips = {p.name: p.min_chips for p in profiles}
+    violations: list[str] = []
+
+    def flag(tick: int, decision: dict, what: str) -> None:
+        violations.append(
+            f"tick {tick} {decision['action']} {decision['tenant']} "
+            f"{decision['from_chips']}->{decision.get('to_chips')}: "
+            f"{what}")
+
+    for tick, d in fired:
+        gates = d["gates"]
+        if not gates["api_ok"] or gates["slo_burning"] or \
+                gates["paused"]:
+            flag(tick, d, f"fired through a closed gate: {gates}")
+        if not d.get("trace_id"):
+            flag(tick, d, "decision is not trace-stamped")
+        if d.get("streak", 0) < int(cfg.autoscale_hysteresis):
+            flag(tick, d, f"hysteresis not met (streak {d.get('streak')})")
+        if tick in outage:
+            flag(tick, d, "fired inside the API-outage window")
+        if tick in burn:
+            flag(tick, d, "fired inside the SLO-burn window")
+        step = abs(d["to_chips"] - d["from_chips"])
+        if step > int(cfg.autoscale_max_step):
+            flag(tick, d, f"step {step} exceeds max_step")
+        if d["action"] == "grow":
+            if d["queue_depth"] < float(cfg.autoscale_queue_grow) or \
+                    d["utilization"] < float(cfg.autoscale_util_grow):
+                flag(tick, d,
+                     f"grow thresholds unmet (queue {d['queue_depth']}, "
+                     f"util {d['utilization']})")
+            if d["to_chips"] > int(cfg.max_tpu_per_request):
+                flag(tick, d, "grew past the per-request ceiling")
+        else:
+            if d["queue_depth"] > float(cfg.autoscale_queue_shrink) or \
+                    d["utilization"] > float(cfg.autoscale_util_shrink):
+                flag(tick, d,
+                     f"shrink thresholds unmet (queue "
+                     f"{d['queue_depth']}, util {d['utilization']})")
+            if d["to_chips"] < max(1, min_chips.get(d["tenant"], 1)):
+                flag(tick, d, "shrank below the tenant floor")
+    by_tenant: dict[str, list[float]] = {}
+    for _, d in fired:
+        by_tenant.setdefault(d["tenant"], []).append(d["at"])
+    for tenant, ats in by_tenant.items():
+        for prev, cur in zip(ats, ats[1:]):
+            if cur - prev < float(cfg.autoscale_cooldown_s) - 1e-6:
+                violations.append(
+                    f"{tenant}: decisions {cur - prev:.0f}s apart "
+                    f"(cooldown {cfg.autoscale_cooldown_s:.0f}s)")
+    if sim.quarantine_placements:
+        violations.append(f"{sim.quarantine_placements} chip(s) placed "
+                          f"on quarantined hosts")
+    if sim.unplaced:
+        violations.append(f"{sim.unplaced} granted chip(s) could not "
+                          f"be placed — feasibility gate lied")
+
+    # --- SLO breach attribution ---------------------------------------
+    shrink_ticks: dict[str, list[int]] = {}
+    for tick, d in fired:
+        if d["action"] == "shrink":
+            shrink_ticks.setdefault(d["tenant"], []).append(tick)
+    breach_ticks = sim.breach_ticks()
+    scaling_caused: list[str] = []
+    total_breach_ticks = 0
+    for tenant, ticks_list in breach_ticks.items():
+        total_breach_ticks += len(ticks_list)
+        for bt in ticks_list:
+            blamed = any(bt - SHRINK_BLAME_WINDOW <= st <= bt
+                         for st in shrink_ticks.get(tenant, []))
+            excused = killed_nodes and \
+                kill_tick <= bt <= kill_tick + KILL_EXCUSE_WINDOW
+            if blamed and not excused:
+                scaling_caused.append(f"{tenant} tick {bt}")
+
+    # --- control legs --------------------------------------------------
+    static_peak = run_static_leg(
+        profiles, arrivals,
+        {p.name: max(p.min_chips, int(math.ceil(
+            p.peak_rps(day_ticks) * 1.05 / PER_CHIP_RPS)))
+         for p in profiles},
+        TICKS, TICK_S, PER_CHIP_RPS, SLO_WAIT_S)
+    static_mean = run_static_leg(
+        profiles, arrivals,
+        {p.name: max(1, int(math.ceil(
+            p.mean_rps(day_ticks) / PER_CHIP_RPS)))
+         for p in profiles},
+        TICKS, TICK_S, PER_CHIP_RPS, SLO_WAIT_S)
+
+    auto_util = round(sim.utilization(), 4)
+    win = (round(auto_util / static_peak["utilization"], 3)
+           if static_peak["utilization"] else 0.0)
+    grows = [d for _, d in fired if d["action"] == "grow"]
+    shrinks = [d for _, d in fired if d["action"] == "shrink"]
+
+    return {
+        "bench": "diurnal-autoscale",
+        "at": round(t_start, 3),
+        "duration_s": round(time.time() - t_start, 3),
+        "config": {
+            "nodes": NODES, "ticks": TICKS, "day_ticks": day_ticks,
+            "tick_s": TICK_S, "seed": SEED, "tenants": len(profiles),
+            "open_nodes": open_nodes, "per_chip_rps": PER_CHIP_RPS,
+            "slo_wait_s": SLO_WAIT_S,
+            "util_win_floor": UTIL_WIN_FLOOR,
+        },
+        "workload": {
+            "total_requests": int(sim.total_requests()),
+            "peak_chips_demand": peak_chips,
+        },
+        "events": {
+            "quarantine_ticks": [quar_start, quar_end],
+            "quarantined_hosts": len(quarantined),
+            "frag_wave_tick": frag_tick,
+            "ballast_surge_chips": sim.ballast_surge,
+            "kill_tick": kill_tick,
+            "killed_nodes": killed_nodes,
+            "outage_ticks": [outage.start, outage.stop],
+            "slo_burn_ticks": [burn.start, burn.stop],
+        },
+        "autoscaled": {
+            "utilization": auto_util,
+            "decisions": {"grow": len(grows), "shrink": len(shrinks)},
+            "deferred_grows": len(deferred),
+            "defrag_requests": sim.defrag.requests,
+            "defrag_runs": sim.defrag.runs,
+            "compaction_moves": sim.compaction_moves,
+            "warm_attaches": sim.warm_attaches,
+            "scatter_allocs": sim.scatter_allocs,
+            "pass_statuses": dict(statuses),
+            "refusals": dict(refusals),
+            "skip_reasons": dict(skip_reasons),
+            "breach_ticks_total": total_breach_ticks,
+            "scaling_caused_breaches": scaling_caused,
+            "violations": violations,
+            "final_chips": {name: len(t.chips)
+                            for name, t in sorted(sim.tenants.items())},
+        },
+        "static_peak": static_peak,
+        "static_mean": static_mean,
+        "utilization_win": win,
+    }
+
+
+def check(committed_path: str, fresh: dict) -> int:
+    with open(committed_path) as fh:
+        committed = json.load(fh)
+    failures = []
+
+    auto = fresh["autoscaled"]
+    if auto["violations"]:
+        failures.append(
+            f"{len(auto['violations'])} decision-correctness "
+            f"violation(s): {auto['violations'][:3]}")
+    if auto["scaling_caused_breaches"]:
+        failures.append(
+            f"{len(auto['scaling_caused_breaches'])} SLO breach-tick(s) "
+            f"caused by scaling: {auto['scaling_caused_breaches'][:3]}")
+    if fresh["utilization_win"] < UTIL_WIN_FLOOR:
+        failures.append(
+            f"utilization win {fresh['utilization_win']}x over "
+            f"static-peak is below the {UTIL_WIN_FLOOR}x floor "
+            f"(autoscaled {auto['utilization']}, static-peak "
+            f"{fresh['static_peak']['utilization']})")
+    if auto["decisions"]["grow"] < 3 or auto["decisions"]["shrink"] < 3:
+        failures.append(
+            f"too few decisions fired to prove the loop "
+            f"({auto['decisions']}) — the diurnal signal is broken")
+    if auto["refusals"].get("api-degraded", 0) < 1:
+        failures.append("the API outage never parked a pass")
+    if auto["refusals"].get("slo-burn", 0) < 1:
+        failures.append("the SLO burn window never refused a pass")
+    if auto["deferred_grows"] < 1 or auto["defrag_runs"] < 1:
+        failures.append(
+            f"the fragmentation wave never exercised the defrag "
+            f"deferral path (deferred {auto['deferred_grows']}, "
+            f"defrag runs {auto['defrag_runs']})")
+    if auto["compaction_moves"] < 1:
+        failures.append("defrag ran but compacted nothing")
+    if auto["warm_attaches"] < 1:
+        failures.append("no grow ever reattached a warm-pool chip")
+    if fresh["static_mean"]["breach_ticks_total"] < 1:
+        failures.append(
+            "the under-provisioned static-mean leg never breached — "
+            "the SLO instrument cannot discriminate")
+
+    committed_auto = committed.get("autoscaled", {})
+    if committed.get("workload", {}).get("total_requests", 0) < \
+            MIN_COMMITTED_REQUESTS:
+        failures.append(
+            f"committed artifact proves only "
+            f"{committed.get('workload', {}).get('total_requests', 0)} "
+            f"simulated requests (< {MIN_COMMITTED_REQUESTS})")
+    if committed_auto.get("scaling_caused_breaches") or \
+            committed_auto.get("violations"):
+        failures.append("committed artifact records scaling-caused "
+                        "breaches or correctness violations")
+    if committed.get("utilization_win", 0.0) < UTIL_WIN_FLOOR:
+        failures.append(
+            f"committed utilization win "
+            f"{committed.get('utilization_win')} is below the "
+            f"{UTIL_WIN_FLOOR}x floor")
+
+    if failures:
+        print("DIURNAL BENCH CHECK FAILED:", file=sys.stderr)
+        for failure in failures:
+            print(f"  - {failure}", file=sys.stderr)
+        return 1
+    print(f"diurnal bench check ok: {auto['decisions']['grow']} grows + "
+          f"{auto['decisions']['shrink']} shrinks over "
+          f"{fresh['workload']['total_requests']} requests, 0 "
+          f"scaling-caused breaches, 0 violations, utilization "
+          f"{auto['utilization']} vs static-peak "
+          f"{fresh['static_peak']['utilization']} "
+          f"({fresh['utilization_win']}x win), outage parked "
+          f"{auto['refusals'].get('api-degraded', 0)} pass(es), burn "
+          f"refused {auto['refusals'].get('slo-burn', 0)}, defrag "
+          f"compacted {auto['compaction_moves']} chip move(s), "
+          f"{auto['warm_attaches']} warm attach(es)")
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--check", metavar="ARTIFACT", default=None,
+                        help="CI smoke: re-run (env-shrunk) and gate "
+                             "against the committed artifact (never "
+                             "overwrites it)")
+    args = parser.parse_args()
+    fresh = run_bench()
+    if args.check:
+        out = os.environ.get("TPM_DIURNAL_ARTIFACT")
+        if out:
+            with open(out, "w") as fh:
+                json.dump(fresh, fh, indent=1)
+        raise SystemExit(check(args.check, fresh))
+    artifact = os.environ.get("TPM_DIURNAL_ARTIFACT", ARTIFACT)
+    with open(artifact, "w") as fh:
+        json.dump(fresh, fh, indent=1)
+    print(json.dumps(fresh, indent=1))
+    print(f"\nwrote {artifact}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Closed-loop autoscaler: tenant telemetry in, elastic intents out.
+
+Two halves (ISSUE 19): the per-tenant batch->tokens/sec throughput
+model (model.py — bounded history, stale/sparse refusal verdicts) and
+the gated decision controller (controller.py — SLO/ApiHealth/
+quarantine guardrails, capacity-feasibility sourcing, hysteresis and
+cooldowns, audited + trace-stamped decisions). Surfaces: GET
+/autoscale, POST /autoscale/{pause,resume}, `tpumounter autoscale`.
+"""
+
+from gpumounter_tpu.autoscale.controller import (
+    GATING_OBJECTIVES,
+    SKIP_REASONS,
+    AutoscaleController,
+    AutoscaleRefused,
+)
+from gpumounter_tpu.autoscale.model import (
+    VERDICTS,
+    ThroughputModel,
+    fit_curve,
+    predict,
+)
+
+__all__ = [
+    "AutoscaleController",
+    "AutoscaleRefused",
+    "GATING_OBJECTIVES",
+    "SKIP_REASONS",
+    "ThroughputModel",
+    "VERDICTS",
+    "fit_curve",
+    "predict",
+]

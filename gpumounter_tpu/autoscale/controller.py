@@ -1,0 +1,544 @@
+"""Autoscale controller: close the telemetry -> intent loop, gated.
+
+Master-side, wired by MasterApp after the defragmenter. Every feedback
+signal the last 18 PRs built feeds one decision loop:
+
+  * the per-tenant throughput model (autoscale/model.py) says whether
+    a tenant's current slice is saturated (`utilization` against the
+    fitted batch->tokens/sec plateau) — and, critically, whether its
+    telemetry is trustworthy at all (stale/sparse verdicts refuse),
+  * queue depth from the same `/tenants` snapshots carries demand,
+  * the capacity plane answers "where would a grow land": prefer hosts
+    with an admissible free block NOW (warm chips first — grows are
+    served from the warm pool at mount time), request a defrag pass on
+    `admissible-after-defrag`, refuse on `infeasible`; quarantined
+    hosts (health plane) are never counted as capacity,
+  * tenant-SLO burn is a hard guardrail: while a tenant objective
+    burns the controller refuses every decision (a scaler that moves
+    capacity during a disruption incident is the incident's
+    accelerant), and a degraded k8s API parks the pass at the next
+    tenant boundary — decisions already journaled stand, nothing new
+    fires,
+  * hysteresis (signal streaks) + per-tenant cooldowns stop flapping,
+    and shrinks never go below the tenant's declared min_chips floor.
+
+Decisions actuate by writing elastic intents (elastic/intents.py) —
+the reconciler owns convergence, including the graceful drain /
+checkpoint-assisted migration machinery shrinks and heals ride. Every
+decision is audited, trace-stamped and on the flight-recorder
+timeline; the bounded metrics carry outcome/cause enums only (tenant
+names ride the /autoscale JSON pane, never labels).
+
+`enforce_gates` exists for the chaos harness's gates-disabled negative
+control ONLY (the POLICY_ENGINE.enforce convention): with it off the
+controller still RECORDS the true gate state in each decision, so
+chaos invariant 21 can prove a decision fired through a closed gate.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import deque
+
+from gpumounter_tpu.autoscale.model import ThroughputModel
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.elastic.intents import Intent
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.k8s.errors import is_outage
+from gpumounter_tpu.obs import trace
+from gpumounter_tpu.obs.audit import AUDIT
+from gpumounter_tpu.obs.capacity import host_capacity
+from gpumounter_tpu.obs.flight import FLIGHT
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("autoscale")
+
+#: tenant-facing SLO objectives whose burn refuses every decision
+#: (never scale into a breach). slice-feasibility deliberately NOT
+#: here: fragmentation burning is exactly when a grow may need to
+#: request defrag — the feasibility gate handles it per decision.
+GATING_OBJECTIVES = ("tenant-migration-downtime",
+                     "tenant-disruption-free-minutes")
+
+AUTOSCALE_DECISIONS = REGISTRY.counter(
+    "tpumounter_autoscale_decisions_total",
+    "Scale decisions fired, by outcome (grow|shrink)")
+AUTOSCALE_SKIPS = REGISTRY.counter(
+    "tpumounter_autoscale_skips_total",
+    "Per-tenant evaluations that held, by bounded reason vocabulary")
+AUTOSCALE_REFUSALS = REGISTRY.counter(
+    "tpumounter_autoscale_refusals_total",
+    "Whole passes refused/parked, by bounded cause vocabulary")
+AUTOSCALE_PASSES = REGISTRY.counter(
+    "tpumounter_autoscale_passes_total",
+    "Evaluate passes completed (including no-decision passes)")
+AUTOSCALE_PAUSED = REGISTRY.gauge(
+    "tpumounter_autoscale_paused",
+    "1 while the autoscaler is operator-paused")
+
+
+class AutoscaleRefused(Exception):
+    """Gate or pause refusal; maps to an HTTP status. The bounded
+    `cause` vocabulary: slo-burn | api-degraded | paused | busy |
+    stale-telemetry."""
+
+    def __init__(self, message: str, cause: str, status: int = 409):
+        super().__init__(message)
+        self.cause = cause
+        self.status = status
+
+
+#: per-tenant skip reasons (bounded; AUTOSCALE_SKIPS label vocabulary)
+SKIP_REASONS = ("stale-telemetry", "sparse-telemetry", "untracked",
+                "cooldown", "hysteresis", "at-floor", "at-ceiling",
+                "infeasible", "steady", "error")
+
+
+class AutoscaleController:
+    """One per master process; decision state in memory (a restarted
+    master re-learns streaks/cooldowns within a few passes — the
+    intents it wrote are the durable output, annotation-journaled like
+    every other intent)."""
+
+    def __init__(self, elastic, capacity, fleet, slo=None,
+                 apihealth=None, health=None, defrag=None, cfg=None,
+                 model=None, clock=None):
+        self.cfg = cfg or get_config()
+        self.elastic = elastic
+        self.capacity = capacity
+        self.fleet = fleet
+        self.slo = slo
+        self.apihealth = apihealth
+        self.health = health
+        #: optional DefragController: admissible-after-defrag grows
+        #: request a plan instead of failing silently
+        self.defrag = defrag
+        self.clock = clock or time.time
+        self.model = model or ThroughputModel(cfg=self.cfg,
+                                              clock=self.clock)
+        #: harness-only control; see module docstring
+        self.enforce_gates = True
+        self._lock = OrderedLock("autoscale.state")
+        self._paused = threading.Event()
+        #: tenant -> {"grow": streak, "shrink": streak}
+        self._streaks: dict[str, dict] = {}
+        #: tenant -> last grow/shrink decision time (cooldowns)
+        self._cooldowns: dict[str, float] = {}
+        self._history: deque[dict] = deque(maxlen=32)
+        self._last_pass: dict | None = None
+        self._pass_mu = OrderedLock("autoscale.pass")
+        self._stop = threading.Event()
+        self._loop_thread: threading.Thread | None = None
+
+    # --- gates (the defrag controller's fail-closed shape) ---
+
+    def _gate_state(self) -> dict:
+        burning = []
+        if self.slo is not None:
+            try:
+                evaluation = self.slo.evaluate()
+            except Exception as exc:  # noqa: BLE001 — a broken SLO
+                # engine reads as burning: fail closed, autoscaling is
+                # an optimization, never a liveness path
+                logger.warning("slo evaluation for autoscale gate "
+                               "failed: %s", exc)
+                burning = ["slo-engine-error"]
+            else:
+                threshold = float(evaluation.get("burn_threshold", 2.0))
+                for objective in evaluation.get("objectives", []):
+                    if objective.get("name") not in GATING_OBJECTIVES:
+                        continue
+                    if objective.get("breached") or \
+                            float(objective.get("burn_fast", 0.0)) \
+                            >= threshold:
+                        burning.append(objective["name"])
+        api_ok = self.apihealth is None or self.apihealth.ok()
+        return {"api_ok": api_ok,
+                "api_state": (self.apihealth.state()
+                              if self.apihealth is not None else "ok"),
+                "slo_burning": burning,
+                "paused": self._paused.is_set()}
+
+    def _check_gates(self, action: str) -> dict:
+        gates = self._gate_state()
+        if not self.enforce_gates:
+            return gates
+        if gates["paused"]:
+            self._refuse(action, "paused",
+                         "autoscaler is operator-paused; POST "
+                         "/autoscale/resume to re-enable", 409)
+        if not gates["api_ok"]:
+            self._refuse(action, "api-degraded",
+                         f"k8s api is {gates['api_state']}; the "
+                         f"autoscaler parks until it heals", 503)
+        if gates["slo_burning"]:
+            self._refuse(action, "slo-burn",
+                         f"SLO burning: {', '.join(gates['slo_burning'])}"
+                         f"; refusing to scale into a breach", 503)
+        return gates
+
+    def _refuse(self, action: str, cause: str, message: str,
+                status: int = 409) -> None:
+        AUTOSCALE_REFUSALS.inc(outcome=cause)
+        AUDIT.record(f"autoscale.{action}", actor="autoscale-controller",
+                     outcome=f"refused: {cause}", cause=cause,
+                     detail=message)
+        raise AutoscaleRefused(message, cause, status)
+
+    # --- feasibility (where would a grow land) ---
+
+    def _grow_feasibility(self, need: int, nodes: dict) -> dict:
+        """Can the fleet place `need` more chips as one ICI block on a
+        single non-quarantined host? Mirrors the capacity plane's
+        verdict vocabulary so operators read one language everywhere.
+        Warm chips count toward after-defrag capacity only — warm
+        holders are reclaimable bookings, not free blocks."""
+        excluded = frozenset()
+        if self.health is not None:
+            try:
+                excluded = self.health.excluded_hosts()
+            except Exception:  # noqa: BLE001 — fail-open exclusion,
+                # exactly like every other excluded_hosts consumer
+                excluded = frozenset()
+        admissible_now = 0
+        after_defrag = 0
+        warm_ready = 0
+        for node, entry in nodes.items():
+            if node in excluded:
+                continue
+            cap = host_capacity((entry or {}).get("capacity"))
+            if cap.get("capacity_unknown"):
+                continue
+            warm_ready += int(cap.get("warm_ready", 0))
+            if cap["largest_block"] >= need:
+                admissible_now += 1
+            elif cap["free"] + cap["warm"] >= need:
+                after_defrag += 1
+        if admissible_now:
+            verdict = "admissible"
+        elif after_defrag:
+            verdict = "admissible-after-defrag"
+        else:
+            verdict = "infeasible"
+        return {"verdict": verdict, "chips": need,
+                "hosts_admissible_now": admissible_now,
+                "hosts_after_defrag": after_defrag,
+                "warm_ready": warm_ready,
+                "excluded_hosts": len(excluded)}
+
+    def _request_defrag(self, tenant: str, need: int) -> None:
+        """An admissible-after-defrag grow cannot land yet — hand the
+        contiguity problem to the defragmenter (which runs under its
+        own gates/budgets) and record the handoff. Best-effort: a
+        refused or absent defragmenter leaves the grow deferred, and
+        the next pass re-evaluates."""
+        FLIGHT.record("marker",
+                      f"autoscale: grow of {need} chip(s) for {tenant} "
+                      f"needs defrag; requesting a plan")
+        if self.defrag is None:
+            return
+        try:
+            plan = self.defrag.plan()
+            if plan.get("moves"):
+                self.defrag.run(plan["id"])
+        except Exception as exc:  # noqa: BLE001 — the defragmenter
+            # refusing (its own gates) or failing must not fail the
+            # autoscale pass; the deferral is already recorded
+            logger.info("defrag request for %s deferred: %s", tenant,
+                        exc)
+
+    # --- the decision pass ---
+
+    def evaluate_once(self) -> dict:
+        """One full pass: fold fresh telemetry into the model, then
+        evaluate every tenant that has an elastic intent. Raises
+        AutoscaleRefused when a gate is closed at the top; parks
+        mid-pass (status parked-api / parked-slo) when a gate closes
+        between tenants — the journal-boundary contract."""
+        with self._pass_mu:
+            with trace.span("autoscale.pass"):
+                return self._evaluate_traced()
+
+    def _evaluate_traced(self) -> dict:
+        now = self.clock()
+        record = {"at": now, "status": "running", "decisions": [],
+                  "considered": 0,
+                  "trace_id": trace.current_trace_id()}
+        gates = self._check_gates("pass")
+        failpoints.fire("autoscale.pass")
+        try:
+            rollup = self.fleet.payload(
+                max_age_s=float(self.cfg.autoscale_stale_s))
+        except Exception as exc:  # noqa: BLE001 — no fleet view means
+            # no trustworthy telemetry OR capacity: refuse like stale
+            self._refuse(
+                "pass", "stale-telemetry",
+                f"fleet collection failed "
+                f"({'api outage' if is_outage(exc) else exc}); "
+                f"refusing to scale blind", 503)
+        nodes = rollup.get("nodes") or {}
+        self.model.observe_nodes(nodes)
+        try:
+            intents = list(self.elastic.store.list())
+        except Exception as exc:  # noqa: BLE001 — intent listing
+            # rides the k8s API; treat like the fleet failure above
+            self._refuse(
+                "pass", "api-degraded",
+                f"intent listing failed "
+                f"({'api outage' if is_outage(exc) else exc})", 503)
+        from gpumounter_tpu.obs.fleet import merge_tenants
+        snapshots = merge_tenants(nodes)
+        for namespace, pod_name, intent in sorted(
+                intents, key=lambda t: (t[0], t[1])):
+            # journal boundary: gates re-checked between tenants; a
+            # mid-pass degradation parks the REST of the pass, never
+            # unwinds decisions already journaled
+            gates = self._gate_state()
+            if self.enforce_gates and not gates["api_ok"]:
+                record["status"] = "parked-api"
+                record["parked"] = gates["api_state"]
+                AUTOSCALE_REFUSALS.inc(outcome="api-degraded")
+                break
+            if self.enforce_gates and gates["slo_burning"]:
+                record["status"] = "parked-slo"
+                record["parked"] = gates["slo_burning"]
+                AUTOSCALE_REFUSALS.inc(outcome="slo-burn")
+                break
+            if self.enforce_gates and gates["paused"]:
+                record["status"] = "paused"
+                AUTOSCALE_REFUSALS.inc(outcome="paused")
+                break
+            record["considered"] += 1
+            decision = self._decide(namespace, pod_name, intent,
+                                    snapshots, nodes, gates, now)
+            record["decisions"].append(decision)
+        if record["status"] == "running":
+            record["status"] = "completed"
+        AUTOSCALE_PASSES.inc()
+        fired = [d for d in record["decisions"]
+                 if d["action"] in ("grow", "shrink")]
+        if fired:
+            AUDIT.record(
+                "autoscale.pass", actor="autoscale-controller",
+                outcome=f"{record['status']}: {len(fired)} decision(s) "
+                        f"over {record['considered']} tenant(s)",
+                decisions=len(fired), considered=record["considered"])
+        with self._lock:
+            self._last_pass = record
+            self._history.append(copy.deepcopy(record))
+        return copy.deepcopy(record)
+
+    def _decide(self, namespace: str, pod_name: str, intent: Intent,
+                snapshots: dict, nodes: dict, gates: dict,
+                now: float) -> dict:
+        tenant = f"{namespace}/{pod_name}"
+        decision = {"at": now, "tenant": tenant,
+                    "namespace": namespace, "pod": pod_name,
+                    "from_chips": intent.desired_chips,
+                    "action": "hold", "reason": "steady",
+                    "gates": gates,
+                    "trace_id": trace.current_trace_id()}
+
+        def hold(reason: str) -> dict:
+            decision["reason"] = reason
+            AUTOSCALE_SKIPS.inc(outcome=reason)
+            self._streaks.pop(tenant, None)
+            return decision
+
+        fit = self.model.fit(tenant, now=now)
+        decision["fit"] = fit
+        if fit["verdict"] != "ok":
+            # refuse, don't thrash: no decision on untrusted telemetry
+            return hold({"stale": "stale-telemetry",
+                         "sparse": "sparse-telemetry",
+                         "untracked": "untracked"}.get(
+                             fit["verdict"], "error"))
+        snap = snapshots.get(tenant) or {}
+        queue = float(snap.get("queue_depth") or 0.0)
+        util = float(fit.get("utilization", 0.0))
+        decision["queue_depth"] = queue
+        decision["utilization"] = util
+        wants_grow = (queue >= float(self.cfg.autoscale_queue_grow)
+                      and util >= float(self.cfg.autoscale_util_grow))
+        wants_shrink = (queue <= float(self.cfg.autoscale_queue_shrink)
+                        and util
+                        <= float(self.cfg.autoscale_util_shrink))
+        if not wants_grow and not wants_shrink:
+            return hold("steady")
+        direction = "grow" if wants_grow else "shrink"
+        streaks = self._streaks.setdefault(
+            tenant, {"grow": 0, "shrink": 0})
+        # a flipped signal resets the opposite streak: hysteresis means
+        # N CONSECUTIVE passes agreeing, not N passes ever
+        streaks["grow" if wants_shrink else "shrink"] = 0
+        streaks[direction] += 1
+        decision["streak"] = streaks[direction]
+        if streaks[direction] < int(self.cfg.autoscale_hysteresis):
+            decision["reason"] = "hysteresis"
+            AUTOSCALE_SKIPS.inc(outcome="hysteresis")
+            return decision
+        last = self._cooldowns.get(tenant)
+        if last is not None and \
+                now - last < float(self.cfg.autoscale_cooldown_s):
+            decision["reason"] = "cooldown"
+            decision["cooldown_remaining_s"] = round(
+                float(self.cfg.autoscale_cooldown_s) - (now - last), 1)
+            AUTOSCALE_SKIPS.inc(outcome="cooldown")
+            return decision
+        step = max(1, int(self.cfg.autoscale_max_step))
+        if direction == "grow":
+            ceiling = int(self.cfg.max_tpu_per_request)
+            target = min(intent.desired_chips + step, ceiling)
+            if target <= intent.desired_chips:
+                return hold("at-ceiling")
+            feas = self._grow_feasibility(
+                target - intent.desired_chips, nodes)
+            decision["feasibility"] = feas
+            if feas["verdict"] == "infeasible":
+                return hold("infeasible")
+            if feas["verdict"] == "admissible-after-defrag":
+                # defer the grow; the defragmenter works the contiguity
+                # problem and the next pass re-evaluates against the
+                # recovered fleet
+                self._request_defrag(tenant,
+                                     target - intent.desired_chips)
+                decision["reason"] = "infeasible"
+                decision["deferred"] = "requested-defrag"
+                AUTOSCALE_SKIPS.inc(outcome="infeasible")
+                return decision
+        else:
+            floor = max(1, intent.min_chips)
+            target = max(intent.desired_chips - step, floor)
+            if target >= intent.desired_chips:
+                return hold("at-floor")
+        return self._actuate(decision, namespace, pod_name, intent,
+                             target, direction, now)
+
+    def _actuate(self, decision: dict, namespace: str, pod_name: str,
+                 intent: Intent, target: int, direction: str,
+                 now: float) -> dict:
+        tenant = decision["tenant"]
+        try:
+            self.elastic.store.put(
+                namespace, pod_name,
+                Intent(desired_chips=target,
+                       min_chips=intent.min_chips,
+                       priority=intent.priority))
+            self.elastic.enqueue(namespace, pod_name)
+        except Exception as exc:  # noqa: BLE001 — actuation boundary:
+            # a failed intent write is a recorded non-decision, and the
+            # streak survives so the next pass retries
+            decision["action"] = "hold"
+            decision["reason"] = "error"
+            decision["error"] = str(exc)
+            AUTOSCALE_SKIPS.inc(outcome="error")
+            logger.warning("autoscale %s of %s failed to write intent: "
+                           "%s", direction, tenant, exc)
+            return decision
+        decision["action"] = direction
+        decision["to_chips"] = target
+        decision["reason"] = ("saturated-queue" if direction == "grow"
+                              else "idle-capacity")
+        self._cooldowns[tenant] = now
+        self._streaks.pop(tenant, None)
+        AUTOSCALE_DECISIONS.inc(outcome=direction)
+        summary = (f"autoscale {direction} {tenant}: "
+                   f"{decision['from_chips']} -> {target} chip(s) "
+                   f"(queue {decision['queue_depth']:.0f}, "
+                   f"utilization {decision['utilization']:.2f})")
+        AUDIT.record("autoscale.decision", actor="autoscale-controller",
+                     outcome=f"{direction}: {decision['from_chips']} "
+                             f"-> {target}",
+                     namespace=namespace, pod=pod_name,
+                     action=direction,
+                     from_chips=decision["from_chips"],
+                     to_chips=target,
+                     queue_depth=decision["queue_depth"],
+                     utilization=decision["utilization"],
+                     trace_id=decision["trace_id"])
+        FLIGHT.record("marker", summary,
+                      trace_id=decision["trace_id"] or "")
+        logger.info("%s", summary)
+        return decision
+
+    # --- pause / resume ---
+
+    def pause(self, actor: str = "operator") -> dict:
+        """Stop deciding (idempotent). In-flight passes park at the
+        next tenant boundary; reads keep working."""
+        self._paused.set()
+        AUTOSCALE_PAUSED.set(1.0)
+        AUDIT.record("autoscale.pause", actor=actor, outcome="paused")
+        FLIGHT.record("marker", f"autoscale paused by {actor}")
+        return self.payload()
+
+    def resume(self, actor: str = "operator") -> dict:
+        self._paused.clear()
+        AUTOSCALE_PAUSED.set(0.0)
+        AUDIT.record("autoscale.resume", actor=actor, outcome="resumed")
+        FLIGHT.record("marker", f"autoscale resumed by {actor}")
+        return self.payload()
+
+    @property
+    def paused(self) -> bool:
+        return self._paused.is_set()
+
+    # --- surfaces ---
+
+    def payload(self) -> dict:
+        """The GET /autoscale response: gate verdicts, the model's
+        per-tenant fits, the last pass and recent decision history."""
+        gates = self._gate_state()
+        now = self.clock()
+        with self._lock:
+            last = copy.deepcopy(self._last_pass)
+            history = [copy.deepcopy(r) for r in self._history]
+            cooldowns = {
+                t: round(float(self.cfg.autoscale_cooldown_s)
+                         - (now - at), 1)
+                for t, at in self._cooldowns.items()
+                if now - at < float(self.cfg.autoscale_cooldown_s)}
+        decisions = [d for r in history for d in r["decisions"]
+                     if d["action"] in ("grow", "shrink")]
+        return {
+            "at": round(now, 3),
+            "enabled": bool(self.cfg.autoscale_enabled),
+            "paused": gates["paused"],
+            "gates": gates,
+            "model": self.model.payload(now=now),
+            "last_pass": last,
+            "decisions": decisions[-16:],
+            "cooldowns": cooldowns,
+        }
+
+    # --- background loop (opt-in via autoscale_enabled) ---
+
+    def start(self) -> None:
+        if self._loop_thread is not None:
+            return
+        self._stop.clear()
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="autoscale-loop", daemon=True)
+        self._loop_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._loop_thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._loop_thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(float(self.cfg.autoscale_interval_s)):
+            try:
+                self.evaluate_once()
+            except AutoscaleRefused as exc:
+                logger.info("autoscale pass parked: %s (%s)", exc,
+                            exc.cause)
+            except Exception as exc:  # noqa: BLE001 — the loop is the
+                # scaling heartbeat; one bad pass must not kill it
+                logger.exception("autoscale pass failed: %s", exc)

@@ -1,0 +1,235 @@
+"""Per-tenant batch-size -> tokens/sec throughput model.
+
+The autoscaler's decisions are only as good as its idea of what a
+tenant's current chips can actually deliver. Naive queue-threshold
+scaling grows a tenant that is queue-deep because its batch is tiny
+(more chips would idle) and shrinks one that is briefly quiet at full
+saturation (the next wave hits a half-sized slice). The fix, per the
+batch-size characterization literature (PAPERS.md), is to scale along
+a *measured* saturating curve:
+
+    rate(b) = r_max * b / (b + b_half)
+
+fit online from the tenant's own `/tenants` step telemetry. Tenant
+snapshots (jaxside/telemetry.py) carry cumulative step and token
+counters, not an explicit batch size, so each observation is a DELTA
+between consecutive snapshots: batch = d_tokens / d_steps (tokens per
+step — the per-step work size the serving stack actually ran), paired
+with the published tokens_per_s for that window.
+
+The fit is the linearized least squares of the Michaelis-Menten form
+(1/r against 1/b): stdlib-only, O(history) per fit, robust enough for
+the monotone saturating shapes step servers produce. What matters more
+than fit quality is the refusal discipline: a tenant with fewer than
+``autoscale_min_samples`` observations is `sparse`, one whose newest
+sample is older than ``autoscale_stale_s`` is `stale`, and the
+controller acts on neither — the capacity plane's "refuse, don't
+thrash" contract applied to telemetry (docs/FAQ.md).
+
+History is bounded per tenant (``autoscale_history`` deque) and the
+tenant table is bounded (``autoscale_max_tenants``, the obs/tenants.py
+256-tenant convention): a churny namespace cannot grow this model's
+memory, and nothing here ever becomes a metric label.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("autoscale.model")
+
+#: fit verdict vocabulary (bounded; FAQ documents each)
+VERDICTS = ("ok", "sparse", "stale", "untracked")
+
+
+def fit_curve(samples: list[tuple[float, float]]) -> dict | None:
+    """Least-squares fit of rate = r_max * b / (b + b_half) over
+    (batch, rate) pairs via the double-reciprocal linearization
+    1/r = (b_half/r_max) * (1/b) + 1/r_max. Returns {r_max, b_half,
+    rmse} or None when the inputs are degenerate (all-equal batches
+    carry no curvature — fall back to the mean-rate plateau)."""
+    pts = [(b, r) for b, r in samples if b > 0 and r > 0]
+    if len(pts) < 2:
+        return None
+    xs = [1.0 / b for b, _ in pts]
+    ys = [1.0 / r for _, r in pts]
+    n = float(len(pts))
+    mean_x = sum(xs) / n
+    mean_y = sum(ys) / n
+    var_x = sum((x - mean_x) ** 2 for x in xs)
+    if var_x <= 1e-12:
+        # One distinct batch size: no slope is identifiable. Treat the
+        # observed mean rate as the plateau (b_half=0 -> rate==r_max
+        # at any batch) so utilization still reads sanely.
+        mean_rate = sum(r for _, r in pts) / n
+        return {"r_max": mean_rate, "b_half": 0.0, "rmse": 0.0,
+                "plateau_only": True}
+    slope = sum((x - mean_x) * (y - mean_y)
+                for x, y in zip(xs, ys)) / var_x
+    intercept = mean_y - slope * mean_x
+    if intercept <= 1e-12:
+        # A non-positive 1/r_max means the linearization broke on this
+        # window (heavy noise); report the plateau fallback instead of
+        # an infinite capacity the controller would scale against.
+        mean_rate = sum(r for _, r in pts) / n
+        return {"r_max": mean_rate, "b_half": 0.0, "rmse": 0.0,
+                "plateau_only": True}
+    r_max = 1.0 / intercept
+    b_half = max(0.0, slope * r_max)
+    err = 0.0
+    for b, r in pts:
+        pred = r_max * b / (b + b_half) if (b + b_half) > 0 else 0.0
+        err += (pred - r) ** 2
+    return {"r_max": r_max, "b_half": b_half,
+            "rmse": (err / n) ** 0.5, "plateau_only": False}
+
+
+def predict(fit: dict, batch: float) -> float:
+    """Modeled tokens/sec at a batch size, from a fit_curve() result."""
+    b_half = float(fit.get("b_half", 0.0))
+    r_max = float(fit.get("r_max", 0.0))
+    if batch <= 0 or (batch + b_half) <= 0:
+        return 0.0
+    return r_max * batch / (batch + b_half)
+
+
+class ThroughputModel:
+    """Bounded online store of per-tenant throughput observations plus
+    the fit/verdict surface the controller consumes. One per master
+    process; all state in memory (the model re-learns from live
+    telemetry within a few scrapes of a restart — deliberately not
+    durable, matching the defrag planner's cheap-to-recompute stance).
+    """
+
+    def __init__(self, cfg=None, clock=None):
+        self.cfg = cfg or get_config()
+        #: injectable clock (the diurnal bench drives simulated time)
+        self.clock = clock or time.time
+        self._lock = OrderedLock("autoscale.model")
+        #: tenant -> deque[(at, batch, tokens_per_s)]
+        self._samples: dict[str, deque] = {}
+        #: tenant -> last cumulative snapshot used for the delta
+        self._last: dict[str, dict] = {}
+        #: tenants refused by the table bound (a count, not names:
+        #: unbounded names stay out of every payload and label)
+        self.overflow_dropped = 0
+
+    # --- ingestion ---
+
+    def observe(self, tenant: str, snapshot: dict) -> tuple | None:
+        """Fold one /tenants snapshot in. Returns the derived
+        (at, batch, tokens_per_s) sample, or None when the snapshot
+        yields no new delta (first sighting, no step progress, counter
+        reset, or tenant-table overflow)."""
+        steps = (snapshot.get("steps") or {})
+        count = float(steps.get("count") or 0.0)
+        tokens = float(snapshot.get("tokens_total") or 0.0)
+        at = float(snapshot.get("at") or 0.0)
+        rate = float(snapshot.get("tokens_per_s") or 0.0)
+        with self._lock:
+            prev = self._last.get(tenant)
+            if prev is None and tenant not in self._samples:
+                limit = int(self.cfg.autoscale_max_tenants)
+                if len(self._samples) >= limit:
+                    self.overflow_dropped += 1
+                    return None
+                self._samples[tenant] = deque(
+                    maxlen=max(2, int(self.cfg.autoscale_history)))
+            self._last[tenant] = {"count": count, "tokens": tokens,
+                                  "at": at}
+            if prev is None:
+                return None
+            d_steps = count - prev["count"]
+            d_tokens = tokens - prev["tokens"]
+            if d_steps <= 0 or d_tokens <= 0 or at <= prev["at"]:
+                # no progress, or a restarted tenant reset its
+                # cumulative counters — re-baseline, never extrapolate
+                return None
+            batch = d_tokens / d_steps
+            if rate <= 0.0:
+                rate = d_tokens / max(1e-9, at - prev["at"])
+            sample = (at, batch, rate)
+            self._samples[tenant].append(sample)
+            return sample
+
+    def observe_nodes(self, nodes: dict) -> int:
+        """Fleet-collector observer hook (same contract as the capacity
+        and health planes): fold every tenant snapshot from a fresh
+        node map. Returns samples derived. Never raises."""
+        derived = 0
+        try:
+            from gpumounter_tpu.obs.fleet import merge_tenants
+            for tenant, snap in merge_tenants(nodes).items():
+                if self.observe(tenant, snap) is not None:
+                    derived += 1
+        except Exception:  # noqa: BLE001 — observer contract: the
+            # model is advisory; its bugs must not fail telemetry
+            logger.exception("throughput observation failed")
+        return derived
+
+    def forget(self, tenant: str) -> None:
+        with self._lock:
+            self._samples.pop(tenant, None)
+            self._last.pop(tenant, None)
+
+    # --- fitting ---
+
+    def fit(self, tenant: str, now: float | None = None) -> dict:
+        """The controller's question: what does this tenant's curve
+        look like, and may I act on it? Always returns a dict with a
+        `verdict` from VERDICTS; curve parameters only when "ok"."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            samples = list(self._samples.get(tenant) or ())
+        out: dict = {"tenant": tenant, "samples": len(samples)}
+        if tenant not in self._samples:
+            out["verdict"] = "untracked"
+            return out
+        if len(samples) < int(self.cfg.autoscale_min_samples):
+            out["verdict"] = "sparse"
+            return out
+        newest = max(at for at, _, _ in samples)
+        age = now - newest
+        out["newest_age_s"] = round(age, 3)
+        if age > float(self.cfg.autoscale_stale_s):
+            out["verdict"] = "stale"
+            return out
+        curve = fit_curve([(b, r) for _, b, r in samples])
+        if curve is None:
+            out["verdict"] = "sparse"
+            return out
+        out["verdict"] = "ok"
+        out.update(r_max=round(curve["r_max"], 3),
+                   b_half=round(curve["b_half"], 3),
+                   rmse=round(curve["rmse"], 3),
+                   plateau_only=curve["plateau_only"])
+        last_at, last_batch, last_rate = samples[-1]
+        out["last_batch"] = round(last_batch, 3)
+        out["last_rate"] = round(last_rate, 3)
+        # Utilization: observed rate against the modeled plateau. At
+        # 1.0 the tenant is extracting everything its current slice
+        # can give — more queue means more chips, not bigger batches.
+        if curve["r_max"] > 0:
+            out["utilization"] = round(
+                min(2.0, last_rate / curve["r_max"]), 3)
+        else:
+            out["utilization"] = 0.0
+        return out
+
+    # --- surfaces ---
+
+    def payload(self, now: float | None = None) -> dict:
+        """The model half of GET /autoscale: per-tenant fit summaries
+        (bounded by the tenant cap) + the overflow count."""
+        with self._lock:
+            tenants = sorted(self._samples)
+        return {
+            "tenants": {t: self.fit(t, now=now) for t in tenants},
+            "tracked": len(tenants),
+            "overflow_dropped": self.overflow_dropped,
+        }

@@ -47,6 +47,11 @@ Two families of verbs:
                                    capacity-recovery migration sequence
                                    (no flag: the state pane; exit 3
                                    when the controller is gated)
+    autoscale [--pause|--resume|--evaluate]
+                                   closed-loop autoscaler: per-tenant
+                                   throughput fits + recent decisions
+                                   (no flag: the state pane; exit 3
+                                   when gated or paused)
     apihealth                      API-outage degraded mode: ApiHealth
                                    verdict, cache staleness, write-behind
                                    queue (exit 3 when not healthy)
@@ -682,6 +687,43 @@ def cmd_defrag(args) -> int:
     return 0 if status == 200 else 1
 
 
+def cmd_autoscale(args) -> int:
+    """The closed-loop autoscaler. No flag: the state pane (GET
+    /autoscale — per-tenant throughput fits, gates, recent decisions;
+    exit 3 when the controller is gated or paused). --pause parks it,
+    --resume un-parks it, --evaluate forces one decision pass now (all
+    POST; mutate token). A 409/503 refusal (SLO burn, degraded API,
+    stale telemetry) exits 2: the controller refused, nothing scaled."""
+    if args.pause:
+        status, body = _http(args, "POST", "/autoscale/pause",
+                             json_body={}, token=_remote_token(args))
+    elif args.resume:
+        status, body = _http(args, "POST", "/autoscale/resume",
+                             json_body={}, token=_remote_token(args))
+    elif args.evaluate:
+        status, body = _http(args, "POST", "/autoscale/evaluate",
+                             json_body={}, token=_remote_token(args))
+    else:
+        status, body = _http(args, "GET", "/autoscale",
+                             token=_obs_token(args))
+        print(body.rstrip())
+        if status != 200:
+            return 1
+        try:
+            pane = json.loads(body)
+        except ValueError:
+            return 1
+        gates = pane.get("gates", {})
+        gated = (not gates.get("api_ok", True)
+                 or gates.get("slo_burning")
+                 or pane.get("paused"))
+        return 3 if gated else 0
+    print(body.rstrip())
+    if status in (409, 503):
+        return 2
+    return 0 if status == 200 else 1
+
+
 def cmd_shares(args) -> int:
     """Fractional chip shares. No flag: the share books (GET /shares;
     exit 3 when any chip's booked load exceeds the weight capacity —
@@ -1211,6 +1253,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="with --run: refuse unless this exact plan "
                          "is still adopted")
     df.set_defaults(fn=cmd_defrag)
+
+    asc = sub.add_parser("autoscale",
+                         help="closed-loop autoscaler: per-tenant "
+                              "throughput fits + gated grow/shrink "
+                              "decisions on elastic intents (no flag: "
+                              "state pane, exit 3 when gated or "
+                              "paused; --pause/--resume/--evaluate "
+                              "mutate, exit 2 on a controller refusal)")
+    _obs_common(asc)
+    asc_group = asc.add_mutually_exclusive_group()
+    asc_group.add_argument("--pause", action="store_true",
+                           help="park the decision loop (passes still "
+                                "observe; nothing actuates)")
+    asc_group.add_argument("--resume", action="store_true",
+                           help="un-park the decision loop")
+    asc_group.add_argument("--evaluate", action="store_true",
+                           help="force one decision pass now")
+    asc.set_defaults(fn=cmd_autoscale)
 
     vs = sub.add_parser("shares",
                         help="fractional chip shares: the co-location "

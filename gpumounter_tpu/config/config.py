@@ -541,6 +541,59 @@ class Config:
     defrag_group_fanout: int = field(default_factory=lambda: int(
         _env("DEFRAG_GROUP_FANOUT", "2")))
 
+    # --- closed-loop autoscaler (gpumounter_tpu/autoscale) ---
+    # The background decision loop is off by default for the same
+    # reason the defragmenter is: acting on intents moves live tenant
+    # capacity, so closing the loop is an explicit operator decision.
+    # GET /autoscale and the pause/resume verbs work either way.
+    autoscale_enabled: bool = field(default_factory=lambda: _env(
+        "TPUMOUNTER_AUTOSCALE", "false").lower() in ("1", "true", "yes"))
+    # Cadence of the background evaluate loop when enabled.
+    autoscale_interval_s: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_INTERVAL_S", "60")))
+    # Per-tenant rate limit: after any grow/shrink on a tenant, no
+    # further decision on that tenant for this long (the anti-flap half
+    # of hysteresis; the other half is the streak requirement below).
+    autoscale_cooldown_s: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_COOLDOWN_S", "300")))
+    # Telemetry freshness bound: a tenant whose newest step sample is
+    # older than this gets the stale-telemetry refusal, never a guess
+    # (the capacity-plane "refuse, don't thrash" contract).
+    autoscale_stale_s: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_STALE_S", "120")))
+    # Minimum throughput samples before the curve fit is trusted;
+    # below it the tenant gets the sparse-telemetry refusal.
+    autoscale_min_samples: int = field(default_factory=lambda: int(
+        _env("AUTOSCALE_MIN_SAMPLES", "4")))
+    # Bounded per-tenant sample history for the batch->tokens/sec fit
+    # (a deque; old samples age out, memory stays flat).
+    autoscale_history: int = field(default_factory=lambda: int(
+        _env("AUTOSCALE_HISTORY", "64")))
+    # Tenant cap mirroring obs/tenants.py: past this many tracked
+    # tenants the model refuses new ones instead of growing unbounded.
+    autoscale_max_tenants: int = field(default_factory=lambda: int(
+        _env("AUTOSCALE_MAX_TENANTS", "256")))
+    # Grow signal: queue depth at or above this AND modeled utilization
+    # at or above autoscale_util_grow.
+    autoscale_queue_grow: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_QUEUE_GROW", "32")))
+    # Shrink signal: queue depth at or below this AND utilization at or
+    # below autoscale_util_shrink.
+    autoscale_queue_shrink: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_QUEUE_SHRINK", "2")))
+    autoscale_util_grow: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_UTIL_GROW", "0.85")))
+    autoscale_util_shrink: float = field(default_factory=lambda: float(
+        _env("AUTOSCALE_UTIL_SHRINK", "0.35")))
+    # Consecutive evaluation passes a grow/shrink signal must persist
+    # before a decision fires (the streak half of hysteresis).
+    autoscale_hysteresis: int = field(default_factory=lambda: int(
+        _env("AUTOSCALE_HYSTERESIS", "2")))
+    # Chips added/removed per decision; small steps + cooldown beat
+    # one big jump the model may regret.
+    autoscale_max_step: int = field(default_factory=lambda: int(
+        _env("AUTOSCALE_MAX_STEP", "2")))
+
     # --- fractional chip virtualization (gpumounter_tpu/vchip) ---
     # The admission controller for policy-carrying fractional shares:
     # inert until a share is requested (POST /shares), so it defaults

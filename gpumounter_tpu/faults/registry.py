@@ -30,6 +30,9 @@ FAILPOINTS: dict[str, str] = {
     # defragmenter (gpumounter_tpu/defrag/controller.py)
     "defrag.run": "top of a defrag plan execution, before the first "
                   "barrier sample",
+    # autoscaler (gpumounter_tpu/autoscale/controller.py)
+    "autoscale.pass": "top of one evaluate pass, before any tenant is "
+                      "considered",
     # warm pool (gpumounter_tpu/allocator/pool.py)
     "pool.refill": "per-node warm-pool refill attempt",
     # health plane (gpumounter_tpu/health/plane.py)

@@ -392,6 +392,13 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/shares$"), "shares_admit"),
     ("DELETE", re.compile(
         r"^/shares/(?P<ns>[^/]+)/(?P<pod>[^/]+)$"), "shares_release"),
+    # Closed-loop autoscaler (gpumounter_tpu/autoscale/): the decision
+    # pane (model fits, gate verdicts, recent decisions) + the audited
+    # operator pause/resume verbs.
+    ("GET", re.compile(r"^/autoscale$"), "autoscale"),
+    ("POST", re.compile(r"^/autoscale/pause$"), "autoscale_pause"),
+    ("POST", re.compile(r"^/autoscale/resume$"), "autoscale_resume"),
+    ("POST", re.compile(r"^/autoscale/evaluate$"), "autoscale_evaluate"),
 ]
 
 
@@ -424,7 +431,8 @@ class MasterApp:
     READ_ROUTES = frozenset({"metrics", "audit", "trace", "fleet", "slo",
                              "shards", "recovery", "tenants",
                              "apihealth", "timeline", "capacity",
-                             "defrag", "shares", "health_nodes"})
+                             "defrag", "shares", "health_nodes",
+                             "autoscale"})
 
     #: mutating routes whose edge outcome lands in the audit trail
     #: (worker-side records carry the chip-level detail for the same
@@ -434,7 +442,8 @@ class MasterApp:
         "intent_put", "intent_delete", "migrate_start",
         "migration_abort", "recovery_evacuate", "health_quarantine",
         "defrag_plan", "defrag_run", "defrag_pause", "shares_admit",
-        "shares_release"})
+        "shares_release", "autoscale_pause", "autoscale_resume",
+        "autoscale_evaluate"})
 
     def __init__(self, kube: KubeClient, cfg=None,
                  worker_client_factory=None,
@@ -606,6 +615,22 @@ class MasterApp:
         self.shares = ShareRegistry(cfg=self.cfg)
         self.packer = SharePacker(self.shares, cfg=self.cfg)
         self.capacity.shares = self.shares
+        # Closed-loop autoscaler (gpumounter_tpu/autoscale/): fits the
+        # per-tenant batch->tokens/sec curve from the fleet's /tenants
+        # telemetry and converts queue/throughput trends into gated
+        # grow/shrink decisions on elastic intents. The throughput
+        # model also rides every fleet collect pass (the capacity/
+        # health observer contract) so the curve keeps learning even
+        # when the decision loop is off. The background loop only runs
+        # after an explicit autoscale.start() (master/main.py, opt-in
+        # via TPUMOUNTER_AUTOSCALE) — GET /autoscale and the pause/
+        # resume verbs work either way.
+        from gpumounter_tpu.autoscale import AutoscaleController
+        self.autoscale = AutoscaleController(
+            self.elastic, self.capacity, self.fleet, slo=self.slo,
+            apihealth=self.apihealth, health=self.health,
+            defrag=self.defrag, cfg=self.cfg)
+        self.fleet.autoscale_model = self.autoscale.model
         # Flight recorder (obs/flight.py): root/error spans, audit
         # records and ApiHealth transitions of this replica feed the
         # /timeline pane. Idempotent — any number of apps/tests share
@@ -642,7 +667,8 @@ class MasterApp:
     UNTRACED_ROUTES = frozenset({"index", "healthz", "metrics", "fleet",
                                  "slo", "shards", "recovery", "tenants",
                                  "apihealth", "timeline", "capacity",
-                                 "defrag", "shares", "health_nodes"})
+                                 "defrag", "shares", "health_nodes",
+                                 "autoscale"})
 
     #: routes that bypass the admission gate: liveness/scrape surfaces
     #: must answer even when the replica is saturated by a mount storm
@@ -1060,6 +1086,58 @@ class MasterApp:
         import json as jsonlib
         return 200, "application/json", \
             jsonlib.dumps(self.defrag.pause(), indent=1) + "\n"
+
+    def _route_autoscale(self, match, body, headers):
+        """The autoscaler's state pane: gate verdicts (ApiHealth +
+        tenant-SLO burn + pause), the throughput model's per-tenant
+        fits with their refusal verdicts, the last evaluate pass and
+        recent grow/shrink decisions — the RUNBOOK's 'Reading and
+        pausing the autoscaler' walkthrough reads this between every
+        step."""
+        import json as jsonlib
+        return 200, "application/json", \
+            jsonlib.dumps(self.autoscale.payload(), indent=1) + "\n"
+
+    def _autoscale_call(self, fn, *args, **kwargs):
+        """Refusal mapping, the _defrag_call shape: an AutoscaleRefused
+        carries its own HTTP status (409 paused/busy, 503 parked) —
+        the 503s get a Retry-After so operator scripts back off."""
+        from gpumounter_tpu.autoscale import AutoscaleRefused
+        try:
+            return fn(*args, **kwargs)
+        except AutoscaleRefused as exc:
+            headers = {}
+            # AutoscaleRefused is our own HTTP refusal type, not a k8s
+            # API error — .status IS the response code it asks for.
+            if exc.status == 503:  # tpulint: allow[typed-k8s-errors] own HTTP type
+                headers["Retry-After"] = str(
+                    int(self.cfg.autoscale_interval_s))
+            raise _HttpError(exc.status, str(exc), headers=headers)
+
+    def _route_autoscale_pause(self, match, body, headers):
+        import json as jsonlib
+        actor = headers.get("x-tpumounter-actor", "http")
+        return 200, "application/json", \
+            jsonlib.dumps(self.autoscale.pause(actor=actor),
+                          indent=1) + "\n"
+
+    def _route_autoscale_resume(self, match, body, headers):
+        import json as jsonlib
+        actor = headers.get("x-tpumounter-actor", "http")
+        return 200, "application/json", \
+            jsonlib.dumps(self.autoscale.resume(actor=actor),
+                          indent=1) + "\n"
+
+    def _route_autoscale_evaluate(self, match, body, headers):
+        """Run one evaluate pass now instead of waiting for the
+        background interval (the defrag /plan analogue). Refusals —
+        paused (409), SLO burn or degraded API (503 + Retry-After) —
+        map through _autoscale_call; nothing fires through a closed
+        gate."""
+        import json as jsonlib
+        out = self._autoscale_call(self.autoscale.evaluate_once)
+        return 200, "application/json", \
+            jsonlib.dumps(out, indent=1) + "\n"
 
     def _route_shares(self, match, body, headers):
         """The fractional share books: every (tenant, chip, weight,

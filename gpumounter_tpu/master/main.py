@@ -68,6 +68,17 @@ def main() -> None:
         logger.info("defragmenter on (interval %.0fs, target block %d, "
                     "max %d moves/plan)", cfg.defrag_interval_s,
                     cfg.defrag_target_block, cfg.defrag_max_moves)
+    # Autoscale decision loop (opt-in via TPUMOUNTER_AUTOSCALE): every
+    # AUTOSCALE_INTERVAL_S fit the per-tenant throughput curves from
+    # the fleet rollup and turn queue/utilization trends into elastic
+    # intent updates. All state is in-memory (the model re-learns from
+    # live telemetry within a few scrapes) — a restart just means a few
+    # quiet passes before the controller trusts its fits again.
+    if cfg.autoscale_enabled:
+        app.autoscale.start()
+        logger.info("autoscaler on (interval %.0fs, cooldown %.0fs, "
+                    "max step %d)", cfg.autoscale_interval_s,
+                    cfg.autoscale_cooldown_s, cfg.autoscale_max_step)
     # Canary prober: active gray-failure probes (synthetic mount ->
     # verify -> unmount) against suspect/quarantined nodes. The passive
     # scorer rides the fleet collect pass and needs no thread of its
@@ -99,6 +110,8 @@ def main() -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if cfg.autoscale_enabled:
+            app.autoscale.stop()
         if cfg.defrag_enabled:
             app.defrag.stop()
         app.canary.stop()

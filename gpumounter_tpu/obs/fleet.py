@@ -421,6 +421,10 @@ class FleetCollector:
         #: the quarantine state machine. Same observer contract as the
         #: capacity plane: exception-isolated, fail-open.
         self.health = None
+        #: optional ThroughputModel (autoscale/model.py): folds every
+        #: pass's tenant snapshots into per-tenant batch->rate history.
+        #: Same observer contract: exception-isolated, fail-open.
+        self.autoscale_model = None
         self.interval_s = cfg.fleet_scrape_interval_s
         #: per-node collection fan-out width: a few wedged workers each
         #: burn their full RPC deadline, so a serial pass would stall
@@ -574,6 +578,15 @@ class FleetCollector:
                 except Exception:  # noqa: BLE001 — same observer
                     # contract as capacity: never fail telemetry
                     logger.exception("health observation failed")
+            if self.autoscale_model is not None:
+                # The throughput model learns from the same per-pass
+                # tenant snapshots /tenants serves — the autoscaler can
+                # never act on telemetry the panes don't show.
+                try:
+                    self.autoscale_model.observe_nodes(fresh)
+                except Exception:  # noqa: BLE001 — same observer
+                    # contract as capacity: never fail telemetry
+                    logger.exception("throughput observation failed")
             FLEET_NODES.set(float(len(fresh)))
             FLEET_COLLECT_DURATION.observe(time.monotonic() - t0)
             rollup = self.payload(max_age_s=None)

@@ -116,6 +116,18 @@ invariants after convergence:
      (disable_scorer: the plane switched off while the node limps)
      DETECTED as a missed detection,
 
+ 21. autoscale decision closure (run_autoscale_scenario): after the
+     autoscaler has grown and shrunk tenants mid-chaos and the fleet
+     converged, every tenant's mounted chips equal its declared
+     intent (intents == books == mounts == ledger — the books/mounts/
+     ledger legs are invariants 1-3, 10 and 17 over the same run);
+     every fired grow/shrink decision is trace-attributed and carries
+     a matching `autoscale.decision` audit record; and NO decision
+     ever fired through a recorded-closed gate (paused, degraded API,
+     or a burning tenant SLO). The negative control (disable_gates:
+     enforcement off while the controller is paused) must be DETECTED
+     as gate bypass,
+
 Determinism: all randomness flows from one seed (`random.Random(seed)`);
 the executed schedule is logged step by step and embedded in the
 InvariantViolation message so a failing run reproduces from its seed.
@@ -373,6 +385,12 @@ class ChaosHarness:
         #: is the set of nodes the scenario deliberately degraded.
         self.gray_armed = False
         self.gray_nodes: set[str] = set()
+        #: run_autoscale_scenario arms this so check_invariants also
+        #: asserts invariant 21 (autoscale decision closure); the pass
+        #: records carry each decision's gates/trace for the audit.
+        self.autoscale_armed = False
+        self.autoscale_passes: list[dict] = []
+        self.autoscale_pods: list[tuple[str, str]] = []
         self.app: MasterApp | None = None
 
     # --- lifecycle ---
@@ -1187,6 +1205,111 @@ class ChaosHarness:
                 "breaker": "closed",
             }
         return entries
+
+    # --- invariant 21: autoscale decision closure ---
+
+    class _TenantOverlayFleet:
+        """Real fleet rollup + harness-simulated tenant telemetry.
+
+        The autoscaler reads tenant snapshots out of the fleet node
+        entries (the /tenants path). Every fake node here runs in ONE
+        process, so real per-tenant step telemetry can't ride the
+        worker RPC per node; like _gray_entries for the health plane,
+        the harness fabricates the tenant sections itself — on top of
+        the REAL collected rollup, so capacity/health stay genuine."""
+
+        def __init__(self, fleet, tenants_by_node):
+            self.fleet = fleet
+            self.tenants_by_node = tenants_by_node
+
+        def payload(self, max_age_s=None):
+            rollup = self.fleet.payload(max_age_s=max_age_s)
+            for node, snaps in self.tenants_by_node.items():
+                entry = rollup.get("nodes", {}).get(node)
+                if entry is not None:
+                    entry["tenants"] = {
+                        t: dict(s) for t, s in snaps.items()}
+            return rollup
+
+    def run_autoscale_scenario(self, n_passes: int = 8,
+                               disable_gates: bool = False) -> dict:
+        """Drive the REAL autoscale controller over the live harness:
+        one saturated tenant (deep queue, rate pinned to its learned
+        plateau) that must be grown, one idle tenant (empty queue, low
+        utilization) that must be shrunk to its floor — with elastic
+        faults armed around the reconciles that actuate the decisions.
+
+        disable_gates=True is the NEGATIVE CONTROL: enforcement off
+        while the controller is operator-paused, so decisions fire
+        through a recorded-closed gate — invariant 21 must DETECT it.
+
+        Returns {"passes": pass records, "fired": decision count}."""
+        from gpumounter_tpu.autoscale import AutoscaleRefused
+        from gpumounter_tpu.elastic.intents import Intent
+        failpoints.seed(self.seed)
+        self.autoscale_armed = True
+        ctrl = self.app.autoscale
+        # Test-speed knobs: no cooldown (a pass is a simulated interval,
+        # not 60 real seconds); everything else at production defaults.
+        ctrl.cfg = self.cfg.replace(autoscale_cooldown_s=0.0)
+        ctrl.model.cfg = ctrl.cfg
+        pods = [("default", "as-grow", NODE_A, 2, 50.0, 160.0),
+                ("default", "as-shrink", NODE_B, 3, 0.0, 3.0)]
+        tenants_by_node: dict[str, dict[str, dict]] = {}
+        cumulative: dict[str, dict] = {}
+        for ns, name, node, desired, queue, batch in pods:
+            self.add_pod(name, node, namespace=ns)
+            self.autoscale_pods.append((ns, name))
+            self.app.elastic.store.put(ns, name, Intent(
+                desired_chips=desired, min_chips=1))
+            self.app.elastic.reconcile_once(ns, name)
+            cumulative[f"{ns}/{name}"] = {
+                "node": node, "steps": 0.0, "tokens": 0.0,
+                "queue": queue, "batch": batch}
+        ctrl.fleet = self._TenantOverlayFleet(self.app.fleet,
+                                              tenants_by_node)
+        if disable_gates:
+            ctrl.enforce_gates = False
+            ctrl.pause(actor="chaos-negative-control")
+            self.record("negative control: autoscale gate enforcement "
+                        "disabled while operator-paused")
+        fired = 0
+        for n in range(n_passes):
+            for tenant, state in sorted(cumulative.items()):
+                # batches wiggle around the profile so the fit sees
+                # curvature; rates sit ON rate = 100*b/(b+10), keeping
+                # each tenant's utilization at its designed regime
+                batch = state["batch"] * (1.0 + 0.25 * self.rng.random())
+                rate = 100.0 * batch / (batch + 10.0)
+                state["steps"] += 1.0
+                state["tokens"] += batch
+                tenants_by_node.setdefault(state["node"], {})[tenant] = {
+                    "steps": {"count": state["steps"]},
+                    "tokens_total": state["tokens"],
+                    "tokens_per_s": rate,
+                    "queue_depth": state["queue"],
+                    "at": time.time(),
+                }
+            try:
+                record = ctrl.evaluate_once()
+            except AutoscaleRefused as exc:
+                self.record(f"autoscale pass {n} refused: {exc.cause}")
+                continue
+            self.autoscale_passes.append(record)
+            for decision in record["decisions"]:
+                if decision["action"] not in ("grow", "shrink"):
+                    continue
+                fired += 1
+                self.record(
+                    f"autoscale {decision['action']} "
+                    f"{decision['tenant']}: {decision['from_chips']} -> "
+                    f"{decision['to_chips']}")
+                ns, name = decision["namespace"], decision["pod"]
+                self._op(FAULTS_ELASTIC, f"reconcile {name}",
+                         lambda ns=ns, name=name:
+                         self.app.elastic.reconcile_once(ns, name))
+        self.converge()
+        return {"passes": self.autoscale_passes, "fired": fired}
 
     # --- invariant 11: node kill -> evacuation -> re-convergence ---
 
@@ -2123,6 +2246,63 @@ class ChaosHarness:
                         f"through the whole scenario but ended "
                         f"{panes.get(node, {}).get('state', 'untracked')!r}"
                         f" instead of quarantined")
+
+        # 21. autoscale decision closure (armed by
+        # run_autoscale_scenario): every fired decision is
+        # trace-attributed with a matching audit record, none fired
+        # through a recorded-closed gate, and after convergence every
+        # autoscale tenant's mounted chips equal its declared intent —
+        # the autoscaler's writes are exactly as durable and exactly as
+        # converged as an operator's own intent edits.
+        if self.autoscale_armed:
+            audit_by_trace: dict[str, list[dict]] = {}
+            for rec in AUDIT.snapshot():
+                if rec["operation"] == "autoscale.decision":
+                    audit_by_trace.setdefault(
+                        rec.get("trace_id") or "", []).append(rec)
+            for record in self.autoscale_passes:
+                for decision in record.get("decisions", []):
+                    if decision["action"] not in ("grow", "shrink"):
+                        continue
+                    who = decision["tenant"]
+                    gates = decision.get("gates") or {}
+                    if gates.get("paused") or not gates.get("api_ok") \
+                            or gates.get("slo_burning"):
+                        violations.append(
+                            f"autoscale {decision['action']} of {who} "
+                            f"fired through a closed gate: {gates}")
+                    trace_id = decision.get("trace_id")
+                    if not trace_id:
+                        violations.append(
+                            f"autoscale {decision['action']} of {who} "
+                            f"carries no trace id (unattributable "
+                            f"decision)")
+                        continue
+                    matches = [
+                        r for r in audit_by_trace.get(trace_id, [])
+                        if r["pod"] == decision["pod"]
+                        and r.get("details", {}).get("action")
+                        == decision["action"]]
+                    if not matches:
+                        violations.append(
+                            f"autoscale {decision['action']} of {who} "
+                            f"(trace {trace_id}) left no matching "
+                            f"autoscale.decision audit record")
+            for ns, name in self.autoscale_pods:
+                if self.pods.get((ns, name)) in self.dead_nodes:
+                    continue
+                intent = self.app.elastic.store.get(ns, name)
+                if intent is None:
+                    violations.append(
+                        f"autoscale tenant {ns}/{name} lost its intent")
+                    continue
+                mounted = len(self.probe(ns, name))
+                if mounted != intent.desired_chips:
+                    violations.append(
+                        f"autoscale tenant {ns}/{name} diverged: "
+                        f"intent desires {intent.desired_chips} "
+                        f"chip(s) but {mounted} are mounted after "
+                        f"convergence")
 
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()

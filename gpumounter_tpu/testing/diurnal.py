@@ -1,0 +1,578 @@
+"""Diurnal fleet simulation for the autoscale capstone bench.
+
+This module is the deterministic world that ``bench_diurnal.py`` runs
+the REAL :class:`~gpumounter_tpu.autoscale.AutoscaleController` (and
+the real :class:`ThroughputModel` inside it) against. Nothing here
+reimplements a decision: the sim only plays the parts of the cluster
+the controller consumes through its injected seams —
+
+  fleet      ``DiurnalSim.payload(max_age_s=...)`` returns the same
+             node-map shape FleetCollector produces: per-node
+             ``capacity`` sections (free/held/warm/fenced over the
+             8-chip 2x4 ICI board) plus per-tenant ``tenants``
+             telemetry snapshots (cumulative steps/tokens counters,
+             the shape jaxside/telemetry.py publishes). The fleet
+             collector's ``refresh_if_stale`` uses the wall clock, so
+             the bench drives the controller with this object and an
+             injected simulated clock instead of a real FleetCollector.
+
+  tenants    each tenant's serving stack follows a fixed
+             Michaelis-Menten curve rate(b) = r_max*b/(b+b_half). The
+             sim publishes batch sizes derived from true load
+             (b = b_half*u/(1-u), so points lie exactly on the curve
+             modulo batch jitter) — the model must REDISCOVER the
+             curve from cumulative counters; the sim never hands it
+             the answer.
+
+  demand     per-tenant diurnal arrival curves (base + positive-half
+             sine, phase-shifted per profile) with multiplicative
+             noise; arrivals are precomputed once per seed so the
+             autoscaled leg and both static control legs serve the
+             exact same request sequence.
+
+  elastic    a store/enqueue fake records every intent the controller
+             writes; ``reconcile()`` then places/releases chips like
+             the elastic reconciler + allocator would: grows claim
+             warm chips first (the warm pool), then a contiguous ICI
+             block on one healthy host, never a quarantined or dead
+             host; shrinks release chips into the warm pool (the
+             graceful-drain abstraction — drained chips stay
+             reattachable until the TTL expires).
+
+  chaos      ``kill_nodes`` drops hosts and their chips mid-run,
+             ``quarantine_hosts`` feeds the health seam's
+             excluded_hosts, ``fragment_wave`` simulates external
+             churn shattering every free ICI block into singletons
+             (the admissible-after-defrag trigger), and the defrag
+             fake's ``run`` compacts hosts the way the real
+             defragmenter's checkpoint-assisted migrations do.
+
+Everything is seeded and wall-clock-free: identical seeds give
+identical artifacts. See bench_diurnal.py for the gates.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+
+from gpumounter_tpu.elastic.intents import Intent
+
+#: chips per simulated host (the 2x4 ICI board capacity.py models)
+CHIPS_PER_NODE = 8
+#: ICI neighbors of chip i on the 2x4 board: {i^1, i-2, i+2}
+_NEIGHBORS = {
+    i: {n for n in (i ^ 1, i - 2, i + 2) if 0 <= n < CHIPS_PER_NODE}
+    for i in range(CHIPS_PER_NODE)
+}
+#: steps each tenant reports per tick (cumulative-counter granularity)
+STEPS_PER_TICK = 24
+
+
+def _components(free: set[int]) -> list[set[int]]:
+    """Connected components of a free-chip set under the ICI edges."""
+    seen: set[int] = set()
+    out: list[set[int]] = []
+    for start in sorted(free):
+        if start in seen:
+            continue
+        comp = {start}
+        queue = [start]
+        while queue:
+            chip = queue.pop()
+            for nbr in _NEIGHBORS[chip]:
+                if nbr in free and nbr not in comp:
+                    comp.add(nbr)
+                    queue.append(nbr)
+        seen |= comp
+        out.append(comp)
+    return out
+
+
+@dataclass
+class TenantProfile:
+    """One tenant's demand curve + serving characteristics."""
+
+    name: str          # namespace/pod
+    base_rps: float    # floor demand, requests/sec
+    amp_rps: float     # diurnal amplitude (positive-half sine)
+    phase: float       # fraction of a day the peak is shifted by
+    min_chips: int = 2
+    r_max: float = 5000.0   # tokens/sec plateau of the true MM curve
+    b_half: float = 12.0    # tokens/step at half saturation
+
+    def rate(self, tick: int, day_ticks: int) -> float:
+        wave = math.sin(2.0 * math.pi
+                        * (tick / float(day_ticks) + self.phase))
+        return self.base_rps + self.amp_rps * max(0.0, wave)
+
+    def peak_rps(self, day_ticks: int) -> float:
+        return max(self.rate(t, day_ticks) for t in range(day_ticks))
+
+    def mean_rps(self, day_ticks: int) -> float:
+        return sum(self.rate(t, day_ticks)
+                   for t in range(day_ticks)) / float(day_ticks)
+
+
+def build_arrivals(profiles: list[TenantProfile], ticks: int,
+                   day_ticks: int, tick_s: float,
+                   seed: int) -> dict[str, list[float]]:
+    """Requests arriving per tick per tenant — computed ONCE per seed
+    so every leg (autoscaled, static-peak, static-mean) serves the
+    identical sequence."""
+    rng = random.Random(seed)
+    out: dict[str, list[float]] = {}
+    for profile in profiles:
+        series = []
+        for tick in range(ticks):
+            jitter = max(0.0, rng.gauss(1.0, 0.05))
+            series.append(profile.rate(tick, day_ticks) * tick_s * jitter)
+        out[profile.name] = series
+    return out
+
+
+@dataclass
+class _Tenant:
+    profile: TenantProfile
+    chips: set = field(default_factory=set)    # {(node, chip_idx)}
+    queue: float = 0.0
+    steps: float = 0.0
+    tokens: float = 0.0
+    requests: float = 0.0
+    served_work: float = 0.0
+    cap_work: float = 0.0
+    breach_ticks: list = field(default_factory=list)
+    snapshot: dict | None = None
+
+
+class _Node:
+    __slots__ = ("held", "warm", "killed")
+
+    def __init__(self):
+        self.held: dict[int, str] = {}       # chip -> owner
+        self.warm: dict[int, int] = {}       # chip -> expiry tick
+        self.killed = False
+
+    def free_set(self) -> set[int]:
+        return (set(range(CHIPS_PER_NODE)) - set(self.held)
+                - set(self.warm))
+
+
+class _Store:
+    """Elastic intent store seam (the controller's durable output)."""
+
+    def __init__(self):
+        self.intents: dict[tuple[str, str], Intent] = {}
+        self.puts: list[tuple[str, str, Intent]] = []
+
+    def put(self, namespace: str, pod_name: str,
+            intent: Intent) -> Intent:
+        self.intents[(namespace, pod_name)] = intent
+        self.puts.append((namespace, pod_name, intent))
+        return intent
+
+    def list(self):
+        return [(ns, pod, i)
+                for (ns, pod), i in sorted(self.intents.items())]
+
+
+class _Elastic:
+    def __init__(self, store: _Store):
+        self.store = store
+        self.enqueued: list[tuple[str, str]] = []
+
+    def enqueue(self, namespace: str, pod_name: str) -> None:
+        self.enqueued.append((namespace, pod_name))
+
+
+class _Api:
+    """ApiHealth seam; the bench flips ``down`` for the outage window."""
+
+    def __init__(self):
+        self.down = False
+
+    def ok(self) -> bool:
+        return not self.down
+
+    def state(self) -> str:
+        return "down" if self.down else "healthy"
+
+
+class _Slo:
+    """SLO seam; the bench flips ``burning`` for the burn window."""
+
+    def __init__(self):
+        self.burning = False
+
+    def evaluate(self) -> dict:
+        objectives = [{"name": "tenant-disruption-free-minutes",
+                       "breached": False,
+                       "burn_fast": 3.5 if self.burning else 0.1}]
+        return {"burn_threshold": 2.0, "objectives": objectives}
+
+
+class _Health:
+    def __init__(self):
+        self.quarantined: set[str] = set()
+
+    def excluded_hosts(self) -> frozenset:
+        return frozenset(self.quarantined)
+
+
+class _Defrag:
+    """DefragController seam: plan() advertises the compactable hosts,
+    run() performs the compaction (the sim's stand-in for the real
+    checkpoint-assisted migrations)."""
+
+    def __init__(self, sim: "DiurnalSim"):
+        self.sim = sim
+        self.requests = 0
+        self.runs = 0
+
+    def plan(self) -> dict:
+        self.requests += 1
+        moves = [{"node": name} for name, node in self.sim.nodes.items()
+                 if not node.killed and len(_components(
+                     node.free_set())) > 1]
+        return {"id": f"dfp-sim-{self.requests}", "moves": moves}
+
+    def run(self, plan_id: str | None = None) -> dict:
+        self.runs += 1
+        moved = self.sim.compact()
+        return {"id": plan_id, "status": "completed", "moved": moved}
+
+
+class DiurnalSim:
+    """The simulated fleet + tenant world (see module docstring)."""
+
+    def __init__(self, profiles: list[TenantProfile], n_nodes: int,
+                 seed: int, tick_s: float = 60.0,
+                 per_chip_rps: float = 1.0, day_ticks: int = 1440,
+                 warm_ttl_ticks: int = 240, slo_wait_s: float = 180.0,
+                 util_cap: float = 0.97):
+        self.rng = random.Random(seed + 1)
+        self.tick_s = tick_s
+        self.per_chip_rps = per_chip_rps
+        self.day_ticks = day_ticks
+        self.warm_ttl_ticks = warm_ttl_ticks
+        self.slo_wait_s = slo_wait_s
+        self.util_cap = util_cap
+        self.now = 1_000_000.0
+        self.tick_index = 0
+        self.nodes: dict[str, _Node] = {
+            f"sim-{i:04d}": _Node() for i in range(n_nodes)}
+        self.tenants: dict[str, _Tenant] = {
+            p.name: _Tenant(profile=p) for p in profiles}
+        # seams the controller is wired to
+        self.store = _Store()
+        self.elastic = _Elastic(self.store)
+        self.api = _Api()
+        self.slo = _Slo()
+        self.health = _Health()
+        self.defrag = _Defrag(self)
+        # counters the bench gates on
+        self.warm_attaches = 0
+        self.scatter_allocs = 0
+        self.unplaced = 0
+        self.quarantine_placements = 0
+        self.compaction_moves = 0
+        self.ballast_surge = 0
+        # seed intents at the initial provision
+        for p in profiles:
+            desired = max(p.min_chips,
+                          int(math.ceil(p.rate(0, day_ticks)
+                                        / per_chip_rps)))
+            ns, pod = p.name.split("/", 1)
+            self.store.put(ns, pod, Intent(desired_chips=desired,
+                                           min_chips=p.min_chips))
+
+    def controller_kwargs(self) -> dict:
+        """Everything AutoscaleController needs, wired to this sim."""
+        return {"elastic": self.elastic, "capacity": None,
+                "fleet": self, "slo": self.slo, "apihealth": self.api,
+                "health": self.health, "defrag": self.defrag,
+                "clock": lambda: self.now}
+
+    # --- fleet seam -----------------------------------------------------
+
+    def payload(self, max_age_s: float | None = None) -> dict:  # noqa: ARG002
+        nodes: dict[str, dict] = {}
+        alive = [n for n, node in sorted(self.nodes.items())
+                 if not node.killed]
+        for name in alive:
+            node = self.nodes[name]
+            nodes[name] = {"capacity": {
+                "total": CHIPS_PER_NODE,
+                "free": sorted(node.free_set()),
+                "held": dict(node.held),
+                "warm": sorted(node.warm),
+                "fenced": [],
+            }}
+        # tenant telemetry rides the rollup from whichever worker
+        # published it; merge_tenants dedups by name, so one section on
+        # the first alive host is equivalent to per-home-node publishes
+        if alive:
+            nodes[alive[0]]["tenants"] = {
+                name: dict(t.snapshot)
+                for name, t in self.tenants.items()
+                if t.snapshot is not None}
+        return {"at": self.now, "nodes": nodes}
+
+    # --- ballast (the rest of the fleet's workloads) --------------------
+
+    def seed_ballast(self, open_nodes: int) -> None:
+        """All hosts beyond the first ``open_nodes`` are occupied by
+        non-autoscaled workloads, each left with only the {0, 3}
+        non-adjacent free pair — they count toward after-defrag
+        capacity but never offer a 2-block."""
+        for i, (name, node) in enumerate(sorted(self.nodes.items())):
+            if i < open_nodes:
+                continue
+            for chip in range(CHIPS_PER_NODE):
+                if chip not in (0, 3):
+                    node.held[chip] = f"ballast/b{i:04d}"
+
+    def fragment_wave(self) -> int:
+        """External churn shatters the fleet: ballast pods land until
+        no free ICI block of 2+ chips survives anywhere. Returns the
+        number of chips the surge claimed."""
+        claimed = 0
+        for name, node in self.nodes.items():
+            if node.killed:
+                continue
+            free = node.free_set()
+            while True:
+                comps = [c for c in _components(free) if len(c) >= 2]
+                if not comps:
+                    break
+                comps.sort(key=len, reverse=True)
+                victim = sorted(comps[0])[len(comps[0]) // 2]
+                node.held[victim] = "ballast/surge"
+                free.discard(victim)
+                claimed += 1
+        self.ballast_surge += claimed
+        return claimed
+
+    def compact(self) -> int:
+        """Defrag execution: repack every live host's held chips to the
+        low indices (the migration-backed compaction), leaving free +
+        warm chips as one contiguous tail. Returns chips relocated."""
+        moved = 0
+        for node_name, node in sorted(self.nodes.items()):
+            if node.killed:
+                continue
+            old_sorted = sorted(node.held)
+            remap = {old_idx: new_idx
+                     for new_idx, old_idx in enumerate(old_sorted)
+                     if new_idx != old_idx}
+            if not remap and not node.warm:
+                continue
+            moved += len(remap)
+            node.held = {remap.get(c, c): node.held[c]
+                         for c in old_sorted}
+            node.warm = {len(old_sorted) + i: exp
+                         for i, (_, exp) in enumerate(
+                             sorted(node.warm.items()))}
+            # fix tenant chip bookkeeping for relocated chips
+            if remap:
+                for tenant in self.tenants.values():
+                    tenant.chips = {
+                        (n, remap[c]) if n == node_name and c in remap
+                        else (n, c)
+                        for (n, c) in tenant.chips}
+        self.compaction_moves += moved
+        return moved
+
+    # --- chaos ----------------------------------------------------------
+
+    def kill_nodes(self, count: int) -> list[str]:
+        """Hard-kill hosts that currently hold tenant chips: the chips
+        are gone, the host leaves the fleet payload entirely."""
+        tenant_hosts = sorted({n for t in self.tenants.values()
+                               for (n, _) in t.chips})
+        victims = self.rng.sample(tenant_hosts,
+                                  min(count, len(tenant_hosts)))
+        for name in victims:
+            self.nodes[name].killed = True
+            self.nodes[name].warm.clear()
+            for tenant in self.tenants.values():
+                tenant.chips = {(n, c) for (n, c) in tenant.chips
+                                if n != name}
+        return victims
+
+    def quarantine_hosts(self, count: int) -> list[str]:
+        alive = [n for n, node in sorted(self.nodes.items())
+                 if not node.killed]
+        picked = self.rng.sample(alive, min(count, len(alive)))
+        self.health.quarantined.update(picked)
+        return picked
+
+    def release_quarantine(self) -> None:
+        self.health.quarantined.clear()
+
+    # --- the elastic reconciler + allocator abstraction -----------------
+
+    def reconcile(self) -> None:
+        """Drive every tenant's placed chips toward its intent."""
+        for (ns, pod), intent in sorted(self.store.intents.items()):
+            tenant = self.tenants.get(f"{ns}/{pod}")
+            if tenant is None:
+                continue
+            current = len(tenant.chips)
+            if intent.desired_chips > current:
+                self._allocate(tenant, intent.desired_chips - current)
+            elif intent.desired_chips < current:
+                self._release(tenant, current - intent.desired_chips)
+
+    def _eligible(self) -> list[tuple[str, _Node]]:
+        out = []
+        for name, node in sorted(self.nodes.items()):
+            if node.killed:
+                continue
+            if name in self.health.quarantined:
+                # counted, never used: the bench gates this at zero
+                continue
+            out.append((name, node))
+        return out
+
+    def _allocate(self, tenant: _Tenant, need: int) -> None:
+        owner = tenant.profile.name
+        # 1. warm pool first: reclaimable drained chips attach fastest
+        for name, node in self._eligible():
+            while need and node.warm:
+                chip = min(node.warm)
+                del node.warm[chip]
+                node.held[chip] = owner
+                tenant.chips.add((name, chip))
+                self.warm_attaches += 1
+                need -= 1
+        if not need:
+            return
+        # 2. one contiguous ICI block on a single healthy host
+        best: tuple[str, _Node, set] | None = None
+        for name, node in self._eligible():
+            for comp in _components(node.free_set()):
+                if len(comp) >= need and (
+                        best is None or len(comp) < len(best[2])):
+                    best = (name, node, comp)
+        if best is not None:
+            name, node, comp = best
+            for chip in sorted(comp)[:need]:
+                node.held[chip] = owner
+                tenant.chips.add((name, chip))
+            return
+        # 3. scatter fallback (counted; the controller's feasibility
+        # gate should make this rare)
+        for name, node in self._eligible():
+            for chip in sorted(node.free_set()):
+                if not need:
+                    return
+                node.held[chip] = owner
+                tenant.chips.add((name, chip))
+                self.scatter_allocs += 1
+                need -= 1
+        self.unplaced += need
+
+    def _release(self, tenant: _Tenant, count: int) -> None:
+        """Graceful drain: released chips enter the warm pool and stay
+        reattachable until the TTL expires."""
+        victims = sorted(tenant.chips)[-count:]
+        expiry = self.tick_index + self.warm_ttl_ticks
+        for (name, chip) in victims:
+            tenant.chips.discard((name, chip))
+            node = self.nodes[name]
+            node.held.pop(chip, None)
+            if not node.killed:
+                node.warm[chip] = expiry
+
+    # --- time -----------------------------------------------------------
+
+    def tick(self, arrivals: dict[str, list[float]]) -> None:
+        """Advance one tick: expire warm chips, serve demand, publish
+        telemetry."""
+        i = self.tick_index
+        self.now += self.tick_s
+        for node in self.nodes.values():
+            if node.killed:
+                continue
+            for chip in [c for c, exp in node.warm.items() if exp <= i]:
+                del node.warm[chip]
+        for name, tenant in self.tenants.items():
+            arr = arrivals[name][i]
+            chips = len(tenant.chips)
+            cap = chips * self.per_chip_rps * self.tick_s
+            demand = arr + tenant.queue
+            served = min(cap, demand)
+            tenant.queue = demand - served
+            tenant.requests += arr
+            tenant.served_work += served
+            tenant.cap_work += cap
+            wait_s = (tenant.queue / (chips * self.per_chip_rps)
+                      if chips else float("inf"))
+            if wait_s > self.slo_wait_s:
+                tenant.breach_ticks.append(i)
+            # telemetry: on-curve batch/rate derived from true load
+            load = (demand / cap) if cap > 0 else self.util_cap
+            u = min(0.95, min(self.util_cap, load))
+            batch = tenant.profile.b_half * u / (1.0 - u)
+            batch *= 1.0 + self.rng.uniform(-0.08, 0.08)
+            rate = (tenant.profile.r_max * batch
+                    / (batch + tenant.profile.b_half))
+            tenant.steps += STEPS_PER_TICK
+            tenant.tokens += batch * STEPS_PER_TICK
+            tenant.snapshot = {
+                "steps": {"count": tenant.steps},
+                "tokens_total": round(tenant.tokens, 3),
+                "tokens_per_s": round(rate, 3),
+                "queue_depth": round(tenant.queue, 1),
+                "at": self.now,
+            }
+        self.tick_index += 1
+
+    # --- leg summary ----------------------------------------------------
+
+    def utilization(self) -> float:
+        cap = sum(t.cap_work for t in self.tenants.values())
+        served = sum(t.served_work for t in self.tenants.values())
+        return (served / cap) if cap else 0.0
+
+    def total_requests(self) -> float:
+        return sum(t.requests for t in self.tenants.values())
+
+    def breach_ticks(self) -> dict[str, list[int]]:
+        return {name: list(t.breach_ticks)
+                for name, t in sorted(self.tenants.items())
+                if t.breach_ticks}
+
+
+def run_static_leg(profiles: list[TenantProfile],
+                   arrivals: dict[str, list[float]],
+                   chips_by_tenant: dict[str, int], ticks: int,
+                   tick_s: float, per_chip_rps: float,
+                   slo_wait_s: float) -> dict:
+    """The control leg: the same arrival sequence served by a FIXED
+    per-tenant allocation (no controller, no chaos). Returns the same
+    utilization/breach summary shape as the autoscaled leg."""
+    served_total = cap_total = 0.0
+    breach_ticks = 0
+    queues = {p.name: 0.0 for p in profiles}
+    for i in range(ticks):
+        for p in profiles:
+            chips = chips_by_tenant[p.name]
+            cap = chips * per_chip_rps * tick_s
+            demand = arrivals[p.name][i] + queues[p.name]
+            served = min(cap, demand)
+            queues[p.name] = demand - served
+            served_total += served
+            cap_total += cap
+            wait_s = (queues[p.name] / (chips * per_chip_rps)
+                      if chips else float("inf"))
+            if wait_s > slo_wait_s:
+                breach_ticks += 1
+    return {
+        "chips_total": sum(chips_by_tenant.values()),
+        "utilization": round(served_total / cap_total, 4)
+        if cap_total else 0.0,
+        "breach_ticks_total": breach_ticks,
+    }

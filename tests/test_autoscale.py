@@ -1,0 +1,592 @@
+"""Autoscaler suite (ISSUE 19 acceptance).
+
+Three layers, matching the subsystem's split:
+
+  * throughput-model units — the Michaelis-Menten fit over synthetic
+    (batch, rate) series: parameter recovery under noise, the plateau
+    fallback, delta derivation from cumulative counters (including the
+    counter-reset re-baseline), and the refusal verdicts (sparse /
+    stale / untracked) plus the bounded tenant table,
+  * controller decision tests — fakes for the SLO engine, ApiHealth,
+    the fleet rollup and the elastic store prove the hard gates (never
+    scale while a tenant objective burns, fail closed on a broken SLO
+    engine, park under degraded API — including MID-pass at a tenant
+    boundary), hysteresis (no flap, interrupted signals restart the
+    streak), per-tenant cooldowns, the shrink floor, and the grow
+    feasibility ladder (admissible / admissible-after-defrag requests
+    a defrag plan / infeasible; quarantined hosts never count),
+  * the HTTP surface over a bare MasterApp — pane shape, pause/resume/
+    evaluate, auth on mutations, Retry-After on gate refusals.
+
+Also arms the declared `autoscale.pass` failpoint (faults/registry.py
+contract: every declared point is exercised by at least one test).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from gpumounter_tpu.autoscale import (
+    AutoscaleController,
+    AutoscaleRefused,
+    ThroughputModel,
+    fit_curve,
+    predict,
+)
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.elastic.intents import Intent
+from gpumounter_tpu.faults import failpoints
+from gpumounter_tpu.obs.audit import AUDIT
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm_all()
+    yield
+    failpoints.disarm_all()
+
+
+def _auth():
+    from conftest import AUTH_HEADER
+    return dict(AUTH_HEADER)
+
+
+# --- throughput-model units ----------------------------------------------
+
+
+def _mm_series(batches, r_max=100.0, b_half=10.0, noise=()):
+    """(batch, rate) pairs on a saturating curve, optional relative
+    noise cycled over the points (deterministic: no RNG in tests)."""
+    out = []
+    for i, b in enumerate(batches):
+        r = r_max * b / (b + b_half)
+        if noise:
+            r *= 1.0 + noise[i % len(noise)]
+        out.append((float(b), r))
+    return out
+
+
+def _feed(model, tenant, series, t0=1000.0, dt=10.0):
+    """Drive the model through its public path: cumulative snapshots,
+    one step per sample, d_tokens == batch. Returns the last snapshot
+    (what a fleet node would still be publishing)."""
+    steps, tokens, at = 0.0, 0.0, t0
+    snap = {"steps": {"count": steps}, "tokens_total": tokens,
+            "at": at, "tokens_per_s": 0.0}
+    model.observe(tenant, snap)
+    for batch, rate in series:
+        steps += 1
+        tokens += batch
+        at += dt
+        snap = {"steps": {"count": steps}, "tokens_total": tokens,
+                "at": at, "tokens_per_s": rate}
+        model.observe(tenant, snap)
+    return snap
+
+
+def test_fit_curve_recovers_saturating_params():
+    fit = fit_curve(_mm_series([5, 10, 20, 40, 80, 160]))
+    assert not fit["plateau_only"]
+    assert fit["r_max"] == pytest.approx(100.0, rel=0.01)
+    assert fit["b_half"] == pytest.approx(10.0, rel=0.05)
+    assert fit["rmse"] < 1.0
+    # predictions ride the curve: monotone, saturating below r_max
+    rates = [predict(fit, b) for b in (1, 8, 64, 512)]
+    assert rates == sorted(rates)
+    assert rates[-1] < fit["r_max"]
+
+
+def test_fit_curve_survives_noise():
+    fit = fit_curve(_mm_series([4, 8, 16, 32, 64, 128, 256],
+                               noise=(0.04, -0.03, 0.02, -0.05)))
+    assert fit is not None
+    assert fit["r_max"] == pytest.approx(100.0, rel=0.25)
+    assert fit["b_half"] > 0.0
+
+
+def test_fit_curve_plateau_fallback_on_flat_batches():
+    """All-equal batch sizes carry no curvature — the fit must fall
+    back to the mean-rate plateau, never divide by zero or report an
+    unbounded r_max the controller would scale against."""
+    fit = fit_curve([(32.0, 90.0), (32.0, 92.0), (32.0, 88.0)])
+    assert fit["plateau_only"]
+    assert fit["r_max"] == pytest.approx(90.0)
+    assert fit["b_half"] == 0.0
+    assert predict(fit, 1) == predict(fit, 1024) == fit["r_max"]
+
+
+def test_model_derives_deltas_and_rebaselines_on_reset():
+    model = ThroughputModel(cfg=Config(), clock=lambda: 2000.0)
+    last = _feed(model, "ns/a", _mm_series([10, 20, 40, 80]))
+    fit = model.fit("ns/a", now=last["at"])
+    assert fit["verdict"] == "ok"
+    assert fit["samples"] == 4
+    # a restarted tenant resets its cumulative counters: the model must
+    # re-baseline (no sample from the wrap), then keep learning
+    reset = {"steps": {"count": 1.0}, "tokens_total": 40.0,
+             "at": last["at"] + 10, "tokens_per_s": 80.0}
+    assert model.observe("ns/a", reset) is None
+    nxt = {"steps": {"count": 2.0}, "tokens_total": 120.0,
+           "at": last["at"] + 20, "tokens_per_s": 88.0}
+    assert model.observe("ns/a", nxt) == (last["at"] + 20, 80.0, 88.0)
+
+
+def test_model_verdicts_sparse_stale_untracked():
+    cfg = Config()
+    model = ThroughputModel(cfg=cfg)
+    assert model.fit("ns/ghost", now=0.0)["verdict"] == "untracked"
+    last = _feed(model, "ns/a", _mm_series([10, 20]))  # < min_samples
+    assert model.fit("ns/a", now=last["at"])["verdict"] == "sparse"
+    last = _feed(model, "ns/b", _mm_series([10, 20, 40, 80, 160]))
+    assert model.fit("ns/b", now=last["at"])["verdict"] == "ok"
+    stale_at = last["at"] + cfg.autoscale_stale_s + 1.0
+    assert model.fit("ns/b", now=stale_at)["verdict"] == "stale"
+    pane = model.payload(now=last["at"])
+    assert pane["tracked"] == 2
+    assert pane["tenants"]["ns/b"]["verdict"] == "ok"
+
+
+def test_model_tenant_table_is_bounded():
+    cfg = Config().replace(autoscale_max_tenants=2)
+    model = ThroughputModel(cfg=cfg)
+    for i in range(4):
+        _feed(model, f"ns/t{i}", _mm_series([10, 20]))
+    assert model.payload(now=1020.0)["tracked"] == 2
+    assert model.overflow_dropped > 0
+    # forgetting frees a slot for the next newcomer
+    model.forget("ns/t0")
+    _feed(model, "ns/fresh", _mm_series([10, 20]))
+    assert "ns/fresh" in model.payload(now=1020.0)["tenants"]
+
+
+# --- controller fakes -----------------------------------------------------
+
+
+class _FakeStore:
+    def __init__(self, intents=None):
+        self.intents = dict(intents or {})  # (ns, pod) -> Intent
+        self.puts = []
+
+    def put(self, namespace, pod_name, intent):
+        self.intents[(namespace, pod_name)] = intent
+        self.puts.append((namespace, pod_name, intent))
+        return intent
+
+    def list(self):
+        return [(ns, pod, i)
+                for (ns, pod), i in sorted(self.intents.items())]
+
+
+class _FakeElastic:
+    def __init__(self, store):
+        self.store = store
+        self.enqueued = []
+
+    def enqueue(self, namespace, pod_name):
+        self.enqueued.append((namespace, pod_name))
+
+
+class _FakeFleet:
+    def __init__(self, nodes):
+        self.nodes = nodes
+        self.fail = None
+
+    def payload(self, max_age_s=None):
+        if self.fail is not None:
+            raise self.fail
+        return {"nodes": self.nodes}
+
+
+class _BurningSlo:
+    def evaluate(self):
+        return {"burn_threshold": 2.0, "objectives": [
+            {"name": "tenant-disruption-free-minutes", "breached": False,
+             "burn_fast": 3.5},
+            {"name": "slice-feasibility", "burn_fast": 9.0},
+        ]}
+
+
+class _BrokenSlo:
+    def evaluate(self):
+        raise RuntimeError("slo store corrupt")
+
+
+class _DeadApi:
+    def ok(self):
+        return False
+
+    def state(self):
+        return "down"
+
+
+class _FlakyApi:
+    """ok() answers from a script — the mid-pass degradation fake."""
+
+    def __init__(self, answers):
+        self.answers = list(answers)
+
+    def ok(self):
+        return self.answers.pop(0) if self.answers else False
+
+    def state(self):
+        return "healthy" if self.answers else "down"
+
+
+class _FakeHealth:
+    def __init__(self, excluded=()):
+        self.excluded = frozenset(excluded)
+
+    def excluded_hosts(self):
+        return self.excluded
+
+
+class _FakeDefrag:
+    def __init__(self, moves=1):
+        self.calls = []
+        self.moves = moves
+
+    def plan(self):
+        self.calls.append("plan")
+        return {"id": "dfp-test",
+                "moves": [{"chips": 2}] * self.moves}
+
+    def run(self, plan_id=None):
+        self.calls.append(f"run:{plan_id}")
+        return {"status": "completed"}
+
+
+def _node(free=(), held=None, warm=(), tenants=None):
+    return {"capacity": {"free": list(free),
+                         "held": {int(i): t
+                                  for i, t in (held or {}).items()},
+                         "warm": list(warm), "fenced": [], "total": 8},
+            "tenants": dict(tenants or {})}
+
+
+def _saturated(nodes, tenant="default/train", queue=50.0,
+               intents=None, cfg=None, clock=None, **kw):
+    """A controller over one saturated tenant: MM-curve history already
+    learned (util ~0.94), queue deep, intent desired=4/min=1."""
+    cfg = cfg or Config()
+    store = _FakeStore(intents if intents is not None else {
+        tuple(tenant.split("/")): Intent(desired_chips=4, min_chips=1)})
+    elastic = _FakeElastic(store)
+    fleet = _FakeFleet(nodes)
+    now = [1100.0]  # newest fed sample is at=1060: fresh, not stale
+    ctrl = AutoscaleController(elastic, None, fleet, cfg=cfg,
+                               clock=(clock or (lambda: now[0])), **kw)
+    last = _feed(ctrl.model, tenant, _mm_series([5, 10, 20, 40, 80, 160]))
+    # the fleet keeps publishing the tenant's latest cumulative snapshot
+    for entry in nodes.values():
+        entry["tenants"][tenant] = {**last, "queue_depth": queue}
+    return ctrl, store, elastic, now
+
+
+# --- controller gates -----------------------------------------------------
+
+
+def test_controller_refuses_while_slo_burns():
+    ctrl, _, _, _ = _saturated({"h1": _node(range(8))}, slo=_BurningSlo())
+    with pytest.raises(AutoscaleRefused) as exc:
+        ctrl.evaluate_once()
+    assert exc.value.cause == "slo-burn"
+    assert exc.value.status == 503
+    assert "tenant-disruption-free-minutes" in str(exc.value)
+    # slice-feasibility burning alone must NOT gate (fragmentation is
+    # exactly when a grow may need to request defrag)
+    assert "slice-feasibility" not in str(exc.value)
+    refusal = AUDIT.query(operation="autoscale.pass")
+    assert any(e["outcome"] == "refused: slo-burn" for e in refusal)
+
+
+def test_controller_fails_closed_when_slo_engine_breaks():
+    ctrl, _, _, _ = _saturated({"h1": _node(range(8))}, slo=_BrokenSlo())
+    with pytest.raises(AutoscaleRefused) as exc:
+        ctrl.evaluate_once()
+    assert exc.value.cause == "slo-burn"
+    assert "slo-engine-error" in str(exc.value)
+
+
+def test_controller_parks_under_degraded_api():
+    ctrl, _, _, _ = _saturated({"h1": _node(range(8))},
+                               apihealth=_DeadApi())
+    with pytest.raises(AutoscaleRefused) as exc:
+        ctrl.evaluate_once()
+    assert exc.value.cause == "api-degraded"
+    assert exc.value.status == 503
+
+
+def test_controller_refuses_while_paused():
+    ctrl, store, _, _ = _saturated({"h1": _node(range(8))})
+    ctrl.pause(actor="test")
+    with pytest.raises(AutoscaleRefused) as exc:
+        ctrl.evaluate_once()
+    assert exc.value.cause == "paused"
+    assert store.puts == []
+    ctrl.resume(actor="test")
+    ctrl.evaluate_once()  # un-parked: the pass runs again
+
+
+def test_midpass_api_degradation_parks_at_tenant_boundary():
+    """Journal-boundary contract: the API dies between tenants — the
+    first tenant's evaluation stands, the rest of the pass parks."""
+    intents = {("default", "aaa"): Intent(desired_chips=2, min_chips=1),
+               ("default", "bbb"): Intent(desired_chips=2, min_chips=1)}
+    # ok() script: top-of-pass check, tenant aaa boundary, tenant bbb
+    # boundary (dies here)
+    ctrl, _, _, _ = _saturated({"h1": _node(range(8))}, intents=intents,
+                               apihealth=_FlakyApi([True, True, False]))
+    record = ctrl.evaluate_once()
+    assert record["status"] == "parked-api"
+    assert record["considered"] == 1
+    assert len(record["decisions"]) == 1
+
+
+def test_fleet_failure_refuses_not_scales_blind():
+    ctrl, _, elastic, _ = _saturated({"h1": _node(range(8))})
+    ctrl.fleet.fail = RuntimeError("collector wedged")
+    with pytest.raises(AutoscaleRefused) as exc:
+        ctrl.evaluate_once()
+    assert exc.value.cause == "stale-telemetry"
+    assert exc.value.status == 503
+    assert elastic.enqueued == []
+
+
+def test_armed_failpoint_aborts_the_pass():
+    """faults/registry.py contract: the declared `autoscale.pass` site
+    is armed here; a pass that dies at the top leaves no decision."""
+    ctrl, store, _, _ = _saturated({"h1": _node(range(8))})
+    failpoints.arm("autoscale.pass", "1*error(chaos autoscale abort)")
+    with pytest.raises(Exception, match="chaos autoscale abort"):
+        ctrl.evaluate_once()
+    assert store.puts == []
+    ctrl.evaluate_once()  # one-shot action: the next pass is clean
+
+
+# --- controller decisions -------------------------------------------------
+
+
+def test_grow_fires_after_hysteresis_with_audit_and_trace():
+    ctrl, store, elastic, _ = _saturated({"h1": _node(range(8))})
+    first = ctrl.evaluate_once()
+    (d1,) = first["decisions"]
+    assert d1["action"] == "hold" and d1["reason"] == "hysteresis"
+    assert d1["streak"] == 1
+    assert store.puts == []
+
+    second = ctrl.evaluate_once()
+    (d2,) = second["decisions"]
+    assert d2["action"] == "grow"
+    assert d2["from_chips"] == 4 and d2["to_chips"] == 6
+    assert d2["feasibility"]["verdict"] == "admissible"
+    assert d2["trace_id"]
+    ((ns, pod, intent),) = store.puts
+    assert (ns, pod) == ("default", "train")
+    assert intent.desired_chips == 6 and intent.min_chips == 1
+    assert elastic.enqueued == [("default", "train")]
+    (entry,) = AUDIT.query(operation="autoscale.decision")
+    assert entry["details"]["action"] == "grow"
+    assert entry["trace_id"] == d2["trace_id"]
+    # the pane shows the decision and the running cooldown
+    pane = ctrl.payload()
+    assert [d["action"] for d in pane["decisions"]] == ["grow"]
+    assert "default/train" in pane["cooldowns"]
+
+
+def test_cooldown_blocks_back_to_back_decisions():
+    ctrl, store, _, now = _saturated({"h1": _node(range(8))})
+    ctrl.evaluate_once()
+    ctrl.evaluate_once()  # fires the grow (and resets the streak)
+    assert len(store.puts) == 1
+    record = ctrl.evaluate_once()  # streak re-accumulates first
+    assert record["decisions"][0]["reason"] == "hysteresis"
+    for _ in range(3):  # still saturated, still inside the cooldown
+        record = ctrl.evaluate_once()
+        (d,) = record["decisions"]
+        assert d["action"] == "hold" and d["reason"] == "cooldown"
+    assert len(store.puts) == 1
+    now[0] += float(ctrl.cfg.autoscale_cooldown_s) + 1.0
+    # cooldown expired — but the tenant telemetry is now stale, so the
+    # controller refuses rather than act on an old curve
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["reason"] == "stale-telemetry"
+    assert len(store.puts) == 1
+
+
+def test_interrupted_signal_restarts_hysteresis():
+    """Hysteresis means N CONSECUTIVE passes agreeing: a steady pass
+    between two saturated ones resets the streak — no flap."""
+    nodes = {"h1": _node(range(8))}
+    ctrl, store, _, _ = _saturated(nodes)
+    ctrl.evaluate_once()  # streak 1
+    # demand evaporates for one pass
+    nodes["h1"]["tenants"]["default/train"]["queue_depth"] = 10.0
+    mid = ctrl.evaluate_once()
+    assert mid["decisions"][0]["reason"] == "steady"
+    nodes["h1"]["tenants"]["default/train"]["queue_depth"] = 50.0
+    after = ctrl.evaluate_once()  # streak restarted at 1
+    assert after["decisions"][0]["reason"] == "hysteresis"
+    assert after["decisions"][0]["streak"] == 1
+    assert store.puts == []
+
+
+def test_stale_telemetry_holds_never_actuates():
+    ctrl, store, _, now = _saturated({"h1": _node(range(8))})
+    now[0] += float(ctrl.cfg.autoscale_stale_s) + 200.0
+    for _ in range(4):
+        record = ctrl.evaluate_once()
+        (d,) = record["decisions"]
+        assert d["action"] == "hold"
+        assert d["reason"] == "stale-telemetry"
+    assert store.puts == []
+
+
+def test_shrink_never_goes_below_the_floor():
+    """An idle tenant shrinks stepwise to its declared min_chips and
+    then holds at-floor — never to zero, never below the floor."""
+    cfg = Config().replace(autoscale_hysteresis=1,
+                           autoscale_cooldown_s=0.0)
+    intents = {("default", "idle"): Intent(desired_chips=4, min_chips=2)}
+    ctrl, store, _, now = _saturated(
+        {"h1": _node(range(8))}, tenant="default/idle", queue=0.0,
+        intents=intents, cfg=cfg)
+    # under-utilized: the tenant's batch collapsed, so its observed
+    # rate sits far down the learned curve (util <= autoscale_util_shrink)
+    for entry in ctrl.fleet.nodes.values():
+        snap = entry["tenants"]["default/idle"]
+        snap["tokens_per_s"] = 100.0 * 5.0 / 15.0  # on-curve at batch 5
+        snap["steps"] = {"count": snap["steps"]["count"] + 1}
+        snap["tokens_total"] = snap["tokens_total"] + 5.0
+        snap["at"] = now[0]
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["action"] == "shrink"
+    assert d["to_chips"] == 2  # 4 - max_step, clamped at the floor
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["action"] == "hold" and d["reason"] == "at-floor"
+    assert len(store.puts) == 1
+
+
+def test_grow_infeasible_when_only_quarantined_hosts_fit():
+    """The only host with an admissible block is quarantined: the grow
+    must read infeasible — quarantined capacity is not capacity."""
+    nodes = {"sick": _node(range(8)),
+             "full": _node([], {i: "ns/x" for i in range(8)})}
+    ctrl, store, _, _ = _saturated(nodes,
+                                   health=_FakeHealth(excluded={"sick"}))
+    ctrl.evaluate_once()
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["action"] == "hold" and d["reason"] == "infeasible"
+    assert d["feasibility"]["verdict"] == "infeasible"
+    assert d["feasibility"]["excluded_hosts"] == 1
+    assert store.puts == []
+    # the same fleet with the quarantine lifted is admissible (the
+    # infeasible hold reset the streak, so hysteresis re-runs first)
+    ctrl.health = _FakeHealth()
+    ctrl.evaluate_once()
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["action"] == "grow"
+
+
+def test_grow_after_defrag_requests_a_plan_and_defers():
+    """Fragmented host: enough free chips in total, no contiguous
+    block. The grow defers and hands the contiguity problem to the
+    defragmenter; nothing actuates this pass."""
+    defrag = _FakeDefrag()
+    # chips 0 and 3 share no ICI edge (neighbors are {i^1, i±2}): two
+    # free singletons, so no 2-block exists until a defrag coalesces
+    nodes = {"frag": _node([0, 3], {1: "ns/x", 2: "ns/x", 4: "ns/x",
+                                    5: "ns/x", 6: "ns/x", 7: "ns/x"})}
+    ctrl, store, _, _ = _saturated(nodes, defrag=defrag)
+    ctrl.evaluate_once()
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["action"] == "hold"
+    assert d["deferred"] == "requested-defrag"
+    assert d["feasibility"]["verdict"] == "admissible-after-defrag"
+    assert defrag.calls == ["plan", "run:dfp-test"]
+    assert store.puts == []
+
+
+def test_grow_holds_at_the_request_ceiling():
+    cfg = Config()
+    intents = {("default", "train"):
+               Intent(desired_chips=int(cfg.max_tpu_per_request),
+                      min_chips=1)}
+    ctrl, store, _, _ = _saturated({"h1": _node(range(8))},
+                                   intents=intents, cfg=cfg)
+    ctrl.evaluate_once()
+    record = ctrl.evaluate_once()
+    (d,) = record["decisions"]
+    assert d["action"] == "hold" and d["reason"] == "at-ceiling"
+    assert store.puts == []
+
+
+# --- HTTP surface over a bare MasterApp ----------------------------------
+
+
+@pytest.fixture()
+def app(test_config):
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.master.app import MasterApp
+
+    return MasterApp(FakeKubeClient(), cfg=test_config)
+
+
+def test_autoscale_routes(app):
+    status, _, body, _ = app.handle("GET", "/autoscale", b"", _auth())
+    assert status == 200
+    pane = json.loads(body)
+    assert pane["gates"]["api_ok"] is True
+    assert pane["paused"] is False
+    assert pane["model"] == {"tenants": {}, "tracked": 0,
+                             "overflow_dropped": 0}
+
+    status, _, body, _ = app.handle("POST", "/autoscale/pause", b"",
+                                    _auth())
+    assert status == 200
+    assert json.loads(body)["paused"] is True
+
+    # a paused controller refuses a forced pass, 409 with the cause
+    status, _, body, _ = app.handle("POST", "/autoscale/evaluate", b"",
+                                    _auth())
+    assert status == 409
+    assert "operator-paused" in body
+
+    status, _, body, _ = app.handle("POST", "/autoscale/resume", b"",
+                                    _auth())
+    assert status == 200
+    assert json.loads(body)["paused"] is False
+
+    status, _, body, _ = app.handle("POST", "/autoscale/evaluate", b"",
+                                    _auth())
+    assert status == 200
+    record = json.loads(body)
+    assert record["status"] == "completed"
+    assert record["trace_id"]
+
+    # pause/resume are audited with the caller identity header
+    ops = [e["operation"] for e in AUDIT.snapshot()]
+    assert "autoscale.pause" in ops and "autoscale.resume" in ops
+
+
+def test_autoscale_mutate_routes_require_auth(app):
+    for path in ("/autoscale/pause", "/autoscale/resume",
+                 "/autoscale/evaluate"):
+        status, _, _, _ = app.handle("POST", path, b"{}", {})
+        assert status == 401, path
+
+
+def test_autoscale_route_parks_with_retry_after(app):
+    app.autoscale.slo = _BurningSlo()
+    status, _, body, headers = app.handle("POST", "/autoscale/evaluate",
+                                          b"{}", _auth())
+    assert status == 503
+    assert "Retry-After" in headers
+    assert "refusing to scale into a breach" in body

@@ -226,6 +226,82 @@ def test_controller_refuses_stale_adopted_plan():
     assert exc.value.cause == "no-plan"
 
 
+def test_run_executes_host_disjoint_groups_concurrently():
+    """Cross-host group scheduling regression (ISSUE 19 satellite):
+    two groups with disjoint host footprints must execute in the SAME
+    batch — a slow host must not serialize the rest of the plan. The
+    slow group's move blocks on an Event; the disjoint fast group's
+    move must complete while the slow one is still in flight (no
+    timing sleeps: pure event ordering)."""
+    import threading
+
+    class _FlatCapacity:
+        def payload(self, max_age_s=None):
+            return {"fleet": {"fragmentation_index": 0.0}}
+
+        def record_recovery(self, **kw):
+            pass
+
+    cfg = Config().replace(defrag_group_fanout=2)
+    ctrl = DefragController(None, None, _FlatCapacity(), None, cfg=cfg)
+    slow_entered = threading.Event()
+    release_slow = threading.Event()
+    fast_done = threading.Event()
+
+    def fake_move(run, move):
+        if move["source_node"] == "slow-host":
+            slow_entered.set()
+            assert release_slow.wait(timeout=10.0)
+        else:
+            fast_done.set()
+        return "succeeded"
+
+    ctrl._execute_move = fake_move
+    move = {"namespace": "ns", "pod": "t", "chips": 2,
+            "est_cost_s": 1.0}
+    ctrl._plan = {
+        "id": "dfp-fanout", "created_at": time.time(),
+        "groups": [{"node": "slow-host"}, {"node": "fast-host"}],
+        "moves": [
+            {**move, "group": "slow-host", "source_node": "slow-host",
+             "dest_node": "spare-a"},
+            {**move, "group": "fast-host", "source_node": "fast-host",
+             "dest_node": "spare-b"},
+        ],
+    }
+    ctrl.run()  # background thread
+    try:
+        assert slow_entered.wait(timeout=10.0)
+        # the fast group finishes while the slow host is still blocked:
+        # they shared a batch, not a serial queue
+        assert fast_done.wait(timeout=10.0)
+        assert not release_slow.is_set()
+    finally:
+        release_slow.set()
+    thread = ctrl._run_thread
+    if thread is not None:
+        thread.join(timeout=10.0)
+    payload = ctrl.payload()
+    last = payload["history"][-1]
+    assert last["status"] == "completed"
+    assert last["plan_id"] == "dfp-fanout"
+
+    # the serial shape still works: fanout 1 puts the same two groups
+    # in separate batches
+    serial = DefragController(None, None, _FlatCapacity(), None,
+                              cfg=Config().replace(defrag_group_fanout=1))
+    batches = serial._disjoint_batches(
+        [{"node": "slow-host"}, {"node": "fast-host"}], {})
+    assert [len(b) for b in batches] == [1, 1]
+    # overlapping host footprints never share a batch, whatever the
+    # fanout: group 2's destination is group 1's source
+    overlap = {"fast-host": [{"source_node": "fast-host",
+                              "dest_node": "slow-host"}]}
+    batches = ctrl._disjoint_batches(
+        [{"node": "slow-host"}, {"node": "fast-host"}], overlap)
+    assert [len(b) for b in batches] == [1, 1]
+
+
 # --- HTTP surface over a bare MasterApp ----------------------------------
 
 

@@ -37,6 +37,11 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 #: gauge are unlabeled; moves/refusals are labeled only by the bounded
 #: outcome/cause vocabulary — plan ids, tenant pods and host names ride
 #: the JSON plane (/defrag), never labels. No bump.
+#: Reviewed for ISSUE 19 (autoscaler): decisions/skips/refusals are
+#: labeled only by the bounded action/reason/cause vocabularies; the
+#: passes counter and paused gauge are unlabeled — tenant names, trace
+#: ids and cooldown keys ride the JSON plane (/autoscale), never
+#: labels. No bump.
 SERIES_BUDGET = 400
 
 
@@ -117,6 +122,10 @@ def test_fake_cluster_run_stays_within_series_budget(tmp_path):
         # ISSUE 16 defragmenter: the budgeted run includes the defrag
         # pane (plan ids / host names stay JSON, never labels).
         assert http("GET", "/defrag")[0] == 200
+        # ISSUE 19 autoscaler: the budgeted run includes the autoscale
+        # pane (tenant names, trace ids and cooldown keys stay in the
+        # JSON payload, never labels).
+        assert http("GET", "/autoscale")[0] == 200
         # ISSUE 13 trace-plane surfaces: the budgeted run includes the
         # assembled /trace read and the flight recorder's /timeline.
         assert http("GET", "/timeline")[0] == 200
@@ -291,6 +300,84 @@ def test_defrag_plane_series_are_bounded():
     assert grown <= 6, (
         f"defrag plane grew {grown} series — an unbounded label "
         f"(plan id? host name? tenant pod?) slipped into an instrument")
+
+
+def test_autoscale_plane_series_are_bounded():
+    """ISSUE 19 guard: heavy autoscale traffic — hundreds of distinct
+    tenants under intent management, repeated evaluate passes, an
+    operator pause/refusal/resume cycle — grows the exposition only by
+    the fixed autoscale series: decisions by the 2-value action
+    vocabulary, skips by the bounded SKIP_REASONS vocabulary, refusals
+    by the bounded cause vocabulary, plus the unlabeled passes counter
+    and paused gauge. Tenant names, trace ids and cooldown keys must
+    never become label values (they live in the /autoscale JSON
+    pane)."""
+    from gpumounter_tpu.autoscale import (
+        AutoscaleController,
+        AutoscaleRefused,
+    )
+    from gpumounter_tpu.autoscale.controller import SKIP_REASONS
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.elastic.intents import Intent
+
+    class _Store:
+        def __init__(self, intents):
+            self.intents = intents
+
+        def put(self, namespace, pod_name, intent):
+            self.intents[(namespace, pod_name)] = intent
+            return intent
+
+        def list(self):
+            return [(ns, pod, i)
+                    for (ns, pod), i in sorted(self.intents.items())]
+
+    class _Elastic:
+        def __init__(self, store):
+            self.store = store
+
+        def enqueue(self, namespace, pod_name):
+            pass
+
+    class _Fleet:
+        """One node publishing a single (sparse) snapshot for each of
+        300 distinct tenants — every per-tenant evaluation holds on the
+        sparse/untracked vocabulary, never on a per-tenant series."""
+
+        def payload(self, max_age_s=None):
+            tenants = {f"churn/as-{i}": {
+                "steps": {"count": 10 + i}, "tokens_total": 100.0 + i,
+                "tokens_per_s": 50.0, "queue_depth": 40.0, "at": 1000.0,
+            } for i in range(300)}
+            return {"nodes": {"card-as-host": {
+                "capacity": {"free": list(range(8)), "held": {},
+                             "warm": [], "fenced": [], "total": 8},
+                "tenants": tenants}}}
+
+    before = REGISTRY.series_count()
+    intents = {("churn", f"as-{i}"): Intent(desired_chips=2, min_chips=1)
+               for i in range(300)}
+    ctrl = AutoscaleController(_Elastic(_Store(intents)), None, _Fleet(),
+                               cfg=Config(), clock=lambda: 1010.0)
+    for _ in range(5):
+        ctrl.evaluate_once()
+    ctrl.pause(actor="card-drill")
+    try:
+        ctrl.evaluate_once()
+    except AutoscaleRefused:
+        pass
+    ctrl.resume(actor="card-drill")
+    grown = REGISTRY.series_count() - before
+    # 2 decision actions + bounded skip reasons + 5 refusal causes +
+    # unlabeled passes counter + paused gauge; nothing per-tenant
+    assert grown <= 2 + len(SKIP_REASONS) + 5 + 2, (
+        f"autoscale plane grew {grown} series — an unbounded label "
+        f"(tenant name? trace id? cooldown key?) slipped into an "
+        f"instrument")
+    # the model's tenant table is bounded too: 300 tenants folded into
+    # the 256-slot table with the rest counted, not tracked
+    assert ctrl.model.payload(now=1010.0)["tracked"] <= \
+        Config().autoscale_max_tenants
 
 
 def test_tenant_label_cardinality_is_capped():
