@@ -59,6 +59,21 @@ Usage:
       BENCH_outage_r01.json; with --check FILE it gates CI (zero
       evacuations/destructive mutations during the outage, queue fully
       drained, reconvergence bounded).
+  python bench_fleet.py --scenario store-microbench
+      -> the ISSUE 20 store A/B: one fleet-sized fake cluster, the
+      list-backed KubeMasterStore vs the watch/informer-backed
+      WatchMasterStore driving identical read mixes
+      (list_intents/scan_journals/list_worker_pods/list_pool_pods);
+      reports ops/sec and k8s LIST calls per leg. With --check it
+      gates the architectural win at any scale: >=5x ops/sec and
+      >=10x fewer LIST calls on the watch leg.
+  python bench_fleet.py --scenario fleet10k
+      -> the ISSUE 20 10k-node proof: the store microbench PLUS the
+      mount-storm, node-kill and api-outage lanes all at
+      TPM_FLEET10K_NODES (default 10000) with the watch store enabled
+      (TPUMOUNTER_WATCH_STORE=1), gates evaluated on every lane.
+      Writes BENCH_fleet10k_r01.json; with --check FILE it runs
+      env-shrunk (CI sets TPM_FLEET10K_NODES≈1000) and re-gates.
 
 Env knobs (CI smoke uses small values):
   TPM_FLEET_NODES        total cluster nodes            (default 1024)
@@ -113,6 +128,15 @@ def _post_json(url: str, payload: dict, timeout: float = 300.0):
         headers={**AUTH, "Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.status, json.loads(resp.read())
+
+
+def stop_app_store(app) -> None:
+    """End a master's watch-store informer, if one is layered under
+    the staleness cache (fleet10k runs with TPUMOUNTER_WATCH_STORE=1;
+    the default list-backed store has nothing to stop)."""
+    inner = getattr(getattr(app, "store", None), "inner", None)
+    if hasattr(inner, "stop"):
+        inner.stop()
 
 
 def build_stub_worker(latency_s: float):
@@ -239,6 +263,7 @@ class FleetStack:
             httpd.shutdown()
         for app in self.apps:
             app.registry.stop()
+            stop_app_store(app)
         self._pool.close_all()
         for server in self._servers:
             server.stop(grace=None)
@@ -593,6 +618,7 @@ def run_node_kill_bench() -> dict:
     finally:
         app.recovery.stop()
         app.registry.stop()
+        stop_app_store(app)
         pool.close_all()
         for stub in stubs:
             stub.stop(grace=None)
@@ -823,6 +849,7 @@ def run_api_outage_bench() -> dict:
     finally:
         app.recovery.stop()
         app.registry.stop()
+        stop_app_store(app)
         pool.close_all()
         for stub in stubs:
             stub.stop(grace=None)
@@ -890,6 +917,277 @@ def run_outage_scenario(check: str | None) -> None:
     print(json.dumps(summary))
 
 
+# --- store microbench A/B + 10k-node proof (--scenario fleet10k) ---
+
+FLEET10K_ARTIFACT = os.path.join(REPO, "BENCH_fleet10k_r01.json")
+FLEET10K_NODES = int(os.environ.get("TPM_FLEET10K_NODES", "10000"))
+MICRO_ROUNDS = int(os.environ.get("TPM_STORE_MICRO_ROUNDS", "40"))
+# Node-kill MTTR at 10k is two full probe sweeps (confirm_failures=2)
+# over 10k REAL in-process gRPC workers — each sweep is ~25s of
+# single-process simulator CPU (probe client AND stub servers share
+# one GIL), and post-evacuation re-convergence is <0.1s. The ceiling
+# catches a broken detection loop (10x blowups, a sweep that never
+# ends), not simulator physics — see docs/FAQ.md on interpreting
+# these gates.
+FLEET10K_MTTR_CEILING_S = float(os.environ.get(
+    "TPM_FLEET10K_MTTR_CEILING_S", "90"))
+FLEET10K_RECONVERGE_CEILING_S = float(os.environ.get(
+    "TPM_FLEET10K_RECONVERGE_CEILING_S", "45"))
+
+
+def build_store_cluster(n_nodes: int):
+    """A fleet-shaped pod population for the store A/B: n worker pods
+    (one per node), intents on ~n/10 tenant pods, a fixed journal set,
+    and pool pods bucketed across nodes — every read the master's hot
+    paths do against the store has real fleet cardinality behind it."""
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.migrate.journal import new_journal
+    from gpumounter_tpu.store import KubeMasterStore
+
+    cfg = Config().replace(
+        # the informer must survive the build-out churn without a 410
+        watch_backlog_events=max(8192, 4 * n_nodes))
+    kube = FakeKubeClient(cfg=cfg)
+    tenants = max(32, n_nodes // 10)
+    pool_pods = max(16, n_nodes // 20)
+    journals = 16
+    for i in range(n_nodes):
+        kube.create_pod(cfg.worker_namespace, {
+            "metadata": {"name": f"w-{i}",
+                         "namespace": cfg.worker_namespace,
+                         "labels": {"app": "tpu-mounter-worker"}},
+            "spec": {"nodeName": f"fleet-node-{i}",
+                     "containers": [{"name": "w"}]},
+            "status": {"phase": "Running",
+                       "podIP": f"10.{100 + i // 62500}."
+                                f"{(i // 250) % 250}.{i % 250 + 1}"}})
+    for t in range(tenants):
+        kube.create_pod("default", {
+            "metadata": {"name": f"tenant-{t}", "namespace": "default",
+                         "annotations": {"tpumounter.io/desired-chips":
+                                         str(t % 4 + 1)}},
+            "spec": {"nodeName": f"fleet-node-{t % n_nodes}",
+                     "containers": [{"name": "m"}]},
+            "status": {"phase": "Running",
+                       "podIP": f"10.200.{t // 250}.{t % 250 + 1}"}})
+    for p in range(pool_pods):
+        kube.create_pod(cfg.pool_namespace, {
+            "metadata": {"name": f"pool-{p}",
+                         "namespace": cfg.pool_namespace},
+            "spec": {"nodeName": f"fleet-node-{p % n_nodes}",
+                     "containers": [{"name": "p"}]},
+            "status": {"phase": "Running"}})
+    seed_store = KubeMasterStore(kube, cfg)
+    for j in range(journals):
+        journal = new_journal(f"mig-{j}", "default", f"tenant-{j}",
+                              "default", f"tenant-{j + 1}")
+        journal["phase"] = "done"
+        journal["outcome"] = "succeeded"
+        seed_store.save_journal(journal)
+    return kube, cfg, tenants, journals
+
+
+def _measure_store(store, kube, n_nodes: int, rounds: int) -> dict:
+    """One leg of the A/B: a fixed read mix over the shared cluster —
+    the three reads the ISSUE 20 indexes turn from O(fleet) LISTs into
+    O(result) lookups (the reads the autoscaler, migration resume and
+    evacuation paths issue on every pass). list_worker_pods is NOT in
+    the mix: its result set IS the fleet, so both backends pay O(n)
+    materializing it — the registry rides its own informer for that."""
+    lists_before = kube.list_calls
+    ops = 0
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        store.list_intents()
+        store.scan_journals()
+        ops += 2
+        for k in range(4):
+            store.list_pool_pods(f"fleet-node-{(r * 4 + k) % n_nodes}")
+            ops += 1
+    wall_s = time.perf_counter() - t0
+    return {
+        "ops": ops,
+        "wall_s": round(wall_s, 3),
+        "ops_per_s": round(ops / wall_s, 2) if wall_s else 0.0,
+        "list_calls": kube.list_calls - lists_before,
+    }
+
+
+def run_store_microbench(n_nodes: int) -> dict:
+    from gpumounter_tpu.store import KubeMasterStore, WatchMasterStore
+    kube, cfg, tenants, journals = build_store_cluster(n_nodes)
+    listed = _measure_store(KubeMasterStore(kube, cfg), kube, n_nodes,
+                            MICRO_ROUNDS)
+    watch_store = WatchMasterStore(kube, cfg)
+    try:
+        if not watch_store.wait_synced(120.0):
+            raise RuntimeError("watch store never primed")
+        assert watch_store.quiesce(30.0), watch_store.payload()
+        # Parity before speed: both backends must answer identically.
+        assert len(watch_store.list_intents()) == tenants
+        assert len(watch_store.scan_journals()) == journals
+        watched = _measure_store(watch_store, kube, n_nodes,
+                                 MICRO_ROUNDS)
+    finally:
+        watch_store.stop()
+    speedup = (watched["ops_per_s"] / listed["ops_per_s"]
+               if listed["ops_per_s"] else 0.0)
+    ratio = listed["list_calls"] / max(1, watched["list_calls"])
+    return {
+        "schema": "tpumounter-store-micro/r01",
+        "total_nodes": n_nodes,
+        "intents": tenants,
+        "journals": journals,
+        "rounds": MICRO_ROUNDS,
+        "list_backed": listed,
+        "watch_backed": watched,
+        "ops_speedup": round(speedup, 2),
+        "list_call_ratio": round(ratio, 2),
+    }
+
+
+def _micro_gate_failures(micro: dict) -> list[str]:
+    failures = []
+    if micro["ops_speedup"] < 5.0:
+        failures.append(
+            f"watch-store ops/sec speedup {micro['ops_speedup']}x "
+            f"below the 5x gate (list {micro['list_backed']['ops_per_s']}"
+            f" vs watch {micro['watch_backed']['ops_per_s']})")
+    if micro["list_call_ratio"] < 10.0:
+        failures.append(
+            f"watch-store LIST-call reduction {micro['list_call_ratio']}x"
+            f" below the 10x gate ({micro['list_backed']['list_calls']} "
+            f"vs {micro['watch_backed']['list_calls']} LIST calls)")
+    return failures
+
+
+def run_store_micro_scenario(check: str | None) -> None:
+    micro = run_store_microbench(FLEET10K_NODES)
+    summary = {
+        "metric": "store_microbench",
+        "nodes": micro["total_nodes"],
+        "list_ops_per_s": micro["list_backed"]["ops_per_s"],
+        "watch_ops_per_s": micro["watch_backed"]["ops_per_s"],
+        "ops_speedup": micro["ops_speedup"],
+        "list_call_ratio": micro["list_call_ratio"],
+    }
+    failures = _micro_gate_failures(micro) if check else []
+    if check:
+        summary["check"] = "fail" if failures else "ok"
+    print(json.dumps(summary))
+    if failures:
+        for failure in failures:
+            print(f"REGRESSION: {failure}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def run_fleet10k(check: str | None) -> None:
+    """The 10k-node proof: every lane at FLEET10K_NODES with the watch
+    store enabled under every master — exactly the deployment shape
+    docs/RUNBOOK.md prescribes for that size."""
+    global TOTAL_NODES, RECOVERY_NODES, OUTAGE_NODES
+    os.environ["TPUMOUNTER_WATCH_STORE"] = "1"
+    os.environ.setdefault("TPUMOUNTER_WATCH_BACKLOG",
+                          str(max(8192, 4 * FLEET10K_NODES)))
+    # Short watch windows so each lane's informers can be joined at
+    # teardown instead of idling out a 60s server-side window.
+    os.environ.setdefault("WATCH_STORE_TIMEOUT_S", "5")
+    TOTAL_NODES = RECOVERY_NODES = OUTAGE_NODES = FLEET10K_NODES
+
+    micro = run_store_microbench(FLEET10K_NODES)
+    storm = run_bench()
+    kill = run_node_kill_bench()
+    outage = run_api_outage_bench()
+
+    failures = _micro_gate_failures(micro)
+    if storm["throughput_gain"] < 1.4:
+        failures.append(
+            f"mount-storm sharded gain {storm['throughput_gain']}x "
+            f"below the 1.4x floor at {FLEET10K_NODES} nodes")
+    if storm["sharded"]["p99_ms"] > storm["single"]["p99_ms"] * 1.15:
+        failures.append(
+            f"mount-storm sharded p99 {storm['sharded']['p99_ms']}ms "
+            f"not better than single {storm['single']['p99_ms']}ms")
+    if storm["sharded"]["failures"] > \
+            max(1, storm["sharded"]["mounted_targets"] * 0.05):
+        failures.append(
+            f"{storm['sharded']['failures']} mount-storm failures")
+    if kill["reconverged"] != kill["affected_intents"]:
+        failures.append(
+            f"node-kill: only {kill['reconverged']}/"
+            f"{kill['affected_intents']} intents re-converged")
+    if kill["mttr_s"] > FLEET10K_MTTR_CEILING_S:
+        failures.append(
+            f"node-kill MTTR {kill['mttr_s']}s above the "
+            f"{FLEET10K_MTTR_CEILING_S}s ceiling")
+    if outage["evacuations_during_outage"]:
+        failures.append(
+            f"{outage['evacuations_during_outage']} evacuation(s) "
+            f"during the api outage")
+    if outage["write_queue_pending_after"] or \
+            outage["deferred_writes_landed"] != outage["deferred_writes"]:
+        failures.append("api-outage deferred writes not exactly-once")
+    if outage["reconverged"] != outage["affected_intents"]:
+        failures.append(
+            f"api-outage: only {outage['reconverged']}/"
+            f"{outage['affected_intents']} intents re-converged")
+    if outage["reconverge_s"] > FLEET10K_RECONVERGE_CEILING_S:
+        failures.append(
+            f"api-outage reconverge {outage['reconverge_s']}s above "
+            f"the {FLEET10K_RECONVERGE_CEILING_S}s ceiling")
+
+    results = {
+        "schema": "tpumounter-fleet10k/r01",
+        "total_nodes": FLEET10K_NODES,
+        "watch_store_enabled": True,
+        "store_microbench": micro,
+        "mount_storm": storm,
+        "node_kill": kill,
+        "api_outage": outage,
+        "gate_failures": failures,
+        "meets_gates": not failures,
+    }
+    summary = {
+        "metric": "fleet10k",
+        "nodes": FLEET10K_NODES,
+        "store_ops_speedup": micro["ops_speedup"],
+        "store_list_call_ratio": micro["list_call_ratio"],
+        "storm_gain": storm["throughput_gain"],
+        "node_kill_mttr_s": kill["mttr_s"],
+        "outage_reconverge_s": outage["reconverge_s"],
+        "meets_gates": not failures,
+    }
+    if check:
+        # CI smoke: env-shrunk fresh run; the committed artifact must
+        # exist (the 10k proof is part of the tree) and the structural
+        # gates must hold at smoke size too.
+        with open(check, encoding="utf-8") as f:
+            committed = json.load(f)
+        if not committed.get("meets_gates"):
+            failures.append("committed fleet10k artifact has failing "
+                            "gates")
+        out = os.environ.get("TPM_FLEET10K_ARTIFACT")
+        if out:
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(results, f, indent=1)
+        summary["check"] = "fail" if failures else "ok"
+        print(json.dumps(summary))
+        if failures:
+            for failure in failures:
+                print(f"REGRESSION: {failure}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+    artifact = os.environ.get("TPM_FLEET10K_ARTIFACT", FLEET10K_ARTIFACT)
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(summary))
+    if failures:
+        for failure in failures:
+            print(f"GATE FAILURE: {failure}", file=sys.stderr)
+        raise SystemExit(1)
+
+
 def run_bench() -> dict:
     single = run_mode(sharded=False)
     sharded = run_mode(sharded=True)
@@ -924,13 +1222,17 @@ def main() -> None:
                              "a healthy sharded-vs-single win and no "
                              "regression vs the committed artifact")
     parser.add_argument("--scenario",
-                        choices=["storm", "node-kill", "api-outage"],
+                        choices=["storm", "node-kill", "api-outage",
+                                 "store-microbench", "fleet10k"],
                         default="storm",
                         help="storm = the shard-scale mount storm; "
                              "node-kill = the recovery-plane MTTR bench "
                              "(BENCH_recovery artifact); api-outage = "
                              "the degraded-mode ride-through bench "
-                             "(BENCH_outage artifact)")
+                             "(BENCH_outage artifact); store-microbench "
+                             "= the list-vs-watch store A/B; fleet10k = "
+                             "every lane at TPM_FLEET10K_NODES with the "
+                             "watch store on (BENCH_fleet10k artifact)")
     args = parser.parse_args()
 
     if args.scenario == "node-kill":
@@ -938,6 +1240,12 @@ def main() -> None:
         return
     if args.scenario == "api-outage":
         run_outage_scenario(args.check)
+        return
+    if args.scenario == "store-microbench":
+        run_store_micro_scenario(args.check)
+        return
+    if args.scenario == "fleet10k":
+        run_fleet10k(args.check)
         return
 
     results = run_bench()

@@ -191,12 +191,21 @@ class AutoscaleController:
 
     # --- feasibility (where would a grow land) ---
 
-    def _grow_feasibility(self, need: int, nodes: dict) -> dict:
+    def _grow_feasibility(self, need: int, nodes: dict,
+                          claims: list[int] | None = None) -> dict:
         """Can the fleet place `need` more chips as one ICI block on a
         single non-quarantined host? Mirrors the capacity plane's
         verdict vocabulary so operators read one language everywhere.
         Warm chips count toward after-defrag capacity only — warm
-        holders are reclaimable bookings, not free blocks."""
+        holders are reclaimable bookings, not free blocks.
+
+        claims: chip counts already granted to earlier tenants in THIS
+        pass. The snapshot doesn't see them (actuation is an intent
+        write, not an instant mount), so they are simulated here —
+        best-fit against the admissible hosts — before judging `need`.
+        This is what makes evaluation order an allocation order under
+        contention: a high-priority tenant's grow consumes the block a
+        lower-priority tenant would otherwise double-book."""
         excluded = frozenset()
         if self.health is not None:
             try:
@@ -204,8 +213,7 @@ class AutoscaleController:
             except Exception:  # noqa: BLE001 — fail-open exclusion,
                 # exactly like every other excluded_hosts consumer
                 excluded = frozenset()
-        admissible_now = 0
-        after_defrag = 0
+        hosts = []
         warm_ready = 0
         for node, entry in nodes.items():
             if node in excluded:
@@ -214,9 +222,27 @@ class AutoscaleController:
             if cap.get("capacity_unknown"):
                 continue
             warm_ready += int(cap.get("warm_ready", 0))
-            if cap["largest_block"] >= need:
+            hosts.append({"largest_block": int(cap["largest_block"]),
+                          "loose": int(cap["free"]) + int(cap["warm"])})
+        for claim in claims or ():
+            # best-fit: the smallest block that holds the claim, so big
+            # blocks survive for big later grows
+            fit = min((h for h in hosts
+                       if h["largest_block"] >= claim),
+                      key=lambda h: h["largest_block"], default=None)
+            if fit is None:
+                fit = min((h for h in hosts if h["loose"] >= claim),
+                          key=lambda h: h["loose"], default=None)
+            if fit is not None:
+                fit["largest_block"] = max(
+                    0, fit["largest_block"] - claim)
+                fit["loose"] -= claim
+        admissible_now = 0
+        after_defrag = 0
+        for h in hosts:
+            if h["largest_block"] >= need:
                 admissible_now += 1
-            elif cap["free"] + cap["warm"] >= need:
+            elif h["loose"] >= need:
                 after_defrag += 1
         if admissible_now:
             verdict = "admissible"
@@ -292,8 +318,15 @@ class AutoscaleController:
                 f"({'api outage' if is_outage(exc) else exc})", 503)
         from gpumounter_tpu.obs.fleet import merge_tenants
         snapshots = merge_tenants(nodes)
+        # Priority classes under contention: higher tpumounter.io/priority
+        # tenants are evaluated (and so claim spare capacity) first; the
+        # default class (priority 0) keeps today's stable alphabetical
+        # order. Capacity gates close mid-pass, so evaluation order IS
+        # allocation order when the fleet cannot fit every grow.
+        pass_claims: list[int] = []
         for namespace, pod_name, intent in sorted(
-                intents, key=lambda t: (t[0], t[1])):
+                intents,
+                key=lambda t: (-t[2].priority, t[0], t[1])):
             # journal boundary: gates re-checked between tenants; a
             # mid-pass degradation parks the REST of the pass, never
             # unwinds decisions already journaled
@@ -314,8 +347,12 @@ class AutoscaleController:
                 break
             record["considered"] += 1
             decision = self._decide(namespace, pod_name, intent,
-                                    snapshots, nodes, gates, now)
+                                    snapshots, nodes, gates, now,
+                                    pass_claims)
             record["decisions"].append(decision)
+            if decision["action"] == "grow":
+                pass_claims.append(decision["to_chips"]
+                                   - decision["from_chips"])
         if record["status"] == "running":
             record["status"] = "completed"
         AUTOSCALE_PASSES.inc()
@@ -334,7 +371,7 @@ class AutoscaleController:
 
     def _decide(self, namespace: str, pod_name: str, intent: Intent,
                 snapshots: dict, nodes: dict, gates: dict,
-                now: float) -> dict:
+                now: float, pass_claims: list[int] | None = None) -> dict:
         tenant = f"{namespace}/{pod_name}"
         decision = {"at": now, "tenant": tenant,
                     "namespace": namespace, "pod": pod_name,
@@ -396,7 +433,8 @@ class AutoscaleController:
             if target <= intent.desired_chips:
                 return hold("at-ceiling")
             feas = self._grow_feasibility(
-                target - intent.desired_chips, nodes)
+                target - intent.desired_chips, nodes,
+                claims=pass_claims)
             decision["feasibility"] = feas
             if feas["verdict"] == "infeasible":
                 return hold("infeasible")

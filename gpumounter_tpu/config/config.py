@@ -157,6 +157,48 @@ class Config:
     bulk_proxy_timeout_s: float = field(default_factory=lambda: float(
         _env("BULK_PROXY_TIMEOUT_S", "330")))
 
+    # --- watch/informer-backed master store (store/watch.py) ---
+    # Opt-in: layer a WatchMasterStore (list-once + watch-resume with
+    # resourceVersion bookkeeping, O(result) in-memory indexes) under
+    # the PR 10 staleness cache. Off by default: the list-backed store
+    # is exact at small fleets; at ~10k nodes the per-operation LISTs
+    # are the wall (see docs/RUNBOOK.md "Running at 10k nodes").
+    store_watch_enabled: bool = field(default_factory=lambda: _env(
+        "TPUMOUNTER_WATCH_STORE", "0") not in ("0", "false", ""))
+    # One watch stream's server-side timeout; the informer re-opens
+    # from its last resourceVersion when a stream ends cleanly.
+    store_watch_timeout_s: float = field(default_factory=lambda: float(
+        _env("WATCH_STORE_TIMEOUT_S", "60")))
+    # Bounded relist backoff after a 410 Gone (expired resourceVersion):
+    # exponential from base to cap, never a tight loop.
+    store_watch_relist_base_s: float = field(default_factory=lambda: float(
+        _env("WATCH_STORE_RELIST_BASE_S", "0.5")))
+    store_watch_relist_cap_s: float = field(default_factory=lambda: float(
+        _env("WATCH_STORE_RELIST_CAP_S", "30")))
+    # How long a read waits for the initial LIST+sync before falling
+    # back to a direct list-backed read (startup only).
+    store_watch_sync_timeout_s: float = field(default_factory=lambda: float(
+        _env("WATCH_STORE_SYNC_TIMEOUT_S", "10")))
+    # Fake apiserver watch backlog (k8s/fake.py): events kept for
+    # resumable watches. 8192 overruns under 10k-node churn — benches
+    # and big-fleet tests raise it; an overrun ends the stream (the
+    # fake's 410) and bumps tpumounter_watch_backlog_evictions_total.
+    watch_backlog_events: int = field(default_factory=lambda: int(
+        _env("TPUMOUNTER_WATCH_BACKLOG", "8192")))
+
+    # --- shared bounded fan-out core (utils/fanout.py) ---
+    # One process-wide executor for the master's hot fan-out paths
+    # (fleet collect, recovery probes, bulk sub-batch dispatch, canary
+    # probes) instead of a fixed 16-thread pool per subsystem pass.
+    # Width 0 = auto (4 x cpu count, min 32).
+    fanout_width: int = field(default_factory=lambda: int(
+        _env("TPUMOUNTER_FANOUT_WIDTH", "0")))
+    # Per-shard concurrency budget within one fan-out pass: a slow
+    # rack/shard can hold at most this many core slots, so it cannot
+    # stall an unrelated shard's work. 0 = no per-shard cap.
+    fanout_shard_budget: int = field(default_factory=lambda: int(
+        _env("TPUMOUNTER_FANOUT_SHARD_BUDGET", "16")))
+
     # --- node-failure recovery plane (worker ledger / epoch fencing /
     # evacuation) ---
     # Durable worker mount ledger: an fsync'd append-only JSONL journal

@@ -701,19 +701,34 @@ class CanaryProber:
 
     def probe_once(self) -> int:
         """One probe pass over the decision-relevant set; returns probes
-        actually driven (skips excluded)."""
-        driven = 0
+        actually driven (skips excluded). Probes fan out on the shared
+        core (utils/fanout.py): the decision-relevant set is exactly the
+        nodes most likely to burn the full probe deadline, so a serial
+        pass degraded to minutes right when quarantine decisions needed
+        the evidence fastest."""
         snapshot = dict(self.registry.registry_snapshot())
+        work = []
         for node in self.targets():
             ip = snapshot.get(node)
             if ip is None:
                 continue  # not registered: recovery's problem, not ours
-            address = f"{ip}:{self.cfg.worker_port}"
+            work.append((node, f"{ip}:{self.cfg.worker_port}"))
+        if not work:
+            return 0
+
+        def _probe_one(item: tuple[str, str]):
+            node, address = item
             try:
                 ok, detail = self.probe(node, address)
             except Exception as exc:  # noqa: BLE001 — a probe that
                 # cannot even dial IS the evidence
                 ok, detail = False, f"{type(exc).__name__}: {exc}"
+            return node, ok, detail
+
+        from gpumounter_tpu.utils.fanout import get_core
+        driven = 0
+        for node, ok, detail in get_core(self.cfg).run(
+                work, _probe_one, kind="canary-probe"):
             if ok is None:
                 continue  # no canary pod on the node: skip, not fail
             driven += 1

@@ -36,6 +36,7 @@ from gpumounter_tpu.k8s.errors import (  # noqa: F401 — re-exports
     ApiError,
     ApiTimeoutError,
     ConflictError,
+    GoneError,
     NotFoundError,
     PartitionError,
     ServerError,
@@ -128,8 +129,24 @@ class KubeClient(abc.ABC):
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
                    resource_version: str = "") -> Iterator[tuple[str, dict]]:
-        """Yield (event_type, pod_json) until timeout. Types: ADDED/MODIFIED/DELETED."""
+        """Yield (event_type, pod_json) until timeout. Types:
+        ADDED/MODIFIED/DELETED. namespace="" watches every namespace.
+        resource_version resumes from that point in the event history;
+        a version that already fell out of the server's watch window
+        raises GoneError (the caller re-LISTs and re-opens — the
+        informer protocol, store/watch.py)."""
         ...
+
+    def list_pods_with_rv(self, namespace: str | None = None,
+                          label_selector: str = "",
+                          field_selector: str = "",
+                          ) -> tuple[list[dict], str]:
+        """LIST plus the collection resourceVersion the list was taken
+        at — the informer's resume cursor. Default: plain list with an
+        empty cursor (watch-from-now; backends that can do better
+        override)."""
+        return self.list_pods(namespace, label_selector=label_selector,
+                              field_selector=field_selector), ""
 
     def create_event(self, namespace: str, manifest: dict) -> dict:
         """Post a core/v1 Event. Best-effort surface; default no-op so
@@ -430,6 +447,20 @@ class RestKubeClient(KubeClient):
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:
+        return self._list_pods_raw(namespace, label_selector,
+                                   field_selector).get("items", [])
+
+    def list_pods_with_rv(self, namespace: str | None = None,
+                          label_selector: str = "",
+                          field_selector: str = "",
+                          ) -> tuple[list[dict], str]:
+        doc = self._list_pods_raw(namespace, label_selector,
+                                  field_selector)
+        return doc.get("items", []), \
+            str(doc.get("metadata", {}).get("resourceVersion", "") or "")
+
+    def _list_pods_raw(self, namespace: str | None, label_selector: str,
+                       field_selector: str) -> dict:
         path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
                 else "/api/v1/pods")
         query: dict[str, Any] = {}
@@ -437,7 +468,7 @@ class RestKubeClient(KubeClient):
             query["labelSelector"] = label_selector
         if field_selector:
             query["fieldSelector"] = field_selector
-        return self._json("GET", path, query=query).get("items", [])
+        return self._json("GET", path, query=query)
 
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
@@ -450,12 +481,13 @@ class RestKubeClient(KubeClient):
             query["fieldSelector"] = field_selector
         if resource_version:
             query["resourceVersion"] = resource_version
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")  # "" = all namespaces (informer)
         # Open the connection EAGERLY (before the generator is consumed):
         # wait_for_pod depends on watch-then-recheck ordering to avoid
         # losing events raised between its state check and the watch start.
-        conn, resp = self._request(
-            "GET", f"/api/v1/namespaces/{namespace}/pods", query,
-            timeout=timeout_s + 10.0)
+        conn, resp = self._request("GET", path, query,
+                                   timeout=timeout_s + 10.0)
         if resp.status >= 400:
             body = resp.read().decode("utf-8", "replace")
             conn.close()
@@ -486,7 +518,18 @@ class _WatchStream:
                 if not line.strip():
                     continue
                 event = json.loads(line)
-                return event.get("type", ""), event.get("object", {})
+                etype = event.get("type", "")
+                obj = event.get("object", {})
+                if etype == "ERROR":
+                    # The API server reports an expired resourceVersion
+                    # as an in-stream ERROR Status with code 410; the
+                    # informer must re-LIST, not keep consuming.
+                    self.close()
+                    code = int(obj.get("code", 0) or 0)
+                    if code == 410:
+                        raise GoneError(obj.get("message", "watch expired"))
+                    _raise_for(code or 500, obj.get("message", ""))
+                return etype, obj
             try:
                 chunk = self._resp.read1(65536)
             except (socket.timeout, TimeoutError):

@@ -49,6 +49,17 @@ class ConflictError(ApiError):
         super().__init__(409, message)
 
 
+class GoneError(ApiError):
+    """410: the requested resourceVersion fell out of the API server's
+    watch window (etcd compaction / the fake's trimmed backlog). The
+    API ANSWERED — this is not outage evidence — but the watcher's
+    cursor is unusable: re-LIST and re-open from the fresh version
+    (store/watch.py's bounded relist)."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(410, message)
+
+
 class ServerError(ApiError):
     """5xx: the API server answered with a failure of its own. Safe to
     retry (the request may never have been applied) and evidence toward
@@ -82,6 +93,8 @@ def raise_for(status: int, body: str) -> None:
         raise NotFoundError(body)
     if status == 409:
         raise ConflictError(body)
+    if status == 410:
+        raise GoneError(body)
     if status == 504:
         raise ApiTimeoutError(body)
     if status >= 500:

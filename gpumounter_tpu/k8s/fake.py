@@ -19,6 +19,7 @@ from collections.abc import Callable, Iterator
 
 from gpumounter_tpu.k8s.client import (
     ConflictError,
+    GoneError,
     KubeClient,
     NotFoundError,
     inject_write_fault,
@@ -26,8 +27,17 @@ from gpumounter_tpu.k8s.client import (
 from gpumounter_tpu.k8s.types import Pod, match_label_selector
 from gpumounter_tpu.utils.locks import OrderedCondition
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("k8s.fake")
+
+#: events trimmed out of the watch backlog while at least one open
+#: watcher had not consumed them yet — each eviction is a future 410
+#: for that watcher. A rising rate means the backlog is undersized for
+#: the churn (TPUMOUNTER_WATCH_BACKLOG, docs/RUNBOOK.md 10k-nodes).
+WATCH_BACKLOG_EVICTIONS = REGISTRY.counter(
+    "tpumounter_watch_backlog_evictions_total",
+    "watch events evicted past an open watcher's resume cursor")
 
 SchedulerHook = Callable[[dict], None]
 """Called (with the stored pod dict, mutable) right after create_pod.
@@ -70,7 +80,14 @@ def _merge_patch(target: dict, patch: dict) -> None:
 class FakeKubeClient(KubeClient):
     def __init__(self, scheduler_hook: SchedulerHook | None = None,
                  scheduler_delay_s: float = 0.0,
-                 delete_hook: SchedulerHook | None = None):
+                 delete_hook: SchedulerHook | None = None,
+                 cfg=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        #: watch backlog bound, from TPUMOUNTER_WATCH_BACKLOG — 8192
+        #: overruns under 10k-node churn (big-fleet benches raise it).
+        self._max_events = max(64, int(cfg.watch_backlog_events))
         self._pods: dict[tuple[str, str], dict] = {}
         self._nodes: dict[str, dict] = {}
         #: API-partition simulation (recovery/chaos tests): while set,
@@ -92,6 +109,15 @@ class FakeKubeClient(KubeClient):
         self.scheduler_delay_s = scheduler_delay_s
         self.create_calls = 0
         self.delete_calls = 0
+        self.list_calls = 0
+        #: last event seq emitted — the collection resourceVersion a
+        #: LIST reports (list_pods_with_rv) and watchers resume from.
+        self._last_seq = 0
+        #: open watcher id -> last consumed seq, for the backlog
+        #: eviction counter (an eviction only counts when it strands a
+        #: LIVE watcher — trimming history nobody needs is free).
+        self._watch_cursors: dict[int, int] = {}
+        self._watch_ids = itertools.count(1)
         self.events_posted: list[tuple[str, dict]] = []
         # Single-worker async scheduler: created pods enqueue a due-time
         # into this heap and ONE thread drains it (created lazily,
@@ -105,11 +131,12 @@ class FakeKubeClient(KubeClient):
 
     # --- event plumbing ---
 
-    #: bounded event backlog. Sequence numbers are consecutive, so any
-    #: watcher can locate its resume point by arithmetic (O(1), not an
-    #: O(total-events) rescan per wake — the old shape made a 1k-node
-    #: churn test quadratic). A watcher that falls behind the trim
-    #: horizon has its stream end, exactly like a real apiserver's
+    #: bounded event backlog (default; the instance bound comes from
+    #: cfg.watch_backlog_events). Sequence numbers are consecutive, so
+    #: any watcher can locate its resume point by arithmetic (O(1), not
+    #: an O(total-events) rescan per wake — the old shape made a
+    #: 1k-node churn test quadratic). A watcher that falls behind the
+    #: trim horizon has its stream end, exactly like a real apiserver's
     #: 410 Gone on an expired resourceVersion: callers re-LIST and
     #: re-open (WorkerRegistry's loop and wait_for_pod already do).
     _MAX_EVENTS = 8192
@@ -119,9 +146,24 @@ class FakeKubeClient(KubeClient):
             # One deepcopy per event, at emit: the stored payload is
             # immutable from then on, so watchers can filter (and copy
             # matches) outside the lock.
-            self._events.append((next(self._seq), etype, copy.deepcopy(pod)))
-            if len(self._events) > self._MAX_EVENTS:
-                del self._events[:len(self._events) - self._MAX_EVENTS]
+            seq = next(self._seq)
+            self._last_seq = seq
+            # Stamp the object's resourceVersion like the API server:
+            # informers resume from the last event's version.
+            pod.setdefault("metadata", {})["resourceVersion"] = str(seq)
+            self._events.append((seq, etype, copy.deepcopy(pod)))
+            overflow = len(self._events) - self._max_events
+            if overflow > 0:
+                # Count evictions only past the SLOWEST open watcher:
+                # those events are a guaranteed future 410 for it.
+                horizon = self._events[overflow - 1][0]
+                evicted = 0
+                for cursor in self._watch_cursors.values():
+                    evicted = max(evicted,
+                                  min(overflow, horizon - cursor))
+                if evicted > 0:
+                    WATCH_BACKLOG_EVICTIONS.inc(evicted)
+                del self._events[:overflow]
             self._lock.notify_all()
 
     # --- KubeClient surface ---
@@ -251,12 +293,21 @@ class FakeKubeClient(KubeClient):
 
     def list_pods(self, namespace: str | None = None, label_selector: str = "",
                   field_selector: str = "") -> list[dict]:
+        return self.list_pods_with_rv(namespace,
+                                      label_selector=label_selector,
+                                      field_selector=field_selector)[0]
+
+    def list_pods_with_rv(self, namespace: str | None = None,
+                          label_selector: str = "",
+                          field_selector: str = "",
+                          ) -> tuple[list[dict], str]:
         self._check_partition("read")
         # Filter FIRST, deepcopy only the matches: a selector LIST over
         # a 1k-pod cluster used to deepcopy every pod (the fake's
         # dominant cost at fleet scale — the registry, the reconciler
         # resync and the warm-pool resync all LIST with selectors).
         with self._lock:
+            self.list_calls += 1
             out = []
             for (ns, _name), pod in self._pods.items():
                 if namespace and ns != namespace:
@@ -267,7 +318,8 @@ class FakeKubeClient(KubeClient):
                 if not _match_field_selector(pod, field_selector):
                     continue
                 out.append(copy.deepcopy(pod))
-        return out
+            rv = str(self._last_seq)
+        return out, rv
 
     def watch_pods(self, namespace: str, *, label_selector: str = "",
                    field_selector: str = "", timeout_s: float = 60.0,
@@ -278,7 +330,22 @@ class FakeKubeClient(KubeClient):
         # missed-event window (KubeClient.wait_for_pod).
         deadline = time.monotonic() + timeout_s
         with self._lock:
-            cursor = self._events[-1][0] if self._events else 0
+            if resource_version:
+                # Resume AFTER the given version (informer protocol).
+                # A cursor that already fell behind the trim horizon is
+                # the real apiserver's immediate 410 on watch open.
+                try:
+                    cursor = int(resource_version)
+                except ValueError:
+                    cursor = self._events[-1][0] if self._events else 0
+                else:
+                    if self._events and cursor < self._events[0][0] - 1:
+                        raise GoneError(
+                            f"resourceVersion {resource_version} is too "
+                            f"old (backlog starts at "
+                            f"{self._events[0][0]})")
+            else:
+                cursor = self._events[-1][0] if self._events else 0
         return self._watch_iter(namespace, label_selector, field_selector,
                                 deadline, cursor)
 
@@ -297,36 +364,42 @@ class FakeKubeClient(KubeClient):
 
     def _watch_iter(self, namespace, label_selector, field_selector,
                     deadline, cursor) -> Iterator[tuple[str, dict]]:
-        while True:
-            with self._lock:
-                pending = self._pending_locked(cursor)
-                if pending is not None and not pending:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return
-                    self._lock.wait(timeout=min(remaining, 0.25))
+        watch_id = next(self._watch_ids)
+        try:
+            while True:
+                with self._lock:
+                    self._watch_cursors[watch_id] = cursor
                     pending = self._pending_locked(cursor)
-            if pending is None:
-                logger.warning("watch backlog trimmed past cursor %d; "
-                               "ending stream (caller must re-list)",
-                               cursor)
-                return
-            # Filter + deepcopy OUTSIDE the lock: event payloads are
-            # immutable after emit, and only matches pay the copy — a
-            # field-selector watch (one pod) over heavy churn was
-            # paying a deepcopy per event per watcher.
-            for seq, etype, pod in pending:
-                cursor = max(cursor, seq)
-                p = Pod(pod)
-                if p.namespace != namespace:
-                    continue
-                if not match_label_selector(p.labels, label_selector):
-                    continue
-                if not _match_field_selector(pod, field_selector):
-                    continue
-                yield etype, copy.deepcopy(pod)
-            if time.monotonic() >= deadline:
-                return
+                    if pending is not None and not pending:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return
+                        self._lock.wait(timeout=min(remaining, 0.25))
+                        pending = self._pending_locked(cursor)
+                if pending is None:
+                    logger.warning("watch backlog trimmed past cursor %d; "
+                                   "ending stream (caller must re-list)",
+                                   cursor)
+                    return
+                # Filter + deepcopy OUTSIDE the lock: event payloads are
+                # immutable after emit, and only matches pay the copy — a
+                # field-selector watch (one pod) over heavy churn was
+                # paying a deepcopy per event per watcher.
+                for seq, etype, pod in pending:
+                    cursor = max(cursor, seq)
+                    p = Pod(pod)
+                    if namespace and p.namespace != namespace:
+                        continue
+                    if not match_label_selector(p.labels, label_selector):
+                        continue
+                    if not _match_field_selector(pod, field_selector):
+                        continue
+                    yield etype, copy.deepcopy(pod)
+                if time.monotonic() >= deadline:
+                    return
+        finally:
+            with self._lock:
+                self._watch_cursors.pop(watch_id, None)
 
     def patch_pod(self, namespace: str, name: str, patch: dict) -> dict:
         self._check_partition("write")

@@ -331,6 +331,12 @@ class HealthTrackingKubeClient(KubeClient):
                           label_selector=label_selector,
                           field_selector=field_selector)
 
+    def list_pods_with_rv(self, namespace=None, label_selector="",
+                          field_selector=""):
+        return self._call("read", "list_pods_with_rv", namespace,
+                          label_selector=label_selector,
+                          field_selector=field_selector)
+
     def patch_pod(self, namespace, name, patch):
         return self._call("write", "patch_pod", namespace, name, patch)
 

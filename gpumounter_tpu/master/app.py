@@ -481,10 +481,18 @@ class MasterApp:
             from gpumounter_tpu.store import (
                 CachedMasterStore,
                 KubeMasterStore,
+                WatchMasterStore,
             )
-            store = CachedMasterStore(
-                KubeMasterStore(kube, self.cfg), cfg=self.cfg,
-                apihealth=self.apihealth)
+            # TPUMOUNTER_WATCH_STORE=1 swaps the list-backed inner
+            # store for the watch/informer-backed one (store/watch.py)
+            # — O(result) index reads instead of O(fleet) API lists.
+            # The outage cache layers ABOVE either one unchanged.
+            if self.cfg.store_watch_enabled:
+                inner = WatchMasterStore(kube, self.cfg)
+            else:
+                inner = KubeMasterStore(kube, self.cfg)
+            store = CachedMasterStore(inner, cfg=self.cfg,
+                                      apihealth=self.apihealth)
         self.store = store
         # Shard ownership (master/shard.py): inactive by default (one
         # master owns everything, zero overhead); master/main.py starts
@@ -1451,7 +1459,7 @@ class MasterApp:
                         "error": f"shard for node {node} has no live "
                                  f"owner yet"}
 
-        threads = []
+        forwards = []
         if remote:
             # Contextvars don't cross threads: capture the edge span's
             # context HERE and re-attach it in each forwarder, so the
@@ -1461,7 +1469,8 @@ class MasterApp:
             # orphaned the remote half of every proxied bulk mount).
             edge_ctx = trace.current()
 
-            def _forward(url: str, indices: list[int]) -> None:
+            def _forward(item: tuple[str, list[int]]) -> None:
+                url, indices = item
                 with trace.attached(edge_ctx), \
                         trace.span("proxy.batch", url=url,
                                    targets=len(indices)):
@@ -1470,11 +1479,14 @@ class MasterApp:
                 for i, entry in zip(indices, entries):
                     results[i] = entry
 
-            threads = [threading.Thread(target=_forward, args=(url, idx),
-                                        daemon=True)
-                       for url, idx in remote.items()]
-            for th in threads:
-                th.start()
+            # Futures on the shared core, NOT a blocking core.run():
+            # the remote sub-batches must overlap with the local mounts
+            # below (the old thread-per-URL behavior). _forward is
+            # exception-safe (_proxy_batch returns ProxyError entries).
+            from gpumounter_tpu.utils.fanout import get_core
+            core = get_core(self.cfg)
+            forwards = [core.submit(_forward, item, kind="batch-proxy")
+                        for item in remote.items()]
         if local_by_node:
             # One resolve total: the grouping computed above IS the
             # mount plan (re-resolving would double the pod reads and
@@ -1484,8 +1496,8 @@ class MasterApp:
             for indices in local_by_node.values():
                 for i in indices:
                     results[i] = local_results[i]
-        for th in threads:
-            th.join()
+        for fut in forwards:
+            fut.result()
 
         out = [r if r is not None else
                {"namespace": targets[i].namespace, "pod": targets[i].pod,

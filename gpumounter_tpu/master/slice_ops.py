@@ -19,7 +19,6 @@ fixes it (it must match the job's process ranks).
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 
 from gpumounter_tpu.faults import failpoints
@@ -281,16 +280,24 @@ class BulkMountCoordinator:
 
         nodes = list(by_node.items())
         width = max(1, int(self.cfg.bulk_node_fanout))
-        # Node groups are independent; a bounded wave pattern keeps a
-        # thousand-node request from spawning a thousand threads.
-        for start in range(0, len(nodes), width):
-            wave = nodes[start:start + width]
-            threads = [threading.Thread(target=_mount_node, args=(n, idx),
-                                        daemon=True) for n, idx in wave]
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
+        # Node groups are independent; the shared fan-out core bounds
+        # them at bulk_node_fanout concurrent node groups (per shard
+        # when sharding is active) — same bound as the old thread
+        # waves, but without the wave barrier: a thousand-node request
+        # keeps `width` mounts in flight continuously instead of
+        # stalling each wave on its slowest node. Safe when this runs
+        # inside a proxied sub-batch already on the core: nested calls
+        # fall back to transient threads (utils/fanout.py).
+        if nodes:
+            from gpumounter_tpu.utils.fanout import get_core
+            if self.shards is not None and self.shards.active() \
+                    and hasattr(self.shards, "owner_shard"):
+                shard_of = lambda pair: self.shards.owner_shard(pair[0])  # noqa: E731
+            else:
+                shard_of = lambda pair: 0  # noqa: E731 — one budget pool
+            get_core(self.cfg).run(
+                nodes, lambda pair: _mount_node(*pair),
+                kind="bulk-mount", shard_of=shard_of, shard_budget=width)
         return [r if r is not None else
                 {"namespace": targets[i].namespace, "pod": targets[i].pod,
                  "result": "Error", "error": "internal: unprocessed"}
@@ -375,13 +382,15 @@ class SliceCoordinator:
             except Exception as exc:  # noqa: BLE001 — per-host gRPC boundary
                 results[i] = exc
 
-        threads = [threading.Thread(target=_mount, args=(i, addr, t, node),
-                                    daemon=True)
-                   for i, (t, node, addr, _ip) in enumerate(resolved)]
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join()
+        # Per-host mounts ride the shared fan-out core (bounded by the
+        # core width instead of thread-per-host; _mount is
+        # exception-safe so the pass never raises out of the core).
+        from gpumounter_tpu.utils.fanout import get_core
+        get_core(self.cfg).run(
+            list(enumerate(resolved)),
+            lambda item: _mount(item[0], item[1][2], item[1][0],
+                                item[1][1]),
+            kind="slice-mount")
 
         failures = {i: r for i, r in results.items()
                     if not (isinstance(r, tuple)
@@ -497,13 +506,12 @@ class SliceCoordinator:
             except Exception as exc:  # noqa: BLE001
                 results[i] = exc
 
-        threads = [threading.Thread(target=_remove, args=(i, addr, t, node),
-                                    daemon=True)
-                   for i, (t, node, addr, _ip) in enumerate(resolved)]
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join()
+        from gpumounter_tpu.utils.fanout import get_core
+        get_core(self.cfg).run(
+            list(enumerate(resolved)),
+            lambda item: _remove(item[0], item[1][2], item[1][0],
+                                 item[1][1]),
+            kind="slice-remove")
         outcome = {
             resolved[i][0].pod: (r.name if isinstance(r, api.RemoveTPUResult)
                                  else f"error: {r}")

@@ -27,7 +27,6 @@ import re
 import threading
 import time
 import urllib.request
-from concurrent import futures
 
 from gpumounter_tpu.cgroup.ebpf import DEVICE_TELEMETRY
 from gpumounter_tpu.obs import trace
@@ -426,10 +425,6 @@ class FleetCollector:
         #: Same observer contract: exception-isolated, fail-open.
         self.autoscale_model = None
         self.interval_s = cfg.fleet_scrape_interval_s
-        #: per-node collection fan-out width: a few wedged workers each
-        #: burn their full RPC deadline, so a serial pass would stall
-        #: the whole fleet behind them.
-        self.collect_width = 16
         self._lock = OrderedLock("fleet.nodes")
         # Single-flight guard: concurrent stale observers (dashboards
         # polling /fleet at the interval edge) must not each launch
@@ -549,13 +544,22 @@ class FleetCollector:
                          if self.shards.owns_node(node)]
             fresh: dict[str, dict] = {}
             if items:
-                width = max(1, min(self.collect_width, len(items)))
-                with futures.ThreadPoolExecutor(
-                        max_workers=width,
-                        thread_name_prefix="fleet-collect") as pool:
-                    for node, entry in pool.map(
-                            lambda it: self._collect_one(*it), items):
-                        fresh[node] = entry
+                # Shared fan-out core (utils/fanout.py) instead of a
+                # private per-pass pool: per-shard budgets keep one
+                # slow rack from camping every core slot, and the pass
+                # parallelism scales with the host instead of a fixed
+                # 16. _collect_one is exception-safe, so a pass never
+                # raises out of the core.
+                from gpumounter_tpu.utils.fanout import get_core
+                core = get_core(self.cfg)
+                shard_of = None
+                if self.shards is not None and self.shards.active() \
+                        and hasattr(self.shards, "owner_shard"):
+                    shard_of = lambda it: self.shards.owner_shard(it[0])  # noqa: E731
+                for node, entry in core.run(
+                        items, lambda it: self._collect_one(*it),
+                        kind="fleet-collect", shard_of=shard_of):
+                    fresh[node] = entry
             with self._lock:
                 self._nodes = fresh
                 self._collected_at = time.time()

@@ -155,17 +155,10 @@ class RecoveryController:
 
     # --- detection ---
 
-    #: probe fan-out width: a correlated failure (rack outage) must not
-    #: serialize N dead nodes' probe timeouts — detection latency would
-    #: grow linearly with the blast radius, exactly when speed matters.
-    #: Same bounded-pool pattern as the fleet collector (obs/fleet.py).
-    PROBE_POOL_WIDTH = 16
-
     def check_once(self) -> dict:
         """One detection pass over every tracked node (liveness probes
         fanned out over a bounded pool). Returns the pass summary
         {checked, suspect, evacuated:[...]}."""
-        from concurrent import futures
         snapshot = self.registry.registry_snapshot()
         with self._lock:
             tracked = set(self._nodes) | set(snapshot)
@@ -181,14 +174,22 @@ class RecoveryController:
             owned.append(node)
         verdicts: dict[str, tuple[bool, str]] = {}
         if owned:
-            width = min(self.PROBE_POOL_WIDTH, len(owned))
-            with futures.ThreadPoolExecutor(
-                    max_workers=width,
-                    thread_name_prefix="recovery-probe") as pool:
-                for node, verdict in zip(owned, pool.map(
-                        lambda n: self._worker_alive(
-                            n, self._address(n, snapshot)), owned)):
-                    verdicts[node] = verdict
+            # Shared fan-out core: a correlated failure (rack outage)
+            # still probes in parallel, but without a private pool and
+            # with per-shard budgets so a storm of probe timeouts can't
+            # crowd out the fleet collector's slots entirely.
+            from gpumounter_tpu.utils.fanout import get_core
+            core = get_core(self.cfg)
+            shard_of = None
+            if self.shards is not None and self.shards.active():
+                # getattr: tests stub ShardManager with active/owns_node
+                shard_of = getattr(self.shards, "owner_shard", None)
+            for node, verdict in zip(owned, core.run(
+                    owned,
+                    lambda n: self._worker_alive(
+                        n, self._address(n, snapshot)),
+                    kind="recovery-probe", shard_of=shard_of)):
+                verdicts[node] = verdict
         evacuated: list[str] = []
         suspect = 0
         for node in owned:

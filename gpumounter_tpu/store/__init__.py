@@ -12,7 +12,8 @@ exactly-once on reconnect. See store/base.py for the design stance.
 from gpumounter_tpu.store.base import MasterStore
 from gpumounter_tpu.store.cache import CachedMasterStore
 from gpumounter_tpu.store.k8s import KubeMasterStore
+from gpumounter_tpu.store.watch import WatchMasterStore
 from gpumounter_tpu.store.writebehind import WriteBehindQueue
 
 __all__ = ["MasterStore", "KubeMasterStore", "CachedMasterStore",
-           "WriteBehindQueue"]
+           "WatchMasterStore", "WriteBehindQueue"]

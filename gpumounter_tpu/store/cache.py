@@ -321,8 +321,15 @@ class CachedMasterStore(MasterStore):
                     for key, (stamp, _) in sorted(self._cache.items())}
 
     def payload(self) -> dict:
-        return {
+        out = {
             "cacheAgesS": self.staleness(),
             "maxStalenessS": self.max_staleness_s,
             "writeBehind": self.queue.stats(),
         }
+        # When the inner store is the watch/informer backend its sync
+        # state and index sizes belong on the same /apihealth pane the
+        # operator already reads during an incident.
+        inner_payload = getattr(self.inner, "payload", None)
+        if callable(inner_payload):
+            out["watch"] = inner_payload()
+        return out

@@ -1,0 +1,491 @@
+"""WatchMasterStore: the watch/informer-backed store for 10k-node fleets.
+
+The list-backed KubeMasterStore re-reads the whole pod population on
+every `list_intents`/`scan_journals` call (store/k8s.py) — exact, and
+fine at 1k nodes, but at 10k nodes every autoscale pass, journal scan
+and evacuation pays an O(fleet) LIST. This store does the informer
+protocol instead:
+
+  LIST once (with the collection resourceVersion) -> build in-memory
+  indexes -> WATCH from that version -> apply deltas -> on a clean
+  stream end re-WATCH from the last seen version -> on 410 Gone
+  (version expired past the server's watch window) re-LIST with
+  bounded exponential backoff — never a tight loop.
+
+Reads become O(result) dictionary lookups: intents and journals are
+maintained per-pod as events arrive, pool pods are bucketed by node.
+Writes go straight through the same annotation writes as the
+list-backed store AND update the indexes synchronously under an
+own-write overlay, so a replica always reads its own writes even while
+the watch stream is catching up (the overlay retires itself when the
+echo of the write arrives on the stream).
+
+Layering (master/app.py): CachedMasterStore(WatchMasterStore(kube)).
+The PR 10 semantics are preserved exactly because they live ABOVE this
+store: writes still hit the API (so ApiHealth sees outages and the
+write-behind queue defers them), and the `.kube` attribute the cache
+wrapper replays against is the same client. The two staleness stories
+are distinct on purpose — see docs/FAQ.md ("watch-staleness vs the
+outage cache"): a synced informer serves slightly-behind-the-watch
+reads with NO error (normal informer behavior), while before the first
+sync every read falls through to the list-backed path so errors
+propagate and the outage cache can do its job.
+
+Restart-resume parity: a fresh instance rebuilds the same view from
+the cluster (the LIST) — tests/test_store.py runs every store contract
+test against both backends.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections.abc import Iterator
+from copy import deepcopy
+
+from gpumounter_tpu.config import get_config
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.k8s.errors import GoneError, classify_exception
+from gpumounter_tpu.k8s.types import Pod, match_label_selector
+from gpumounter_tpu.store.base import MasterStore
+from gpumounter_tpu.store.k8s import KubeMasterStore
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("store.watch")
+
+WATCH_EVENTS = REGISTRY.counter(
+    "tpumounter_watch_store_events_total",
+    "watch events applied to the store indexes, by kind")
+WATCH_RELISTS = REGISTRY.counter(
+    "tpumounter_watch_store_relists_total",
+    "full re-LISTs of the watch store, by reason")
+WATCH_FALLBACK_READS = REGISTRY.counter(
+    "tpumounter_watch_store_fallback_reads_total",
+    "reads served by the list-backed path because the indexes were "
+    "not yet synced")
+WATCH_SYNCED = REGISTRY.gauge(
+    "tpumounter_watch_store_synced",
+    "1 while the watch store indexes are primed and serving reads")
+
+
+class WatchMasterStore(MasterStore):
+    """Informer-backed MasterStore; wraps the list-backed store for
+    writes and for reads before the first sync."""
+
+    def __init__(self, kube: KubeClient, cfg=None, start: bool = True):
+        self.kube = kube
+        self.cfg = cfg or get_config()
+        #: the annotation write paths and the pre-sync read fallback —
+        #: byte-for-byte the list-backed behavior.
+        self.inner = KubeMasterStore(kube, self.cfg)
+        self._mu = OrderedLock("store.watch")
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        #: (ns, name) -> pod dict (the full population)
+        self._pods: dict[tuple[str, str], dict] = {}
+        #: worker-pod name -> pod (worker namespace + label selector)
+        self._workers: dict[tuple[str, str], dict] = {}
+        #: (ns, name) -> parsed Intent
+        self._intents: dict[tuple[str, str], object] = {}
+        #: (ns, name) -> parsed journal dict
+        self._journals: dict[tuple[str, str], dict] = {}
+        #: node -> {(ns, name) -> pod} (pool namespace only)
+        self._pool_by_node: dict[str, dict[tuple[str, str], dict]] = {}
+        #: own-write overlays: (ns, name) -> {annotation: value|None}.
+        #: Merged over incoming events for that pod until the stream
+        #: echoes the write back (read-your-writes within a replica).
+        self._overlays: dict[tuple[str, str], dict[str, str | None]] = {}
+        self._rv = ""
+        self.relists = 0
+        self.events_applied = 0
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="watch-store", daemon=True)
+            self._thread.start()
+
+    # --- informer loop ---
+
+    def _loop(self) -> None:
+        backoff = float(self.cfg.store_watch_relist_base_s)
+        cap = float(self.cfg.store_watch_relist_cap_s)
+        need_list = True
+        reason = "initial"
+        while not self._stop.is_set():
+            if need_list:
+                try:
+                    self._relist(reason)
+                except Exception as exc:  # noqa: BLE001 — outage: keep
+                    # serving the last-synced indexes, retry bounded
+                    logger.warning("watch-store relist failed: %s",
+                                   classify_exception(exc))
+                    if self._stop.wait(backoff +
+                                       random.uniform(0, backoff / 2)):
+                        return
+                    backoff = min(cap, backoff * 2)
+                    continue
+                need_list = False
+                backoff = float(self.cfg.store_watch_relist_base_s)
+            try:
+                stream = self.kube.watch_pods(
+                    "", timeout_s=float(self.cfg.store_watch_timeout_s),
+                    resource_version=self._rv)
+                for etype, pod in stream:
+                    if self._stop.is_set():
+                        return
+                    self._apply_event(etype, pod)
+                # Clean end (server-side timeout, or the fake's trimmed
+                # backlog ending the stream silently): re-open from the
+                # last seen version. If that version already expired,
+                # the open raises GoneError and we re-LIST.
+            except GoneError:
+                # _relist() counts the relist (by reason) when it
+                # completes — counting here too double-counted a gone.
+                logger.info("watch expired (410 Gone); re-listing")
+                need_list = True
+                reason = "gone"
+                if self._stop.wait(backoff +
+                                   random.uniform(0, backoff / 2)):
+                    return
+                backoff = min(cap, backoff * 2)
+            except Exception as exc:  # noqa: BLE001 — transport blip /
+                # partition: indexes keep serving, watch retries bounded
+                logger.warning("watch stream failed: %s",
+                               classify_exception(exc))
+                if self._stop.wait(backoff +
+                                   random.uniform(0, backoff / 2)):
+                    return
+                backoff = min(cap, backoff * 2)
+
+    def _relist(self, reason: str) -> None:
+        pods, rv = self.kube.list_pods_with_rv()
+        with self._mu:
+            self._pods.clear()
+            self._workers.clear()
+            self._intents.clear()
+            self._journals.clear()
+            self._pool_by_node.clear()
+            # A LIST strictly after a completed write reflects it:
+            # every overlay is covered by the fresh view.
+            self._overlays.clear()
+            for pod in pods:
+                self._index(pod)
+            self._rv = rv
+            self.relists += 1
+        self._synced.set()
+        WATCH_SYNCED.set(1)
+        WATCH_RELISTS.inc(reason=reason)
+        logger.info("watch-store primed: %d pods at rv=%s (%s)",
+                    len(pods), rv or "?", reason)
+
+    def _apply_event(self, etype: str, pod: dict) -> None:
+        key = (Pod(pod).namespace, Pod(pod).name)
+        with self._mu:
+            overlay = self._overlays.get(key)
+            if overlay is not None and etype != "DELETED":
+                annots = (pod.get("metadata", {})
+                          .get("annotations") or {})
+                if all(annots.get(k) == v if v is not None
+                       else k not in annots
+                       for k, v in overlay.items()):
+                    # the stream caught up to our write: overlay done
+                    del self._overlays[key]
+                else:
+                    meta = pod.setdefault("metadata", {})
+                    merged = dict(meta.get("annotations") or {})
+                    for k, v in overlay.items():
+                        if v is None:
+                            merged.pop(k, None)
+                        else:
+                            merged[k] = v
+                    meta["annotations"] = merged
+            if etype == "DELETED":
+                self._overlays.pop(key, None)
+                self._deindex(key)
+            else:
+                self._index(pod)
+            rv = (pod.get("metadata", {}) or {}).get("resourceVersion")
+            if rv:
+                self._rv = str(rv)
+            self.events_applied += 1
+        WATCH_EVENTS.inc(kind=etype.lower() or "unknown")
+
+    # --- index maintenance (caller holds _mu) ---
+
+    def _index(self, pod: dict) -> None:
+        from gpumounter_tpu.elastic.intents import Intent, IntentError
+        from gpumounter_tpu.migrate.journal import parse_journal
+        p = Pod(pod)
+        key = (p.namespace, p.name)
+        prev = self._pods.get(key)
+        if prev is not None:
+            prev_node = Pod(prev).node_name
+            if prev_node and prev_node != p.node_name:
+                bucket = self._pool_by_node.get(prev_node)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del self._pool_by_node[prev_node]
+        self._pods[key] = pod
+        if p.namespace == self.cfg.worker_namespace and \
+                match_label_selector(p.labels,
+                                     self.cfg.worker_label_selector):
+            self._workers[key] = pod
+        else:
+            self._workers.pop(key, None)
+        try:
+            intent = Intent.from_annotations(p.annotations)
+        except IntentError as exc:
+            # parity with the list-backed skip-and-warn
+            logger.warning("skipping malformed intent on %s/%s: %s",
+                           p.namespace, p.name, exc)
+            intent = None
+        if intent is not None:
+            self._intents[key] = intent
+        else:
+            self._intents.pop(key, None)
+        journal = parse_journal(p.annotations)
+        if journal is not None:
+            self._journals[key] = journal
+        else:
+            self._journals.pop(key, None)
+        if p.namespace == self.cfg.pool_namespace and p.node_name:
+            self._pool_by_node.setdefault(p.node_name, {})[key] = pod
+        elif p.node_name:
+            bucket = self._pool_by_node.get(p.node_name)
+            if bucket is not None:
+                bucket.pop(key, None)
+
+    def _deindex(self, key: tuple[str, str]) -> None:
+        pod = self._pods.pop(key, None)
+        self._workers.pop(key, None)
+        self._intents.pop(key, None)
+        self._journals.pop(key, None)
+        if pod is not None:
+            node = Pod(pod).node_name
+            bucket = self._pool_by_node.get(node)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    del self._pool_by_node[node]
+
+    def _apply_own_write(self, namespace: str, pod_name: str,
+                         annotations: dict[str, str | None]) -> None:
+        """Synchronous index update after one of OUR annotation writes
+        landed on the API server (read-your-writes). The overlay keeps
+        the values pinned against older in-flight events until the
+        write's own event arrives."""
+        key = (namespace, pod_name)
+        with self._mu:
+            if not self._synced.is_set():
+                return  # pre-sync reads go to the fallback anyway
+            pod = self._pods.get(key)
+            if pod is None:
+                fetch_needed = True
+            else:
+                fetch_needed = False
+        if fetch_needed:
+            # The pod is not indexed yet (created between our LIST and
+            # this write): fetch it OUTSIDE the index lock — a slow GET
+            # must not stall the event-apply path.
+            try:
+                fetched = self.kube.get_pod(namespace, pod_name)
+            except Exception as exc:  # noqa: BLE001 — the write
+                # landed; the watch stream will deliver the pod shortly
+                logger.debug("own-write backfill get failed: %s",
+                             classify_exception(exc))
+                return
+            with self._mu:
+                if self._synced.is_set() and key not in self._pods:
+                    self._index(fetched)
+            return
+        with self._mu:
+            if not self._synced.is_set():
+                return
+            pod = self._pods.get(key)
+            if pod is None:
+                return  # deleted between the two regions; event wins
+            meta = pod.setdefault("metadata", {})
+            annots = dict(meta.get("annotations") or {})
+            for k, v in annotations.items():
+                if v is None:
+                    annots.pop(k, None)
+                else:
+                    annots[k] = v
+            meta["annotations"] = annots
+            self._index(pod)
+            overlay = self._overlays.setdefault(key, {})
+            overlay.update(annotations)
+
+    # --- read synchronization ---
+
+    def _ready(self) -> bool:
+        if self._synced.is_set():
+            return True
+        # Startup grace: the first LIST is usually in flight — give it
+        # a moment before paying a full list-backed read.
+        self._synced.wait(float(self.cfg.store_watch_sync_timeout_s))
+        if self._synced.is_set():
+            return True
+        WATCH_FALLBACK_READS.inc()
+        return False
+
+    def wait_synced(self, timeout_s: float = 30.0) -> bool:
+        return self._synced.wait(timeout_s)
+
+    def quiesce(self, timeout_s: float = 5.0) -> bool:
+        """Tests/benches: wait until the informer has drained the event
+        stream (no event applied for two consecutive polls)."""
+        deadline = time.monotonic() + timeout_s
+        last = -1
+        settled = 0
+        while time.monotonic() < deadline:
+            with self._mu:
+                n = self.events_applied
+            if n == last:
+                settled += 1
+                if settled >= 2 and not self._overlays:
+                    return True
+            else:
+                settled = 0
+            last = n
+            time.sleep(0.05)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._synced.set()  # release any _ready() waiters
+        WATCH_SYNCED.set(0)
+        if self._thread is not None:
+            # The informer may be parked inside an idle watch window up
+            # to store_watch_timeout_s long; it is a daemon thread, so
+            # wait one window then let it expire on its own.
+            self._thread.join(
+                timeout=float(self.cfg.store_watch_timeout_s) + 1.0)
+            self._thread = None
+
+    # --- MasterStore surface: reads from the indexes ---
+
+    def list_worker_pods(self) -> list[dict]:
+        if not self._ready():
+            return self.inner.list_worker_pods()
+        with self._mu:
+            return [deepcopy(p) for p in self._workers.values()]
+
+    def watch_worker_pods(self, timeout_s: float = 60.0,
+                          ) -> Iterator[tuple[str, dict]]:
+        # The registry runs its own informer; hand it the live stream.
+        return self.inner.watch_worker_pods(timeout_s=timeout_s)
+
+    def list_intents(self) -> list[tuple[str, str, object]]:
+        if not self._ready():
+            return self.inner.list_intents()
+        with self._mu:
+            return [(ns, name, intent)
+                    for (ns, name), intent in self._intents.items()]
+
+    def get_intent(self, namespace: str, pod_name: str):
+        from gpumounter_tpu.elastic.intents import Intent
+        key = (namespace, pod_name)
+        if self._ready():
+            with self._mu:
+                pod = self._pods.get(key)
+                if pod is not None:
+                    # re-parse so a malformed intent raises IntentError
+                    # exactly like the list-backed single-pod read
+                    return Intent.from_annotations(Pod(pod).annotations)
+        # Unknown pod: the informer may simply not have seen it yet —
+        # answer exactly (NotFoundError contract) from the live API.
+        return self.inner.get_intent(namespace, pod_name)
+
+    def put_intent(self, namespace: str, pod_name: str, intent) -> None:
+        self.inner.put_intent(namespace, pod_name, intent)
+        self._apply_own_write(namespace, pod_name,
+                              dict(intent.to_annotations()))
+
+    def delete_intent(self, namespace: str, pod_name: str) -> bool:
+        from gpumounter_tpu.elastic.intents import (
+            ANNOT_DESIRED,
+            ANNOT_MIN,
+            ANNOT_PRIORITY,
+            ANNOT_REPLACED,
+        )
+        clear: dict[str, str | None] = {
+            ANNOT_DESIRED: None, ANNOT_MIN: None,
+            ANNOT_PRIORITY: None, ANNOT_REPLACED: None}
+        if self._synced.is_set():
+            with self._mu:
+                pod = self._pods.get((namespace, pod_name))
+                had = pod is not None and ANNOT_DESIRED in (
+                    pod.get("metadata", {}).get("annotations") or {})
+            if pod is not None:
+                # `had` answered from the index: the list-backed shape
+                # pays a get_pod read per delete purely to compute it.
+                # The patch still goes straight to the API (a deleted
+                # pod raises NotFoundError exactly like inner's read).
+                self.kube.patch_pod(namespace, pod_name, {
+                    "metadata": {"annotations": dict(clear)}})
+                self._apply_own_write(namespace, pod_name, clear)
+                return had
+        had = self.inner.delete_intent(namespace, pod_name)
+        self._apply_own_write(namespace, pod_name, clear)
+        return had
+
+    def scan_journals(self) -> list[dict]:
+        if not self._ready():
+            return self.inner.scan_journals()
+        with self._mu:
+            return [deepcopy(j) for j in self._journals.values()]
+
+    def save_journal(self, journal: dict) -> None:
+        from gpumounter_tpu.migrate.journal import ANNOT_JOURNAL, dump
+        self.inner.save_journal(journal)
+        src = journal["source"]
+        self._apply_own_write(src["namespace"], src["pod"],
+                              {ANNOT_JOURNAL: dump(journal)})
+
+    def get_node(self, node_name: str) -> dict | None:
+        # Always live: evacuation safety reads must never ride a cache
+        # (the CachedMasterStore above holds the same line).
+        return self.inner.get_node(node_name)
+
+    def list_pool_pods(self, node_name: str) -> list[dict]:
+        if not self._ready():
+            return self.inner.list_pool_pods(node_name)
+        with self._mu:
+            bucket = self._pool_by_node.get(node_name) or {}
+            return [deepcopy(p) for p in bucket.values()]
+
+    def load_health_state(self) -> dict | None:
+        return self.inner.load_health_state()
+
+    def save_health_state(self, state: dict) -> None:
+        self.inner.save_health_state(state)
+
+    def stamp_annotation(self, namespace: str, pod_name: str,
+                         annotation: str, payload: str | None) -> None:
+        self.inner.stamp_annotation(namespace, pod_name, annotation,
+                                    payload)
+        self._apply_own_write(namespace, pod_name, {annotation: payload})
+
+    # --- diagnostics ---
+
+    def payload(self) -> dict:
+        with self._mu:
+            return {
+                "synced": self._synced.is_set(),
+                "resource_version": self._rv,
+                "relists": self.relists,
+                "events_applied": self.events_applied,
+                "overlays": len(self._overlays),
+                "indexes": {
+                    "pods": len(self._pods),
+                    "workers": len(self._workers),
+                    "intents": len(self._intents),
+                    "journals": len(self._journals),
+                    "pool_nodes": len(self._pool_by_node),
+                },
+            }

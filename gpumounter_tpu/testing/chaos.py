@@ -391,6 +391,17 @@ class ChaosHarness:
         self.autoscale_armed = False
         self.autoscale_passes: list[dict] = []
         self.autoscale_pods: list[tuple[str, str]] = []
+        #: run_watch_store_scenario arms this so check_invariants also
+        #: asserts invariant 22 (watch-store index parity): after
+        #: severed watches, 410 storms and a master restart the
+        #: informer's indexes must agree exactly with a fresh
+        #: list-backed view of the same cluster.
+        self.watchstore_armed = False
+        self.watch_store = None
+        self._watch_cfg = None
+        self._ws_serial = 0
+        self._ws_default: list[str] = []
+        self._ws_pool: dict[str, str] = {}
         self.app: MasterApp | None = None
 
     # --- lifecycle ---
@@ -549,6 +560,8 @@ class ChaosHarness:
     def stop(self) -> None:
         failpoints.disarm_all()
         self.stop_tenants()
+        if self.watch_store is not None:
+            self.watch_store.stop()
         if self.app is not None:
             self.app.recovery.stop()
             self.app.elastic.stop()
@@ -1310,6 +1323,206 @@ class ChaosHarness:
                          self.app.elastic.reconcile_once(ns, name))
         self.converge()
         return {"passes": self.autoscale_passes, "fired": fired}
+
+    # --- invariant 22: watch-store index parity under stream chaos ---
+
+    #: fictional hosts the watch-store churn schedules pool pods onto —
+    #: disjoint from the real worker nodes so nothing else (recovery,
+    #: health, bookings) ever operates on the churned population.
+    WS_POOL_NODES = ("wsnode-1", "wsnode-2", "wsnode-3")
+    WS_ANCHORS = ("ws-anchor-a", "ws-anchor-b")
+
+    def run_watch_store_scenario(self, churn_per_round: int = 40,
+                                 storm_events: int = 120) -> dict:
+        """Build the watch/informer-backed store over the live cluster
+        and batter its event stream with the three failure shapes the
+        informer protocol must survive, in seeded order: a severed
+        watch plus a churn storm far past a shrunken event backlog (so
+        the resume's resourceVersion has honestly expired — a 410
+        Gone), a full master restart (stop + fresh instance = relist
+        from scratch), and plain steady churn. check_invariants() then
+        holds invariant 22: the store's in-memory indexes agree
+        EXACTLY with a fresh list-backed view of the same cluster.
+
+        Returns {"rounds": flavor order, "payload": store diagnostics}.
+        """
+        from gpumounter_tpu.store import WatchMasterStore
+        failpoints.seed(self.seed)
+        self.watchstore_armed = True
+        kube = self.cluster.kube
+        # Shrink the fake apiserver's watch backlog: a storm round's
+        # churn must genuinely expire the informer's resourceVersion
+        # so its next resume is an honest 410 (the path under test).
+        kube._max_events = 64
+        self._watch_cfg = self.cfg.replace(store_watch_timeout_s=0.2,
+                                           store_watch_relist_base_s=0.02,
+                                           store_watch_relist_cap_s=0.2)
+        for anchor in self.WS_ANCHORS:
+            # Persistent write targets: intent/journal writes THROUGH
+            # the store land here (never deleted by the churn).
+            kube.create_pod("default", {
+                "metadata": {"name": anchor, "namespace": "default"},
+                "spec": {"nodeName": self.WS_POOL_NODES[0],
+                         "containers": [{"name": "c"}]},
+                "status": {"phase": "Running", "podIP": "10.99.0.1"},
+            })
+        self.watch_store = WatchMasterStore(kube, self._watch_cfg)
+        if not self.watch_store.wait_synced(10.0):
+            raise InvariantViolation(
+                f"watch store never primed (seed={self.seed})")
+        self.record("watch store primed (invariant 22 armed)")
+        flavors = ["storm", "restart", "steady"]
+        self.rng.shuffle(flavors)
+        relists_total = 0  # across instances (the restart replaces one)
+        for n, flavor in enumerate(flavors):
+            self.record(f"watch round {n}: {flavor}")
+            if flavor == "storm":
+                kube.set_partitioned(True, mode="reads")
+                time.sleep(0.3)  # the 0.2s watch window expires; the
+                # re-open fails against the partition — stream severed
+                self._watch_churn(storm_events)
+                kube.set_partitioned(False)
+                self.record(f"healed after {storm_events}-event storm "
+                            f"(backlog 64: the resume must 410)")
+                # Wait out the 410 -> re-LIST recovery HERE: a restart
+                # round right behind the heal would otherwise stop the
+                # instance mid-recovery and the storm proves nothing.
+                self._watch_settle(10.0)
+            elif flavor == "restart":
+                relists_total += self.watch_store.relists
+                self.watch_store.stop()
+                self.watch_store = WatchMasterStore(kube,
+                                                    self._watch_cfg)
+                if not self.watch_store.wait_synced(10.0):
+                    raise InvariantViolation(
+                        f"watch store never re-primed after restart "
+                        f"(seed={self.seed})")
+                self.record("watch store restarted (fresh relist)")
+                self._watch_churn(churn_per_round)
+            else:
+                self._watch_churn(churn_per_round)
+        # The churned journals are harness-synthetic (no migration
+        # machine ran them): clear them through the store so invariant
+        # 4's terminal-journal sweep judges only real machines. The
+        # clears themselves exercise the annotation-clear write path
+        # and overlay retirement one last time.
+        from gpumounter_tpu.migrate.journal import ANNOT_JOURNAL
+        for anchor in self.WS_ANCHORS:
+            self.watch_store.stamp_annotation("default", anchor,
+                                              ANNOT_JOURNAL, None)
+        self._watch_settle(10.0)
+        payload = self.watch_store.payload()
+        relists_total += payload["relists"]
+        self.record(f"watch store settled: relists={relists_total} "
+                    f"events={payload['events_applied']} "
+                    f"indexes={payload['indexes']}")
+        return {"rounds": flavors, "payload": payload,
+                "relists_total": relists_total}
+
+    def _watch_settle(self, timeout_s: float) -> bool:
+        """Poll until the watch store's pod index matches the live pod
+        count AND the stream has quiesced (a trimmed backlog can only
+        be crossed by the 410 -> re-LIST recovery, so this also waits
+        that recovery out)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            want = len(self.cluster.kube.list_pods_with_rv()[0])
+            if self.watch_store.payload()["indexes"]["pods"] == want \
+                    and self.watch_store.quiesce(1.0):
+                return True
+        return False
+
+    def _watch_churn(self, n_events: int) -> None:
+        """Seeded population churn for the watch-store scenario: every
+        operation is a WRITE against the fake apiserver (creates,
+        annotation patches, reschedules, deletes, plus intent/journal
+        writes through the store itself), so storms run cleanly under
+        a reads-only partition while the event backlog overflows."""
+        from gpumounter_tpu.elastic.intents import Intent
+        from gpumounter_tpu.migrate.journal import new_journal
+        kube = self.cluster.kube
+        pool_ns = self.cfg.pool_namespace
+        emitted = 0
+        while emitted < n_events:
+            roll = self.rng.random()
+            if roll < 0.30:  # a new intent-bearing tenant pod
+                name = f"ws-{self._ws_serial}"
+                self._ws_serial += 1
+                kube.create_pod("default", {
+                    "metadata": {
+                        "name": name, "namespace": "default",
+                        "annotations": {"tpumounter.io/desired-chips":
+                                        str(self.rng.randint(1, 4))}},
+                    "spec": {"nodeName":
+                             self.rng.choice(self.WS_POOL_NODES),
+                             "containers": [{"name": "c"}]},
+                    "status": {"phase": "Running",
+                               "podIP": "10.99.0.2"},
+                })
+                self._ws_default.append(name)
+            elif roll < 0.45 and self._ws_default:  # intent flips
+                name = self.rng.choice(self._ws_default)
+                kube.patch_pod("default", name, {
+                    "metadata": {"annotations":
+                                 {"tpumounter.io/desired-chips":
+                                  str(self.rng.randint(1, 4))}}})
+            elif roll < 0.60:  # a new pool pod
+                name = f"ws-pool-{self._ws_serial}"
+                self._ws_serial += 1
+                node = self.rng.choice(self.WS_POOL_NODES)
+                kube.create_pod(pool_ns, {
+                    "metadata": {"name": name, "namespace": pool_ns},
+                    "spec": {"nodeName": node,
+                             "containers": [{"name": "c"}]},
+                    "status": {"phase": "Running",
+                               "podIP": "10.99.0.3"},
+                })
+                self._ws_pool[name] = node
+            elif roll < 0.72 and self._ws_pool:  # pool pod reschedules
+                name = self.rng.choice(sorted(self._ws_pool))
+                node = self.rng.choice(self.WS_POOL_NODES)
+                kube.patch_pod(pool_ns, name,
+                               {"spec": {"nodeName": node}})
+                self._ws_pool[name] = node
+            elif roll < 0.82 and len(self._ws_default) > 2:
+                name = self._ws_default.pop(
+                    self.rng.randrange(len(self._ws_default)))
+                kube.delete_pod("default", name)
+            elif roll < 0.90 and len(self._ws_pool) > 1:
+                name = sorted(self._ws_pool)[
+                    self.rng.randrange(len(self._ws_pool))]
+                del self._ws_pool[name]
+                kube.delete_pod(pool_ns, name)
+            elif roll < 0.96:  # a write THROUGH the store: the
+                # read-your-writes overlay works under stream chaos
+                anchor = self.rng.choice(self.WS_ANCHORS)
+                self.watch_store.put_intent(
+                    "default", anchor,
+                    Intent(desired_chips=self.rng.randint(1, 4),
+                           min_chips=1))
+            else:  # a journal save through the store (pure patch)
+                src, dst = self.WS_ANCHORS if self.rng.random() < 0.5 \
+                    else tuple(reversed(self.WS_ANCHORS))
+                journal = new_journal(f"ws-mig-{self._ws_serial}",
+                                      "default", src, "default", dst)
+                self._ws_serial += 1
+                journal["phase"] = "drain"
+                self.watch_store.save_journal(journal)
+            emitted += 1
+
+    def poison_watch_index(self) -> None:
+        """NEGATIVE CONTROL for invariant 22: corrupt one indexed
+        intent in place — the stale-cache entry a missed event or a
+        buggy overlay merge would leave behind. Nothing changed on the
+        API server, so no event, quiesce, or clean stream re-open will
+        ever repair it; check_invariants() must flag the divergence."""
+        from gpumounter_tpu.elastic.intents import Intent
+        store = self.watch_store
+        key = ("default", self.WS_ANCHORS[0])
+        with store._mu:
+            store._intents[key] = Intent(desired_chips=97, min_chips=1)
+        self.record(f"negative control: poisoned watch-store intent "
+                    f"index for {key[0]}/{key[1]} (stale entry)")
 
     # --- invariant 11: node kill -> evacuation -> re-convergence ---
 
@@ -2304,6 +2517,24 @@ class ChaosHarness:
                         f"chip(s) but {mounted} are mounted after "
                         f"convergence")
 
+        # 22. watch-store index parity (armed by
+        # run_watch_store_scenario): after severed watches, 410 storms
+        # and a master restart, the informer's in-memory indexes —
+        # worker pods, intents, journals, per-node pool buckets — must
+        # agree EXACTLY with a fresh list-backed view of the same
+        # cluster. The comparison polls briefly (the stream is
+        # eventually consistent by design) but a divergence that
+        # outlives the deadline is a lost/phantom entry: a poisoned
+        # index (the negative control) reads as exactly that.
+        if self.watchstore_armed:
+            deadline = time.monotonic() + 4.0
+            while True:
+                self.watch_store.quiesce(1.0)
+                watch_diverged = self._watch_parity()
+                if not watch_diverged or time.monotonic() > deadline:
+                    break
+            violations.extend(watch_diverged)
+
         # 7. no leaked channels: exact pool accounting under chaos.
         stats = self.channel_pool.stats()
         if stats["dialed"] != stats["live"] + stats["closed"]:
@@ -2339,6 +2570,53 @@ class ChaosHarness:
                 f"chaos invariants violated (seed={self.seed}):\n- "
                 + "\n- ".join(violations)
                 + f"\nschedule tail:\n  {tail}")
+
+    def _watch_parity(self) -> list[str]:
+        """Invariant 22's comparison: every watch-store index against a
+        fresh list-backed store reading the same cluster."""
+        from gpumounter_tpu.store import KubeMasterStore
+        out: list[str] = []
+        store = self.watch_store
+        cfg = self._watch_cfg
+        ref = KubeMasterStore(self.cluster.kube, cfg)
+
+        def _names(pods):
+            return sorted((p["metadata"]["namespace"],
+                           p["metadata"]["name"]) for p in pods)
+
+        got = _names(store.list_worker_pods())
+        want = _names(ref.list_worker_pods())
+        if got != want:
+            out.append(f"invariant 22: worker index diverges from a "
+                       f"fresh LIST: indexed {got} != listed {want}")
+        by_pod = lambda t: (t[0], t[1])  # noqa: E731
+        got_i = sorted(store.list_intents(), key=by_pod)
+        want_i = sorted(ref.list_intents(), key=by_pod)
+        if got_i != want_i:
+            out.append(f"invariant 22: intent index diverges from a "
+                       f"fresh LIST: indexed {got_i} != listed {want_i}")
+        got_j = sorted(store.scan_journals(), key=lambda j: j["id"])
+        want_j = sorted(ref.scan_journals(), key=lambda j: j["id"])
+        if got_j != want_j:
+            out.append(f"invariant 22: journal index diverges from a "
+                       f"fresh LIST: indexed "
+                       f"{[j['id'] for j in got_j]} != listed "
+                       f"{[j['id'] for j in want_j]}")
+        nodes = {Pod(p).node_name
+                 for p in self.cluster.kube.list_pods(cfg.pool_namespace)
+                 if Pod(p).node_name}
+        with store._mu:
+            nodes |= set(store._pool_by_node)
+        for node in sorted(nodes):
+            got_p = sorted(p["metadata"]["name"]
+                           for p in store.list_pool_pods(node))
+            want_p = sorted(p["metadata"]["name"]
+                            for p in ref.list_pool_pods(node))
+            if got_p != want_p:
+                out.append(f"invariant 22: pool bucket for {node} "
+                           f"diverges from a fresh LIST: indexed "
+                           f"{got_p} != listed {want_p}")
+        return out
 
     def _throttle_agreement(self, books: dict) -> list[str]:
         """Invariant 19's decision-parity half: drive one metered share

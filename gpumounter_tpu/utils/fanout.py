@@ -1,0 +1,226 @@
+"""Shared bounded fan-out core for the master's hot parallel paths.
+
+Before this module every fan-out owned a fixed-width pool: the fleet
+collector built a fresh 16-thread ThreadPoolExecutor per collect pass,
+the recovery controller another per probe pass, bulk mounts spawned a
+thread wave per node group and the canary prober ran serially. At 1k
+nodes that is merely wasteful; at 10k nodes a collect pass serializes
+10k worker RPCs behind 16 threads while three other subsystems do the
+same thing next to it with their own 16.
+
+One process-wide executor replaces them:
+
+  * width sized to the host (cfg.fanout_width, 0 = auto), shared by
+    collect / probe / bulk dispatch / canary — a pass's parallelism is
+    no longer its private constant,
+  * per-shard concurrency budgets (cfg.fanout_shard_budget): within one
+    run() call, items mapping to the same shard occupy at most
+    budget slots, so one slow rack cannot camp the whole core and
+    stall an unrelated shard's work,
+  * order-preserving results with the submitting pass's error
+    semantics (first exception re-raised after the pass drains, like
+    the `pool.map` the call sites used),
+  * re-entrancy safe: a task that itself fans out (a proxied bulk
+    sub-batch mounting locally) falls back to transient threads
+    instead of submitting to the pool it is running on — the classic
+    nested-executor starvation deadlock cannot happen.
+
+The instruments stay fleet-scalar: tasks by the bounded kind
+vocabulary, plus one in-flight gauge. Node names never become labels.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent import futures
+
+from gpumounter_tpu.utils.locks import OrderedLock
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("fanout")
+
+FANOUT_TASKS = REGISTRY.counter(
+    "tpumounter_fanout_tasks_total",
+    "tasks executed on the shared fan-out core, by kind")
+FANOUT_INFLIGHT = REGISTRY.gauge(
+    "tpumounter_fanout_inflight",
+    "tasks currently running on the shared fan-out core")
+FANOUT_SHARD_WAITS = REGISTRY.counter(
+    "tpumounter_fanout_shard_waits_total",
+    "task submissions parked behind a per-shard concurrency budget")
+
+
+def _auto_width() -> int:
+    return max(32, 4 * (os.cpu_count() or 8))
+
+
+class FanoutCore:
+    """One bounded executor shared by every master fan-out path."""
+
+    def __init__(self, cfg=None):
+        if cfg is None:
+            from gpumounter_tpu.config import get_config
+            cfg = get_config()
+        self.width = int(cfg.fanout_width) or _auto_width()
+        self.shard_budget = int(cfg.fanout_shard_budget)
+        self._pool = futures.ThreadPoolExecutor(
+            max_workers=self.width, thread_name_prefix="fanout-core")
+        self._in_core = threading.local()
+
+    # --- plumbing ---
+
+    def _call(self, fn, item, kind: str):
+        self._in_core.active = True
+        FANOUT_INFLIGHT.inc()
+        try:
+            return fn(item)
+        finally:
+            FANOUT_INFLIGHT.dec()
+            FANOUT_TASKS.inc(kind=kind)
+            self._in_core.active = False
+
+    def _nested(self) -> bool:
+        return bool(getattr(self._in_core, "active", False))
+
+    def submit(self, fn, item, *, kind: str = "task") -> futures.Future:
+        """One task on the core (no shard budget — single submissions
+        are the caller's own concurrency decision). Safe from a core
+        task: falls back to a transient thread."""
+        if self._nested():
+            fut: futures.Future = futures.Future()
+
+            def _run():
+                try:
+                    fut.set_result(self._call(fn, item, kind))
+                except BaseException as exc:  # noqa: BLE001 — boundary
+                    fut.set_exception(exc)
+
+            threading.Thread(target=_run, daemon=True,
+                             name="fanout-nested").start()
+            return fut
+        return self._pool.submit(self._call, fn, item, kind)
+
+    def run(self, items, fn, *, kind: str = "task", shard_of=None,
+            shard_budget: int | None = None) -> list:
+        """fn(item) for every item, results in item order.
+
+        shard_of(item) -> hashable names the item's shard; items of one
+        shard hold at most shard_budget (default cfg) core slots at a
+        time, so a stalled shard's tasks queue behind their budget
+        while other shards keep flowing. The first exception re-raises
+        after all items finish (pool.map parity — call sites that want
+        per-item degradation catch inside fn)."""
+        items = list(items)
+        if not items:
+            return []
+        budget = self.shard_budget if shard_budget is None \
+            else int(shard_budget)
+        if self._nested():
+            return self._run_transient(items, fn, kind, shard_of, budget)
+
+        results: list = [None] * len(items)
+        first_error: list[BaseException | None] = [None]
+        inflight: dict[futures.Future, tuple[int, object]] = {}
+        shard_load: dict[object, int] = {}
+        waiting: dict[object, deque[int]] = {}
+
+        def shard_key(i: int):
+            if shard_of is None or budget <= 0:
+                return None
+            try:
+                return shard_of(items[i])
+            except Exception:  # noqa: BLE001 — a broken key fn must
+                # not fail the pass; unkeyed items are unbudgeted
+                return None
+
+        def start(i: int, key) -> None:
+            if key is not None:
+                shard_load[key] = shard_load.get(key, 0) + 1
+            inflight[self._pool.submit(self._call, fn, items[i],
+                                       kind)] = (i, key)
+
+        for i in range(len(items)):
+            key = shard_key(i)
+            if key is not None and shard_load.get(key, 0) >= budget:
+                waiting.setdefault(key, deque()).append(i)
+                FANOUT_SHARD_WAITS.inc()
+            else:
+                start(i, key)
+        while inflight:
+            done, _ = futures.wait(list(inflight),
+                                   return_when=futures.FIRST_COMPLETED)
+            for fut in done:
+                i, key = inflight.pop(fut)
+                try:
+                    results[i] = fut.result()
+                except BaseException as exc:  # noqa: BLE001 — drain
+                    # the whole pass first, re-raise after (map parity)
+                    if first_error[0] is None:
+                        first_error[0] = exc
+                if key is not None:
+                    shard_load[key] -= 1
+                    queue = waiting.get(key)
+                    if queue:
+                        start(queue.popleft(), key)
+                        if not queue:
+                            del waiting[key]
+        if first_error[0] is not None:
+            raise first_error[0]
+        return results
+
+    def _run_transient(self, items, fn, kind, shard_of, budget) -> list:
+        """Nested-call fallback: bounded waves of transient threads
+        (the pre-core shape) — never submits to the pool the caller is
+        already running on."""
+        results: list = [None] * len(items)
+        errors: list = [None] * len(items)
+
+        def _one(i: int) -> None:
+            try:
+                results[i] = self._call(fn, items[i], kind)
+            except BaseException as exc:  # noqa: BLE001 — see run()
+                errors[i] = exc
+
+        width = max(1, budget if budget > 0 else self.width)
+        for base in range(0, len(items), width):
+            wave = [threading.Thread(target=_one, args=(i,), daemon=True,
+                                     name="fanout-nested")
+                    for i in range(base, min(base + width, len(items)))]
+            for th in wave:
+                th.start()
+            for th in wave:
+                th.join()
+        for exc in errors:
+            if exc is not None:
+                raise exc
+        return results
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+_CORE: FanoutCore | None = None
+_CORE_MU = OrderedLock("fanout.core")
+
+
+def get_core(cfg=None) -> FanoutCore:
+    """The process-wide core (sized by the first caller's cfg — one
+    process, one width, exactly like the metrics registry)."""
+    global _CORE
+    with _CORE_MU:
+        if _CORE is None:
+            _CORE = FanoutCore(cfg)
+        return _CORE
+
+
+def reset_core() -> None:
+    """Tests/benches: drop the global so the next get_core() re-sizes
+    from fresh config."""
+    global _CORE
+    with _CORE_MU:
+        if _CORE is not None:
+            _CORE.shutdown()
+        _CORE = None

@@ -55,6 +55,10 @@ def _reset_observability():
     # simulated outage must not leak a degraded verdict (which parks
     # destructive subsystem work) into the next test.
     k8s_health.reset_all()
+    # The shared fan-out core is sized from the first get_core() cfg;
+    # drop it so a test that shrinks fanout_width gets its own sizing.
+    from gpumounter_tpu.utils.fanout import reset_core
+    reset_core()
 
 
 @pytest.fixture()

@@ -528,6 +528,66 @@ def test_grow_holds_at_the_request_ceiling():
     assert store.puts == []
 
 
+def _two_tenant_contention(priorities):
+    """Two saturated tenants, one host with exactly ONE admissible
+    2-chip block: whoever is evaluated first claims it and the other
+    reads infeasible. Returns (ctrl, store, pass record)."""
+    cfg = Config().replace(autoscale_hysteresis=1,
+                           autoscale_cooldown_s=0.0,
+                           autoscale_max_step=2)
+    intents = {
+        ("default", "aaa-batch"): Intent(
+            desired_chips=4, min_chips=1,
+            priority=priorities["aaa-batch"]),
+        ("default", "zzz-prod"): Intent(
+            desired_chips=4, min_chips=1,
+            priority=priorities["zzz-prod"]),
+    }
+    nodes = {"h1": _node([0, 1],
+                         {i: "default/other" for i in range(2, 8)})}
+    ctrl, store, _, _ = _saturated(nodes, tenant="default/aaa-batch",
+                                   intents=intents, cfg=cfg)
+    last = _feed(ctrl.model, "default/zzz-prod",
+                 _mm_series([5, 10, 20, 40, 80, 160]))
+    for entry in nodes.values():
+        entry["tenants"]["default/zzz-prod"] = {**last,
+                                                "queue_depth": 50.0}
+    record = ctrl.evaluate_once()
+    return ctrl, store, record
+
+
+def test_priority_class_wins_contended_capacity():
+    """Under contention the higher tpumounter.io/priority tenant is
+    evaluated first and takes the only admissible block, even though it
+    sorts alphabetically last; the default-class tenant reads
+    infeasible against the claimed fleet."""
+    _, store, record = _two_tenant_contention(
+        {"aaa-batch": 0, "zzz-prod": 10})
+    d1, d2 = record["decisions"]
+    assert d1["tenant"] == "default/zzz-prod"
+    assert d1["action"] == "grow" and d1["to_chips"] == 6
+    assert d2["tenant"] == "default/aaa-batch"
+    assert d2["action"] == "hold" and d2["reason"] == "infeasible"
+    ((ns, pod, intent),) = store.puts
+    assert (ns, pod) == ("default", "zzz-prod")
+    assert intent.priority == 10  # actuation preserves the class
+
+
+def test_default_priority_class_keeps_stable_order():
+    """Equal (default) classes: today's alphabetical order — the
+    regression guard that priority classes change nothing unless a
+    tenant actually sets one."""
+    _, store, record = _two_tenant_contention(
+        {"aaa-batch": 0, "zzz-prod": 0})
+    d1, d2 = record["decisions"]
+    assert d1["tenant"] == "default/aaa-batch"
+    assert d1["action"] == "grow"
+    assert d2["tenant"] == "default/zzz-prod"
+    assert d2["action"] == "hold" and d2["reason"] == "infeasible"
+    ((ns, pod, _),) = store.puts
+    assert (ns, pod) == ("default", "aaa-batch")
+
+
 # --- HTTP surface over a bare MasterApp ----------------------------------
 
 

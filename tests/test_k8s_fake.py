@@ -248,3 +248,61 @@ def test_watch_expires_when_backlog_trimmed():
     kube.patch_pod("ns", "seed", {"metadata": {"annotations": {"z": "1"}}})
     etype, pod = next(iter(fresh))
     assert etype == "MODIFIED"
+
+
+def test_watch_backlog_knob_and_eviction_counter():
+    """TPUMOUNTER_WATCH_BACKLOG sizes the fake's event backlog, and
+    trimming past a live lagging watcher surfaces on
+    tpumounter_watch_backlog_evictions_total — the signal operators
+    watch to know a fleet's churn outruns the configured backlog."""
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.k8s.fake import WATCH_BACKLOG_EVICTIONS
+    cfg = Config().replace(watch_backlog_events=64)
+    kube = FakeKubeClient(cfg=cfg)
+    before = WATCH_BACKLOG_EVICTIONS.get()
+    lagging = iter(kube.watch_pods("ns", timeout_s=5.0))
+    kube.create_pod("ns", make_pod("seed", "ns"))
+    next(lagging)  # consume the ADDED: the watcher is live at cursor 1
+    for i in range(200):
+        kube.patch_pod("ns", "seed",
+                       {"metadata": {"annotations": {"i": str(i)}}})
+    assert WATCH_BACKLOG_EVICTIONS.get() > before
+    # the stranded stream ends instead of silently skipping events
+    assert list(lagging) == []
+    # and a resume from the pre-trim version is an honest 410
+    from gpumounter_tpu.k8s.errors import GoneError
+    with pytest.raises(GoneError):
+        kube.watch_pods("ns", timeout_s=1.0, resource_version="1")
+
+
+def test_watch_overrun_ends_stream_at_10k_pod_scale():
+    """10k pods churning through a default-sized backlog: a watcher that
+    opened before the storm must have its stream END promptly (the
+    silent-skip failure mode would hand an informer a view missing
+    thousands of pods with no signal to relist from)."""
+    from gpumounter_tpu.config import Config
+    cfg = Config().replace(watch_backlog_events=2048)
+    kube = FakeKubeClient(cfg=cfg)
+    lagging = iter(kube.watch_pods("ns", timeout_s=10.0))
+    kube.create_pod("ns", make_pod("seed", "ns"))
+    next(lagging)
+    t0 = time.monotonic()
+    for i in range(10_000):
+        kube.create_pod("ns", make_pod(f"p-{i}", "ns"))
+    created = time.monotonic() - t0
+    assert created < 30.0, f"10k-pod churn took {created:.1f}s"
+    t1 = time.monotonic()
+    leftovers = sum(1 for _ in lagging)
+    assert time.monotonic() - t1 < 2.0  # ended, not timed out
+    # whatever it streamed before falling off is a consecutive prefix —
+    # bounded by the backlog, never the full churn
+    assert leftovers <= 2048
+    # recovery path: LIST gives the full population + fresh rv to
+    # re-watch from (what the informer's relist does)
+    pods, rv = kube.list_pods_with_rv("ns")
+    assert len(pods) == 10_001
+    fresh = iter(kube.watch_pods("ns", timeout_s=5.0,
+                                 resource_version=rv))
+    kube.patch_pod("ns", "seed", {"metadata": {"annotations": {"z": "1"}}})
+    etype, pod = next(fresh)
+    assert etype == "MODIFIED" and Pod(pod).name == "seed"

@@ -42,6 +42,13 @@ from gpumounter_tpu.utils.metrics import REGISTRY
 #: passes counter and paused gauge are unlabeled — tenant names, trace
 #: ids and cooldown keys ride the JSON plane (/autoscale), never
 #: labels. No bump.
+#: Reviewed for ISSUE 20 (watch store + fan-out core): watch events by
+#: the 3-value kind vocabulary, relists by the bounded reason
+#: vocabulary, fan-out tasks by the fixed call-site kind vocabulary;
+#: fallback-reads/shard-waits/backlog-evictions counters and the
+#: synced/inflight gauges are unlabeled — pod names, node names and
+#: resourceVersions ride the store payload() diagnostics, never
+#: labels. No bump.
 SERIES_BUDGET = 400
 
 
@@ -378,6 +385,80 @@ def test_autoscale_plane_series_are_bounded():
     # the 256-slot table with the rest counted, not tracked
     assert ctrl.model.payload(now=1010.0)["tracked"] <= \
         Config().autoscale_max_tenants
+
+
+def test_watch_store_and_fanout_series_are_bounded(tmp_path):
+    """ISSUE 20 guard: a watch store indexing hundreds of distinct
+    pods across hundreds of distinct nodes — through churn, a 410
+    storm with backlog evictions, and relists — plus a fan-out pass
+    sharded over a hundred distinct node names, grows the exposition
+    only by the fixed watch/fan-out series: watch events by the
+    3-value kind vocabulary, relists by the bounded reason vocabulary,
+    fan-out tasks by the call-site kind vocabulary, and the unlabeled
+    fallback/shard-wait/backlog-eviction counters + synced/inflight
+    gauges. Pod names, node names and resourceVersions must never
+    become label values (they live in the store's payload()
+    diagnostics and the /fleet JSON plane)."""
+    import time
+
+    from gpumounter_tpu.config import Config
+    from gpumounter_tpu.k8s.fake import FakeKubeClient
+    from gpumounter_tpu.store import WatchMasterStore
+    from gpumounter_tpu.utils.fanout import FanoutCore
+
+    cfg = Config().replace(store_watch_timeout_s=0.2,
+                           store_watch_relist_base_s=0.02,
+                           store_watch_relist_cap_s=0.2,
+                           watch_backlog_events=64)
+    kube = FakeKubeClient(cfg=cfg)
+    for i in range(200):
+        kube.create_pod("default", {
+            "metadata": {"name": f"card-ws-{i}", "namespace": "default",
+                         "annotations": {"tpumounter.io/desired-chips":
+                                         str(i % 4 + 1)}},
+            "spec": {"nodeName": f"card-node-{i}",
+                     "containers": [{"name": "c"}]},
+            "status": {"phase": "Running"},
+        })
+    before = REGISTRY.series_count()
+    store = WatchMasterStore(kube, cfg)
+    try:
+        assert store.wait_synced(10.0)
+        # churn past the 64-event backlog under a read partition: the
+        # resume cursor expires (evictions fire), the heal is an honest
+        # 410 answered with a re-LIST — all through distinct pod names
+        kube.set_partitioned(True, mode="reads")
+        time.sleep(0.3)
+        for i in range(120):
+            kube.patch_pod("default", f"card-ws-{i}", {
+                "metadata": {"annotations":
+                             {"tpumounter.io/desired-chips": "2"}}})
+        kube.set_partitioned(False)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if store.relists >= 2 and store.quiesce(1.0):
+                break
+        assert len(store.list_intents()) == 200
+    finally:
+        store.stop()
+    core = FanoutCore(cfg.replace(fanout_width=8, fanout_shard_budget=2))
+    try:
+        out = core.run(range(300), lambda i: i,
+                       kind="fleet-collect",
+                       shard_of=lambda i: f"card-node-{i % 100}")
+        assert out == list(range(300))
+        core.run(range(50), lambda i: i, kind="recovery-probe",
+                 shard_of=lambda i: f"card-node-{i}")
+    finally:
+        core.shutdown()
+    grown = REGISTRY.series_count() - before
+    # 3 watch-event kinds + bounded relist reasons + fallback counter +
+    # synced gauge + 2 fan-out kinds here (8-value call-site
+    # vocabulary) + inflight gauge + shard-waits + backlog evictions
+    assert grown <= 12, (
+        f"watch/fan-out plane grew {grown} series — an unbounded label "
+        f"(pod name? node name? resourceVersion?) slipped into an "
+        f"instrument")
 
 
 def test_tenant_label_cardinality_is_capped():

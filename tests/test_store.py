@@ -5,6 +5,12 @@ one store instance — intents, migration journals, worker registry —
 must be rebuilt IDENTICALLY by a freshly-constructed instance reading
 the same cluster. That is the whole basis for shard takeover and for
 N-replica masters sharing one view with no replica-local database.
+
+Parameterized over BOTH backends (ISSUE 20): the list-backed
+KubeMasterStore and the watch/informer-backed WatchMasterStore face
+identical contract assertions — a fresh watch store's LIST-primed
+indexes must answer exactly like a fresh list-backed store reading the
+same cluster.
 """
 
 from __future__ import annotations
@@ -16,7 +22,7 @@ from gpumounter_tpu.elastic.intents import Intent, IntentStore
 from gpumounter_tpu.k8s.client import NotFoundError
 from gpumounter_tpu.k8s.fake import FakeKubeClient
 from gpumounter_tpu.migrate.journal import new_journal
-from gpumounter_tpu.store import KubeMasterStore
+from gpumounter_tpu.store import KubeMasterStore, WatchMasterStore
 
 
 @pytest.fixture()
@@ -29,6 +35,39 @@ def cfg():
     return Config()
 
 
+@pytest.fixture(params=["kube", "watch"])
+def make_store(request, kube, cfg):
+    """Factory for a fresh store instance over the shared cluster —
+    'fresh instance' IS the restart being tested. Watch-backed stores
+    wait for their initial LIST before the test proceeds."""
+    created = []
+
+    # Short watch timeout so teardown's stop() (which must wait out an
+    # idle watch window) returns promptly.
+    watch_cfg = cfg.replace(store_watch_timeout_s=0.2)
+
+    def factory():
+        if request.param == "kube":
+            return KubeMasterStore(kube, cfg)
+        store = WatchMasterStore(kube, watch_cfg)
+        assert store.wait_synced(10.0), "informer never primed"
+        created.append(store)
+        return store
+
+    yield factory
+    for store in created:
+        store.stop()
+
+
+def _settle(store) -> None:
+    """Watch-backed stores serve another instance's writes only after
+    the event stream delivers them; list-backed stores are always
+    current. Contract tests call this before cross-instance reads."""
+    quiesce = getattr(store, "quiesce", None)
+    if quiesce is not None:
+        assert quiesce(5.0), "informer did not drain"
+
+
 def _pod(kube, name, namespace="default", node="node-0", labels=None):
     kube.create_pod(namespace, {
         "metadata": {"name": name, "namespace": namespace,
@@ -38,28 +77,29 @@ def _pod(kube, name, namespace="default", node="node-0", labels=None):
     })
 
 
-def test_intent_roundtrip_fresh_instance(kube, cfg):
+def test_intent_roundtrip_fresh_instance(kube, cfg, make_store):
     _pod(kube, "tenant-a")
     _pod(kube, "tenant-b", namespace="jobs")
-    writer = KubeMasterStore(kube, cfg)
+    writer = make_store()
     writer.put_intent("default", "tenant-a",
                       Intent(desired_chips=3, min_chips=1, priority=2))
     writer.put_intent("jobs", "tenant-b", Intent(desired_chips=1))
 
-    reader = KubeMasterStore(kube, cfg)  # fresh instance = restarted master
+    reader = make_store()  # fresh instance = restarted master
     assert sorted(reader.list_intents()) == sorted(writer.list_intents())
     got = reader.get_intent("default", "tenant-a")
     assert got == Intent(desired_chips=3, min_chips=1, priority=2)
     # Delete through the fresh instance; the original sees it gone too.
     assert reader.delete_intent("default", "tenant-a") is True
+    _settle(writer)
     assert writer.get_intent("default", "tenant-a") is None
 
 
-def test_intent_store_api_delegates_to_backend(kube, cfg):
+def test_intent_store_api_delegates_to_backend(kube, cfg, make_store):
     """IntentStore keeps its public CRUD surface; persistence rides the
     MasterStore seam (one backend shared by routes + reconciler)."""
     _pod(kube, "tenant-c")
-    backend = KubeMasterStore(kube, cfg)
+    backend = make_store()
     store = IntentStore(kube, cfg, backend=backend)
     store.put("default", "tenant-c", Intent(desired_chips=2))
     assert backend.get_intent("default", "tenant-c") == \
@@ -69,17 +109,17 @@ def test_intent_store_api_delegates_to_backend(kube, cfg):
         store.get("default", "never-created")
 
 
-def test_journal_roundtrip_fresh_instance(kube, cfg):
+def test_journal_roundtrip_fresh_instance(kube, cfg, make_store):
     _pod(kube, "src")
     _pod(kube, "dst", node="node-1")
-    writer = KubeMasterStore(kube, cfg)
+    writer = make_store()
     journal = new_journal("mig-roundtrip", "default", "src",
                           "default", "dst")
     journal["phase"] = "drain"
     journal["chips"] = ["tpu-a", "tpu-b"]
     writer.save_journal(journal)
 
-    reader = KubeMasterStore(kube, cfg)
+    reader = make_store()
     scanned = reader.scan_journals()
     assert len(scanned) == 1
     got = scanned[0]
@@ -87,40 +127,42 @@ def test_journal_roundtrip_fresh_instance(kube, cfg):
     assert got["phase"] == "drain"
     assert got["chips"] == ["tpu-a", "tpu-b"]
     assert got["outcome"] is None
-    # Byte-level parity between two fresh readers.
+    # Byte-level parity across backends: a fresh list-backed reader
+    # over the same cluster answers identically.
     assert reader.scan_journals() == \
         KubeMasterStore(kube, cfg).scan_journals()
 
 
-def test_journal_save_raises_when_source_gone(kube, cfg):
-    store = KubeMasterStore(kube, cfg)
+def test_journal_save_raises_when_source_gone(kube, cfg, make_store):
+    store = make_store()
     journal = new_journal("mig-gone", "default", "vanished",
                           "default", "dst")
     with pytest.raises(NotFoundError):
         store.save_journal(journal)
 
 
-def test_interrupted_journal_adopted_by_fresh_coordinator(kube, cfg):
+def test_interrupted_journal_adopted_by_fresh_coordinator(kube, cfg,
+                                                          make_store):
     """A non-terminal journal persisted by one master shows up in a
     freshly-built coordinator's listing — the restart-resume (and shard
     takeover) entry point."""
     from gpumounter_tpu.migrate.orchestrator import MigrationCoordinator
     _pod(kube, "src")
     _pod(kube, "dst", node="node-1")
-    first = KubeMasterStore(kube, cfg)
+    first = make_store()
     journal = new_journal("mig-interrupted", "default", "src",
                           "default", "dst")
     journal["phase"] = "remount"
     first.save_journal(journal)
 
     fresh = MigrationCoordinator(kube, registry=None, client_factory=None,
-                                 cfg=cfg, store=KubeMasterStore(kube, cfg))
+                                 cfg=cfg, store=make_store())
     listed = fresh.list_migrations()
     assert [j["id"] for j in listed] == ["mig-interrupted"]
     assert fresh.get("mig-interrupted")["phase"] == "remount"
 
 
-def test_worker_registry_rebuilt_identically(kube, cfg):
+def test_worker_registry_rebuilt_identically(kube, cfg, make_store):
     """Two registries over two fresh stores converge to the same
     node -> worker map from the cluster alone."""
     from gpumounter_tpu.master.app import WorkerRegistry
@@ -130,8 +172,8 @@ def test_worker_registry_rebuilt_identically(kube, cfg):
     _pod(kube, "not-a-worker", namespace=cfg.worker_namespace,
          node="node-9")
 
-    first = WorkerRegistry(kube, cfg, store=KubeMasterStore(kube, cfg))
-    second = WorkerRegistry(kube, cfg, store=KubeMasterStore(kube, cfg))
+    first = WorkerRegistry(kube, cfg, store=make_store())
+    second = WorkerRegistry(kube, cfg, store=make_store())
     try:
         snap_a = first.registry_snapshot()
         snap_b = second.registry_snapshot()
@@ -142,9 +184,9 @@ def test_worker_registry_rebuilt_identically(kube, cfg):
         second.stop()
 
 
-def test_stamp_annotation_write_and_clear(kube, cfg):
+def test_stamp_annotation_write_and_clear(kube, cfg, make_store):
     _pod(kube, "stamped")
-    store = KubeMasterStore(kube, cfg)
+    store = make_store()
     store.stamp_annotation("default", "stamped",
                            "tpumounter.io/migration-lock", '{"id":"m1"}')
     from gpumounter_tpu.k8s.types import Pod

@@ -1,0 +1,231 @@
+"""WatchMasterStore: informer protocol, 410 recovery, read-your-writes
+(ISSUE 20 tentpole).
+
+The contract under test: a synced watch store answers every MasterStore
+read from in-memory indexes with ZERO kubernetes LIST calls, stays
+exactly consistent with a fresh list-backed store over the same
+cluster, recovers from expired resourceVersions by bounded re-LIST
+(never a tight loop, never a silent gap), and always reads its own
+writes — while before the first sync every read falls through to the
+list-backed path so the PR 10 outage cache sees real errors.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gpumounter_tpu.config import Config
+from gpumounter_tpu.elastic.intents import Intent
+from gpumounter_tpu.k8s.errors import PartitionError
+from gpumounter_tpu.k8s.fake import FakeKubeClient
+from gpumounter_tpu.store import KubeMasterStore, WatchMasterStore
+
+
+@pytest.fixture()
+def cfg():
+    # Short watch windows: streams close fast, so partitions are
+    # noticed and teardown is prompt. Tiny backlog: churn can expire a
+    # resourceVersion within a test.
+    return Config().replace(store_watch_timeout_s=0.2,
+                            store_watch_relist_base_s=0.05,
+                            store_watch_relist_cap_s=0.2,
+                            watch_backlog_events=64)
+
+
+@pytest.fixture()
+def kube(cfg):
+    return FakeKubeClient(cfg=cfg)
+
+
+def _pod(kube, name, namespace="default", node="node-0", labels=None,
+         annotations=None):
+    kube.create_pod(namespace, {
+        "metadata": {"name": name, "namespace": namespace,
+                     **({"labels": labels} if labels else {}),
+                     **({"annotations": annotations}
+                        if annotations else {})},
+        "spec": {"nodeName": node, "containers": [{"name": "c"}]},
+        "status": {"phase": "Running", "podIP": "10.0.0.9"},
+    })
+
+
+def _synced_store(kube, cfg):
+    store = WatchMasterStore(kube, cfg)
+    assert store.wait_synced(10.0)
+    return store
+
+
+def _assert_parity(store, kube, cfg):
+    """The invariant-22 core: every indexed read agrees exactly with a
+    fresh list-backed store over the same cluster."""
+    ref = KubeMasterStore(kube, cfg)
+    assert sorted((p["metadata"]["namespace"], p["metadata"]["name"])
+                  for p in store.list_worker_pods()) == \
+        sorted((p["metadata"]["namespace"], p["metadata"]["name"])
+               for p in ref.list_worker_pods())
+    assert sorted(store.list_intents()) == sorted(ref.list_intents())
+    assert sorted(store.scan_journals(), key=lambda j: j["id"]) == \
+        sorted(ref.scan_journals(), key=lambda j: j["id"])
+
+
+def test_synced_reads_cost_zero_list_calls(kube, cfg):
+    for i in range(20):
+        _pod(kube, f"t-{i}",
+             annotations={"tpumounter.io/desired-chips": str(i % 4 + 1)})
+    store = _synced_store(kube, cfg)
+    try:
+        before = kube.list_calls
+        for _ in range(50):
+            assert len(store.list_intents()) == 20
+            store.scan_journals()
+            store.list_pool_pods("node-0")
+        assert kube.list_calls == before
+    finally:
+        store.stop()
+
+
+def test_watch_stream_reopen_without_relist(kube, cfg):
+    """Clean stream ends (the server-side watch window expiring) re-open
+    from the last seen resourceVersion: deltas keep flowing and the
+    store never pays another LIST."""
+    _pod(kube, "a")
+    store = _synced_store(kube, cfg)
+    try:
+        # outlive several 0.2s watch windows
+        for i in range(4):
+            time.sleep(0.25)
+            _pod(kube, f"late-{i}")
+        assert store.quiesce(5.0)
+        assert {name for _, name in store._pods} >= \
+            {"a", "late-0", "late-3"}
+        assert store.relists == 1  # the initial prime only
+    finally:
+        store.stop()
+
+
+def test_410_storm_relists_and_reconverges(kube, cfg):
+    """Partition the API, churn far past the watch backlog, heal: the
+    informer's next resume is an honest 410 Gone, answered with a
+    bounded re-LIST that reconverges the indexes exactly."""
+    _pod(kube, "seed")
+    store = _synced_store(kube, cfg)
+    try:
+        kube.set_partitioned(True, mode="reads")
+        time.sleep(0.5)  # current watch window expires; re-opens fail
+        for i in range(200):  # 200 >> 64: the old rv falls off
+            _pod(kube, f"storm-{i}",
+                 annotations={"tpumounter.io/desired-chips": "1"})
+        kube.set_partitioned(False)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if store.payload()["indexes"]["pods"] == 201 \
+                    and store.quiesce(1.0):
+                break
+        _assert_parity(store, kube, cfg)
+        assert store.relists >= 2  # prime + at least one 410 recovery
+    finally:
+        store.stop()
+
+
+def test_read_your_writes_while_stream_is_down(kube, cfg):
+    """A replica must see its own intent/journal writes immediately —
+    even when the watch stream cannot deliver the echo (reads
+    partitioned, writes healthy: the asymmetric-outage shape)."""
+    _pod(kube, "tenant")
+    store = _synced_store(kube, cfg)
+    try:
+        kube.set_partitioned(True, mode="reads")
+        store.put_intent("default", "tenant",
+                         Intent(desired_chips=4, min_chips=2))
+        got = store.get_intent("default", "tenant")
+        assert got == Intent(desired_chips=4, min_chips=2)
+        assert [(ns, n) for ns, n, _ in store.list_intents()] == \
+            [("default", "tenant")]
+        assert store.delete_intent("default", "tenant") is True
+        assert store.get_intent("default", "tenant") is None
+        kube.set_partitioned(False)
+    finally:
+        store.stop()
+
+
+def test_overlay_retires_when_stream_catches_up(kube, cfg):
+    _pod(kube, "tenant")
+    store = _synced_store(kube, cfg)
+    try:
+        store.put_intent("default", "tenant", Intent(desired_chips=2))
+        assert store.quiesce(5.0)  # quiesce also waits overlays out
+        assert store.payload()["overlays"] == 0
+        assert store.get_intent("default", "tenant") == \
+            Intent(desired_chips=2)
+    finally:
+        store.stop()
+
+
+def test_before_sync_reads_fall_through_to_lists(kube, cfg):
+    """An unsynced store answers from the list-backed path (and its
+    errors PROPAGATE — the PR 10 cache wrapper's contract: it must see
+    the outage, not a fresh-stamped empty answer)."""
+    _pod(kube, "tenant",
+         annotations={"tpumounter.io/desired-chips": "2"})
+    cfg = cfg.replace(store_watch_sync_timeout_s=0.05)
+    store = WatchMasterStore(kube, cfg, start=False)  # never syncs
+    before = kube.list_calls
+    assert [(ns, n) for ns, n, _ in store.list_intents()] == \
+        [("default", "tenant")]
+    assert kube.list_calls > before
+    kube.set_partitioned(True)
+    with pytest.raises(PartitionError):
+        store.scan_journals()
+    kube.set_partitioned(False)
+
+
+def test_layers_under_the_outage_cache(kube, cfg):
+    """CachedMasterStore(WatchMasterStore(...)): the PR 10 wrapper
+    finds the same .kube it replays write-behind against, and synced
+    reads flow through both layers."""
+    from gpumounter_tpu.store import CachedMasterStore
+    _pod(kube, "tenant",
+         annotations={"tpumounter.io/desired-chips": "3"})
+    inner = _synced_store(kube, cfg)
+    try:
+        outer = CachedMasterStore(inner, cfg=cfg)
+        assert inner.kube is kube
+        assert [(ns, n) for ns, n, _ in outer.list_intents()] == \
+            [("default", "tenant")]
+    finally:
+        inner.stop()
+
+
+def test_master_app_wires_watch_store_behind_flag(cfg):
+    """TPUMOUNTER_WATCH_STORE=1 swaps the inner store under the cache
+    wrapper; default stays list-backed."""
+    from gpumounter_tpu.master.app import MasterApp
+    on = cfg.replace(store_watch_enabled=True)
+    app = MasterApp(FakeKubeClient(cfg=on), cfg=on)
+    assert isinstance(app.store.inner, WatchMasterStore)
+    app.store.inner.stop()
+    off = Config()
+    app2 = MasterApp(FakeKubeClient(), cfg=off)
+    assert isinstance(app2.store.inner, KubeMasterStore)
+
+
+def test_pool_pods_index_tracks_node_moves(kube, cfg):
+    pool_ns = cfg.pool_namespace
+    _pod(kube, "p1", namespace=pool_ns, node="n1")
+    store = _synced_store(kube, cfg)
+    try:
+        assert [p["metadata"]["name"]
+                for p in store.list_pool_pods("n1")] == ["p1"]
+        # the pod reschedules onto another node
+        kube.patch_pod(pool_ns, "p1", {"spec": {"nodeName": "n2"}})
+        assert store.quiesce(5.0)
+        assert store.list_pool_pods("n1") == []
+        assert [p["metadata"]["name"]
+                for p in store.list_pool_pods("n2")] == ["p1"]
+        kube.delete_pod(pool_ns, "p1")
+        assert store.quiesce(5.0)
+        assert store.list_pool_pods("n2") == []
+    finally:
+        store.stop()
